@@ -1,0 +1,24 @@
+"""asyncrl_tpu — a TPU-native asynchronous reinforcement-learning framework.
+
+A ground-up JAX/XLA redesign with the capabilities of the ``PeerM/async-rl``
+reference (see SURVEY.md): A3C / IMPALA-V-trace / PPO-GAE actor-learner
+training behind a ``make_agent``/``Trainer`` API, where
+
+- per-thread CPU actor workers become a ``vmap``-ped ``jax.lax.scan`` over
+  batches of environments resident in HBM (Anakin pattern), or host env pools
+  feeding an on-device double buffer (Sebulba pattern),
+- the actor->learner queue becomes two HBM slots and an index,
+- ``Learner.update`` becomes a donated-buffer ``jit``/``shard_map`` step with
+  ``lax.psum`` gradient reduction over a ``jax.sharding.Mesh``.
+
+Reference parity: the reference mount was empty this session (SURVEY.md §0);
+API names (``make_agent``, ``Trainer``, ``ActorWorker``, ``RolloutBuffer``,
+``Learner``) follow the driver's north-star spec (BASELINE.json:5).
+"""
+
+__version__ = "0.1.0"
+
+from asyncrl_tpu.api.factory import make_agent
+from asyncrl_tpu.api.trainer import Trainer
+
+__all__ = ["make_agent", "Trainer", "__version__"]

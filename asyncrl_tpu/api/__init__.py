@@ -1,0 +1,4 @@
+from asyncrl_tpu.api.factory import make_agent
+from asyncrl_tpu.api.trainer import Trainer
+
+__all__ = ["Trainer", "make_agent"]

@@ -1,0 +1,47 @@
+"""``make_agent``: the reference's user-facing factory (BASELINE.json:5;
+SURVEY.md §3.4) — config -> assembled agent, with a ``backend`` selection
+point. ``backend="tpu"`` is the Anakin in-HBM path; ``backend="sebulba"``
+drives host envs against an on-device double buffer; ``backend="cpu_async"``
+is the thread-based parity path mirroring the reference's default A3C mode.
+"""
+
+from __future__ import annotations
+
+from asyncrl_tpu.utils.config import Config
+
+
+def make_agent(config: Config | None = None, **overrides):
+    """Build a Trainer for ``config``.
+
+    Any Config field can be passed as a keyword override, e.g.::
+
+        agent = make_agent(env_id="CartPole-v1", algo="impala", backend="tpu")
+        agent.train()
+    """
+    config = (config or Config()).replace(**overrides)
+
+    if config.backend == "tpu":
+        from asyncrl_tpu.api.trainer import Trainer
+
+        return Trainer(config)
+    if config.backend == "sebulba":
+        try:
+            from asyncrl_tpu.api.sebulba_trainer import SebulbaTrainer
+        except ImportError as e:
+            raise NotImplementedError(
+                "backend='sebulba' is not built yet (planned: host env pools "
+                "+ on-device double buffer)"
+            ) from e
+        return SebulbaTrainer(config)
+    if config.backend == "cpu_async":
+        try:
+            from asyncrl_tpu.api.cpu_async import CpuAsyncTrainer
+        except ImportError as e:
+            raise NotImplementedError(
+                "backend='cpu_async' is not built yet (planned: thread-based "
+                "parity path mirroring the reference's A3C mode)"
+            ) from e
+        return CpuAsyncTrainer(config)
+    raise ValueError(
+        f"unknown backend {config.backend!r}; expected tpu|sebulba|cpu_async"
+    )

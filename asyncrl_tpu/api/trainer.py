@@ -1,0 +1,142 @@
+"""Trainer: the user-facing training-loop owner, name-parity with the
+reference's ``Trainer`` (BASELINE.json:5; SURVEY.md §3.1).
+
+``Trainer.train()`` drives ``Learner.update`` and drains device-resident
+metrics to the host every ``log_every`` updates — the hot loop never blocks
+on host sync between drains. ``Trainer.evaluate()`` runs greedy episodes
+fully on device (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from asyncrl_tpu.envs import registry
+from asyncrl_tpu.learn.learner import Learner, TrainState
+from asyncrl_tpu.models.networks import build_model
+from asyncrl_tpu.parallel.mesh import make_mesh
+from asyncrl_tpu.utils.config import Config
+
+
+class Trainer:
+    """Owns env, model, mesh, learner, and the training loop."""
+
+    def __init__(self, config: Config, env=None, model=None, mesh=None):
+        self.config = config
+        self.env = env if env is not None else registry.make(config.env_id)
+        self.model = (
+            model if model is not None else build_model(config, self.env.spec)
+        )
+        self.mesh = (
+            mesh
+            if mesh is not None
+            else make_mesh(config.mesh_shape, config.mesh_axes)
+        )
+        self.learner = Learner(config, self.env, self.model, self.mesh)
+        self.state: TrainState = self.learner.init_state(config.seed)
+        self.env_steps = 0
+        self._eval_fns: dict[tuple[int, int], Callable] = {}
+
+    # ------------------------------------------------------------------ train
+
+    def train(
+        self,
+        total_env_steps: int | None = None,
+        callback: Callable[[dict[str, Any]], None] | None = None,
+    ) -> list[dict[str, Any]]:
+        """Run updates until ``total_env_steps`` env frames consumed.
+
+        Returns the list of drained metric dicts (one per ``log_every``
+        updates), each including ``env_steps``, ``fps``, and
+        ``episode_return`` (mean over episodes completed in the window).
+        """
+        cfg = self.config
+        target = total_env_steps or cfg.total_env_steps
+        steps_per_update = cfg.batch_steps_per_update
+        history: list[dict[str, Any]] = []
+
+        pending: list[dict[str, jax.Array]] = []
+        window_start = time.perf_counter()
+        window_steps = 0
+
+        while self.env_steps < target:
+            self.state, metrics = self.learner.update(self.state)
+            self.env_steps += steps_per_update
+            window_steps += steps_per_update
+            pending.append(metrics)
+
+            if len(pending) >= cfg.log_every or self.env_steps >= target:
+                drained = jax.device_get(pending)
+                pending = []
+                elapsed = time.perf_counter() - window_start
+                window_start = time.perf_counter()
+
+                agg = {
+                    k: float(sum(m[k] for m in drained) / len(drained))
+                    for k in drained[0]
+                    if not k.startswith("episode_")
+                }
+                ep_count = sum(m["episode_count"] for m in drained)
+                agg["episode_count"] = float(ep_count)
+                agg["episode_return"] = float(
+                    sum(m["episode_return_sum"] for m in drained)
+                    / max(ep_count, 1.0)
+                )
+                agg["episode_length"] = float(
+                    sum(m["episode_length_sum"] for m in drained)
+                    / max(ep_count, 1.0)
+                )
+                agg["env_steps"] = self.env_steps
+                agg["fps"] = window_steps / max(elapsed, 1e-9)
+                window_steps = 0
+                history.append(agg)
+                if callback:
+                    callback(agg)
+        return history
+
+    # ----------------------------------------------------------------- eval
+
+    def evaluate(
+        self, num_episodes: int = 32, max_steps: int = 1000, seed: int = 1234
+    ) -> float:
+        """Mean greedy-policy episode return over ``num_episodes`` fresh envs,
+        fully on device (one jitted scan)."""
+        cache_key = (num_episodes, max_steps)
+        if cache_key not in self._eval_fns:
+            env = self.env
+            apply_fn = self.model.apply
+
+            def eval_rollout(params, key):
+                init_keys = jax.random.split(key, num_episodes + 1)
+                env_state = jax.vmap(env.init)(init_keys[:-1])
+                obs = jax.vmap(env.observe)(env_state)
+                step_key = init_keys[-1]
+
+                def body(carry, _):
+                    env_state, obs, ret, alive, k = carry
+                    logits, _ = apply_fn(params, obs)
+                    actions = jnp.argmax(logits, axis=-1)
+                    k, sub = jax.random.split(k)
+                    step_keys = jax.random.split(sub, num_episodes)
+                    env_state, ts = jax.vmap(env.step)(env_state, actions, step_keys)
+                    ret = ret + ts.reward * alive
+                    alive = alive * (1.0 - ts.done.astype(jnp.float32))
+                    return (env_state, ts.obs, ret, alive, k), None
+
+                zeros = jnp.zeros((num_episodes,), jnp.float32)
+                (_, _, ret, _, _), _ = jax.lax.scan(
+                    body,
+                    (env_state, obs, zeros, zeros + 1.0, step_key),
+                    None,
+                    length=max_steps,
+                )
+                return jnp.mean(ret)
+
+            self._eval_fns[cache_key] = jax.jit(eval_rollout)
+        return float(
+            self._eval_fns[cache_key](self.state.params, jax.random.PRNGKey(seed))
+        )

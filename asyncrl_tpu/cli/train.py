@@ -1,0 +1,72 @@
+"""CLI entry: ``python -m asyncrl_tpu.cli.train <preset> [key=value ...]``.
+
+The reference family drives training through per-workload run scripts
+(SURVEY.md §1.2 L6); here one entry point + the preset registry covers all
+workloads (BASELINE.json:6-12), with ``key=value`` overrides (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="asyncrl-tpu",
+        description="Train an asyncrl_tpu agent from a workload preset.",
+    )
+    parser.add_argument("preset", help="preset name (see asyncrl_tpu.configs)")
+    parser.add_argument(
+        "overrides", nargs="*", help="config overrides as key=value"
+    )
+    parser.add_argument(
+        "--steps", type=int, default=None, help="override total_env_steps"
+    )
+    parser.add_argument(
+        "--eval-episodes", type=int, default=32,
+        help="greedy-eval episodes after training (0 to skip)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit one JSON line per window"
+    )
+    args = parser.parse_args(argv)
+
+    from asyncrl_tpu.api.factory import make_agent
+    from asyncrl_tpu.configs import presets
+    from asyncrl_tpu.utils.config import override
+
+    cfg = override(presets.get(args.preset), args.overrides)
+    if args.steps is not None:
+        cfg = cfg.replace(total_env_steps=args.steps)
+
+    agent = make_agent(cfg)
+
+    def report(window: dict) -> None:
+        if args.json:
+            print(json.dumps(window))
+        else:
+            print(
+                f"steps={window['env_steps']:>10}  "
+                f"fps={window['fps']:>12,.0f}  "
+                f"ep_return={window['episode_return']:8.2f}  "
+                f"loss={window['loss']:8.4f}  "
+                f"entropy={window['entropy']:6.3f}"
+            )
+        sys.stdout.flush()
+
+    agent.train(callback=report)
+
+    if args.eval_episodes:
+        ret = agent.evaluate(num_episodes=args.eval_episodes)
+        print(
+            json.dumps({"eval_episodes": args.eval_episodes, "mean_return": ret})
+            if args.json
+            else f"greedy eval over {args.eval_episodes} episodes: {ret:.1f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

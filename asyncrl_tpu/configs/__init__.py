@@ -1,0 +1,3 @@
+from asyncrl_tpu.configs.presets import PRESETS, get
+
+__all__ = ["PRESETS", "get"]

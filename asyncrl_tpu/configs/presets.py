@@ -1,0 +1,95 @@
+"""Workload presets mirroring the reference's five benchmark configs
+(BASELINE.json:6-12). Suites whose native deps are absent in this image
+(ale-py / procgen / brax — SURVEY.md §7.4 R1) target JAX-native stand-in
+envs (JaxPong-v0, JaxPendulum-v0); swap ``env_id`` when the real suites are
+installable. Presets whose stand-in env is not yet registered fail fast with
+a KeyError naming the registered envs.
+"""
+
+from __future__ import annotations
+
+from asyncrl_tpu.utils.config import Config
+
+# BASELINE.json:7 — "CartPole-v1, 4 async CPU actors, A3C (smoke test)".
+# The 4 async actors become a vectorized env batch on the tpu backend; the
+# cpu_async backend reproduces the literal 4-thread layout.
+cartpole_a3c = Config(
+    env_id="CartPole-v1",
+    algo="a3c",
+    backend="tpu",
+    num_envs=64,
+    unroll_len=32,
+    total_env_steps=400_000,
+    learning_rate=1e-3,
+    entropy_coef=0.01,
+    gamma=0.99,
+)
+
+# BASELINE.json:8 — "PongNoFrameskip-v4, IMPALA V-trace, 256 vectorized
+# envs". ale-py is unavailable; JaxPong-v0 is the JAX-native stand-in
+# (envs/pong.py) with Pong-like dynamics and reward scale.
+pong_impala = Config(
+    env_id="JaxPong-v0",
+    algo="impala",
+    backend="tpu",
+    num_envs=256,
+    unroll_len=32,
+    total_env_steps=5_000_000,
+    learning_rate=6e-4,
+    entropy_coef=0.01,
+    torso="mlp",
+    hidden_sizes=(256, 256),
+    actor_staleness=2,
+)
+
+# BASELINE.json:9 — "Atari-57 suite, IMPALA, 1024 envs/chip".
+atari_impala = pong_impala.replace(num_envs=1024, torso="impala_cnn")
+
+# BASELINE.json:10 — "Procgen-16, PPO + GAE, 4096 envs data-parallel".
+procgen_ppo = Config(
+    env_id="JaxPong-v0",
+    algo="ppo",
+    backend="tpu",
+    num_envs=4096,
+    unroll_len=16,
+    total_env_steps=10_000_000,
+    learning_rate=5e-4,
+    ppo_epochs=2,
+    ppo_minibatches=4,
+    torso="mlp",
+    hidden_sizes=(256, 256),
+)
+
+# BASELINE.json:11 — "Brax Ant/Humanoid, PPO, 8192 envs". brax absent; the
+# pure-JAX Pendulum swing-up (envs/pendulum.py, continuous-control classic)
+# is the on-TPU-physics stand-in.
+brax_ppo = Config(
+    env_id="JaxPendulum-v0",
+    algo="ppo",
+    backend="tpu",
+    num_envs=8192,
+    unroll_len=16,
+    total_env_steps=10_000_000,
+    learning_rate=3e-4,
+    gae_lambda=0.95,
+)
+
+# Extra smoke presets used by tests and quick benchmarking.
+cartpole_impala = cartpole_a3c.replace(algo="impala", actor_staleness=2)
+cartpole_ppo = cartpole_a3c.replace(algo="ppo", learning_rate=3e-4)
+
+PRESETS: dict[str, Config] = {
+    "cartpole_a3c": cartpole_a3c,
+    "cartpole_impala": cartpole_impala,
+    "cartpole_ppo": cartpole_ppo,
+    "pong_impala": pong_impala,
+    "atari_impala": atari_impala,
+    "procgen_ppo": procgen_ppo,
+    "brax_ppo": brax_ppo,
+}
+
+
+def get(name: str) -> Config:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]

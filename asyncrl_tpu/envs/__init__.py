@@ -1,0 +1,4 @@
+from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+from asyncrl_tpu.envs.registry import make, register, registered
+
+__all__ = ["Environment", "EnvSpec", "TimeStep", "make", "register", "registered"]

@@ -1,0 +1,89 @@
+"""Pure-JAX CartPole-v1 with gymnasium-identical dynamics.
+
+The reference's smoke-test workload is "CartPole-v1, 4 async CPU actors, A3C"
+(BASELINE.json:7). Here the env itself is JAX so thousands of instances run
+vectorized in HBM under ``vmap``; dynamics are the classic Barto-Sutton-
+Anderson cart-pole exactly as gymnasium 1.2 implements them (Euler
+integration, tau=0.02), validated trajectory-for-trajectory against
+``gymnasium.make("CartPole-v1")`` in tests/test_envs.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+
+GRAVITY = 9.8
+MASS_CART = 1.0
+MASS_POLE = 0.1
+TOTAL_MASS = MASS_CART + MASS_POLE
+HALF_POLE_LENGTH = 0.5
+POLE_MASS_LENGTH = MASS_POLE * HALF_POLE_LENGTH
+FORCE_MAG = 10.0
+TAU = 0.02
+THETA_THRESHOLD = 12 * 2 * jnp.pi / 360  # ~0.2095 rad
+X_THRESHOLD = 2.4
+MAX_STEPS = 500
+INIT_BOUND = 0.05
+
+
+@struct.dataclass
+class CartPoleState:
+    # physics state: [x, x_dot, theta, theta_dot]
+    phys: jax.Array
+    t: jax.Array  # step count within episode (int32)
+
+
+class CartPole(Environment):
+    """CartPole-v1: 4-dim observation, 2 actions, 500-step time limit."""
+
+    spec = EnvSpec(obs_shape=(4,), num_actions=2)
+
+    def init(self, key: jax.Array) -> CartPoleState:
+        phys = jax.random.uniform(key, (4,), jnp.float32, -INIT_BOUND, INIT_BOUND)
+        return CartPoleState(phys=phys, t=jnp.zeros((), jnp.int32))
+
+    def observe(self, state: CartPoleState) -> jax.Array:
+        return state.phys
+
+    def _physics(self, phys: jax.Array, action: jax.Array) -> jax.Array:
+        x, x_dot, theta, theta_dot = phys[0], phys[1], phys[2], phys[3]
+        force = jnp.where(action == 1, FORCE_MAG, -FORCE_MAG)
+        cos_t = jnp.cos(theta)
+        sin_t = jnp.sin(theta)
+        temp = (force + POLE_MASS_LENGTH * theta_dot**2 * sin_t) / TOTAL_MASS
+        theta_acc = (GRAVITY * sin_t - cos_t * temp) / (
+            HALF_POLE_LENGTH * (4.0 / 3.0 - MASS_POLE * cos_t**2 / TOTAL_MASS)
+        )
+        x_acc = temp - POLE_MASS_LENGTH * theta_acc * cos_t / TOTAL_MASS
+        # Euler integration, gymnasium's kinematics_integrator == "euler"
+        x = x + TAU * x_dot
+        x_dot = x_dot + TAU * x_acc
+        theta = theta + TAU * theta_dot
+        theta_dot = theta_dot + TAU * theta_acc
+        return jnp.stack([x, x_dot, theta, theta_dot])
+
+    def step(
+        self, state: CartPoleState, action: jax.Array, key: jax.Array
+    ) -> tuple[CartPoleState, TimeStep]:
+        phys = self._physics(state.phys, action)
+        t = state.t + 1
+        terminated = (
+            (jnp.abs(phys[0]) > X_THRESHOLD) | (jnp.abs(phys[2]) > THETA_THRESHOLD)
+        )
+        truncated = (t >= MAX_STEPS) & ~terminated
+        done = terminated | truncated
+        reset_state = self.init(key)
+        new_phys = jnp.where(done, reset_state.phys, phys)
+        new_t = jnp.where(done, reset_state.t, t)
+        ts = TimeStep(
+            obs=new_phys,
+            reward=jnp.float32(1.0),
+            terminated=terminated,
+            truncated=truncated,
+            last_obs=phys,
+        )
+        return CartPoleState(phys=new_phys, t=new_t), ts

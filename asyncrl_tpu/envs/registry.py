@@ -1,0 +1,39 @@
+"""Env registry keyed by the reference's workload env ids (BASELINE.json:6-12).
+
+Workloads whose native dependencies are absent in this image (ale-py, procgen,
+brax — SURVEY.md §7.4 R1) map to JAX-native stand-ins so every config remains
+runnable; the registry abstraction lets the real suites drop in later.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from asyncrl_tpu.envs.core import Environment
+
+_REGISTRY: dict[str, Callable[[], Environment]] = {}
+
+
+def register(env_id: str, factory: Callable[[], Environment]) -> None:
+    _REGISTRY[env_id] = factory
+
+
+def make(env_id: str) -> Environment:
+    if env_id not in _REGISTRY:
+        raise KeyError(
+            f"unknown env {env_id!r}; registered: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[env_id]()
+
+
+def registered() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def _register_builtins() -> None:
+    from asyncrl_tpu.envs.cartpole import CartPole
+
+    register("CartPole-v1", CartPole)
+
+
+_register_builtins()

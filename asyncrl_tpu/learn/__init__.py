@@ -1,0 +1,15 @@
+from asyncrl_tpu.learn.learner import (
+    Learner,
+    TrainState,
+    make_optimizer,
+    make_train_step,
+    state_partition_spec,
+)
+
+__all__ = [
+    "Learner",
+    "TrainState",
+    "make_optimizer",
+    "make_train_step",
+    "state_partition_spec",
+]

@@ -1,0 +1,268 @@
+"""Learner: the reference's ``Learner.update`` (BASELINE.json:5) as a single
+donated-buffer ``jit`` of a ``shard_map`` over the device mesh.
+
+One call = one fused XLA program that (per device shard): rolls out
+``unroll_len`` steps across the local env batch with the (possibly stale)
+actor params, recomputes logits/values under learner params, applies the
+algorithm loss (A3C / IMPALA-V-trace / PPO), all-reduces gradients with
+``lax.pmean`` over the ``dp`` axis, and applies Adam. Weight "publishing" to
+actors (the reference's queue-back channel) is the ``actor_params`` refresh —
+a pytree select every ``actor_staleness`` updates, staying entirely in HBM
+(SURVEY.md §5.8b, §7.3).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, PartitionSpec as P
+
+from asyncrl_tpu.envs.core import Environment
+from asyncrl_tpu.ops.gae import gae
+from asyncrl_tpu.ops.losses import a3c_loss, impala_loss, ppo_loss
+from asyncrl_tpu.parallel.mesh import DP_AXIS
+from asyncrl_tpu.rollout.anakin import ActorState, actor_init, unroll
+from asyncrl_tpu.rollout.buffer import Rollout
+from asyncrl_tpu.utils.config import Config
+
+
+@struct.dataclass
+class TrainState:
+    """Full training state; the unit of checkpointing (SURVEY.md §5.4).
+
+    ``params`` are the learner weights; ``actor_params`` the stale copy the
+    rollout uses (equal for on-policy algos, lagged for IMPALA). ``actor``
+    holds env states/obs/keys, sharded over the dp axis.
+    """
+
+    params: Any
+    actor_params: Any
+    opt_state: Any
+    actor: ActorState
+    update_step: jax.Array  # int32 scalar
+
+
+def state_partition_spec() -> TrainState:
+    """Pytree-prefix PartitionSpecs for shard_map in/out_specs: params and
+    optimizer replicated, actor state sharded on its leading env dim."""
+    return TrainState(
+        params=P(),
+        actor_params=P(),
+        opt_state=P(),
+        actor=P(DP_AXIS),
+        update_step=P(),
+    )
+
+
+def make_optimizer(config: Config) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(config.max_grad_norm),
+        optax.adam(config.learning_rate, eps=config.adam_eps),
+    )
+
+
+def _algo_loss(
+    config: Config, apply_fn, params, rollout: Rollout,
+    axis_name: str | None = None,
+):
+    """Forward the learner net over [T+1, B] obs and apply the configured
+    algorithm's loss. Returns (loss, metrics). ``axis_name`` is the dp mesh
+    axis when called inside shard_map (for losses needing global batch
+    moments, i.e. PPO advantage normalization)."""
+    obs_all = jnp.concatenate([rollout.obs, rollout.bootstrap_obs[None]], axis=0)
+    logits, values = apply_fn(params, obs_all)
+    logits_t, values_t = logits[:-1], values[:-1]
+    bootstrap_value = values[-1]
+    discounts = rollout.discounts(config.gamma)
+
+    if config.algo == "a3c":
+        return a3c_loss(
+            logits_t, values_t, rollout.actions, rollout.rewards, discounts,
+            jax.lax.stop_gradient(bootstrap_value),
+            value_coef=config.value_coef, entropy_coef=config.entropy_coef,
+        )
+    if config.algo == "impala":
+        return impala_loss(
+            logits_t, values_t, rollout.actions, rollout.behaviour_logp,
+            rollout.rewards, discounts, jax.lax.stop_gradient(bootstrap_value),
+            value_coef=config.value_coef, entropy_coef=config.entropy_coef,
+            rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
+        )
+    if config.algo == "ppo":
+        # Single-pass PPO over the fresh fragment. The multi-epoch
+        # minibatched update (config.ppo_epochs/ppo_minibatches) is planned
+        # as a separate step body; until then those knobs are inert here.
+        adv = gae(
+            rollout.rewards, discounts, jax.lax.stop_gradient(values_t),
+            jax.lax.stop_gradient(bootstrap_value), config.gae_lambda,
+        )
+        return ppo_loss(
+            logits_t, values_t, rollout.actions, rollout.behaviour_logp,
+            adv.advantages, adv.returns,
+            clip_eps=config.ppo_clip_eps, value_coef=config.value_coef,
+            entropy_coef=config.entropy_coef, axis_name=axis_name,
+        )
+    raise ValueError(f"unknown algo {config.algo!r}")
+
+
+def make_train_step(
+    config: Config,
+    env: Environment,
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+) -> Callable[[TrainState], tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the per-shard train-step body (to be wrapped in shard_map)."""
+
+    def train_step(state: TrainState):
+        actor, rollout, stats = unroll(
+            apply_fn, state.actor_params, env, state.actor, config.unroll_len
+        )
+
+        # shard_map autodiff semantics (jax>=0.8 vma tracking): the gradient
+        # of a REPLICATED input (params) w.r.t. a device-varying loss is
+        # automatically psum'd across the mesh axis during transposition.
+        # So we scale the per-shard loss by 1/axis_size — the implicit psum
+        # of local-mean gradients then yields exactly the global-batch-mean
+        # gradient, with no explicit pmean(grads) (which would double-count:
+        # verified 8x inflation on the 8-device CPU mesh, tests/test_learner).
+        def scaled_loss(p):
+            loss, metrics = _algo_loss(
+                config, apply_fn, p, rollout, axis_name=DP_AXIS
+            )
+            return loss / jax.lax.axis_size(DP_AXIS), (loss, metrics)
+
+        (_, (loss, metrics)), grads = jax.value_and_grad(
+            scaled_loss, has_aux=True
+        )(state.params)
+
+        metrics = jax.lax.pmean(metrics, DP_AXIS)
+        loss = jax.lax.pmean(loss, DP_AXIS)
+
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        step = state.update_step + 1
+        if config.algo == "impala" and config.actor_staleness > 1:
+            refresh = (step % config.actor_staleness) == 0
+            actor_params = jax.tree.map(
+                lambda new, old: jnp.where(refresh, new, old),
+                params, state.actor_params,
+            )
+        else:
+            # On-policy (and staleness<=1 IMPALA): actors always see the
+            # newest weights next fragment — one full update of lag, the
+            # minimum true-IMPALA staleness.
+            actor_params = params
+
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+        metrics["grad_norm"] = optax.global_norm(grads)
+        metrics["episode_return_sum"] = jax.lax.psum(
+            stats.completed_return_sum, DP_AXIS
+        )
+        metrics["episode_length_sum"] = jax.lax.psum(
+            stats.completed_length_sum, DP_AXIS
+        )
+        metrics["episode_count"] = jax.lax.psum(stats.completed_count, DP_AXIS)
+
+        new_state = TrainState(
+            params=params,
+            actor_params=actor_params,
+            opt_state=opt_state,
+            actor=actor,
+            update_step=step,
+        )
+        return new_state, metrics
+
+    return train_step
+
+
+class Learner:
+    """Owns the compiled train step and the train state lifecycle.
+
+    Name parity with the reference's ``Learner`` (BASELINE.json:5); its
+    ``update`` method is one mesh-wide fused step.
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        env: Environment,
+        model,
+        mesh: Mesh,
+    ):
+        self.config = config
+        self.env = env
+        self.model = model
+        self.mesh = mesh
+        self.optimizer = make_optimizer(config)
+
+        spec = state_partition_spec()
+        body = make_train_step(config, env, model.apply, self.optimizer)
+        self._step = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(spec,), out_specs=(spec, P())
+            ),
+            donate_argnums=(0,) if config.donate_buffers else (),
+        )
+
+    def init_state(self, seed: int) -> TrainState:
+        """Build the initial TrainState with proper shardings."""
+        cfg = self.config
+        if cfg.num_envs % self.mesh.shape[DP_AXIS]:
+            raise ValueError(
+                f"num_envs={cfg.num_envs} not divisible by dp={self.mesh.shape[DP_AXIS]}"
+            )
+        key = jax.random.PRNGKey(seed)
+        pkey, akey = jax.random.split(key)
+
+        dummy_obs = jnp.zeros((1, *self.env.spec.obs_shape), self.env.spec.obs_dtype)
+        params = self.model.init(pkey, dummy_obs)
+        opt_state = self.optimizer.init(params)
+
+        # Per-device actor init inside shard_map so env states are born
+        # sharded (no host-side giant arrays for big env batches).
+        local_envs = cfg.num_envs // self.mesh.shape[DP_AXIS]
+
+        def shard_actor_init(keys):
+            return actor_init(self.env, local_envs, keys[0])
+
+        per_device_keys = jax.random.split(akey, self.mesh.shape[DP_AXIS])
+        actor = jax.jit(
+            jax.shard_map(
+                shard_actor_init,
+                mesh=self.mesh,
+                in_specs=(P(DP_AXIS),),
+                out_specs=P(DP_AXIS),
+            )
+        )(per_device_keys)
+
+        state = TrainState(
+            params=params,
+            actor_params=params,
+            opt_state=opt_state,
+            actor=actor,
+            update_step=jnp.zeros((), jnp.int32),
+        )
+        # Place replicated leaves explicitly on the mesh.
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(self.mesh, P())
+        state = TrainState(
+            params=jax.device_put(state.params, rep),
+            actor_params=jax.device_put(state.actor_params, rep),
+            opt_state=jax.device_put(state.opt_state, rep),
+            actor=state.actor,
+            update_step=jax.device_put(state.update_step, rep),
+        )
+        return state
+
+    def update(self, state: TrainState):
+        """One train step: rollout + loss + pmean(grads) + Adam. Donates
+        ``state``."""
+        return self._step(state)

@@ -1,0 +1,9 @@
+from asyncrl_tpu.models.networks import (
+    ActorCritic,
+    ImpalaCNN,
+    MLPTorso,
+    NatureCNN,
+    build_model,
+)
+
+__all__ = ["ActorCritic", "ImpalaCNN", "MLPTorso", "NatureCNN", "build_model"]

@@ -1,0 +1,127 @@
+"""Policy/value networks for the reference's workload suites (SURVEY.md §1.2
+L2): MLP torso for classic control / continuous-control stand-ins, Nature-CNN
+and IMPALA-ResNet torsos for pixel suites (Atari/Procgen), with a shared
+categorical policy head + value head.
+
+TPU notes: matmuls run in bfloat16 when ``compute_dtype`` says so (params and
+loss math stay f32 — MXU-friendly mixed precision); conv torsos use NHWC which
+XLA:TPU prefers.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ORTHO = nn.initializers.orthogonal
+
+
+class MLPTorso(nn.Module):
+    hidden_sizes: Sequence[int] = (64, 64)
+    compute_dtype: jnp.dtype = jnp.float32
+    obs_rank: int = 1  # trailing dims that form one observation; flattened
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.reshape(*x.shape[: x.ndim - self.obs_rank], -1)
+        x = x.astype(self.compute_dtype)
+        for size in self.hidden_sizes:
+            x = nn.Dense(size, dtype=self.compute_dtype, kernel_init=ORTHO(jnp.sqrt(2)))(x)
+            x = nn.tanh(x)
+        return x
+
+
+class NatureCNN(nn.Module):
+    """DQN/Nature conv torso (84x84 stacked frames)."""
+
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.compute_dtype)
+        x = nn.relu(nn.Conv(32, (8, 8), strides=(4, 4), dtype=self.compute_dtype)(x))
+        x = nn.relu(nn.Conv(64, (4, 4), strides=(2, 2), dtype=self.compute_dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1), dtype=self.compute_dtype)(x))
+        x = x.reshape(*x.shape[:-3], -1)
+        x = nn.relu(nn.Dense(512, dtype=self.compute_dtype, kernel_init=ORTHO(jnp.sqrt(2)))(x))
+        return x
+
+
+class ResidualBlock(nn.Module):
+    channels: int
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = nn.relu(x)
+        y = nn.Conv(self.channels, (3, 3), dtype=self.compute_dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.channels, (3, 3), dtype=self.compute_dtype)(y)
+        return x + y
+
+
+class ImpalaCNN(nn.Module):
+    """IMPALA deep ResNet torso (Espeholt et al. 2018 'large' network)."""
+
+    channels: Sequence[int] = (16, 32, 32)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.compute_dtype)
+        for ch in self.channels:
+            x = nn.Conv(ch, (3, 3), dtype=self.compute_dtype)(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            x = ResidualBlock(ch, self.compute_dtype)(x)
+            x = ResidualBlock(ch, self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = x.reshape(*x.shape[:-3], -1)
+        x = nn.relu(nn.Dense(256, dtype=self.compute_dtype, kernel_init=ORTHO(jnp.sqrt(2)))(x))
+        return x
+
+
+class ActorCritic(nn.Module):
+    """Shared-torso policy + value network.
+
+    ``__call__`` returns ``(logits [..., A], value [...])`` in float32
+    regardless of compute dtype, so losses and V-trace stay full-precision.
+    """
+
+    num_actions: int
+    torso: str = "mlp"  # "mlp" | "nature_cnn" | "impala_cnn"
+    hidden_sizes: Sequence[int] = (64, 64)
+    channels: Sequence[int] = (16, 32, 32)
+    compute_dtype: jnp.dtype = jnp.float32
+    obs_rank: int = 1  # rank of one observation (e.g. 3 for H,W,C images)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
+        if self.torso == "mlp":
+            h = MLPTorso(self.hidden_sizes, self.compute_dtype, self.obs_rank)(obs)
+        elif self.torso == "nature_cnn":
+            h = NatureCNN(self.compute_dtype)(obs)
+        elif self.torso == "impala_cnn":
+            h = ImpalaCNN(self.channels, self.compute_dtype)(obs)
+        else:
+            raise ValueError(f"unknown torso {self.torso!r}")
+        logits = nn.Dense(self.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01))(h)
+        value = nn.Dense(1, dtype=jnp.float32, kernel_init=ORTHO(1.0))(h)[..., 0]
+        return logits.astype(jnp.float32), value.astype(jnp.float32)
+
+
+def build_model(config, env_spec) -> ActorCritic:
+    """Construct the ActorCritic matching a Config + EnvSpec."""
+    compute_dtype = (
+        jnp.bfloat16 if config.precision == "bf16_matmul" else jnp.float32
+    )
+    return ActorCritic(
+        num_actions=env_spec.num_actions,
+        torso=config.torso,
+        hidden_sizes=tuple(config.hidden_sizes),
+        channels=tuple(config.channels),
+        compute_dtype=compute_dtype,
+        obs_rank=len(env_spec.obs_shape),
+    )

@@ -1,0 +1,25 @@
+from asyncrl_tpu.ops.gae import GAEOutput, gae, n_step_returns
+from asyncrl_tpu.ops.losses import (
+    a3c_loss,
+    categorical_entropy,
+    categorical_logp,
+    impala_loss,
+    ppo_loss,
+)
+from asyncrl_tpu.ops.scan import reverse_linear_scan, reverse_linear_scan_sequential
+from asyncrl_tpu.ops.vtrace import VTraceOutput, vtrace
+
+__all__ = [
+    "GAEOutput",
+    "VTraceOutput",
+    "a3c_loss",
+    "categorical_entropy",
+    "categorical_logp",
+    "gae",
+    "impala_loss",
+    "n_step_returns",
+    "ppo_loss",
+    "reverse_linear_scan",
+    "reverse_linear_scan_sequential",
+    "vtrace",
+]

@@ -1,0 +1,56 @@
+"""Generalized Advantage Estimation (Schulman et al. 2016), scan form.
+
+Used by the reference's PPO workloads (BASELINE.json:10-11). The recurrence
+
+    delta_t = r_t + gamma_t V_{t+1} - V_t
+    A_t = delta_t + gamma_t * lambda * A_{t+1}
+
+is the same reverse-time affine scan as V-trace (ops/scan.py) with
+a_t = gamma_t * lambda, b_t = delta_t. Inputs time-major [T, B];
+``discounts`` = gamma * (1 - terminated).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from asyncrl_tpu.ops.scan import reverse_linear_scan
+
+
+class GAEOutput(NamedTuple):
+    advantages: jax.Array  # [T, B]
+    returns: jax.Array  # [T, B] advantage + value (TD(lambda) targets)
+
+
+def gae(
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    gae_lambda: float = 0.95,
+) -> GAEOutput:
+    values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = rewards + discounts * values_tp1 - values
+    advantages = reverse_linear_scan(discounts * gae_lambda, deltas)
+    returns = advantages + values
+    return GAEOutput(
+        advantages=jax.lax.stop_gradient(advantages),
+        returns=jax.lax.stop_gradient(returns),
+    )
+
+
+def n_step_returns(
+    rewards: jax.Array, discounts: jax.Array, bootstrap_value: jax.Array
+) -> jax.Array:
+    """Discounted n-step returns across the whole fragment (A3C targets,
+    cf. the A3C paper's t_max-step returns — PAPERS.md:8): the lambda=1,
+    value-free case of the same affine recurrence."""
+    # R_t = r_t + gamma_t R_{t+1} with R_T = bootstrap; the scan solves for
+    # x_T = 0, so fold the bootstrap into the final step's b term.
+    rewards_ext = jnp.concatenate(
+        [rewards[:-1], (rewards[-1] + discounts[-1] * bootstrap_value)[None]], axis=0
+    )
+    return reverse_linear_scan(discounts, rewards_ext)

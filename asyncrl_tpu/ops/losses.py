@@ -1,0 +1,161 @@
+"""Loss functions for the three algorithm families the reference supports:
+A3C n-step policy gradient, IMPALA V-trace, PPO clipped surrogate
+(BASELINE.json:6-12; SURVEY.md §2). All pure functions over time-major
+[T, B, ...] arrays; no classes, fully jittable.
+
+Each returns ``(scalar_loss, metrics_dict)`` where metrics are scalars safe
+to psum-average across a mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from asyncrl_tpu.ops.gae import GAEOutput, gae, n_step_returns
+from asyncrl_tpu.ops.vtrace import vtrace
+
+
+def categorical_logp(logits: jax.Array, actions: jax.Array) -> jax.Array:
+    """log pi(a|s) for discrete actions; logits [..., A], actions [...]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, actions[..., None], axis=-1)[..., 0]
+
+
+def categorical_entropy(logits: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def a3c_loss(
+    logits: jax.Array,
+    values: jax.Array,
+    actions: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    bootstrap_value: jax.Array,
+    value_coef: float = 0.5,
+    entropy_coef: float = 0.01,
+):
+    """n-step-return actor-critic loss (A3C, PAPERS.md:8).
+
+    returns R_t are full-fragment discounted returns bootstrapped from
+    V(x_T); advantage = R_t - V_t with stop-gradient on the target.
+    """
+    returns = jax.lax.stop_gradient(
+        n_step_returns(rewards, discounts, bootstrap_value)
+    )
+    advantages = returns - values
+    logp = categorical_logp(logits, actions)
+    pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(advantages))
+    value_loss = 0.5 * jnp.mean(jnp.square(advantages))
+    entropy = jnp.mean(categorical_entropy(logits))
+    loss = pg_loss + value_coef * value_loss - entropy_coef * entropy
+    metrics = {
+        "pg_loss": pg_loss,
+        "value_loss": value_loss,
+        "entropy": entropy,
+        "mean_value": jnp.mean(values),
+    }
+    return loss, metrics
+
+
+def impala_loss(
+    logits: jax.Array,
+    values: jax.Array,
+    actions: jax.Array,
+    behaviour_logp: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    bootstrap_value: jax.Array,
+    value_coef: float = 0.5,
+    entropy_coef: float = 0.01,
+    rho_clip: float = 1.0,
+    c_clip: float = 1.0,
+):
+    """IMPALA: V-trace corrected policy gradient + value + entropy
+    (BASELINE.json:5 'V-trace correction + policy-gradient/value loss')."""
+    target_logp = categorical_logp(logits, actions)
+    vt = vtrace(
+        behaviour_logp=behaviour_logp,
+        target_logp=target_logp,
+        rewards=rewards,
+        discounts=discounts,
+        values=values,
+        bootstrap_value=bootstrap_value,
+        rho_clip=rho_clip,
+        c_clip=c_clip,
+    )
+    pg_loss = -jnp.mean(target_logp * vt.pg_advantages)
+    value_loss = 0.5 * jnp.mean(jnp.square(vt.vs - values))
+    entropy = jnp.mean(categorical_entropy(logits))
+    loss = pg_loss + value_coef * value_loss - entropy_coef * entropy
+    metrics = {
+        "pg_loss": pg_loss,
+        "value_loss": value_loss,
+        "entropy": entropy,
+        "rho_clip_frac": vt.rho_clip_frac,
+        "mean_value": jnp.mean(values),
+    }
+    return loss, metrics
+
+
+def ppo_loss(
+    logits: jax.Array,
+    values: jax.Array,
+    actions: jax.Array,
+    behaviour_logp: jax.Array,
+    advantages: jax.Array,
+    returns: jax.Array,
+    clip_eps: float = 0.2,
+    value_coef: float = 0.5,
+    entropy_coef: float = 0.01,
+    normalize_advantages: bool = True,
+    axis_name: str | None = None,
+):
+    """PPO clipped surrogate over precomputed GAE advantages
+    (BASELINE.json:10 'PPO + GAE'). Flat or [T, B] batch shapes both work.
+
+    ``axis_name``: when running inside shard_map/pmap over a data-parallel
+    axis, pass its name so advantage normalization uses *global* batch
+    moments (otherwise each shard would normalize differently and dp
+    training would diverge from single-device training).
+    """
+    logp = categorical_logp(logits, actions)
+    ratio = jnp.exp(logp - behaviour_logp)
+    if normalize_advantages:
+        mean = jnp.mean(advantages)
+        sq_mean = jnp.mean(jnp.square(advantages))
+        if axis_name is not None:
+            mean = jax.lax.pmean(mean, axis_name)
+            sq_mean = jax.lax.pmean(sq_mean, axis_name)
+        std = jnp.sqrt(jnp.maximum(sq_mean - jnp.square(mean), 0.0))
+        advantages = (advantages - mean) / (std + 1e-8)
+    unclipped = ratio * advantages
+    clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * advantages
+    pg_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+    value_loss = 0.5 * jnp.mean(jnp.square(returns - values))
+    entropy = jnp.mean(categorical_entropy(logits))
+    loss = pg_loss + value_coef * value_loss - entropy_coef * entropy
+    metrics = {
+        "pg_loss": pg_loss,
+        "value_loss": value_loss,
+        "entropy": entropy,
+        "clip_frac": jnp.mean(
+            (jnp.abs(ratio - 1.0) > clip_eps).astype(jnp.float32)
+        ),
+        "approx_kl": jnp.mean(behaviour_logp - logp),
+    }
+    return loss, metrics
+
+
+__all__ = [
+    "a3c_loss",
+    "impala_loss",
+    "ppo_loss",
+    "gae",
+    "GAEOutput",
+    "vtrace",
+    "categorical_logp",
+    "categorical_entropy",
+]

@@ -1,0 +1,62 @@
+"""Reverse-time linear recurrences as associative scans.
+
+Both V-trace and GAE are instances of the first-order linear recurrence
+
+    x_t = b_t + a_t * x_{t+1},      x_T = 0   (time runs backward)
+
+which is associative under (a1, b1) o (a2, b2) = (a1*a2, b1 + a1*b2) and so
+parallelizes across the time axis with ``jax.lax.associative_scan`` — O(log T)
+depth instead of the reference's O(T) Python/serial loop (SURVEY.md §5.7).
+This is the TPU analogue of the reference's rollout time axis; the
+sequence-parallel (multi-device time-sharded) version in
+``asyncrl_tpu.parallel.timeshard`` reuses the same combine operator.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def combine(
+    left: tuple[jax.Array, jax.Array], right: tuple[jax.Array, jax.Array]
+) -> tuple[jax.Array, jax.Array]:
+    """Associative combine for the affine maps f(x) = b + a*x.
+
+    Each element represents one recurrence step; an inclusive prefix scan
+    must yield f_right o f_left (the element later in scan order is applied
+    on top), so: a = a_r * a_l, b = b_r + a_r * b_l.
+    """
+    a_l, b_l = left
+    a_r, b_r = right
+    return a_r * a_l, b_r + a_r * b_l
+
+
+def reverse_linear_scan(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Solve x_t = b_t + a_t * x_{t+1} with x_{T} = 0, for t = T-1..0.
+
+    Args:
+      a, b: [T, ...] coefficient arrays (time-major).
+    Returns:
+      x: [T, ...] solutions.
+
+    Implemented with ``associative_scan`` over reversed time. Identity
+    element is (1, 0); the scan's prefix combine of reversed elements yields
+    exactly the suffix recurrence.
+    """
+    a_rev = jnp.flip(a, axis=0)
+    b_rev = jnp.flip(b, axis=0)
+    _, x_rev = jax.lax.associative_scan(combine, (a_rev, b_rev), axis=0)
+    return jnp.flip(x_rev, axis=0)
+
+
+def reverse_linear_scan_sequential(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Reference O(T) ``lax.scan`` implementation, for tests and tiny T."""
+
+    def body(carry, ab):
+        a_t, b_t = ab
+        x_t = b_t + a_t * carry
+        return x_t, x_t
+
+    _, xs = jax.lax.scan(body, jnp.zeros_like(b[0]), (a, b), reverse=True)
+    return xs

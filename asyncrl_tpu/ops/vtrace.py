@@ -1,0 +1,85 @@
+"""V-trace off-policy correction (IMPALA), associative-scan form.
+
+The reference applies V-trace inside ``Learner.update`` (BASELINE.json:5;
+SURVEY.md §3.2). Definition per Espeholt et al. 2018 ("IMPALA: Scalable
+Distributed Deep-RL with Importance Weighted Actor-Learner Architectures"):
+
+    rho_t = min(rho_bar, pi(a_t|x_t) / mu(a_t|x_t))
+    c_t   = min(c_bar,   pi(a_t|x_t) / mu(a_t|x_t))
+    delta_t = rho_t (r_t + gamma_t V(x_{t+1}) - V(x_t))
+    vs_t - V(x_t) = delta_t + gamma_t c_t (vs_{t+1} - V(x_{t+1}))
+
+The recurrence is the reverse-time affine scan of ``ops.scan`` with
+a_t = gamma_t * c_t and b_t = delta_t, so it parallelizes over the time axis
+(O(log T) depth) instead of serializing like a torch loop would.
+
+Policy-gradient advantages use the one-step-lookahead target:
+    adv_t = rho_t (r_t + gamma_t vs_{t+1} - V(x_t))
+
+All inputs are time-major [T, B]. ``discounts`` should already include the
+termination mask (gamma * (1 - terminated)).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from asyncrl_tpu.ops.scan import reverse_linear_scan
+
+
+class VTraceOutput(NamedTuple):
+    vs: jax.Array  # [T, B] corrected value targets
+    pg_advantages: jax.Array  # [T, B] importance-weighted PG advantages
+    rho_clip_frac: jax.Array  # scalar: fraction of rho's hitting the clip
+
+
+def vtrace(
+    behaviour_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    rho_clip: float = 1.0,
+    c_clip: float = 1.0,
+) -> VTraceOutput:
+    """Compute V-trace targets and advantages.
+
+    Args:
+      behaviour_logp: [T, B] log mu(a_t|x_t) recorded by the actor.
+      target_logp: [T, B] log pi(a_t|x_t) under the learner policy.
+      rewards: [T, B].
+      discounts: [T, B] gamma * (1 - terminated_t); zero cuts the recurrence
+        and the bootstrap at terminal steps.
+      values: [T, B] V(x_t) under the learner.
+      bootstrap_value: [B] V(x_T).
+      rho_clip: rho_bar >= c_bar per the paper.
+      c_clip: c_bar.
+
+    Returns:
+      ``VTraceOutput`` with stop-gradient applied to vs and advantages.
+    """
+    log_rhos = target_logp - behaviour_logp
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(rho_clip, rhos)
+    clipped_cs = jnp.minimum(c_clip, rhos)
+
+    values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+
+    # vs_t - V_t = delta_t + gamma_t c_t (vs_{t+1} - V_{t+1})
+    vs_minus_v = reverse_linear_scan(discounts * clipped_cs, deltas)
+    vs = vs_minus_v + values
+
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_advantages = clipped_rhos * (rewards + discounts * vs_tp1 - values)
+
+    rho_clip_frac = jnp.mean((rhos > rho_clip).astype(jnp.float32))
+    return VTraceOutput(
+        vs=jax.lax.stop_gradient(vs),
+        pg_advantages=jax.lax.stop_gradient(pg_advantages),
+        rho_clip_frac=rho_clip_frac,
+    )

@@ -1,0 +1,19 @@
+from asyncrl_tpu.parallel.mesh import (
+    DP_AXIS,
+    TIME_AXIS,
+    TP_AXIS,
+    dp_sharded,
+    make_mesh,
+    num_dp,
+    replicated,
+)
+
+__all__ = [
+    "DP_AXIS",
+    "TIME_AXIS",
+    "TP_AXIS",
+    "dp_sharded",
+    "make_mesh",
+    "num_dp",
+    "replicated",
+]

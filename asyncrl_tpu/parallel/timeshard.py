@@ -1,0 +1,83 @@
+"""Time-axis (sequence) parallelism for the reverse affine recurrence.
+
+The reference has no sequence parallelism to port — its "sequence" axis is
+the rollout time axis, processed whole on one host (SURVEY.md §5.7). On TPU
+the analogue that matters is sharding that time axis across the mesh for
+long-horizon fragments: V-trace/GAE are first-order affine recurrences, so a
+T-sharded solve needs only one tiny all_gather of per-segment aggregates —
+the distributed classic two-level scan:
+
+1. each device solves its local segment with zero inflow (associative scan,
+   O(log T_local) depth),
+2. per-segment aggregates (a-product, zero-inflow solution at segment start)
+   are all_gathered over the ``sp`` axis — [n_seg] scalars per batch
+   element, riding ICI,
+3. a segment-level scan of those aggregates yields each segment's inflow;
+   one fused multiply-add corrects the local solution.
+
+This makes million-step fragments (or future recurrent/attention policies
+with long horizons) scale across chips without serializing time.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from asyncrl_tpu.ops.scan import reverse_linear_scan
+from asyncrl_tpu.parallel.mesh import TIME_AXIS
+
+
+def reverse_linear_scan_timesharded(
+    a: jax.Array, b: jax.Array, axis_name: str = TIME_AXIS
+) -> jax.Array:
+    """Solve x_t = b_t + a_t * x_{t+1}, x_T = 0, with the time axis sharded.
+
+    Must be called INSIDE shard_map/pmap over ``axis_name``; ``a``/``b`` are
+    the local time segment [T_local, ...], segments ordered by axis index
+    (device i holds times [i*T_local, (i+1)*T_local)).
+    """
+    # (1) local solve with zero inflow from the right.
+    x_local = reverse_linear_scan(a, b)
+    # suffix a-products: prod_{s=t..end} a_s — the factor an inflow picks up
+    # travelling from the segment end back to time t.
+    suffix_prod = jnp.flip(jnp.cumprod(jnp.flip(a, axis=0), axis=0), axis=0)
+
+    # (2) per-segment aggregates: x at segment start = B_seg + A_seg * inflow.
+    a_seg = suffix_prod[0]
+    b_seg = x_local[0]
+    a_all = jax.lax.all_gather(a_seg, axis_name)  # [n_seg, ...]
+    b_all = jax.lax.all_gather(b_seg, axis_name)
+
+    # (3) segment-level solve: y[k] = solution at segment k's first time.
+    # The inflow into segment k is y[k+1] (zero for the last segment).
+    y = reverse_linear_scan(a_all, b_all)
+    n_seg = y.shape[0]
+    idx = jax.lax.axis_index(axis_name)
+    zero = jnp.zeros_like(y[0])
+    inflow = jnp.where(
+        idx + 1 < n_seg,
+        jax.lax.dynamic_index_in_dim(
+            y, jnp.minimum(idx + 1, n_seg - 1), axis=0, keepdims=False
+        ),
+        zero,
+    )
+    return x_local + suffix_prod * inflow
+
+
+def make_timesharded_solver(
+    mesh: Mesh, axis_name: str = TIME_AXIS
+) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Wrap the in-shard solver as a standalone jitted function over global
+    [T, ...] arrays, time-sharded on ``axis_name`` of ``mesh``."""
+
+    solver = jax.shard_map(
+        lambda a, b: reverse_linear_scan_timesharded(a, b, axis_name),
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name)),
+        out_specs=P(axis_name),
+    )
+    return jax.jit(solver)

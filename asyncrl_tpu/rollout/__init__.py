@@ -1,0 +1,4 @@
+from asyncrl_tpu.rollout.anakin import ActorState, actor_init, unroll
+from asyncrl_tpu.rollout.buffer import EpisodeStats, Rollout
+
+__all__ = ["ActorState", "EpisodeStats", "Rollout", "actor_init", "unroll"]

@@ -1,0 +1,127 @@
+"""Anakin rollout: envs resident in HBM, unrolled with ``vmap`` + ``lax.scan``.
+
+This is the TPU-native replacement for the reference's per-thread
+``ActorWorker.run`` loop (BASELINE.json:5): instead of N Python threads each
+stepping one env, a single XLA program steps B envs in lockstep for T steps.
+The policy forward, action sample, env physics, auto-reset, and trajectory
+write all fuse into one compiled scan — zero host round-trips per fragment.
+
+PRNG design: every env slot carries its own raw uint32 key ([B, 2]), so the
+whole ``ActorState`` pytree shards over the mesh's ``dp`` axis with a single
+``P('dp')`` prefix spec — no replicated-key divergence problems inside
+``shard_map`` (SURVEY.md §7.3 "mesh-size-agnostic").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from asyncrl_tpu.envs.core import Environment
+from asyncrl_tpu.rollout.buffer import EpisodeStats, Rollout
+from asyncrl_tpu.utils.prng import gumbel_sample
+
+
+@struct.dataclass
+class ActorState:
+    """Carry for the rollout scan: env states + current obs + per-env PRNG
+    keys + running per-env episode accumulators (device-resident metrics)."""
+
+    env_state: Any  # vmapped env-state pytree, leading dim B
+    obs: jax.Array  # [B, *obs_shape]
+    keys: jax.Array  # [B, 2] uint32 raw PRNG keys
+    running_return: jax.Array  # [B] f32
+    running_length: jax.Array  # [B] f32
+
+
+def actor_init(env: Environment, num_envs: int, seed_key: jax.Array) -> ActorState:
+    init_keys, carry_keys = jax.random.split(seed_key)
+    env_keys = jax.random.split(init_keys, num_envs)
+    env_state = jax.vmap(env.init)(env_keys)
+    obs = jax.vmap(env.observe)(env_state)
+    zeros = jnp.zeros((num_envs,), jnp.float32)
+    return ActorState(
+        env_state=env_state,
+        obs=obs,
+        keys=jax.random.split(carry_keys, num_envs),
+        running_return=zeros,
+        running_length=zeros,
+    )
+
+
+def _sample_categorical(keys: jax.Array, logits: jax.Array) -> jax.Array:
+    """Per-env Gumbel-max categorical sample; keys [B,2], logits [B,A]."""
+    return jax.vmap(gumbel_sample)(keys, logits)
+
+
+def unroll(
+    apply_fn: Callable[[Any, jax.Array], tuple[jax.Array, jax.Array]],
+    params: Any,
+    env: Environment,
+    actor_state: ActorState,
+    unroll_len: int,
+) -> tuple[ActorState, Rollout, EpisodeStats]:
+    """Roll the policy forward ``unroll_len`` steps over the env batch.
+
+    ``apply_fn(params, obs[B]) -> (logits[B, A], value[B])``. The value head
+    output is discarded here (the learner recomputes values under its own
+    params); only the behaviour log-prob is recorded — exactly what V-trace
+    needs (SURVEY.md §3.3).
+    """
+
+    def step_fn(carry: ActorState, _):
+        split = jax.vmap(lambda k: jax.random.split(k, 3))(carry.keys)  # [B,3,2]
+        next_keys, act_keys, step_keys = split[:, 0], split[:, 1], split[:, 2]
+
+        logits, _ = apply_fn(params, carry.obs)
+        actions = _sample_categorical(act_keys, logits)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        behaviour_logp = jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+
+        env_state, ts = jax.vmap(env.step)(carry.env_state, actions, step_keys)
+
+        done_f = ts.done.astype(jnp.float32)
+        ep_return = carry.running_return + ts.reward
+        ep_length = carry.running_length + 1.0
+        new_carry = ActorState(
+            env_state=env_state,
+            obs=ts.obs,
+            keys=next_keys,
+            running_return=ep_return * (1.0 - done_f),
+            running_length=ep_length * (1.0 - done_f),
+        )
+        out = (
+            carry.obs,
+            actions,
+            behaviour_logp,
+            ts.reward,
+            ts.terminated,
+            ts.truncated,
+            ep_return * done_f,
+            ep_length * done_f,
+            done_f,
+        )
+        return new_carry, out
+
+    final_state, outs = jax.lax.scan(step_fn, actor_state, None, length=unroll_len)
+    (obs, actions, behaviour_logp, rewards, terminated, truncated,
+     done_returns, done_lengths, dones) = outs
+
+    rollout = Rollout(
+        obs=obs,
+        actions=actions,
+        behaviour_logp=behaviour_logp,
+        rewards=rewards,
+        terminated=terminated,
+        truncated=truncated,
+        bootstrap_obs=final_state.obs,
+    )
+    stats = EpisodeStats(
+        completed_return_sum=jnp.sum(done_returns),
+        completed_length_sum=jnp.sum(done_lengths),
+        completed_count=jnp.sum(dones),
+    )
+    return final_state, rollout, stats

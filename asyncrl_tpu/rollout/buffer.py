@@ -1,0 +1,66 @@
+"""Rollout fragment storage.
+
+The reference's ``RolloutBuffer`` is a Python object actors append to
+step-by-step (BASELINE.json:5; SURVEY.md §2). TPU-native, the buffer is just
+the stacked output pytree of a ``lax.scan`` — time-major [T, B, ...] arrays
+produced in one XLA program, with no per-step Python. The same struct is the
+unit carried by the Sebulba double buffer and by the ``cpu_async`` backend's
+queue, so all three backends feed an identical learner.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class Rollout:
+    """One rollout fragment, time-major [T, B, ...].
+
+    ``obs[t]`` is the observation the policy saw when choosing ``actions[t]``;
+    ``bootstrap_obs`` is the observation after the final transition, used for
+    V(x_T) bootstrapping. ``behaviour_logp`` is recorded at action time for
+    V-trace / PPO ratios (BASELINE.json:5).
+    """
+
+    obs: jax.Array  # [T, B, *obs_shape]
+    actions: jax.Array  # [T, B] int32
+    behaviour_logp: jax.Array  # [T, B] float32
+    rewards: jax.Array  # [T, B] float32
+    terminated: jax.Array  # [T, B] bool
+    truncated: jax.Array  # [T, B] bool
+    bootstrap_obs: jax.Array  # [B, *obs_shape]
+
+    @property
+    def done(self) -> jax.Array:
+        return jnp.logical_or(self.terminated, self.truncated)
+
+    def discounts(self, gamma: float) -> jax.Array:
+        """gamma * (1 - done): cuts bootstrap at episode ends.
+
+        Truncated episodes are treated like terminated ones (no bootstrap
+        through the reset boundary) — the standard Anakin simplification; the
+        exact truncation-bootstrap correction (add gamma*V(last_obs) to the
+        reward at truncated steps) is a possible future option and would need
+        one extra forward pass.
+        """
+        return gamma * (1.0 - self.done.astype(jnp.float32))
+
+    @property
+    def num_steps(self) -> int:
+        return self.actions.shape[0] * self.actions.shape[1]
+
+
+@struct.dataclass
+class EpisodeStats:
+    """Streaming episode-return/length statistics, computed inside jit.
+
+    ``completed_*`` are per-fragment sums over episodes that finished during
+    the fragment; divide by ``completed_count`` host-side (guard zero).
+    """
+
+    completed_return_sum: jax.Array  # scalar f32
+    completed_length_sum: jax.Array  # scalar f32
+    completed_count: jax.Array  # scalar f32

@@ -1,0 +1,4 @@
+from asyncrl_tpu.utils.config import Config, override
+from asyncrl_tpu.utils.prng import split_key_batch
+
+__all__ = ["Config", "override", "split_key_batch"]

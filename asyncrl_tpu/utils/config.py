@@ -1,0 +1,120 @@
+"""Config system: frozen dataclasses + a tiny ``key=value`` override parser.
+
+The reference family uses per-script argparse (SURVEY.md §5.6); here every
+workload is a frozen-dataclass preset (``asyncrl_tpu.configs``) and the CLI
+applies ``key=value`` overrides — no heavyweight config dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Training configuration for one workload.
+
+    Mirrors the knobs implied by the reference's five benchmark configs
+    (BASELINE.json:6-12): env selection, actor parallelism, algorithm family,
+    and optimization hyperparameters.
+    """
+
+    # --- workload ---
+    env_id: str = "CartPole-v1"
+    algo: str = "a3c"  # "a3c" | "impala" | "ppo"
+    backend: str = "tpu"  # "tpu" (anakin) | "sebulba" | "cpu_async"
+
+    # --- rollout geometry ---
+    # Global env batch across the whole mesh (the reference's "actors");
+    # must divide evenly by the dp axis size — each device runs
+    # num_envs / dp of them.
+    num_envs: int = 64
+    unroll_len: int = 32  # t_max: steps per rollout fragment
+    total_env_steps: int = 500_000
+
+    # --- model ---
+    torso: str = "mlp"  # "mlp" | "nature_cnn" | "impala_cnn"
+    hidden_sizes: tuple[int, ...] = (64, 64)
+    channels: tuple[int, ...] = (16, 32, 32)
+
+    # --- optimization ---
+    learning_rate: float = 3e-4
+    adam_eps: float = 1e-8
+    max_grad_norm: float = 0.5
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+
+    # --- loss coefficients ---
+    value_coef: float = 0.5
+    entropy_coef: float = 0.01
+
+    # --- IMPALA / V-trace ---
+    vtrace_rho_clip: float = 1.0
+    vtrace_c_clip: float = 1.0
+    actor_staleness: int = 1  # learner updates between actor weight refreshes
+
+    # --- PPO ---
+    ppo_clip_eps: float = 0.2
+    ppo_epochs: int = 4
+    ppo_minibatches: int = 4
+
+    # --- parallelism ---
+    mesh_shape: tuple[int, ...] = (-1,)  # -1: all local devices on axis "dp"
+    mesh_axes: tuple[str, ...] = ("dp",)
+
+    # --- runtime ---
+    seed: int = 0
+    log_every: int = 20  # learner updates between metric drains
+    checkpoint_every: int = 0  # 0 disables
+    checkpoint_dir: str = ""
+    precision: str = "bf16_matmul"  # "f32" | "bf16_matmul"
+    # Donate the TrainState into the compiled step. Off by default: the
+    # experimental axon PJRT plugin (the one real chip available here)
+    # returns INVALID_ARGUMENT when the full train step's donation/aliasing
+    # table is used (reproduced 2026-07-29; subsets of the outputs work).
+    # Enable on standard Cloud TPU runtimes for in-place state updates.
+    donate_buffers: bool = False
+
+    def replace(self, **kwargs: Any) -> "Config":
+        return dataclasses.replace(self, **kwargs)
+
+    @property
+    def batch_steps_per_update(self) -> int:
+        return self.num_envs * self.unroll_len
+
+
+def _coerce(old: Any, raw: str) -> Any:
+    """Parse ``raw`` to the type of ``old`` (bool/int/float/str/tuple)."""
+    if isinstance(old, bool):
+        if raw.lower() in ("1", "true", "yes"):
+            return True
+        if raw.lower() in ("0", "false", "no"):
+            return False
+        raise ValueError(f"not a bool: {raw!r}")
+    if isinstance(old, int):
+        return int(raw)
+    if isinstance(old, float):
+        return float(raw)
+    if isinstance(old, tuple):
+        items = [s for s in raw.strip("()[] ").split(",") if s.strip()]
+        elem = old[0] if old else raw
+        return tuple(type(elem)(s.strip()) if old else s.strip() for s in items)
+    return raw
+
+
+def override(config: Config, kvs: Mapping[str, str] | list[str]) -> Config:
+    """Apply CLI-style ``key=value`` overrides onto a frozen config."""
+    if isinstance(kvs, list):
+        pairs = dict(kv.split("=", 1) for kv in kvs)
+    else:
+        pairs = dict(kvs)
+    field_names = {f.name for f in dataclasses.fields(config)}
+    updates = {}
+    for key, raw in pairs.items():
+        if key not in field_names:
+            raise KeyError(
+                f"unknown config key: {key!r}; valid keys: {sorted(field_names)}"
+            )
+        updates[key] = _coerce(getattr(config, key), raw)
+    return config.replace(**updates)

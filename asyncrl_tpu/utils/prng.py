@@ -1,0 +1,35 @@
+"""PRNG plumbing helpers.
+
+Everything on-device uses explicit ``jax.random`` keys threaded through the
+rollout scan; no global RNG state (SURVEY.md §7.1 runtime layer).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def split_key_batch(key: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
+    """Split ``key`` into a carry key and a batch of ``n`` per-env keys."""
+    key, sub = jax.random.split(key)
+    return key, jax.random.split(sub, n)
+
+
+def fold_in_axis_index(key: jax.Array, axis_name: str) -> jax.Array:
+    """Decorrelate per-device keys inside ``shard_map``/``pmap`` bodies."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+def uniform_like(key: jax.Array, x: jax.Array, lo: float, hi: float) -> jax.Array:
+    return jax.random.uniform(key, x.shape, x.dtype, lo, hi)
+
+
+def batched_keys(seed: int, n: int) -> jax.Array:
+    return jax.random.split(jax.random.PRNGKey(seed), n)
+
+
+def gumbel_sample(key: jax.Array, logits: jax.Array) -> jax.Array:
+    """Categorical sample via Gumbel-max (fuses well under XLA)."""
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, logits.shape) + 1e-20) + 1e-20)
+    return jnp.argmax(logits + g, axis=-1)

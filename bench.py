@@ -1,0 +1,77 @@
+"""Benchmark harness: prints ONE JSON line with the north-star metric.
+
+Metric (BASELINE.json:2): env frames/sec for the IMPALA V-trace configuration
+on TPU. ``vs_baseline`` is the ratio against the driver-set target of
+1,000,000 env fps (BASELINE.md — the reference itself has no recorded
+published numbers; see SURVEY.md §0/§6).
+
+Usage: python bench.py [preset] [key=value ...]
+Default preset: pong_impala if its env is available, else cartpole_impala.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+
+
+def main() -> None:
+    from asyncrl_tpu.api.trainer import Trainer
+    from asyncrl_tpu.configs import presets
+    from asyncrl_tpu.envs import registered
+    from asyncrl_tpu.utils.config import override
+
+    args = sys.argv[1:]
+    preset_name = None
+    overrides = []
+    for a in args:
+        if "=" in a:
+            overrides.append(a)
+        else:
+            preset_name = a
+    if preset_name is None:
+        preset_name = (
+            "pong_impala" if "JaxPong-v0" in registered() else "cartpole_impala"
+        )
+
+    cfg = presets.get(preset_name)
+    # Benchmark geometry: large env batch to saturate the chip.
+    if preset_name == "cartpole_impala":
+        cfg = cfg.replace(num_envs=8192)
+    cfg = override(cfg, overrides)
+
+    trainer = Trainer(cfg)
+    state = trainer.state
+
+    warmup, timed = 3, 30
+    for _ in range(warmup):
+        state, metrics = trainer.learner.update(state)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        state, metrics = trainer.learner.update(state)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - t0
+
+    fps = timed * cfg.num_envs * cfg.unroll_len / elapsed
+    target = 1_000_000.0  # BASELINE.json:5 north-star (v4-8 target)
+    print(
+        json.dumps(
+            {
+                "metric": f"env_frames_per_sec ({preset_name}, "
+                f"{cfg.num_envs} envs x {cfg.unroll_len} unroll, "
+                f"{jax.devices()[0].device_kind} x{jax.device_count()})",
+                "value": round(fps),
+                "unit": "frames/sec",
+                "vs_baseline": round(fps / target, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

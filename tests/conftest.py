@@ -1,0 +1,31 @@
+"""Test harness: force CPU with 8 virtual devices, so all mesh/collective
+code paths run in CI with no TPU (SURVEY.md §4 "Distributed without a
+cluster"). The real-chip path is exercised by bench.py instead.
+
+Note: this image's axon sitecustomize pre-imports jax and force-sets
+``jax_platforms="axon,cpu"`` via jax.config (ignoring the env var), so we
+must override through jax.config — but XLA_FLAGS still must be in the
+environment before the CPU backend is first initialized.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

@@ -1,0 +1,71 @@
+"""GAE and n-step returns vs their recursive definitions."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu.ops.gae import gae, n_step_returns
+
+
+def numpy_gae(rewards, discounts, values, bootstrap, lam):
+    T, B = rewards.shape
+    values_tp1 = np.concatenate([values[1:], bootstrap[None]], axis=0)
+    deltas = rewards + discounts * values_tp1 - values
+    adv = np.zeros_like(rewards)
+    acc = np.zeros(B, dtype=np.float64)
+    for t in range(T - 1, -1, -1):
+        acc = deltas[t] + discounts[t] * lam * acc
+        adv[t] = acc
+    return adv, adv + values
+
+
+@pytest.mark.parametrize("lam", [0.0, 0.5, 0.95, 1.0])
+def test_matches_recursive_definition(lam):
+    rng = np.random.default_rng(0)
+    T, B = 13, 4
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    done = rng.uniform(size=(T, B)) < 0.2
+    discounts = (0.99 * (1 - done)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+
+    expected_adv, expected_ret = numpy_gae(rewards, discounts, values, bootstrap, lam)
+    out = gae(
+        jnp.asarray(rewards), jnp.asarray(discounts), jnp.asarray(values),
+        jnp.asarray(bootstrap), gae_lambda=lam,
+    )
+    np.testing.assert_allclose(np.asarray(out.advantages), expected_adv, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out.returns), expected_ret, rtol=1e-4, atol=1e-4)
+
+
+def test_lambda_zero_is_td_error():
+    rng = np.random.default_rng(1)
+    T, B = 7, 3
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    discounts = np.full((T, B), 0.9, np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    out = gae(*map(jnp.asarray, (rewards, discounts, values, bootstrap)), gae_lambda=0.0)
+    values_tp1 = np.concatenate([values[1:], bootstrap[None]], axis=0)
+    np.testing.assert_allclose(
+        np.asarray(out.advantages),
+        rewards + discounts * values_tp1 - values,
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_n_step_returns():
+    rng = np.random.default_rng(2)
+    T, B = 9, 2
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    done = rng.uniform(size=(T, B)) < 0.25
+    discounts = (0.99 * (1 - done)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+
+    expected = np.zeros((T, B), np.float32)
+    acc = bootstrap.copy()
+    for t in range(T - 1, -1, -1):
+        acc = rewards[t] + discounts[t] * acc
+        expected[t] = acc
+    got = n_step_returns(jnp.asarray(rewards), jnp.asarray(discounts), jnp.asarray(bootstrap))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-4)

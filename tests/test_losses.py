@@ -1,0 +1,108 @@
+"""Loss-function unit tests, including a torch cross-check of the PPO
+surrogate (torch cpu is in the image exactly for this — SURVEY.md §7.0)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu.ops.losses import (
+    a3c_loss,
+    categorical_entropy,
+    categorical_logp,
+    impala_loss,
+    ppo_loss,
+)
+
+
+def rand(T=6, B=4, A=3, seed=0):
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(size=(T, B, A)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    actions = rng.integers(0, A, (T, B)).astype(np.int32)
+    behaviour_logp = rng.normal(-1.0, 0.3, (T, B)).astype(np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    discounts = np.full((T, B), 0.99, np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    return logits, values, actions, behaviour_logp, rewards, discounts, bootstrap
+
+
+def test_categorical_helpers():
+    logits = jnp.asarray([[1.0, 2.0, 0.5]])
+    actions = jnp.asarray([1])
+    lp = categorical_logp(logits, actions)
+    expected = jax.nn.log_softmax(logits)[0, 1]
+    assert np.isclose(float(lp[0]), float(expected))
+    ent = categorical_entropy(jnp.zeros((1, 4)))
+    assert np.isclose(float(ent[0]), np.log(4), atol=1e-6)
+
+
+def test_a3c_loss_finite_and_grads():
+    logits, values, actions, _, rewards, discounts, bootstrap = rand()
+
+    def f(lg, v):
+        loss, _ = a3c_loss(lg, v, jnp.asarray(actions), jnp.asarray(rewards),
+                           jnp.asarray(discounts), jnp.asarray(bootstrap))
+        return loss
+
+    g_lg, g_v = jax.grad(f, argnums=(0, 1))(jnp.asarray(logits), jnp.asarray(values))
+    assert np.isfinite(np.asarray(g_lg)).all() and np.isfinite(np.asarray(g_v)).all()
+
+
+def test_impala_on_policy_entropy_term():
+    """On-policy, rho==1: metrics should show zero clip fraction."""
+    logits, values, actions, _, rewards, discounts, bootstrap = rand(seed=1)
+    behaviour = np.asarray(
+        jax.nn.log_softmax(jnp.asarray(logits))[
+            np.arange(6)[:, None], np.arange(4)[None, :], actions
+        ]
+    )
+    _, metrics = impala_loss(
+        jnp.asarray(logits), jnp.asarray(values), jnp.asarray(actions),
+        jnp.asarray(behaviour), jnp.asarray(rewards), jnp.asarray(discounts),
+        jnp.asarray(bootstrap),
+    )
+    assert float(metrics["rho_clip_frac"]) == 0.0
+
+
+def test_ppo_matches_torch():
+    torch = pytest.importorskip("torch")
+    logits, values, actions, behaviour_logp, _, _, _ = rand(seed=2)
+    rng = np.random.default_rng(3)
+    advantages = rng.normal(size=values.shape).astype(np.float32)
+    returns = rng.normal(size=values.shape).astype(np.float32)
+    clip_eps, vc, ec = 0.2, 0.5, 0.01
+
+    loss, _ = ppo_loss(
+        jnp.asarray(logits), jnp.asarray(values), jnp.asarray(actions),
+        jnp.asarray(behaviour_logp), jnp.asarray(advantages),
+        jnp.asarray(returns), clip_eps=clip_eps, value_coef=vc,
+        entropy_coef=ec, normalize_advantages=False,
+    )
+
+    tl = torch.tensor(logits)
+    dist = torch.distributions.Categorical(logits=tl)
+    lp = dist.log_prob(torch.tensor(actions))
+    ratio = torch.exp(lp - torch.tensor(behaviour_logp))
+    adv = torch.tensor(advantages)
+    s1 = ratio * adv
+    s2 = torch.clamp(ratio, 1 - clip_eps, 1 + clip_eps) * adv
+    pg = -torch.min(s1, s2).mean()
+    vl = 0.5 * ((torch.tensor(returns) - torch.tensor(values)) ** 2).mean()
+    ent = dist.entropy().mean()
+    expected = pg + vc * vl - ec * ent
+    assert np.isclose(float(loss), float(expected), rtol=1e-5, atol=1e-5)
+
+
+def test_ppo_clip_frac_extremes():
+    T, B, A = 2, 2, 2
+    logits = jnp.zeros((T, B, A))
+    actions = jnp.zeros((T, B), jnp.int32)
+    values = jnp.zeros((T, B))
+    # behaviour logp very different from current -> all ratios clip
+    behaviour = jnp.full((T, B), -10.0)
+    adv = jnp.ones((T, B))
+    ret = jnp.zeros((T, B))
+    _, metrics = ppo_loss(logits, values, actions, behaviour, adv, ret,
+                          normalize_advantages=False)
+    assert float(metrics["clip_frac"]) == 1.0

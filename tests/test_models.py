@@ -1,0 +1,47 @@
+"""Model shape/dtype contracts, including the multi-dim-obs MLP flatten."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from asyncrl_tpu.envs.core import EnvSpec
+from asyncrl_tpu.models.networks import ActorCritic, build_model
+from asyncrl_tpu.utils.config import Config
+
+
+def test_mlp_flattens_image_observations():
+    """MLP torso on [*, H, W, C] obs must emit [*, A] logits / [*] values —
+    regression for the no-op reshape that silently broadcast garbage."""
+    spec = EnvSpec(obs_shape=(8, 8, 3), num_actions=4)
+    model = build_model(Config(torso="mlp", precision="f32"), spec)
+    obs = jnp.zeros((5, 8, 8, 3))
+    params = model.init(jax.random.PRNGKey(0), obs)
+    logits, value = model.apply(params, obs)
+    assert logits.shape == (5, 4)
+    assert value.shape == (5,)
+    # leading time+batch dims too
+    logits, value = model.apply(params, jnp.zeros((7, 5, 8, 8, 3)))
+    assert logits.shape == (7, 5, 4)
+    assert value.shape == (7, 5)
+
+
+def test_cnn_torsos_shapes():
+    for torso in ("nature_cnn", "impala_cnn"):
+        model = ActorCritic(num_actions=6, torso=torso, obs_rank=3)
+        obs = jnp.zeros((2, 84, 84, 4))
+        params = model.init(jax.random.PRNGKey(0), obs)
+        logits, value = model.apply(params, obs)
+        assert logits.shape == (2, 6)
+        assert value.shape == (2,)
+        assert logits.dtype == jnp.float32
+
+
+def test_outputs_float32_under_bf16_compute():
+    spec = EnvSpec(obs_shape=(4,), num_actions=2)
+    model = build_model(Config(precision="bf16_matmul"), spec)
+    obs = jnp.zeros((3, 4))
+    params = model.init(jax.random.PRNGKey(0), obs)
+    logits, value = model.apply(params, obs)
+    assert logits.dtype == jnp.float32
+    assert value.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()

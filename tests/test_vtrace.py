@@ -1,0 +1,102 @@
+"""V-trace unit fixtures (SURVEY.md §4): hand-computed recurrence from the
+IMPALA paper definition, plus analytic special cases."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu.ops.vtrace import vtrace
+
+
+def numpy_vtrace(behaviour_logp, target_logp, rewards, discounts, values,
+                 bootstrap_value, rho_clip=1.0, c_clip=1.0):
+    """Direct transcription of Espeholt et al. 2018 eq. (1)."""
+    T, B = rewards.shape
+    rhos = np.exp(target_logp - behaviour_logp)
+    clipped_rhos = np.minimum(rho_clip, rhos)
+    clipped_cs = np.minimum(c_clip, rhos)
+    values_tp1 = np.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+    vs = np.zeros_like(values)
+    acc = np.zeros(B, dtype=np.float64)
+    for t in range(T - 1, -1, -1):
+        acc = deltas[t] + discounts[t] * clipped_cs[t] * acc
+        vs[t] = values[t] + acc
+    vs_tp1 = np.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = clipped_rhos * (rewards + discounts * vs_tp1 - values)
+    return vs, pg_adv
+
+
+def random_inputs(T=11, B=5, seed=0):
+    rng = np.random.default_rng(seed)
+    behaviour_logp = rng.normal(-1.2, 0.4, (T, B)).astype(np.float32)
+    target_logp = behaviour_logp + rng.normal(0, 0.3, (T, B)).astype(np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    done = rng.uniform(size=(T, B)) < 0.15
+    discounts = (0.99 * (1 - done)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    return behaviour_logp, target_logp, rewards, discounts, values, bootstrap
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("clips", [(1.0, 1.0), (2.0, 1.5), (0.5, 0.5)])
+def test_matches_paper_recurrence(seed, clips):
+    rho_clip, c_clip = clips
+    inputs = random_inputs(seed=seed)
+    expected_vs, expected_adv = numpy_vtrace(*inputs, rho_clip, c_clip)
+    out = vtrace(*map(jnp.asarray, inputs), rho_clip=rho_clip, c_clip=c_clip)
+    np.testing.assert_allclose(np.asarray(out.vs), expected_vs, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(out.pg_advantages), expected_adv, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_on_policy_reduces_to_n_step_bellman_target():
+    """With pi == mu and no clipping active, vs_t is the n-step TD(1) target:
+    discounted sum of rewards plus bootstrap (IMPALA paper, remark 1)."""
+    T, B = 6, 3
+    rng = np.random.default_rng(3)
+    logp = rng.normal(-1.0, 0.2, (T, B)).astype(np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    discounts = np.full((T, B), 0.95, np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+
+    out = vtrace(
+        jnp.asarray(logp), jnp.asarray(logp), jnp.asarray(rewards),
+        jnp.asarray(discounts), jnp.asarray(values), jnp.asarray(bootstrap),
+    )
+    # n-step return: sum_k gamma^k r_{t+k} + gamma^{T-t} bootstrap
+    expected = np.zeros((T, B), np.float32)
+    acc = bootstrap.copy()
+    for t in range(T - 1, -1, -1):
+        acc = rewards[t] + discounts[t] * acc
+        expected[t] = acc
+    np.testing.assert_allclose(np.asarray(out.vs), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_rho_clip_frac():
+    T, B = 4, 2
+    behaviour = np.zeros((T, B), np.float32)
+    target = np.zeros((T, B), np.float32)
+    target[0, 0] = 2.0  # rho = e^2 > 1 at exactly one of 8 entries
+    out = vtrace(
+        jnp.asarray(behaviour), jnp.asarray(target),
+        jnp.zeros((T, B)), jnp.full((T, B), 0.9), jnp.zeros((T, B)),
+        jnp.zeros((B,)),
+    )
+    assert np.isclose(float(out.rho_clip_frac), 1 / 8)
+
+
+def test_terminal_cut():
+    """discount=0 at t cuts all influence of t+1.. on vs_t."""
+    inputs = list(random_inputs(T=8, B=2, seed=5))
+    inputs[3][4, :] = 0.0  # discounts at t=4
+    out1 = vtrace(*map(jnp.asarray, inputs))
+    inputs2 = [x.copy() for x in inputs]
+    inputs2[2][5:, :] = 123.0  # rewards after the cut
+    out2 = vtrace(*map(jnp.asarray, inputs2))
+    np.testing.assert_allclose(
+        np.asarray(out1.vs[:5]), np.asarray(out2.vs[:5]), rtol=1e-5
+    )
