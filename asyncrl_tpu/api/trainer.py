@@ -101,8 +101,11 @@ class Trainer:
     # ----------------------------------------------------------------- eval
 
     def evaluate(
-        self, num_episodes: int = 32, max_steps: int = 1000, seed: int = 1234
+        self, num_episodes: int = 32, max_steps: int = 3200, seed: int = 1234
     ) -> float:
+        # Default max_steps must contain the longest builtin episode: a full
+        # first-to-21 JaxPong game can run to its 3000-step truncation limit;
+        # CartPole truncates at 500. Pass a smaller value for quick checks.
         """Mean greedy-policy episode return over ``num_episodes`` fresh envs,
         fully on device (one jitted scan)."""
         cache_key = (num_episodes, max_steps)

@@ -42,8 +42,11 @@ pong_impala = Config(
     actor_staleness=2,
 )
 
-# BASELINE.json:9 — "Atari-57 suite, IMPALA, 1024 envs/chip".
-atari_impala = pong_impala.replace(num_envs=1024, torso="impala_cnn")
+# BASELINE.json:9 — "Atari-57 suite, IMPALA, 1024 envs/chip". Pixel-obs
+# Pong (84x84x4, on-device rendering) stands in for the ALE games.
+atari_impala = pong_impala.replace(
+    env_id="JaxPongPixels-v0", num_envs=1024, torso="impala_cnn"
+)
 
 # BASELINE.json:10 — "Procgen-16, PPO + GAE, 4096 envs data-parallel".
 procgen_ppo = Config(

@@ -1,0 +1,259 @@
+"""Pure-JAX Pong: the stand-in for the reference's PongNoFrameskip-v4 IMPALA
+workload (BASELINE.json:8) — ale-py is unavailable in this image (SURVEY.md
+§7.4 R1), so the game itself is reimplemented as a functional JAX env and
+runs *on the TPU*, vectorized under ``vmap`` like every Anakin env.
+
+Game rules mirror Atari Pong's structure so the benchmark semantics carry
+over: first to 21 points ends the episode, reward is ±1 per point, the action
+set is the 6-action ALE Pong set (NOOP/FIRE/UP/DOWN/UPFIRE/DOWNFIRE), and the
+"mean reward 18.0" target (BASELINE.json:2) means beating the scripted
+opponent 21–3 on average. The opponent is a rate-limited ball tracker; angled
+returns (bounce angle set by hit offset, like the original) out-pace it, so
+the optimal policy wins every rally while a random policy loses ~every rally.
+
+Two observation variants:
+
+- ``JaxPong-v0`` — 6-dim state vector (ball pos/vel, both paddle ys); pairs
+  with the MLP torso (pong_impala preset).
+- ``JaxPongPixels-v0`` — 84x84x4 stacked grayscale frames rendered on-device
+  (paddles + ball painted via iota masks), matching the reference's Atari
+  preprocessing output shape (SURVEY.md §3.3); pairs with the conv torsos
+  (atari_impala preset).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+
+# Court is the unit square; x grows toward the agent's side.
+AGENT_X = 0.95  # agent paddle plane (right)
+OPP_X = 0.05  # opponent paddle plane (left)
+PADDLE_HALF = 0.08  # paddle half-height
+AGENT_SPEED = 0.05  # agent paddle speed / step
+OPP_SPEED = 0.025  # opponent tracking speed / step (out-paced by spin)
+BALL_VX = 0.03  # horizontal ball speed (constant magnitude)
+MAX_SPIN = 0.04  # max |vy| imparted by an off-center hit
+SERVE_VY = 0.02  # max |vy| on serve
+WIN_SCORE = 21
+MAX_STEPS = 3000  # truncation safety net (~8 rallies/player minimum)
+
+NUM_ACTIONS = 6  # ALE Pong action set
+FRAME = 84  # pixel variant resolution
+
+
+@struct.dataclass
+class PongState:
+    ball: jax.Array  # [4] = x, y, vx, vy
+    agent_y: jax.Array  # scalar
+    opp_y: jax.Array  # scalar
+    score: jax.Array  # [2] int32 = (agent, opponent)
+    t: jax.Array  # int32 step count
+
+
+def _serve(key: jax.Array, toward_agent: jax.Array) -> jax.Array:
+    """Ball at center, |vx| = BALL_VX toward the given side, random vy."""
+    vy = jax.random.uniform(key, (), jnp.float32, -SERVE_VY, SERVE_VY)
+    vx = jnp.where(toward_agent, BALL_VX, -BALL_VX)
+    return jnp.stack([jnp.float32(0.5), jnp.float32(0.5), vx, vy])
+
+
+def _action_dir(action: jax.Array) -> jax.Array:
+    """ALE Pong mapping: {2,4} move up (+), {3,5} move down (−), else hold."""
+    up = (action == 2) | (action == 4)
+    down = (action == 3) | (action == 5)
+    return jnp.where(up, 1.0, 0.0) - jnp.where(down, 1.0, 0.0)
+
+
+class Pong(Environment):
+    """Vector-observation Pong (6-dim state)."""
+
+    spec = EnvSpec(obs_shape=(6,), num_actions=NUM_ACTIONS)
+
+    def init(self, key: jax.Array) -> PongState:
+        serve_key, side_key = jax.random.split(key)
+        toward_agent = jax.random.bernoulli(side_key)
+        return PongState(
+            ball=_serve(serve_key, toward_agent),
+            agent_y=jnp.float32(0.5),
+            opp_y=jnp.float32(0.5),
+            score=jnp.zeros((2,), jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def observe(self, state: PongState) -> jax.Array:
+        b = state.ball
+        return jnp.stack(
+            [
+                b[0],
+                b[1],
+                b[2] / BALL_VX,
+                b[3] / MAX_SPIN,
+                state.agent_y,
+                state.opp_y,
+            ]
+        )
+
+    def step(
+        self, state: PongState, action: jax.Array, key: jax.Array
+    ) -> tuple[PongState, TimeStep]:
+        serve_key, reset_key = jax.random.split(key)
+
+        # Paddles.
+        agent_y = jnp.clip(
+            state.agent_y + AGENT_SPEED * _action_dir(action),
+            PADDLE_HALF,
+            1.0 - PADDLE_HALF,
+        )
+        track = jnp.clip(state.ball[1] - state.opp_y, -OPP_SPEED, OPP_SPEED)
+        opp_y = jnp.clip(state.opp_y + track, PADDLE_HALF, 1.0 - PADDLE_HALF)
+
+        # Ball advance + wall bounce.
+        x = state.ball[0] + state.ball[2]
+        y = state.ball[1] + state.ball[3]
+        vx, vy = state.ball[2], state.ball[3]
+        y = jnp.where(y < 0.0, -y, y)
+        vy = jnp.where(state.ball[1] + state.ball[3] < 0.0, jnp.abs(vy), vy)
+        y2 = jnp.where(y > 1.0, 2.0 - y, y)
+        vy = jnp.where(y > 1.0, -jnp.abs(vy), vy)
+        y = y2
+
+        # Paddle planes: bounce if aligned, else the rally is scored.
+        def hit_bounce(plane_x, paddle_y, crossing, sign):
+            hit = crossing & (jnp.abs(y - paddle_y) <= PADDLE_HALF)
+            spin = MAX_SPIN * (y - paddle_y) / PADDLE_HALF
+            return hit, 2.0 * plane_x - x, sign * BALL_VX, spin
+
+        cross_agent = (x >= AGENT_X) & (vx > 0)
+        cross_opp = (x <= OPP_X) & (vx < 0)
+        agent_hit, ax, avx, aspin = hit_bounce(AGENT_X, agent_y, cross_agent, -1.0)
+        opp_hit, ox, ovx, ospin = hit_bounce(OPP_X, opp_y, cross_opp, 1.0)
+
+        x = jnp.where(agent_hit, ax, jnp.where(opp_hit, ox, x))
+        vx = jnp.where(agent_hit, avx, jnp.where(opp_hit, ovx, vx))
+        vy = jnp.where(agent_hit, aspin, jnp.where(opp_hit, ospin, vy))
+
+        # Points: ball crossed a plane without a paddle there.
+        opp_scores = cross_agent & ~agent_hit
+        agent_scores = cross_opp & ~opp_hit
+        reward = jnp.where(
+            agent_scores, 1.0, jnp.where(opp_scores, -1.0, 0.0)
+        ).astype(jnp.float32)
+        score = state.score + jnp.stack(
+            [agent_scores.astype(jnp.int32), opp_scores.astype(jnp.int32)]
+        )
+
+        # Re-serve after a point (loser receives, as in Pong: the side that
+        # conceded gets the ball served toward them).
+        point = agent_scores | opp_scores
+        ball = jnp.stack([x, y, vx, vy])
+        ball = jnp.where(point, _serve(serve_key, opp_scores), ball)
+
+        t = state.t + 1
+        terminated = (score[0] >= WIN_SCORE) | (score[1] >= WIN_SCORE)
+        truncated = (t >= MAX_STEPS) & ~terminated
+        done = terminated | truncated
+
+        ended = PongState(ball=ball, agent_y=agent_y, opp_y=opp_y, score=score, t=t)
+        fresh = self.init(reset_key)
+        new_state = jax.tree.map(
+            lambda f, e: jnp.where(done, f, e), fresh, ended
+        )
+        ts = TimeStep(
+            obs=self.observe(new_state),
+            reward=reward,
+            terminated=terminated,
+            truncated=truncated,
+            last_obs=self.observe(ended),
+        )
+        return new_state, ts
+
+
+def render_positions(
+    ball_x: jax.Array, ball_y: jax.Array, agent_y: jax.Array, opp_y: jax.Array
+) -> jax.Array:
+    """Paint the court to an [FRAME, FRAME] grayscale image in {0, 1}.
+
+    Pure elementwise mask math (iota grids) so it fuses into the rollout
+    scan — the TPU-native version of the reference's Atari preprocessing
+    pipeline (SURVEY.md §3.3: grayscale, 84x84, stack 4).
+    """
+    rows = jax.lax.broadcasted_iota(jnp.float32, (FRAME, FRAME), 0) / (FRAME - 1)
+    cols = jax.lax.broadcasted_iota(jnp.float32, (FRAME, FRAME), 1) / (FRAME - 1)
+    half_w = 1.5 / FRAME  # paddle/ball half-width in court units
+
+    def paddle(px, py):
+        return (jnp.abs(cols - px) <= half_w) & (jnp.abs(rows - py) <= PADDLE_HALF)
+
+    ball = (jnp.abs(cols - ball_x) <= half_w) & (jnp.abs(rows - ball_y) <= half_w)
+    img = paddle(AGENT_X, agent_y) | paddle(OPP_X, opp_y) | ball
+    # uint8 {0,1}: 4x smaller rollout buffers than f32 (the [T, B, 84, 84, 4]
+    # atari_impala buffer is ~0.9 GB instead of 3.7); torsos cast to the
+    # compute dtype on entry.
+    return img.astype(jnp.uint8)
+
+
+def render(state: PongState) -> jax.Array:
+    return render_positions(
+        state.ball[0], state.ball[1], state.agent_y, state.opp_y
+    )
+
+
+@struct.dataclass
+class PongPixelState:
+    core: PongState
+    frames: jax.Array  # [FRAME, FRAME, 4] most-recent-last
+
+
+class PongPixels(Environment):
+    """Pixel-observation Pong: 84x84x4 stacked frames, Atari-shaped."""
+
+    spec = EnvSpec(
+        obs_shape=(FRAME, FRAME, 4), num_actions=NUM_ACTIONS, obs_dtype=jnp.uint8
+    )
+
+    def __init__(self):
+        self._core = Pong()
+
+    def init(self, key: jax.Array) -> PongPixelState:
+        core = self._core.init(key)
+        frame = render(core)
+        return PongPixelState(
+            core=core, frames=jnp.repeat(frame[..., None], 4, axis=-1)
+        )
+
+    def observe(self, state: PongPixelState) -> jax.Array:
+        return state.frames
+
+    def step(
+        self, state: PongPixelState, action: jax.Array, key: jax.Array
+    ) -> tuple[PongPixelState, TimeStep]:
+        new_core, ts = self._core.step(state.core, action, key)
+        frame = render(new_core)
+        shifted = jnp.concatenate(
+            [state.frames[..., 1:], frame[..., None]], axis=-1
+        )
+        # Post-reset state gets a full stack of its own frame, exactly like a
+        # fresh init — no leakage of the previous episode's pixels.
+        frames = jnp.where(
+            ts.done, jnp.repeat(frame[..., None], 4, axis=-1), shifted
+        )
+        # True pre-reset final frame, reconstructed from the core's vector
+        # last_obs (obs[0]=ball_x, obs[1]=ball_y, obs[4]=agent_y, obs[5]=opp_y)
+        # — used only for truncation bootstrapping.
+        lo = ts.last_obs
+        last_frame = render_positions(lo[0], lo[1], lo[4], lo[5])
+        last_frames = jnp.concatenate(
+            [state.frames[..., 1:], last_frame[..., None]], axis=-1
+        )
+        new_state = PongPixelState(core=new_core, frames=frames)
+        return new_state, TimeStep(
+            obs=frames,
+            reward=ts.reward,
+            terminated=ts.terminated,
+            truncated=ts.truncated,
+            last_obs=last_frames,
+        )

@@ -32,8 +32,11 @@ def registered() -> list[str]:
 
 def _register_builtins() -> None:
     from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.envs.pong import Pong, PongPixels
 
     register("CartPole-v1", CartPole)
+    register("JaxPong-v0", Pong)
+    register("JaxPongPixels-v0", PongPixels)
 
 
 _register_builtins()

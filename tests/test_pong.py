@@ -1,0 +1,172 @@
+"""JaxPong dynamics invariants + pixel variant (SURVEY.md §4 unit tests;
+stand-in for the reference's Pong IMPALA workload, BASELINE.json:8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from asyncrl_tpu.envs.pong import (
+    AGENT_X,
+    FRAME,
+    MAX_STEPS,
+    OPP_X,
+    PADDLE_HALF,
+    WIN_SCORE,
+    Pong,
+    PongPixels,
+    PongState,
+)
+
+
+def _rollout(env, num_envs, steps, seed=0, policy=None):
+    """vmap+scan rollout with a random (or given) policy; returns stacked
+    TimeSteps and final states."""
+    key = jax.random.PRNGKey(seed)
+    init_keys = jax.random.split(key, num_envs)
+    states = jax.vmap(env.init)(init_keys)
+
+    def step_fn(carry, key):
+        states = carry
+        akeys = jax.random.split(key, num_envs + 1)
+        if policy is None:
+            actions = jax.random.randint(
+                akeys[-1], (num_envs,), 0, env.spec.num_actions
+            )
+        else:
+            actions = policy(states)
+        states, ts = jax.vmap(env.step)(states, actions, akeys[:num_envs])
+        return states, ts
+
+    step_keys = jax.random.split(jax.random.PRNGKey(seed + 1), steps)
+    states, traj = jax.lax.scan(step_fn, states, step_keys)
+    return states, traj
+
+
+def test_pong_invariants_random_policy():
+    env = Pong()
+    states, traj = jax.jit(lambda: _rollout(env, 16, 500))()
+    obs = np.asarray(traj.obs)  # [T, B, 6]
+    # Ball and paddles stay in the unit court.
+    assert (obs[..., 0] >= -0.01).all() and (obs[..., 0] <= 1.01).all()
+    assert (obs[..., 1] >= -0.01).all() and (obs[..., 1] <= 1.01).all()
+    assert (obs[..., 4] >= PADDLE_HALF - 1e-6).all()
+    assert (obs[..., 4] <= 1 - PADDLE_HALF + 1e-6).all()
+    # Rewards only in {-1, 0, 1}.
+    r = np.asarray(traj.reward)
+    assert set(np.unique(r)).issubset({-1.0, 0.0, 1.0})
+    # A random policy concedes points: the opponent scores within 500 steps.
+    assert (r == -1.0).sum() > 0
+    # Scores stay below WIN_SCORE (episode resets at 21).
+    assert (np.asarray(states.score) <= WIN_SCORE).all()
+
+
+def test_pong_perfect_tracker_never_concedes():
+    """A policy that tracks the ball perfectly returns every shot."""
+    env = Pong()
+
+    def tracker(states):
+        # Move toward the ball: action 2 = up(+), 3 = down(−).
+        diff = states.ball[:, 1] - states.agent_y
+        return jnp.where(diff > 0, 2, 3).astype(jnp.int32)
+
+    _, traj = jax.jit(lambda: _rollout(env, 8, 800, policy=tracker))()
+    r = np.asarray(traj.reward)
+    assert (r == -1.0).sum() == 0, "perfect tracker should never concede"
+
+
+def test_pong_scoring_and_serve():
+    """Ball sailing past an absent opponent paddle scores +1 and re-serves."""
+    env = Pong()
+    state = env.init(jax.random.PRNGKey(0))
+    # Ball just left of the opponent plane, moving left, opponent far away.
+    state = PongState(
+        ball=jnp.array([OPP_X + 0.01, 0.9, -0.03, 0.0]),
+        agent_y=jnp.float32(0.5),
+        opp_y=jnp.float32(0.1),  # will track, but ball is at 0.9: miss
+        score=jnp.zeros((2,), jnp.int32),
+        t=jnp.zeros((), jnp.int32),
+    )
+    new_state, ts = jax.jit(env.step)(state, jnp.int32(0), jax.random.PRNGKey(1))
+    assert float(ts.reward) == 1.0
+    assert int(new_state.score[0]) == 1
+    # Re-serve from center.
+    np.testing.assert_allclose(float(new_state.ball[0]), 0.5, atol=1e-6)
+
+
+def test_pong_agent_bounce():
+    """Ball meeting the agent paddle reflects with spin from hit offset."""
+    env = Pong()
+    state = PongState(
+        ball=jnp.array([AGENT_X - 0.01, 0.5 + PADDLE_HALF / 2, 0.03, 0.0]),
+        agent_y=jnp.float32(0.5),
+        opp_y=jnp.float32(0.5),
+        score=jnp.zeros((2,), jnp.int32),
+        t=jnp.zeros((), jnp.int32),
+    )
+    new_state, ts = jax.jit(env.step)(state, jnp.int32(0), jax.random.PRNGKey(1))
+    assert float(ts.reward) == 0.0
+    assert float(new_state.ball[2]) < 0  # reflected
+    assert float(new_state.ball[3]) > 0  # upper-half hit imparts + spin
+
+
+def test_pong_episode_ends_at_win_score():
+    env = Pong()
+    state = PongState(
+        ball=jnp.array([OPP_X + 0.01, 0.9, -0.03, 0.0]),
+        agent_y=jnp.float32(0.5),
+        opp_y=jnp.float32(0.1),
+        score=jnp.array([WIN_SCORE - 1, 0], jnp.int32),
+        t=jnp.int32(100),
+    )
+    new_state, ts = jax.jit(env.step)(state, jnp.int32(0), jax.random.PRNGKey(1))
+    assert bool(ts.terminated)
+    # Auto-reset: fresh episode, scores zeroed.
+    assert int(new_state.score.sum()) == 0
+    assert int(new_state.t) == 0
+
+
+def test_pong_truncation():
+    env = Pong()
+    state = PongState(
+        ball=jnp.array([0.5, 0.5, 0.03, 0.0]),
+        agent_y=jnp.float32(0.5),
+        opp_y=jnp.float32(0.5),
+        score=jnp.zeros((2,), jnp.int32),
+        t=jnp.int32(MAX_STEPS - 1),
+    )
+    _, ts = jax.jit(env.step)(state, jnp.int32(0), jax.random.PRNGKey(1))
+    assert bool(ts.truncated) and not bool(ts.terminated)
+
+
+def test_pong_pixels_shapes_and_stack():
+    env = PongPixels()
+    assert env.spec.obs_shape == (FRAME, FRAME, 4)
+    state = env.init(jax.random.PRNGKey(0))
+    obs = env.observe(state)
+    assert obs.shape == (FRAME, FRAME, 4)
+    # Initial stack: all four frames identical.
+    np.testing.assert_array_equal(
+        np.asarray(obs[..., 0]), np.asarray(obs[..., 3])
+    )
+    # Values are binary and both paddles + ball are painted.
+    vals = np.unique(np.asarray(obs))
+    assert set(vals).issubset({0.0, 1.0})
+    assert np.asarray(obs[..., 0]).sum() > 10
+
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(1)
+    prev = obs
+    for i in range(3):
+        key, sub = jax.random.split(key)
+        state, ts = step(state, jnp.int32(2), sub)
+        # Stack shifts: new frame's slot 0..2 are prev slots 1..3.
+        np.testing.assert_array_equal(
+            np.asarray(ts.obs[..., :3]), np.asarray(prev[..., 1:])
+        )
+        prev = ts.obs
+
+
+def test_pong_pixels_vmap_scan():
+    env = PongPixels()
+    states, traj = jax.jit(lambda: _rollout(env, 4, 8))()
+    assert traj.obs.shape == (8, 4, FRAME, FRAME, 4)
