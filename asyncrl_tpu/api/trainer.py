@@ -110,8 +110,11 @@ class Trainer:
         fully on device (one jitted scan)."""
         cache_key = (num_episodes, max_steps)
         if cache_key not in self._eval_fns:
+            from asyncrl_tpu.ops import distributions
+
             env = self.env
             apply_fn = self.model.apply
+            dist = distributions.for_spec(env.spec)
 
             def eval_rollout(params, key):
                 init_keys = jax.random.split(key, num_episodes + 1)
@@ -121,8 +124,8 @@ class Trainer:
 
                 def body(carry, _):
                     env_state, obs, ret, alive, k = carry
-                    logits, _ = apply_fn(params, obs)
-                    actions = jnp.argmax(logits, axis=-1)
+                    dist_params, _ = apply_fn(params, obs)
+                    actions = dist.mode(dist_params)
                     k, sub = jax.random.split(k)
                     step_keys = jax.random.split(sub, num_episodes)
                     env_state, ts = jax.vmap(env.step)(env_state, actions, step_keys)

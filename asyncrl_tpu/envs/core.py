@@ -52,11 +52,18 @@ class TimeStep:
 
 @dataclasses.dataclass(frozen=True)
 class EnvSpec:
-    """Static env metadata used to build models and buffers."""
+    """Static env metadata used to build models and buffers.
+
+    Discrete envs set ``num_actions``; continuous envs (the Brax-style
+    workloads, BASELINE.json:11) set ``continuous=True`` + ``action_dim``
+    and clip incoming actions to their own physical bounds.
+    """
 
     obs_shape: tuple[int, ...]
-    num_actions: int  # discrete action spaces only, like the reference suites
+    num_actions: int = 0  # discrete spaces; 0 for continuous envs
     obs_dtype: Any = jnp.float32
+    continuous: bool = False
+    action_dim: int = 0  # continuous spaces; 0 for discrete envs
 
 
 class Environment:

@@ -1,0 +1,176 @@
+"""ctypes wrapper for the native C++ vectorized env pool (native/envpool.cc).
+
+This is the framework's ALE-analogue: a C++ engine stepping hundreds of envs
+per call behind a batched C ABI, feeding the Sebulba host path
+(SURVEY.md §2.1, §7.2 M3). ctypes releases the GIL during ``envpool_step``,
+so Python actor threads overlap env stepping with device inference.
+
+The library auto-builds via ``make`` on first use (g++ is in the image;
+SURVEY.md §7.0) and is cached under ``native/build/``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libenvpool.so")
+_BUILD_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+
+
+def _build() -> None:
+    proc = subprocess.run(
+        ["make", "-C", _NATIVE_DIR],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native env pool build failed (exit {proc.returncode}):\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if needed) and load the shared library; cached per-process."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.join(_NATIVE_DIR, "envpool.cc")
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        ):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.envpool_create.restype = ctypes.c_void_p
+        lib.envpool_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ]
+        lib.envpool_reset.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.envpool_step.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 5
+        lib.envpool_obs_dim.argtypes = [ctypes.c_void_p]
+        lib.envpool_obs_dim.restype = ctypes.c_int
+        lib.envpool_num_actions.argtypes = [ctypes.c_void_p]
+        lib.envpool_num_actions.restype = ctypes.c_int
+        lib.envpool_num_envs.argtypes = [ctypes.c_void_p]
+        lib.envpool_num_envs.restype = ctypes.c_int
+        lib.envpool_destroy.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+# env ids the native engine implements, mapped from registry ids.
+NATIVE_ENV_IDS = {
+    "CartPole-v1": "CartPole-v1",
+    "JaxPong-v0": "Pong",  # same rules as the JAX env (envs/pong.py)
+}
+
+
+class NativeEnvPool:
+    """A batch of C++ envs stepped in one call.
+
+    ``step`` takes int32 actions [B] and returns
+    ``(obs [B, D] f32, reward [B] f32, terminated [B] bool, truncated [B]
+    bool)``; envs auto-reset (post-reset obs returned), matching the
+    functional env contract (envs/core.py).
+    """
+
+    def __init__(
+        self,
+        env_id: str,
+        num_envs: int,
+        num_threads: int = 0,
+        seed: int = 0,
+    ):
+        if env_id not in NATIVE_ENV_IDS:
+            raise KeyError(
+                f"no native implementation for {env_id!r}; "
+                f"have {sorted(NATIVE_ENV_IDS)}"
+            )
+        if num_envs < 1:
+            raise ValueError(f"num_envs must be >= 1, got {num_envs}")
+        self._lib = load_library()
+        if num_threads <= 0:
+            # Threads pay off only for biggish batches.
+            num_threads = min(8, max(1, num_envs // 64))
+        self._handle = self._lib.envpool_create(
+            NATIVE_ENV_IDS[env_id].encode(), num_envs, num_threads, seed
+        )
+        if not self._handle:
+            raise RuntimeError(f"envpool_create failed for {env_id!r}")
+        self.num_envs = num_envs
+        self.obs_dim = self._lib.envpool_obs_dim(self._handle)
+        self.num_actions = self._lib.envpool_num_actions(self._handle)
+        # Reused output buffers: zero allocation in the hot loop.
+        self._obs = np.empty((num_envs, self.obs_dim), np.float32)
+        self._rew = np.empty((num_envs,), np.float32)
+        self._term = np.empty((num_envs,), np.uint8)
+        self._trunc = np.empty((num_envs,), np.uint8)
+
+    def reset(self) -> np.ndarray:
+        self._lib.envpool_reset(self._handle, self._obs.ctypes.data)
+        return self._obs.copy()
+
+    def step(
+        self, actions: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Step all envs; returns fresh arrays safe to retain across calls
+        (the C side writes into reused internal buffers; the copies here are
+        noise next to the env-step cost, and ``step_into`` exists for
+        zero-copy staging straight into a caller-owned fragment buffer)."""
+        self.step_into(
+            actions, self._obs, self._rew, self._term, self._trunc
+        )
+        return (
+            self._obs.copy(),
+            self._rew.copy(),
+            self._term.astype(bool),
+            self._trunc.astype(bool),
+        )
+
+    def step_into(
+        self,
+        actions: np.ndarray,
+        obs_out: np.ndarray,
+        rew_out: np.ndarray,
+        term_out: np.ndarray,
+        trunc_out: np.ndarray,
+    ) -> None:
+        """Zero-copy step: writes results into caller-owned C-contiguous
+        arrays (obs [B, D] f32, rew [B] f32, term/trunc [B] u8). This is the
+        Sebulba hot path — results land directly in the fragment staging
+        buffer."""
+        actions = np.ascontiguousarray(actions, np.int32)
+        assert actions.shape == (self.num_envs,)
+        assert obs_out.flags.c_contiguous and obs_out.dtype == np.float32
+        self._lib.envpool_step(
+            self._handle,
+            actions.ctypes.data,
+            obs_out.ctypes.data,
+            rew_out.ctypes.data,
+            term_out.ctypes.data,
+            trunc_out.ctypes.data,
+        )
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.envpool_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

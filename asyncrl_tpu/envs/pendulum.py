@@ -1,0 +1,92 @@
+"""Pure-JAX Pendulum swing-up: the continuous-control / on-TPU-physics
+stand-in for the reference's Brax Ant/Humanoid PPO workload
+(BASELINE.json:11) — brax is not installed in this image (SURVEY.md §7.4
+R1), so the physics runs as a functional JAX env instead, vectorized to
+thousands of instances in HBM exactly like Brax would be.
+
+Dynamics are gymnasium's Pendulum-v1 exactly (g=10, m=1, l=1, dt=0.05,
+torque clipped to ±2, speed clipped to ±8, 200-step episodes, reward
+−(θ²+0.1·θ̇²+0.001·u²)); solved is a mean return around −150, random play
+sits near −1200.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+
+G = 10.0
+MASS = 1.0
+LENGTH = 1.0
+DT = 0.05
+MAX_SPEED = 8.0
+MAX_TORQUE = 2.0
+MAX_STEPS = 200
+
+
+@struct.dataclass
+class PendulumState:
+    theta: jax.Array  # angle, 0 = upright
+    theta_dot: jax.Array
+    t: jax.Array  # int32 step count
+
+
+def _angle_normalize(x: jax.Array) -> jax.Array:
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class Pendulum(Environment):
+    """Pendulum-v1: obs [cosθ, sinθ, θ̇], one continuous torque dim."""
+
+    spec = EnvSpec(obs_shape=(3,), continuous=True, action_dim=1)
+
+    def init(self, key: jax.Array) -> PendulumState:
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, (), jnp.float32, -jnp.pi, jnp.pi)
+        theta_dot = jax.random.uniform(k2, (), jnp.float32, -1.0, 1.0)
+        return PendulumState(theta=theta, theta_dot=theta_dot, t=jnp.zeros((), jnp.int32))
+
+    def observe(self, state: PendulumState) -> jax.Array:
+        return jnp.stack(
+            [jnp.cos(state.theta), jnp.sin(state.theta), state.theta_dot]
+        )
+
+    def step(
+        self, state: PendulumState, action: jax.Array, key: jax.Array
+    ) -> tuple[PendulumState, TimeStep]:
+        u = jnp.clip(action[0], -MAX_TORQUE, MAX_TORQUE)
+        th, thdot = state.theta, state.theta_dot
+
+        cost = (
+            jnp.square(_angle_normalize(th))
+            + 0.1 * jnp.square(thdot)
+            + 0.001 * jnp.square(u)
+        )
+
+        # gymnasium Pendulum-v1 semi-implicit Euler (theta uses the NEW
+        # velocity).
+        thdot = thdot + (
+            3.0 * G / (2.0 * LENGTH) * jnp.sin(th)
+            + 3.0 / (MASS * LENGTH**2) * u
+        ) * DT
+        thdot = jnp.clip(thdot, -MAX_SPEED, MAX_SPEED)
+        th = th + thdot * DT
+
+        t = state.t + 1
+        truncated = t >= MAX_STEPS  # pendulum never terminates, only truncates
+        ended = PendulumState(theta=th, theta_dot=thdot, t=t)
+        fresh = self.init(key)
+        new_state = jax.tree.map(
+            lambda f, e: jnp.where(truncated, f, e), fresh, ended
+        )
+        ts = TimeStep(
+            obs=self.observe(new_state),
+            reward=-cost,
+            terminated=jnp.zeros((), bool),
+            truncated=truncated,
+            last_obs=self.observe(ended),
+        )
+        return new_state, ts

@@ -32,11 +32,13 @@ def registered() -> list[str]:
 
 def _register_builtins() -> None:
     from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.envs.pendulum import Pendulum
     from asyncrl_tpu.envs.pong import Pong, PongPixels
 
     register("CartPole-v1", CartPole)
     register("JaxPong-v0", Pong)
     register("JaxPongPixels-v0", PongPixels)
+    register("JaxPendulum-v0", Pendulum)
 
 
 _register_builtins()

@@ -68,12 +68,13 @@ def make_optimizer(config: Config) -> optax.GradientTransformation:
 
 def _algo_loss(
     config: Config, apply_fn, params, rollout: Rollout,
-    axis_name: str | None = None,
+    axis_name: str | None = None, dist=None,
 ):
     """Forward the learner net over [T+1, B] obs and apply the configured
     algorithm's loss. Returns (loss, metrics). ``axis_name`` is the dp mesh
     axis when called inside shard_map (for losses needing global batch
-    moments, i.e. PPO advantage normalization)."""
+    moments, i.e. PPO advantage normalization). ``dist`` interprets the
+    policy head (ops.distributions)."""
     obs_all = jnp.concatenate([rollout.obs, rollout.bootstrap_obs[None]], axis=0)
     logits, values = apply_fn(params, obs_all)
     logits_t, values_t = logits[:-1], values[:-1]
@@ -85,6 +86,7 @@ def _algo_loss(
             logits_t, values_t, rollout.actions, rollout.rewards, discounts,
             jax.lax.stop_gradient(bootstrap_value),
             value_coef=config.value_coef, entropy_coef=config.entropy_coef,
+            dist=dist,
         )
     if config.algo == "impala":
         return impala_loss(
@@ -92,11 +94,12 @@ def _algo_loss(
             rollout.rewards, discounts, jax.lax.stop_gradient(bootstrap_value),
             value_coef=config.value_coef, entropy_coef=config.entropy_coef,
             rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
+            dist=dist,
         )
     if config.algo == "ppo":
-        # Single-pass PPO over the fresh fragment. The multi-epoch
-        # minibatched update (config.ppo_epochs/ppo_minibatches) is planned
-        # as a separate step body; until then those knobs are inert here.
+        # Single-pass PPO over the fresh fragment (used when
+        # ppo_epochs == ppo_minibatches == 1; the multi-epoch minibatched
+        # path is _ppo_multipass below).
         adv = gae(
             rollout.rewards, discounts, jax.lax.stop_gradient(values_t),
             jax.lax.stop_gradient(bootstrap_value), config.gae_lambda,
@@ -106,8 +109,96 @@ def _algo_loss(
             adv.advantages, adv.returns,
             clip_eps=config.ppo_clip_eps, value_coef=config.value_coef,
             entropy_coef=config.entropy_coef, axis_name=axis_name,
+            dist=dist,
         )
     raise ValueError(f"unknown algo {config.algo!r}")
+
+
+def _ppo_multipass(
+    config: Config, apply_fn, optimizer, dist, params, opt_state,
+    rollout: Rollout, update_step: jax.Array,
+):
+    """PPO's real update: ``ppo_epochs`` passes over the fragment, each a
+    scan of ``ppo_minibatches`` shuffled minibatch Adam steps (the reference's
+    Procgen PPO config, BASELINE.json:10).
+
+    Advantages/returns are computed ONCE under the pre-update params (the
+    standard PPO recipe); each minibatch recomputes the ratio against the
+    progressively-updated params. Runs inside shard_map: each device shuffles
+    its local fragment independently (decorrelated minibatches), while
+    gradients and advantage-normalization moments ride the implicit/explicit
+    psum over the dp axis, so every device applies identical parameter
+    updates.
+    """
+    obs_all = jnp.concatenate([rollout.obs, rollout.bootstrap_obs[None]], axis=0)
+    _, values_all = apply_fn(params, obs_all)
+    values_t, bootstrap_value = values_all[:-1], values_all[-1]
+    adv = gae(
+        rollout.rewards,
+        rollout.discounts(config.gamma),
+        jax.lax.stop_gradient(values_t),
+        jax.lax.stop_gradient(bootstrap_value),
+        config.gae_lambda,
+    )
+
+    T, B = rollout.actions.shape[:2]
+    n = T * B
+    mb = config.ppo_minibatches
+    if n % mb:
+        raise ValueError(
+            f"unroll_len*local_envs={n} not divisible by ppo_minibatches={mb}"
+        )
+    flat = {
+        "obs": rollout.obs.reshape(n, *rollout.obs.shape[2:]),
+        "actions": rollout.actions.reshape(n, *rollout.actions.shape[2:]),
+        "behaviour_logp": rollout.behaviour_logp.reshape(n),
+        "advantages": jax.lax.stop_gradient(adv.advantages).reshape(n),
+        "returns": jax.lax.stop_gradient(adv.returns).reshape(n),
+    }
+
+    # Deterministic per-(step, device, epoch) shuffle key; no PRNG state
+    # threads through TrainState.
+    base_key = jax.random.fold_in(
+        jax.random.PRNGKey(config.seed + 0x5EB), update_step
+    )
+    base_key = jax.random.fold_in(base_key, jax.lax.axis_index(DP_AXIS))
+
+    def minibatch_step(carry, batch):
+        params, opt_state = carry
+
+        def scaled_loss(p):
+            logits, values = apply_fn(p, batch["obs"])
+            loss, metrics = ppo_loss(
+                logits, values, batch["actions"], batch["behaviour_logp"],
+                batch["advantages"], batch["returns"],
+                clip_eps=config.ppo_clip_eps, value_coef=config.value_coef,
+                entropy_coef=config.entropy_coef, axis_name=DP_AXIS, dist=dist,
+            )
+            metrics = dict(metrics, loss=loss)
+            return loss / jax.lax.axis_size(DP_AXIS), metrics
+
+        grads, metrics = jax.grad(scaled_loss, has_aux=True)(params)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), metrics
+
+    def epoch_step(carry, ekey):
+        perm = jax.random.permutation(ekey, n)
+        batches = jax.tree.map(
+            lambda x: x[perm].reshape(mb, n // mb, *x.shape[1:]), flat
+        )
+        return jax.lax.scan(minibatch_step, carry, batches)
+
+    epoch_keys = jax.random.split(base_key, config.ppo_epochs)
+    (params, opt_state), metrics = jax.lax.scan(
+        epoch_step, (params, opt_state), epoch_keys
+    )
+    # [E, M, ...] scalars -> means; psum-averaged later by the caller.
+    metrics = jax.tree.map(jnp.mean, metrics)
+    loss = metrics.pop("loss")
+    grad_norm = metrics.pop("grad_norm")
+    return params, opt_state, loss, grad_norm, metrics
 
 
 def make_train_step(
@@ -117,34 +208,53 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
 ) -> Callable[[TrainState], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the per-shard train-step body (to be wrapped in shard_map)."""
+    from asyncrl_tpu.ops import distributions
+
+    dist = distributions.for_spec(env.spec)
+
+    # Static choice: PPO with epochs/minibatches > 1 takes the multipass
+    # update path; everything else is one fused gradient step.
+    ppo_multipass = config.algo == "ppo" and (
+        config.ppo_epochs > 1 or config.ppo_minibatches > 1
+    )
 
     def train_step(state: TrainState):
         actor, rollout, stats = unroll(
-            apply_fn, state.actor_params, env, state.actor, config.unroll_len
+            apply_fn, state.actor_params, env, state.actor, config.unroll_len,
+            dist=dist, reward_scale=config.reward_scale,
         )
 
-        # shard_map autodiff semantics (jax>=0.8 vma tracking): the gradient
-        # of a REPLICATED input (params) w.r.t. a device-varying loss is
-        # automatically psum'd across the mesh axis during transposition.
-        # So we scale the per-shard loss by 1/axis_size — the implicit psum
-        # of local-mean gradients then yields exactly the global-batch-mean
-        # gradient, with no explicit pmean(grads) (which would double-count:
-        # verified 8x inflation on the 8-device CPU mesh, tests/test_learner).
-        def scaled_loss(p):
-            loss, metrics = _algo_loss(
-                config, apply_fn, p, rollout, axis_name=DP_AXIS
+        if ppo_multipass:
+            params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
+                config, apply_fn, optimizer, dist,
+                state.params, state.opt_state, rollout, state.update_step,
             )
-            return loss / jax.lax.axis_size(DP_AXIS), (loss, metrics)
+        else:
+            # shard_map autodiff semantics (jax>=0.8 vma tracking): the
+            # gradient of a REPLICATED input (params) w.r.t. a device-varying
+            # loss is automatically psum'd across the mesh axis during
+            # transposition. So we scale the per-shard loss by 1/axis_size —
+            # the implicit psum of local-mean gradients then yields exactly
+            # the global-batch-mean gradient, with no explicit pmean(grads)
+            # (which would double-count: verified 8x inflation on the
+            # 8-device CPU mesh, tests/test_learner).
+            def scaled_loss(p):
+                loss, metrics = _algo_loss(
+                    config, apply_fn, p, rollout, axis_name=DP_AXIS, dist=dist
+                )
+                return loss / jax.lax.axis_size(DP_AXIS), (loss, metrics)
 
-        (_, (loss, metrics)), grads = jax.value_and_grad(
-            scaled_loss, has_aux=True
-        )(state.params)
+            (_, (loss, metrics)), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True
+            )(state.params)
+            grad_norm = optax.global_norm(grads)
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
 
         metrics = jax.lax.pmean(metrics, DP_AXIS)
         loss = jax.lax.pmean(loss, DP_AXIS)
-
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
 
         step = state.update_step + 1
         if config.algo == "impala" and config.actor_staleness > 1:
@@ -161,7 +271,7 @@ def make_train_step(
 
         metrics = dict(metrics)
         metrics["loss"] = loss
-        metrics["grad_norm"] = optax.global_norm(grads)
+        metrics["grad_norm"] = grad_norm
         metrics["episode_return_sum"] = jax.lax.psum(
             stats.completed_return_sum, DP_AXIS
         )
@@ -201,6 +311,22 @@ class Learner:
         self.model = model
         self.mesh = mesh
         self.optimizer = make_optimizer(config)
+
+        # Eager geometry validation (clearer than a trace-time failure).
+        dp = mesh.shape[DP_AXIS]
+        if config.num_envs % dp:
+            raise ValueError(
+                f"num_envs={config.num_envs} not divisible by dp={dp}"
+            )
+        if config.algo == "ppo" and (
+            config.ppo_epochs > 1 or config.ppo_minibatches > 1
+        ):
+            local = (config.num_envs // dp) * config.unroll_len
+            if local % config.ppo_minibatches:
+                raise ValueError(
+                    f"per-device fragment of {local} samples not divisible "
+                    f"by ppo_minibatches={config.ppo_minibatches}"
+                )
 
         spec = state_partition_spec()
         body = make_train_step(config, env, model.apply, self.optimizer)

@@ -86,8 +86,12 @@ class ImpalaCNN(nn.Module):
 class ActorCritic(nn.Module):
     """Shared-torso policy + value network.
 
-    ``__call__`` returns ``(logits [..., A], value [...])`` in float32
-    regardless of compute dtype, so losses and V-trace stay full-precision.
+    ``__call__`` returns ``(dist_params, value)`` in float32 regardless of
+    compute dtype, so losses and V-trace stay full-precision. For discrete
+    envs ``dist_params`` are logits [..., A]; for continuous envs they are
+    concat(mean, log_std) [..., 2*D] with log_std a learned
+    state-independent bias (the standard continuous-PPO head) — interpreted
+    by ``ops.distributions``.
     """
 
     num_actions: int
@@ -96,6 +100,8 @@ class ActorCritic(nn.Module):
     channels: Sequence[int] = (16, 32, 32)
     compute_dtype: jnp.dtype = jnp.float32
     obs_rank: int = 1  # rank of one observation (e.g. 3 for H,W,C images)
+    continuous: bool = False
+    action_dim: int = 0
 
     @nn.compact
     def __call__(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -107,9 +113,22 @@ class ActorCritic(nn.Module):
             h = ImpalaCNN(self.channels, self.compute_dtype)(obs)
         else:
             raise ValueError(f"unknown torso {self.torso!r}")
-        logits = nn.Dense(self.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01))(h)
+        if self.continuous:
+            mean = nn.Dense(
+                self.action_dim, dtype=jnp.float32, kernel_init=ORTHO(0.01)
+            )(h)
+            log_std = self.param(
+                "log_std", nn.initializers.zeros, (self.action_dim,), jnp.float32
+            )
+            dist_params = jnp.concatenate(
+                [mean, jnp.broadcast_to(log_std, mean.shape)], axis=-1
+            )
+        else:
+            dist_params = nn.Dense(
+                self.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01)
+            )(h)
         value = nn.Dense(1, dtype=jnp.float32, kernel_init=ORTHO(1.0))(h)[..., 0]
-        return logits.astype(jnp.float32), value.astype(jnp.float32)
+        return dist_params.astype(jnp.float32), value.astype(jnp.float32)
 
 
 def build_model(config, env_spec) -> ActorCritic:
@@ -124,4 +143,6 @@ def build_model(config, env_spec) -> ActorCritic:
         channels=tuple(config.channels),
         compute_dtype=compute_dtype,
         obs_rank=len(env_spec.obs_shape),
+        continuous=env_spec.continuous,
+        action_dim=env_spec.action_dim,
     )

@@ -1,0 +1,112 @@
+"""Policy action distributions.
+
+The reference's suites span discrete control (CartPole/Atari/Procgen) and
+continuous control (Brax Ant/Humanoid) — BASELINE.json:6-12. Rather than
+special-casing losses and rollouts per action space, the policy head emits a
+flat ``dist_params`` array and one of these (stateless, jit-friendly)
+distribution objects interprets it:
+
+- ``Categorical``: ``dist_params`` = logits [..., A]; int32 actions [...].
+- ``DiagGaussian``: ``dist_params`` = concat(mean, log_std) [..., 2*D];
+  float32 actions [..., D]. log_std is state-dependent only if the model
+  makes it so (the builtin head uses a learned state-independent bias, the
+  standard PPO continuous-control parameterization).
+
+Everything is a pure function over arrays — usable inside ``vmap``/``scan``/
+``shard_map`` with no dispatch overhead (shape-static branching happens at
+trace time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from asyncrl_tpu.utils.prng import gumbel_sample
+
+
+@dataclasses.dataclass(frozen=True)
+class Categorical:
+    """Discrete action distribution over ``num_actions`` choices."""
+
+    num_actions: int
+
+    @property
+    def param_size(self) -> int:
+        return self.num_actions
+
+    @property
+    def action_dtype(self):
+        return jnp.int32
+
+    def sample(self, key: jax.Array, params: jax.Array) -> jax.Array:
+        """Unbatched sample: params [A] -> scalar action (vmap for batches)."""
+        return gumbel_sample(key, params)
+
+    def logp(self, params: jax.Array, actions: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(params, axis=-1)
+        return jnp.take_along_axis(
+            logp, actions[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+
+    def entropy(self, params: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(params, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    def mode(self, params: jax.Array) -> jax.Array:
+        return jnp.argmax(params, axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class DiagGaussian:
+    """Diagonal Gaussian over ``action_dim`` continuous dims.
+
+    Actions are emitted unsquashed (the env applies its own physical bounds,
+    e.g. torque clipping); log-probs are of the unsquashed sample, the
+    standard choice for clipped continuous PPO.
+    """
+
+    action_dim: int
+
+    @property
+    def param_size(self) -> int:
+        return 2 * self.action_dim
+
+    @property
+    def action_dtype(self):
+        return jnp.float32
+
+    def _split(self, params: jax.Array) -> tuple[jax.Array, jax.Array]:
+        mean = params[..., : self.action_dim]
+        log_std = jnp.clip(params[..., self.action_dim :], -20.0, 2.0)
+        return mean, log_std
+
+    def sample(self, key: jax.Array, params: jax.Array) -> jax.Array:
+        """Unbatched sample: params [2D] -> action [D] (vmap for batches)."""
+        mean, log_std = self._split(params)
+        noise = jax.random.normal(key, mean.shape, mean.dtype)
+        return mean + jnp.exp(log_std) * noise
+
+    def logp(self, params: jax.Array, actions: jax.Array) -> jax.Array:
+        mean, log_std = self._split(params)
+        z = (actions - mean) * jnp.exp(-log_std)
+        per_dim = -0.5 * jnp.square(z) - log_std - 0.5 * math.log(2 * math.pi)
+        return jnp.sum(per_dim, axis=-1)
+
+    def entropy(self, params: jax.Array) -> jax.Array:
+        _, log_std = self._split(params)
+        return jnp.sum(log_std + 0.5 * math.log(2 * math.pi * math.e), axis=-1)
+
+    def mode(self, params: jax.Array) -> jax.Array:
+        mean, _ = self._split(params)
+        return mean
+
+
+def for_spec(spec) -> Categorical | DiagGaussian:
+    """Distribution matching an ``EnvSpec``."""
+    if getattr(spec, "continuous", False):
+        return DiagGaussian(spec.action_dim)
+    return Categorical(spec.num_actions)

@@ -36,6 +36,7 @@ def a3c_loss(
     bootstrap_value: jax.Array,
     value_coef: float = 0.5,
     entropy_coef: float = 0.01,
+    dist=None,
 ):
     """n-step-return actor-critic loss (A3C, PAPERS.md:8).
 
@@ -46,10 +47,10 @@ def a3c_loss(
         n_step_returns(rewards, discounts, bootstrap_value)
     )
     advantages = returns - values
-    logp = categorical_logp(logits, actions)
+    logp = dist.logp(logits, actions) if dist else categorical_logp(logits, actions)
     pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(advantages))
     value_loss = 0.5 * jnp.mean(jnp.square(advantages))
-    entropy = jnp.mean(categorical_entropy(logits))
+    entropy = jnp.mean(dist.entropy(logits) if dist else categorical_entropy(logits))
     loss = pg_loss + value_coef * value_loss - entropy_coef * entropy
     metrics = {
         "pg_loss": pg_loss,
@@ -72,10 +73,11 @@ def impala_loss(
     entropy_coef: float = 0.01,
     rho_clip: float = 1.0,
     c_clip: float = 1.0,
+    dist=None,
 ):
     """IMPALA: V-trace corrected policy gradient + value + entropy
     (BASELINE.json:5 'V-trace correction + policy-gradient/value loss')."""
-    target_logp = categorical_logp(logits, actions)
+    target_logp = dist.logp(logits, actions) if dist else categorical_logp(logits, actions)
     vt = vtrace(
         behaviour_logp=behaviour_logp,
         target_logp=target_logp,
@@ -88,7 +90,7 @@ def impala_loss(
     )
     pg_loss = -jnp.mean(target_logp * vt.pg_advantages)
     value_loss = 0.5 * jnp.mean(jnp.square(vt.vs - values))
-    entropy = jnp.mean(categorical_entropy(logits))
+    entropy = jnp.mean(dist.entropy(logits) if dist else categorical_entropy(logits))
     loss = pg_loss + value_coef * value_loss - entropy_coef * entropy
     metrics = {
         "pg_loss": pg_loss,
@@ -112,6 +114,7 @@ def ppo_loss(
     entropy_coef: float = 0.01,
     normalize_advantages: bool = True,
     axis_name: str | None = None,
+    dist=None,
 ):
     """PPO clipped surrogate over precomputed GAE advantages
     (BASELINE.json:10 'PPO + GAE'). Flat or [T, B] batch shapes both work.
@@ -121,7 +124,7 @@ def ppo_loss(
     moments (otherwise each shard would normalize differently and dp
     training would diverge from single-device training).
     """
-    logp = categorical_logp(logits, actions)
+    logp = dist.logp(logits, actions) if dist else categorical_logp(logits, actions)
     ratio = jnp.exp(logp - behaviour_logp)
     if normalize_advantages:
         mean = jnp.mean(advantages)
@@ -135,7 +138,7 @@ def ppo_loss(
     clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * advantages
     pg_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
     value_loss = 0.5 * jnp.mean(jnp.square(returns - values))
-    entropy = jnp.mean(categorical_entropy(logits))
+    entropy = jnp.mean(dist.entropy(logits) if dist else categorical_entropy(logits))
     loss = pg_loss + value_coef * value_loss - entropy_coef * entropy
     metrics = {
         "pg_loss": pg_loss,

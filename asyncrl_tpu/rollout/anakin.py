@@ -22,7 +22,6 @@ from flax import struct
 
 from asyncrl_tpu.envs.core import Environment
 from asyncrl_tpu.rollout.buffer import EpisodeStats, Rollout
-from asyncrl_tpu.utils.prng import gumbel_sample
 
 
 @struct.dataclass
@@ -52,34 +51,35 @@ def actor_init(env: Environment, num_envs: int, seed_key: jax.Array) -> ActorSta
     )
 
 
-def _sample_categorical(keys: jax.Array, logits: jax.Array) -> jax.Array:
-    """Per-env Gumbel-max categorical sample; keys [B,2], logits [B,A]."""
-    return jax.vmap(gumbel_sample)(keys, logits)
-
-
 def unroll(
     apply_fn: Callable[[Any, jax.Array], tuple[jax.Array, jax.Array]],
     params: Any,
     env: Environment,
     actor_state: ActorState,
     unroll_len: int,
+    dist=None,
+    reward_scale: float = 1.0,
 ) -> tuple[ActorState, Rollout, EpisodeStats]:
     """Roll the policy forward ``unroll_len`` steps over the env batch.
 
-    ``apply_fn(params, obs[B]) -> (logits[B, A], value[B])``. The value head
-    output is discarded here (the learner recomputes values under its own
-    params); only the behaviour log-prob is recorded — exactly what V-trace
-    needs (SURVEY.md §3.3).
+    ``apply_fn(params, obs[B]) -> (dist_params[B, P], value[B])``. The value
+    head output is discarded here (the learner recomputes values under its
+    own params); only the behaviour log-prob is recorded — exactly what
+    V-trace needs (SURVEY.md §3.3). ``dist`` (ops.distributions) interprets
+    the policy head; defaults to the spec's distribution.
     """
+    if dist is None:
+        from asyncrl_tpu.ops import distributions
+
+        dist = distributions.for_spec(env.spec)
 
     def step_fn(carry: ActorState, _):
         split = jax.vmap(lambda k: jax.random.split(k, 3))(carry.keys)  # [B,3,2]
         next_keys, act_keys, step_keys = split[:, 0], split[:, 1], split[:, 2]
 
-        logits, _ = apply_fn(params, carry.obs)
-        actions = _sample_categorical(act_keys, logits)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        behaviour_logp = jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+        dist_params, _ = apply_fn(params, carry.obs)
+        actions = jax.vmap(dist.sample)(act_keys, dist_params)
+        behaviour_logp = dist.logp(dist_params, actions)
 
         env_state, ts = jax.vmap(env.step)(carry.env_state, actions, step_keys)
 
@@ -97,7 +97,7 @@ def unroll(
             carry.obs,
             actions,
             behaviour_logp,
-            ts.reward,
+            ts.reward * reward_scale,  # learner's view; metrics stay raw
             ts.terminated,
             ts.truncated,
             ep_return * done_f,

@@ -26,7 +26,7 @@ class Rollout:
     """
 
     obs: jax.Array  # [T, B, *obs_shape]
-    actions: jax.Array  # [T, B] int32
+    actions: jax.Array  # [T, B] int32 (discrete) | [T, B, D] f32 (continuous)
     behaviour_logp: jax.Array  # [T, B] float32
     rewards: jax.Array  # [T, B] float32
     terminated: jax.Array  # [T, B] bool

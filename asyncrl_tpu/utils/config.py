@@ -48,6 +48,12 @@ class Config:
     # --- loss coefficients ---
     value_coef: float = 0.5
     entropy_coef: float = 0.01
+    # Reward scaling applied to the learner's view of rewards (episode-return
+    # metrics stay raw). Essential for continuous-control workloads whose raw
+    # returns are in the hundreds/thousands (e.g. Pendulum ≈ −1200): without
+    # it the value loss dwarfs the policy gradient under grad-norm clipping.
+    # Brax's PPO does the same for Ant/Humanoid (BASELINE.json:11).
+    reward_scale: float = 1.0
 
     # --- IMPALA / V-trace ---
     vtrace_rho_clip: float = 1.0

@@ -1,0 +1,369 @@
+// Native vectorized environment pool.
+//
+// The reference reaches its C++ env engine (ALE) through per-thread Python
+// workers (SURVEY.md §2.1). The TPU-native framework inverts that: the pool
+// itself is C++ and steps ALL envs for one batched policy query, so the
+// Sebulba host path does exactly one Python→C call per env-batch step —
+// no per-env Python, no GIL contention in the hot loop (the Python side
+// releases the GIL around envpool_step via ctypes).
+//
+// Envs implemented: CartPole-v1 (gymnasium dynamics) and Pong (the same
+// rules as asyncrl_tpu/envs/pong.py, so the native pool and the JAX env are
+// cross-checkable trajectory-for-trajectory in tests).
+//
+// Threading: a persistent worker pool with a generation-counted barrier.
+// Each step, workers wake, step their contiguous env slice, and report done.
+// For small batches the main thread steps everything itself (threads only
+// pay off past a few hundred envs).
+//
+// C ABI only (ctypes-friendly): create / reset / step / destroy.
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr float kPi = 3.14159265358979323846f;
+
+// ----------------------------------------------------------------- RNG
+// xorshift128+ per env: fast, no allocation, seedable.
+struct Rng {
+  uint64_t s0, s1;
+  void seed(uint64_t seed) {
+    // splitmix64 init
+    uint64_t z = (seed += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    s0 = z ^ (z >> 31);
+    z = (seed += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    s1 = z ^ (z >> 31);
+  }
+  uint64_t next() {
+    uint64_t x = s0;
+    const uint64_t y = s1;
+    s0 = y;
+    x ^= x << 23;
+    s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return s1 + y;
+  }
+  // uniform in [lo, hi)
+  float uniform(float lo, float hi) {
+    return lo + (hi - lo) * (float)((next() >> 11) * (1.0 / 9007199254740992.0));
+  }
+};
+
+// ----------------------------------------------------------------- envs
+struct EnvBase {
+  virtual ~EnvBase() = default;
+  virtual int obs_dim() const = 0;
+  virtual int num_actions() const = 0;
+  virtual void reset(Rng& rng, float* obs) = 0;
+  // Steps; fills obs (post-reset on episode end), reward, terminated,
+  // truncated. Auto-resets internally.
+  virtual void step(int action, Rng& rng, float* obs, float* reward,
+                    uint8_t* terminated, uint8_t* truncated) = 0;
+};
+
+// CartPole-v1, gymnasium dynamics (matches asyncrl_tpu/envs/cartpole.py).
+struct CartPoleEnv final : EnvBase {
+  static constexpr float kGravity = 9.8f, kMassCart = 1.0f, kMassPole = 0.1f;
+  static constexpr float kTotalMass = kMassCart + kMassPole;
+  static constexpr float kHalfPole = 0.5f;
+  static constexpr float kPoleMassLength = kMassPole * kHalfPole;
+  static constexpr float kForceMag = 10.0f, kTau = 0.02f;
+  static constexpr float kThetaThresh = 12.0f * 2.0f * kPi / 360.0f;
+  static constexpr float kXThresh = 2.4f;
+  static constexpr int kMaxSteps = 500;
+
+  float x, x_dot, theta, theta_dot;
+  int t;
+
+  int obs_dim() const override { return 4; }
+  int num_actions() const override { return 2; }
+
+  void reset(Rng& rng, float* obs) override {
+    x = rng.uniform(-0.05f, 0.05f);
+    x_dot = rng.uniform(-0.05f, 0.05f);
+    theta = rng.uniform(-0.05f, 0.05f);
+    theta_dot = rng.uniform(-0.05f, 0.05f);
+    t = 0;
+    observe(obs);
+  }
+
+  void observe(float* obs) const {
+    obs[0] = x; obs[1] = x_dot; obs[2] = theta; obs[3] = theta_dot;
+  }
+
+  void step(int action, Rng& rng, float* obs, float* reward,
+            uint8_t* terminated, uint8_t* truncated) override {
+    const float force = action == 1 ? kForceMag : -kForceMag;
+    const float cos_t = std::cos(theta), sin_t = std::sin(theta);
+    const float temp =
+        (force + kPoleMassLength * theta_dot * theta_dot * sin_t) / kTotalMass;
+    const float theta_acc =
+        (kGravity * sin_t - cos_t * temp) /
+        (kHalfPole * (4.0f / 3.0f - kMassPole * cos_t * cos_t / kTotalMass));
+    const float x_acc = temp - kPoleMassLength * theta_acc * cos_t / kTotalMass;
+    x += kTau * x_dot;
+    x_dot += kTau * x_acc;
+    theta += kTau * theta_dot;
+    theta_dot += kTau * theta_acc;
+    t += 1;
+
+    const bool term = std::fabs(x) > kXThresh || std::fabs(theta) > kThetaThresh;
+    const bool trunc = !term && t >= kMaxSteps;
+    *reward = 1.0f;
+    *terminated = term;
+    *truncated = trunc;
+    if (term || trunc) {
+      reset(rng, obs);
+    } else {
+      observe(obs);
+    }
+  }
+};
+
+// Pong, same rules/constants as asyncrl_tpu/envs/pong.py (vector obs).
+struct PongEnv final : EnvBase {
+  static constexpr float kAgentX = 0.95f, kOppX = 0.05f;
+  static constexpr float kPaddleHalf = 0.08f;
+  static constexpr float kAgentSpeed = 0.05f, kOppSpeed = 0.025f;
+  static constexpr float kBallVx = 0.03f, kMaxSpin = 0.04f, kServeVy = 0.02f;
+  static constexpr int kWinScore = 21, kMaxSteps = 3000;
+
+  float bx, by, bvx, bvy, agent_y, opp_y;
+  int score_a, score_o, t;
+
+  int obs_dim() const override { return 6; }
+  int num_actions() const override { return 6; }
+
+  void serve(Rng& rng, bool toward_agent) {
+    bx = 0.5f; by = 0.5f;
+    bvx = toward_agent ? kBallVx : -kBallVx;
+    bvy = rng.uniform(-kServeVy, kServeVy);
+  }
+
+  void reset(Rng& rng, float* obs) override {
+    serve(rng, (rng.next() & 1) != 0);
+    agent_y = 0.5f; opp_y = 0.5f;
+    score_a = 0; score_o = 0; t = 0;
+    observe(obs);
+  }
+
+  void observe(float* obs) const {
+    obs[0] = bx; obs[1] = by; obs[2] = bvx / kBallVx; obs[3] = bvy / kMaxSpin;
+    obs[4] = agent_y; obs[5] = opp_y;
+  }
+
+  void step(int action, Rng& rng, float* obs, float* reward,
+            uint8_t* terminated, uint8_t* truncated) override {
+    // ALE Pong action mapping: {2,4} up, {3,5} down.
+    const float dir = (action == 2 || action == 4)   ? 1.0f
+                      : (action == 3 || action == 5) ? -1.0f
+                                                     : 0.0f;
+    agent_y += kAgentSpeed * dir;
+    if (agent_y < kPaddleHalf) agent_y = kPaddleHalf;
+    if (agent_y > 1.0f - kPaddleHalf) agent_y = 1.0f - kPaddleHalf;
+
+    float track = by - opp_y;
+    if (track > kOppSpeed) track = kOppSpeed;
+    if (track < -kOppSpeed) track = -kOppSpeed;
+    opp_y += track;
+    if (opp_y < kPaddleHalf) opp_y = kPaddleHalf;
+    if (opp_y > 1.0f - kPaddleHalf) opp_y = 1.0f - kPaddleHalf;
+
+    float x = bx + bvx, y = by + bvy;
+    if (y < 0.0f) { y = -y; bvy = std::fabs(bvy); }
+    else if (y > 1.0f) { y = 2.0f - y; bvy = -std::fabs(bvy); }
+
+    const bool cross_agent = x >= kAgentX && bvx > 0;
+    const bool cross_opp = x <= kOppX && bvx < 0;
+    bool agent_scores = false, opp_scores = false;
+    if (cross_agent) {
+      if (std::fabs(y - agent_y) <= kPaddleHalf) {
+        x = 2.0f * kAgentX - x;
+        bvx = -kBallVx;
+        bvy = kMaxSpin * (y - agent_y) / kPaddleHalf;
+      } else {
+        opp_scores = true;
+      }
+    } else if (cross_opp) {
+      if (std::fabs(y - opp_y) <= kPaddleHalf) {
+        x = 2.0f * kOppX - x;
+        bvx = kBallVx;
+        bvy = kMaxSpin * (y - opp_y) / kPaddleHalf;
+      } else {
+        agent_scores = true;
+      }
+    }
+    *reward = agent_scores ? 1.0f : (opp_scores ? -1.0f : 0.0f);
+    score_a += agent_scores;
+    score_o += opp_scores;
+    bx = x; by = y;
+    if (agent_scores || opp_scores) {
+      // Loser receives (serve travels toward the conceding side).
+      serve(rng, opp_scores);
+    }
+    t += 1;
+
+    const bool term = score_a >= kWinScore || score_o >= kWinScore;
+    const bool trunc = !term && t >= kMaxSteps;
+    *terminated = term;
+    *truncated = trunc;
+    if (term || trunc) {
+      reset(rng, obs);
+    } else {
+      observe(obs);
+    }
+  }
+};
+
+// ----------------------------------------------------------------- pool
+struct EnvPool {
+  std::vector<EnvBase*> envs;
+  std::vector<Rng> rngs;
+  int num_envs = 0;
+  int obs_dim_ = 0;
+  int num_actions_ = 0;
+
+  // step-call shared pointers (set by step(), read by workers)
+  const int32_t* actions = nullptr;
+  float* obs_out = nullptr;
+  float* rew_out = nullptr;
+  uint8_t* term_out = nullptr;
+  uint8_t* trunc_out = nullptr;
+
+  // persistent worker pool
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  uint64_t generation = 0;
+  int pending = 0;
+  bool shutdown = false;
+  int num_threads = 0;
+
+  ~EnvPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      shutdown = true;
+      ++generation;
+    }
+    cv_work.notify_all();
+    for (auto& w : workers) w.join();
+    for (auto* e : envs) delete e;
+  }
+
+  void worker_loop(int tid) {
+    uint64_t seen = 0;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] { return generation != seen || shutdown; });
+        if (shutdown) return;
+        seen = generation;
+      }
+      step_slice(tid);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (--pending == 0) cv_done.notify_one();
+      }
+    }
+  }
+
+  void step_slice(int tid) {
+    const int per = (num_envs + num_threads - 1) / num_threads;
+    const int lo = tid * per;
+    const int hi = std::min(num_envs, lo + per);
+    for (int i = lo; i < hi; ++i) {
+      envs[i]->step(actions[i], rngs[i], obs_out + (size_t)i * obs_dim_,
+                    rew_out + i, term_out + i, trunc_out + i);
+    }
+  }
+
+  void step(const int32_t* acts, float* obs, float* rew, uint8_t* term,
+            uint8_t* trunc) {
+    actions = acts; obs_out = obs; rew_out = rew; term_out = term;
+    trunc_out = trunc;
+    if (num_threads <= 1) {
+      for (int i = 0; i < num_envs; ++i) {
+        envs[i]->step(acts[i], rngs[i], obs + (size_t)i * obs_dim_, rew + i,
+                      term + i, trunc + i);
+      }
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      pending = num_threads;
+      ++generation;
+    }
+    cv_work.notify_all();
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_done.wait(lk, [&] { return pending == 0; });
+    }
+  }
+};
+
+EnvBase* make_env(const std::string& id) {
+  if (id == "CartPole-v1") return new CartPoleEnv();
+  if (id == "Pong") return new PongEnv();
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+EnvPool* envpool_create(const char* env_id, int num_envs, int num_threads,
+                        uint64_t seed) {
+  auto* pool = new EnvPool();
+  pool->num_envs = num_envs;
+  pool->envs.reserve(num_envs);
+  pool->rngs.resize(num_envs);
+  for (int i = 0; i < num_envs; ++i) {
+    EnvBase* e = make_env(env_id);
+    if (!e) { delete pool; return nullptr; }
+    pool->envs.push_back(e);
+    pool->rngs[i].seed(seed * 0x9E3779B97F4A7C15ULL + (uint64_t)i);
+  }
+  pool->obs_dim_ = pool->envs[0]->obs_dim();
+  pool->num_actions_ = pool->envs[0]->num_actions();
+  pool->num_threads = num_threads;
+  if (num_threads > 1) {
+    pool->workers.reserve(num_threads);
+    for (int tid = 0; tid < num_threads; ++tid) {
+      pool->workers.emplace_back(&EnvPool::worker_loop, pool, tid);
+    }
+  }
+  return pool;
+}
+
+void envpool_reset(EnvPool* pool, float* obs_out) {
+  for (int i = 0; i < pool->num_envs; ++i) {
+    pool->envs[i]->reset(pool->rngs[i],
+                         obs_out + (size_t)i * pool->obs_dim_);
+  }
+}
+
+void envpool_step(EnvPool* pool, const int32_t* actions, float* obs_out,
+                  float* rew_out, uint8_t* term_out, uint8_t* trunc_out) {
+  pool->step(actions, obs_out, rew_out, term_out, trunc_out);
+}
+
+int envpool_obs_dim(EnvPool* pool) { return pool->obs_dim_; }
+int envpool_num_actions(EnvPool* pool) { return pool->num_actions_; }
+int envpool_num_envs(EnvPool* pool) { return pool->num_envs; }
+
+void envpool_destroy(EnvPool* pool) { delete pool; }
+
+}  // extern "C"

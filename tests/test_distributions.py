@@ -1,0 +1,71 @@
+"""Distribution unit tests: logp/entropy cross-checked against
+torch.distributions (torch-cpu is in the image for exactly this,
+SURVEY.md §7.0)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu.ops.distributions import Categorical, DiagGaussian, for_spec
+from asyncrl_tpu.envs.core import EnvSpec
+
+
+def test_for_spec_dispatch():
+    assert isinstance(for_spec(EnvSpec(obs_shape=(4,), num_actions=3)), Categorical)
+    d = for_spec(EnvSpec(obs_shape=(3,), continuous=True, action_dim=2))
+    assert isinstance(d, DiagGaussian) and d.action_dim == 2
+
+
+def test_categorical_matches_torch():
+    torch = pytest.importorskip("torch")
+    logits = np.random.default_rng(0).normal(size=(5, 7)).astype(np.float32)
+    actions = np.array([0, 3, 6, 2, 1])
+    d = Categorical(7)
+    got_logp = np.asarray(d.logp(jnp.asarray(logits), jnp.asarray(actions)))
+    got_ent = np.asarray(d.entropy(jnp.asarray(logits)))
+    td = torch.distributions.Categorical(logits=torch.tensor(logits))
+    np.testing.assert_allclose(
+        got_logp, td.log_prob(torch.tensor(actions)).numpy(), rtol=1e-5
+    )
+    np.testing.assert_allclose(got_ent, td.entropy().numpy(), rtol=1e-5)
+
+
+def test_gaussian_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    mean = rng.normal(size=(5, 3)).astype(np.float32)
+    log_std = rng.normal(scale=0.3, size=(5, 3)).astype(np.float32)
+    actions = rng.normal(size=(5, 3)).astype(np.float32)
+    params = jnp.concatenate([jnp.asarray(mean), jnp.asarray(log_std)], axis=-1)
+    d = DiagGaussian(3)
+    got_logp = np.asarray(d.logp(params, jnp.asarray(actions)))
+    got_ent = np.asarray(d.entropy(params))
+    td = torch.distributions.Normal(
+        torch.tensor(mean), torch.tensor(np.exp(log_std))
+    )
+    np.testing.assert_allclose(
+        got_logp, td.log_prob(torch.tensor(actions)).sum(-1).numpy(), rtol=1e-4
+    )
+    np.testing.assert_allclose(got_ent, td.entropy().sum(-1).numpy(), rtol=1e-5)
+
+
+def test_gaussian_sample_statistics():
+    d = DiagGaussian(2)
+    mean = jnp.array([1.0, -2.0])
+    log_std = jnp.array([0.0, jnp.log(0.5)])
+    params = jnp.concatenate([mean, log_std])
+    keys = jax.random.split(jax.random.PRNGKey(0), 20000)
+    samples = jax.vmap(lambda k: d.sample(k, params))(keys)
+    np.testing.assert_allclose(np.asarray(samples.mean(0)), mean, atol=0.02)
+    np.testing.assert_allclose(np.asarray(samples.std(0)), [1.0, 0.5], atol=0.02)
+    np.testing.assert_array_equal(np.asarray(d.mode(params)), np.asarray(mean))
+
+
+def test_categorical_sample_distribution():
+    d = Categorical(3)
+    logits = jnp.log(jnp.array([0.2, 0.5, 0.3]))
+    keys = jax.random.split(jax.random.PRNGKey(0), 30000)
+    samples = jax.vmap(lambda k: d.sample(k, logits))(keys)
+    freqs = np.bincount(np.asarray(samples), minlength=3) / 30000
+    np.testing.assert_allclose(freqs, [0.2, 0.5, 0.3], atol=0.02)
