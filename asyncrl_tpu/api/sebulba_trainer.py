@@ -1,0 +1,301 @@
+"""SebulbaTrainer: host actor threads + device learner, pipelined.
+
+``backend="sebulba"`` is the framework's answer to the reference's default
+architecture — per-thread actors feeding a learner through a queue
+(BASELINE.json:5; SURVEY.md §3.1) — for envs that cannot live in HBM (C++
+engines, gymnasium suites). Actors produce ``Rollout`` fragments on the host;
+the learner thread transfers them batch-sharded to the mesh and steps the
+``RolloutLearner``; weights publish back through a ``ParamStore`` every
+``actor_staleness`` updates. The bounded queue is the pipelining element:
+actors run ahead of the learner by up to ``queue_capacity`` fragments, and
+V-trace (algo="impala") corrects the resulting off-policyness exactly as in
+the reference (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from asyncrl_tpu.learn.rollout_learner import LearnerState, RolloutLearner
+from asyncrl_tpu.models.networks import build_model
+from asyncrl_tpu.ops import distributions
+from asyncrl_tpu.parallel.mesh import make_mesh
+from asyncrl_tpu.rollout.sebulba import (
+    ActorThread,
+    Fragment,
+    ParamStore,
+    make_host_pool,
+    make_inference_fn,
+)
+from asyncrl_tpu.utils.config import Config
+
+
+class SebulbaTrainer:
+    """Owns host actor threads, the param store, and the device learner."""
+
+    def __init__(self, config: Config, spec=None, model=None, mesh=None):
+        self.config = config
+        if config.num_envs % config.actor_threads:
+            raise ValueError(
+                f"num_envs={config.num_envs} not divisible by "
+                f"actor_threads={config.actor_threads}"
+            )
+        self._envs_per_actor = config.num_envs // config.actor_threads
+
+        # Spec comes from a probe pool (host envs are authoritative here).
+        probe = make_host_pool(config, 1, seed=config.seed)
+        self.spec = spec if spec is not None else _pool_spec(probe, config)
+        _close(probe)
+
+        self.model = (
+            model if model is not None else build_model(config, self.spec)
+        )
+        self.mesh = (
+            mesh
+            if mesh is not None
+            else make_mesh(config.mesh_shape, config.mesh_axes)
+        )
+
+        # Eager geometry validation, mirroring the Anakin Learner: fail at
+        # construction, not with a cryptic sharding error mid-train after
+        # actor threads have already started.
+        dp = self.mesh.shape["dp"]
+        if self._envs_per_actor % dp:
+            raise ValueError(
+                f"num_envs/actor_threads={self._envs_per_actor} not "
+                f"divisible by dp={dp}"
+            )
+        if config.algo == "ppo" and (
+            config.ppo_epochs > 1 or config.ppo_minibatches > 1
+        ):
+            local = (self._envs_per_actor // dp) * config.unroll_len
+            if local % config.ppo_minibatches:
+                raise ValueError(
+                    f"per-device fragment of {local} samples not divisible "
+                    f"by ppo_minibatches={config.ppo_minibatches}"
+                )
+        self.learner = RolloutLearner(config, self.spec, self.model, self.mesh)
+        self.state: LearnerState = self.learner.init_state(config.seed)
+        self.env_steps = 0
+
+        self._inference_fn = make_inference_fn(self.model.apply, self.spec)
+        self._store = ParamStore(self.state.params)
+        cap = config.queue_capacity or 2 * config.actor_threads
+        self._queue: "queue.Queue[Fragment]" = queue.Queue(maxsize=cap)
+        self._errors: "queue.Queue[tuple[int, BaseException]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._actors: list[ActorThread] = []
+        self._updates = 0
+        self._actor_restarts = 0
+        self._recent_restarts: list[float] = []
+        self._RESTART_WINDOW_S = 300.0
+        self._next_actor_seed = config.seed * 7919 + 1
+
+    # --------------------------------------------------------------- actors
+
+    def _spawn_actor(self, index: int) -> ActorThread:
+        seed = self._next_actor_seed
+        self._next_actor_seed += 104729
+        pool = make_host_pool(self.config, self._envs_per_actor, seed=seed)
+        actor = ActorThread(
+            index=index,
+            pool=pool,
+            inference_fn=self._inference_fn,
+            store=self._store,
+            out_queue=self._queue,
+            unroll_len=self.config.unroll_len,
+            seed=seed,
+            stop_event=self._stop,
+            errors=self._errors,
+        )
+        actor.start()
+        return actor
+
+    def _start_actors(self) -> None:
+        if self._actors:
+            return
+        self._stop.clear()
+        self._actors = [
+            self._spawn_actor(i) for i in range(self.config.actor_threads)
+        ]
+
+    def _supervise(self) -> None:
+        """Restart dead actors; re-raise only if failures repeat rapidly
+        (SURVEY.md §5.3 — dead actor restarted with fresh env). "Rapidly"
+        means within ``_RESTART_WINDOW_S``: sporadic transient failures over
+        a long run recover indefinitely; a crash loop aborts."""
+        try:
+            while True:
+                index, err = self._errors.get_nowait()
+                now = time.monotonic()
+                self._actor_restarts += 1
+                self._recent_restarts.append(now)
+                self._recent_restarts = [
+                    t for t in self._recent_restarts
+                    if now - t < self._RESTART_WINDOW_S
+                ]
+                if len(self._recent_restarts) > 3 * self.config.actor_threads:
+                    self.stop()
+                    raise RuntimeError(
+                        f"actor {index} failed repeatedly "
+                        f"({len(self._recent_restarts)} restarts in "
+                        f"{self._RESTART_WINDOW_S}s)"
+                    ) from err
+                self._actors[index] = self._spawn_actor(index)
+        except queue.Empty:
+            pass
+
+    def stop(self) -> None:
+        """Stop actor threads and drain the queue."""
+        self._stop.set()
+        # Unblock producers stuck on a full queue.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        for actor in self._actors:
+            actor.join(timeout=5.0)
+        self._actors = []
+
+    # ---------------------------------------------------------------- train
+
+    def train(
+        self,
+        total_env_steps: int | None = None,
+        callback: Callable[[dict[str, Any]], None] | None = None,
+    ) -> list[dict[str, Any]]:
+        """Drain fragments and update until ``total_env_steps`` consumed.
+
+        Metric dicts match ``Trainer.train``'s contract (env_steps, fps,
+        episode_return/length/count + loss terms).
+        """
+        cfg = self.config
+        target = total_env_steps or cfg.total_env_steps
+        steps_per_fragment = self._envs_per_actor * cfg.unroll_len
+        history: list[dict[str, Any]] = []
+
+        self._start_actors()
+        pending: list[dict[str, jax.Array]] = []
+        ret_sum = len_sum = count = lag_sum = 0.0
+        window_start = time.perf_counter()
+        window_steps = 0
+        try:
+            while self.env_steps < target:
+                self._supervise()
+                try:
+                    fragment = self._queue.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+                rollout = fragment.rollout
+                if cfg.reward_scale != 1.0:
+                    rollout = rollout.replace(
+                        rewards=rollout.rewards * cfg.reward_scale
+                    )
+                rollout = self.learner.put_rollout(rollout)
+                self.state, metrics = self.learner.update(self.state, rollout)
+                self.env_steps += steps_per_fragment
+                window_steps += steps_per_fragment
+                pending.append(metrics)
+                ret_sum += fragment.return_sum
+                len_sum += fragment.length_sum
+                count += fragment.count
+                # Actual policy lag of this fragment, in learner updates:
+                # fragment.version was published at update version*staleness.
+                lag_sum += self._updates - fragment.version * max(
+                    cfg.actor_staleness, 1
+                )
+
+                self._updates += 1
+                if self._updates % max(cfg.actor_staleness, 1) == 0:
+                    self._store.publish(self.state.params)
+
+                if len(pending) >= cfg.log_every or self.env_steps >= target:
+                    drained = jax.device_get(pending)
+                    pending = []
+                    elapsed = time.perf_counter() - window_start
+                    window_start = time.perf_counter()
+                    agg = {
+                        k: float(sum(m[k] for m in drained) / len(drained))
+                        for k in drained[0]
+                    }
+                    agg["episode_count"] = count
+                    agg["episode_return"] = ret_sum / max(count, 1.0)
+                    agg["episode_length"] = len_sum / max(count, 1.0)
+                    agg["param_lag"] = lag_sum / len(drained)
+                    agg["env_steps"] = self.env_steps
+                    agg["fps"] = window_steps / max(elapsed, 1e-9)
+                    ret_sum = len_sum = count = lag_sum = 0.0
+                    window_steps = 0
+                    history.append(agg)
+                    if callback:
+                        callback(agg)
+        finally:
+            self.stop()
+        return history
+
+    # ----------------------------------------------------------------- eval
+
+    def evaluate(
+        self, num_episodes: int = 32, max_steps: int = 3200, seed: int = 1234
+    ) -> float:
+        """Mean greedy-policy return over ``num_episodes`` fresh host envs.
+
+        Each env counts only its FIRST completed episode (pools auto-reset).
+        """
+        pool = make_host_pool(self.config, num_episodes, seed=seed)
+        dist = distributions.for_spec(self.spec)
+        apply_fn = self.model.apply
+
+        @jax.jit
+        def greedy(params, obs):
+            dist_params, _ = apply_fn(params, obs)
+            return dist.mode(dist_params)
+
+        params = self.state.params
+        try:
+            obs = pool.reset()
+            ep_return = np.zeros((num_episodes,), np.float64)
+            finished = np.zeros((num_episodes,), bool)
+            final_return = np.zeros((num_episodes,), np.float64)
+            for _ in range(max_steps):
+                actions = np.asarray(greedy(params, obs))
+                obs, rew, term, trunc = pool.step(actions)
+                ep_return += np.where(finished, 0.0, rew)
+                done = np.logical_or(term, trunc) & ~finished
+                final_return = np.where(done, ep_return, final_return)
+                finished |= done
+                if finished.all():
+                    break
+            final_return = np.where(finished, final_return, ep_return)
+            return float(final_return.mean())
+        finally:
+            _close(pool)
+
+
+def _pool_spec(pool, config: Config):
+    """EnvSpec from a host pool: adapters carry one; the native pool exposes
+    obs_dim/num_actions; fall back to the registry env's spec."""
+    spec = getattr(pool, "spec", None)
+    if spec is not None:
+        return spec
+    from asyncrl_tpu.envs.core import EnvSpec
+
+    return EnvSpec(
+        obs_shape=(pool.obs_dim,), num_actions=pool.num_actions
+    )
+
+
+def _close(pool) -> None:
+    close = getattr(pool, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:
+            pass

@@ -1,0 +1,87 @@
+"""Gymnasium host adapter: third-party Gym-style envs behind the host-pool
+interface the Sebulba actors consume (SURVEY.md §7.1 Envs, §1.2 L1).
+
+The reference steps Gym envs directly from its actor threads (SURVEY.md
+§3.3); here a ``GymnasiumHostPool`` wraps a ``gymnasium`` vector env and
+presents the same batched ``reset()/step(actions)`` contract as the C++
+``NativeEnvPool`` — so ALE / Procgen / any pip-installable Gym suite drops
+into the Sebulba path with zero framework changes once its package exists in
+the image (SURVEY.md §7.4 R1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from asyncrl_tpu.envs.core import EnvSpec
+
+try:
+    import gymnasium
+
+    _HAVE_GYM = True
+except ImportError:  # pragma: no cover - gymnasium is in the image
+    _HAVE_GYM = False
+
+
+def available(env_id: str) -> bool:
+    """True if ``env_id`` resolves in the gymnasium registry."""
+    if not _HAVE_GYM:
+        return False
+    return env_id in gymnasium.registry
+
+
+class GymnasiumHostPool:
+    """A batch of gymnasium envs behind the host-pool interface.
+
+    Uses ``SyncVectorEnv`` (per-pool, threads give cross-pool parallelism —
+    each Sebulba actor thread owns one pool, mirroring the reference's
+    env-per-thread layout at batch granularity). Auto-reset follows the
+    functional-env contract: ``step`` returns post-reset observations with
+    separate terminated/truncated flags (envs/core.py).
+    """
+
+    def __init__(self, env_id: str, num_envs: int, seed: int = 0):
+        if not _HAVE_GYM:
+            raise ImportError("gymnasium is not installed")
+        self.num_envs = num_envs
+        self._env = gymnasium.vector.SyncVectorEnv(
+            [lambda: gymnasium.make(env_id) for _ in range(num_envs)],
+            autoreset_mode=gymnasium.vector.AutoresetMode.SAME_STEP,
+        )
+        self._seed = seed
+
+        obs_space = self._env.single_observation_space
+        act_space = self._env.single_action_space
+        if isinstance(act_space, gymnasium.spaces.Discrete):
+            self.spec = EnvSpec(
+                obs_shape=tuple(obs_space.shape),
+                num_actions=int(act_space.n),
+            )
+        else:
+            self.spec = EnvSpec(
+                obs_shape=tuple(obs_space.shape),
+                continuous=True,
+                action_dim=int(np.prod(act_space.shape)),
+            )
+            self._act_low = np.asarray(act_space.low, np.float32)
+            self._act_high = np.asarray(act_space.high, np.float32)
+        self.num_actions = self.spec.num_actions
+        self.obs_dim = int(np.prod(obs_space.shape))
+
+    def reset(self) -> np.ndarray:
+        obs, _ = self._env.reset(seed=self._seed)
+        return np.asarray(obs, np.float32)
+
+    def step(self, actions: np.ndarray):
+        if self.spec.continuous:
+            actions = np.clip(actions, self._act_low, self._act_high)
+        obs, rew, term, trunc, _info = self._env.step(actions)
+        return (
+            np.asarray(obs, np.float32),
+            np.asarray(rew, np.float32),
+            np.asarray(term, bool),
+            np.asarray(trunc, bool),
+        )
+
+    def close(self) -> None:
+        self._env.close()

@@ -153,8 +153,24 @@ class NativeEnvPool:
         Sebulba hot path — results land directly in the fragment staging
         buffer."""
         actions = np.ascontiguousarray(actions, np.int32)
-        assert actions.shape == (self.num_envs,)
-        assert obs_out.flags.c_contiguous and obs_out.dtype == np.float32
+        B = self.num_envs
+        if actions.shape != (B,):
+            raise ValueError(f"actions shape {actions.shape} != ({B},)")
+        # The C side writes raw bytes through these pointers: every output
+        # buffer must match the ABI's dtype/contiguity exactly or writes
+        # corrupt the heap silently (no asserts: they vanish under -O).
+        for name, arr, dtype, shape in (
+            ("obs_out", obs_out, np.float32, (B, self.obs_dim)),
+            ("rew_out", rew_out, np.float32, (B,)),
+            ("term_out", term_out, np.uint8, (B,)),
+            ("trunc_out", trunc_out, np.uint8, (B,)),
+        ):
+            if arr.dtype != dtype or arr.shape != shape or not arr.flags.c_contiguous:
+                raise ValueError(
+                    f"{name} must be C-contiguous {np.dtype(dtype).name}"
+                    f"{shape}; got {arr.dtype}{arr.shape} "
+                    f"contiguous={arr.flags.c_contiguous}"
+                )
         self._lib.envpool_step(
             self._handle,
             actions.ctypes.data,

@@ -1,0 +1,172 @@
+"""Learner that consumes host-produced rollout fragments (Sebulba path).
+
+The Anakin ``Learner`` (learn/learner.py) fuses rollout + update into one XLA
+program because its envs live in HBM. The Sebulba and ``cpu_async`` backends
+instead produce ``Rollout`` fragments on the host (C++ env pools / gymnasium /
+Python actor threads — SURVEY.md §7.2 M3-M4), so this learner exposes the
+other half only: ``update(state, rollout)`` — one jitted ``shard_map`` over
+the mesh that recomputes learner logits/values, applies the configured
+algorithm loss (A3C / IMPALA V-trace / PPO), all-reduces gradients over the
+``dp`` axis, and steps Adam. The rollout arrives batch-sharded (``[T, B]``
+with B split over dp), mirroring how the reference's learner consumed
+queue-batched fragments (BASELINE.json:5; SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from asyncrl_tpu.envs.core import EnvSpec
+from asyncrl_tpu.learn.learner import (
+    _algo_loss,
+    _ppo_multipass,
+    make_optimizer,
+)
+from asyncrl_tpu.ops import distributions
+from asyncrl_tpu.parallel.mesh import DP_AXIS
+from asyncrl_tpu.rollout.buffer import Rollout
+from asyncrl_tpu.utils.config import Config
+
+
+@struct.dataclass
+class LearnerState:
+    """Learner-side train state for host-rollout backends.
+
+    Unlike the Anakin ``TrainState`` there is no ``actor`` (env states live
+    on the host) and no ``actor_params`` (weight publishing to host actors
+    goes through ``rollout.sebulba.ParamStore``).
+    """
+
+    params: Any
+    opt_state: Any
+    update_step: jax.Array  # int32 scalar
+
+
+def learner_state_spec() -> LearnerState:
+    return LearnerState(params=P(), opt_state=P(), update_step=P())
+
+
+def rollout_partition_spec() -> Rollout:
+    """Time-major [T, B, ...] fragments, batch dim sharded over dp."""
+    return Rollout(
+        obs=P(None, DP_AXIS),
+        actions=P(None, DP_AXIS),
+        behaviour_logp=P(None, DP_AXIS),
+        rewards=P(None, DP_AXIS),
+        terminated=P(None, DP_AXIS),
+        truncated=P(None, DP_AXIS),
+        bootstrap_obs=P(DP_AXIS),
+    )
+
+
+def rollout_sharding(mesh: Mesh) -> Rollout:
+    """NamedShardings for ``jax.device_put`` of a host fragment."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        rollout_partition_spec(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+class RolloutLearner:
+    """Compiled ``update(state, rollout)`` step + state lifecycle.
+
+    Same loss/optimizer machinery as the Anakin learner (single source of
+    truth in learn/learner.py), minus the on-device unroll.
+    """
+
+    def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
+        self.config = config
+        self.spec = spec
+        self.model = model
+        self.mesh = mesh
+        self.optimizer = make_optimizer(config)
+        dist = distributions.for_spec(spec)
+
+        ppo_multipass = config.algo == "ppo" and (
+            config.ppo_epochs > 1 or config.ppo_minibatches > 1
+        )
+        apply_fn = model.apply
+        optimizer = self.optimizer
+
+        def update_body(state: LearnerState, rollout: Rollout):
+            if ppo_multipass:
+                params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
+                    config, apply_fn, optimizer, dist,
+                    state.params, state.opt_state, rollout, state.update_step,
+                )
+            else:
+                # Same implicit-psum gradient scaling as the Anakin step:
+                # replicated-param grads are psum'd across dp during
+                # transposition, so local loss is scaled by 1/axis_size.
+                def scaled_loss(p):
+                    loss, metrics = _algo_loss(
+                        config, apply_fn, p, rollout,
+                        axis_name=DP_AXIS, dist=dist,
+                    )
+                    return loss / jax.lax.axis_size(DP_AXIS), (loss, metrics)
+
+                (_, (loss, metrics)), grads = jax.value_and_grad(
+                    scaled_loss, has_aux=True
+                )(state.params)
+                grad_norm = optax.global_norm(grads)
+                updates, opt_state = optimizer.update(
+                    grads, state.opt_state, state.params
+                )
+                params = optax.apply_updates(state.params, updates)
+
+            metrics = dict(jax.lax.pmean(metrics, DP_AXIS))
+            metrics["loss"] = jax.lax.pmean(loss, DP_AXIS)
+            metrics["grad_norm"] = grad_norm
+            new_state = LearnerState(
+                params=params,
+                opt_state=opt_state,
+                update_step=state.update_step + 1,
+            )
+            return new_state, metrics
+
+        sspec = learner_state_spec()
+        # NEVER donate here, regardless of config.donate_buffers: the params
+        # in this state are published to concurrently-running actor threads
+        # via ParamStore; donation would delete buffers mid-inference
+        # ("Array has been deleted" in every actor). The Anakin learner can
+        # donate because its params never escape the update loop.
+        self._step = jax.jit(
+            jax.shard_map(
+                update_body,
+                mesh=mesh,
+                in_specs=(sspec, rollout_partition_spec()),
+                out_specs=(sspec, P()),
+            ),
+        )
+        self._rollout_sharding = rollout_sharding(mesh)
+
+    # ---------------------------------------------------------------- state
+
+    def init_state(self, seed: int) -> LearnerState:
+        key = jax.random.PRNGKey(seed)
+        dummy_obs = jnp.zeros((1, *self.spec.obs_shape), self.spec.obs_dtype)
+        params = self.model.init(key, dummy_obs)
+        opt_state = self.optimizer.init(params)
+        rep = NamedSharding(self.mesh, P())
+        return LearnerState(
+            params=jax.device_put(params, rep),
+            opt_state=jax.device_put(opt_state, rep),
+            update_step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+        )
+
+    # --------------------------------------------------------------- update
+
+    def put_rollout(self, rollout: Rollout) -> Rollout:
+        """Transfer a host (numpy) fragment to the mesh, batch-sharded."""
+        return jax.device_put(rollout, self._rollout_sharding)
+
+    def update(self, state: LearnerState, rollout: Rollout):
+        """One gradient step on a device-resident fragment."""
+        return self._step(state, rollout)

@@ -1,0 +1,292 @@
+"""Sebulba host-actor runtime: Python actor threads pipelined against the
+device learner (SURVEY.md §7.2 M3, §5.8b).
+
+This is the TPU-native analogue of the reference's thread-per-actor +
+actor→learner queue design (BASELINE.json:5; SURVEY.md §3.1): each
+``ActorThread`` owns a slice of the env batch as a *host* env pool (the C++
+``NativeEnvPool``, a gymnasium adapter, or a CPU-jitted functional env),
+steps it with batched device inference, assembles time-major ``Rollout``
+fragments in reusable numpy buffers, and puts them on a bounded queue. The
+learner thread drains the queue, ``device_put``s fragments batch-sharded onto
+the mesh, and steps the ``RolloutLearner``. Weight "publishing" back to
+actors is a ``ParamStore`` swap of device arrays — no tensor ever leaves HBM
+for the publish path; actors read the store at fragment boundaries
+(staleness = learner updates between publishes, the queue bound gives the
+pipelining the reference got from true asynchrony — SURVEY.md §7.3).
+
+Failure handling (SURVEY.md §5.3): actor threads never raise into nowhere —
+exceptions land in an error sink the trainer polls; dead actors are restarted
+with a fresh env pool.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from asyncrl_tpu.envs.core import Environment, EnvSpec
+from asyncrl_tpu.ops import distributions
+from asyncrl_tpu.rollout.buffer import Rollout
+
+
+class ParamStore:
+    """Latest published learner params (device arrays) + version counter.
+
+    The reference's back-channel from learner to actors was shared memory /
+    the actors re-reading updated weights (SURVEY.md §3.1); here it is a
+    lock-guarded reference swap — actors fetch at fragment start, so a
+    fragment is always generated under one consistent ``behaviour`` policy.
+    """
+
+    def __init__(self, params: Any):
+        self._lock = threading.Lock()
+        self._params = params
+        self._version = 0
+
+    def publish(self, params: Any) -> None:
+        with self._lock:
+            self._params = params
+            self._version += 1
+
+    def get(self) -> tuple[Any, int]:
+        with self._lock:
+            return self._params, self._version
+
+
+class Fragment:
+    """One host-side rollout fragment + the episode stats gathered while
+    producing it. Arrays are owned copies, safe to retain."""
+
+    __slots__ = ("rollout", "return_sum", "length_sum", "count", "version")
+
+    def __init__(self, rollout: Rollout, return_sum: float, length_sum: float,
+                 count: float, version: int):
+        self.rollout = rollout
+        self.return_sum = return_sum
+        self.length_sum = length_sum
+        self.count = count
+        self.version = version
+
+
+class JaxHostPool:
+    """Host env pool wrapping a functional JAX env, stepped on the CPU
+    backend. Lets every registry env drive the Sebulba path even without a
+    native/gymnasium implementation (useful for tests and for pixel envs)."""
+
+    def __init__(self, env: Environment, num_envs: int, seed: int = 0):
+        self.num_envs = num_envs
+        self.spec = env.spec
+        self._cpu = jax.devices("cpu")[0]
+        with jax.default_device(self._cpu):
+            self._init = jax.jit(lambda keys: _pool_init(env, keys))
+            self._step = jax.jit(
+                lambda state, actions, key: _pool_step(env, state, actions, key)
+            )
+            self._key = jax.random.PRNGKey(seed)
+        self._state = None
+
+    def reset(self) -> np.ndarray:
+        with jax.default_device(self._cpu):
+            self._key, sub = jax.random.split(self._key)
+            keys = jax.random.split(sub, self.num_envs)
+            self._state, obs = self._init(keys)
+        return np.asarray(obs)
+
+    def step(self, actions: np.ndarray):
+        with jax.default_device(self._cpu):
+            self._key, sub = jax.random.split(self._key)
+            self._state, ts = self._step(self._state, jnp.asarray(actions), sub)
+        return (
+            np.asarray(ts.obs),
+            np.asarray(ts.reward),
+            np.asarray(ts.terminated),
+            np.asarray(ts.truncated),
+        )
+
+    def close(self) -> None:
+        self._state = None
+
+
+def _pool_init(env: Environment, keys):
+    state = jax.vmap(env.init)(keys)
+    return state, jax.vmap(env.observe)(state)
+
+
+def _pool_step(env: Environment, state, actions, key):
+    keys = jax.random.split(key, actions.shape[0])
+    return jax.vmap(env.step)(state, actions, keys)
+
+
+def make_host_pool(config, num_envs: int, seed: int):
+    """Pick the fastest available host pool for ``config.env_id``.
+
+    Preference order for ``host_pool="auto"``: native C++ pool (GIL-releasing
+    batched stepping) → gymnasium vector adapter → CPU-jitted JAX env.
+    """
+    kind = config.host_pool
+    env_id = config.env_id
+
+    if kind in ("auto", "native"):
+        from asyncrl_tpu.envs import native_pool
+
+        if env_id in native_pool.NATIVE_ENV_IDS:
+            try:
+                return native_pool.NativeEnvPool(env_id, num_envs, seed=seed)
+            except Exception:
+                if kind == "native":
+                    raise
+        elif kind == "native":
+            raise KeyError(
+                f"no native pool for {env_id!r}; have "
+                f"{sorted(native_pool.NATIVE_ENV_IDS)}"
+            )
+
+    if kind in ("auto", "gym"):
+        from asyncrl_tpu.envs import gym_adapter
+
+        if gym_adapter.available(env_id):
+            return gym_adapter.GymnasiumHostPool(env_id, num_envs, seed=seed)
+        if kind == "gym":
+            raise KeyError(f"no gymnasium env for {env_id!r}")
+
+    if kind in ("auto", "jax"):
+        from asyncrl_tpu.envs import registry
+
+        return JaxHostPool(registry.make(env_id), num_envs, seed=seed)
+
+    raise ValueError(
+        f"unknown host_pool {kind!r}; expected auto|native|gym|jax"
+    )
+
+
+def make_inference_fn(apply_fn: Callable, spec: EnvSpec) -> Callable:
+    """Jitted batched action selection: (params, obs[B], key) ->
+    (actions, behaviour_logp, new_key). The key stays on device across calls;
+    actions/logp sync to host (actions are needed by the env anyway)."""
+    dist = distributions.for_spec(spec)
+
+    @jax.jit
+    def infer(params, obs, key):
+        key, sub = jax.random.split(key)
+        dist_params, _ = apply_fn(params, obs)
+        act_keys = jax.random.split(sub, obs.shape[0])
+        actions = jax.vmap(dist.sample)(act_keys, dist_params)
+        logp = dist.logp(dist_params, actions)
+        return actions, logp, key
+
+    return infer
+
+
+class ActorThread(threading.Thread):
+    """One host actor: a pool slice + the fragment production loop.
+
+    The reference's ``ActorWorker.run`` (BASELINE.json:5) stepped ONE env per
+    thread; here each thread steps a *batch* through a pool (the C++ engine
+    releases the GIL during stepping, so threads overlap env physics with
+    device inference — SURVEY.md §7.3 "host↔device throughput").
+    """
+
+    def __init__(
+        self,
+        index: int,
+        pool,
+        inference_fn: Callable,
+        store: ParamStore,
+        out_queue: "queue.Queue[Fragment]",
+        unroll_len: int,
+        seed: int,
+        stop_event: threading.Event,
+        errors: "queue.Queue[tuple[int, BaseException]]",
+    ):
+        super().__init__(name=f"actor-{index}", daemon=True)
+        self.index = index
+        self.pool = pool
+        self.inference_fn = inference_fn
+        self.store = store
+        self.out_queue = out_queue
+        self.unroll_len = unroll_len
+        self.seed = seed
+        self.stop_event = stop_event
+        self.errors = errors
+
+    def run(self) -> None:  # noqa: D102 — thread entry
+        try:
+            self._run()
+        except BaseException as e:  # report, don't die silently (§5.3)
+            self.errors.put((self.index, e))
+        finally:
+            close = getattr(self.pool, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+
+    def _run(self) -> None:
+        pool = self.pool
+        T, B = self.unroll_len, pool.num_envs
+        obs = pool.reset()
+        key = jax.random.PRNGKey(self.seed)
+
+        obs_buf = np.empty((T, B) + obs.shape[1:], obs.dtype)
+        logp_buf = np.empty((T, B), np.float32)
+        rew_buf = np.empty((T, B), np.float32)
+        term_buf = np.empty((T, B), bool)
+        trunc_buf = np.empty((T, B), bool)
+        act_buf: np.ndarray | None = None  # dtype/shape known after 1st step
+
+        running_return = np.zeros((B,), np.float64)
+        running_length = np.zeros((B,), np.float64)
+
+        while not self.stop_event.is_set():
+            params, version = self.store.get()
+            ret_sum = 0.0
+            len_sum = 0.0
+            count = 0.0
+            for t in range(T):
+                actions_d, logp_d, key = self.inference_fn(params, obs, key)
+                actions = np.asarray(actions_d)
+                if act_buf is None:
+                    act_buf = np.empty((T, B) + actions.shape[1:], actions.dtype)
+                obs_buf[t] = obs
+                act_buf[t] = actions
+                logp_buf[t] = np.asarray(logp_d)
+                obs, rew, term, trunc = pool.step(actions)
+                rew_buf[t] = rew
+                term_buf[t] = term
+                trunc_buf[t] = trunc
+
+                running_return += rew
+                running_length += 1.0
+                done = np.logical_or(term, trunc)
+                if done.any():
+                    ret_sum += float(running_return[done].sum())
+                    len_sum += float(running_length[done].sum())
+                    count += float(done.sum())
+                    running_return[done] = 0.0
+                    running_length[done] = 0.0
+
+            fragment = Fragment(
+                Rollout(
+                    obs=obs_buf.copy(),
+                    actions=act_buf.copy(),
+                    behaviour_logp=logp_buf.copy(),
+                    rewards=rew_buf.copy(),
+                    terminated=term_buf.copy(),
+                    truncated=trunc_buf.copy(),
+                    bootstrap_obs=obs.copy(),
+                ),
+                ret_sum, len_sum, count, version,
+            )
+            # Bounded put that stays responsive to shutdown.
+            while not self.stop_event.is_set():
+                try:
+                    self.out_queue.put(fragment, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue

@@ -69,6 +69,11 @@ class Config:
     mesh_shape: tuple[int, ...] = (-1,)  # -1: all local devices on axis "dp"
     mesh_axes: tuple[str, ...] = ("dp",)
 
+    # --- sebulba / cpu_async host backends ---
+    actor_threads: int = 2  # host actor threads; each owns num_envs/threads
+    queue_capacity: int = 0  # actor→learner queue bound; 0 = 2*actor_threads
+    host_pool: str = "auto"  # "auto" | "native" | "gym" | "jax"
+
     # --- runtime ---
     seed: int = 0
     log_every: int = 20  # learner updates between metric drains
