@@ -10,29 +10,29 @@ from __future__ import annotations
 from asyncrl_tpu.utils.config import Config
 
 
-def make_agent(config: Config | None = None, **overrides):
+def make_agent(
+    config: Config | None = None, restore: str | None = None, **overrides
+):
     """Build a Trainer for ``config``.
 
     Any Config field can be passed as a keyword override, e.g.::
 
         agent = make_agent(env_id="CartPole-v1", algo="impala", backend="tpu")
         agent.train()
+
+    ``restore=path`` loads initial state from an existing checkpoint
+    directory (read-only; ongoing saves go to ``config.checkpoint_dir``).
     """
     config = (config or Config()).replace(**overrides)
 
     if config.backend == "tpu":
         from asyncrl_tpu.api.trainer import Trainer
 
-        return Trainer(config)
+        return Trainer(config, restore=restore)
     if config.backend == "sebulba":
-        try:
-            from asyncrl_tpu.api.sebulba_trainer import SebulbaTrainer
-        except ImportError as e:
-            raise NotImplementedError(
-                "backend='sebulba' is not built yet (planned: host env pools "
-                "+ on-device double buffer)"
-            ) from e
-        return SebulbaTrainer(config)
+        from asyncrl_tpu.api.sebulba_trainer import SebulbaTrainer
+
+        return SebulbaTrainer(config, restore=restore)
     if config.backend == "cpu_async":
         try:
             from asyncrl_tpu.api.cpu_async import CpuAsyncTrainer
@@ -41,7 +41,7 @@ def make_agent(config: Config | None = None, **overrides):
                 "backend='cpu_async' is not built yet (planned: thread-based "
                 "parity path mirroring the reference's A3C mode)"
             ) from e
-        return CpuAsyncTrainer(config)
+        return CpuAsyncTrainer(config, restore=restore)
     raise ValueError(
         f"unknown backend {config.backend!r}; expected tpu|sebulba|cpu_async"
     )

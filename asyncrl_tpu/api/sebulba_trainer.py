@@ -39,7 +39,9 @@ from asyncrl_tpu.utils.config import Config
 class SebulbaTrainer:
     """Owns host actor threads, the param store, and the device learner."""
 
-    def __init__(self, config: Config, spec=None, model=None, mesh=None):
+    def __init__(
+        self, config: Config, spec=None, model=None, mesh=None, restore=None
+    ):
         self.config = config
         if config.num_envs % config.actor_threads:
             raise ValueError(
@@ -83,6 +85,16 @@ class SebulbaTrainer:
         self.learner = RolloutLearner(config, self.spec, self.model, self.mesh)
         self.state: LearnerState = self.learner.init_state(config.seed)
         self.env_steps = 0
+
+        # Checkpoint/resume (SURVEY.md §5.4): learner-side state only — host
+        # env states are transient by design (actors restart from fresh envs
+        # on resume, exactly as after a §5.3 actor restart).
+        from asyncrl_tpu.utils import checkpoint
+
+        self._ckpt, self.state, self.env_steps = checkpoint.setup(
+            config, restore, self.state
+        )
+        self.checkpointer = self._ckpt.checkpointer
 
         self._inference_fn = make_inference_fn(self.model.apply, self.spec)
         self._store = ParamStore(self.state.params)
@@ -215,6 +227,7 @@ class SebulbaTrainer:
                 self._updates += 1
                 if self._updates % max(cfg.actor_staleness, 1) == 0:
                     self._store.publish(self.state.params)
+                self._ckpt.after_update(self.state, self.env_steps)
 
                 if len(pending) >= cfg.log_every or self.env_steps >= target:
                     drained = jax.device_get(pending)
@@ -238,7 +251,19 @@ class SebulbaTrainer:
                         callback(agg)
         finally:
             self.stop()
+            # A crash (including the §5.3 actor crash-loop abort) must not
+            # lose progress: save final state and flush async writes.
+            self._ckpt.finalize(self.state, self.env_steps)
         return history
+
+    def save_checkpoint(self) -> None:
+        """Save the current LearnerState now (async; see ``Checkpointer``)."""
+        self._ckpt.save_now(self.state, self.env_steps)
+
+    def close(self) -> None:
+        """Stop actors, flush pending checkpoint saves, release resources."""
+        self.stop()
+        self._ckpt.close()
 
     # ----------------------------------------------------------------- eval
 

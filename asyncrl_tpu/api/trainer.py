@@ -23,9 +23,20 @@ from asyncrl_tpu.utils.config import Config
 
 
 class Trainer:
-    """Owns env, model, mesh, learner, and the training loop."""
+    """Owns env, model, mesh, learner, and the training loop.
 
-    def __init__(self, config: Config, env=None, model=None, mesh=None):
+    Checkpointing (SURVEY.md §5.4): with ``config.checkpoint_dir`` set, the
+    full TrainState + env-steps counter is saved there every
+    ``config.checkpoint_every`` updates (orbax, async), plus once when
+    ``train()`` exits — by any path. On construction, an explicit
+    ``restore=path`` loads initial state from that path read-only; otherwise
+    an existing checkpoint under ``config.checkpoint_dir`` auto-resumes
+    bit-exact.
+    """
+
+    def __init__(
+        self, config: Config, env=None, model=None, mesh=None, restore=None
+    ):
         self.config = config
         self.env = env if env is not None else registry.make(config.env_id)
         self.model = (
@@ -40,6 +51,21 @@ class Trainer:
         self.state: TrainState = self.learner.init_state(config.seed)
         self.env_steps = 0
         self._eval_fns: dict[tuple[int, int], Callable] = {}
+
+        from asyncrl_tpu.utils import checkpoint
+
+        self._ckpt, self.state, self.env_steps = checkpoint.setup(
+            config, restore, self.state
+        )
+        self.checkpointer = self._ckpt.checkpointer
+
+    def save_checkpoint(self) -> None:
+        """Save the current TrainState now (async; see ``Checkpointer``)."""
+        self._ckpt.save_now(self.state, self.env_steps)
+
+    def close(self) -> None:
+        """Flush pending async checkpoint saves and release resources."""
+        self._ckpt.close()
 
     # ------------------------------------------------------------------ train
 
@@ -63,39 +89,45 @@ class Trainer:
         window_start = time.perf_counter()
         window_steps = 0
 
-        while self.env_steps < target:
-            self.state, metrics = self.learner.update(self.state)
-            self.env_steps += steps_per_update
-            window_steps += steps_per_update
-            pending.append(metrics)
+        try:
+            while self.env_steps < target:
+                self.state, metrics = self.learner.update(self.state)
+                self.env_steps += steps_per_update
+                window_steps += steps_per_update
+                pending.append(metrics)
+                self._ckpt.after_update(self.state, self.env_steps)
 
-            if len(pending) >= cfg.log_every or self.env_steps >= target:
-                drained = jax.device_get(pending)
-                pending = []
-                elapsed = time.perf_counter() - window_start
-                window_start = time.perf_counter()
+                if len(pending) >= cfg.log_every or self.env_steps >= target:
+                    drained = jax.device_get(pending)
+                    pending = []
+                    elapsed = time.perf_counter() - window_start
+                    window_start = time.perf_counter()
 
-                agg = {
-                    k: float(sum(m[k] for m in drained) / len(drained))
-                    for k in drained[0]
-                    if not k.startswith("episode_")
-                }
-                ep_count = sum(m["episode_count"] for m in drained)
-                agg["episode_count"] = float(ep_count)
-                agg["episode_return"] = float(
-                    sum(m["episode_return_sum"] for m in drained)
-                    / max(ep_count, 1.0)
-                )
-                agg["episode_length"] = float(
-                    sum(m["episode_length_sum"] for m in drained)
-                    / max(ep_count, 1.0)
-                )
-                agg["env_steps"] = self.env_steps
-                agg["fps"] = window_steps / max(elapsed, 1e-9)
-                window_steps = 0
-                history.append(agg)
-                if callback:
-                    callback(agg)
+                    agg = {
+                        k: float(sum(m[k] for m in drained) / len(drained))
+                        for k in drained[0]
+                        if not k.startswith("episode_")
+                    }
+                    ep_count = sum(m["episode_count"] for m in drained)
+                    agg["episode_count"] = float(ep_count)
+                    agg["episode_return"] = float(
+                        sum(m["episode_return_sum"] for m in drained)
+                        / max(ep_count, 1.0)
+                    )
+                    agg["episode_length"] = float(
+                        sum(m["episode_length_sum"] for m in drained)
+                        / max(ep_count, 1.0)
+                    )
+                    agg["env_steps"] = self.env_steps
+                    agg["fps"] = window_steps / max(elapsed, 1e-9)
+                    window_steps = 0
+                    history.append(agg)
+                    if callback:
+                        callback(agg)
+        finally:
+            # A crash must not lose progress: save whatever state we have
+            # (even with periodic saves disabled) and flush async writes.
+            self._ckpt.finalize(self.state, self.env_steps)
         return history
 
     # ----------------------------------------------------------------- eval

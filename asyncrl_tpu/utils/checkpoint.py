@@ -1,0 +1,266 @@
+"""Checkpoint / resume (SURVEY.md §5.4) on ``orbax-checkpoint``.
+
+The reference family at most pickles weights (SURVEY.md §5.4a — mechanism
+unknown, reference unreadable); here the FULL ``TrainState`` — learner
+params, stale actor params, optimizer state, sharded actor/env state with
+its per-env PRNG keys, and the update counter — plus the host-side
+``env_steps`` counter is checkpointed, so a restore resumes *bit-exact*:
+the next ``Learner.update`` after restore produces the same state as if the
+run had never stopped (asserted in tests/test_checkpoint.py).
+
+Restoration is sharding-aware: the target pytree is described by
+``jax.ShapeDtypeStruct``s carrying the live state's ``NamedSharding``s, so
+restored arrays land directly on the mesh (replicated params, dp-sharded
+actor state) without a host-side gather/scatter round-trip.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+STATE_KEY = "state"
+META_KEY = "meta"
+
+
+def _abstract_like(tree: Any) -> Any:
+    """ShapeDtypeStructs carrying each leaf's sharding (restore template)."""
+
+    def one(x):
+        sharding = getattr(x, "sharding", None)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    return jax.tree.map(one, tree)
+
+
+class Checkpointer:
+    """Thin lifecycle wrapper over ``ocp.CheckpointManager``.
+
+    Saves are keyed by learner ``update_step``; ``max_to_keep`` old steps are
+    retained. ``meta`` carries host-side scalars (env_steps) that live
+    outside the device pytree.
+    """
+
+    def __init__(
+        self, directory: str, max_to_keep: int = 3, create: bool = True
+    ):
+        self.directory = os.path.abspath(directory)
+        if not create and not os.path.isdir(self.directory):
+            raise FileNotFoundError(
+                f"no checkpoint directory at {self.directory}"
+            )
+        self._last_saved: int | None = None
+        self._restored_step: int | None = None
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                create=create,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    # ------------------------------------------------------------------ save
+
+    def save(self, step: int, state: Any, env_steps: int = 0) -> None:
+        """Async-save ``state`` + metadata under ``step``.
+
+        Idempotent within a run: re-saving the step this Checkpointer just
+        wrote (e.g. the end-of-train save landing on the step the periodic
+        cadence already covered), or the step it just restored from this
+        directory (the no-op-train finalize path — data is bit-identical by
+        the resume contract, and deleting-to-rewrite would open a window
+        with no durable checkpoint), is a no-op. A same-numbered step left
+        on disk by an EARLIER run (possible after ``restore=`` from
+        elsewhere into a dir with history) is stale — it is replaced
+        synchronously, never silently kept, so auto-resume can't load
+        another run's state. ``_last_saved`` is only recorded on success: a
+        failed periodic save is retried by the crash-path ``finalize``, not
+        silently skipped."""
+        step = int(step)
+        if step == self._last_saved:
+            return
+        if step in self._mngr.all_steps():
+            if step == self._restored_step:
+                self._last_saved = step
+                return
+            # Cross-run collision: replace. Wait for durability immediately
+            # to keep the no-checkpoint window (delete -> rewrite complete)
+            # as short as possible.
+            self._mngr.delete(step)
+            self._do_save(step, state, env_steps)
+            self._mngr.wait_until_finished()
+        else:
+            self._do_save(step, state, env_steps)
+        self._last_saved = step
+
+    def _do_save(self, step: int, state: Any, env_steps: int) -> None:
+        self._mngr.save(
+            int(step),
+            args=ocp.args.Composite(
+                **{
+                    STATE_KEY: ocp.args.StandardSave(state),
+                    META_KEY: ocp.args.JsonSave({"env_steps": int(env_steps)}),
+                }
+            ),
+        )
+
+    # --------------------------------------------------------------- restore
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mngr.all_steps())
+
+    def restore(self, state_like: Any, step: int | None = None):
+        """Restore ``(state, env_steps)``.
+
+        ``state_like`` is a live (freshly initialized) TrainState used as the
+        shape/dtype/sharding template — the restored pytree matches its
+        structure and device placement exactly.
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}"
+            )
+        restored = self._mngr.restore(
+            int(step),
+            args=ocp.args.Composite(
+                **{
+                    STATE_KEY: ocp.args.StandardRestore(
+                        _abstract_like(state_like)
+                    ),
+                    META_KEY: ocp.args.JsonRestore(),
+                }
+            ),
+        )
+        meta = restored[META_KEY] or {}
+        self._restored_step = int(step)
+        return restored[STATE_KEY], int(meta.get("env_steps", 0))
+
+    # ------------------------------------------------------------- lifecycle
+
+    def wait(self) -> None:
+        """Block until all pending async saves are durable."""
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class TrainerCheckpointing:
+    """The trainer-side checkpoint policy, shared by every backend: periodic
+    save cadence, the end-of-train/crash-path flush, and lifecycle. Holds an
+    optional ``Checkpointer`` (None → everything is a no-op except
+    ``save_now``, which raises)."""
+
+    def __init__(self, checkpointer: "Checkpointer | None", every: int):
+        self.checkpointer = checkpointer
+        self.every = every
+        self._since = 0
+
+    def save_now(self, state: Any, env_steps: int) -> None:
+        if self.checkpointer is None:
+            raise RuntimeError(
+                "no checkpoint_dir configured; set config.checkpoint_dir"
+            )
+        self.checkpointer.save(
+            int(state.update_step), state, env_steps
+        )
+
+    def after_update(self, state: Any, env_steps: int) -> None:
+        """Periodic cadence: call once per learner update."""
+        if self.checkpointer is None or not self.every:
+            return
+        self._since += 1
+        if self._since >= self.every:
+            self._since = 0
+            self.save_now(state, env_steps)
+
+    def finalize(self, state: Any, env_steps: int) -> None:
+        """Call from the train loop's ``finally``: save final state and
+        flush async writes. When an exception is already propagating, a
+        failing save is reported but NOT raised — the original crash cause
+        must survive (e.g. KeyboardInterrupt stays KeyboardInterrupt)."""
+        if self.checkpointer is None:
+            return
+        in_flight = sys.exc_info()[0] is not None
+        try:
+            self.save_now(state, env_steps)
+            self.checkpointer.wait()
+        except Exception:
+            if not in_flight:
+                raise
+            traceback.print_exc()
+            print(
+                "asyncrl_tpu: final checkpoint save failed while handling "
+                "another exception (above); re-raising the original.",
+                file=sys.stderr,
+            )
+
+    def close(self) -> None:
+        if self.checkpointer is not None:
+            self.checkpointer.close()
+
+
+def setup(config, restore: str | None, state):
+    """Shared trainer-side checkpoint wiring.
+
+    Returns ``(hook, state, env_steps)`` where ``hook`` is a
+    ``TrainerCheckpointing``:
+
+    - ``restore=path`` restores the initial state from ``path`` READ-ONLY
+      (never created, never written to — a typo'd path raises instead of
+      leaving an empty directory behind);
+    - ``config.checkpoint_dir`` is where ongoing saves go; if it already
+      holds checkpoints (and no explicit ``restore`` was given), training
+      auto-resumes from its latest step — crash recovery (SURVEY.md §5.3/5.4);
+    - both unset → a no-op hook.
+    """
+    env_steps = 0
+    if restore is not None:
+        with Checkpointer(restore, create=False) as src:
+            if src.latest_step() is None:
+                raise FileNotFoundError(f"no checkpoint under {restore!r}")
+            state, env_steps = src.restore(state)
+
+    if not config.checkpoint_dir:
+        return TrainerCheckpointing(None, 0), state, env_steps
+
+    ckpt = Checkpointer(config.checkpoint_dir)
+    if restore is None and ckpt.latest_step() is not None:
+        state, env_steps = ckpt.restore(state)
+    elif restore is not None and ckpt.latest_step() is not None:
+        # Explicit restore into a dir that already has history: refuse if
+        # that history runs AHEAD of the restored state — otherwise a later
+        # auto-resume would pick the old run's higher-numbered step and
+        # silently load another run's state.
+        latest = ckpt.latest_step()
+        if latest > int(state.update_step):
+            ckpt.close()
+            raise ValueError(
+                f"checkpoint_dir {config.checkpoint_dir!r} already holds "
+                f"steps up to {latest}, ahead of the restored step "
+                f"{int(state.update_step)} from {restore!r}; use a fresh "
+                "checkpoint_dir or clean the old run's checkpoints"
+            )
+    return (
+        TrainerCheckpointing(ckpt, config.checkpoint_every),
+        state,
+        env_steps,
+    )

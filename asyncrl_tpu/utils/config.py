@@ -77,7 +77,10 @@ class Config:
     # --- runtime ---
     seed: int = 0
     log_every: int = 20  # learner updates between metric drains
-    checkpoint_every: int = 0  # 0 disables
+    # Updates between periodic checkpoint saves; 0 disables the periodic
+    # cadence (with checkpoint_dir set, a final save on train() exit — clean
+    # or crashed — still happens).
+    checkpoint_every: int = 0
     checkpoint_dir: str = ""
     precision: str = "bf16_matmul"  # "f32" | "bf16_matmul"
     # Donate the TrainState into the compiled step. Off by default: the

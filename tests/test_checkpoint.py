@@ -1,0 +1,302 @@
+"""Checkpoint/resume (SURVEY.md §5.4): full-TrainState orbax round trip.
+
+The contract: restoring a checkpoint and running one more ``Learner.update``
+produces bit-identical state/metrics to the uninterrupted run — params, opt
+state, sharded actor/env state, and PRNG keys all survive exactly.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from asyncrl_tpu.api.trainer import Trainer
+from asyncrl_tpu.utils.config import Config
+
+
+def small_cfg(**kw):
+    base = dict(
+        env_id="CartPole-v1",
+        algo="impala",
+        num_envs=8,
+        unroll_len=8,
+        precision="f32",
+        log_every=2,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def tree_equal(a, b):
+    eq = jax.tree.map(
+        lambda x, y: bool(np.array_equal(np.asarray(x), np.asarray(y))), a, b
+    )
+    return all(jax.tree.leaves(eq))
+
+
+def test_save_restore_bit_exact_next_step(tmp_path):
+    cfg = small_cfg()
+    t = Trainer(cfg)
+    for _ in range(3):
+        t.state, _ = t.learner.update(t.state)
+    t.env_steps = 3 * cfg.batch_steps_per_update
+
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    with Checkpointer(str(tmp_path / "ck")) as ck:
+        ck.save(int(t.state.update_step), t.state, t.env_steps)
+        ck.wait()
+
+        # Uninterrupted continuation.
+        cont_state, cont_metrics = t.learner.update(t.state)
+
+        # Fresh trainer restores and continues.
+        t2 = Trainer(cfg)
+        restored, env_steps = ck.restore(t2.state)
+
+    assert env_steps == t.env_steps
+    assert tree_equal(restored, t.state)
+    res_state, res_metrics = t2.learner.update(restored)
+
+    assert tree_equal(cont_state, res_state)
+    assert tree_equal(cont_metrics, res_metrics)
+
+
+def test_trainer_auto_resume_from_dir(tmp_path):
+    ck_dir = str(tmp_path / "auto")
+    cfg = small_cfg(checkpoint_dir=ck_dir, checkpoint_every=2)
+    t = Trainer(cfg)
+    t.train(total_env_steps=4 * cfg.batch_steps_per_update)
+    assert t.checkpointer.latest_step() == 4
+
+    t2 = Trainer(cfg)  # same dir -> auto-resume
+    assert int(t2.state.update_step) == 4
+    assert t2.env_steps == 4 * cfg.batch_steps_per_update
+    assert tree_equal(t2.state.params, t.state.params)
+    assert tree_equal(t2.state.opt_state, t.state.opt_state)
+    assert tree_equal(t2.state.actor.keys, t.state.actor.keys)
+    t.close()
+    t2.close()
+
+
+def test_restore_is_read_only_and_saves_go_to_checkpoint_dir(tmp_path):
+    """restore= loads from a source run without writing to it; ongoing saves
+    land in config.checkpoint_dir."""
+    src_dir = str(tmp_path / "src")
+    cfg_src = small_cfg(checkpoint_dir=src_dir)
+    t = Trainer(cfg_src)
+    t.train(total_env_steps=2 * cfg_src.batch_steps_per_update)
+    t.close()
+    src_steps = Trainer(cfg_src).checkpointer.all_steps()
+
+    new_dir = str(tmp_path / "new")
+    cfg_new = small_cfg(checkpoint_dir=new_dir, checkpoint_every=1)
+    t2 = Trainer(cfg_new, restore=src_dir)
+    assert int(t2.state.update_step) == 2
+    t2.train(total_env_steps=4 * cfg_new.batch_steps_per_update)
+    t2.close()
+
+    # Source untouched; new saves (steps 3, 4) only under new_dir.
+    with_trainer = Trainer(cfg_src)
+    assert with_trainer.checkpointer.all_steps() == src_steps
+    with_trainer.close()
+    t3 = Trainer(small_cfg(checkpoint_dir=new_dir))
+    assert max(t3.checkpointer.all_steps()) == 4
+    t3.close()
+
+
+def test_checkpoint_dir_without_periodic_still_saves_on_exit(tmp_path):
+    ck_dir = str(tmp_path / "final_only")
+    cfg = small_cfg(checkpoint_dir=ck_dir)  # checkpoint_every left at 0
+    t = Trainer(cfg)
+    t.train(total_env_steps=3 * cfg.batch_steps_per_update)
+    assert t.checkpointer.latest_step() == 3
+    t.close()
+
+
+def test_crash_mid_train_saves_state(tmp_path):
+    """An exception escaping the train loop still leaves a durable
+    checkpoint of the progress made (the finally-path save)."""
+    ck_dir = str(tmp_path / "crash")
+    cfg = small_cfg(checkpoint_dir=ck_dir, log_every=1)
+    t = Trainer(cfg)
+    boom = {"n": 0}
+
+    def exploding_callback(window):
+        boom["n"] += 1
+        if boom["n"] == 2:
+            raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        t.train(
+            total_env_steps=100 * cfg.batch_steps_per_update,
+            callback=exploding_callback,
+        )
+    t.close()
+    t2 = Trainer(cfg)
+    assert int(t2.state.update_step) == 2
+    t2.close()
+
+
+def test_restore_missing_raises_without_creating_dir(tmp_path):
+    cfg = small_cfg()
+    missing = str(tmp_path / "nope")
+    with pytest.raises(FileNotFoundError):
+        Trainer(cfg, restore=missing)
+    assert not os.path.exists(missing)
+
+
+def test_retention_max_to_keep(tmp_path):
+    cfg = small_cfg(checkpoint_dir=str(tmp_path / "keep"), checkpoint_every=1)
+    t = Trainer(cfg)
+    t.train(total_env_steps=6 * cfg.batch_steps_per_update)
+    t.checkpointer.wait()
+    steps = t.checkpointer.all_steps()
+    assert len(steps) <= 3  # default max_to_keep
+    assert max(steps) == 6
+    t.close()
+
+
+def test_sebulba_checkpoint_resume(tmp_path):
+    """Sebulba backend: learner state checkpoints and auto-resumes; host env
+    state is transient by design (fresh actors on resume, like a §5.3
+    restart)."""
+    from asyncrl_tpu.api.sebulba_trainer import SebulbaTrainer
+
+    ck_dir = str(tmp_path / "seb")
+    cfg = small_cfg(
+        backend="sebulba",
+        actor_threads=1,
+        checkpoint_dir=ck_dir,
+        checkpoint_every=2,
+    )
+    t = SebulbaTrainer(cfg)
+    t.train(total_env_steps=4 * cfg.batch_steps_per_update)
+    assert t.checkpointer.latest_step() is not None
+
+    t2 = SebulbaTrainer(cfg)
+    assert int(t2.state.update_step) == int(t.state.update_step)
+    assert t2.env_steps == t.env_steps
+    assert tree_equal(t2.state.params, t.state.params)
+    assert tree_equal(t2.state.opt_state, t.state.opt_state)
+    t.close()
+    t2.close()
+
+
+def test_make_agent_restore_passthrough(tmp_path):
+    """restore= reaches the trainers through the public factory."""
+    from asyncrl_tpu.api.factory import make_agent
+
+    src = str(tmp_path / "factory_src")
+    cfg = small_cfg(checkpoint_dir=src)
+    t = make_agent(cfg)
+    t.train(total_env_steps=2 * cfg.batch_steps_per_update)
+    t.close()
+
+    t2 = make_agent(small_cfg(), restore=src)
+    assert int(t2.state.update_step) == 2
+    t2.close()
+
+
+def test_stale_same_numbered_step_is_replaced(tmp_path):
+    """A same-numbered step left by an earlier run is overwritten, not
+    silently kept — auto-resume must never load another run's state."""
+    import jax.numpy as jnp
+
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    d = str(tmp_path / "stale")
+    tree_a = {"w": jnp.zeros((4,))}
+    tree_b = {"w": jnp.ones((4,))}
+    with Checkpointer(d) as ck:
+        ck.save(5, tree_a, 100)
+        ck.wait()
+    with Checkpointer(d) as ck2:  # new run, same dir, same step number
+        ck2.save(5, tree_b, 200)
+        ck2.wait()
+        restored, env_steps = ck2.restore(tree_b, step=5)
+    assert env_steps == 200
+    assert np.array_equal(np.asarray(restored["w"]), np.ones((4,)))
+
+
+def test_restore_into_dir_with_newer_history_refuses(tmp_path):
+    """restore= into a checkpoint_dir whose old run is AHEAD must refuse:
+    a later auto-resume would otherwise load the old run's state."""
+    old_dir = str(tmp_path / "old_run")
+    cfg_old = small_cfg(checkpoint_dir=old_dir)
+    t = Trainer(cfg_old)
+    t.train(total_env_steps=3 * cfg_old.batch_steps_per_update)
+    t.close()
+
+    src_dir = str(tmp_path / "short_src")
+    cfg_src = small_cfg(checkpoint_dir=src_dir)
+    t2 = Trainer(cfg_src)
+    t2.train(total_env_steps=1 * cfg_src.batch_steps_per_update)
+    t2.close()
+
+    with pytest.raises(ValueError, match="ahead of the restored step"):
+        Trainer(small_cfg(checkpoint_dir=old_dir), restore=src_dir)
+
+
+def test_failed_save_is_retried_not_skipped(tmp_path, monkeypatch):
+    """A save that raises must not mark the step as saved — the crash-path
+    finalize retry must actually write."""
+    import jax.numpy as jnp
+
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    tree = {"w": jnp.arange(4.0)}
+    with Checkpointer(str(tmp_path / "retry")) as ck:
+        orig = ck._do_save
+        calls = {"n": 0}
+
+        def flaky(step, state, env_steps):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("disk full")
+            orig(step, state, env_steps)
+
+        monkeypatch.setattr(ck, "_do_save", flaky)
+        with pytest.raises(OSError):
+            ck.save(7, tree, 70)
+        ck.save(7, tree, 70)  # the finalize retry
+        ck.wait()
+        assert ck.all_steps() == [7]
+
+
+def test_noop_train_does_not_rewrite_restored_step(tmp_path):
+    """Auto-resume at step N followed immediately by finalize(N) must not
+    delete-and-rewrite the only durable checkpoint."""
+    ck_dir = str(tmp_path / "noop")
+    cfg = small_cfg(checkpoint_dir=ck_dir)
+    t = Trainer(cfg)
+    t.train(total_env_steps=2 * cfg.batch_steps_per_update)
+    t.close()
+
+    t2 = Trainer(cfg)  # auto-resumes at step 2
+    import glob
+
+    step_dirs = sorted(glob.glob(os.path.join(ck_dir, "*")))
+    mtimes = {d: os.path.getmtime(d) for d in step_dirs}
+    t2.train(total_env_steps=t2.env_steps)  # target already met: zero updates
+    t2.close()
+    for d, m in mtimes.items():
+        assert os.path.getmtime(d) == m, f"checkpoint {d} was rewritten"
+
+
+def test_sharded_actor_state_restores_sharded(tmp_path):
+    """Restored actor state must land dp-sharded on the mesh, params
+    replicated — no silent host gather."""
+    cfg = small_cfg()
+    t = Trainer(cfg)
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    with Checkpointer(str(tmp_path / "sh")) as ck:
+        ck.save(0, t.state, 0)
+        ck.wait()
+        restored, _ = ck.restore(t.state)
+    assert restored.actor.keys.sharding == t.state.actor.keys.sharding
+    assert restored.params is not t.state.params
+    leaf = jax.tree.leaves(restored.params)[0]
+    assert leaf.sharding == jax.tree.leaves(t.state.params)[0].sharding
