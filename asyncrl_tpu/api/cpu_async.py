@@ -1,0 +1,81 @@
+"""``backend="cpu_async"``: the thread-based CPU actor-learner parity path.
+
+This mirrors the reference's DEFAULT architecture — N asynchronous CPU actor
+workers, each with its own env(s), feeding a learner through a bounded queue
+(BASELINE.json:5,7 "4 async CPU actors"; SURVEY.md §3.1) — with every tensor
+pinned to the host CPU backend, so it runs identically with or without a TPU
+attached. Its purpose (SURVEY.md §7.2 M4): a differential-testing baseline
+for ``backend="tpu"``/``"sebulba"`` and a faithful stand-in for the
+reference's behavior under matched hyperparameters (§8-Q7).
+
+Architecture notes vs. the reference (reconstructed, SURVEY.md §3.1):
+- ``ActorWorker.run`` = the per-thread env-stepping loop filling a
+  ``RolloutBuffer`` and putting fragments on the actor→learner queue. Here
+  that is exactly ``rollout.sebulba.ActorThread`` (re-exported as
+  ``ActorWorker``) over a 1-device CPU pool slice + the explicit
+  ``rollout.buffer.RolloutBuffer``.
+- The learner is the same ``RolloutLearner.update`` all backends share
+  (V-trace/A3C/PPO + Adam), compiled for a 1-device CPU mesh.
+- Weight publishing is the ``ParamStore`` swap (the reference's shared-
+  weights re-read); classic Hogwild racing is intentionally NOT reproduced —
+  a fragment is always produced under one consistent behaviour policy, and
+  V-trace corrects the staleness (SURVEY.md §5.2: race-free by construction).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from asyncrl_tpu.api.sebulba_trainer import SebulbaTrainer
+from asyncrl_tpu.parallel.mesh import make_mesh
+from asyncrl_tpu.rollout.buffer import RolloutBuffer  # noqa: F401  (API parity)
+from asyncrl_tpu.rollout.sebulba import ActorThread
+from asyncrl_tpu.utils.config import Config
+
+# Name parity with the reference's per-thread actor class (BASELINE.json:5).
+ActorWorker = ActorThread
+
+
+class CpuAsyncTrainer(SebulbaTrainer):
+    """Thread-based CPU actor-learner trainer (reference parity backend).
+
+    A ``SebulbaTrainer`` whose mesh is pinned to one host-CPU device: learner
+    state, compiled update step, and (because params live on the CPU device)
+    the actors' batched inference all execute on CPU regardless of what
+    accelerator is attached. Everything else — ActorWorker threads, bounded
+    queue, ParamStore publishing, supervision (§5.3), checkpointing (§5.4) —
+    is the shared host-actor runtime.
+
+    Placement contract: all COMPUTATION is CPU-pinned, which deliberately
+    still allows other trainers (e.g. ``backend="tpu"``) in the same process
+    — the §8-Q7 differential test runs both side by side. JAX's first device
+    query does globally initialize every registered platform, so merely
+    constructing this trainer can start (but never compute on) an attached
+    accelerator's runtime; a process that must not touch the accelerator at
+    all should restrict ``jax.config.update("jax_platforms", "cpu")`` before
+    any JAX use, as the CLI does for cpu_async presets.
+    """
+
+    def __init__(
+        self, config: Config, spec=None, model=None, mesh=None, restore=None
+    ):
+        cpu = jax.devices("cpu")[0]
+        if mesh is None:
+            mesh = make_mesh((1,), ("dp",), devices=[cpu])
+        # Pin DEFAULT placement to CPU for the whole construction (model
+        # init, probe pools, checkpoint restore): no computation — not even
+        # a throwaway init later device_put back to host — may land on an
+        # attached accelerator (see class docstring placement contract).
+        with jax.default_device(cpu):
+            super().__init__(
+                config, spec=spec, model=model, mesh=mesh, restore=restore
+            )
+        self._actor_device = cpu
+
+    def train(self, *args, **kwargs):
+        with jax.default_device(jax.devices("cpu")[0]):
+            return super().train(*args, **kwargs)
+
+    def evaluate(self, *args, **kwargs):
+        with jax.default_device(jax.devices("cpu")[0]):
+            return super().evaluate(*args, **kwargs)

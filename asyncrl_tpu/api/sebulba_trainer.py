@@ -108,6 +108,7 @@ class SebulbaTrainer:
         self._recent_restarts: list[float] = []
         self._RESTART_WINDOW_S = 300.0
         self._next_actor_seed = config.seed * 7919 + 1
+        self._actor_device = None  # CpuAsyncTrainer pins actors to host CPU
 
     # --------------------------------------------------------------- actors
 
@@ -125,6 +126,7 @@ class SebulbaTrainer:
             seed=seed,
             stop_event=self._stop,
             errors=self._errors,
+            device=self._actor_device,
         )
         actor.start()
         return actor

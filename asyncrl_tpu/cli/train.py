@@ -41,6 +41,15 @@ def main(argv: list[str] | None = None) -> int:
     if args.steps is not None:
         cfg = cfg.replace(total_env_steps=args.steps)
 
+    if cfg.backend == "cpu_async":
+        # The parity backend is CPU-only by contract; restricting the
+        # platform list before any backend initializes keeps JAX's global
+        # backend init from even touching an attached accelerator (jax
+        # initializes ALL registered platforms on first device query).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     agent = make_agent(cfg)
 
     def report(window: dict) -> None:

@@ -87,8 +87,20 @@ brax_ppo = Config(
 cartpole_impala = cartpole_a3c.replace(algo="impala", actor_staleness=2)
 cartpole_ppo = cartpole_a3c.replace(algo="ppo", learning_rate=3e-4)
 
+# The reference's literal default layout (BASELINE.json:7): 4 async CPU
+# actor threads, one env each, A3C — the cpu_async differential-testing
+# baseline (SURVEY.md §7.2 M4, §8-Q7).
+cartpole_a3c_cpu = cartpole_a3c.replace(
+    backend="cpu_async",
+    num_envs=4,
+    actor_threads=4,
+    unroll_len=20,
+    total_env_steps=200_000,
+)
+
 PRESETS: dict[str, Config] = {
     "cartpole_a3c": cartpole_a3c,
+    "cartpole_a3c_cpu": cartpole_a3c_cpu,
     "cartpole_impala": cartpole_impala,
     "cartpole_ppo": cartpole_ppo,
     "pong_impala": pong_impala,

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 
@@ -51,6 +52,79 @@ class Rollout:
     @property
     def num_steps(self) -> int:
         return self.actions.shape[0] * self.actions.shape[1]
+
+
+class RolloutBuffer:
+    """Host-side fixed-length fragment buffer actors append to step-by-step —
+    direct parity with the reference's ``RolloutBuffer`` (BASELINE.json:5;
+    SURVEY.md §2). Used by the ``sebulba`` and ``cpu_async`` host-actor
+    backends; the Anakin path needs no host buffer (the scan's stacked
+    outputs ARE the fragment).
+
+    Reusable: numpy storage is allocated once (action storage lazily, on the
+    first append, when dtype/shape are known) and overwritten each fragment;
+    ``emit`` copies, so fragments are safe to retain after ``reset``.
+    """
+
+    def __init__(self, unroll_len: int, num_envs: int, obs_shape, obs_dtype):
+        T, B = unroll_len, num_envs
+        self.unroll_len = T
+        self.num_envs = B
+        self.obs = np.empty((T, B, *obs_shape), obs_dtype)
+        self.behaviour_logp = np.empty((T, B), np.float32)
+        self.rewards = np.empty((T, B), np.float32)
+        self.terminated = np.empty((T, B), bool)
+        self.truncated = np.empty((T, B), bool)
+        self.actions: np.ndarray | None = None
+        self._t = 0
+
+    def __len__(self) -> int:
+        return self._t
+
+    @property
+    def full(self) -> bool:
+        return self._t == self.unroll_len
+
+    def append(self, obs, action, logp, reward, terminated, truncated) -> None:
+        """Record one transition: ``obs`` is what the policy saw choosing
+        ``action``; reward/terminated/truncated describe the step outcome."""
+        t = self._t
+        if t >= self.unroll_len:
+            raise IndexError(f"buffer full at t={t}; call emit()/reset()")
+        action = np.asarray(action)
+        if self.actions is None:
+            self.actions = np.empty(
+                (self.unroll_len, self.num_envs, *action.shape[1:]),
+                action.dtype,
+            )
+        self.obs[t] = obs
+        self.actions[t] = action
+        self.behaviour_logp[t] = logp
+        self.rewards[t] = reward
+        self.terminated[t] = terminated
+        self.truncated[t] = truncated
+        self._t = t + 1
+
+    def emit(self, bootstrap_obs) -> Rollout:
+        """Copy out the completed fragment and reset for the next one."""
+        if not self.full:
+            raise ValueError(
+                f"fragment incomplete: {self._t}/{self.unroll_len} steps"
+            )
+        rollout = Rollout(
+            obs=self.obs.copy(),
+            actions=self.actions.copy(),
+            behaviour_logp=self.behaviour_logp.copy(),
+            rewards=self.rewards.copy(),
+            terminated=self.terminated.copy(),
+            truncated=self.truncated.copy(),
+            bootstrap_obs=np.asarray(bootstrap_obs).copy(),
+        )
+        self._t = 0
+        return rollout
+
+    def reset(self) -> None:
+        self._t = 0
 
 
 @struct.dataclass

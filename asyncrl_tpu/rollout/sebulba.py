@@ -31,7 +31,7 @@ import numpy as np
 
 from asyncrl_tpu.envs.core import Environment, EnvSpec
 from asyncrl_tpu.ops import distributions
-from asyncrl_tpu.rollout.buffer import Rollout
+from asyncrl_tpu.rollout.buffer import Rollout, RolloutBuffer
 
 
 class ParamStore:
@@ -202,6 +202,7 @@ class ActorThread(threading.Thread):
         seed: int,
         stop_event: threading.Event,
         errors: "queue.Queue[tuple[int, BaseException]]",
+        device=None,
     ):
         super().__init__(name=f"actor-{index}", daemon=True)
         self.index = index
@@ -213,10 +214,19 @@ class ActorThread(threading.Thread):
         self.seed = seed
         self.stop_event = stop_event
         self.errors = errors
+        # ``jax.default_device`` is thread-local, so a device pin must be
+        # re-established INSIDE the thread: the cpu_async backend pins actors
+        # to host CPU (never touching an attached accelerator); sebulba
+        # leaves None (batched inference on the accelerator is the point).
+        self.device = device
 
     def run(self) -> None:  # noqa: D102 — thread entry
         try:
-            self._run()
+            if self.device is not None:
+                with jax.default_device(self.device):
+                    self._run()
+            else:
+                self._run()
         except BaseException as e:  # report, don't die silently (§5.3)
             self.errors.put((self.index, e))
         finally:
@@ -233,13 +243,7 @@ class ActorThread(threading.Thread):
         obs = pool.reset()
         key = jax.random.PRNGKey(self.seed)
 
-        obs_buf = np.empty((T, B) + obs.shape[1:], obs.dtype)
-        logp_buf = np.empty((T, B), np.float32)
-        rew_buf = np.empty((T, B), np.float32)
-        term_buf = np.empty((T, B), bool)
-        trunc_buf = np.empty((T, B), bool)
-        act_buf: np.ndarray | None = None  # dtype/shape known after 1st step
-
+        buffer = RolloutBuffer(T, B, obs.shape[1:], obs.dtype)
         running_return = np.zeros((B,), np.float64)
         running_length = np.zeros((B,), np.float64)
 
@@ -248,18 +252,12 @@ class ActorThread(threading.Thread):
             ret_sum = 0.0
             len_sum = 0.0
             count = 0.0
-            for t in range(T):
+            while not buffer.full:
                 actions_d, logp_d, key = self.inference_fn(params, obs, key)
                 actions = np.asarray(actions_d)
-                if act_buf is None:
-                    act_buf = np.empty((T, B) + actions.shape[1:], actions.dtype)
-                obs_buf[t] = obs
-                act_buf[t] = actions
-                logp_buf[t] = np.asarray(logp_d)
+                prev_obs = obs
                 obs, rew, term, trunc = pool.step(actions)
-                rew_buf[t] = rew
-                term_buf[t] = term
-                trunc_buf[t] = trunc
+                buffer.append(prev_obs, actions, np.asarray(logp_d), rew, term, trunc)
 
                 running_return += rew
                 running_length += 1.0
@@ -272,15 +270,7 @@ class ActorThread(threading.Thread):
                     running_length[done] = 0.0
 
             fragment = Fragment(
-                Rollout(
-                    obs=obs_buf.copy(),
-                    actions=act_buf.copy(),
-                    behaviour_logp=logp_buf.copy(),
-                    rewards=rew_buf.copy(),
-                    terminated=term_buf.copy(),
-                    truncated=trunc_buf.copy(),
-                    bootstrap_obs=obs.copy(),
-                ),
+                buffer.emit(bootstrap_obs=obs),
                 ret_sum, len_sum, count, version,
             )
             # Bounded put that stays responsive to shutdown.
