@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import sys
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -30,6 +29,19 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--json", action="store_true", help="emit one JSON line per window"
+    )
+    parser.add_argument(
+        "--jsonl", metavar="FILE", default=None,
+        help="also append one JSON line per window to FILE",
+    )
+    parser.add_argument(
+        "--logdir", metavar="DIR", default=None,
+        help="also write TensorBoard scalar summaries under DIR",
+    )
+    parser.add_argument(
+        "--profile", metavar="DIR", default=None,
+        help="capture a jax.profiler trace of the training run into DIR "
+        "(view with tensorboard --logdir DIR)",
     )
     args = parser.parse_args(argv)
 
@@ -52,28 +64,39 @@ def main(argv: list[str] | None = None) -> int:
 
     agent = make_agent(cfg)
 
-    def report(window: dict) -> None:
-        if args.json:
-            print(json.dumps(window))
-        else:
+    from asyncrl_tpu.utils import metrics as metrics_mod
+
+    sink = metrics_mod.MultiSink(
+        metrics_mod.StdoutSink(as_json=args.json),
+        metrics_mod.JsonlSink(args.jsonl) if args.jsonl else None,
+        metrics_mod.TensorBoardSink(args.logdir) if args.logdir else None,
+    )
+
+    import jax
+
+    try:
+        if args.profile:
+            jax.profiler.start_trace(args.profile)
+        try:
+            agent.train(callback=sink)
+        finally:
+            if args.profile:
+                jax.profiler.stop_trace()
+            sink.close()
+
+        if args.eval_episodes:
+            ret = agent.evaluate(num_episodes=args.eval_episodes)
             print(
-                f"steps={window['env_steps']:>10}  "
-                f"fps={window['fps']:>12,.0f}  "
-                f"ep_return={window['episode_return']:8.2f}  "
-                f"loss={window['loss']:8.4f}  "
-                f"entropy={window['entropy']:6.3f}"
+                json.dumps(
+                    {"eval_episodes": args.eval_episodes, "mean_return": ret}
+                )
+                if args.json
+                else f"greedy eval over {args.eval_episodes} episodes: {ret:.1f}"
             )
-        sys.stdout.flush()
-
-    agent.train(callback=report)
-
-    if args.eval_episodes:
-        ret = agent.evaluate(num_episodes=args.eval_episodes)
-        print(
-            json.dumps({"eval_episodes": args.eval_episodes, "mean_return": ret})
-            if args.json
-            else f"greedy eval over {args.eval_episodes} episodes: {ret:.1f}"
-        )
+    finally:
+        close = getattr(agent, "close", None)
+        if close is not None:
+            close()
     return 0
 
 

@@ -219,16 +219,20 @@ def make_train_step(
     )
 
     def train_step(state: TrainState):
-        actor, rollout, stats = unroll(
-            apply_fn, state.actor_params, env, state.actor, config.unroll_len,
-            dist=dist, reward_scale=config.reward_scale,
-        )
+        # named_scope: sections show up as labeled blocks in jax.profiler
+        # traces (SURVEY.md §5.1; CLI --profile).
+        with jax.named_scope("rollout"):
+            actor, rollout, stats = unroll(
+                apply_fn, state.actor_params, env, state.actor,
+                config.unroll_len, dist=dist, reward_scale=config.reward_scale,
+            )
 
         if ppo_multipass:
-            params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
-                config, apply_fn, optimizer, dist,
-                state.params, state.opt_state, rollout, state.update_step,
-            )
+            with jax.named_scope("ppo_multipass"):
+                params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
+                    config, apply_fn, optimizer, dist,
+                    state.params, state.opt_state, rollout, state.update_step,
+                )
         else:
             # shard_map autodiff semantics (jax>=0.8 vma tracking): the
             # gradient of a REPLICATED input (params) w.r.t. a device-varying
@@ -244,14 +248,16 @@ def make_train_step(
                 )
                 return loss / jax.lax.axis_size(DP_AXIS), (loss, metrics)
 
-            (_, (loss, metrics)), grads = jax.value_and_grad(
-                scaled_loss, has_aux=True
-            )(state.params)
-            grad_norm = optax.global_norm(grads)
-            updates, opt_state = optimizer.update(
-                grads, state.opt_state, state.params
-            )
-            params = optax.apply_updates(state.params, updates)
+            with jax.named_scope("loss_and_grad"):
+                (_, (loss, metrics)), grads = jax.value_and_grad(
+                    scaled_loss, has_aux=True
+                )(state.params)
+            with jax.named_scope("optimizer"):
+                grad_norm = optax.global_norm(grads)
+                updates, opt_state = optimizer.update(
+                    grads, state.opt_state, state.params
+                )
+                params = optax.apply_updates(state.params, updates)
 
         metrics = jax.lax.pmean(metrics, DP_AXIS)
         loss = jax.lax.pmean(loss, DP_AXIS)

@@ -1,0 +1,125 @@
+"""Metrics sinks (SURVEY.md §5.5): stdout / JSONL / TensorBoard.
+
+The hot loop never blocks on host sync for metrics — trainers drain
+device-resident metric pytrees every ``log_every`` updates (see
+``Trainer.train``) and hand each aggregated window dict to a sink. Sinks are
+composable; the CLI wires them from flags (``--json``, ``--jsonl FILE``,
+``--logdir DIR``). The reference family at most printed episode rewards to
+stdout (SURVEY.md §5.5a); TensorBoard here uses ``tf.summary`` (tensorflow
+ships in this image) imported lazily so the common path never pays the TF
+import.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Mapping, TextIO
+
+
+class MetricsSink:
+    """One destination for per-window metric dicts."""
+
+    def write(self, window: Mapping[str, Any]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __call__(self, window: Mapping[str, Any]) -> None:
+        """Sinks are usable directly as ``Trainer.train(callback=sink)``."""
+        self.write(window)
+
+
+class StdoutSink(MetricsSink):
+    """Human-readable one-liner per window (or raw JSON with ``as_json``)."""
+
+    def __init__(self, as_json: bool = False, stream: TextIO | None = None):
+        self.as_json = as_json
+        self.stream = stream or sys.stdout
+
+    def write(self, window: Mapping[str, Any]) -> None:
+        if self.as_json:
+            print(json.dumps(dict(window)), file=self.stream)
+        else:
+            parts = [
+                f"steps={int(window.get('env_steps', 0)):>10}",
+                f"fps={window.get('fps', 0.0):>12,.0f}",
+                f"ep_return={window.get('episode_return', 0.0):8.2f}",
+            ]
+            for k in ("loss", "entropy", "param_lag"):
+                if k in window:
+                    parts.append(f"{k}={window[k]:8.4f}")
+            print("  ".join(parts), file=self.stream)
+        self.stream.flush()
+
+
+class JsonlSink(MetricsSink):
+    """Append one JSON line per window to a file — the greppable run log."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a", buffering=1)
+
+    def write(self, window: Mapping[str, Any]) -> None:
+        self._f.write(json.dumps(dict(window)) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class TensorBoardSink(MetricsSink):
+    """Scalar summaries under ``logdir``, stepped by ``env_steps``.
+
+    Uses ``tf.summary`` lazily; every numeric value in the window becomes a
+    scalar tag. View with ``tensorboard --logdir <dir>``.
+    """
+
+    def __init__(self, logdir: str):
+        import tensorflow as tf  # local: ~10s import, only when requested
+
+        self._tf = tf
+        self._writer = tf.summary.create_file_writer(logdir)
+
+    def write(self, window: Mapping[str, Any]) -> None:
+        tf = self._tf
+        step = int(window.get("env_steps", 0))
+        with self._writer.as_default():
+            for key, value in window.items():
+                if key == "env_steps":
+                    continue
+                try:
+                    tf.summary.scalar(key, float(value), step=step)
+                except (TypeError, ValueError):
+                    continue
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class MultiSink(MetricsSink):
+    """Fan a window out to several sinks."""
+
+    def __init__(self, *sinks: MetricsSink):
+        self.sinks = [s for s in sinks if s is not None]
+
+    def write(self, window: Mapping[str, Any]) -> None:
+        for sink in self.sinks:
+            sink.write(window)
+
+    def close(self) -> None:
+        first_error = None
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception as e:
+                first_error = first_error or e
+        if first_error is not None:
+            raise first_error

@@ -69,6 +69,7 @@ def test_everything_runs_on_cpu():
         t.close()
 
 
+@pytest.mark.slow
 def test_cpu_async_learns_cartpole():
     """The reference smoke config (4 async CPU actors, A3C, BASELINE.json:7):
     short-budget learning signal — mean return must clearly beat random."""

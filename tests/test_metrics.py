@@ -1,0 +1,89 @@
+"""Metrics sinks (SURVEY.md §5.5) and the CLI observability flags."""
+
+import glob
+import io
+import json
+
+import pytest
+
+from asyncrl_tpu.utils.metrics import (
+    JsonlSink,
+    MetricsSink,
+    MultiSink,
+    StdoutSink,
+)
+
+WINDOW = {
+    "env_steps": 2048,
+    "fps": 123456.7,
+    "episode_return": 21.5,
+    "loss": 0.25,
+    "entropy": 0.69,
+}
+
+
+def test_stdout_sink_text_and_json():
+    buf = io.StringIO()
+    StdoutSink(stream=buf).write(WINDOW)
+    line = buf.getvalue()
+    assert "steps=" in line and "ep_return=" in line and "loss=" in line
+
+    buf = io.StringIO()
+    StdoutSink(as_json=True, stream=buf).write(WINDOW)
+    assert json.loads(buf.getvalue()) == WINDOW
+
+
+def test_jsonl_sink_appends(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with JsonlSink(path) as sink:
+        sink.write(WINDOW)
+        sink.write(dict(WINDOW, env_steps=4096))
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["env_steps"] for l in lines] == [2048, 4096]
+
+
+def test_multi_sink_fans_out_and_skips_none(tmp_path):
+    buf = io.StringIO()
+    path = str(tmp_path / "m.jsonl")
+    multi = MultiSink(StdoutSink(stream=buf), None, JsonlSink(path))
+    multi.write(WINDOW)
+    multi.close()
+    assert "steps=" in buf.getvalue()
+    assert json.loads(open(path).read())["env_steps"] == 2048
+
+
+def test_sink_is_a_trainer_callback(tmp_path):
+    """Sinks plug directly into Trainer.train(callback=...)."""
+    from asyncrl_tpu.api.trainer import Trainer
+    from asyncrl_tpu.utils.config import Config
+
+    cfg = Config(
+        env_id="CartPole-v1", algo="a3c", num_envs=8, unroll_len=8,
+        precision="f32", log_every=2,
+    )
+    path = str(tmp_path / "train.jsonl")
+    t = Trainer(cfg)
+    with JsonlSink(path) as sink:
+        t.train(total_env_steps=4 * cfg.batch_steps_per_update, callback=sink)
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2  # 4 updates / log_every=2
+    assert all("fps" in l and "loss" in l for l in lines)
+
+
+@pytest.mark.slow
+def test_tensorboard_sink_writes_event_files(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    del tf
+    from asyncrl_tpu.utils.metrics import TensorBoardSink
+
+    logdir = str(tmp_path / "tb")
+    with TensorBoardSink(logdir) as sink:
+        sink.write(WINDOW)
+        sink.write(dict(WINDOW, env_steps=4096))
+    events = glob.glob(f"{logdir}/events.out.tfevents.*")
+    assert events, "no TensorBoard event file written"
+
+
+def test_base_sink_is_abstract():
+    with pytest.raises(NotImplementedError):
+        MetricsSink().write(WINDOW)
