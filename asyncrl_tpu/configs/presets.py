@@ -43,9 +43,18 @@ pong_impala = Config(
 )
 
 # BASELINE.json:9 — "Atari-57 suite, IMPALA, 1024 envs/chip". Pixel-obs
-# Pong (84x84x4, on-device rendering) stands in for the ALE games.
+# Pong (84x84x4, on-device rendering) stands in for the ALE games;
+# JaxBreakoutPixels-v0 (envs/breakout.py) is the second game of the family
+# (`atari_impala env_id=JaxBreakoutPixels-v0` switches games, exactly like
+# swapping ALE roms in the reference suite).
 atari_impala = pong_impala.replace(
     env_id="JaxPongPixels-v0", num_envs=1024, torso="impala_cnn"
+)
+# Breakout's reward lands ~23 steps after the paddle hit that caused it and
+# returns run to 288/wall, so the learner sees scaled rewards (value loss
+# would otherwise dominate under grad clipping) and less entropy pressure.
+breakout_impala = pong_impala.replace(
+    env_id="JaxBreakout-v0", reward_scale=0.1, entropy_coef=0.003
 )
 
 # BASELINE.json:10 — "Procgen-16, PPO + GAE, 4096 envs data-parallel".
@@ -98,6 +107,32 @@ cartpole_a3c_cpu = cartpole_a3c.replace(
     total_env_steps=200_000,
 )
 
+# BASELINE.json:11's real-physics variant: gymnasium's MuJoCo Ant/Humanoid
+# through the Sebulba host path (mujoco ships in this image even though brax
+# does not — SURVEY.md §7.0). Continuous PPO with the same reward scaling
+# brax uses for these tasks. Host envs are C-backed MuJoCo, so actor threads
+# overlap physics with device inference.
+mujoco_ant_ppo = Config(
+    env_id="Ant-v5",
+    algo="ppo",
+    backend="sebulba",
+    host_pool="gym",
+    num_envs=64,
+    actor_threads=4,
+    unroll_len=64,
+    total_env_steps=5_000_000,
+    learning_rate=3e-4,
+    gamma=0.97,
+    gae_lambda=0.95,
+    entropy_coef=0.001,
+    reward_scale=0.1,
+    ppo_epochs=4,
+    ppo_minibatches=8,
+    torso="mlp",
+    hidden_sizes=(256, 256),
+)
+mujoco_humanoid_ppo = mujoco_ant_ppo.replace(env_id="Humanoid-v5")
+
 PRESETS: dict[str, Config] = {
     "cartpole_a3c": cartpole_a3c,
     "cartpole_a3c_cpu": cartpole_a3c_cpu,
@@ -105,8 +140,11 @@ PRESETS: dict[str, Config] = {
     "cartpole_ppo": cartpole_ppo,
     "pong_impala": pong_impala,
     "atari_impala": atari_impala,
+    "breakout_impala": breakout_impala,
     "procgen_ppo": procgen_ppo,
     "brax_ppo": brax_ppo,
+    "mujoco_ant_ppo": mujoco_ant_ppo,
+    "mujoco_humanoid_ppo": mujoco_humanoid_ppo,
 }
 
 

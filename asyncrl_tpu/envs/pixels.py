@@ -1,0 +1,88 @@
+"""Shared 4-frame-stack pixel wrapper for the Atari stand-in games.
+
+The TPU-native version of the reference's Atari preprocessing pipeline
+(SURVEY.md §3.3: grayscale, 84x84, stack 4): a core vector-state game plus an
+on-device iota-mask renderer become an Atari-shaped pixel env whose frames
+fuse into the rollout scan. One implementation serves every game
+(``envs/pong.py``, ``envs/breakout.py``, future additions), so the stacking /
+auto-reset / truncation-bootstrap frame logic cannot diverge per game.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+
+
+@struct.dataclass
+class PixelState:
+    core: Any
+    frames: jax.Array  # [FRAME, FRAME, 4] most-recent-last
+
+
+class FrameStackPixels(Environment):
+    """84x84x4 uint8 stacked-frame observations over a vector-state core.
+
+    ``render_state(core_state)`` paints the current frame;
+    ``render_last_obs(vector_obs)`` reconstructs the true pre-reset final
+    frame from the core's vector ``last_obs`` (used only for truncation
+    bootstrapping — the post-reset stack is rebuilt from the fresh frame, so
+    no pixels leak across episodes).
+    """
+
+    def __init__(
+        self,
+        core: Environment,
+        render_state: Callable[[Any], jax.Array],
+        render_last_obs: Callable[[jax.Array], jax.Array],
+        frame: int = 84,
+    ):
+        self._core = core
+        self._render = render_state
+        self._render_last = render_last_obs
+        self.spec = EnvSpec(
+            obs_shape=(frame, frame, 4),
+            num_actions=core.spec.num_actions,
+            obs_dtype=jnp.uint8,
+        )
+
+    def init(self, key: jax.Array) -> PixelState:
+        core = self._core.init(key)
+        frame = self._render(core)
+        return PixelState(
+            core=core, frames=jnp.repeat(frame[..., None], 4, axis=-1)
+        )
+
+    def observe(self, state: PixelState) -> jax.Array:
+        return state.frames
+
+    def step(
+        self, state: PixelState, action: jax.Array, key: jax.Array
+    ) -> tuple[PixelState, TimeStep]:
+        new_core, ts = self._core.step(state.core, action, key)
+        frame = self._render(new_core)
+        shifted = jnp.concatenate(
+            [state.frames[..., 1:], frame[..., None]], axis=-1
+        )
+        # Post-reset state gets a full stack of its own frame, exactly like
+        # a fresh init — no leakage of the previous episode's pixels.
+        frames = jnp.where(
+            ts.done, jnp.repeat(frame[..., None], 4, axis=-1), shifted
+        )
+        last_frame = self._render_last(ts.last_obs)
+        last_frames = jnp.concatenate(
+            [state.frames[..., 1:], last_frame[..., None]], axis=-1
+        )
+        new_state = PixelState(core=new_core, frames=frames)
+        return new_state, TimeStep(
+            obs=frames,
+            reward=ts.reward,
+            terminated=ts.terminated,
+            truncated=ts.truncated,
+            last_obs=last_frames,
+        )

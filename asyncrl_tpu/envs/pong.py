@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+from asyncrl_tpu.envs.pixels import FrameStackPixels
 
 # Court is the unit square; x grows toward the agent's side.
 AGENT_X = 0.95  # agent paddle plane (right)
@@ -202,58 +203,19 @@ def render(state: PongState) -> jax.Array:
     )
 
 
-@struct.dataclass
-class PongPixelState:
-    core: PongState
-    frames: jax.Array  # [FRAME, FRAME, 4] most-recent-last
+class PongPixels(FrameStackPixels):
+    """Pixel-observation Pong: 84x84x4 stacked frames, Atari-shaped.
 
-
-class PongPixels(Environment):
-    """Pixel-observation Pong: 84x84x4 stacked frames, Atari-shaped."""
-
-    spec = EnvSpec(
-        obs_shape=(FRAME, FRAME, 4), num_actions=NUM_ACTIONS, obs_dtype=jnp.uint8
-    )
+    The vector ``last_obs`` layout for frame reconstruction: obs[0]=ball_x,
+    obs[1]=ball_y, obs[4]=agent_y, obs[5]=opp_y.
+    """
 
     def __init__(self):
-        self._core = Pong()
-
-    def init(self, key: jax.Array) -> PongPixelState:
-        core = self._core.init(key)
-        frame = render(core)
-        return PongPixelState(
-            core=core, frames=jnp.repeat(frame[..., None], 4, axis=-1)
-        )
-
-    def observe(self, state: PongPixelState) -> jax.Array:
-        return state.frames
-
-    def step(
-        self, state: PongPixelState, action: jax.Array, key: jax.Array
-    ) -> tuple[PongPixelState, TimeStep]:
-        new_core, ts = self._core.step(state.core, action, key)
-        frame = render(new_core)
-        shifted = jnp.concatenate(
-            [state.frames[..., 1:], frame[..., None]], axis=-1
-        )
-        # Post-reset state gets a full stack of its own frame, exactly like a
-        # fresh init — no leakage of the previous episode's pixels.
-        frames = jnp.where(
-            ts.done, jnp.repeat(frame[..., None], 4, axis=-1), shifted
-        )
-        # True pre-reset final frame, reconstructed from the core's vector
-        # last_obs (obs[0]=ball_x, obs[1]=ball_y, obs[4]=agent_y, obs[5]=opp_y)
-        # — used only for truncation bootstrapping.
-        lo = ts.last_obs
-        last_frame = render_positions(lo[0], lo[1], lo[4], lo[5])
-        last_frames = jnp.concatenate(
-            [state.frames[..., 1:], last_frame[..., None]], axis=-1
-        )
-        new_state = PongPixelState(core=new_core, frames=frames)
-        return new_state, TimeStep(
-            obs=frames,
-            reward=ts.reward,
-            terminated=ts.terminated,
-            truncated=ts.truncated,
-            last_obs=last_frames,
+        super().__init__(
+            Pong(),
+            render_state=render,
+            render_last_obs=lambda lo: render_positions(
+                lo[0], lo[1], lo[4], lo[5]
+            ),
+            frame=FRAME,
         )

@@ -31,6 +31,7 @@ def registered() -> list[str]:
 
 
 def _register_builtins() -> None:
+    from asyncrl_tpu.envs.breakout import Breakout, BreakoutPixels
     from asyncrl_tpu.envs.cartpole import CartPole
     from asyncrl_tpu.envs.pendulum import Pendulum
     from asyncrl_tpu.envs.pong import Pong, PongPixels
@@ -38,6 +39,8 @@ def _register_builtins() -> None:
     register("CartPole-v1", CartPole)
     register("JaxPong-v0", Pong)
     register("JaxPongPixels-v0", PongPixels)
+    register("JaxBreakout-v0", Breakout)
+    register("JaxBreakoutPixels-v0", BreakoutPixels)
     register("JaxPendulum-v0", Pendulum)
 
 

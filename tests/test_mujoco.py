@@ -1,0 +1,70 @@
+"""MuJoCo continuous-control workloads (the real-physics variant of the
+reference's Brax Ant/Humanoid config, BASELINE.json:11) through the Sebulba
+host path: gymnasium MuJoCo envs + continuous PPO."""
+
+import importlib.util
+
+import numpy as np
+import pytest
+
+from asyncrl_tpu.configs import presets
+from asyncrl_tpu.envs.gym_adapter import GymnasiumHostPool, available
+
+# gymnasium registers the MuJoCo env SPECS unconditionally; only a present
+# mujoco package makes them constructible.
+mujoco_available = (
+    available("Ant-v5") and importlib.util.find_spec("mujoco") is not None
+)
+
+
+@pytest.mark.skipif(not mujoco_available, reason="gymnasium MuJoCo not available")
+def test_ant_pool_contract():
+    pool = GymnasiumHostPool("Ant-v5", num_envs=3, seed=0)
+    try:
+        assert pool.spec.continuous and pool.spec.action_dim == 8
+        obs = pool.reset()
+        assert obs.shape == (3, 105) and obs.dtype == np.float32
+        actions = np.random.default_rng(0).uniform(-1, 1, (3, 8)).astype(np.float32)
+        obs, rew, term, trunc = pool.step(actions)
+        assert obs.shape == (3, 105)
+        assert rew.shape == (3,) and term.shape == (3,) and trunc.shape == (3,)
+        # Out-of-bounds actions are clipped, not rejected.
+        obs, *_ = pool.step(np.full((3, 8), 5.0, np.float32))
+        assert np.isfinite(obs).all()
+    finally:
+        pool.close()
+
+
+@pytest.mark.skipif(not mujoco_available, reason="gymnasium MuJoCo not available")
+def test_ant_ppo_sebulba_pipeline():
+    """A few PPO updates on real MuJoCo physics flow through actors, queue,
+    and the continuous-action learner without shape/dtype mismatches."""
+    from asyncrl_tpu import make_agent
+
+    cfg = presets.get("mujoco_ant_ppo").replace(
+        num_envs=16,
+        actor_threads=2,
+        unroll_len=16,
+        ppo_epochs=2,
+        ppo_minibatches=2,
+        precision="f32",
+        log_every=2,
+    )
+    agent = make_agent(cfg)
+    try:
+        history = agent.train(total_env_steps=8 * (16 // 2) * 16)
+        assert history and all(np.isfinite(h["loss"]) for h in history)
+        ret = agent.evaluate(num_episodes=2, max_steps=100)
+        assert np.isfinite(ret)
+    finally:
+        agent.close()
+
+
+@pytest.mark.skipif(not mujoco_available, reason="gymnasium MuJoCo not available")
+def test_humanoid_preset_resolves():
+    cfg = presets.get("mujoco_humanoid_ppo")
+    pool = GymnasiumHostPool(cfg.env_id, num_envs=1, seed=0)
+    try:
+        assert pool.spec.continuous and pool.spec.action_dim == 17
+    finally:
+        pool.close()
