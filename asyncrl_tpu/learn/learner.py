@@ -66,6 +66,22 @@ def make_optimizer(config: Config) -> optax.GradientTransformation:
     )
 
 
+def resolve_scan_impl(config: Config, mesh: Mesh) -> Config:
+    """Resolve ``scan_impl="auto"`` to a concrete implementation. Called by
+    each learner constructor so the per-shard loss code sees a fixed choice.
+
+    Currently "auto" -> "associative" everywhere: the Pallas kernel
+    (ops/pallas_scan.py) is opt-in (``scan_impl=pallas``) until its Mosaic
+    lowering has been validated on a real chip — the only TPU reachable
+    while this was written was down (see .claude/skills/verify gotchas), and
+    defaulting an unvalidated kernel into every TPU run would put bench.py
+    at risk. Flip to mesh-platform dispatch after on-chip validation."""
+    if config.scan_impl != "auto":
+        return config
+    del mesh
+    return config.replace(scan_impl="associative")
+
+
 def _algo_loss(
     config: Config, apply_fn, params, rollout: Rollout,
     axis_name: str | None = None, dist=None,
@@ -86,7 +102,7 @@ def _algo_loss(
             logits_t, values_t, rollout.actions, rollout.rewards, discounts,
             jax.lax.stop_gradient(bootstrap_value),
             value_coef=config.value_coef, entropy_coef=config.entropy_coef,
-            dist=dist,
+            dist=dist, scan_impl=config.scan_impl,
         )
     if config.algo == "impala":
         return impala_loss(
@@ -94,7 +110,7 @@ def _algo_loss(
             rollout.rewards, discounts, jax.lax.stop_gradient(bootstrap_value),
             value_coef=config.value_coef, entropy_coef=config.entropy_coef,
             rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
-            dist=dist,
+            dist=dist, scan_impl=config.scan_impl,
         )
     if config.algo == "ppo":
         # Single-pass PPO over the fresh fragment (used when
@@ -103,6 +119,7 @@ def _algo_loss(
         adv = gae(
             rollout.rewards, discounts, jax.lax.stop_gradient(values_t),
             jax.lax.stop_gradient(bootstrap_value), config.gae_lambda,
+            scan_impl=config.scan_impl,
         )
         return ppo_loss(
             logits_t, values_t, rollout.actions, rollout.behaviour_logp,
@@ -139,6 +156,7 @@ def _ppo_multipass(
         jax.lax.stop_gradient(values_t),
         jax.lax.stop_gradient(bootstrap_value),
         config.gae_lambda,
+        scan_impl=config.scan_impl,
     )
 
     T, B = rollout.actions.shape[:2]
@@ -312,6 +330,7 @@ class Learner:
         model,
         mesh: Mesh,
     ):
+        config = resolve_scan_impl(config, mesh)
         self.config = config
         self.env = env
         self.model = model

@@ -27,6 +27,7 @@ from asyncrl_tpu.learn.learner import (
     _algo_loss,
     _ppo_multipass,
     make_optimizer,
+    resolve_scan_impl,
 )
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.parallel.mesh import DP_AXIS
@@ -82,6 +83,7 @@ class RolloutLearner:
     """
 
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
+        config = resolve_scan_impl(config, mesh)
         self.config = config
         self.spec = spec
         self.model = model

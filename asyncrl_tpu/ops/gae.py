@@ -31,10 +31,17 @@ def gae(
     values: jax.Array,
     bootstrap_value: jax.Array,
     gae_lambda: float = 0.95,
+    scan_impl: str = "associative",
 ) -> GAEOutput:
     values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
     deltas = rewards + discounts * values_tp1 - values
-    advantages = reverse_linear_scan(discounts * gae_lambda, deltas)
+    # Scan inputs stop-gradient'd (outputs are stop-gradient targets anyway;
+    # the Pallas impl defines no VJP, so tangents must not reach it).
+    advantages = reverse_linear_scan(
+        jax.lax.stop_gradient(discounts * gae_lambda),
+        jax.lax.stop_gradient(deltas),
+        impl=scan_impl,
+    )
     returns = advantages + values
     return GAEOutput(
         advantages=jax.lax.stop_gradient(advantages),
@@ -43,7 +50,10 @@ def gae(
 
 
 def n_step_returns(
-    rewards: jax.Array, discounts: jax.Array, bootstrap_value: jax.Array
+    rewards: jax.Array,
+    discounts: jax.Array,
+    bootstrap_value: jax.Array,
+    scan_impl: str = "associative",
 ) -> jax.Array:
     """Discounted n-step returns across the whole fragment (A3C targets,
     cf. the A3C paper's t_max-step returns — PAPERS.md:8): the lambda=1,
@@ -53,4 +63,10 @@ def n_step_returns(
     rewards_ext = jnp.concatenate(
         [rewards[:-1], (rewards[-1] + discounts[-1] * bootstrap_value)[None]], axis=0
     )
-    return reverse_linear_scan(discounts, rewards_ext)
+    # Inputs stop-gradient'd: the caller treats R_t as a fixed target, and
+    # the Pallas impl defines no VJP.
+    return reverse_linear_scan(
+        jax.lax.stop_gradient(discounts),
+        jax.lax.stop_gradient(rewards_ext),
+        impl=scan_impl,
+    )

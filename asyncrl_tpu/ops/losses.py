@@ -37,6 +37,7 @@ def a3c_loss(
     value_coef: float = 0.5,
     entropy_coef: float = 0.01,
     dist=None,
+    scan_impl: str = "associative",
 ):
     """n-step-return actor-critic loss (A3C, PAPERS.md:8).
 
@@ -44,7 +45,7 @@ def a3c_loss(
     V(x_T); advantage = R_t - V_t with stop-gradient on the target.
     """
     returns = jax.lax.stop_gradient(
-        n_step_returns(rewards, discounts, bootstrap_value)
+        n_step_returns(rewards, discounts, bootstrap_value, scan_impl=scan_impl)
     )
     advantages = returns - values
     logp = dist.logp(logits, actions) if dist else categorical_logp(logits, actions)
@@ -74,6 +75,7 @@ def impala_loss(
     rho_clip: float = 1.0,
     c_clip: float = 1.0,
     dist=None,
+    scan_impl: str = "associative",
 ):
     """IMPALA: V-trace corrected policy gradient + value + entropy
     (BASELINE.json:5 'V-trace correction + policy-gradient/value loss')."""
@@ -87,6 +89,7 @@ def impala_loss(
         bootstrap_value=bootstrap_value,
         rho_clip=rho_clip,
         c_clip=c_clip,
+        scan_impl=scan_impl,
     )
     pg_loss = -jnp.mean(target_logp * vt.pg_advantages)
     value_loss = 0.5 * jnp.mean(jnp.square(vt.vs - values))

@@ -1,0 +1,115 @@
+"""Pallas TPU kernel for the reverse affine time scan (V-trace/GAE core).
+
+The recurrence x_t = b_t + a_t * x_{t+1} (x_T = 0) is the single hot
+non-matmul op in every learner update (``ops/scan.py``). The default
+implementation is ``lax.associative_scan`` — O(log T) depth, but each of the
+log2(T) combine rounds materializes full [T, B] intermediates, so for long
+fragments (the long-horizon workloads of SURVEY.md §5.7) it is HBM-bound:
+~2·log2(T) round trips of the whole fragment.
+
+This kernel instead keeps [T, block_b] tiles resident in VMEM and walks the
+time axis once, sequentially, with one fused VPU multiply-add per row — HBM
+traffic is exactly one read of (a, b) and one write of x. The batch axis is
+the embarrassingly parallel grid dimension. Three tiles (a, b, out) are live
+at once and Pallas double-buffers across grid steps, so the wrapper sizes
+``block_b`` to keep ~6 tiles within half the ~16 MB VMEM, shrinking the
+batch block as T grows.
+
+Gradient note: every call site (vtrace, gae, n_step_returns) applies
+stop_gradient to the scan's INPUTS — their outputs are fixed targets by
+construction — so no custom VJP is defined; differentiating through this
+kernel raises, which is the correct loud failure if a future loss forgets
+the stop (covered by tests/test_pallas_scan.py grad tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# f32 tiling: sublane multiple of 8, lane multiple of 128.
+_SUBLANE = 8
+_LANE = 128
+
+
+def _scan_kernel(a_ref, b_ref, out_ref):
+    """Sequential reverse walk over the time (sublane) axis, one VPU
+    multiply-add per row; the whole [T, block_b] tile lives in VMEM."""
+    T = a_ref.shape[0]
+
+    def body(i, carry):
+        t = T - 1 - i
+        x = b_ref[pl.ds(t, 1), :] + a_ref[pl.ds(t, 1), :] * carry
+        out_ref[pl.ds(t, 1), :] = x
+        return x
+
+    # Zero carry built FROM the input (not jnp.zeros) so it inherits the
+    # input's varying-mesh-axes under shard_map's interpret-mode vma checks.
+    zero = a_ref[pl.ds(0, 1), :] * 0.0
+    jax.lax.fori_loop(0, T, body, zero)
+
+
+def _round_up(n: int, mult: int) -> int:
+    return (n + mult - 1) // mult * mult
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
+def reverse_linear_scan_pallas(
+    a: jax.Array,
+    b: jax.Array,
+    block_b: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Solve x_t = b_t + a_t * x_{t+1}, x_T = 0, on the TPU VPU.
+
+    ``a``/``b`` are time-major [T, ...]; trailing dims are flattened into
+    the batch (lane) axis and restored. Zero-padding is used to reach the
+    f32 tile grid (padded tail rows have a=b=0, which correctly injects the
+    x_T = 0 boundary into the real region). ``interpret=True`` runs the
+    kernel in the Pallas interpreter (CPU CI — SURVEY.md §4).
+    """
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch: {a.shape} vs {b.shape}")
+    orig_shape = a.shape
+    T = a.shape[0]
+    a2 = a.reshape(T, -1).astype(jnp.float32)
+    b2 = b.reshape(T, -1).astype(jnp.float32)
+    B = a2.shape[1]
+
+    T_pad = _round_up(T, _SUBLANE)
+    # VMEM budget: three live tiles (a, b, out) plus Pallas's cross-grid-step
+    # double buffering — size the batch block so 6 * T_pad * block * 4B stays
+    # within ~8 MB of the ~16 MB VMEM, shrinking block as T grows instead of
+    # overflowing on long fragments.
+    budget_elems = (8 * 1024 * 1024) // (6 * 4)
+    fit_b = max(_LANE, (budget_elems // T_pad) // _LANE * _LANE)
+    block = min(block_b, fit_b, _round_up(B, _LANE))
+    B_pad = _round_up(B, block)
+    a2 = jnp.pad(a2, ((0, T_pad - T), (0, B_pad - B)))
+    b2 = jnp.pad(b2, ((0, T_pad - T), (0, B_pad - B)))
+
+    # Under shard_map's vma tracking (jax>=0.8) the kernel output must
+    # declare which mesh axes it varies over — it varies exactly as its
+    # inputs do (the scan is pointwise in the batch/shard axes).
+    vma = getattr(jax.typeof(a2), "vma", frozenset()) | getattr(
+        jax.typeof(b2), "vma", frozenset()
+    )
+    out = pl.pallas_call(
+        _scan_kernel,
+        grid=(B_pad // block,),
+        in_specs=[
+            pl.BlockSpec((T_pad, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((T_pad, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (T_pad, block), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((T_pad, B_pad), jnp.float32, vma=vma),
+        interpret=interpret,
+    )(a2, b2)
+
+    return out[:T, :B].reshape(orig_shape).astype(a.dtype)

@@ -32,18 +32,42 @@ def combine(
     return a_r * a_l, b_r + a_r * b_l
 
 
-def reverse_linear_scan(a: jax.Array, b: jax.Array) -> jax.Array:
+def reverse_linear_scan(
+    a: jax.Array, b: jax.Array, impl: str = "associative"
+) -> jax.Array:
     """Solve x_t = b_t + a_t * x_{t+1} with x_{T} = 0, for t = T-1..0.
 
     Args:
       a, b: [T, ...] coefficient arrays (time-major).
+      impl: "associative" (default — ``lax.associative_scan``, O(log T)
+        depth, portable), "pallas" (TPU VMEM-resident single-pass kernel,
+        ``ops/pallas_scan.py`` — minimal HBM traffic, TPU only),
+        "pallas_interpret" (same kernel in the Pallas interpreter, for CPU
+        CI), or "sequential" (O(T) ``lax.scan`` reference).
     Returns:
       x: [T, ...] solutions.
 
-    Implemented with ``associative_scan`` over reversed time. Identity
-    element is (1, 0); the scan's prefix combine of reversed elements yields
-    exactly the suffix recurrence.
+    The associative form: identity element is (1, 0); the scan's prefix
+    combine of reversed elements yields exactly the suffix recurrence.
     """
+    if impl == "pallas" or impl == "pallas_interpret":
+        from asyncrl_tpu.ops.pallas_scan import reverse_linear_scan_pallas
+
+        return reverse_linear_scan_pallas(
+            a, b, interpret=impl == "pallas_interpret"
+        )
+    if impl == "sequential":
+        return reverse_linear_scan_sequential(a, b)
+    if impl == "auto":
+        # Callers going through a Learner get "auto" resolved against the
+        # mesh (learn.learner.resolve_scan_impl); direct ops-level callers
+        # fall back to the portable default here.
+        impl = "associative"
+    if impl != "associative":
+        raise ValueError(
+            f"unknown scan impl {impl!r}; expected "
+            "associative|pallas|pallas_interpret|sequential"
+        )
     a_rev = jnp.flip(a, axis=0)
     b_rev = jnp.flip(b, axis=0)
     _, x_rev = jax.lax.associative_scan(combine, (a_rev, b_rev), axis=0)

@@ -45,6 +45,7 @@ def vtrace(
     bootstrap_value: jax.Array,
     rho_clip: float = 1.0,
     c_clip: float = 1.0,
+    scan_impl: str = "associative",
 ) -> VTraceOutput:
     """Compute V-trace targets and advantages.
 
@@ -70,8 +71,15 @@ def vtrace(
     values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
     deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
 
-    # vs_t - V_t = delta_t + gamma_t c_t (vs_{t+1} - V_{t+1})
-    vs_minus_v = reverse_linear_scan(discounts * clipped_cs, deltas)
+    # vs_t - V_t = delta_t + gamma_t c_t (vs_{t+1} - V_{t+1}).
+    # The scan's INPUTS are stop-gradient'd (not just the outputs below):
+    # semantics-preserving since vs/pg_advantages are stop-gradient targets
+    # anyway, and required for the Pallas impl, which defines no VJP.
+    vs_minus_v = reverse_linear_scan(
+        jax.lax.stop_gradient(discounts * clipped_cs),
+        jax.lax.stop_gradient(deltas),
+        impl=scan_impl,
+    )
     vs = vs_minus_v + values
 
     vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)

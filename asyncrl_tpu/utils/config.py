@@ -83,6 +83,12 @@ class Config:
     checkpoint_every: int = 0
     checkpoint_dir: str = ""
     precision: str = "bf16_matmul"  # "f32" | "bf16_matmul"
+    # V-trace/GAE reverse-scan implementation (ops/scan.py). "auto"
+    # currently resolves to "associative" everywhere (see
+    # learn.learner.resolve_scan_impl — the Pallas VMEM kernel stays opt-in
+    # until validated on a real chip); force "pallas" to use the kernel on
+    # TPU, or "pallas_interpret" | "sequential" for debugging.
+    scan_impl: str = "auto"
     # Donate the TrainState into the compiled step. Off by default: the
     # experimental axon PJRT plugin (the one real chip available here)
     # returns INVALID_ARGUMENT when the full train step's donation/aliasing

@@ -1,0 +1,212 @@
+"""Pallas reverse-affine-scan kernel (ops/pallas_scan.py) vs the associative
+and sequential references — run in the Pallas interpreter on CPU (SURVEY.md
+§4 'distributed without a cluster' applies to kernels too: CI needs no TPU).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu.ops.pallas_scan import reverse_linear_scan_pallas
+from asyncrl_tpu.ops.scan import (
+    reverse_linear_scan,
+    reverse_linear_scan_sequential,
+)
+
+
+@pytest.mark.parametrize(
+    "T,B",
+    [(5, 3), (8, 128), (32, 256), (100, 7), (128, 640), (1, 1)],
+)
+def test_pallas_matches_references(T, B):
+    key = jax.random.PRNGKey(T * 1000 + B)
+    ka, kb = jax.random.split(key)
+    a = jax.random.uniform(ka, (T, B), jnp.float32, 0.0, 1.0)
+    b = jax.random.normal(kb, (T, B), jnp.float32)
+
+    want_seq = reverse_linear_scan_sequential(a, b)
+    want_assoc = reverse_linear_scan(a, b)
+    got = reverse_linear_scan_pallas(a, b, interpret=True)
+
+    np.testing.assert_allclose(got, want_seq, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got, want_assoc, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_trailing_dims_flatten():
+    key = jax.random.PRNGKey(0)
+    ka, kb = jax.random.split(key)
+    a = jax.random.uniform(ka, (16, 4, 5), jnp.float32, 0.0, 1.0)
+    b = jax.random.normal(kb, (16, 4, 5), jnp.float32)
+    got = reverse_linear_scan_pallas(a, b, interpret=True)
+    want = reverse_linear_scan(a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_grid_tiles_batch():
+    """B larger than block_b exercises the grid dimension."""
+    key = jax.random.PRNGKey(7)
+    ka, kb = jax.random.split(key)
+    a = jax.random.uniform(ka, (24, 1000), jnp.float32, 0.0, 1.0)
+    b = jax.random.normal(kb, (24, 1000), jnp.float32)
+    got = reverse_linear_scan_pallas(a, b, block_b=256, interpret=True)
+    want = reverse_linear_scan(a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_scan_dispatch_impls_agree():
+    key = jax.random.PRNGKey(3)
+    ka, kb = jax.random.split(key)
+    a = jax.random.uniform(ka, (20, 33), jnp.float32, 0.0, 1.0)
+    b = jax.random.normal(kb, (20, 33), jnp.float32)
+    assoc = reverse_linear_scan(a, b, impl="associative")
+    seq = reverse_linear_scan(a, b, impl="sequential")
+    pall = reverse_linear_scan(a, b, impl="pallas_interpret")
+    np.testing.assert_allclose(assoc, seq, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(pall, seq, rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="unknown scan impl"):
+        reverse_linear_scan(a, b, impl="nope")
+
+
+def test_vtrace_with_pallas_scan_matches_default():
+    from asyncrl_tpu.ops.vtrace import vtrace
+
+    T, B = 16, 12
+    key = jax.random.PRNGKey(11)
+    ks = jax.random.split(key, 5)
+    kwargs = dict(
+        behaviour_logp=jax.random.normal(ks[0], (T, B)) * 0.1 - 1.0,
+        target_logp=jax.random.normal(ks[1], (T, B)) * 0.1 - 1.0,
+        rewards=jax.random.normal(ks[2], (T, B)),
+        discounts=jnp.full((T, B), 0.99)
+        * (jax.random.uniform(ks[3], (T, B)) > 0.1),
+        values=jax.random.normal(ks[4], (T, B)),
+        bootstrap_value=jnp.zeros((B,)),
+    )
+    default = vtrace(**kwargs)
+    pallas = vtrace(**kwargs, scan_impl="pallas_interpret")
+    np.testing.assert_allclose(pallas.vs, default.vs, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        pallas.pg_advantages, default.pg_advantages, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_kernel_inside_shard_map(devices):
+    """The kernel runs inside a shard_map'd computation over the dp mesh —
+    the context the learner update places it in. (The Pallas INTERPRETER
+    needs check_vma=False under shard_map — a known interpreter rough edge;
+    the compiled Mosaic path on real TPUs declares its vma via out_shape.)"""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices), ("dp",))
+    T, B = 16, 64
+    key = jax.random.PRNGKey(5)
+    ka, kb = jax.random.split(key)
+    a = jax.random.uniform(ka, (T, B), jnp.float32, 0.0, 1.0)
+    b = jax.random.normal(kb, (T, B), jnp.float32)
+
+    def body(a_sh, b_sh):
+        return reverse_linear_scan_pallas(a_sh, b_sh, interpret=True)
+
+    got = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(None, "dp"), P(None, "dp")),
+            out_specs=P(None, "dp"),
+            check_vma=False,
+        )
+    )(a, b)
+    want = reverse_linear_scan(a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_vtrace_fixture_with_pallas():
+    """The IMPALA-paper recurrence fixture also holds under the kernel."""
+    T, B = 6, 2
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.uniform(0.0, 1.0, (T, B)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    # Hand-rolled reverse recurrence in numpy.
+    want = np.zeros((T, B), np.float32)
+    carry = np.zeros((B,), np.float32)
+    for t in range(T - 1, -1, -1):
+        carry = np.asarray(b)[t] + np.asarray(a)[t] * carry
+        want[t] = carry
+    got = reverse_linear_scan_pallas(a, b, interpret=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_grad_through_losses_with_pallas_scan():
+    """jax.grad through every loss family must work with the Pallas scan:
+    scan INPUTS are stop-gradient'd at the call sites (the kernel has no
+    VJP, so a forgotten stop would raise at trace time — this is the
+    regression test for exactly that failure)."""
+    from asyncrl_tpu.ops.losses import a3c_loss, impala_loss
+
+    T, B, A = 8, 4, 3
+    key = jax.random.PRNGKey(2)
+    ks = jax.random.split(key, 4)
+    logits = jax.random.normal(ks[0], (T, B, A))
+    values = jax.random.normal(ks[1], (T, B))
+    actions = jax.random.randint(ks[2], (T, B), 0, A)
+    rewards = jax.random.normal(ks[3], (T, B))
+    discounts = jnp.full((T, B), 0.99)
+    bootstrap = jnp.zeros((B,))
+
+    def loss_impala(params):
+        loss, _ = impala_loss(
+            logits * params, values * params, actions,
+            behaviour_logp=jnp.full((T, B), -1.0),
+            rewards=rewards, discounts=discounts, bootstrap_value=bootstrap,
+            scan_impl="pallas_interpret",
+        )
+        return loss
+
+    def loss_a3c(params):
+        loss, _ = a3c_loss(
+            logits * params, values * params, actions, rewards, discounts,
+            bootstrap, scan_impl="pallas_interpret",
+        )
+        return loss
+
+    g1 = jax.grad(loss_impala)(jnp.float32(1.0))
+    g2 = jax.grad(loss_a3c)(jnp.float32(1.0))
+    assert np.isfinite(float(g1)) and np.isfinite(float(g2))
+
+    # And the gradients must equal the associative-scan gradients.
+    def loss_impala_assoc(params):
+        loss, _ = impala_loss(
+            logits * params, values * params, actions,
+            behaviour_logp=jnp.full((T, B), -1.0),
+            rewards=rewards, discounts=discounts, bootstrap_value=bootstrap,
+            scan_impl="associative",
+        )
+        return loss
+
+    np.testing.assert_allclose(
+        float(g1), float(jax.grad(loss_impala_assoc)(jnp.float32(1.0))),
+        rtol=1e-5,
+    )
+
+
+def test_long_fragment_block_sizing():
+    """T=2048 must shrink the batch block instead of overflowing VMEM; the
+    result still matches the reference."""
+    key = jax.random.PRNGKey(9)
+    ka, kb = jax.random.split(key)
+    a = jax.random.uniform(ka, (2048, 256), jnp.float32, 0.0, 1.0)
+    b = jax.random.normal(kb, (2048, 256), jnp.float32)
+    got = reverse_linear_scan_pallas(a, b, interpret=True)
+    want = reverse_linear_scan(a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_auto_resolution_is_concrete():
+    from asyncrl_tpu.api.trainer import Trainer
+    from asyncrl_tpu.utils.config import Config
+
+    t = Trainer(
+        Config(env_id="CartPole-v1", num_envs=8, unroll_len=4, precision="f32")
+    )
+    assert t.learner.config.scan_impl in ("associative", "pallas")
