@@ -25,7 +25,7 @@ import numpy as np
 from asyncrl_tpu.learn.rollout_learner import LearnerState, RolloutLearner
 from asyncrl_tpu.models.networks import build_model
 from asyncrl_tpu.ops import distributions
-from asyncrl_tpu.parallel.mesh import make_mesh
+from asyncrl_tpu.parallel.mesh import dp_size, make_mesh
 from asyncrl_tpu.rollout.sebulba import (
     ActorThread,
     Fragment,
@@ -67,7 +67,7 @@ class SebulbaTrainer:
         # Eager geometry validation, mirroring the Anakin Learner: fail at
         # construction, not with a cryptic sharding error mid-train after
         # actor threads have already started.
-        dp = self.mesh.shape["dp"]
+        dp = dp_size(self.mesh)
         if self._envs_per_actor % dp:
             raise ValueError(
                 f"num_envs/actor_threads={self._envs_per_actor} not "

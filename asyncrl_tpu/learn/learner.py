@@ -25,7 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from asyncrl_tpu.envs.core import Environment
 from asyncrl_tpu.ops.gae import gae
 from asyncrl_tpu.ops.losses import a3c_loss, impala_loss, ppo_loss
-from asyncrl_tpu.parallel.mesh import DP_AXIS
+from asyncrl_tpu.parallel.mesh import DP_AXIS, dp_axes, dp_size
 from asyncrl_tpu.rollout.anakin import ActorState, actor_init, unroll
 from asyncrl_tpu.rollout.buffer import Rollout
 from asyncrl_tpu.utils.config import Config
@@ -47,14 +47,16 @@ class TrainState:
     update_step: jax.Array  # int32 scalar
 
 
-def state_partition_spec() -> TrainState:
+def state_partition_spec(axes: tuple[str, ...]) -> TrainState:
     """Pytree-prefix PartitionSpecs for shard_map in/out_specs: params and
-    optimizer replicated, actor state sharded on its leading env dim."""
+    optimizer replicated, actor state sharded on its leading env dim over
+    all data-parallel axes (one axis on a single slice, (dcn, dp) on a
+    hybrid multi-slice mesh)."""
     return TrainState(
         params=P(),
         actor_params=P(),
         opt_state=P(),
-        actor=P(DP_AXIS),
+        actor=P(axes),
         update_step=P(),
     )
 
@@ -134,7 +136,10 @@ def _algo_loss(
 def _ppo_multipass(
     config: Config, apply_fn, optimizer, dist, params, opt_state,
     rollout: Rollout, update_step: jax.Array,
+    axes: tuple[str, ...] = (),
 ):
+    if not axes:
+        raise ValueError("axes is required (pass dp_axes(mesh))")
     """PPO's real update: ``ppo_epochs`` passes over the fragment, each a
     scan of ``ppo_minibatches`` shuffled minibatch Adam steps (the reference's
     Procgen PPO config, BASELINE.json:10).
@@ -179,7 +184,7 @@ def _ppo_multipass(
     base_key = jax.random.fold_in(
         jax.random.PRNGKey(config.seed + 0x5EB), update_step
     )
-    base_key = jax.random.fold_in(base_key, jax.lax.axis_index(DP_AXIS))
+    base_key = jax.random.fold_in(base_key, jax.lax.axis_index(axes))
 
     def minibatch_step(carry, batch):
         params, opt_state = carry
@@ -190,10 +195,10 @@ def _ppo_multipass(
                 logits, values, batch["actions"], batch["behaviour_logp"],
                 batch["advantages"], batch["returns"],
                 clip_eps=config.ppo_clip_eps, value_coef=config.value_coef,
-                entropy_coef=config.entropy_coef, axis_name=DP_AXIS, dist=dist,
+                entropy_coef=config.entropy_coef, axis_name=axes, dist=dist,
             )
             metrics = dict(metrics, loss=loss)
-            return loss / jax.lax.axis_size(DP_AXIS), metrics
+            return loss / jax.lax.axis_size(axes), metrics
 
         grads, metrics = jax.grad(scaled_loss, has_aux=True)(params)
         metrics["grad_norm"] = optax.global_norm(grads)
@@ -224,6 +229,7 @@ def make_train_step(
     env: Environment,
     apply_fn: Callable,
     optimizer: optax.GradientTransformation,
+    mesh: Mesh,
 ) -> Callable[[TrainState], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the per-shard train-step body (to be wrapped in shard_map)."""
     from asyncrl_tpu.ops import distributions
@@ -235,6 +241,8 @@ def make_train_step(
     ppo_multipass = config.algo == "ppo" and (
         config.ppo_epochs > 1 or config.ppo_minibatches > 1
     )
+
+    axes = dp_axes(mesh)
 
     def train_step(state: TrainState):
         # named_scope: sections show up as labeled blocks in jax.profiler
@@ -250,6 +258,7 @@ def make_train_step(
                 params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
                     config, apply_fn, optimizer, dist,
                     state.params, state.opt_state, rollout, state.update_step,
+                    axes=axes,
                 )
         else:
             # shard_map autodiff semantics (jax>=0.8 vma tracking): the
@@ -262,9 +271,9 @@ def make_train_step(
             # 8-device CPU mesh, tests/test_learner).
             def scaled_loss(p):
                 loss, metrics = _algo_loss(
-                    config, apply_fn, p, rollout, axis_name=DP_AXIS, dist=dist
+                    config, apply_fn, p, rollout, axis_name=axes, dist=dist
                 )
-                return loss / jax.lax.axis_size(DP_AXIS), (loss, metrics)
+                return loss / jax.lax.axis_size(axes), (loss, metrics)
 
             with jax.named_scope("loss_and_grad"):
                 (_, (loss, metrics)), grads = jax.value_and_grad(
@@ -277,8 +286,8 @@ def make_train_step(
                 )
                 params = optax.apply_updates(state.params, updates)
 
-        metrics = jax.lax.pmean(metrics, DP_AXIS)
-        loss = jax.lax.pmean(loss, DP_AXIS)
+        metrics = jax.lax.pmean(metrics, axes)
+        loss = jax.lax.pmean(loss, axes)
 
         step = state.update_step + 1
         if config.algo == "impala" and config.actor_staleness > 1:
@@ -297,12 +306,12 @@ def make_train_step(
         metrics["loss"] = loss
         metrics["grad_norm"] = grad_norm
         metrics["episode_return_sum"] = jax.lax.psum(
-            stats.completed_return_sum, DP_AXIS
+            stats.completed_return_sum, axes
         )
         metrics["episode_length_sum"] = jax.lax.psum(
-            stats.completed_length_sum, DP_AXIS
+            stats.completed_length_sum, axes
         )
-        metrics["episode_count"] = jax.lax.psum(stats.completed_count, DP_AXIS)
+        metrics["episode_count"] = jax.lax.psum(stats.completed_count, axes)
 
         new_state = TrainState(
             params=params,
@@ -338,7 +347,7 @@ class Learner:
         self.optimizer = make_optimizer(config)
 
         # Eager geometry validation (clearer than a trace-time failure).
-        dp = mesh.shape[DP_AXIS]
+        dp = dp_size(mesh)
         if config.num_envs % dp:
             raise ValueError(
                 f"num_envs={config.num_envs} not divisible by dp={dp}"
@@ -353,8 +362,8 @@ class Learner:
                     f"by ppo_minibatches={config.ppo_minibatches}"
                 )
 
-        spec = state_partition_spec()
-        body = make_train_step(config, env, model.apply, self.optimizer)
+        spec = state_partition_spec(dp_axes(mesh))
+        body = make_train_step(config, env, model.apply, self.optimizer, mesh)
         self._step = jax.jit(
             jax.shard_map(
                 body, mesh=mesh, in_specs=(spec,), out_specs=(spec, P())
@@ -365,9 +374,10 @@ class Learner:
     def init_state(self, seed: int) -> TrainState:
         """Build the initial TrainState with proper shardings."""
         cfg = self.config
-        if cfg.num_envs % self.mesh.shape[DP_AXIS]:
+        dp = dp_size(self.mesh)
+        if cfg.num_envs % dp:
             raise ValueError(
-                f"num_envs={cfg.num_envs} not divisible by dp={self.mesh.shape[DP_AXIS]}"
+                f"num_envs={cfg.num_envs} not divisible by dp={dp}"
             )
         key = jax.random.PRNGKey(seed)
         pkey, akey = jax.random.split(key)
@@ -378,18 +388,19 @@ class Learner:
 
         # Per-device actor init inside shard_map so env states are born
         # sharded (no host-side giant arrays for big env batches).
-        local_envs = cfg.num_envs // self.mesh.shape[DP_AXIS]
+        local_envs = cfg.num_envs // dp
+        axes = dp_axes(self.mesh)
 
         def shard_actor_init(keys):
             return actor_init(self.env, local_envs, keys[0])
 
-        per_device_keys = jax.random.split(akey, self.mesh.shape[DP_AXIS])
+        per_device_keys = jax.random.split(akey, dp)
         actor = jax.jit(
             jax.shard_map(
                 shard_actor_init,
                 mesh=self.mesh,
-                in_specs=(P(DP_AXIS),),
-                out_specs=P(DP_AXIS),
+                in_specs=(P(axes),),
+                out_specs=P(axes),
             )
         )(per_device_keys)
 

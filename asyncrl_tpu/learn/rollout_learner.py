@@ -30,7 +30,7 @@ from asyncrl_tpu.learn.learner import (
     resolve_scan_impl,
 )
 from asyncrl_tpu.ops import distributions
-from asyncrl_tpu.parallel.mesh import DP_AXIS
+from asyncrl_tpu.parallel.mesh import dp_axes
 from asyncrl_tpu.rollout.buffer import Rollout
 from asyncrl_tpu.utils.config import Config
 
@@ -53,16 +53,17 @@ def learner_state_spec() -> LearnerState:
     return LearnerState(params=P(), opt_state=P(), update_step=P())
 
 
-def rollout_partition_spec() -> Rollout:
-    """Time-major [T, B, ...] fragments, batch dim sharded over dp."""
+def rollout_partition_spec(axes: tuple[str, ...]) -> Rollout:
+    """Time-major [T, B, ...] fragments, batch dim sharded over all
+    data-parallel axes."""
     return Rollout(
-        obs=P(None, DP_AXIS),
-        actions=P(None, DP_AXIS),
-        behaviour_logp=P(None, DP_AXIS),
-        rewards=P(None, DP_AXIS),
-        terminated=P(None, DP_AXIS),
-        truncated=P(None, DP_AXIS),
-        bootstrap_obs=P(DP_AXIS),
+        obs=P(None, axes),
+        actions=P(None, axes),
+        behaviour_logp=P(None, axes),
+        rewards=P(None, axes),
+        terminated=P(None, axes),
+        truncated=P(None, axes),
+        bootstrap_obs=P(axes),
     )
 
 
@@ -70,7 +71,7 @@ def rollout_sharding(mesh: Mesh) -> Rollout:
     """NamedShardings for ``jax.device_put`` of a host fragment."""
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        rollout_partition_spec(),
+        rollout_partition_spec(dp_axes(mesh)),
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -97,11 +98,14 @@ class RolloutLearner:
         apply_fn = model.apply
         optimizer = self.optimizer
 
+        axes = dp_axes(mesh)
+
         def update_body(state: LearnerState, rollout: Rollout):
             if ppo_multipass:
                 params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
                     config, apply_fn, optimizer, dist,
                     state.params, state.opt_state, rollout, state.update_step,
+                    axes=axes,
                 )
             else:
                 # Same implicit-psum gradient scaling as the Anakin step:
@@ -110,9 +114,9 @@ class RolloutLearner:
                 def scaled_loss(p):
                     loss, metrics = _algo_loss(
                         config, apply_fn, p, rollout,
-                        axis_name=DP_AXIS, dist=dist,
+                        axis_name=axes, dist=dist,
                     )
-                    return loss / jax.lax.axis_size(DP_AXIS), (loss, metrics)
+                    return loss / jax.lax.axis_size(axes), (loss, metrics)
 
                 (_, (loss, metrics)), grads = jax.value_and_grad(
                     scaled_loss, has_aux=True
@@ -123,8 +127,8 @@ class RolloutLearner:
                 )
                 params = optax.apply_updates(state.params, updates)
 
-            metrics = dict(jax.lax.pmean(metrics, DP_AXIS))
-            metrics["loss"] = jax.lax.pmean(loss, DP_AXIS)
+            metrics = dict(jax.lax.pmean(metrics, axes))
+            metrics["loss"] = jax.lax.pmean(loss, axes)
             metrics["grad_norm"] = grad_norm
             new_state = LearnerState(
                 params=params,
@@ -143,7 +147,7 @@ class RolloutLearner:
             jax.shard_map(
                 update_body,
                 mesh=mesh,
-                in_specs=(sspec, rollout_partition_spec()),
+                in_specs=(sspec, rollout_partition_spec(axes)),
                 out_specs=(sspec, P()),
             ),
         )

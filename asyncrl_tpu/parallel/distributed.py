@@ -1,0 +1,60 @@
+"""Multi-host initialization and hybrid (DCN x ICI) mesh construction.
+
+The reference is single-host: its "distributed backend" is Python threads +
+queues (SURVEY.md §5.8a). The TPU-native counterpart scales the same trainer
+across hosts and pod slices with zero algorithm changes:
+
+1. every host calls :func:`initialize` (a thin ``jax.distributed`` wrapper)
+   before any JAX computation;
+2. :func:`make_hybrid_mesh` builds a ``Mesh`` with axes ``("dcn", "dp")`` —
+   the outer axis crosses slices over DCN, the inner axis stays within a
+   slice on ICI, so the compiler schedules the bandwidth-hungry part of
+   every gradient all-reduce on ICI (SURVEY.md §5.8b);
+3. the learners shard envs/batches and reduce gradients over ALL
+   data-parallel axes (``parallel.mesh.dp_axes``), so the exact same train
+   step runs on one chip, one slice, or many slices.
+
+Single-host multi-device falls back transparently (dcn axis of size 1).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+from asyncrl_tpu.parallel.mesh import DP_AXIS, make_mesh
+
+DCN_AXIS = "dcn"
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host JAX runtime (call once per host, before any
+    computation). On Cloud TPU all arguments are auto-detected from the
+    environment; pass them explicitly elsewhere (coordinator ``host:port``,
+    world size, this host's rank)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hybrid_mesh(
+    dcn_size: int | None = None, devices: list | None = None
+) -> Mesh:
+    """Mesh with axes ``(dcn, dp)``: ``dcn_size`` groups (default: one per
+    process/host) with the remaining device factor inside each group.
+
+    Device order: ``jax.devices()`` is sorted so that each process's local
+    devices are contiguous, which makes the leading reshape axis exactly the
+    host/slice boundary — DCN-adjacent groups land on the dcn axis, ICI
+    neighbours on dp, the layout SURVEY.md §5.8b prescribes.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if dcn_size is None:
+        dcn_size = max(jax.process_count(), 1)
+    return make_mesh((dcn_size, -1), (DCN_AXIS, DP_AXIS), devices=devices)

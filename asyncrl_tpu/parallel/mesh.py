@@ -48,14 +48,32 @@ def make_mesh(
     return Mesh(dev_array, mesh_axes)
 
 
+def dp_axes(mesh: Mesh) -> tuple[str, ...]:
+    """All data-parallel mesh axes: every axis except the reserved
+    model-parallel (tp) and time-sharding (sp) axes.
+
+    A single-slice mesh is ``("dp",)``; a multi-slice/multi-host hybrid mesh
+    is e.g. ``("dcn", "dp")`` with the inner, bandwidth-hungry axis on ICI
+    (SURVEY.md §5.8b). Env batches shard — and gradients all-reduce — over
+    the PRODUCT of these axes; collectives take the tuple directly
+    (``lax.pmean(x, ("dcn", "dp"))``)."""
+    return tuple(n for n in mesh.axis_names if n not in (TP_AXIS, TIME_AXIS))
+
+
+def dp_size(mesh: Mesh) -> int:
+    return math.prod(mesh.shape[a] for a in dp_axes(mesh))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
 def dp_sharded(mesh: Mesh) -> NamedSharding:
-    """Shard the leading (env/batch) dim over the dp axis."""
-    return NamedSharding(mesh, P(DP_AXIS))
+    """Shard the leading (env/batch) dim over ALL data-parallel axes."""
+    return NamedSharding(mesh, P(dp_axes(mesh)))
 
 
 def num_dp(mesh: Mesh) -> int:
-    return mesh.shape[DP_AXIS]
+    """Total data-parallel degree (product of all dp axes); alias of
+    :func:`dp_size`."""
+    return dp_size(mesh)
