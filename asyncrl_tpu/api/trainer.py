@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from asyncrl_tpu.envs import registry
 from asyncrl_tpu.learn.learner import Learner, TrainState
-from asyncrl_tpu.models.networks import build_model
+from asyncrl_tpu.models.networks import build_model, is_recurrent, reset_core
 from asyncrl_tpu.parallel.mesh import make_mesh
 from asyncrl_tpu.utils.config import Config
 
@@ -145,30 +145,38 @@ class Trainer:
             from asyncrl_tpu.ops import distributions
 
             env = self.env
+            model = self.model
             apply_fn = self.model.apply
             dist = distributions.for_spec(env.spec)
+            recurrent = is_recurrent(model)
 
             def eval_rollout(params, key):
                 init_keys = jax.random.split(key, num_episodes + 1)
                 env_state = jax.vmap(env.init)(init_keys[:-1])
                 obs = jax.vmap(env.observe)(env_state)
                 step_key = init_keys[-1]
+                core = model.initial_core(num_episodes) if recurrent else None
 
                 def body(carry, _):
-                    env_state, obs, ret, alive, k = carry
-                    dist_params, _ = apply_fn(params, obs)
+                    env_state, obs, ret, alive, k, core = carry
+                    if recurrent:
+                        dist_params, _, core = apply_fn(params, obs, core)
+                    else:
+                        dist_params, _ = apply_fn(params, obs)
                     actions = dist.mode(dist_params)
                     k, sub = jax.random.split(k)
                     step_keys = jax.random.split(sub, num_episodes)
                     env_state, ts = jax.vmap(env.step)(env_state, actions, step_keys)
+                    if recurrent:
+                        core = reset_core(core, ts.done)
                     ret = ret + ts.reward * alive
                     alive = alive * (1.0 - ts.done.astype(jnp.float32))
-                    return (env_state, ts.obs, ret, alive, k), None
+                    return (env_state, ts.obs, ret, alive, k, core), None
 
                 zeros = jnp.zeros((num_episodes,), jnp.float32)
-                (_, _, ret, _, _), _ = jax.lax.scan(
+                (_, _, ret, _, _, _), _ = jax.lax.scan(
                     body,
-                    (env_state, obs, zeros, zeros + 1.0, step_key),
+                    (env_state, obs, zeros, zeros + 1.0, step_key, core),
                     None,
                     length=max_steps,
                 )

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from asyncrl_tpu.envs.core import Environment
 from asyncrl_tpu.ops.gae import gae
+from asyncrl_tpu.models.networks import is_recurrent, reset_core
 from asyncrl_tpu.ops.losses import a3c_loss, impala_loss, ppo_loss
 from asyncrl_tpu.parallel.mesh import DP_AXIS, dp_axes, dp_size
 from asyncrl_tpu.rollout.anakin import ActorState, actor_init, unroll
@@ -84,6 +85,37 @@ def resolve_scan_impl(config: Config, mesh: Mesh) -> Config:
     return config.replace(scan_impl="associative")
 
 
+def _forward_fragment(apply_fn, params, rollout: Rollout):
+    """Learner forward over one fragment -> (dist_params, values), both
+    [T+1, ...] (final entry is the bootstrap step).
+
+    Feed-forward: one batched apply over the stacked [T+1, B] obs.
+    Recurrent (``rollout.init_core`` present): a ``lax.scan`` over time
+    carrying the core from the fragment-initial behaviour carry (IMPALA's
+    stale-core recipe) and resetting it at episode boundaries, exactly as
+    the actor did."""
+    if rollout.init_core is None:
+        obs_all = jnp.concatenate(
+            [rollout.obs, rollout.bootstrap_obs[None]], axis=0
+        )
+        return apply_fn(params, obs_all)
+
+    def fwd(core, inputs):
+        obs_t, done_t = inputs
+        dist_params, value, new_core = apply_fn(params, obs_t, core)
+        return reset_core(new_core, done_t), (dist_params, value)
+
+    core_end, (logits_t, values_t) = jax.lax.scan(
+        fwd, rollout.init_core, (rollout.obs, rollout.done)
+    )
+    boot_logits, boot_value, _ = apply_fn(
+        params, rollout.bootstrap_obs, core_end
+    )
+    logits = jnp.concatenate([logits_t, boot_logits[None]], axis=0)
+    values = jnp.concatenate([values_t, boot_value[None]], axis=0)
+    return logits, values
+
+
 def _algo_loss(
     config: Config, apply_fn, params, rollout: Rollout,
     axis_name: str | None = None, dist=None,
@@ -93,8 +125,7 @@ def _algo_loss(
     axis when called inside shard_map (for losses needing global batch
     moments, i.e. PPO advantage normalization). ``dist`` interprets the
     policy head (ops.distributions)."""
-    obs_all = jnp.concatenate([rollout.obs, rollout.bootstrap_obs[None]], axis=0)
-    logits, values = apply_fn(params, obs_all)
+    logits, values = _forward_fragment(apply_fn, params, rollout)
     logits_t, values_t = logits[:-1], values[:-1]
     bootstrap_value = values[-1]
     discounts = rollout.discounts(config.gamma)
@@ -138,8 +169,6 @@ def _ppo_multipass(
     rollout: Rollout, update_step: jax.Array,
     axes: tuple[str, ...] = (),
 ):
-    if not axes:
-        raise ValueError("axes is required (pass dp_axes(mesh))")
     """PPO's real update: ``ppo_epochs`` passes over the fragment, each a
     scan of ``ppo_minibatches`` shuffled minibatch Adam steps (the reference's
     Procgen PPO config, BASELINE.json:10).
@@ -152,6 +181,8 @@ def _ppo_multipass(
     psum over the dp axis, so every device applies identical parameter
     updates.
     """
+    if not axes:
+        raise ValueError("axes is required (pass dp_axes(mesh))")
     obs_all = jnp.concatenate([rollout.obs, rollout.bootstrap_obs[None]], axis=0)
     _, values_all = apply_fn(params, obs_all)
     values_t, bootstrap_value = values_all[:-1], values_all[-1]
@@ -347,6 +378,20 @@ class Learner:
         self.optimizer = make_optimizer(config)
 
         # Eager geometry validation (clearer than a trace-time failure).
+        if config.core == "lstm" and not is_recurrent(model):
+            raise ValueError(
+                "config.core='lstm' but the given model is not a "
+                "RecurrentActorCritic — pass a recurrent model or core='ff'"
+            )
+        if is_recurrent(model) and config.algo == "ppo" and (
+            config.ppo_epochs > 1 or config.ppo_minibatches > 1
+        ):
+            raise NotImplementedError(
+                "recurrent (core='lstm') policies are not supported with "
+                "multi-epoch/minibatched PPO (shuffled minibatches break "
+                "the temporal structure the core needs); use "
+                "ppo_epochs=ppo_minibatches=1, or algo='impala'/'a3c'"
+            )
         dp = dp_size(mesh)
         if config.num_envs % dp:
             raise ValueError(
@@ -383,7 +428,12 @@ class Learner:
         pkey, akey = jax.random.split(key)
 
         dummy_obs = jnp.zeros((1, *self.env.spec.obs_shape), self.env.spec.obs_dtype)
-        params = self.model.init(pkey, dummy_obs)
+        if is_recurrent(self.model):
+            params = self.model.init(
+                pkey, dummy_obs, self.model.initial_core(1)
+            )
+        else:
+            params = self.model.init(pkey, dummy_obs)
         opt_state = self.optimizer.init(params)
 
         # Per-device actor init inside shard_map so env states are born
@@ -392,7 +442,7 @@ class Learner:
         axes = dp_axes(self.mesh)
 
         def shard_actor_init(keys):
-            return actor_init(self.env, local_envs, keys[0])
+            return actor_init(self.env, local_envs, keys[0], model=self.model)
 
         per_device_keys = jax.random.split(akey, dp)
         actor = jax.jit(

@@ -84,6 +84,14 @@ class RolloutLearner:
     """
 
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
+        from asyncrl_tpu.models.networks import is_recurrent
+
+        if config.core != "ff" or is_recurrent(model):
+            raise NotImplementedError(
+                "recurrent policies (core='lstm') are only supported on the "
+                "Anakin backend (backend='tpu'): host actors don't record "
+                "core state in their fragments yet"
+            )
         config = resolve_scan_impl(config, mesh)
         self.config = config
         self.spec = spec

@@ -3,7 +3,19 @@ from asyncrl_tpu.models.networks import (
     ImpalaCNN,
     MLPTorso,
     NatureCNN,
+    RecurrentActorCritic,
     build_model,
+    is_recurrent,
+    reset_core,
 )
 
-__all__ = ["ActorCritic", "ImpalaCNN", "MLPTorso", "NatureCNN", "build_model"]
+__all__ = [
+    "ActorCritic",
+    "ImpalaCNN",
+    "MLPTorso",
+    "NatureCNN",
+    "RecurrentActorCritic",
+    "build_model",
+    "is_recurrent",
+    "reset_core",
+]

@@ -83,16 +83,50 @@ class ImpalaCNN(nn.Module):
         return x
 
 
-class ActorCritic(nn.Module):
-    """Shared-torso policy + value network.
+def _apply_torso(module: nn.Module, obs: jax.Array) -> jax.Array:
+    """Shared torso dispatch for the (Recurrent)ActorCritic modules; reads
+    the torso hyperparameters off ``module``."""
+    if module.torso == "mlp":
+        return MLPTorso(
+            module.hidden_sizes, module.compute_dtype, module.obs_rank
+        )(obs)
+    if module.torso == "nature_cnn":
+        return NatureCNN(module.compute_dtype)(obs)
+    if module.torso == "impala_cnn":
+        return ImpalaCNN(module.channels, module.compute_dtype)(obs)
+    raise ValueError(f"unknown torso {module.torso!r}")
 
-    ``__call__`` returns ``(dist_params, value)`` in float32 regardless of
-    compute dtype, so losses and V-trace stay full-precision. For discrete
-    envs ``dist_params`` are logits [..., A]; for continuous envs they are
-    concat(mean, log_std) [..., 2*D] with log_std a learned
-    state-independent bias (the standard continuous-PPO head) — interpreted
-    by ``ops.distributions``.
-    """
+
+def _apply_heads(
+    module: nn.Module, h: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Shared policy + value heads: returns ``(dist_params, value)`` in
+    float32 regardless of compute dtype, so losses and V-trace stay
+    full-precision. For discrete envs ``dist_params`` are logits [..., A];
+    for continuous envs concat(mean, log_std) [..., 2*D] with log_std a
+    learned state-independent bias (the standard continuous-PPO head) —
+    interpreted by ``ops.distributions``."""
+    if module.continuous:
+        mean = nn.Dense(
+            module.action_dim, dtype=jnp.float32, kernel_init=ORTHO(0.01)
+        )(h)
+        log_std = module.param(
+            "log_std", nn.initializers.zeros, (module.action_dim,), jnp.float32
+        )
+        dist_params = jnp.concatenate(
+            [mean, jnp.broadcast_to(log_std, mean.shape)], axis=-1
+        )
+    else:
+        dist_params = nn.Dense(
+            module.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01)
+        )(h)
+    value = nn.Dense(1, dtype=jnp.float32, kernel_init=ORTHO(1.0))(h)[..., 0]
+    return dist_params.astype(jnp.float32), value.astype(jnp.float32)
+
+
+class ActorCritic(nn.Module):
+    """Shared-torso policy + value network (see ``_apply_heads`` for the
+    head/output contract)."""
 
     num_actions: int
     torso: str = "mlp"  # "mlp" | "nature_cnn" | "impala_cnn"
@@ -105,38 +139,64 @@ class ActorCritic(nn.Module):
 
     @nn.compact
     def __call__(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
-        if self.torso == "mlp":
-            h = MLPTorso(self.hidden_sizes, self.compute_dtype, self.obs_rank)(obs)
-        elif self.torso == "nature_cnn":
-            h = NatureCNN(self.compute_dtype)(obs)
-        elif self.torso == "impala_cnn":
-            h = ImpalaCNN(self.channels, self.compute_dtype)(obs)
-        else:
-            raise ValueError(f"unknown torso {self.torso!r}")
-        if self.continuous:
-            mean = nn.Dense(
-                self.action_dim, dtype=jnp.float32, kernel_init=ORTHO(0.01)
-            )(h)
-            log_std = self.param(
-                "log_std", nn.initializers.zeros, (self.action_dim,), jnp.float32
-            )
-            dist_params = jnp.concatenate(
-                [mean, jnp.broadcast_to(log_std, mean.shape)], axis=-1
-            )
-        else:
-            dist_params = nn.Dense(
-                self.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01)
-            )(h)
-        value = nn.Dense(1, dtype=jnp.float32, kernel_init=ORTHO(1.0))(h)[..., 0]
-        return dist_params.astype(jnp.float32), value.astype(jnp.float32)
+        return _apply_heads(self, _apply_torso(self, obs))
 
 
-def build_model(config, env_spec) -> ActorCritic:
-    """Construct the ActorCritic matching a Config + EnvSpec."""
+class RecurrentActorCritic(nn.Module):
+    """Recurrent policy + value network: torso -> LSTM core -> heads.
+
+    The async-rl/A3C family's LSTM variant (the A3C paper's recurrent agent;
+    IMPALA's LSTM agent). TPU-idiomatic: the core state is an explicit
+    ``(c, h)`` pytree carried through the rollout ``lax.scan`` — the same
+    carry that holds env states — so the whole recurrent rollout stays one
+    fused XLA program. Call as ``apply(params, obs[B], core) ->
+    (dist_params, value, new_core)``; the CALLER resets the core where
+    episodes end (``reset_core``), keeping the cell itself stateless.
+    """
+
+    num_actions: int
+    torso: str = "mlp"
+    hidden_sizes: Sequence[int] = (64, 64)
+    channels: Sequence[int] = (16, 32, 32)
+    core_size: int = 256
+    compute_dtype: jnp.dtype = jnp.float32
+    obs_rank: int = 1
+    continuous: bool = False
+    action_dim: int = 0
+
+    @nn.compact
+    def __call__(self, obs, core):
+        h = _apply_torso(self, obs)
+        # LSTM math in f32: tiny vs the torso, and carries must not
+        # accumulate bf16 rounding across hundreds of steps.
+        cell = nn.OptimizedLSTMCell(self.core_size, dtype=jnp.float32)
+        core, h = cell(core, h.astype(jnp.float32))
+        dist_params, value = _apply_heads(self, h)
+        return dist_params, value, core
+
+    def initial_core(self, batch_size: int):
+        """Zero (c, h) carry for ``batch_size`` envs."""
+        zeros = jnp.zeros((batch_size, self.core_size), jnp.float32)
+        return (zeros, zeros)
+
+
+def reset_core(core, done):
+    """Zero the recurrent carry where ``done`` (episode boundary); ``done``
+    is [B] bool/float, core leaves are [B, H]."""
+    keep = 1.0 - done.astype(jnp.float32)
+    return jax.tree.map(lambda c: c * keep[:, None], core)
+
+
+def is_recurrent(model) -> bool:
+    return isinstance(model, RecurrentActorCritic)
+
+
+def build_model(config, env_spec):
+    """Construct the (Recurrent)ActorCritic matching a Config + EnvSpec."""
     compute_dtype = (
         jnp.bfloat16 if config.precision == "bf16_matmul" else jnp.float32
     )
-    return ActorCritic(
+    common = dict(
         num_actions=env_spec.num_actions,
         torso=config.torso,
         hidden_sizes=tuple(config.hidden_sizes),
@@ -146,3 +206,8 @@ def build_model(config, env_spec) -> ActorCritic:
         continuous=env_spec.continuous,
         action_dim=env_spec.action_dim,
     )
+    if config.core == "lstm":
+        return RecurrentActorCritic(core_size=config.core_size, **common)
+    if config.core != "ff":
+        raise ValueError(f"unknown core {config.core!r}; expected ff|lstm")
+    return ActorCritic(**common)

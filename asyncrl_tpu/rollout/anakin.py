@@ -21,33 +21,46 @@ import jax.numpy as jnp
 from flax import struct
 
 from asyncrl_tpu.envs.core import Environment
+from asyncrl_tpu.models.networks import is_recurrent, reset_core
 from asyncrl_tpu.rollout.buffer import EpisodeStats, Rollout
 
 
 @struct.dataclass
 class ActorState:
     """Carry for the rollout scan: env states + current obs + per-env PRNG
-    keys + running per-env episode accumulators (device-resident metrics)."""
+    keys + running per-env episode accumulators (device-resident metrics).
+    ``core`` is the policy's recurrent (c, h) carry for LSTM agents — None
+    for feed-forward policies (an empty pytree subtree, so all partition
+    specs apply unchanged)."""
 
     env_state: Any  # vmapped env-state pytree, leading dim B
     obs: jax.Array  # [B, *obs_shape]
     keys: jax.Array  # [B, 2] uint32 raw PRNG keys
     running_return: jax.Array  # [B] f32
     running_length: jax.Array  # [B] f32
+    core: Any = None  # recurrent policy carry, leading dim B
 
 
-def actor_init(env: Environment, num_envs: int, seed_key: jax.Array) -> ActorState:
+def actor_init(
+    env: Environment, num_envs: int, seed_key: jax.Array, model=None
+) -> ActorState:
     init_keys, carry_keys = jax.random.split(seed_key)
     env_keys = jax.random.split(init_keys, num_envs)
     env_state = jax.vmap(env.init)(env_keys)
     obs = jax.vmap(env.observe)(env_state)
     zeros = jnp.zeros((num_envs,), jnp.float32)
+    core = (
+        model.initial_core(num_envs)
+        if model is not None and is_recurrent(model)
+        else None
+    )
     return ActorState(
         env_state=env_state,
         obs=obs,
         keys=jax.random.split(carry_keys, num_envs),
         running_return=zeros,
         running_length=zeros,
+        core=core,
     )
 
 
@@ -73,15 +86,24 @@ def unroll(
 
         dist = distributions.for_spec(env.spec)
 
+    recurrent = actor_state.core is not None
+
     def step_fn(carry: ActorState, _):
         split = jax.vmap(lambda k: jax.random.split(k, 3))(carry.keys)  # [B,3,2]
         next_keys, act_keys, step_keys = split[:, 0], split[:, 1], split[:, 2]
 
-        dist_params, _ = apply_fn(params, carry.obs)
+        if recurrent:
+            dist_params, _, core = apply_fn(params, carry.obs, carry.core)
+        else:
+            dist_params, _ = apply_fn(params, carry.obs)
+            core = None
         actions = jax.vmap(dist.sample)(act_keys, dist_params)
         behaviour_logp = dist.logp(dist_params, actions)
 
         env_state, ts = jax.vmap(env.step)(carry.env_state, actions, step_keys)
+
+        if recurrent:
+            core = reset_core(core, ts.done)
 
         done_f = ts.done.astype(jnp.float32)
         ep_return = carry.running_return + ts.reward
@@ -92,6 +114,7 @@ def unroll(
             keys=next_keys,
             running_return=ep_return * (1.0 - done_f),
             running_length=ep_length * (1.0 - done_f),
+            core=core,
         )
         out = (
             carry.obs,
@@ -118,6 +141,9 @@ def unroll(
         terminated=terminated,
         truncated=truncated,
         bootstrap_obs=final_state.obs,
+        # Fragment-initial recurrent carry (behaviour policy's), for the
+        # learner's re-forward — the IMPALA "stale core state" recipe.
+        init_core=actor_state.core,
     )
     stats = EpisodeStats(
         completed_return_sum=jnp.sum(done_returns),

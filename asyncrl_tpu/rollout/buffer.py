@@ -10,6 +10,8 @@ queue, so all three backends feed an identical learner.
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -33,6 +35,10 @@ class Rollout:
     terminated: jax.Array  # [T, B] bool
     truncated: jax.Array  # [T, B] bool
     bootstrap_obs: jax.Array  # [B, *obs_shape]
+    # Recurrent policies only: the (c, h) carry at the fragment's first step
+    # (behaviour policy's), used by the learner to re-forward the fragment.
+    # None (empty subtree) for feed-forward policies.
+    init_core: Any = None
 
     @property
     def done(self) -> jax.Array:

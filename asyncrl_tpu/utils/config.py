@@ -37,6 +37,11 @@ class Config:
     torso: str = "mlp"  # "mlp" | "nature_cnn" | "impala_cnn"
     hidden_sizes: tuple[int, ...] = (64, 64)
     channels: tuple[int, ...] = (16, 32, 32)
+    # Recurrent core after the torso: "ff" (none) or "lstm" (the A3C/IMPALA
+    # LSTM-agent variant; tpu backend only). Core state rides the rollout
+    # scan carry and resets at episode boundaries.
+    core: str = "ff"
+    core_size: int = 256
 
     # --- optimization ---
     learning_rate: float = 3e-4

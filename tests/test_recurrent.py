@@ -1,0 +1,170 @@
+"""Recurrent (LSTM-core) policies — the async-rl family's A3C-LSTM /
+IMPALA-LSTM agent variant, Anakin backend (core rides the rollout scan)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu.api.trainer import Trainer
+from asyncrl_tpu.models.networks import (
+    RecurrentActorCritic,
+    build_model,
+    is_recurrent,
+    reset_core,
+)
+from asyncrl_tpu.utils.config import Config
+
+
+def lstm_cfg(**kw):
+    base = dict(
+        env_id="CartPole-v1",
+        algo="impala",
+        core="lstm",
+        core_size=32,
+        num_envs=8,
+        unroll_len=8,
+        precision="f32",
+        log_every=2,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def test_build_model_dispatch():
+    from asyncrl_tpu.envs import registry
+
+    spec = registry.make("CartPole-v1").spec
+    assert is_recurrent(build_model(lstm_cfg(), spec))
+    assert not is_recurrent(build_model(lstm_cfg(core="ff"), spec))
+    with pytest.raises(ValueError, match="unknown core"):
+        build_model(lstm_cfg(core="gru"), spec)
+
+
+def test_recurrent_apply_and_reset():
+    model = RecurrentActorCritic(num_actions=2, core_size=16)
+    obs = jnp.ones((4, 5))
+    core0 = model.initial_core(4)
+    params = model.init(jax.random.PRNGKey(0), obs, core0)
+    logits, value, core1 = model.apply(params, obs, core0)
+    assert logits.shape == (4, 2) and value.shape == (4,)
+    # Core evolves, and resets exactly where done.
+    assert any(
+        np.abs(np.asarray(c)).sum() > 0 for c in jax.tree.leaves(core1)
+    )
+    done = jnp.array([True, False, True, False])
+    core_r = reset_core(core1, done)
+    for leaf in jax.tree.leaves(core_r):
+        assert np.allclose(np.asarray(leaf)[0], 0.0)
+        assert np.allclose(np.asarray(leaf)[2], 0.0)
+    # Different core -> different policy output (the core is actually used).
+    logits2, _, _ = model.apply(params, obs, core1)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits2))
+
+
+def test_recurrent_learner_update_and_determinism():
+    t = Trainer(lstm_cfg())
+    assert t.state.actor.core is not None
+    s1, m1 = t.learner.update(t.state)
+    assert np.isfinite(float(m1["loss"]))
+    assert int(s1.update_step) == 1
+    # Same seed -> bit-identical update (PRNG threading incl. core).
+    t2 = Trainer(lstm_cfg())
+    s2, m2 = t2.learner.update(t2.state)
+    assert float(m2["loss"]) == float(m1["loss"])
+
+
+def test_recurrent_fragment_forward_resets_core_mid_fragment():
+    """The learner's re-forward must reset the core at episode boundaries
+    inside the fragment — a fragment with a done in the middle must give
+    the same post-done logits as one starting fresh at that step."""
+    from asyncrl_tpu.learn.learner import _forward_fragment
+    from asyncrl_tpu.rollout.buffer import Rollout
+
+    model = RecurrentActorCritic(num_actions=2, core_size=8)
+    B, T = 2, 6
+    obs = jnp.asarray(
+        np.random.default_rng(0).normal(size=(T, B, 4)).astype(np.float32)
+    )
+    core0 = model.initial_core(B)
+    params = model.init(jax.random.PRNGKey(0), obs[0], core0)
+
+    def make_rollout(terminated):
+        return Rollout(
+            obs=obs,
+            actions=jnp.zeros((T, B), jnp.int32),
+            behaviour_logp=jnp.zeros((T, B)),
+            rewards=jnp.zeros((T, B)),
+            terminated=terminated,
+            truncated=jnp.zeros((T, B), bool),
+            bootstrap_obs=obs[-1],
+            init_core=core0,
+        )
+
+    # done after step 2 for env 0.
+    term = jnp.zeros((T, B), bool).at[2, 0].set(True)
+    logits_full, _ = _forward_fragment(model.apply, params, make_rollout(term))
+
+    # Reference: forward only steps 3.. with a fresh core for env 0.
+    tail = Rollout(
+        obs=obs[3:],
+        actions=jnp.zeros((T - 3, B), jnp.int32),
+        behaviour_logp=jnp.zeros((T - 3, B)),
+        rewards=jnp.zeros((T - 3, B)),
+        terminated=jnp.zeros((T - 3, B), bool),
+        truncated=jnp.zeros((T - 3, B), bool),
+        bootstrap_obs=obs[-1],
+        init_core=model.initial_core(B),
+    )
+    logits_tail, _ = _forward_fragment(model.apply, params, tail)
+    np.testing.assert_allclose(
+        np.asarray(logits_full)[3:, 0],
+        np.asarray(logits_tail)[:, 0],
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_recurrent_eval_and_checkpoint(tmp_path):
+    t = Trainer(lstm_cfg(checkpoint_dir=str(tmp_path / "ck")))
+    ret = t.evaluate(num_episodes=4, max_steps=50)
+    assert np.isfinite(ret)
+    t.state, _ = t.learner.update(t.state)
+    t.save_checkpoint()
+    t.checkpointer.wait()
+
+    t2 = Trainer(lstm_cfg(checkpoint_dir=str(tmp_path / "ck")))
+    assert int(t2.state.update_step) == 1
+    for a, b in zip(
+        jax.tree.leaves(t.state.actor.core), jax.tree.leaves(t2.state.actor.core)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    t.close()
+    t2.close()
+
+
+def test_recurrent_guards():
+    with pytest.raises(NotImplementedError, match="minibatched PPO"):
+        Trainer(lstm_cfg(algo="ppo", ppo_epochs=4, ppo_minibatches=4))
+    from asyncrl_tpu.api.sebulba_trainer import SebulbaTrainer
+
+    with pytest.raises(NotImplementedError, match="Anakin backend"):
+        SebulbaTrainer(lstm_cfg(backend="sebulba", actor_threads=1))
+
+
+@pytest.mark.slow
+def test_recurrent_cartpole_learns():
+    """IMPALA-LSTM smoke: the recurrent agent's TRAINING return climbs
+    clearly on CartPole in a CI-sized budget. (Greedy eval is not
+    discriminative here: an untrained LSTM's argmax policy oscillates to
+    ~110 on CartPole already; the sampled training return starts ~20-30 and
+    reaches ~90 by 500k steps — calibrated 2026-07-29.)"""
+    cfg = lstm_cfg(
+        algo="impala", num_envs=64, unroll_len=16, learning_rate=1e-3,
+        core_size=64, log_every=40,
+    )
+    t = Trainer(cfg)
+    history = t.train(total_env_steps=500_000)
+    early = history[0]["episode_return"]
+    late = sum(h["episode_return"] for h in history[-3:]) / 3
+    assert late > max(2 * early, 60.0), f"no learning: {early:.1f} -> {late:.1f}"
