@@ -23,7 +23,7 @@ import jax
 import numpy as np
 
 from asyncrl_tpu.learn.rollout_learner import LearnerState, RolloutLearner
-from asyncrl_tpu.models.networks import build_model
+from asyncrl_tpu.models.networks import build_model, is_recurrent, reset_core
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.parallel.mesh import dp_size, make_mesh
 from asyncrl_tpu.rollout.sebulba import (
@@ -96,7 +96,12 @@ class SebulbaTrainer:
         )
         self.checkpointer = self._ckpt.checkpointer
 
-        self._inference_fn = make_inference_fn(self.model.apply, self.spec)
+        self._inference_fn = make_inference_fn(
+            self.model.apply, self.spec, model=self.model
+        )
+        self._initial_core = (
+            self.model.initial_core if is_recurrent(self.model) else None
+        )
         self._store = ParamStore(self.state.params)
         cap = config.queue_capacity or 2 * config.actor_threads
         self._queue: "queue.Queue[Fragment]" = queue.Queue(maxsize=cap)
@@ -127,6 +132,7 @@ class SebulbaTrainer:
             stop_event=self._stop,
             errors=self._errors,
             device=self._actor_device,
+            initial_core=self._initial_core,
         )
         actor.start()
         return actor
@@ -279,21 +285,39 @@ class SebulbaTrainer:
         pool = make_host_pool(self.config, num_episodes, seed=seed)
         dist = distributions.for_spec(self.spec)
         apply_fn = self.model.apply
+        recurrent = is_recurrent(self.model)
 
-        @jax.jit
-        def greedy(params, obs):
-            dist_params, _ = apply_fn(params, obs)
-            return dist.mode(dist_params)
+        if recurrent:
+
+            @jax.jit
+            def greedy_rec(params, obs, core, done_prev):
+                core = reset_core(core, done_prev)
+                dist_params, _, core = apply_fn(params, obs, core)
+                return dist.mode(dist_params), core
+
+        else:
+
+            @jax.jit
+            def greedy(params, obs):
+                dist_params, _ = apply_fn(params, obs)
+                return dist.mode(dist_params)
 
         params = self.state.params
+        core = self.model.initial_core(num_episodes) if recurrent else None
+        done_prev = np.zeros((num_episodes,), bool)
         try:
             obs = pool.reset()
             ep_return = np.zeros((num_episodes,), np.float64)
             finished = np.zeros((num_episodes,), bool)
             final_return = np.zeros((num_episodes,), np.float64)
             for _ in range(max_steps):
-                actions = np.asarray(greedy(params, obs))
+                if recurrent:
+                    actions_d, core = greedy_rec(params, obs, core, done_prev)
+                    actions = np.asarray(actions_d)
+                else:
+                    actions = np.asarray(greedy(params, obs))
                 obs, rew, term, trunc = pool.step(actions)
+                done_prev = np.logical_or(term, trunc)
                 ep_return += np.where(finished, 0.0, rew)
                 done = np.logical_or(term, trunc) & ~finished
                 final_return = np.where(done, ep_return, final_return)

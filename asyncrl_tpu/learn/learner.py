@@ -85,6 +85,25 @@ def resolve_scan_impl(config: Config, mesh: Mesh) -> Config:
     return config.replace(scan_impl="associative")
 
 
+def validate_recurrent_config(config: Config, model) -> None:
+    """Shared constructor-time checks for recurrent policies (Anakin and
+    host-fragment learners alike)."""
+    if is_recurrent(model) and config.algo == "ppo" and (
+        config.ppo_epochs > 1 or config.ppo_minibatches > 1
+    ):
+        raise NotImplementedError(
+            "recurrent (core='lstm') policies are not supported with "
+            "multi-epoch/minibatched PPO (shuffled minibatches break "
+            "the temporal structure the core needs); use "
+            "ppo_epochs=ppo_minibatches=1, or algo='impala'/'a3c'"
+        )
+    if config.core == "lstm" and not is_recurrent(model):
+        raise ValueError(
+            "config.core='lstm' but the given model is not a "
+            "RecurrentActorCritic — pass a recurrent model or core='ff'"
+        )
+
+
 def _forward_fragment(apply_fn, params, rollout: Rollout):
     """Learner forward over one fragment -> (dist_params, values), both
     [T+1, ...] (final entry is the bootstrap step).
@@ -378,20 +397,7 @@ class Learner:
         self.optimizer = make_optimizer(config)
 
         # Eager geometry validation (clearer than a trace-time failure).
-        if config.core == "lstm" and not is_recurrent(model):
-            raise ValueError(
-                "config.core='lstm' but the given model is not a "
-                "RecurrentActorCritic — pass a recurrent model or core='ff'"
-            )
-        if is_recurrent(model) and config.algo == "ppo" and (
-            config.ppo_epochs > 1 or config.ppo_minibatches > 1
-        ):
-            raise NotImplementedError(
-                "recurrent (core='lstm') policies are not supported with "
-                "multi-epoch/minibatched PPO (shuffled minibatches break "
-                "the temporal structure the core needs); use "
-                "ppo_epochs=ppo_minibatches=1, or algo='impala'/'a3c'"
-            )
+        validate_recurrent_config(config, model)
         dp = dp_size(mesh)
         if config.num_envs % dp:
             raise ValueError(

@@ -28,7 +28,9 @@ from asyncrl_tpu.learn.learner import (
     _ppo_multipass,
     make_optimizer,
     resolve_scan_impl,
+    validate_recurrent_config,
 )
+from asyncrl_tpu.models.networks import is_recurrent
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.parallel.mesh import dp_axes
 from asyncrl_tpu.rollout.buffer import Rollout
@@ -55,7 +57,9 @@ def learner_state_spec() -> LearnerState:
 
 def rollout_partition_spec(axes: tuple[str, ...]) -> Rollout:
     """Time-major [T, B, ...] fragments, batch dim sharded over all
-    data-parallel axes."""
+    data-parallel axes. ``init_core``'s P is a pytree PREFIX: it applies to
+    every leaf of the recurrent (c, h) carry when present, and to nothing
+    for feed-forward fragments (None = empty subtree)."""
     return Rollout(
         obs=P(None, axes),
         actions=P(None, axes),
@@ -64,15 +68,30 @@ def rollout_partition_spec(axes: tuple[str, ...]) -> Rollout:
         terminated=P(None, axes),
         truncated=P(None, axes),
         bootstrap_obs=P(axes),
+        init_core=P(axes),
     )
 
 
-def rollout_sharding(mesh: Mesh) -> Rollout:
-    """NamedShardings for ``jax.device_put`` of a host fragment."""
-    return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
-        rollout_partition_spec(dp_axes(mesh)),
-        is_leaf=lambda x: isinstance(x, P),
+def rollout_sharding(mesh: Mesh, rollout: Rollout) -> Rollout:
+    """NamedShardings for ``jax.device_put`` of one host fragment — built
+    against the fragment's own pytree structure (device_put needs an exact
+    structural match, unlike shard_map's prefix specs)."""
+    axes = dp_axes(mesh)
+    time_major = NamedSharding(mesh, P(None, axes))
+    batch_first = NamedSharding(mesh, P(axes))
+    return Rollout(
+        obs=time_major,
+        actions=time_major,
+        behaviour_logp=time_major,
+        rewards=time_major,
+        terminated=time_major,
+        truncated=time_major,
+        bootstrap_obs=batch_first,
+        init_core=(
+            None
+            if rollout.init_core is None
+            else jax.tree.map(lambda _: batch_first, rollout.init_core)
+        ),
     )
 
 
@@ -84,14 +103,7 @@ class RolloutLearner:
     """
 
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
-        from asyncrl_tpu.models.networks import is_recurrent
-
-        if config.core != "ff" or is_recurrent(model):
-            raise NotImplementedError(
-                "recurrent policies (core='lstm') are only supported on the "
-                "Anakin backend (backend='tpu'): host actors don't record "
-                "core state in their fragments yet"
-            )
+        validate_recurrent_config(config, model)
         config = resolve_scan_impl(config, mesh)
         self.config = config
         self.spec = spec
@@ -159,14 +171,26 @@ class RolloutLearner:
                 out_specs=(sspec, P()),
             ),
         )
-        self._rollout_sharding = rollout_sharding(mesh)
+        # Fragment structure is fixed for this trainer (ff vs recurrent), so
+        # the device_put sharding pytree is built once, not per update.
+        template = Rollout(
+            obs=None, actions=None, behaviour_logp=None, rewards=None,
+            terminated=None, truncated=None, bootstrap_obs=None,
+            init_core=model.initial_core(1) if is_recurrent(model) else None,
+        )
+        self._rollout_sharding = rollout_sharding(mesh, template)
 
     # ---------------------------------------------------------------- state
 
     def init_state(self, seed: int) -> LearnerState:
         key = jax.random.PRNGKey(seed)
         dummy_obs = jnp.zeros((1, *self.spec.obs_shape), self.spec.obs_dtype)
-        params = self.model.init(key, dummy_obs)
+        if is_recurrent(self.model):
+            params = self.model.init(
+                key, dummy_obs, self.model.initial_core(1)
+            )
+        else:
+            params = self.model.init(key, dummy_obs)
         opt_state = self.optimizer.init(params)
         rep = NamedSharding(self.mesh, P())
         return LearnerState(

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from asyncrl_tpu.envs.core import Environment, EnvSpec
+from asyncrl_tpu.models.networks import is_recurrent, reset_core
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.rollout.buffer import Rollout, RolloutBuffer
 
@@ -164,11 +165,27 @@ def make_host_pool(config, num_envs: int, seed: int):
     )
 
 
-def make_inference_fn(apply_fn: Callable, spec: EnvSpec) -> Callable:
-    """Jitted batched action selection: (params, obs[B], key) ->
-    (actions, behaviour_logp, new_key). The key stays on device across calls;
-    actions/logp sync to host (actions are needed by the env anyway)."""
+def make_inference_fn(apply_fn: Callable, spec: EnvSpec, model=None) -> Callable:
+    """Jitted batched action selection. Feed-forward: (params, obs[B], key)
+    -> (actions, behaviour_logp, new_key). Recurrent (LSTM) models:
+    (params, obs, key, core, done_prev) -> (..., new_core) — the core stays
+    ON DEVICE across calls (only actions/logp sync to host), and is reset
+    where the PREVIOUS step ended an episode, mirroring the Anakin scan."""
     dist = distributions.for_spec(spec)
+
+    if model is not None and is_recurrent(model):
+
+        @jax.jit
+        def infer_recurrent(params, obs, key, core, done_prev):
+            core = reset_core(core, done_prev)
+            key, sub = jax.random.split(key)
+            dist_params, _, core = apply_fn(params, obs, core)
+            act_keys = jax.random.split(sub, obs.shape[0])
+            actions = jax.vmap(dist.sample)(act_keys, dist_params)
+            logp = dist.logp(dist_params, actions)
+            return actions, logp, key, core
+
+        return infer_recurrent
 
     @jax.jit
     def infer(params, obs, key):
@@ -203,6 +220,7 @@ class ActorThread(threading.Thread):
         stop_event: threading.Event,
         errors: "queue.Queue[tuple[int, BaseException]]",
         device=None,
+        initial_core: Callable[[int], Any] | None = None,
     ):
         super().__init__(name=f"actor-{index}", daemon=True)
         self.index = index
@@ -214,6 +232,9 @@ class ActorThread(threading.Thread):
         self.seed = seed
         self.stop_event = stop_event
         self.errors = errors
+        # Recurrent policies: builds the initial (c, h) carry for B envs;
+        # None for feed-forward.
+        self.initial_core = initial_core
         # ``jax.default_device`` is thread-local, so a device pin must be
         # re-established INSIDE the thread: the cpu_async backend pins actors
         # to host CPU (never touching an attached accelerator); sebulba
@@ -246,22 +267,38 @@ class ActorThread(threading.Thread):
         buffer = RolloutBuffer(T, B, obs.shape[1:], obs.dtype)
         running_return = np.zeros((B,), np.float64)
         running_length = np.zeros((B,), np.float64)
+        core = self.initial_core(B) if self.initial_core else None
+        done_prev = np.zeros((B,), bool)
 
         while not self.stop_event.is_set():
             params, version = self.store.get()
             ret_sum = 0.0
             len_sum = 0.0
             count = 0.0
+            # Fragment-initial core AFTER the pending episode-boundary reset
+            # (the jitted inference applies the reset; mirror it here so the
+            # recorded carry is the one the fragment actually starts from).
+            if core is not None:
+                core = jax.tree.map(jnp.asarray, core)
+                core = reset_core(core, jnp.asarray(done_prev))
+                done_prev = np.zeros((B,), bool)
+                init_core = jax.tree.map(np.asarray, core)
             while not buffer.full:
-                actions_d, logp_d, key = self.inference_fn(params, obs, key)
+                if core is not None:
+                    actions_d, logp_d, key, core = self.inference_fn(
+                        params, obs, key, core, done_prev
+                    )
+                else:
+                    actions_d, logp_d, key = self.inference_fn(params, obs, key)
                 actions = np.asarray(actions_d)
                 prev_obs = obs
                 obs, rew, term, trunc = pool.step(actions)
                 buffer.append(prev_obs, actions, np.asarray(logp_d), rew, term, trunc)
+                done_prev = np.logical_or(term, trunc)
 
                 running_return += rew
                 running_length += 1.0
-                done = np.logical_or(term, trunc)
+                done = done_prev
                 if done.any():
                     ret_sum += float(running_return[done].sum())
                     len_sum += float(running_length[done].sum())
@@ -269,8 +306,11 @@ class ActorThread(threading.Thread):
                     running_return[done] = 0.0
                     running_length[done] = 0.0
 
+            rollout = buffer.emit(bootstrap_obs=obs)
+            if core is not None:
+                rollout = rollout.replace(init_core=init_core)
             fragment = Fragment(
-                buffer.emit(bootstrap_obs=obs),
+                rollout,
                 ret_sum, len_sum, count, version,
             )
             # Bounded put that stays responsive to shutdown.

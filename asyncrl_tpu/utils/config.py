@@ -38,8 +38,9 @@ class Config:
     hidden_sizes: tuple[int, ...] = (64, 64)
     channels: tuple[int, ...] = (16, 32, 32)
     # Recurrent core after the torso: "ff" (none) or "lstm" (the A3C/IMPALA
-    # LSTM-agent variant; tpu backend only). Core state rides the rollout
-    # scan carry and resets at episode boundaries.
+    # LSTM-agent variant; all backends). Core state rides the rollout scan
+    # carry (Anakin) or stays device-resident across host actor steps
+    # (sebulba/cpu_async), resetting at episode boundaries.
     core: str = "ff"
     core_size: int = 256
 

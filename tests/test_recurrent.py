@@ -146,10 +146,46 @@ def test_recurrent_eval_and_checkpoint(tmp_path):
 def test_recurrent_guards():
     with pytest.raises(NotImplementedError, match="minibatched PPO"):
         Trainer(lstm_cfg(algo="ppo", ppo_epochs=4, ppo_minibatches=4))
+    from asyncrl_tpu.models.networks import ActorCritic
+
+    with pytest.raises(ValueError, match="not a\n?.*Recurrent"):
+        Trainer(
+            lstm_cfg(),
+            model=ActorCritic(num_actions=2, torso="mlp"),
+        )
+
+
+def test_recurrent_sebulba_end_to_end():
+    """LSTM agent through the host-actor path: fragments carry init_core,
+    the learner re-forwards with it, eval carries the core."""
     from asyncrl_tpu.api.sebulba_trainer import SebulbaTrainer
 
-    with pytest.raises(NotImplementedError, match="Anakin backend"):
-        SebulbaTrainer(lstm_cfg(backend="sebulba", actor_threads=1))
+    cfg = lstm_cfg(
+        backend="sebulba", actor_threads=1, host_pool="jax", num_envs=8
+    )
+    t = SebulbaTrainer(cfg)
+    try:
+        history = t.train(total_env_steps=6 * cfg.batch_steps_per_update)
+        assert history and all(np.isfinite(h["loss"]) for h in history)
+        ret = t.evaluate(num_episodes=4, max_steps=60)
+        assert np.isfinite(ret)
+    finally:
+        t.close()
+
+
+def test_recurrent_cpu_async_end_to_end():
+    from asyncrl_tpu.api.cpu_async import CpuAsyncTrainer
+
+    cfg = lstm_cfg(
+        backend="cpu_async", actor_threads=2, host_pool="jax",
+        num_envs=2, unroll_len=8, mesh_shape=(1,),
+    )
+    t = CpuAsyncTrainer(cfg)
+    try:
+        history = t.train(total_env_steps=6 * 8)
+        assert history and all(np.isfinite(h["loss"]) for h in history)
+    finally:
+        t.close()
 
 
 @pytest.mark.slow
