@@ -96,9 +96,7 @@ class SebulbaTrainer:
         )
         self.checkpointer = self._ckpt.checkpointer
 
-        self._inference_fn = make_inference_fn(
-            self.model.apply, self.spec, model=self.model
-        )
+        self._inference_fn = make_inference_fn(self.model, self.spec)
         self._initial_core = (
             self.model.initial_core if is_recurrent(self.model) else None
         )

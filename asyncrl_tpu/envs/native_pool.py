@@ -75,6 +75,7 @@ def load_library() -> ctypes.CDLL:
 NATIVE_ENV_IDS = {
     "CartPole-v1": "CartPole-v1",
     "JaxPong-v0": "Pong",  # same rules as the JAX env (envs/pong.py)
+    "JaxBreakout-v0": "Breakout",  # same rules as envs/breakout.py
 }
 
 

@@ -165,15 +165,18 @@ def make_host_pool(config, num_envs: int, seed: int):
     )
 
 
-def make_inference_fn(apply_fn: Callable, spec: EnvSpec, model=None) -> Callable:
-    """Jitted batched action selection. Feed-forward: (params, obs[B], key)
-    -> (actions, behaviour_logp, new_key). Recurrent (LSTM) models:
+def make_inference_fn(model, spec: EnvSpec) -> Callable:
+    """Jitted batched action selection for ``model`` (a flax module; the
+    recurrent/ff call shape is derived from it, so the wrong variant cannot
+    be built). Feed-forward: (params, obs[B], key) ->
+    (actions, behaviour_logp, new_key). Recurrent (LSTM) models:
     (params, obs, key, core, done_prev) -> (..., new_core) — the core stays
     ON DEVICE across calls (only actions/logp sync to host), and is reset
     where the PREVIOUS step ended an episode, mirroring the Anakin scan."""
     dist = distributions.for_spec(spec)
+    apply_fn = model.apply
 
-    if model is not None and is_recurrent(model):
+    if is_recurrent(model):
 
         @jax.jit
         def infer_recurrent(params, obs, key, core, done_prev):
@@ -279,7 +282,6 @@ class ActorThread(threading.Thread):
             # (the jitted inference applies the reset; mirror it here so the
             # recorded carry is the one the fragment actually starts from).
             if core is not None:
-                core = jax.tree.map(jnp.asarray, core)
                 core = reset_core(core, jnp.asarray(done_prev))
                 done_prev = np.zeros((B,), bool)
                 init_core = jax.tree.map(np.asarray, core)

@@ -228,6 +228,125 @@ struct PongEnv final : EnvBase {
   }
 };
 
+// Breakout, same rules/constants as asyncrl_tpu/envs/breakout.py (vector
+// obs: ball(4), paddle_x, lives/5, 72 brick bits = 78 dims).
+struct BreakoutEnv final : EnvBase {
+  static constexpr int kRows = 6, kCols = 12;
+  static constexpr float kBrickTop = 0.88f, kRowH = 0.04f;
+  static constexpr float kBrickBot = kBrickTop - kRows * kRowH;
+  static constexpr float kPaddleY = 0.06f, kPaddleHalf = 0.075f;
+  static constexpr float kPaddleSpeed = 0.05f;
+  static constexpr float kBallSpeedY = 0.025f, kMaxVx = 0.035f;
+  static constexpr int kLives = 5, kAutoServe = 8, kMaxSteps = 3000;
+
+  float bx, by, bvx, bvy, paddle_x;
+  bool bricks[kRows][kCols];
+  int lives, held, t;
+
+  static float row_points(int r) {
+    static constexpr float kPoints[kRows] = {1, 1, 4, 4, 7, 7};
+    return kPoints[r];
+  }
+
+  int obs_dim() const override { return 4 + 2 + kRows * kCols; }
+  int num_actions() const override { return 4; }
+
+  void reset(Rng& rng, float* obs) override {
+    (void)rng;
+    bx = 0.5f; by = kPaddleY + 0.02f; bvx = 0.0f; bvy = 0.0f;
+    paddle_x = 0.5f;
+    for (auto& row : bricks)
+      for (auto& b : row) b = true;
+    lives = kLives; held = 0; t = 0;
+    observe(obs);
+  }
+
+  void observe(float* obs) const {
+    obs[0] = bx; obs[1] = by;
+    obs[2] = bvx / kMaxVx; obs[3] = bvy / kBallSpeedY;
+    obs[4] = paddle_x; obs[5] = (float)lives / kLives;
+    for (int r = 0; r < kRows; ++r)
+      for (int c = 0; c < kCols; ++c) obs[6 + r * kCols + c] = bricks[r][c];
+  }
+
+  void step(int action, Rng& rng, float* obs, float* reward,
+            uint8_t* terminated, uint8_t* truncated) override {
+    // ALE Breakout mapping: 1 = FIRE (serve), 2 = RIGHT, 3 = LEFT.
+    const float dx = action == 2 ? 1.0f : (action == 3 ? -1.0f : 0.0f);
+    paddle_x += kPaddleSpeed * dx;
+    if (paddle_x < kPaddleHalf) paddle_x = kPaddleHalf;
+    if (paddle_x > 1.0f - kPaddleHalf) paddle_x = 1.0f - kPaddleHalf;
+
+    const bool in_play = bvx != 0.0f || bvy != 0.0f;
+    held = in_play ? 0 : held + 1;
+    if (!in_play) {
+      if (action == 1 || held >= kAutoServe) {
+        bx = paddle_x; by = kPaddleY + 0.02f;
+        bvx = rng.uniform(-0.5f * kMaxVx, 0.5f * kMaxVx);
+        bvy = kBallSpeedY;
+      } else {
+        bx = paddle_x;  // still held: ride the paddle
+      }
+    }
+
+    float x = bx + bvx, y = by + bvy;
+    if (x < 0.0f) { x = -x; bvx = std::fabs(bvx); }
+    else if (x > 1.0f) { x = 2.0f - x; bvx = -std::fabs(bvx); }
+    if (y > 1.0f) { y = 2.0f - y; bvy = -std::fabs(bvy); }
+
+    // Brick collision: the cell the ball sits in, if inside the band.
+    *reward = 0.0f;
+    if (y >= kBrickBot && y < kBrickTop) {
+      int r = (int)std::floor((y - kBrickBot) / kRowH);
+      if (r < 0) r = 0;
+      if (r >= kRows) r = kRows - 1;
+      int c = (int)std::floor(x * kCols);
+      if (c < 0) c = 0;
+      if (c >= kCols) c = kCols - 1;
+      if (bricks[r][c]) {
+        bricks[r][c] = false;
+        *reward = row_points(r);
+        bvy = -bvy;
+      }
+    }
+
+    // Paddle bounce: offset sets outgoing vx (the aiming mechanic).
+    const bool at_paddle = y <= kPaddleY && bvy < 0.0f;
+    bool lost = false;
+    if (at_paddle) {
+      const float offset = (x - paddle_x) / kPaddleHalf;
+      if (std::fabs(offset) <= 1.0f) {
+        bvy = std::fabs(bvy);
+        bvx = kMaxVx * offset;
+        y = 2.0f * kPaddleY - y;
+      } else {
+        lost = true;
+      }
+    }
+    if (lost) {
+      lives -= 1;
+      bx = paddle_x; by = kPaddleY + 0.02f; bvx = 0.0f; bvy = 0.0f;
+      held = 0;
+    } else {
+      bx = x; by = y;
+    }
+    t += 1;
+
+    bool cleared = true;
+    for (auto& row : bricks)
+      for (auto& b : row) cleared &= !b;
+    const bool term = cleared || lives <= 0;
+    const bool trunc = !term && t >= kMaxSteps;
+    *terminated = term;
+    *truncated = trunc;
+    if (term || trunc) {
+      reset(rng, obs);
+    } else {
+      observe(obs);
+    }
+  }
+};
+
 // ----------------------------------------------------------------- pool
 struct EnvPool {
   std::vector<EnvBase*> envs;
@@ -317,6 +436,7 @@ struct EnvPool {
 EnvBase* make_env(const std::string& id) {
   if (id == "CartPole-v1") return new CartPoleEnv();
   if (id == "Pong") return new PongEnv();
+  if (id == "Breakout") return new BreakoutEnv();
   return nullptr;
 }
 

@@ -104,6 +104,84 @@ def test_native_pong_matches_jax_dynamics():
     assert compared > 100  # plenty of deterministic steps actually compared
 
 
+def test_native_breakout_matches_jax_dynamics():
+    """In-play Breakout dynamics are RNG-free in both engines (RNG is only
+    consumed by the serve's random vx), and resets are fully deterministic —
+    so after resyncing the JAX state from the native obs at every serve, the
+    two must match step-for-step while the ball is in play."""
+    from asyncrl_tpu.envs.breakout import (
+        BALL_SPEED_Y,
+        COLS,
+        LIVES,
+        MAX_VX,
+        ROWS,
+        Breakout,
+        BreakoutState,
+    )
+
+    pool = NativeEnvPool("JaxBreakout-v0", 4, num_threads=1, seed=3)
+    nobs = pool.reset()
+    env = Breakout()
+    B = pool.num_envs
+    step = jax.jit(jax.vmap(env.step))
+
+    def state_from_obs(obs, held, t):
+        return BreakoutState(
+            ball=jnp.stack(
+                [
+                    jnp.asarray(obs[:, 0]),
+                    jnp.asarray(obs[:, 1]),
+                    jnp.asarray(obs[:, 2]) * MAX_VX,
+                    jnp.asarray(obs[:, 3]) * BALL_SPEED_Y,
+                ],
+                axis=-1,
+            ),
+            paddle_x=jnp.asarray(obs[:, 4]),
+            bricks=jnp.asarray(obs[:, 6:].reshape(B, ROWS, COLS) > 0.5),
+            lives=jnp.asarray(np.rint(obs[:, 5] * LIVES).astype(np.int32)),
+            held=jnp.asarray(held.astype(np.int32)),
+            t=jnp.asarray(t.astype(np.int32)),
+        )
+
+    held = np.zeros((B,), np.int64)
+    t_host = np.zeros((B,), np.int64)
+    states = state_from_obs(nobs, held, t_host)
+    rng = np.random.default_rng(4)
+    key = jax.random.PRNGKey(0)
+    compared = 0
+    for i in range(200):
+        pre_in_play = (nobs[:, 2] != 0.0) | (nobs[:, 3] != 0.0)
+        actions = rng.integers(0, 4, B).astype(np.int32)
+        nobs, nrew, nterm, ntrunc = pool.step(actions)
+        key, sub = jax.random.split(key)
+        states, ts = step(states, jnp.asarray(actions), jax.random.split(sub, B))
+
+        if pre_in_play.any():
+            np.testing.assert_allclose(
+                nobs[pre_in_play],
+                np.asarray(ts.obs)[pre_in_play],
+                rtol=1e-4,
+                atol=1e-5,
+                err_msg=f"divergence at step {i}",
+            )
+            np.testing.assert_allclose(
+                nrew[pre_in_play], np.asarray(ts.reward)[pre_in_play]
+            )
+            compared += int(pre_in_play.sum())
+
+        # Host-side mirror of the native held/t counters, then resync the
+        # JAX state from native obs for envs whose serve consumed RNG (the
+        # only cross-engine divergence source).
+        done = np.logical_or(nterm, ntrunc)
+        post_in_play = (nobs[:, 2] != 0.0) | (nobs[:, 3] != 0.0)
+        held = np.where(pre_in_play, 0, held + 1)
+        held = np.where(post_in_play | done, 0, held)
+        t_host = np.where(done, 0, t_host + 1)
+        states = state_from_obs(nobs, held, t_host)
+    pool.close()
+    assert compared > 300  # in-play steps across 4 envs actually compared
+
+
 def test_native_pool_threaded_equals_single_threaded():
     """Same seeds => identical trajectories regardless of thread count."""
     p1 = NativeEnvPool("CartPole-v1", 64, num_threads=1, seed=5)
