@@ -12,13 +12,49 @@ Default preset: pong_impala if its env is available, else cartpole_impala.
 from __future__ import annotations
 
 import json
+import subprocess
 import sys
 import time
 
-import jax
+
+def _accelerator_alive(timeout: float = 120.0) -> bool:
+    """Probe backend init in a THROWAWAY subprocess: the axon TPU plugin has
+    been observed to hang indefinitely on first device query when its tunnel
+    is down (see .claude/skills/verify gotchas), which would otherwise turn
+    the whole benchmark run into a silent hang. A dead probe -> fall back to
+    CPU so the driver still records a (clearly labeled) datapoint."""
+    import os
+    import signal
+
+    # No pipes (a hung plugin helper process could inherit them and keep
+    # them open past the child's death, blocking us forever) and a fresh
+    # session so the WHOLE process group can be killed on timeout.
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    try:
+        return proc.wait(timeout=timeout) == 0
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        return False
 
 
 def main() -> None:
+    import jax
+
+    if not _accelerator_alive():
+        jax.config.update("jax_platforms", "cpu")
+        print(
+            "bench: accelerator backend hung/unavailable; falling back to "
+            "CPU (metric label carries the device kind)",
+            file=sys.stderr,
+        )
     from asyncrl_tpu.api.trainer import Trainer
     from asyncrl_tpu.configs import presets
     from asyncrl_tpu.envs import registered
