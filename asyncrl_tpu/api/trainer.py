@@ -14,6 +14,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from asyncrl_tpu.envs import registry
 from asyncrl_tpu.learn.learner import Learner, TrainState
@@ -82,7 +83,7 @@ class Trainer:
         """
         cfg = self.config
         target = total_env_steps or cfg.total_env_steps
-        steps_per_update = cfg.batch_steps_per_update
+        steps_per_update = cfg.batch_steps_per_update * cfg.updates_per_call
         history: list[dict[str, Any]] = []
 
         pending: list[dict[str, jax.Array]] = []
@@ -103,19 +104,27 @@ class Trainer:
                     elapsed = time.perf_counter() - window_start
                     window_start = time.perf_counter()
 
+                    # Metric leaves are scalars (updates_per_call=1) or [K]
+                    # stacks (fused multi-update calls): np handles both.
                     agg = {
-                        k: float(sum(m[k] for m in drained) / len(drained))
+                        k: float(np.mean([np.mean(m[k]) for m in drained]))
                         for k in drained[0]
                         if not k.startswith("episode_")
                     }
-                    ep_count = sum(m["episode_count"] for m in drained)
-                    agg["episode_count"] = float(ep_count)
+                    ep_count = float(
+                        np.sum([np.sum(m["episode_count"]) for m in drained])
+                    )
+                    agg["episode_count"] = ep_count
                     agg["episode_return"] = float(
-                        sum(m["episode_return_sum"] for m in drained)
+                        np.sum(
+                            [np.sum(m["episode_return_sum"]) for m in drained]
+                        )
                         / max(ep_count, 1.0)
                     )
                     agg["episode_length"] = float(
-                        sum(m["episode_length_sum"] for m in drained)
+                        np.sum(
+                            [np.sum(m["episode_length_sum"]) for m in drained]
+                        )
                         / max(ep_count, 1.0)
                     )
                     agg["env_steps"] = self.env_steps

@@ -415,9 +415,24 @@ class Learner:
 
         spec = state_partition_spec(dp_axes(mesh))
         body = make_train_step(config, env, model.apply, self.optimizer, mesh)
+
+        if config.updates_per_call > 1:
+            # Fuse K updates into one XLA program: zero host dispatch
+            # between them; metrics stack to [K] leaves.
+            K = config.updates_per_call
+
+            def multi_step(state: TrainState):
+                return jax.lax.scan(
+                    lambda s, _: body(s), state, None, length=K
+                )
+
+            wrapped = multi_step
+        else:
+            wrapped = body
+
         self._step = jax.jit(
             jax.shard_map(
-                body, mesh=mesh, in_specs=(spec,), out_specs=(spec, P())
+                wrapped, mesh=mesh, in_specs=(spec,), out_specs=(spec, P())
             ),
             donate_argnums=(0,) if config.donate_buffers else (),
         )

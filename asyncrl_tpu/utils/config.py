@@ -82,7 +82,12 @@ class Config:
 
     # --- runtime ---
     seed: int = 0
-    log_every: int = 20  # learner updates between metric drains
+    # Anakin backend: learner updates fused into ONE jitted call via
+    # lax.scan — removes per-update Python dispatch from the hot loop
+    # (metrics come back stacked [K] and are aggregated at drain time).
+    # Checkpoint/log cadences count CALLS, i.e. multiples of this.
+    updates_per_call: int = 1
+    log_every: int = 20  # learner update CALLS between metric drains
     # Updates between periodic checkpoint saves; 0 disables the periodic
     # cadence (with checkpoint_dir set, a final save on train() exit — clean
     # or crashed — still happens).

@@ -93,7 +93,7 @@ def main() -> None:
     jax.block_until_ready(metrics)
     elapsed = time.perf_counter() - t0
 
-    fps = timed * cfg.num_envs * cfg.unroll_len / elapsed
+    fps = timed * cfg.updates_per_call * cfg.num_envs * cfg.unroll_len / elapsed
     target = 1_000_000.0  # BASELINE.json:5 north-star (v4-8 target)
     print(
         json.dumps(
