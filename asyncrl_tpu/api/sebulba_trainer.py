@@ -68,6 +68,11 @@ class SebulbaTrainer:
         # construction, not with a cryptic sharding error mid-train after
         # actor threads have already started.
         dp = dp_size(self.mesh)
+        if self.config.updates_per_call != 1:
+            raise NotImplementedError(
+                "updates_per_call is Anakin-only (backend='tpu'): host-"
+                "fragment learners consume one queued fragment per update"
+            )
         if self._envs_per_actor % dp:
             raise ValueError(
                 f"num_envs/actor_threads={self._envs_per_actor} not "

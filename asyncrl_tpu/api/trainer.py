@@ -2,9 +2,10 @@
 reference's ``Trainer`` (BASELINE.json:5; SURVEY.md §3.1).
 
 ``Trainer.train()`` drives ``Learner.update`` and drains device-resident
-metrics to the host every ``log_every`` updates — the hot loop never blocks
-on host sync between drains. ``Trainer.evaluate()`` runs greedy episodes
-fully on device (SURVEY.md §3.5).
+metrics to the host every ``log_every`` update CALLS (each call fuses
+``updates_per_call`` learner updates) — the hot loop never blocks on host
+sync between drains. ``Trainer.evaluate()`` runs greedy episodes fully on
+device (SURVEY.md §3.5).
 """
 
 from __future__ import annotations
@@ -78,8 +79,9 @@ class Trainer:
         """Run updates until ``total_env_steps`` env frames consumed.
 
         Returns the list of drained metric dicts (one per ``log_every``
-        updates), each including ``env_steps``, ``fps``, and
-        ``episode_return`` (mean over episodes completed in the window).
+        update calls; a call fuses ``updates_per_call`` updates), each
+        including ``env_steps``, ``fps``, and ``episode_return`` (mean over
+        episodes completed in the window).
         """
         cfg = self.config
         target = total_env_steps or cfg.total_env_steps

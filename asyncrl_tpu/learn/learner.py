@@ -398,6 +398,10 @@ class Learner:
 
         # Eager geometry validation (clearer than a trace-time failure).
         validate_recurrent_config(config, model)
+        if config.updates_per_call < 1:
+            raise ValueError(
+                f"updates_per_call={config.updates_per_call} must be >= 1"
+            )
         dp = dp_size(mesh)
         if config.num_envs % dp:
             raise ValueError(

@@ -171,11 +171,14 @@ def test_updates_per_call_matches_sequential():
     np.testing.assert_allclose(
         np.asarray(fused_m["loss"]), np.asarray(seq_losses), rtol=1e-6
     )
-    eq = jax.tree.map(
-        lambda a, b: bool(np.array_equal(np.asarray(a), np.asarray(b))),
-        state.params, fused_state.params,
-    )
-    assert all(jax.tree.leaves(eq))
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(fused_state.params)
+    ):
+        # Same math, but scanned vs standalone programs may fuse float
+        # reductions differently on some backends: tolerance, not bitwise.
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
     assert int(fused_state.update_step) == 3
 
     # Trainer drain aggregates [K] metric stacks correctly.
