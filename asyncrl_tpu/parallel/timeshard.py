@@ -68,6 +68,104 @@ def reverse_linear_scan_timesharded(
     return x_local + suffix_prod * inflow
 
 
+def shift_from_next_shard(
+    x: jax.Array, fill: jax.Array, axis_name: str = TIME_AXIS
+) -> jax.Array:
+    """Time-sharded ``x[t+1]``: shift the local segment up by one, filling
+    the last local slot with the NEXT shard's first element (via a one-hop
+    ``ppermute`` riding ICI); the final shard's last slot gets ``fill``
+    (the bootstrap). This is the boundary exchange every one-step-lookahead
+    (V-trace/GAE deltas) needs once the time axis is sharded."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return jnp.concatenate([x[1:], fill[None]], axis=0)
+    # Each shard i sends its first element to shard i-1.
+    from_next = jax.lax.ppermute(
+        x[0], axis_name, perm=[(i, i - 1) for i in range(1, n)]
+    )
+    idx = jax.lax.axis_index(axis_name)
+    last = jnp.where(idx == n - 1, fill, from_next)
+    return jnp.concatenate([x[1:], last[None]], axis=0)
+
+
+def vtrace_timesharded(
+    behaviour_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    rho_clip: float = 1.0,
+    c_clip: float = 1.0,
+    axis_name: str = TIME_AXIS,
+):
+    """V-trace with the TIME axis sharded over ``axis_name`` (sequence
+    parallelism for long-horizon fragments — SURVEY.md §5.7).
+
+    Must run INSIDE shard_map over ``axis_name``; every input is the local
+    [T_local, B] segment (``bootstrap_value`` [B] is replicated; only the
+    last shard consumes it). Cross-shard communication: two one-hop
+    ``ppermute``s (the t+1 value/target shifts) + the tiny per-segment
+    all_gather inside the distributed scan. Matches ``ops.vtrace.vtrace``
+    on the gathered result exactly (tests/test_timeshard.py).
+    """
+    from asyncrl_tpu.ops.vtrace import VTraceOutput
+
+    log_rhos = target_logp - behaviour_logp
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(rho_clip, rhos)
+    clipped_cs = jnp.minimum(c_clip, rhos)
+
+    values_tp1 = shift_from_next_shard(values, bootstrap_value, axis_name)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+
+    vs_minus_v = reverse_linear_scan_timesharded(
+        jax.lax.stop_gradient(discounts * clipped_cs),
+        jax.lax.stop_gradient(deltas),
+        axis_name,
+    )
+    vs = vs_minus_v + values
+
+    vs_tp1 = shift_from_next_shard(vs, bootstrap_value, axis_name)
+    pg_advantages = clipped_rhos * (rewards + discounts * vs_tp1 - values)
+
+    # Global clip fraction: equal-sized shards -> pmean of local means.
+    rho_clip_frac = jax.lax.pmean(
+        jnp.mean((rhos > rho_clip).astype(jnp.float32)), axis_name
+    )
+    return VTraceOutput(
+        vs=jax.lax.stop_gradient(vs),
+        pg_advantages=jax.lax.stop_gradient(pg_advantages),
+        rho_clip_frac=rho_clip_frac,
+    )
+
+
+def gae_timesharded(
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    gae_lambda: float = 0.95,
+    axis_name: str = TIME_AXIS,
+):
+    """GAE with the time axis sharded over ``axis_name`` (see
+    ``vtrace_timesharded`` for the calling contract)."""
+    from asyncrl_tpu.ops.gae import GAEOutput
+
+    values_tp1 = shift_from_next_shard(values, bootstrap_value, axis_name)
+    deltas = rewards + discounts * values_tp1 - values
+    advantages = reverse_linear_scan_timesharded(
+        jax.lax.stop_gradient(discounts * gae_lambda),
+        jax.lax.stop_gradient(deltas),
+        axis_name,
+    )
+    returns = advantages + values
+    return GAEOutput(
+        advantages=jax.lax.stop_gradient(advantages),
+        returns=jax.lax.stop_gradient(returns),
+    )
+
+
 def make_timesharded_solver(
     mesh: Mesh, axis_name: str = TIME_AXIS
 ) -> Callable[[jax.Array, jax.Array], jax.Array]:

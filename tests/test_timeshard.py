@@ -72,3 +72,115 @@ def test_vtrace_consistency_long_fragment(devices):
     vs_minus_v = solver(jnp.asarray(discounts * cc), jnp.asarray(deltas))
     vs = np.asarray(vs_minus_v) + values
     np.testing.assert_allclose(vs, np.asarray(out.vs), rtol=1e-4, atol=1e-4)
+
+
+def test_shift_from_next_shard(devices):
+    """x[t+1] across shard boundaries: last shard's tail gets the fill."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from asyncrl_tpu.parallel.timeshard import shift_from_next_shard
+
+    mesh = Mesh(np.array(devices), ("sp",))
+    T, B = 32, 3
+    x = jnp.arange(T * B, dtype=jnp.float32).reshape(T, B)
+    fill = jnp.full((B,), -1.0)
+
+    out = jax.jit(
+        jax.shard_map(
+            lambda x: shift_from_next_shard(x, fill, "sp"),
+            mesh=mesh,
+            in_specs=(P("sp"),),
+            out_specs=P("sp"),
+        )
+    )(x)
+    want = jnp.concatenate([x[1:], fill[None]], axis=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+def test_vtrace_timesharded_matches_single_device(devices):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from asyncrl_tpu.ops.vtrace import VTraceOutput, vtrace
+    from asyncrl_tpu.parallel.timeshard import vtrace_timesharded
+
+    mesh = Mesh(np.array(devices), ("sp",))
+    T, B = 64, 5
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 5)
+    behaviour_logp = jax.random.normal(ks[0], (T, B)) * 0.1 - 1.0
+    target_logp = jax.random.normal(ks[1], (T, B)) * 0.1 - 1.0
+    rewards = jax.random.normal(ks[2], (T, B))
+    discounts = jnp.full((T, B), 0.99) * (
+        jax.random.uniform(ks[3], (T, B)) > 0.1
+    )
+    values = jax.random.normal(ks[4], (T, B))
+    bootstrap = jnp.ones((B,)) * 0.3
+
+    want = vtrace(
+        behaviour_logp, target_logp, rewards, discounts, values, bootstrap
+    )
+
+    sharded = jax.jit(
+        jax.shard_map(
+            lambda bl, tl, r, d, v: vtrace_timesharded(
+                bl, tl, r, d, v, bootstrap, axis_name="sp"
+            ),
+            mesh=mesh,
+            in_specs=(P("sp"),) * 5,
+            out_specs=VTraceOutput(
+                vs=P("sp"), pg_advantages=P("sp"), rho_clip_frac=P()
+            ),
+        )
+    )(behaviour_logp, target_logp, rewards, discounts, values)
+
+    np.testing.assert_allclose(
+        np.asarray(sharded.vs), np.asarray(want.vs), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.pg_advantages),
+        np.asarray(want.pg_advantages),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        float(sharded.rho_clip_frac), float(want.rho_clip_frac), rtol=1e-6
+    )
+
+
+def test_gae_timesharded_matches_single_device(devices):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from asyncrl_tpu.ops.gae import GAEOutput, gae
+    from asyncrl_tpu.parallel.timeshard import gae_timesharded
+
+    mesh = Mesh(np.array(devices), ("sp",))
+    T, B = 40, 4
+    key = jax.random.PRNGKey(1)
+    ks = jax.random.split(key, 3)
+    rewards = jax.random.normal(ks[0], (T, B))
+    discounts = jnp.full((T, B), 0.97) * (
+        jax.random.uniform(ks[1], (T, B)) > 0.05
+    )
+    values = jax.random.normal(ks[2], (T, B))
+    bootstrap = jnp.ones((B,)) * -0.2
+
+    want = gae(rewards, discounts, values, bootstrap, gae_lambda=0.9)
+    sharded = jax.jit(
+        jax.shard_map(
+            lambda r, d, v: gae_timesharded(
+                r, d, v, bootstrap, gae_lambda=0.9, axis_name="sp"
+            ),
+            mesh=mesh,
+            in_specs=(P("sp"),) * 3,
+            out_specs=GAEOutput(advantages=P("sp"), returns=P("sp")),
+        )
+    )(rewards, discounts, values)
+
+    np.testing.assert_allclose(
+        np.asarray(sharded.advantages), np.asarray(want.advantages),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.returns), np.asarray(want.returns),
+        rtol=1e-5, atol=1e-6,
+    )
