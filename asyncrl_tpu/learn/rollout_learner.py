@@ -32,7 +32,8 @@ from asyncrl_tpu.learn.learner import (
 )
 from asyncrl_tpu.models.networks import is_recurrent
 from asyncrl_tpu.ops import distributions
-from asyncrl_tpu.parallel.mesh import dp_axes
+from asyncrl_tpu.ops.losses import a3c_loss, impala_loss, ppo_loss
+from asyncrl_tpu.parallel.mesh import TIME_AXIS, dp_axes
 from asyncrl_tpu.rollout.buffer import Rollout
 from asyncrl_tpu.utils.config import Config
 
@@ -55,18 +56,23 @@ def learner_state_spec() -> LearnerState:
     return LearnerState(params=P(), opt_state=P(), update_step=P())
 
 
-def rollout_partition_spec(axes: tuple[str, ...]) -> Rollout:
+def rollout_partition_spec(
+    axes: tuple[str, ...], time_axis: str | None = None
+) -> Rollout:
     """Time-major [T, B, ...] fragments, batch dim sharded over all
-    data-parallel axes. ``init_core``'s P is a pytree PREFIX: it applies to
-    every leaf of the recurrent (c, h) carry when present, and to nothing
-    for feed-forward fragments (None = empty subtree)."""
+    data-parallel axes; with ``time_axis`` set (sequence parallelism,
+    SURVEY.md §5.7) the T dim shards over it too. ``init_core``'s P is a
+    pytree PREFIX: it applies to every leaf of the recurrent (c, h) carry
+    when present, and to nothing for feed-forward fragments (None = empty
+    subtree)."""
+    tm = P(time_axis, axes)
     return Rollout(
-        obs=P(None, axes),
-        actions=P(None, axes),
-        behaviour_logp=P(None, axes),
-        rewards=P(None, axes),
-        terminated=P(None, axes),
-        truncated=P(None, axes),
+        obs=tm,
+        actions=tm,
+        behaviour_logp=tm,
+        rewards=tm,
+        terminated=tm,
+        truncated=tm,
         bootstrap_obs=P(axes),
         init_core=P(axes),
     )
@@ -77,7 +83,8 @@ def rollout_sharding(mesh: Mesh, rollout: Rollout) -> Rollout:
     against the fragment's own pytree structure (device_put needs an exact
     structural match, unlike shard_map's prefix specs)."""
     axes = dp_axes(mesh)
-    time_major = NamedSharding(mesh, P(None, axes))
+    time_axis = TIME_AXIS if TIME_AXIS in mesh.axis_names else None
+    time_major = NamedSharding(mesh, P(time_axis, axes))
     batch_first = NamedSharding(mesh, P(axes))
     return Rollout(
         obs=time_major,
@@ -104,6 +111,27 @@ class RolloutLearner:
 
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
+        time_sharded = TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1
+        if time_sharded:
+            sp = mesh.shape[TIME_AXIS]
+            if config.unroll_len % sp:
+                raise ValueError(
+                    f"unroll_len={config.unroll_len} not divisible by the "
+                    f"time-shard axis sp={sp}"
+                )
+            if is_recurrent(model):
+                raise NotImplementedError(
+                    "recurrent cores cannot be time-sharded (the carry is "
+                    "sequential across the whole fragment); use a dp-only "
+                    "mesh for core='lstm'"
+                )
+            if config.algo == "ppo" and (
+                config.ppo_epochs > 1 or config.ppo_minibatches > 1
+            ):
+                raise NotImplementedError(
+                    "multi-epoch/minibatched PPO is not time-shardable; "
+                    "use ppo_epochs=ppo_minibatches=1"
+                )
         config = resolve_scan_impl(config, mesh)
         self.config = config
         self.spec = spec
@@ -119,6 +147,10 @@ class RolloutLearner:
         optimizer = self.optimizer
 
         axes = dp_axes(mesh)
+        # Gradient/metric reduction spans every axis the fragment is
+        # sharded over: batch axes always, plus the time axis when the
+        # fragment's T dim is sequence-parallel.
+        reduce_axes = axes + ((TIME_AXIS,) if time_sharded else ())
 
         def update_body(state: LearnerState, rollout: Rollout):
             if ppo_multipass:
@@ -129,14 +161,24 @@ class RolloutLearner:
                 )
             else:
                 # Same implicit-psum gradient scaling as the Anakin step:
-                # replicated-param grads are psum'd across dp during
-                # transposition, so local loss is scaled by 1/axis_size.
+                # replicated-param grads are psum'd across every sharded
+                # axis during transposition, so local loss is scaled by
+                # 1/axis_size of ALL of them.
                 def scaled_loss(p):
-                    loss, metrics = _algo_loss(
-                        config, apply_fn, p, rollout,
-                        axis_name=axes, dist=dist,
+                    if time_sharded:
+                        loss, metrics = _algo_loss_timesharded(
+                            config, apply_fn, p, rollout,
+                            reduce_axes=reduce_axes, dist=dist,
+                        )
+                    else:
+                        loss, metrics = _algo_loss(
+                            config, apply_fn, p, rollout,
+                            axis_name=axes, dist=dist,
+                        )
+                    return (
+                        loss / jax.lax.axis_size(reduce_axes),
+                        (loss, metrics),
                     )
-                    return loss / jax.lax.axis_size(axes), (loss, metrics)
 
                 (_, (loss, metrics)), grads = jax.value_and_grad(
                     scaled_loss, has_aux=True
@@ -147,8 +189,8 @@ class RolloutLearner:
                 )
                 params = optax.apply_updates(state.params, updates)
 
-            metrics = dict(jax.lax.pmean(metrics, axes))
-            metrics["loss"] = jax.lax.pmean(loss, axes)
+            metrics = dict(jax.lax.pmean(metrics, reduce_axes))
+            metrics["loss"] = jax.lax.pmean(loss, reduce_axes)
             metrics["grad_norm"] = grad_norm
             new_state = LearnerState(
                 params=params,
@@ -167,7 +209,12 @@ class RolloutLearner:
             jax.shard_map(
                 update_body,
                 mesh=mesh,
-                in_specs=(sspec, rollout_partition_spec(axes)),
+                in_specs=(
+                    sspec,
+                    rollout_partition_spec(
+                        axes, TIME_AXIS if time_sharded else None
+                    ),
+                ),
                 out_specs=(sspec, P()),
             ),
         )

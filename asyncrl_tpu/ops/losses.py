@@ -38,15 +38,20 @@ def a3c_loss(
     entropy_coef: float = 0.01,
     dist=None,
     scan_impl: str = "associative",
+    returns=None,
 ):
     """n-step-return actor-critic loss (A3C, PAPERS.md:8).
 
     returns R_t are full-fragment discounted returns bootstrapped from
     V(x_T); advantage = R_t - V_t with stop-gradient on the target.
+    ``returns`` may be passed precomputed (the time-sharded learner builds
+    them with ``parallel.timeshard.n_step_returns_timesharded``).
     """
-    returns = jax.lax.stop_gradient(
-        n_step_returns(rewards, discounts, bootstrap_value, scan_impl=scan_impl)
-    )
+    if returns is None:
+        returns = n_step_returns(
+            rewards, discounts, bootstrap_value, scan_impl=scan_impl
+        )
+    returns = jax.lax.stop_gradient(returns)
     advantages = returns - values
     logp = dist.logp(logits, actions) if dist else categorical_logp(logits, actions)
     pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(advantages))
@@ -76,11 +81,14 @@ def impala_loss(
     c_clip: float = 1.0,
     dist=None,
     scan_impl: str = "associative",
+    vtrace_out=None,
 ):
     """IMPALA: V-trace corrected policy gradient + value + entropy
-    (BASELINE.json:5 'V-trace correction + policy-gradient/value loss')."""
+    (BASELINE.json:5 'V-trace correction + policy-gradient/value loss').
+    ``vtrace_out`` may be passed precomputed (the time-sharded learner
+    builds it with ``parallel.timeshard.vtrace_timesharded``)."""
     target_logp = dist.logp(logits, actions) if dist else categorical_logp(logits, actions)
-    vt = vtrace(
+    vt = vtrace_out if vtrace_out is not None else vtrace(
         behaviour_logp=behaviour_logp,
         target_logp=target_logp,
         rewards=rewards,

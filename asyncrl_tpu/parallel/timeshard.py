@@ -166,6 +166,28 @@ def gae_timesharded(
     )
 
 
+def n_step_returns_timesharded(
+    rewards: jax.Array,
+    discounts: jax.Array,
+    bootstrap_value: jax.Array,
+    axis_name: str = TIME_AXIS,
+) -> jax.Array:
+    """Time-sharded discounted n-step returns (A3C targets): the bootstrap
+    folds into the LAST shard's final step; everything else is the
+    distributed reverse scan."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    is_last = (idx == n - 1).astype(rewards.dtype)
+    rewards_ext = rewards.at[-1].add(
+        is_last * discounts[-1] * bootstrap_value
+    )
+    return reverse_linear_scan_timesharded(
+        jax.lax.stop_gradient(discounts),
+        jax.lax.stop_gradient(rewards_ext),
+        axis_name,
+    )
+
+
 def make_timesharded_solver(
     mesh: Mesh, axis_name: str = TIME_AXIS
 ) -> Callable[[jax.Array, jax.Array], jax.Array]:
