@@ -25,6 +25,20 @@ def make_agent(
     """
     config = (config or Config()).replace(**overrides)
 
+    # Fail fast on enum-like fields the backends only consult at trace time
+    # (a bad algo would otherwise surface mid-train, after env/model build).
+    if config.algo not in ("a3c", "impala", "ppo"):
+        raise ValueError(
+            f"unknown algo {config.algo!r}; expected a3c|impala|ppo"
+        )
+    if config.torso not in ("mlp", "nature_cnn", "impala_cnn"):
+        raise ValueError(
+            f"unknown torso {config.torso!r}; expected "
+            "mlp|nature_cnn|impala_cnn"
+        )
+    if config.core not in ("ff", "lstm"):
+        raise ValueError(f"unknown core {config.core!r}; expected ff|lstm")
+
     if config.backend == "tpu":
         from asyncrl_tpu.api.trainer import Trainer
 

@@ -92,6 +92,30 @@ brax_ppo = Config(
     ppo_minibatches=8,
 )
 
+# BASELINE.json:11 with *rigid-body* on-TPU physics: the planar locomotion
+# family (envs/locomotion.py, engine envs/physics2d.py) — articulated
+# multi-joint control like Brax Ant/Humanoid, with physics+rollout+update
+# fused into one XLA program at 8192 HBM-resident worlds.
+hopper_ppo = Config(
+    env_id="JaxHopper-v0",
+    algo="ppo",
+    backend="tpu",
+    num_envs=8192,
+    unroll_len=32,
+    total_env_steps=30_000_000,
+    learning_rate=3e-4,
+    gamma=0.99,
+    gae_lambda=0.95,
+    entropy_coef=0.001,
+    reward_scale=0.1,
+    ppo_epochs=4,
+    ppo_minibatches=8,
+    torso="mlp",
+    hidden_sizes=(256, 256),
+)
+walker_ppo = hopper_ppo.replace(env_id="JaxWalker2d-v0")
+halfcheetah_ppo = hopper_ppo.replace(env_id="JaxHalfCheetah-v0")
+
 # Extra smoke presets used by tests and quick benchmarking.
 cartpole_impala = cartpole_a3c.replace(algo="impala", actor_staleness=2)
 cartpole_ppo = cartpole_a3c.replace(algo="ppo", learning_rate=3e-4)
@@ -143,6 +167,9 @@ PRESETS: dict[str, Config] = {
     "breakout_impala": breakout_impala,
     "procgen_ppo": procgen_ppo,
     "brax_ppo": brax_ppo,
+    "hopper_ppo": hopper_ppo,
+    "walker_ppo": walker_ppo,
+    "halfcheetah_ppo": halfcheetah_ppo,
     "mujoco_ant_ppo": mujoco_ant_ppo,
     "mujoco_humanoid_ppo": mujoco_humanoid_ppo,
 }

@@ -1,0 +1,310 @@
+"""Planar locomotion tasks on the pure-JAX physics engine
+(``envs/physics2d.py``): JaxHopper, JaxWalker2d, JaxHalfCheetah.
+
+These are the on-TPU-physics continuous-control workloads standing in for
+the reference's Brax Ant/Humanoid PPO config (BASELINE.json:11): physics,
+rollout, and learning all fuse into one XLA program, and the env batch
+(8192 in the ``brax_ppo``-family presets) lives in HBM. Observation layouts,
+reward shapes (forward velocity + healthy bonus − control cost), and
+termination rules follow the classic MuJoCo task family so hyperparameters
+transfer; dynamics come from the penalty-based planar engine, not MuJoCo —
+the real MuJoCo Ant/Humanoid run via the Sebulba host path instead
+(``configs/presets.py::mujoco_ant_ppo``).
+
+Observation vector (length 5 + 2·nj):
+  [torso_z, torso_angle, rel_joint_angles…, torso_vx, torso_vz,
+   torso_angvel, rel_joint_vels…]
+matching the MuJoCo convention of excluding absolute x. Hopper: 11 dims,
+Walker2d/HalfCheetah: 17 dims, as in gymnasium.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from asyncrl_tpu.envs import physics2d
+from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+from asyncrl_tpu.envs.physics2d import Builder, PhysicsState, System
+
+MAX_STEPS = 1000
+
+
+@struct.dataclass
+class LocomotionState:
+    phys: PhysicsState
+    t: jax.Array  # int32 step counter
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskParams:
+    """Per-task reward/termination knobs (MuJoCo-family defaults)."""
+
+    forward_weight: float = 1.0
+    healthy_reward: float = 1.0
+    ctrl_cost: float = 1e-3
+    # Termination window on torso pose; None disables (HalfCheetah).
+    healthy_z: tuple[float, float] | None = None
+    healthy_angle: tuple[float, float] | None = None
+    reset_noise: float = 5e-3
+
+
+class LocomotionEnv(Environment):
+    """Shared stepper for the planar locomotion family."""
+
+    def __init__(
+        self,
+        sys: System,
+        init_pos: np.ndarray,
+        params: TaskParams,
+        torso: int = 0,
+    ):
+        self.sys = sys
+        self.params = params
+        self.torso = torso
+        self._init_pos = jnp.asarray(init_pos, jnp.float32)
+        nj = sys.nj
+        self.spec = EnvSpec(
+            obs_shape=(5 + 2 * nj,), continuous=True, action_dim=nj
+        )
+
+    def init(self, key: jax.Array) -> LocomotionState:
+        nb = self.sys.nb
+        k1, k2, k3 = jax.random.split(key, 3)
+        noise = self.params.reset_noise
+        phys = PhysicsState(
+            pos=self._init_pos
+            + jax.random.uniform(k1, (nb, 2), jnp.float32, -noise, noise),
+            angle=jax.random.uniform(k2, (nb,), jnp.float32, -noise, noise),
+            vel=jnp.zeros((nb, 2), jnp.float32),
+            angvel=jax.random.uniform(
+                k3, (nb,), jnp.float32, -noise, noise
+            ),
+        )
+        return LocomotionState(phys=phys, t=jnp.zeros((), jnp.int32))
+
+    def observe(self, state: LocomotionState) -> jax.Array:
+        s = state.phys
+        jp = jnp.asarray(self.sys.j_parent)
+        jc = jnp.asarray(self.sys.j_child)
+        return jnp.concatenate(
+            [
+                s.pos[self.torso, 1][None],
+                s.angle[self.torso][None],
+                s.angle[jc] - s.angle[jp],
+                s.vel[self.torso],
+                s.angvel[self.torso][None],
+                s.angvel[jc] - s.angvel[jp],
+            ]
+        )
+
+    def _unhealthy(self, s: PhysicsState) -> jax.Array:
+        p = self.params
+        bad = jnp.zeros((), bool)
+        if p.healthy_z is not None:
+            z = s.pos[self.torso, 1]
+            bad |= (z < p.healthy_z[0]) | (z > p.healthy_z[1])
+        if p.healthy_angle is not None:
+            a = s.angle[self.torso]
+            bad |= (a < p.healthy_angle[0]) | (a > p.healthy_angle[1])
+        return bad
+
+    def step(
+        self, state: LocomotionState, action: jax.Array, key: jax.Array
+    ) -> tuple[LocomotionState, TimeStep]:
+        p = self.params
+        a = jnp.clip(action, -1.0, 1.0)
+        torque = a * jnp.asarray(self.sys.j_gear, jnp.float32)
+        phys = physics2d.step(self.sys, state.phys, torque)
+
+        reward = (
+            p.forward_weight * phys.vel[self.torso, 0]
+            + p.healthy_reward
+            - p.ctrl_cost * jnp.sum(jnp.square(a))
+        )
+        # Blow-up guard: penalty physics can diverge under adversarial
+        # torque sequences; treat it as termination, not NaN propagation.
+        exploded = ~jnp.all(
+            jnp.isfinite(phys.pos)
+        ) | ~jnp.all(jnp.abs(phys.vel) < 100.0)
+        terminated = self._unhealthy(phys) | exploded
+        reward = jnp.where(exploded, 0.0, reward)
+
+        t = state.t + 1
+        truncated = (t >= MAX_STEPS) & ~terminated
+        done = terminated | truncated
+        ended = LocomotionState(phys=phys, t=t)
+        fresh = self.init(key)
+        new_state = jax.tree.map(
+            lambda f, e: jnp.where(done, f, e), fresh, ended
+        )
+        safe_ended = jax.tree.map(
+            lambda e, f: jnp.where(jnp.isfinite(e), e, f), ended, fresh
+        )
+        ts = TimeStep(
+            obs=self.observe(new_state),
+            reward=reward,
+            terminated=terminated,
+            truncated=truncated,
+            last_obs=self.observe(safe_ended),
+        )
+        return new_state, ts
+
+
+# --------------------------------------------------------------------------
+# Task constructions. Geometry: x forward, z up, ground plane z=0; bodies
+# are rods positioned by their centers; all initial angles are 0 with the
+# rod direction baked into anchors/contact points.
+
+
+def _leg(
+    b: Builder,
+    torso: int,
+    hip_anchor: tuple[float, float],
+    hip_z: float,
+    thigh_len: float,
+    shin_len: float,
+    foot_half: float,
+    masses: tuple[float, float, float],
+    gears: tuple[float, float, float],
+    foot_fwd: float = 0.5,
+) -> tuple[list[int], list[float]]:
+    """Append a thigh–shin–foot chain below ``hip_anchor`` on the torso.
+
+    Returns (body ids, body center heights). Knee bends backward
+    (relative angle ≤ 0), ankle is a small symmetric joint, matching the
+    hopper/walker template.
+    """
+    th_c = hip_z - thigh_len / 2
+    sh_c = hip_z - thigh_len - shin_len / 2
+    ft_z = hip_z - thigh_len - shin_len
+    thigh = b.add_body(masses[0], (0.0, thigh_len / 2))
+    shin = b.add_body(masses[1], (0.0, shin_len / 2))
+    foot = b.add_body(masses[2], (foot_half, 0.0))
+    b.add_joint(
+        torso, thigh, hip_anchor, (0.0, thigh_len / 2), (-1.0, 0.7), gears[0]
+    )
+    b.add_joint(
+        thigh,
+        shin,
+        (0.0, -thigh_len / 2),
+        (0.0, shin_len / 2),
+        (-2.2, 0.0),
+        gears[1],
+    )
+    # Foot center sits ahead of the ankle by foot_fwd·foot_half.
+    b.add_joint(
+        shin,
+        foot,
+        (0.0, -shin_len / 2),
+        (-foot_fwd * foot_half, 0.0),
+        (-0.6, 0.6),
+        gears[2],
+    )
+    b.add_contact(foot, (-foot_half, 0.0))
+    b.add_contact(foot, (foot_half, 0.0))
+    b.add_contact(shin, (0.0, -shin_len / 2))
+    return [thigh, shin, foot], [th_c, sh_c, ft_z]
+
+
+def make_hopper() -> LocomotionEnv:
+    """Single-leg hopper: 4 bodies, 3 motors, 11-dim obs (MuJoCo Hopper-v5
+    layout)."""
+    b = Builder()
+    torso_len, hip_z = 0.4, 1.05
+    torso = b.add_body(3.5, (0.0, torso_len / 2))
+    torso_c = hip_z + torso_len / 2
+    ids, zs = _leg(
+        b,
+        torso,
+        hip_anchor=(0.0, -torso_len / 2),
+        hip_z=hip_z,
+        thigh_len=0.45,
+        shin_len=0.5,
+        foot_half=0.195,
+        masses=(4.0, 2.7, 5.0),
+        gears=(150.0, 120.0, 60.0),
+    )
+    b.add_contact(torso, (0.0, torso_len / 2))
+    b.add_contact(torso, (0.0, -torso_len / 2))
+    sys = b.build()
+    foot_fwd_offset = 0.5 * 0.195
+    init = np.array(
+        [[0.0, torso_c]]
+        + [[0.0, zs[0]], [0.0, zs[1]], [foot_fwd_offset, zs[2] + 0.06]],
+        np.float32,
+    )
+    params = TaskParams(
+        healthy_z=(0.8, 2.2), healthy_angle=(-0.6, 0.6)
+    )
+    return LocomotionEnv(sys, init, params)
+
+
+def make_walker2d() -> LocomotionEnv:
+    """Two-leg walker: 7 bodies, 6 motors, 17-dim obs (Walker2d-v5
+    layout)."""
+    b = Builder()
+    torso_len, hip_z = 0.4, 1.05
+    torso = b.add_body(3.5, (0.0, torso_len / 2))
+    torso_c = hip_z + torso_len / 2
+    rows = [[0.0, torso_c]]
+    for _ in range(2):
+        ids, zs = _leg(
+            b,
+            torso,
+            hip_anchor=(0.0, -torso_len / 2),
+            hip_z=hip_z,
+            thigh_len=0.45,
+            shin_len=0.5,
+            foot_half=0.1,
+            masses=(4.0, 2.7, 3.0),
+            gears=(100.0, 100.0, 40.0),
+        )
+        rows += [[0.0, zs[0]], [0.0, zs[1]], [0.05, zs[2] + 0.06]]
+    b.add_contact(torso, (0.0, torso_len / 2))
+    b.add_contact(torso, (0.0, -torso_len / 2))
+    sys = b.build()
+    params = TaskParams(
+        healthy_z=(0.8, 2.2), healthy_angle=(-0.9, 0.9)
+    )
+    return LocomotionEnv(sys, np.asarray(rows, np.float32), params)
+
+
+def make_halfcheetah() -> LocomotionEnv:
+    """Horizontal-torso runner: 7 bodies, 6 motors, 17-dim obs
+    (HalfCheetah-v5 layout); never terminates, pure speed task."""
+    b = Builder()
+    torso_half, torso_z = 0.5, 0.64
+    torso = b.add_body(6.3, (torso_half, 0.0))
+    rows = [[0.0, torso_z]]
+    for sgn, masses, gears in (
+        (-1.0, (1.5, 1.6, 1.1), (120.0, 90.0, 60.0)),
+        (+1.0, (1.4, 1.2, 0.9), (120.0, 60.0, 30.0)),
+    ):
+        ids, zs = _leg(
+            b,
+            torso,
+            hip_anchor=(sgn * torso_half, 0.0),
+            hip_z=torso_z,
+            thigh_len=0.29,
+            shin_len=0.26,
+            foot_half=0.09,
+            masses=masses,
+            gears=gears,
+        )
+        rows += [
+            [sgn * torso_half, zs[0]],
+            [sgn * torso_half, zs[1]],
+            [sgn * torso_half + 0.045, zs[2] + 0.04],
+        ]
+    b.add_contact(torso, (-torso_half, 0.0))
+    b.add_contact(torso, (torso_half, 0.0))
+    sys = b.build()
+    params = TaskParams(
+        ctrl_cost=0.05, healthy_reward=0.0, healthy_z=None, healthy_angle=None
+    )
+    return LocomotionEnv(sys, np.asarray(rows, np.float32), params)

@@ -1,0 +1,269 @@
+"""Pure-JAX planar rigid-body physics: the on-TPU physics engine behind the
+Brax-workload stand-ins (BASELINE.json:11 — "Brax Ant/Humanoid (on-TPU
+physics), PPO, 8192 envs"; brax itself is absent from this image, SURVEY.md
+§7.4 R1).
+
+Design, TPU-first rather than a port of any CPU engine:
+
+- **Maximal coordinates + penalty constraints** (the design Brax's original
+  "spring" pipeline validated for RL): every body carries its own pose and
+  velocity; revolute joints are stiff spring-dampers pinning anchor points
+  together; ground contact is a one-sided spring with smooth Coulomb
+  friction. No iterative constraint solver, no data-dependent control flow —
+  each substep is a fixed pipeline of dense array ops, so the whole stepper
+  jits to one fused XLA program and ``vmap`` scales it to thousands of
+  parallel worlds in HBM.
+- **Static topology**: the articulation (bodies, joints, contact points) is
+  a set of frozen numpy index/parameter arrays baked into the closure at
+  trace time; XLA sees only fixed-shape gathers/scatters.
+- **Substepped semi-implicit Euler** via ``lax.scan`` — stiffness demands a
+  small dt; the scan keeps compile time flat in the substep count.
+
+The engine is deliberately planar (x up-axis z): 3 DoF/body keeps rotations
+scalar (no quaternions) while covering the classic locomotion family
+(hopper/walker/cheetah — ``envs/locomotion.py``) that stands in for Brax's
+Ant/Humanoid. Real MuJoCo Ant/Humanoid run through the Sebulba host path
+(``configs/presets.py::mujoco_ant_ppo``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+GRAVITY = 9.81
+
+
+@dataclasses.dataclass(frozen=True)
+class System:
+    """Static articulation description. All fields are numpy (trace-time
+    constants); shapes: nb bodies, nj joints, nc contact points.
+
+    Bodies are rods/capsules characterized by mass + rotational inertia.
+    Joints are revolute: they pin ``anchor_p`` (in parent frame) to
+    ``anchor_c`` (in child frame) with a stiff spring-damper and constrain
+    the relative angle ``angle[child] - angle[parent]`` to ``limit`` with a
+    penalty torque; ``gear`` scales the motor torque (0 = passive).
+    Contact points are body-frame points that collide with the ground plane
+    z=0.
+    """
+
+    mass: np.ndarray  # [nb]
+    inertia: np.ndarray  # [nb]
+    j_parent: np.ndarray  # [nj] int32
+    j_child: np.ndarray  # [nj] int32
+    j_anchor_p: np.ndarray  # [nj, 2]
+    j_anchor_c: np.ndarray  # [nj, 2]
+    j_limit: np.ndarray  # [nj, 2] (lo, hi) relative angle
+    j_gear: np.ndarray  # [nj] motor torque scale
+    c_body: np.ndarray  # [nc] int32
+    c_point: np.ndarray  # [nc, 2] body-frame offsets
+    # Solver constants (per-system so tasks can tune stiffness to mass scale).
+    joint_stiffness: float = 8000.0
+    joint_damping: float = 80.0
+    limit_stiffness: float = 120.0
+    limit_damping: float = 4.0
+    joint_friction: float = 0.3  # passive damping torque on relative angvel
+    contact_stiffness: float = 12000.0
+    contact_damping: float = 150.0
+    friction_mu: float = 0.9
+    slip_vel: float = 0.08  # tanh friction smoothing scale (m/s)
+    substeps: int = 48
+    dt: float = 0.048  # control timestep; dt/substeps = physics step
+
+    @property
+    def nb(self) -> int:
+        return int(self.mass.shape[0])
+
+    @property
+    def nj(self) -> int:
+        return int(self.j_parent.shape[0])
+
+
+@struct.dataclass
+class PhysicsState:
+    pos: jax.Array  # [nb, 2] (x, z)
+    angle: jax.Array  # [nb]
+    vel: jax.Array  # [nb, 2]
+    angvel: jax.Array  # [nb]
+
+
+def _rot(angle: jax.Array, v: jax.Array) -> jax.Array:
+    """Rotate body-frame vectors v [..., 2] by angle [...]."""
+    c, s = jnp.cos(angle), jnp.sin(angle)
+    x, z = v[..., 0], v[..., 1]
+    return jnp.stack([c * x - s * z, s * x + c * z], axis=-1)
+
+
+def _cross2(r: jax.Array, f: jax.Array) -> jax.Array:
+    """Planar cross product r × f → scalar torque."""
+    return r[..., 0] * f[..., 1] - r[..., 1] * f[..., 0]
+
+
+def _perp(omega: jax.Array, r: jax.Array) -> jax.Array:
+    """Velocity of a point at offset r on a body spinning at omega: ω × r."""
+    return jnp.stack([-omega * r[..., 1], omega * r[..., 0]], axis=-1)
+
+
+def step(
+    sys: System, state: PhysicsState, motor_torque: jax.Array
+) -> PhysicsState:
+    """Advance one control step (``sys.substeps`` physics substeps).
+
+    ``motor_torque`` is [nj], already scaled by the task (actions × gear
+    happen in the env so it can also add action cost); passive joints simply
+    carry zero.
+    """
+    mass = jnp.asarray(sys.mass, jnp.float32)
+    inertia = jnp.asarray(sys.inertia, jnp.float32)
+    jp = jnp.asarray(sys.j_parent)
+    jc = jnp.asarray(sys.j_child)
+    anchor_p = jnp.asarray(sys.j_anchor_p, jnp.float32)
+    anchor_c = jnp.asarray(sys.j_anchor_c, jnp.float32)
+    limit = jnp.asarray(sys.j_limit, jnp.float32)
+    cb = jnp.asarray(sys.c_body)
+    cpt = jnp.asarray(sys.c_point, jnp.float32)
+    h = sys.dt / sys.substeps
+
+    def substep(s: PhysicsState, _):
+        force = jnp.zeros_like(s.pos)
+        torque = jnp.zeros_like(s.angle)
+
+        # Gravity.
+        force = force.at[:, 1].add(-GRAVITY * mass)
+
+        # --- Revolute joints: spring-damper pinning anchors together. ---
+        r_p = _rot(s.angle[jp], anchor_p)  # world-frame lever arms
+        r_c = _rot(s.angle[jc], anchor_c)
+        p_w = s.pos[jp] + r_p
+        c_w = s.pos[jc] + r_c
+        v_p = s.vel[jp] + _perp(s.angvel[jp], r_p)
+        v_c = s.vel[jc] + _perp(s.angvel[jc], r_c)
+        f_j = sys.joint_stiffness * (p_w - c_w) + sys.joint_damping * (
+            v_p - v_c
+        )  # force ON child (pulls child anchor toward parent anchor)
+        force = force.at[jc].add(f_j)
+        force = force.at[jp].add(-f_j)
+        torque = torque.at[jc].add(_cross2(r_c, f_j))
+        torque = torque.at[jp].add(_cross2(r_p, -f_j))
+
+        # --- Joint-limit penalty + passive friction + motors. ---
+        rel = s.angle[jc] - s.angle[jp]
+        rel_vel = s.angvel[jc] - s.angvel[jp]
+        below = jnp.minimum(rel - limit[:, 0], 0.0)
+        above = jnp.maximum(rel - limit[:, 1], 0.0)
+        t_j = (
+            -sys.limit_stiffness * (below + above)
+            - sys.limit_damping
+            * rel_vel
+            * ((below < 0.0) | (above > 0.0)).astype(jnp.float32)
+            - sys.joint_friction * rel_vel
+            + motor_torque
+        )
+        torque = torque.at[jc].add(t_j)
+        torque = torque.at[jp].add(-t_j)
+
+        # --- Ground contact: one-sided normal spring + smooth friction. ---
+        r_k = _rot(s.angle[cb], cpt)
+        p_k = s.pos[cb] + r_k
+        v_k = s.vel[cb] + _perp(s.angvel[cb], r_k)
+        depth = jnp.maximum(-p_k[:, 1], 0.0)
+        in_contact = (depth > 0.0).astype(jnp.float32)
+        f_n = jnp.maximum(
+            sys.contact_stiffness * depth
+            - sys.contact_damping * v_k[:, 1] * in_contact,
+            0.0,
+        )
+        f_t = -sys.friction_mu * f_n * jnp.tanh(v_k[:, 0] / sys.slip_vel)
+        f_k = jnp.stack([f_t, f_n], axis=-1)
+        force = force.at[cb].add(f_k)
+        torque = torque.at[cb].add(_cross2(r_k, f_k))
+
+        # --- Semi-implicit Euler. ---
+        vel = s.vel + h * force / mass[:, None]
+        angvel = s.angvel + h * torque / inertia
+        return (
+            PhysicsState(
+                pos=s.pos + h * vel,
+                angle=s.angle + h * angvel,
+                vel=vel,
+                angvel=angvel,
+            ),
+            None,
+        )
+
+    out, _ = jax.lax.scan(substep, state, None, length=sys.substeps)
+    return out
+
+
+# --------------------------------------------------------------------------
+# System construction helpers (numpy, trace-time).
+
+
+class Builder:
+    """Accumulates bodies/joints/contacts into a :class:`System`.
+
+    Bodies are uniform rods: ``add_body`` takes the rod half-extent vector
+    in the body frame (center to tip); inertia is m·L²/12.
+    """
+
+    def __init__(self, **solver_overrides):
+        self._mass: list[float] = []
+        self._inertia: list[float] = []
+        self._joints: list[tuple] = []
+        self._contacts: list[tuple[int, tuple[float, float]]] = []
+        self._solver = solver_overrides
+
+    def add_body(self, mass: float, half_extent: tuple[float, float]) -> int:
+        length_sq = 4.0 * (half_extent[0] ** 2 + half_extent[1] ** 2)
+        self._mass.append(mass)
+        # Thin-rod inertia with a floor (≈ a 15 cm rod's) — very short
+        # bodies (feet) otherwise spin at frequencies the substep can't
+        # integrate stably.
+        self._inertia.append(mass * max(length_sq / 12.0, 1.9e-3))
+        return len(self._mass) - 1
+
+    def add_joint(
+        self,
+        parent: int,
+        child: int,
+        anchor_p: tuple[float, float],
+        anchor_c: tuple[float, float],
+        limit: tuple[float, float],
+        gear: float,
+    ) -> int:
+        self._joints.append((parent, child, anchor_p, anchor_c, limit, gear))
+        return len(self._joints) - 1
+
+    def add_contact(self, body: int, point: tuple[float, float]) -> int:
+        self._contacts.append((body, point))
+        return len(self._contacts) - 1
+
+    def build(self) -> System:
+        nj = len(self._joints)
+        nc = len(self._contacts)
+        return System(
+            mass=np.asarray(self._mass, np.float32),
+            inertia=np.asarray(self._inertia, np.float32),
+            j_parent=np.asarray([j[0] for j in self._joints], np.int32),
+            j_child=np.asarray([j[1] for j in self._joints], np.int32),
+            j_anchor_p=np.asarray(
+                [j[2] for j in self._joints], np.float32
+            ).reshape(nj, 2),
+            j_anchor_c=np.asarray(
+                [j[3] for j in self._joints], np.float32
+            ).reshape(nj, 2),
+            j_limit=np.asarray([j[4] for j in self._joints], np.float32).reshape(
+                nj, 2
+            ),
+            j_gear=np.asarray([j[5] for j in self._joints], np.float32),
+            c_body=np.asarray([c[0] for c in self._contacts], np.int32),
+            c_point=np.asarray(
+                [c[1] for c in self._contacts], np.float32
+            ).reshape(nc, 2),
+            **self._solver,
+        )

@@ -33,6 +33,11 @@ def registered() -> list[str]:
 def _register_builtins() -> None:
     from asyncrl_tpu.envs.breakout import Breakout, BreakoutPixels
     from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.envs.locomotion import (
+        make_halfcheetah,
+        make_hopper,
+        make_walker2d,
+    )
     from asyncrl_tpu.envs.pendulum import Pendulum
     from asyncrl_tpu.envs.pong import Pong, PongPixels
 
@@ -42,6 +47,10 @@ def _register_builtins() -> None:
     register("JaxBreakout-v0", Breakout)
     register("JaxBreakoutPixels-v0", BreakoutPixels)
     register("JaxPendulum-v0", Pendulum)
+    # On-TPU rigid-body physics (Brax-workload stand-ins, BASELINE.json:11).
+    register("JaxHopper-v0", make_hopper)
+    register("JaxWalker2d-v0", make_walker2d)
+    register("JaxHalfCheetah-v0", make_halfcheetah)
 
 
 _register_builtins()

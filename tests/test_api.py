@@ -36,6 +36,16 @@ def test_make_agent_unknown_backend():
         make_agent(backend="gpu_cluster")
 
 
+def test_make_agent_rejects_bad_enums_eagerly():
+    for kw in (
+        dict(algo="dqn"),
+        dict(torso="transformer"),
+        dict(core="gru"),
+    ):
+        with pytest.raises(ValueError):
+            make_agent(**kw)
+
+
 def test_make_agent_train_smoke(devices):
     agent = make_agent(
         env_id="CartPole-v1", algo="a3c", backend="tpu",

@@ -1,0 +1,275 @@
+"""Physics-engine invariants (envs/physics2d.py) and locomotion-family env
+contracts (envs/locomotion.py) — the on-TPU-physics Brax-workload stand-ins
+(BASELINE.json:11, SURVEY.md §7.4 R1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu.envs import physics2d
+from asyncrl_tpu.envs.locomotion import (
+    MAX_STEPS,
+    make_halfcheetah,
+    make_hopper,
+    make_walker2d,
+)
+from asyncrl_tpu.envs.physics2d import Builder, PhysicsState
+
+ALL_TASKS = [
+    ("hopper", make_hopper, 11, 3),
+    ("walker2d", make_walker2d, 17, 6),
+    ("halfcheetah", make_halfcheetah, 17, 6),
+]
+
+
+def test_free_body_is_exact_projectile():
+    """With no joints/contacts engaged, integration must reduce to ballistic
+    motion — semi-implicit Euler is exact for constant acceleration up to
+    the discrete-sum correction, so compare against the discrete solution."""
+    b = Builder()
+    b.add_body(2.0, (0.1, 0.0))
+    sys = b.build()
+    state = PhysicsState(
+        pos=jnp.array([[0.0, 100.0]]),
+        angle=jnp.array([0.3]),
+        vel=jnp.array([[3.0, 1.0]]),
+        angvel=jnp.array([0.7]),
+    )
+    n = 10
+    out = state
+    for _ in range(n):
+        out = physics2d.step(sys, out, jnp.zeros((0,)))
+    t = n * sys.dt
+    h = sys.dt / sys.substeps
+    steps = n * sys.substeps
+    # Semi-implicit Euler: x(t) = x0 + sum_k h*(v0 + k*h*g), k=1..steps.
+    z_expected = 100.0 + 1.0 * t - physics2d.GRAVITY * h * h * steps * (steps + 1) / 2
+    np.testing.assert_allclose(float(out.pos[0, 0]), 3.0 * t, rtol=1e-5)
+    np.testing.assert_allclose(float(out.pos[0, 1]), z_expected, rtol=1e-5)
+    np.testing.assert_allclose(float(out.angle[0]), 0.3 + 0.7 * t, rtol=1e-5)
+
+
+def test_joint_holds_anchors_together():
+    """A two-rod pendulum swinging under gravity: the revolute joint's
+    anchor points must stay coincident to within the penalty tolerance."""
+    b = Builder()
+    top = b.add_body(1.0, (0.0, 0.25))
+    bot = b.add_body(1.0, (0.0, 0.25))
+    b.add_joint(top, bot, (0.0, -0.25), (0.0, 0.25), (-3.0, 3.0), 0.0)
+    sys = b.build()
+    # Hang from a stiff joint to a heavy anchor body standing on the ground
+    # is not needed: just let the chain free-fall briefly and swing; check
+    # anchor coincidence every step.
+    state = PhysicsState(
+        pos=jnp.array([[0.0, 1.0], [0.35, 0.65]]),  # bottom rod kicked out
+        angle=jnp.array([0.0, 1.2]),
+        vel=jnp.zeros((2, 2)),
+        angvel=jnp.zeros((2,)),
+    )
+    step = jax.jit(lambda s: physics2d.step(sys, s, jnp.zeros((1,))))
+    worst = 0.0
+    for _ in range(50):
+        state = step(state)
+        pa = state.pos[0] + physics2d._rot(state.angle[0], jnp.array([0.0, -0.25]))
+        pb = state.pos[1] + physics2d._rot(state.angle[1], jnp.array([0.0, 0.25]))
+        worst = max(worst, float(jnp.linalg.norm(pa - pb)))
+    assert worst < 0.05, worst  # anchors stay within 5 cm through the swing
+
+
+def test_internal_forces_conserve_momentum():
+    """Joint + limit + motor forces are equal-and-opposite: with gravity the
+    only external force (no contacts), horizontal momentum is conserved and
+    vertical momentum follows -M·g·t."""
+    b = Builder()
+    a_ = b.add_body(1.0, (0.0, 0.3))
+    c_ = b.add_body(2.0, (0.0, 0.2))
+    b.add_joint(a_, c_, (0.0, -0.3), (0.0, 0.2), (-0.4, 0.4), 50.0)
+    sys = b.build()
+    state = PhysicsState(
+        pos=jnp.array([[0.0, 50.0], [0.1, 49.4]]),
+        angle=jnp.array([0.0, 0.5]),
+        vel=jnp.array([[1.0, 0.0], [-0.5, 0.2]]),
+        angvel=jnp.array([2.0, -1.0]),
+    )
+    mass = jnp.asarray(sys.mass)
+    p0 = jnp.sum(mass[:, None] * state.vel, axis=0)
+    n = 5
+    out = state
+    for _ in range(n):
+        out = physics2d.step(sys, out, jnp.array([0.8]))  # motor torque on
+    p1 = jnp.sum(mass[:, None] * out.vel, axis=0)
+    t = n * sys.dt
+    np.testing.assert_allclose(float(p1[0]), float(p0[0]), atol=1e-3)
+    np.testing.assert_allclose(
+        float(p1[1]), float(p0[1]) - float(jnp.sum(mass)) * physics2d.GRAVITY * t,
+        atol=1e-2,
+    )
+
+
+def test_ground_contact_supports_and_dissipates():
+    """A rod dropped on the ground must come to rest ON the plane (bounded
+    penetration, no tunnelling, velocities decaying to ~0)."""
+    b = Builder()
+    body = b.add_body(5.0, (0.3, 0.0))
+    b.add_contact(body, (-0.3, 0.0))
+    b.add_contact(body, (0.3, 0.0))
+    sys = b.build()
+    state = PhysicsState(
+        pos=jnp.array([[0.0, 0.5]]),
+        angle=jnp.array([0.15]),
+        vel=jnp.zeros((1, 2)),
+        angvel=jnp.zeros((1,)),
+    )
+    step = jax.jit(lambda s: physics2d.step(sys, s, jnp.zeros((0,))))
+    for _ in range(120):
+        state = step(state)
+    assert float(state.pos[0, 1]) > -0.05  # no tunnelling
+    assert float(state.pos[0, 1]) < 0.05  # resting at the plane
+    assert float(jnp.max(jnp.abs(state.vel))) < 0.05  # settled
+    assert abs(float(state.angle[0])) < 0.05  # flat
+
+
+@pytest.mark.parametrize("name,mk,obs_dim,act_dim", ALL_TASKS)
+def test_task_spec_and_shapes(name, mk, obs_dim, act_dim):
+    env = mk()
+    assert env.spec.obs_shape == (obs_dim,)
+    assert env.spec.continuous and env.spec.action_dim == act_dim
+    state = jax.jit(env.init)(jax.random.PRNGKey(0))
+    obs = env.observe(state)
+    assert obs.shape == (obs_dim,)
+    state, ts = jax.jit(env.step)(
+        state, jnp.zeros((act_dim,)), jax.random.PRNGKey(1)
+    )
+    assert ts.obs.shape == (obs_dim,)
+    assert ts.reward.shape == ()
+
+
+@pytest.mark.parametrize("name,mk,obs_dim,act_dim", ALL_TASKS)
+def test_task_deterministic_and_finite(name, mk, obs_dim, act_dim):
+    env = mk()
+    step = jax.jit(env.step)
+
+    def run(seed):
+        key = jax.random.PRNGKey(seed)
+        state = env.init(key)
+        tot = 0.0
+        for i in range(100):
+            key, k, ka = jax.random.split(key, 3)
+            a = jax.random.uniform(ka, (act_dim,), minval=-1.0, maxval=1.0)
+            state, ts = step(state, a, k)
+            tot += float(ts.reward)
+            assert np.isfinite(float(ts.reward)), (name, i)
+        return tot, np.asarray(env.observe(state))
+
+    t1, o1 = run(3)
+    t2, o2 = run(3)
+    assert t1 == t2
+    np.testing.assert_array_equal(o1, o2)
+
+
+def test_task_vmaps():
+    env = make_hopper()
+    keys = jax.random.split(jax.random.PRNGKey(0), 32)
+    states = jax.vmap(env.init)(keys)
+    acts = jnp.zeros((32, env.spec.action_dim))
+    step_keys = jax.random.split(jax.random.PRNGKey(1), 32)
+    states, ts = jax.jit(jax.vmap(env.step))(states, acts, step_keys)
+    assert ts.obs.shape == (32, 11)
+    assert bool(jnp.all(jnp.isfinite(ts.obs)))
+
+
+def test_hopper_terminates_on_fall_and_autoresets():
+    env = make_hopper()
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(0)
+    state = env.init(key)
+    saw_term = False
+    for _ in range(200):
+        key, k = jax.random.split(key)
+        state, ts = step(state, jnp.zeros((3,)), k)
+        if bool(ts.terminated):
+            saw_term = True
+            assert int(state.t) == 0  # auto-reset
+            # Post-reset torso is back in the healthy window.
+            assert 0.8 < float(state.phys.pos[env.torso, 1]) < 2.2
+            break
+    assert saw_term  # passive hopper must fall
+
+
+def test_halfcheetah_never_terminates_passively():
+    env = make_halfcheetah()
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(0)
+    state = env.init(key)
+    for i in range(MAX_STEPS + 5):
+        key, k = jax.random.split(key)
+        state, ts = step(state, jnp.zeros((6,)), k)
+        assert not bool(ts.terminated), i
+        if bool(ts.truncated):
+            assert i == MAX_STEPS - 1
+            return
+    raise AssertionError("never truncated")
+
+
+def test_forward_torque_moves_cheetah_forward():
+    """Physics sanity coupling actuation → locomotion: a hand-scripted
+    paddling gait must produce net forward (+x) torso motion."""
+    env = make_halfcheetah()
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(0)
+    state = env.init(key)
+    x0 = float(state.phys.pos[env.torso, 0])
+    for i in range(150):
+        key, k = jax.random.split(key)
+        phase = 1.0 if (i // 8) % 2 == 0 else -1.0
+        a = jnp.array([phase, -phase, 0.3, -phase, phase, -0.3])
+        state, ts = step(state, a, k)
+    x1 = float(state.phys.pos[env.torso, 0])
+    assert abs(x1 - x0) > 0.3, (x0, x1)  # scripted gait displaces the torso
+
+
+def test_registry_and_presets_wired():
+    from asyncrl_tpu.configs import presets
+    from asyncrl_tpu.envs import registered
+    from asyncrl_tpu.envs.registry import make
+
+    for env_id in ("JaxHopper-v0", "JaxWalker2d-v0", "JaxHalfCheetah-v0"):
+        assert env_id in registered()
+        assert make(env_id).spec.continuous
+    for p in ("hopper_ppo", "walker_ppo", "halfcheetah_ppo"):
+        cfg = presets.get(p)
+        assert cfg.algo == "ppo" and cfg.num_envs == 8192
+
+
+@pytest.mark.slow
+def test_halfcheetah_ppo_learns():
+    """End-to-end on-TPU-physics PPO (the BASELINE.json:11 workload shape).
+
+    Validated on the real chip at 9.3 → 1250+ greedy-eval return in 300
+    updates (1024 envs); this CI-sized run (512 envs × 150 updates, ~45 s
+    on the 1-core CPU backend) reproducibly climbs from ≈ −80 to > +200
+    train-window return, so the threshold asserts the climb, not the
+    asymptote. unroll_len=32 matters: a 16-step GAE horizon is too short
+    for the gait's credit assignment and the climb disappears."""
+    from asyncrl_tpu.api.factory import make_agent
+
+    agent = make_agent(
+        env_id="JaxHalfCheetah-v0",
+        algo="ppo",
+        num_envs=512,
+        unroll_len=32,
+        total_env_steps=512 * 32 * 150,
+        learning_rate=3e-4,
+        gamma=0.99,
+        entropy_coef=0.001,
+        reward_scale=0.1,
+        ppo_epochs=4,
+        ppo_minibatches=8,
+        precision="f32",
+        log_every=25,
+    )
+    hist = agent.train()
+    rets = [float(h["episode_return"]) for h in hist]
+    assert rets[-1] > rets[0] + 100, rets
+    assert rets[-1] > 100, rets
