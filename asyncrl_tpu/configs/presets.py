@@ -58,18 +58,21 @@ breakout_impala = pong_impala.replace(
 )
 
 # BASELINE.json:10 — "Procgen-16, PPO + GAE, 4096 envs data-parallel".
+# JaxChaser-v0 (envs/gridworlds.py) carries the defining Procgen property:
+# a fresh procedurally-generated level every episode, CNN observations.
+# `procgen_ppo env_id=JaxMaze-v0` switches games (sparse-reward variant).
 procgen_ppo = Config(
-    env_id="JaxPong-v0",
+    env_id="JaxChaser-v0",
     algo="ppo",
     backend="tpu",
     num_envs=4096,
     unroll_len=16,
-    total_env_steps=10_000_000,
+    total_env_steps=50_000_000,
     learning_rate=5e-4,
+    entropy_coef=0.01,
     ppo_epochs=2,
-    ppo_minibatches=4,
-    torso="mlp",
-    hidden_sizes=(256, 256),
+    ppo_minibatches=8,
+    torso="impala_cnn",
 )
 
 # BASELINE.json:11 — "Brax Ant/Humanoid, PPO, 8192 envs". brax absent; the

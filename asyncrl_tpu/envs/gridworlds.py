@@ -1,0 +1,305 @@
+"""Procedurally-generated gridworld family: the Procgen stand-in workload
+(BASELINE.json:10 — "Procgen-16, PPO + GAE, 4096 envs data-parallel";
+procgen itself is absent from this image, SURVEY.md §7.4 R1).
+
+The defining Procgen property — a FRESH procedurally generated level every
+episode, so policies must generalize rather than memorize — is preserved:
+``init`` derives the whole level (maze topology, item placement) from its
+PRNG key, and auto-reset hands each episode a new key, hence a new level.
+
+TPU-first design note: level generation runs inside the jitted step (the
+auto-reset path evaluates it every step), so it must be cheap and
+loop-free. Classic maze generators (Prim/Kruskal/DFS) are inherently
+sequential; the **binary-tree algorithm** is used instead — every cell
+independently opens its north or west wall with one vectorized Bernoulli
+draw, provably yielding a spanning tree (perfect maze) in O(1) XLA ops with
+no scan at all. Chaser then "braids" the maze by knocking out extra
+interior walls (never disconnects) for a more open arena.
+
+Games:
+  - ``Maze``: reach the goal (+10, terminate); goal placed ≥ grid-width
+    Manhattan distance from the agent. The Procgen "maze" analogue.
+  - ``Chaser``: eat pellets (+1) while dodging random-walking enemies
+    (contact: −5, terminate); clearing every pellet pays +10. The Procgen
+    "chaser" analogue with dense reward.
+
+Observations are [H, W, C] uint8 {0,1} feature planes (walls / items /
+enemies / agent), consumed directly by the CNN torsos exactly like the
+pixel Atari stand-ins (envs/pong.py renders the same convention).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+
+# Actions: noop, up (r-1), down (r+1), left (c-1), right (c+1).
+_DR = jnp.array([0, -1, 1, 0, 0], jnp.int32)
+_DC = jnp.array([0, 0, 0, -1, 1], jnp.int32)
+
+
+def generate_maze(key: jax.Array, k: int) -> jax.Array:
+    """Perfect maze over a k×k cell grid via the binary-tree algorithm.
+
+    Returns a wall grid bool[H, H] with H = 2k+1: cell (r, c) lives at grid
+    (2r+1, 2c+1); the wall between two adjacent cells is the grid point
+    between them. True = wall. Every cell is reachable from every other
+    (spanning-tree property of the algorithm; asserted by the test suite's
+    BFS check).
+    """
+    h = 2 * k + 1
+    rows = jnp.arange(k)[:, None]
+    cols = jnp.arange(k)[None, :]
+    choose_west = jax.random.bernoulli(key, 0.5, (k, k))
+    open_west = (cols > 0) & ((rows == 0) | choose_west)
+    open_north = (rows > 0) & ((cols == 0) | ~choose_west)
+
+    open_grid = jnp.zeros((h, h), bool)
+    open_grid = open_grid.at[1::2, 1::2].set(True)  # cells
+    open_grid = open_grid.at[1::2, 0 : 2 * k - 1 : 2].set(open_west)
+    open_grid = open_grid.at[0 : 2 * k - 1 : 2, 1::2].max(open_north)
+    return ~open_grid
+
+
+def _braid(key: jax.Array, walls: jax.Array, k: int, p: float) -> jax.Array:
+    """Open a fraction ``p`` of interior walls (braiding). Removing walls
+    can only add connectivity, so the maze stays fully connected."""
+    h = 2 * k + 1
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(h)[None, :]
+    interior = (rows > 0) & (rows < h - 1) & (cols > 0) & (cols < h - 1)
+    # Wall segments sit at (odd, even) or (even, odd) grid points.
+    seg = (rows % 2) != (cols % 2)
+    knock = jax.random.bernoulli(key, p, (h, h)) & interior & seg
+    return walls & ~knock
+
+
+def _masked_choice(key: jax.Array, mask: jax.Array) -> jax.Array:
+    """Uniformly sample one True index of a boolean vector (Gumbel-argmax)."""
+    g = jax.random.gumbel(key, mask.shape)
+    return jnp.argmax(jnp.where(mask, g, -jnp.inf))
+
+
+def _move(
+    walls: jax.Array, pos: jax.Array, action: jax.Array
+) -> jax.Array:
+    """Move a cell-coordinate position by an action, blocked by walls."""
+    dr, dc = _DR[action], _DC[action]
+    blocked = walls[2 * pos[0] + 1 + dr, 2 * pos[1] + 1 + dc]
+    return jnp.where(blocked, pos, pos + jnp.stack([dr, dc]))
+
+
+@struct.dataclass
+class MazeState:
+    walls: jax.Array  # [H, H] bool
+    agent: jax.Array  # [2] int32 cell coords
+    goal: jax.Array  # [2] int32
+    t: jax.Array
+
+
+class Maze(Environment):
+    """Procgen-maze analogue: fresh binary-tree maze each episode, +10 at
+    the goal, 256-step limit. Obs planes: walls, agent, goal."""
+
+    def __init__(self, k: int = 8, max_steps: int = 256):
+        self.k = k
+        self.max_steps = max_steps
+        h = 2 * k + 1
+        self.spec = EnvSpec(
+            obs_shape=(h, h, 3), num_actions=5, obs_dtype=jnp.uint8
+        )
+
+    def init(self, key: jax.Array) -> MazeState:
+        k_maze, k_agent, k_goal = jax.random.split(key, 3)
+        walls = generate_maze(k_maze, self.k)
+        n = self.k * self.k
+        agent_idx = jax.random.randint(k_agent, (), 0, n)
+        agent = jnp.stack([agent_idx // self.k, agent_idx % self.k])
+        # Goal at Manhattan distance ≥ k−1 from the agent. k−1 is the
+        # largest always-satisfiable threshold: from the exact center of an
+        # odd-k grid the farthest corner is only 2·(k−1)/2 = k−1 away, so a
+        # ≥ k mask could be empty (and Gumbel-argmax over an empty mask
+        # silently returns index 0 — a systematic corner bias, not an
+        # error).
+        rows = jnp.arange(self.k)[:, None]
+        cols = jnp.arange(self.k)[None, :]
+        dist = jnp.abs(rows - agent[0]) + jnp.abs(cols - agent[1])
+        goal_idx = _masked_choice(k_goal, (dist >= self.k - 1).reshape(-1))
+        goal = jnp.stack([goal_idx // self.k, goal_idx % self.k])
+        return MazeState(
+            walls=walls,
+            agent=agent.astype(jnp.int32),
+            goal=goal.astype(jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def observe(self, state: MazeState) -> jax.Array:
+        h = 2 * self.k + 1
+        agent_plane = jnp.zeros((h, h), jnp.uint8).at[
+            2 * state.agent[0] + 1, 2 * state.agent[1] + 1
+        ].set(1)
+        goal_plane = jnp.zeros((h, h), jnp.uint8).at[
+            2 * state.goal[0] + 1, 2 * state.goal[1] + 1
+        ].set(1)
+        return jnp.stack(
+            [state.walls.astype(jnp.uint8), agent_plane, goal_plane], axis=-1
+        )
+
+    def step(
+        self, state: MazeState, action: jax.Array, key: jax.Array
+    ) -> tuple[MazeState, TimeStep]:
+        agent = _move(state.walls, state.agent, action)
+        reached = jnp.all(agent == state.goal)
+        reward = jnp.where(reached, 10.0, 0.0)
+        t = state.t + 1
+        terminated = reached
+        truncated = (t >= self.max_steps) & ~terminated
+        done = terminated | truncated
+        ended = MazeState(walls=state.walls, agent=agent, goal=state.goal, t=t)
+        fresh = self.init(key)
+        new_state = jax.tree.map(
+            lambda f, e: jnp.where(done, f, e), fresh, ended
+        )
+        return new_state, TimeStep(
+            obs=self.observe(new_state),
+            reward=reward,
+            terminated=terminated,
+            truncated=truncated,
+            last_obs=self.observe(ended),
+        )
+
+
+@struct.dataclass
+class ChaserState:
+    walls: jax.Array  # [H, H] bool
+    pellets: jax.Array  # [k, k] bool
+    agent: jax.Array  # [2] int32
+    enemies: jax.Array  # [NE, 2] int32
+    t: jax.Array
+
+
+class Chaser(Environment):
+    """Procgen-chaser analogue: braided maze, pellet per cell (+1 eaten on
+    entry), random-walking enemies (contact −5, terminate), +10 for a full
+    clear. Obs planes: walls, pellets, enemies, agent."""
+
+    NUM_ENEMIES = 3
+
+    def __init__(self, k: int = 8, max_steps: int = 512, braid: float = 0.3):
+        self.k = k
+        self.max_steps = max_steps
+        self.braid = braid
+        h = 2 * k + 1
+        self.spec = EnvSpec(
+            obs_shape=(h, h, 4), num_actions=5, obs_dtype=jnp.uint8
+        )
+
+    def init(self, key: jax.Array) -> ChaserState:
+        k_maze, k_braid, k_agent = jax.random.split(key, 3)
+        walls = _braid(
+            k_braid, generate_maze(k_maze, self.k), self.k, self.braid
+        )
+        n = self.k * self.k
+        agent_idx = jax.random.randint(k_agent, (), 0, n)
+        agent = jnp.stack([agent_idx // self.k, agent_idx % self.k]).astype(
+            jnp.int32
+        )
+        # Enemies start in the three corners farthest from the agent.
+        corners = jnp.array(
+            [[0, 0], [0, self.k - 1], [self.k - 1, 0], [self.k - 1, self.k - 1]],
+            jnp.int32,
+        )
+        d = jnp.sum(jnp.abs(corners - agent[None, :]), axis=1)
+        order = jnp.argsort(-d)
+        enemies = corners[order[: self.NUM_ENEMIES]]
+        pellets = jnp.ones((self.k, self.k), bool).at[
+            agent[0], agent[1]
+        ].set(False)
+        return ChaserState(
+            walls=walls,
+            pellets=pellets,
+            agent=agent,
+            enemies=enemies,
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def observe(self, state: ChaserState) -> jax.Array:
+        h = 2 * self.k + 1
+        agent_plane = jnp.zeros((h, h), jnp.uint8).at[
+            2 * state.agent[0] + 1, 2 * state.agent[1] + 1
+        ].set(1)
+        enemy_plane = jnp.zeros((h, h), jnp.uint8).at[
+            2 * state.enemies[:, 0] + 1, 2 * state.enemies[:, 1] + 1
+        ].set(1)
+        pellet_plane = jnp.zeros((h, h), jnp.uint8).at[1::2, 1::2].set(
+            state.pellets.astype(jnp.uint8)
+        )
+        return jnp.stack(
+            [
+                state.walls.astype(jnp.uint8),
+                pellet_plane,
+                enemy_plane,
+                agent_plane,
+            ],
+            axis=-1,
+        )
+
+    def step(
+        self, state: ChaserState, action: jax.Array, key: jax.Array
+    ) -> tuple[ChaserState, TimeStep]:
+        k_reset, k_enemy = jax.random.split(key)
+        agent = _move(state.walls, state.agent, action)
+
+        ate = state.pellets[agent[0], agent[1]]
+        pellets = state.pellets.at[agent[0], agent[1]].set(False)
+        cleared = ~jnp.any(pellets)
+
+        # Enemies random-walk one cell along open directions (noop excluded
+        # from their choices unless fully walled in — impossible here).
+        def enemy_step(k, pos):
+            dirs = jnp.arange(1, 5)
+            open_dir = ~state.walls[
+                2 * pos[0] + 1 + _DR[dirs], 2 * pos[1] + 1 + _DC[dirs]
+            ]
+            d = dirs[_masked_choice(k, open_dir)]
+            return _move(state.walls, pos, d)
+
+        enemies = jax.vmap(enemy_step)(
+            jax.random.split(k_enemy, self.NUM_ENEMIES), state.enemies
+        )
+        caught = jnp.any(jnp.all(enemies == agent[None, :], axis=1)) | jnp.any(
+            # swap-through collision: enemy and agent exchanged cells
+            jnp.all(enemies == state.agent[None, :], axis=1)
+            & jnp.all(state.enemies == agent[None, :], axis=1)
+        )
+
+        reward = (
+            ate.astype(jnp.float32)
+            + jnp.where(cleared, 10.0, 0.0)
+            + jnp.where(caught, -5.0, 0.0)
+        )
+        t = state.t + 1
+        terminated = caught | cleared
+        truncated = (t >= self.max_steps) & ~terminated
+        done = terminated | truncated
+        ended = ChaserState(
+            walls=state.walls,
+            pellets=pellets,
+            agent=agent,
+            enemies=enemies,
+            t=t,
+        )
+        fresh = self.init(k_reset)
+        new_state = jax.tree.map(
+            lambda f, e: jnp.where(done, f, e), fresh, ended
+        )
+        return new_state, TimeStep(
+            obs=self.observe(new_state),
+            reward=reward,
+            terminated=terminated,
+            truncated=truncated,
+            last_obs=self.observe(ended),
+        )

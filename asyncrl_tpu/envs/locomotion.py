@@ -128,9 +128,16 @@ class LocomotionEnv(Environment):
         )
         # Blow-up guard: penalty physics can diverge under adversarial
         # torque sequences; treat it as termination, not NaN propagation.
-        exploded = ~jnp.all(
-            jnp.isfinite(phys.pos)
-        ) | ~jnp.all(jnp.abs(phys.vel) < 100.0)
+        # Every field the observation exposes is bounded — a low-inertia
+        # foot can spin up (angvel) well before linear velocity diverges.
+        finite = jnp.array(
+            [jnp.all(jnp.isfinite(leaf)) for leaf in jax.tree.leaves(phys)]
+        ).all()
+        exploded = (
+            ~finite
+            | ~jnp.all(jnp.abs(phys.vel) < 100.0)
+            | ~jnp.all(jnp.abs(phys.angvel) < 400.0)
+        )
         terminated = self._unhealthy(phys) | exploded
         reward = jnp.where(exploded, 0.0, reward)
 
@@ -172,12 +179,12 @@ def _leg(
     masses: tuple[float, float, float],
     gears: tuple[float, float, float],
     foot_fwd: float = 0.5,
-) -> tuple[list[int], list[float]]:
+) -> list[float]:
     """Append a thigh–shin–foot chain below ``hip_anchor`` on the torso.
 
-    Returns (body ids, body center heights). Knee bends backward
-    (relative angle ≤ 0), ankle is a small symmetric joint, matching the
-    hopper/walker template.
+    Returns the three body center heights (for building the init pose).
+    Knee bends backward (relative angle ≤ 0), ankle is a small symmetric
+    joint, matching the hopper/walker template.
     """
     th_c = hip_z - thigh_len / 2
     sh_c = hip_z - thigh_len - shin_len / 2
@@ -208,7 +215,7 @@ def _leg(
     b.add_contact(foot, (-foot_half, 0.0))
     b.add_contact(foot, (foot_half, 0.0))
     b.add_contact(shin, (0.0, -shin_len / 2))
-    return [thigh, shin, foot], [th_c, sh_c, ft_z]
+    return [th_c, sh_c, ft_z]
 
 
 def make_hopper() -> LocomotionEnv:
@@ -218,7 +225,7 @@ def make_hopper() -> LocomotionEnv:
     torso_len, hip_z = 0.4, 1.05
     torso = b.add_body(3.5, (0.0, torso_len / 2))
     torso_c = hip_z + torso_len / 2
-    ids, zs = _leg(
+    zs = _leg(
         b,
         torso,
         hip_anchor=(0.0, -torso_len / 2),
@@ -253,7 +260,7 @@ def make_walker2d() -> LocomotionEnv:
     torso_c = hip_z + torso_len / 2
     rows = [[0.0, torso_c]]
     for _ in range(2):
-        ids, zs = _leg(
+        zs = _leg(
             b,
             torso,
             hip_anchor=(0.0, -torso_len / 2),
@@ -285,7 +292,7 @@ def make_halfcheetah() -> LocomotionEnv:
         (-1.0, (1.5, 1.6, 1.1), (120.0, 90.0, 60.0)),
         (+1.0, (1.4, 1.2, 0.9), (120.0, 60.0, 30.0)),
     ):
-        ids, zs = _leg(
+        zs = _leg(
             b,
             torso,
             hip_anchor=(sgn * torso_half, 0.0),

@@ -47,6 +47,11 @@ def _register_builtins() -> None:
     register("JaxBreakout-v0", Breakout)
     register("JaxBreakoutPixels-v0", BreakoutPixels)
     register("JaxPendulum-v0", Pendulum)
+    from asyncrl_tpu.envs.gridworlds import Chaser, Maze
+
+    # Procedurally-generated family (Procgen stand-ins, BASELINE.json:10).
+    register("JaxMaze-v0", Maze)
+    register("JaxChaser-v0", Chaser)
     # On-TPU rigid-body physics (Brax-workload stand-ins, BASELINE.json:11).
     register("JaxHopper-v0", make_hopper)
     register("JaxWalker2d-v0", make_walker2d)

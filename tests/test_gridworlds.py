@@ -1,0 +1,207 @@
+"""Procedural gridworld family (envs/gridworlds.py) — the Procgen stand-in
+workload (BASELINE.json:10, SURVEY.md §7.4 R1): level-generation
+correctness (connectivity, freshness per episode) and game rules."""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu.envs.gridworlds import Chaser, Maze, generate_maze
+
+
+def _reachable_cells(walls: np.ndarray, k: int) -> set[tuple[int, int]]:
+    """BFS over cells through the wall grid (numpy reference check)."""
+    seen = {(0, 0)}
+    q = collections.deque([(0, 0)])
+    while q:
+        r, c = q.popleft()
+        for dr, dc in ((1, 0), (-1, 0), (0, 1), (0, -1)):
+            r2, c2 = r + dr, c + dc
+            if 0 <= r2 < k and 0 <= c2 < k and (r2, c2) not in seen:
+                if not walls[2 * r + 1 + dr, 2 * c + 1 + dc]:
+                    seen.add((r2, c2))
+                    q.append((r2, c2))
+    return seen
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_binary_tree_maze_is_spanning_tree(seed):
+    """Every generated maze must be fully connected AND acyclic: exactly
+    k²−1 open internal walls connecting all k² cells (spanning tree)."""
+    k = 8
+    walls = np.asarray(generate_maze(jax.random.PRNGKey(seed), k))
+    assert len(_reachable_cells(walls, k)) == k * k
+    # Count open wall segments between cells.
+    open_v = (~walls[1::2, 2 : 2 * k - 1 : 2]).sum()  # east-west
+    open_h = (~walls[2 : 2 * k - 1 : 2, 1::2]).sum()  # north-south
+    assert open_v + open_h == k * k - 1
+    # Border is fully walled.
+    assert walls[0, :].all() and walls[-1, :].all()
+    assert walls[:, 0].all() and walls[:, -1].all()
+
+
+def test_each_episode_gets_a_fresh_level():
+    env = Maze()
+    s1 = env.init(jax.random.PRNGKey(0))
+    s2 = env.init(jax.random.PRNGKey(1))
+    assert not np.array_equal(np.asarray(s1.walls), np.asarray(s2.walls))
+
+
+def test_maze_goal_distance_and_termination():
+    env = Maze(k=4)
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(0)
+    state = env.init(key)
+    assert int(jnp.sum(jnp.abs(state.agent - state.goal))) >= env.k - 1
+    # Random walk until the goal is hit (k=4 maze, 5000 tries is plenty).
+    hit = False
+    for i in range(5000):
+        key, ka, ks = jax.random.split(key, 3)
+        a = jax.random.randint(ka, (), 0, 5)
+        prev_t = int(state.t)
+        state, ts = step(state, a, ks)
+        if bool(ts.terminated):
+            assert float(ts.reward) == 10.0
+            assert int(state.t) == 0  # auto-reset to a fresh level
+            hit = True
+            break
+    assert hit
+
+
+def test_maze_walls_block_movement():
+    env = Maze()
+    state = env.init(jax.random.PRNGKey(3))
+    walls = np.asarray(state.walls)
+    r, c = int(state.agent[0]), int(state.agent[1])
+    step = jax.jit(env.step)
+    for a, (dr, dc) in ((1, (-1, 0)), (2, (1, 0)), (3, (0, -1)), (4, (0, 1))):
+        new_state, _ = step(state, jnp.asarray(a), jax.random.PRNGKey(9))
+        blocked = walls[2 * r + 1 + dr, 2 * c + 1 + dc]
+        expect = (r, c) if blocked else (r + dr, c + dc)
+        assert (int(new_state.agent[0]), int(new_state.agent[1])) == expect, a
+
+
+def test_maze_obs_planes():
+    env = Maze()
+    state = env.init(jax.random.PRNGKey(0))
+    obs = env.observe(state)
+    assert obs.shape == env.spec.obs_shape and obs.dtype == jnp.uint8
+    assert int(obs[..., 1].sum()) == 1  # one agent
+    assert int(obs[..., 2].sum()) == 1  # one goal
+    r, c = np.argwhere(np.asarray(obs[..., 1]))[0]
+    assert (r % 2, c % 2) == (1, 1)  # agent sits on a cell, not a wall
+
+
+def test_chaser_pellets_and_clear_bonus():
+    env = Chaser(k=3, braid=1.0)  # fully open arena
+    step = jax.jit(env.step)
+    state = env.init(jax.random.PRNGKey(0))
+    assert int(state.pellets.sum()) == 8  # 9 cells minus agent's
+
+    # Eating a pellet pays +1: walk the agent onto one deterministically.
+    state0 = state.replace(
+        agent=jnp.array([0, 0], jnp.int32),
+        enemies=jnp.array([[2, 0], [2, 1], [2, 2]], jnp.int32),
+        pellets=jnp.ones((3, 3), bool).at[0, 0].set(False),
+    )
+    _, ts = step(state0, jnp.asarray(4), jax.random.PRNGKey(1))  # move right
+    assert float(ts.reward) == 1.0 and not bool(ts.terminated)
+
+    # Clearing the LAST pellet pays +1 +10 and terminates; enemies start
+    # ≥ 2 cells away so they cannot catch in the same step.
+    state1 = state0.replace(
+        pellets=jnp.zeros((3, 3), bool).at[0, 1].set(True)
+    )
+    new_state, ts = step(state1, jnp.asarray(4), jax.random.PRNGKey(2))
+    assert float(ts.reward) == 11.0
+    assert bool(ts.terminated)
+    assert int(new_state.t) == 0  # auto-reset to a fresh level
+
+
+def test_chaser_enemy_contact_terminates():
+    env = Chaser(k=2, braid=1.0)  # 2x2: enemies are adjacent immediately
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(1)
+    state = env.init(key)
+    for i in range(200):
+        key, ks = jax.random.split(key)
+        state, ts = step(state, jnp.asarray(0), ks)  # stand still
+        if bool(ts.terminated) and float(ts.reward) < 0:
+            assert float(ts.reward) == -5.0
+            assert int(state.t) == 0
+            return
+    raise AssertionError("enemies never caught a stationary agent on 2x2")
+
+
+def test_chaser_enemies_respect_walls():
+    env = Chaser(k=8, braid=0.0)  # pure maze: walls everywhere
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(2)
+    state = env.init(key)
+    for _ in range(60):
+        key, ks = jax.random.split(key)
+        walls = np.asarray(state.walls)
+        prev = np.asarray(state.enemies)
+        state, ts = step(state, jnp.asarray(0), ks)
+        if bool(ts.done):
+            state = env.init(ks)
+            continue
+        cur = np.asarray(state.enemies)
+        for (r0, c0), (r1, c1) in zip(prev, cur):
+            dr, dc = r1 - r0, c1 - c0
+            assert abs(dr) + abs(dc) == 1  # exactly one cell, never stuck
+            assert not walls[2 * r0 + 1 + dr, 2 * c0 + 1 + dc]
+
+
+def test_gridworlds_vmap_and_registry():
+    from asyncrl_tpu.configs import presets
+    from asyncrl_tpu.envs import registered
+    from asyncrl_tpu.envs.registry import make
+
+    assert {"JaxMaze-v0", "JaxChaser-v0"} <= set(registered())
+    env = make("JaxChaser-v0")
+    keys = jax.random.split(jax.random.PRNGKey(0), 16)
+    states = jax.vmap(env.init)(keys)
+    acts = jnp.zeros((16,), jnp.int32)
+    states, ts = jax.jit(jax.vmap(env.step))(
+        states, acts, jax.random.split(jax.random.PRNGKey(1), 16)
+    )
+    assert ts.obs.shape == (16, 17, 17, 4)
+    cfg = presets.get("procgen_ppo")
+    assert cfg.env_id == "JaxChaser-v0" and cfg.num_envs == 4096
+    assert cfg.torso == "impala_cnn"
+
+
+def test_maze_ppo_runs():
+    """procgen_ppo workload shape end-to-end at CI size: CNN torso over
+    uint8 planes, PPO+GAE, finite loss."""
+    from asyncrl_tpu.api.factory import make_agent
+
+    agent = make_agent(
+        env_id="JaxChaser-v0",
+        algo="ppo",
+        num_envs=8,
+        unroll_len=8,
+        total_env_steps=8 * 8 * 2,
+        torso="impala_cnn",
+        ppo_epochs=2,
+        ppo_minibatches=2,
+        precision="f32",
+        log_every=1,
+    )
+    hist = agent.train()
+    assert np.isfinite(hist[-1]["loss"])
+
+
+def test_maze_goal_mask_never_empty_for_odd_k():
+    """Regression: from the exact center of an odd-k grid the farthest cell
+    is only k−1 away; the distance mask must still be satisfiable (an empty
+    Gumbel-argmax mask would silently pin the goal to cell 0)."""
+    env = Maze(k=9)
+    for seed in range(40):
+        state = env.init(jax.random.PRNGKey(seed))
+        d = int(jnp.sum(jnp.abs(state.agent - state.goal)))
+        assert d >= env.k - 1, (seed, d)
