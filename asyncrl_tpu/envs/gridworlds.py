@@ -77,10 +77,7 @@ def _braid(key: jax.Array, walls: jax.Array, k: int, p: float) -> jax.Array:
     return walls & ~knock
 
 
-def _masked_choice(key: jax.Array, mask: jax.Array) -> jax.Array:
-    """Uniformly sample one True index of a boolean vector (Gumbel-argmax)."""
-    g = jax.random.gumbel(key, mask.shape)
-    return jnp.argmax(jnp.where(mask, g, -jnp.inf))
+from asyncrl_tpu.utils.prng import masked_choice as _masked_choice
 
 
 def _move(

@@ -1,0 +1,440 @@
+"""MinAtar-style pure-JAX arcade games: SpaceInvaders, Freeway, Asterix.
+
+Together with JaxPong / JaxBreakout (envs/pong.py, envs/breakout.py) these
+widen the Atari-suite stand-in (BASELINE.json:9 — "Atari-57 suite, IMPALA,
+1024 envs/chip"; ale-py is unavailable in this image, SURVEY.md §7.4 R1)
+to a five-game family, mirroring how the MinAtar suite (Young & Tian 2019,
+a public 10×10 re-implementation of five ALE games) substitutes for full
+Atari in RL research. Swapping games is one ``env_id`` override, exactly
+like swapping ALE roms in the reference suite.
+
+All three run on the TPU under ``vmap``: 10×10×C uint8 {0,1} feature-plane
+observations (the same plane convention as envs/gridworlds.py), entity
+state kept as fixed-size masks/slots — no dynamic shapes. The games follow
+MinAtar's rules in structure (action sets, reward events, termination) but
+are re-derived from those rules, not ports.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+from asyncrl_tpu.utils.prng import masked_choice
+
+G = 10  # grid side
+
+
+# ---------------------------------------------------------------------------
+# Space Invaders
+
+
+@struct.dataclass
+class InvadersState:
+    pos: jax.Array  # agent column, int32
+    aliens: jax.Array  # [G, G] bool
+    f_bullets: jax.Array  # [G, G] bool, friendly, travel up
+    e_bullets: jax.Array  # [G, G] bool, enemy, travel down
+    alien_dir: jax.Array  # +1 right / -1 left
+    move_timer: jax.Array  # int32 countdown to next alien march
+    shot_timer: jax.Array  # int32 countdown to next alien shot
+    wave: jax.Array  # int32, completed waves (marching speeds up)
+    t: jax.Array
+
+
+class SpaceInvaders(Environment):
+    """MinAtar space_invaders analogue.
+
+    Actions: 0 noop, 1 left, 2 right, 3 fire. +1 per alien destroyed;
+    episode ends when an enemy bullet or an alien reaches the agent row.
+    Clearing a wave spawns the next one marching faster.
+    """
+
+    MOVE_PERIOD = 4  # alien march period (steps), minus the wave number
+    SHOT_PERIOD = 10
+    MAX_STEPS = 2000
+
+    spec = EnvSpec(obs_shape=(G, G, 4), num_actions=4, obs_dtype=jnp.uint8)
+
+    def _fresh_wave(self) -> jax.Array:
+        aliens = jnp.zeros((G, G), bool)
+        return aliens.at[1:4, 2:8].set(True)  # 3 rows x 6 columns
+
+    def init(self, key: jax.Array) -> InvadersState:
+        return InvadersState(
+            pos=jnp.asarray(G // 2, jnp.int32),
+            aliens=self._fresh_wave(),
+            f_bullets=jnp.zeros((G, G), bool),
+            e_bullets=jnp.zeros((G, G), bool),
+            alien_dir=jnp.asarray(1, jnp.int32),
+            move_timer=jnp.asarray(self.MOVE_PERIOD, jnp.int32),
+            shot_timer=jnp.asarray(self.SHOT_PERIOD, jnp.int32),
+            wave=jnp.zeros((), jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def observe(self, state: InvadersState) -> jax.Array:
+        agent = jnp.zeros((G, G), jnp.uint8).at[G - 1, state.pos].set(1)
+        return jnp.stack(
+            [
+                agent,
+                state.aliens.astype(jnp.uint8),
+                state.f_bullets.astype(jnp.uint8),
+                state.e_bullets.astype(jnp.uint8),
+            ],
+            axis=-1,
+        )
+
+    def step(
+        self, state: InvadersState, action: jax.Array, key: jax.Array
+    ) -> tuple[InvadersState, TimeStep]:
+        k_shot_col = key  # single consumer below
+
+        # Agent move / fire.
+        pos = jnp.clip(
+            state.pos + jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0)),
+            0,
+            G - 1,
+        ).astype(jnp.int32)
+        f_bullets = jnp.roll(state.f_bullets, -1, axis=0).at[G - 1, :].set(False)
+        f_bullets = jnp.where(
+            action == 3, f_bullets.at[G - 2, pos].set(True), f_bullets
+        )
+
+        # Friendly bullets hit aliens (checked before and after the march so
+        # bullets can't pass through a row the aliens step across).
+        hits1 = f_bullets & state.aliens
+        aliens = state.aliens & ~hits1
+        f_bullets = f_bullets & ~hits1
+
+        # Alien march: sideways every MOVE_PERIOD-wave steps; drop one row
+        # at the walls. March period floors at 1 step.
+        period = jnp.maximum(self.MOVE_PERIOD - state.wave, 1)
+        move_now = state.move_timer <= 1
+        cols = jnp.any(aliens, axis=0)
+        idx = jnp.arange(G)
+        leftmost = jnp.min(jnp.where(cols, idx, G))
+        rightmost = jnp.max(jnp.where(cols, idx, -1))
+        at_wall = jnp.where(
+            state.alien_dir > 0, rightmost >= G - 1, leftmost <= 0
+        )
+        drop = move_now & at_wall
+        turn_dir = jnp.where(drop, -state.alien_dir, state.alien_dir)
+        marched = jnp.where(
+            drop,
+            jnp.roll(aliens, 1, axis=0).at[0, :].set(False),
+            jnp.roll(aliens, turn_dir, axis=1),
+        )
+        aliens = jnp.where(move_now, marched, aliens)
+        move_timer = jnp.where(move_now, period, state.move_timer - 1).astype(
+            jnp.int32
+        )
+
+        # Alien shooting: lowest alien of a random occupied column fires.
+        shoot_now = state.shot_timer <= 1
+        occupied = jnp.any(aliens, axis=0)
+        shot_col = masked_choice(k_shot_col, occupied)
+        lowest = jnp.max(jnp.where(aliens[:, shot_col], jnp.arange(G), -1))
+        e_bullets = jnp.roll(state.e_bullets, 1, axis=0).at[0, :].set(False)
+        can_shoot = shoot_now & jnp.any(occupied) & (lowest < G - 1)
+        e_bullets = jnp.where(
+            can_shoot,
+            e_bullets.at[jnp.clip(lowest + 1, 0, G - 1), shot_col].set(True),
+            e_bullets,
+        )
+        shot_timer = jnp.where(
+            shoot_now, self.SHOT_PERIOD, state.shot_timer - 1
+        ).astype(jnp.int32)
+
+        # Post-march friendly-bullet hits.
+        hits2 = f_bullets & aliens
+        aliens = aliens & ~hits2
+        f_bullets = f_bullets & ~hits2
+        reward = (jnp.sum(hits1) + jnp.sum(hits2)).astype(jnp.float32)
+
+        # Wave cleared -> next wave, marching faster.
+        cleared = ~jnp.any(aliens)
+        aliens = jnp.where(cleared, self._fresh_wave(), aliens)
+        wave = state.wave + cleared.astype(jnp.int32)
+
+        # Termination: enemy bullet on the agent, or aliens reach its row.
+        shot_down = e_bullets[G - 1, pos]
+        invaded = jnp.any(aliens[G - 1, :])
+        t = state.t + 1
+        terminated = shot_down | invaded
+        truncated = (t >= self.MAX_STEPS) & ~terminated
+
+        done = terminated | truncated
+        ended = InvadersState(
+            pos=pos,
+            aliens=aliens,
+            f_bullets=f_bullets,
+            e_bullets=e_bullets,
+            alien_dir=turn_dir,
+            move_timer=move_timer,
+            shot_timer=shot_timer,
+            wave=wave,
+            t=t,
+        )
+        fresh = self.init(key)
+        new_state = jax.tree.map(
+            lambda f, e: jnp.where(done, f, e), fresh, ended
+        )
+        return new_state, TimeStep(
+            obs=self.observe(new_state),
+            reward=reward,
+            terminated=terminated,
+            truncated=truncated,
+            last_obs=self.observe(ended),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Freeway
+
+
+@struct.dataclass
+class FreewayState:
+    chicken: jax.Array  # row, int32 (G-1 = start, 0 = goal)
+    cars: jax.Array  # [8] int32 column of the car in each lane
+    timers: jax.Array  # [8] int32 countdown to each car's next move
+    move_cd: jax.Array  # chicken move cooldown
+    t: jax.Array
+
+
+# Lane speeds: a car moves one cell every `speed` steps; sign = direction.
+_LANE_SPEED = jnp.array([1, 2, 3, 4, -1, -2, -3, -4], jnp.int32)
+_LANE_ROWS = jnp.arange(1, 9)  # rows 1..8 carry traffic
+
+
+class Freeway(Environment):
+    """MinAtar freeway analogue.
+
+    Actions: 0 noop, 1 up, 2 down. +1 for reaching the top row (chicken
+    returns to start); collision with a car sends it back to start. Fixed
+    2500-step episode (pure truncation, like the original's timer).
+    """
+
+    MAX_STEPS = 2500
+    # After a move the chicken must skip exactly one step (cooldown 1), so
+    # it advances every other step at best.
+    MOVE_COOLDOWN = 1
+
+    spec = EnvSpec(obs_shape=(G, G, 2), num_actions=3, obs_dtype=jnp.uint8)
+
+    def init(self, key: jax.Array) -> FreewayState:
+        cars = jax.random.randint(key, (8,), 0, G)
+        return FreewayState(
+            chicken=jnp.asarray(G - 1, jnp.int32),
+            cars=cars.astype(jnp.int32),
+            timers=jnp.abs(_LANE_SPEED),
+            move_cd=jnp.zeros((), jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def observe(self, state: FreewayState) -> jax.Array:
+        chicken = jnp.zeros((G, G), jnp.uint8).at[state.chicken, 4].set(1)
+        cars = jnp.zeros((G, G), jnp.uint8).at[_LANE_ROWS, state.cars].set(1)
+        return jnp.stack([chicken, cars], axis=-1)
+
+    def step(
+        self, state: FreewayState, action: jax.Array, key: jax.Array
+    ) -> tuple[FreewayState, TimeStep]:
+        can_move = state.move_cd <= 0
+        delta = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        chicken = jnp.clip(
+            state.chicken + jnp.where(can_move, delta, 0), 0, G - 1
+        ).astype(jnp.int32)
+        move_cd = jnp.where(
+            can_move & (delta != 0), self.MOVE_COOLDOWN, state.move_cd - 1
+        ).astype(jnp.int32)
+
+        # Cars advance when their lane timer expires.
+        fire = state.timers <= 1
+        cars = jnp.where(
+            fire, (state.cars + jnp.sign(_LANE_SPEED)) % G, state.cars
+        ).astype(jnp.int32)
+        timers = jnp.where(fire, jnp.abs(_LANE_SPEED), state.timers - 1).astype(
+            jnp.int32
+        )
+
+        # Collision: chicken (column 4) shares a cell with its lane's car.
+        lane = chicken - 1  # index into the 8 traffic lanes, valid when 1..8
+        in_traffic = (chicken >= 1) & (chicken <= 8)
+        hit = in_traffic & (cars[jnp.clip(lane, 0, 7)] == 4)
+
+        scored = chicken == 0
+        reward = scored.astype(jnp.float32)
+        chicken = jnp.where(scored | hit, G - 1, chicken).astype(jnp.int32)
+
+        t = state.t + 1
+        truncated = t >= self.MAX_STEPS
+        done = truncated
+        ended = FreewayState(
+            chicken=chicken, cars=cars, timers=timers, move_cd=move_cd, t=t
+        )
+        fresh = self.init(key)
+        new_state = jax.tree.map(
+            lambda f, e: jnp.where(done, f, e), fresh, ended
+        )
+        return new_state, TimeStep(
+            obs=self.observe(new_state),
+            reward=reward,
+            terminated=jnp.zeros((), bool),
+            truncated=truncated,
+            last_obs=self.observe(ended),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Asterix
+
+
+@struct.dataclass
+class AsterixState:
+    pos: jax.Array  # [2] int32 (row, col)
+    active: jax.Array  # [8] bool — one entity slot per traffic row
+    cols: jax.Array  # [8] int32 entity column
+    dirs: jax.Array  # [8] int32 +-1
+    gold: jax.Array  # [8] bool — entity is treasure, else enemy
+    timers: jax.Array  # [8] int32 countdown to entity move
+    t: jax.Array
+
+
+class Asterix(Environment):
+    """MinAtar asterix analogue.
+
+    Actions: 0 noop, 1 up, 2 down, 3 left, 4 right. Entities stream across
+    rows 1..8: touching treasure pays +1, touching an enemy ends the
+    episode. Spawns are random (30% treasure), entity speed is fixed.
+    """
+
+    MAX_STEPS = 2000
+    MOVE_PERIOD = 3
+    SPAWN_PROB = 0.3
+    GOLD_PROB = 0.3
+
+    spec = EnvSpec(obs_shape=(G, G, 3), num_actions=5, obs_dtype=jnp.uint8)
+
+    def init(self, key: jax.Array) -> AsterixState:
+        return AsterixState(
+            pos=jnp.array([G // 2, G // 2], jnp.int32),
+            active=jnp.zeros((8,), bool),
+            cols=jnp.zeros((8,), jnp.int32),
+            dirs=jnp.ones((8,), jnp.int32),
+            gold=jnp.zeros((8,), bool),
+            timers=jnp.full((8,), self.MOVE_PERIOD, jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def observe(self, state: AsterixState) -> jax.Array:
+        agent = jnp.zeros((G, G), jnp.uint8).at[
+            state.pos[0], state.pos[1]
+        ].set(1)
+        enemy_mask = state.active & ~state.gold
+        gold_mask = state.active & state.gold
+        enemies = jnp.zeros((G, G), jnp.uint8).at[_LANE_ROWS, state.cols].max(
+            enemy_mask.astype(jnp.uint8)
+        )
+        golds = jnp.zeros((G, G), jnp.uint8).at[_LANE_ROWS, state.cols].max(
+            gold_mask.astype(jnp.uint8)
+        )
+        return jnp.stack([agent, enemies, golds], axis=-1)
+
+    def _collide(self, state: AsterixState) -> tuple[jax.Array, jax.Array]:
+        """(hit_enemy, hit_gold_slot_mask) for the agent's current cell."""
+        lane = state.pos[0] - 1
+        in_lane = (state.pos[0] >= 1) & (state.pos[0] <= 8)
+        slot = jnp.clip(lane, 0, 7)
+        same_cell = in_lane & state.active[slot] & (
+            state.cols[slot] == state.pos[1]
+        )
+        hit_enemy = same_cell & ~state.gold[slot]
+        gold_mask = jnp.zeros((8,), bool).at[slot].set(
+            same_cell & state.gold[slot]
+        )
+        return hit_enemy, gold_mask
+
+    def step(
+        self, state: AsterixState, action: jax.Array, key: jax.Array
+    ) -> tuple[AsterixState, TimeStep]:
+        k_spawn, k_side, k_gold = jax.random.split(key, 3)
+
+        dr = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        dc = jnp.where(action == 3, -1, jnp.where(action == 4, 1, 0))
+        pos = jnp.clip(
+            state.pos + jnp.stack([dr, dc]), 0, G - 1
+        ).astype(jnp.int32)
+        moved = state.replace(pos=pos)
+
+        # Collisions before entity movement (agent steps onto an entity);
+        # consumed gold is deactivated IMMEDIATELY, before movement/spawn
+        # can reuse the slot (a stale mask applied later would delete a
+        # fresh entity spawned into the same slot this step).
+        hit1, gold1 = self._collide(moved)
+        pre_active = state.active & ~gold1
+
+        # Entities advance; leaving the grid deactivates the slot.
+        fire = state.timers <= 1
+        cols = jnp.where(fire, state.cols + state.dirs, state.cols).astype(
+            jnp.int32
+        )
+        off = (cols < 0) | (cols >= G)
+        active = pre_active & ~off
+        cols = jnp.clip(cols, 0, G - 1)
+        timers = jnp.where(
+            fire, self.MOVE_PERIOD, state.timers - 1
+        ).astype(jnp.int32)
+
+        # Spawns fill inactive slots with fresh edge entities.
+        spawn = (
+            jax.random.bernoulli(k_spawn, self.SPAWN_PROB, (8,)) & ~active
+        )
+        from_left = jax.random.bernoulli(k_side, 0.5, (8,))
+        dirs = jnp.where(
+            spawn, jnp.where(from_left, 1, -1), state.dirs
+        ).astype(jnp.int32)
+        cols = jnp.where(spawn, jnp.where(from_left, 0, G - 1), cols).astype(
+            jnp.int32
+        )
+        gold = jnp.where(
+            spawn, jax.random.bernoulli(k_gold, self.GOLD_PROB, (8,)), state.gold
+        )
+        active = active | spawn
+
+        # Collisions after movement (entity steps onto the agent).
+        after = state.replace(
+            pos=pos, active=active, cols=cols, dirs=dirs, gold=gold
+        )
+        hit2, gold2 = self._collide(after)
+        hit_enemy = hit1 | hit2
+        reward = (jnp.any(gold1) | jnp.any(gold2)).astype(jnp.float32)
+        active = active & ~gold2  # post-move treasure consumed (gold1
+        # was already consumed via pre_active above)
+
+        t = state.t + 1
+        terminated = hit_enemy
+        truncated = (t >= self.MAX_STEPS) & ~terminated
+        done = terminated | truncated
+        ended = AsterixState(
+            pos=pos,
+            active=active,
+            cols=cols,
+            dirs=dirs,
+            gold=gold,
+            timers=timers,
+            t=t,
+        )
+        fresh = self.init(key)
+        new_state = jax.tree.map(
+            lambda f, e: jnp.where(done, f, e), fresh, ended
+        )
+        return new_state, TimeStep(
+            obs=self.observe(new_state),
+            reward=reward,
+            terminated=terminated,
+            truncated=truncated,
+            last_obs=self.observe(ended),
+        )

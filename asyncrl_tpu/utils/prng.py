@@ -33,3 +33,13 @@ def gumbel_sample(key: jax.Array, logits: jax.Array) -> jax.Array:
     """Categorical sample via Gumbel-max (fuses well under XLA)."""
     g = -jnp.log(-jnp.log(jax.random.uniform(key, logits.shape) + 1e-20) + 1e-20)
     return jnp.argmax(logits + g, axis=-1)
+
+
+def masked_choice(key: jax.Array, mask: jax.Array) -> jax.Array:
+    """Uniformly sample one True index of a boolean vector (Gumbel-argmax).
+
+    Caveat: an all-False mask silently returns index 0 (argmax over all
+    -inf) — callers must guarantee satisfiability or guard the result.
+    """
+    g = jax.random.gumbel(key, mask.shape)
+    return jnp.argmax(jnp.where(mask, g, -jnp.inf))

@@ -1,0 +1,222 @@
+"""MinAtar-style game family (envs/minatari.py) — the Atari-suite width
+stand-ins (BASELINE.json:9): rule/termination/reward contracts per game."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu.envs.minatari import (
+    G,
+    Asterix,
+    AsterixState,
+    Freeway,
+    FreewayState,
+    InvadersState,
+    SpaceInvaders,
+)
+
+ALL_GAMES = [
+    ("space_invaders", SpaceInvaders, 4, 4),
+    ("freeway", Freeway, 2, 3),
+    ("asterix", Asterix, 3, 5),
+]
+
+
+@pytest.mark.parametrize("name,cls,channels,num_actions", ALL_GAMES)
+def test_spec_shapes_and_determinism(name, cls, channels, num_actions):
+    env = cls()
+    assert env.spec.obs_shape == (G, G, channels)
+    assert env.spec.num_actions == num_actions
+    step = jax.jit(env.step)
+
+    def run(seed):
+        key = jax.random.PRNGKey(seed)
+        state = env.init(key)
+        tot = 0.0
+        for _ in range(80):
+            key, ka, ks = jax.random.split(key, 3)
+            a = jax.random.randint(ka, (), 0, num_actions)
+            state, ts = step(state, a, ks)
+            assert ts.obs.dtype == jnp.uint8
+            tot += float(ts.reward)
+        return tot, np.asarray(env.observe(state))
+
+    t1, o1 = run(5)
+    t2, o2 = run(5)
+    assert t1 == t2
+    np.testing.assert_array_equal(o1, o2)
+    assert set(np.unique(o1)) <= {0, 1}
+
+
+@pytest.mark.parametrize("name,cls,channels,num_actions", ALL_GAMES)
+def test_vmap_batch(name, cls, channels, num_actions):
+    env = cls()
+    keys = jax.random.split(jax.random.PRNGKey(0), 16)
+    states = jax.vmap(env.init)(keys)
+    acts = jnp.zeros((16,), jnp.int32)
+    states, ts = jax.jit(jax.vmap(env.step))(
+        states, acts, jax.random.split(jax.random.PRNGKey(1), 16)
+    )
+    assert ts.obs.shape == (16, G, G, channels)
+
+
+def test_invaders_shooting_aliens_scores():
+    """Parking under the alien block and firing must earn reward."""
+    env = SpaceInvaders()
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(0)
+    state = env.init(key)
+    total = 0.0
+    for i in range(60):
+        key, ks = jax.random.split(key)
+        # Fire every step; stay put (column 5 is inside the initial block).
+        state, ts = step(state, jnp.asarray(3), ks)
+        total += float(ts.reward)
+        if bool(ts.terminated):
+            break
+    assert total >= 1.0, total
+
+
+def test_invaders_march_reaches_agent_row_and_terminates():
+    """A passive agent must eventually lose to the descending wave (march
+    drops one row at each wall)."""
+    env = SpaceInvaders()
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(1)
+    state = env.init(key)
+    for i in range(env.MAX_STEPS):
+        key, ks = jax.random.split(key)
+        state, ts = step(state, jnp.asarray(0), ks)
+        if bool(ts.terminated):
+            assert int(state.t) == 0  # auto-reset
+            return
+    raise AssertionError("passive game never terminated")
+
+
+def test_invaders_wave_respawns_faster():
+    """Clearing the wave respawns it and bumps the wave counter."""
+    env = SpaceInvaders()
+    state = env.init(jax.random.PRNGKey(0))
+    # Hand-build a state with one alien about to be shot.
+    aliens = jnp.zeros((G, G), bool).at[1, 5].set(True)
+    bullets = jnp.zeros((G, G), bool).at[2, 5].set(True)
+    state = state.replace(aliens=aliens, f_bullets=bullets, pos=jnp.asarray(5))
+    new_state, ts = jax.jit(env.step)(
+        state, jnp.asarray(0), jax.random.PRNGKey(2)
+    )
+    assert float(ts.reward) == 1.0
+    assert int(new_state.wave) == 1
+    assert int(jnp.sum(new_state.aliens)) == 18  # fresh 3x6 block
+
+
+def test_freeway_scoring_and_collision_reset():
+    env = Freeway()
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(0)
+    state = env.init(key)
+    # March straight up with a no-car board: must score within ~2*G steps.
+    state = state.replace(cars=jnp.full((8,), 9, jnp.int32))
+    scored = False
+    for i in range(4 * G):
+        key, ks = jax.random.split(key)
+        # Freeze cars far from column 4 so only the chicken moves.
+        state = state.replace(cars=jnp.full((8,), 9, jnp.int32))
+        state, ts = step(state, jnp.asarray(1), ks)
+        if float(ts.reward) > 0:
+            scored = True
+            assert int(state.chicken) == G - 1  # back to start
+            break
+    assert scored
+
+    # Collision: put a car on the chicken's cell in its lane.
+    state = state.replace(chicken=jnp.asarray(3, jnp.int32))
+    lane = 3 - 1
+    cars = jnp.full((8,), 9, jnp.int32).at[lane].set(4)
+    # Timer high so the car doesn't move off the cell this step.
+    state = state.replace(cars=cars, timers=jnp.full((8,), 5, jnp.int32))
+    new_state, ts = step(state, jnp.asarray(0), jax.random.PRNGKey(3))
+    assert int(new_state.chicken) == G - 1  # sent back to start
+    assert float(ts.reward) == 0.0
+
+
+def test_freeway_truncates_only():
+    env = Freeway()
+    state = env.init(jax.random.PRNGKey(0))
+    state = state.replace(t=jnp.asarray(env.MAX_STEPS - 1, jnp.int32))
+    _, ts = jax.jit(env.step)(state, jnp.asarray(0), jax.random.PRNGKey(1))
+    assert bool(ts.truncated) and not bool(ts.terminated)
+
+
+def test_asterix_gold_and_enemy_contact():
+    env = Asterix()
+    step = jax.jit(env.step)
+    base = env.init(jax.random.PRNGKey(0))
+
+    # Agent at (3, 4); gold entity parked on the same cell -> +1, consumed.
+    lane = 3 - 1
+    state = base.replace(
+        pos=jnp.array([3, 4], jnp.int32),
+        active=jnp.zeros((8,), bool).at[lane].set(True),
+        cols=jnp.zeros((8,), jnp.int32).at[lane].set(4),
+        gold=jnp.zeros((8,), bool).at[lane].set(True),
+        timers=jnp.full((8,), 5, jnp.int32),
+    )
+    new_state, ts = step(state, jnp.asarray(0), jax.random.PRNGKey(1))
+    assert float(ts.reward) == 1.0
+    assert not bool(ts.terminated)
+    assert not bool(new_state.active[lane])  # consumed
+
+    # Same cell but an enemy -> terminate.
+    state = state.replace(gold=jnp.zeros((8,), bool))
+    new_state, ts = step(state, jnp.asarray(0), jax.random.PRNGKey(1))
+    assert bool(ts.terminated)
+    assert float(ts.reward) == 0.0
+    assert int(new_state.t) == 0  # auto-reset
+
+
+def test_asterix_entities_spawn_and_cross():
+    env = Asterix()
+    step = jax.jit(env.step)
+    key = jax.random.PRNGKey(4)
+    state = env.init(key)
+    seen_active = 0
+    for _ in range(100):
+        key, ks = jax.random.split(key)
+        state, ts = step(state, jnp.asarray(0), ks)
+        seen_active = max(seen_active, int(jnp.sum(state.active)))
+        if bool(ts.done):
+            break
+    assert seen_active >= 2  # spawns happen
+
+
+def test_registry_has_the_five_game_family():
+    from asyncrl_tpu.envs import registered
+
+    suite = {
+        "JaxPong-v0",
+        "JaxBreakout-v0",
+        "JaxSpaceInvaders-v0",
+        "JaxFreeway-v0",
+        "JaxAsterix-v0",
+    }
+    assert suite <= set(registered())
+
+
+def test_invaders_impala_runs():
+    """IMPALA over the widened suite's obs planes: one update, finite loss."""
+    from asyncrl_tpu.api.factory import make_agent
+
+    agent = make_agent(
+        env_id="JaxSpaceInvaders-v0",
+        algo="impala",
+        num_envs=16,
+        unroll_len=8,
+        total_env_steps=16 * 8,
+        torso="impala_cnn",
+        precision="f32",
+        log_every=1,
+        actor_staleness=2,
+    )
+    hist = agent.train()
+    assert np.isfinite(hist[-1]["loss"])
