@@ -118,6 +118,10 @@ hopper_ppo = Config(
 )
 walker_ppo = hopper_ppo.replace(env_id="JaxWalker2d-v0")
 halfcheetah_ppo = hopper_ppo.replace(env_id="JaxHalfCheetah-v0")
+# The two tasks BASELINE.json:11 names, as planar on-TPU-physics analogues
+# (real MuJoCo Ant/Humanoid run via mujoco_ant_ppo / mujoco_humanoid_ppo).
+brax_ant_ppo = hopper_ppo.replace(env_id="JaxAnt-v0")
+brax_humanoid_ppo = hopper_ppo.replace(env_id="JaxHumanoid-v0")
 
 # Extra smoke presets used by tests and quick benchmarking.
 cartpole_impala = cartpole_a3c.replace(algo="impala", actor_staleness=2)
@@ -173,6 +177,8 @@ PRESETS: dict[str, Config] = {
     "hopper_ppo": hopper_ppo,
     "walker_ppo": walker_ppo,
     "halfcheetah_ppo": halfcheetah_ppo,
+    "brax_ant_ppo": brax_ant_ppo,
+    "brax_humanoid_ppo": brax_humanoid_ppo,
     "mujoco_ant_ppo": mujoco_ant_ppo,
     "mujoco_humanoid_ppo": mujoco_humanoid_ppo,
 }

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
+from asyncrl_tpu.utils.prng import masked_choice as _masked_choice
 
 # Actions: noop, up (r-1), down (r+1), left (c-1), right (c+1).
 _DR = jnp.array([0, -1, 1, 0, 0], jnp.int32)
@@ -75,9 +76,6 @@ def _braid(key: jax.Array, walls: jax.Array, k: int, p: float) -> jax.Array:
     seg = (rows % 2) != (cols % 2)
     knock = jax.random.bernoulli(key, p, (h, h)) & interior & seg
     return walls & ~knock
-
-
-from asyncrl_tpu.utils.prng import masked_choice as _masked_choice
 
 
 def _move(

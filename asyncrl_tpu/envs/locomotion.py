@@ -315,3 +315,100 @@ def make_halfcheetah() -> LocomotionEnv:
         ctrl_cost=0.05, healthy_reward=0.0, healthy_z=None, healthy_angle=None
     )
     return LocomotionEnv(sys, np.asarray(rows, np.float32), params)
+
+
+def make_ant() -> LocomotionEnv:
+    """Planar quadruped ("Ant" of BASELINE.json:11): low horizontal torso,
+    four 2-segment legs (hip+knee, contact at the lower-leg tip), 8 motors,
+    21-dim obs. The 2-D projection of Brax/MuJoCo Ant's morphology — same
+    reward shape (forward velocity + healthy bonus − control cost) and
+    healthy-z termination."""
+    b = Builder()
+    torso_half, torso_z = 0.35, 0.65
+    upper_len, lower_len = 0.3, 0.3
+    torso = b.add_body(5.0, (torso_half, 0.0))
+    rows = [[0.0, torso_z]]
+    for ax in (-torso_half, -0.12, 0.12, torso_half):
+        upper = b.add_body(0.8, (0.0, upper_len / 2))
+        lower = b.add_body(0.6, (0.0, lower_len / 2))
+        b.add_joint(
+            torso, upper, (ax, 0.0), (0.0, upper_len / 2), (-0.9, 0.9), 80.0
+        )
+        b.add_joint(
+            upper,
+            lower,
+            (0.0, -upper_len / 2),
+            (0.0, lower_len / 2),
+            (-1.8, 0.0),
+            60.0,
+        )
+        b.add_contact(lower, (0.0, -lower_len / 2))
+        b.add_contact(lower, (0.0, 0.0))
+        rows += [
+            [ax, torso_z - upper_len / 2],
+            [ax, torso_z - upper_len - lower_len / 2],
+        ]
+    b.add_contact(torso, (-torso_half, 0.0))
+    b.add_contact(torso, (torso_half, 0.0))
+    sys = b.build()
+    params = TaskParams(
+        ctrl_cost=0.5 / 8.0,  # MuJoCo Ant's 0.5 spread over 8 actuators
+        healthy_z=(0.3, 1.2),
+    )
+    return LocomotionEnv(sys, np.asarray(rows, np.float32), params)
+
+
+def make_humanoid() -> LocomotionEnv:
+    """Planar biped with arms ("Humanoid" of BASELINE.json:11): vertical
+    torso, two 3-segment legs, two 2-segment arms, 10 motors, 25-dim obs.
+    Arms are light pendulums the policy can swing for balance, as in the
+    3-D original."""
+    b = Builder()
+    torso_len, hip_z = 0.6, 0.95
+    torso = b.add_body(8.0, (0.0, torso_len / 2))
+    torso_c = hip_z + torso_len / 2
+    rows = [[0.0, torso_c]]
+    for _ in range(2):
+        zs = _leg(
+            b,
+            torso,
+            hip_anchor=(0.0, -torso_len / 2),
+            hip_z=hip_z,
+            thigh_len=0.4,
+            shin_len=0.45,
+            foot_half=0.12,
+            masses=(4.5, 3.0, 1.5),
+            gears=(120.0, 100.0, 40.0),
+        )
+        rows += [[0.0, zs[0]], [0.0, zs[1]], [0.06, zs[2] + 0.06]]
+    arm_len = 0.24
+    shoulder_z = torso_c + 0.25
+    for _ in range(2):
+        upper = b.add_body(1.5, (0.0, arm_len / 2))
+        lower = b.add_body(1.0, (0.0, arm_len / 2))
+        b.add_joint(
+            torso, upper, (0.0, 0.25), (0.0, arm_len / 2), (-2.0, 2.0), 40.0
+        )
+        b.add_joint(
+            upper,
+            lower,
+            (0.0, -arm_len / 2),
+            (0.0, arm_len / 2),
+            (-0.1, 2.3),
+            30.0,
+        )
+        rows += [
+            [0.0, shoulder_z - arm_len / 2],
+            [0.0, shoulder_z - 1.5 * arm_len],
+        ]
+    b.add_contact(torso, (0.0, torso_len / 2))
+    b.add_contact(torso, (0.0, -torso_len / 2))
+    sys = b.build()
+    params = TaskParams(
+        forward_weight=1.25,
+        healthy_reward=2.0,
+        ctrl_cost=0.1 / 10.0,
+        healthy_z=(0.9, 2.2),
+        healthy_angle=(-0.7, 0.7),
+    )
+    return LocomotionEnv(sys, np.asarray(rows, np.float32), params)

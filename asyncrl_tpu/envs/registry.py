@@ -34,8 +34,10 @@ def _register_builtins() -> None:
     from asyncrl_tpu.envs.breakout import Breakout, BreakoutPixels
     from asyncrl_tpu.envs.cartpole import CartPole
     from asyncrl_tpu.envs.locomotion import (
+        make_ant,
         make_halfcheetah,
         make_hopper,
+        make_humanoid,
         make_walker2d,
     )
     from asyncrl_tpu.envs.pendulum import Pendulum
@@ -62,6 +64,8 @@ def _register_builtins() -> None:
     register("JaxHopper-v0", make_hopper)
     register("JaxWalker2d-v0", make_walker2d)
     register("JaxHalfCheetah-v0", make_halfcheetah)
+    register("JaxAnt-v0", make_ant)
+    register("JaxHumanoid-v0", make_humanoid)
 
 
 _register_builtins()

@@ -45,6 +45,12 @@ def _accelerator_alive(timeout: float = 120.0) -> bool:
         return False
 
 
+def jnp_abs_sum(x):
+    import jax.numpy as jnp
+
+    return jnp.sum(jnp.abs(x.astype(jnp.float32)))
+
+
 def main() -> None:
     import jax
 
@@ -81,6 +87,9 @@ def main() -> None:
 
     trainer = Trainer(cfg)
     state = trainer.state
+    # Real copies: with donate_buffers=true the update donates state's
+    # buffers, and an aliasing snapshot would be deleted from under us.
+    params0 = jax.tree.map(lambda x: x.copy(), state.params)
 
     warmup, timed = 3, 30
     for _ in range(warmup):
@@ -90,8 +99,29 @@ def main() -> None:
     t0 = time.perf_counter()
     for _ in range(timed):
         state, metrics = trainer.learner.update(state)
-    jax.block_until_ready(metrics)
+    # Block on the full carried state, not just the metrics leaf, so any
+    # trailing device work is inside the timed window.
+    jax.block_until_ready(state)
     elapsed = time.perf_counter() - t0
+
+    # Execution-integrity guard: a wedged accelerator tunnel has been
+    # observed acking dispatches without executing them (absurd fps right
+    # before a hang). Training must have actually moved the params.
+    import numpy as np
+
+    delta = jax.tree.reduce(
+        lambda a, b: a + b,
+        jax.tree.map(
+            lambda a, b: float(jnp_abs_sum(a - b)), state.params, params0
+        ),
+    )
+    if not np.isfinite(delta) or delta == 0.0:
+        print(
+            f"bench: integrity check failed (param delta {delta}); "
+            "refusing to report a throughput number",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
     fps = timed * cfg.updates_per_call * cfg.num_envs * cfg.unroll_len / elapsed
     target = 1_000_000.0  # BASELINE.json:5 north-star (v4-8 target)

@@ -10,8 +10,10 @@ import pytest
 from asyncrl_tpu.envs import physics2d
 from asyncrl_tpu.envs.locomotion import (
     MAX_STEPS,
+    make_ant,
     make_halfcheetah,
     make_hopper,
+    make_humanoid,
     make_walker2d,
 )
 from asyncrl_tpu.envs.physics2d import Builder, PhysicsState
@@ -20,6 +22,8 @@ ALL_TASKS = [
     ("hopper", make_hopper, 11, 3),
     ("walker2d", make_walker2d, 17, 6),
     ("halfcheetah", make_halfcheetah, 17, 6),
+    ("ant", make_ant, 21, 8),
+    ("humanoid", make_humanoid, 25, 10),
 ]
 
 
@@ -234,10 +238,22 @@ def test_registry_and_presets_wired():
     from asyncrl_tpu.envs import registered
     from asyncrl_tpu.envs.registry import make
 
-    for env_id in ("JaxHopper-v0", "JaxWalker2d-v0", "JaxHalfCheetah-v0"):
+    for env_id in (
+        "JaxHopper-v0",
+        "JaxWalker2d-v0",
+        "JaxHalfCheetah-v0",
+        "JaxAnt-v0",
+        "JaxHumanoid-v0",
+    ):
         assert env_id in registered()
         assert make(env_id).spec.continuous
-    for p in ("hopper_ppo", "walker_ppo", "halfcheetah_ppo"):
+    for p in (
+        "hopper_ppo",
+        "walker_ppo",
+        "halfcheetah_ppo",
+        "brax_ant_ppo",
+        "brax_humanoid_ppo",
+    ):
         cfg = presets.get(p)
         assert cfg.algo == "ppo" and cfg.num_envs == 8192
 
