@@ -1,0 +1,79 @@
+"""Suite-sweep CLI (cli/suite.py) — the Atari-57 workload shape
+(BASELINE.json:9): per-game rows + aggregate summary."""
+
+import json
+
+from asyncrl_tpu.cli.suite import ATARI_FAMILY, main
+from asyncrl_tpu.envs import registered
+
+
+def test_default_family_is_registered_and_cnn_compatible():
+    from asyncrl_tpu.envs.registry import make
+
+    for env_id in ATARI_FAMILY:
+        assert env_id in registered()
+        # CNN torsos need image-like (H, W, C) observations.
+        assert len(make(env_id).spec.obs_shape) == 3, env_id
+
+
+def test_suite_sweeps_and_aggregates(tmp_path, capsys):
+    out = tmp_path / "suite.jsonl"
+    rc = main(
+        [
+            "--games",
+            "JaxPong-v0",
+            "JaxFreeway-v0",
+            "--steps",
+            "2048",
+            "--eval-episodes",
+            "2",
+            "--jsonl",
+            str(out),
+            "num_envs=16",
+            "unroll_len=8",
+            "precision=f32",
+            "log_every=1",
+            "torso=mlp",
+        ]
+    )
+    assert rc == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    games = [r["game"] for r in rows if "game" in r]
+    assert games == ["JaxPong-v0", "JaxFreeway-v0"]
+    summary = rows[-1]["suite_summary"]
+    assert summary["suite_size"] == 2
+    finals = sorted(r["final_return"] for r in rows if "game" in r)
+    assert summary["median_final_return"] == sum(finals) / 2
+
+
+def test_suite_rejects_unknown_games(capsys):
+    assert main(["--games", "NotAGame-v0"]) == 2
+    assert "NotAGame-v0" in capsys.readouterr().err
+
+
+def test_suite_skips_incompatible_games(tmp_path):
+    """A CNN-torso sweep over a vector-obs game records a skip row instead
+    of crashing the whole sweep."""
+    out = tmp_path / "skip.jsonl"
+    rc = main(
+        [
+            "--games",
+            "CartPole-v1",  # (4,) obs: incompatible with impala_cnn
+            "JaxFreeway-v0",
+            "--steps",
+            "2048",
+            "--eval-episodes",
+            "1",
+            "--jsonl",
+            str(out),
+            "num_envs=16",
+            "unroll_len=8",
+            "precision=f32",
+            "log_every=1",
+        ]
+    )
+    assert rc == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert "skipped" in rows[0] and rows[0]["game"] == "CartPole-v1"
+    assert rows[1]["game"] == "JaxFreeway-v0" and "final_return" in rows[1]
+    assert rows[-1]["suite_summary"]["suite_size"] == 1
