@@ -144,13 +144,19 @@ class Trainer:
     # ----------------------------------------------------------------- eval
 
     def evaluate(
-        self, num_episodes: int = 32, max_steps: int = 3200, seed: int = 1234
-    ) -> float:
+        self,
+        num_episodes: int = 32,
+        max_steps: int = 3200,
+        seed: int = 1234,
+        return_episodes: bool = False,
+    ):
         # Default max_steps must contain the longest builtin episode: a full
         # first-to-21 JaxPong game can run to its 3000-step truncation limit;
         # CartPole truncates at 500. Pass a smaller value for quick checks.
         """Mean greedy-policy episode return over ``num_episodes`` fresh envs,
-        fully on device (one jitted scan)."""
+        fully on device (one jitted scan). ``return_episodes=True`` returns
+        the per-episode return vector instead of the mean (same single
+        batched rollout either way)."""
         cache_key = (num_episodes, max_steps)
         if cache_key not in self._eval_fns:
             from asyncrl_tpu.ops import distributions
@@ -191,9 +197,14 @@ class Trainer:
                     None,
                     length=max_steps,
                 )
-                return jnp.mean(ret)
+                return ret
 
             self._eval_fns[cache_key] = jax.jit(eval_rollout)
-        return float(
-            self._eval_fns[cache_key](self.state.params, jax.random.PRNGKey(seed))
+        returns = self._eval_fns[cache_key](
+            self.state.params, jax.random.PRNGKey(seed)
         )
+        if return_episodes:
+            import numpy as np
+
+            return np.asarray(returns)
+        return float(jnp.mean(returns))

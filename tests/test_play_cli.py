@@ -1,0 +1,109 @@
+"""Demo/play CLI (cli/play.py) — the reference family's demo-script
+analogue (SURVEY.md §3.5): greedy episodes, trajectory dump, checkpoint
+restore."""
+
+import json
+
+import numpy as np
+
+from asyncrl_tpu.cli.play import main
+
+
+def test_play_reports_returns_and_dumps_trajectory(tmp_path, capsys):
+    npz = tmp_path / "traj.npz"
+    rc = main(
+        [
+            "cartpole_a3c",
+            "--episodes",
+            "2",
+            "--max-steps",
+            "150",
+            "--save",
+            str(npz),
+            "--json",
+            "num_envs=16",
+            "precision=f32",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert len(out["episode_returns"]) == 2
+    z = np.load(npz)
+    t = z["obs"].shape[0]
+    assert z["actions"].shape[0] == t and z["rewards"].shape[0] == t
+    # CartPole pays +1 per live step: the trimmed trajectory's return is its
+    # length, and the stored scalar matches the reward sum exactly.
+    assert float(z["episode_return"]) == float(z["rewards"].sum()) == t
+
+
+def test_play_restores_checkpoint(tmp_path, capsys):
+    """Train briefly with checkpointing, then play from the restored
+    params; restore path must load without error."""
+    from asyncrl_tpu.api.factory import make_agent
+
+    ckdir = tmp_path / "ck"
+    agent = make_agent(
+        env_id="CartPole-v1",
+        algo="a3c",
+        num_envs=16,
+        unroll_len=8,
+        total_env_steps=16 * 8 * 4,
+        precision="f32",
+        log_every=2,
+        checkpoint_dir=str(ckdir),
+        checkpoint_every=2,
+    )
+    agent.train()
+    rc = main(
+        [
+            "cartpole_a3c",
+            "--restore",
+            str(ckdir),
+            "--episodes",
+            "1",
+            "--max-steps",
+            "50",
+            "--json",
+            "num_envs=16",
+            "precision=f32",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert out["restored"] == str(ckdir)
+
+
+def test_play_episodes_zero_dumps_only(tmp_path, capsys):
+    npz = tmp_path / "only.npz"
+    rc = main(
+        [
+            "cartpole_a3c",
+            "--episodes",
+            "0",
+            "--max-steps",
+            "60",
+            "--save",
+            str(npz),
+            "num_envs=16",
+            "precision=f32",
+        ]
+    )
+    assert rc == 0
+    assert npz.exists()
+    assert "mean over" not in capsys.readouterr().out
+
+
+def test_play_save_rejects_host_backends():
+    import pytest
+
+    with pytest.raises(SystemExit, match="device-env"):
+        main(
+            [
+                "cartpole_a3c_cpu",
+                "--episodes",
+                "0",
+                "--save",
+                "/tmp/nope.npz",
+                "total_env_steps=128",
+            ]
+        )
