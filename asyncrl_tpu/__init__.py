@@ -19,6 +19,7 @@ API names (``make_agent``, ``Trainer``, ``ActorWorker``, ``RolloutBuffer``,
 __version__ = "0.1.0"
 
 from asyncrl_tpu.api.factory import make_agent
+from asyncrl_tpu.api.population import PopulationTrainer
 from asyncrl_tpu.api.trainer import Trainer
 
-__all__ = ["make_agent", "Trainer", "__version__"]
+__all__ = ["make_agent", "PopulationTrainer", "Trainer", "__version__"]

@@ -1,4 +1,5 @@
 from asyncrl_tpu.api.factory import make_agent
+from asyncrl_tpu.api.population import PopulationTrainer
 from asyncrl_tpu.api.trainer import Trainer
 
-__all__ = ["Trainer", "make_agent"]
+__all__ = ["PopulationTrainer", "Trainer", "make_agent"]

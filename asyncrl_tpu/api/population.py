@@ -1,0 +1,196 @@
+"""Population training: K independent seeds advancing in ONE fused program.
+
+A capability the reference's thread architecture cannot express: because
+the entire act→store→learn cycle is a pure function of ``TrainState``
+(learn/learner.py), a *population* of runs is just ``vmap`` over a stacked
+state — K complete training runs (distinct params, optimizer state, env
+batches, PRNG streams) advance per XLA dispatch, sharing every compiled
+kernel. Seed sweeps and hyperparameter-robustness studies that are K
+sequential jobs on the reference become one chip-saturating program here.
+
+Composition: the train-step body is built with ``axes=()`` — no collective
+touches anything, so members are EXACTLY independent single-device runs
+(test-asserted) — then ``vmap`` adds the member axis and ``shard_map``
+shards that axis over the mesh's dp axes: each device owns
+``pop_size / dp`` members end to end, so scaling the population across a
+pod costs zero inter-chip communication.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from asyncrl_tpu.envs.registry import make as make_env
+from asyncrl_tpu.learn.learner import (
+    TrainState,
+    make_optimizer,
+    make_train_step,
+    resolve_scan_impl,
+)
+from asyncrl_tpu.models.networks import build_model, is_recurrent
+from asyncrl_tpu.parallel.mesh import dp_axes, dp_size, make_mesh
+from asyncrl_tpu.rollout.anakin import actor_init
+from asyncrl_tpu.utils.config import Config
+
+
+class PopulationTrainer:
+    """Train ``pop_size`` independent seeds of one Config simultaneously.
+
+    ``num_envs`` is PER MEMBER. Members are sharded over the mesh's dp
+    axes (``pop_size`` must divide evenly); on one device the whole
+    population advances in a single fused program.
+    """
+
+    def __init__(self, config: Config, pop_size: int, mesh=None):
+        if pop_size < 1:
+            raise ValueError(f"pop_size={pop_size} must be >= 1")
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            # Auto-fit: shard members over the most devices that divide the
+            # population (a 2-member population on an 8-device host uses 2
+            # devices rather than failing the divisibility check).
+            n = len(jax.devices())
+            d = min(pop_size, n)
+            while pop_size % d:
+                d -= 1
+            self.mesh = make_mesh((d,), ("dp",), devices=jax.devices()[:d])
+        config = resolve_scan_impl(config, self.mesh)
+        if config.backend != "tpu":
+            raise ValueError(
+                "population training is Anakin-only (backend='tpu'); "
+                f"got {config.backend!r}"
+            )
+        dp = dp_size(self.mesh)
+        if pop_size % dp:
+            raise ValueError(
+                f"pop_size={pop_size} not divisible by mesh dp={dp}"
+            )
+        if config.updates_per_call != 1:
+            raise NotImplementedError(
+                "updates_per_call > 1 is not wired for population training "
+                "(the fused-K scan lives in Learner); use the default of 1"
+            )
+        self.config = config
+        self.pop_size = pop_size
+        self.env = make_env(config.env_id)
+        self.model = build_model(config, self.env.spec)
+        if is_recurrent(self.model):
+            raise NotImplementedError(
+                "population training with recurrent cores is not wired yet"
+            )
+        self.optimizer = make_optimizer(config)
+
+        # Self-contained body (axes=()) -> vmap over members -> shard_map
+        # the member axis over dp.
+        body = make_train_step(
+            config, self.env, self.model.apply, self.optimizer, self.mesh,
+            axes=(),
+        )
+        axes = dp_axes(self.mesh)
+        spec = TrainState(
+            params=P(axes),
+            actor_params=P(axes),
+            opt_state=P(axes),
+            actor=P(axes),
+            update_step=P(axes),
+        )
+        self._step = jax.jit(
+            jax.shard_map(
+                jax.vmap(body),
+                mesh=self.mesh,
+                in_specs=(spec, P(axes)),
+                out_specs=(spec, P(axes)),
+            ),
+            donate_argnums=(0,) if config.donate_buffers else (),
+        )
+        # Per-member seeds: member i must reproduce a standalone run with
+        # seed base+i (init AND in-update PRNG streams, e.g. the PPO
+        # minibatch shuffle) — asserted by tests/test_population.py.
+        self.member_seeds = jnp.arange(
+            config.seed, config.seed + pop_size, dtype=jnp.int32
+        )
+        self.state = self._init_population(config.seed)
+
+    def _member_init(self, key: jax.Array) -> TrainState:
+        """Identical state derivation to Learner.init_state, per member."""
+        cfg = self.config
+        pkey, akey = jax.random.split(key)
+        dummy_obs = jnp.zeros(
+            (1, *self.env.spec.obs_shape), self.env.spec.obs_dtype
+        )
+        params = self.model.init(pkey, dummy_obs)
+        opt_state = self.optimizer.init(params)
+        actor = actor_init(
+            self.env, cfg.num_envs, jax.random.split(akey, 1)[0],
+            model=self.model,
+        )
+        return TrainState(
+            params=params,
+            actor_params=params,
+            opt_state=opt_state,
+            actor=actor,
+            update_step=jnp.zeros((), jnp.int32),
+        )
+
+    def _init_population(self, base_seed: int) -> TrainState:
+        keys = jnp.stack(
+            [jax.random.PRNGKey(base_seed + i) for i in range(self.pop_size)]
+        )
+        return jax.jit(jax.vmap(self._member_init))(keys)
+
+    def update(self) -> dict[str, jax.Array]:
+        """Advance every member one update; metrics leaves are [pop_size]."""
+        self.state, metrics = self._step(self.state, self.member_seeds)
+        return metrics
+
+    def train(
+        self, callback: Callable[[dict], Any] | None = None
+    ) -> list[dict]:
+        """Run the full budget (``total_env_steps`` PER MEMBER), reporting
+        per-member metric vectors every ``log_every`` updates.
+
+        Episode statistics accumulate across the WHOLE window (as in
+        Trainer.train): every completed episode since the last report
+        counts, so members with long episodes are not spuriously zeroed by
+        whichever fragment happened to land on the logging step.
+        """
+        cfg = self.config
+        frames_per_update = cfg.num_envs * cfg.unroll_len
+        num_updates = max(1, cfg.total_env_steps // frames_per_update)
+        history = []
+        pending: list[dict] = []
+        for step in range(1, num_updates + 1):
+            pending.append(self.update())
+            if step % cfg.log_every == 0 or step == num_updates:
+                # One host sync per window, not per update.
+                drained = [
+                    {k: np.asarray(v) for k, v in m.items()} for m in pending
+                ]
+                pending = []
+                window = {
+                    k: np.mean([m[k] for m in drained], axis=0)
+                    for k in drained[0]
+                    if not k.endswith("_sum") and k != "episode_count"
+                }
+                counts = sum(m["episode_count"] for m in drained)
+                ret_sum = sum(m["episode_return_sum"] for m in drained)
+                len_sum = sum(m["episode_length_sum"] for m in drained)
+                safe = np.maximum(counts, 1)
+                window["episode_return"] = ret_sum / safe
+                window["episode_length"] = len_sum / safe
+                window["episode_count"] = counts
+                window["env_steps"] = step * frames_per_update
+                history.append(window)
+                if callback is not None:
+                    callback(window)
+        return history
+
+    def member_params(self, i: int):
+        """Extract one member's params (e.g. the best seed, for eval)."""
+        return jax.tree.map(lambda x: x[i], self.state.params)

@@ -32,6 +32,26 @@ from asyncrl_tpu.rollout.buffer import Rollout
 from asyncrl_tpu.utils.config import Config
 
 
+# Axes-tolerant collectives: the train-step body is also used with
+# ``axes=()`` (population mode, api/population.py — members are independent,
+# nothing may reduce across them), where each collective degenerates to the
+# single-shard identity.
+def _pmean(x, axes):
+    return x if not axes else jax.lax.pmean(x, axes)
+
+
+def _psum(x, axes):
+    return x if not axes else jax.lax.psum(x, axes)
+
+
+def _axis_size(axes) -> int:
+    return 1 if not axes else jax.lax.axis_size(axes)
+
+
+def _axis_index(axes):
+    return jnp.zeros((), jnp.int32) if not axes else jax.lax.axis_index(axes)
+
+
 @struct.dataclass
 class TrainState:
     """Full training state; the unit of checkpointing (SURVEY.md §5.4).
@@ -186,7 +206,11 @@ def _algo_loss(
 def _ppo_multipass(
     config: Config, apply_fn, optimizer, dist, params, opt_state,
     rollout: Rollout, update_step: jax.Array,
-    axes: tuple[str, ...] = (),
+    *,
+    axes: tuple[str, ...],  # required: () is now a MEANINGFUL value
+    # (population mode, no cross-shard reduction) — a silent default here
+    # would turn a forgotten-axes call site into unsynchronized params.
+    member_seed: jax.Array | None = None,
 ):
     """PPO's real update: ``ppo_epochs`` passes over the fragment, each a
     scan of ``ppo_minibatches`` shuffled minibatch Adam steps (the reference's
@@ -200,8 +224,6 @@ def _ppo_multipass(
     psum over the dp axis, so every device applies identical parameter
     updates.
     """
-    if not axes:
-        raise ValueError("axes is required (pass dp_axes(mesh))")
     obs_all = jnp.concatenate([rollout.obs, rollout.bootstrap_obs[None]], axis=0)
     _, values_all = apply_fn(params, obs_all)
     values_t, bootstrap_value = values_all[:-1], values_all[-1]
@@ -231,10 +253,14 @@ def _ppo_multipass(
 
     # Deterministic per-(step, device, epoch) shuffle key; no PRNG state
     # threads through TrainState.
+    # ``member_seed`` (population mode) replaces config.seed so member i's
+    # shuffle stream equals a STANDALONE run with seed=member_seed — the
+    # exact-equivalence invariant tests/test_population.py asserts.
+    seed = config.seed if member_seed is None else member_seed
     base_key = jax.random.fold_in(
-        jax.random.PRNGKey(config.seed + 0x5EB), update_step
+        jax.random.PRNGKey(seed + 0x5EB), update_step
     )
-    base_key = jax.random.fold_in(base_key, jax.lax.axis_index(axes))
+    base_key = jax.random.fold_in(base_key, _axis_index(axes))
 
     def minibatch_step(carry, batch):
         params, opt_state = carry
@@ -245,10 +271,11 @@ def _ppo_multipass(
                 logits, values, batch["actions"], batch["behaviour_logp"],
                 batch["advantages"], batch["returns"],
                 clip_eps=config.ppo_clip_eps, value_coef=config.value_coef,
-                entropy_coef=config.entropy_coef, axis_name=axes, dist=dist,
+                entropy_coef=config.entropy_coef, axis_name=axes or None,
+                dist=dist,
             )
             metrics = dict(metrics, loss=loss)
-            return loss / jax.lax.axis_size(axes), metrics
+            return loss / _axis_size(axes), metrics
 
         grads, metrics = jax.grad(scaled_loss, has_aux=True)(params)
         metrics["grad_norm"] = optax.global_norm(grads)
@@ -280,8 +307,14 @@ def make_train_step(
     apply_fn: Callable,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
+    axes: tuple[str, ...] | None = None,
 ) -> Callable[[TrainState], tuple[TrainState, dict[str, jax.Array]]]:
-    """Build the per-shard train-step body (to be wrapped in shard_map)."""
+    """Build the per-shard train-step body (to be wrapped in shard_map).
+
+    ``axes`` defaults to the mesh's data-parallel axes; pass ``()`` for a
+    fully self-contained body with no cross-shard reduction (population
+    mode: each vmapped member is its own training run).
+    """
     from asyncrl_tpu.ops import distributions
 
     dist = distributions.for_spec(env.spec)
@@ -292,9 +325,13 @@ def make_train_step(
         config.ppo_epochs > 1 or config.ppo_minibatches > 1
     )
 
-    axes = dp_axes(mesh)
+    if axes is None:
+        axes = dp_axes(mesh)
 
-    def train_step(state: TrainState):
+    def train_step(state: TrainState, member_seed: jax.Array | None = None):
+        # ``member_seed``: population mode only (api/population.py) — the
+        # per-member integer seed whose standalone run this member must
+        # reproduce exactly. None everywhere else.
         # named_scope: sections show up as labeled blocks in jax.profiler
         # traces (SURVEY.md §5.1; CLI --profile).
         with jax.named_scope("rollout"):
@@ -308,7 +345,7 @@ def make_train_step(
                 params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
                     config, apply_fn, optimizer, dist,
                     state.params, state.opt_state, rollout, state.update_step,
-                    axes=axes,
+                    axes=axes, member_seed=member_seed,
                 )
         else:
             # shard_map autodiff semantics (jax>=0.8 vma tracking): the
@@ -321,9 +358,10 @@ def make_train_step(
             # 8-device CPU mesh, tests/test_learner).
             def scaled_loss(p):
                 loss, metrics = _algo_loss(
-                    config, apply_fn, p, rollout, axis_name=axes, dist=dist
+                    config, apply_fn, p, rollout,
+                    axis_name=axes or None, dist=dist,
                 )
-                return loss / jax.lax.axis_size(axes), (loss, metrics)
+                return loss / _axis_size(axes), (loss, metrics)
 
             with jax.named_scope("loss_and_grad"):
                 (_, (loss, metrics)), grads = jax.value_and_grad(
@@ -336,8 +374,8 @@ def make_train_step(
                 )
                 params = optax.apply_updates(state.params, updates)
 
-        metrics = jax.lax.pmean(metrics, axes)
-        loss = jax.lax.pmean(loss, axes)
+        metrics = _pmean(metrics, axes)
+        loss = _pmean(loss, axes)
 
         step = state.update_step + 1
         if config.algo == "impala" and config.actor_staleness > 1:
@@ -355,13 +393,9 @@ def make_train_step(
         metrics = dict(metrics)
         metrics["loss"] = loss
         metrics["grad_norm"] = grad_norm
-        metrics["episode_return_sum"] = jax.lax.psum(
-            stats.completed_return_sum, axes
-        )
-        metrics["episode_length_sum"] = jax.lax.psum(
-            stats.completed_length_sum, axes
-        )
-        metrics["episode_count"] = jax.lax.psum(stats.completed_count, axes)
+        metrics["episode_return_sum"] = _psum(stats.completed_return_sum, axes)
+        metrics["episode_length_sum"] = _psum(stats.completed_length_sum, axes)
+        metrics["episode_count"] = _psum(stats.completed_count, axes)
 
         new_state = TrainState(
             params=params,
