@@ -14,12 +14,31 @@ training behind a ``make_agent``/``Trainer`` API, where
 Reference parity: the reference mount was empty this session (SURVEY.md §0);
 API names (``make_agent``, ``Trainer``, ``ActorWorker``, ``RolloutBuffer``,
 ``Learner``) follow the driver's north-star spec (BASELINE.json:5).
+
+Exports resolve lazily (PEP 562): importing the bare package touches no JAX
+arrays, so ``jax.distributed.initialize`` (cli/launch.py) can still run
+first — env modules hold module-level ``jnp`` constants that would
+otherwise initialize the XLA backend at import time.
 """
 
 __version__ = "0.1.0"
 
-from asyncrl_tpu.api.factory import make_agent
-from asyncrl_tpu.api.population import PopulationTrainer
-from asyncrl_tpu.api.trainer import Trainer
+_EXPORTS = {
+    "make_agent": "asyncrl_tpu.api.factory",
+    "Trainer": "asyncrl_tpu.api.trainer",
+    "PopulationTrainer": "asyncrl_tpu.api.population",
+}
 
 __all__ = ["make_agent", "PopulationTrainer", "Trainer", "__version__"]
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module 'asyncrl_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(__all__)

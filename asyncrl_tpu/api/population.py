@@ -28,6 +28,8 @@ from jax.sharding import PartitionSpec as P
 from asyncrl_tpu.envs.registry import make as make_env
 from asyncrl_tpu.learn.learner import (
     TrainState,
+    derive_init_keys,
+    init_params,
     make_optimizer,
     make_train_step,
     resolve_scan_impl,
@@ -76,6 +78,17 @@ class PopulationTrainer:
                 "updates_per_call > 1 is not wired for population training "
                 "(the fused-K scan lives in Learner); use the default of 1"
             )
+        # Same eager geometry validation as Learner.__init__ (clearer than
+        # a trace-time failure inside the first update).
+        if config.algo == "ppo" and (
+            config.ppo_epochs > 1 or config.ppo_minibatches > 1
+        ):
+            member_frag = config.num_envs * config.unroll_len
+            if member_frag % config.ppo_minibatches:
+                raise ValueError(
+                    f"per-member fragment of {member_frag} samples not "
+                    f"divisible by ppo_minibatches={config.ppo_minibatches}"
+                )
         self.config = config
         self.pop_size = pop_size
         self.env = make_env(config.env_id)
@@ -118,14 +131,14 @@ class PopulationTrainer:
         self.state = self._init_population(config.seed)
 
     def _member_init(self, key: jax.Array) -> TrainState:
-        """Identical state derivation to Learner.init_state, per member."""
+        """Identical state derivation to Learner.init_state (dp=1 case),
+        via the shared helpers — see learn.learner.derive_init_keys."""
         cfg = self.config
-        pkey, akey = jax.random.split(key)
-        dummy_obs = jnp.zeros(
-            (1, *self.env.spec.obs_shape), self.env.spec.obs_dtype
-        )
-        params = self.model.init(pkey, dummy_obs)
+        pkey, akey = derive_init_keys(key)
+        params = init_params(self.model, self.env, pkey)
         opt_state = self.optimizer.init(params)
+        # Matches init_state's per-device key derivation at dp=1:
+        # split(akey, dp)[device] with dp=1, device=0.
         actor = actor_init(
             self.env, cfg.num_envs, jax.random.split(akey, 1)[0],
             model=self.model,
@@ -162,7 +175,11 @@ class PopulationTrainer:
         """
         cfg = self.config
         frames_per_update = cfg.num_envs * cfg.unroll_len
-        num_updates = max(1, cfg.total_env_steps // frames_per_update)
+        # Run UNTIL the budget is met (ceil), matching Trainer.train's
+        # while-loop semantics for budgets that aren't exact multiples.
+        num_updates = max(
+            1, -(-cfg.total_env_steps // frames_per_update)
+        )
         history = []
         pending: list[dict] = []
         for step in range(1, num_updates + 1):

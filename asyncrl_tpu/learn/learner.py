@@ -301,6 +301,25 @@ def _ppo_multipass(
     return params, opt_state, loss, grad_norm, metrics
 
 
+def derive_init_keys(key: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """The one canonical (params key, actor key) split for a training run.
+
+    Shared by ``Learner.init_state`` AND ``PopulationTrainer._member_init``:
+    a population member with seed s must reproduce a standalone run with
+    seed s bit-for-bit (tests/test_population.py), so the derivation lives
+    in exactly one place.
+    """
+    return tuple(jax.random.split(key))
+
+
+def init_params(model, env: Environment, pkey: jax.Array):
+    """Canonical model init for a training run (see derive_init_keys)."""
+    dummy_obs = jnp.zeros((1, *env.spec.obs_shape), env.spec.obs_dtype)
+    if is_recurrent(model):
+        return model.init(pkey, dummy_obs, model.initial_core(1))
+    return model.init(pkey, dummy_obs)
+
+
 def make_train_step(
     config: Config,
     env: Environment,
@@ -484,15 +503,8 @@ class Learner:
                 f"num_envs={cfg.num_envs} not divisible by dp={dp}"
             )
         key = jax.random.PRNGKey(seed)
-        pkey, akey = jax.random.split(key)
-
-        dummy_obs = jnp.zeros((1, *self.env.spec.obs_shape), self.env.spec.obs_dtype)
-        if is_recurrent(self.model):
-            params = self.model.init(
-                pkey, dummy_obs, self.model.initial_core(1)
-            )
-        else:
-            params = self.model.init(pkey, dummy_obs)
+        pkey, akey = derive_init_keys(key)
+        params = init_params(self.model, self.env, pkey)
         opt_state = self.optimizer.init(params)
 
         # Per-device actor init inside shard_map so env states are born

@@ -1,0 +1,67 @@
+"""TRUE multi-process distributed training (cli/launch.py +
+parallel/distributed.py): two OS processes, each with 4 virtual CPU
+devices, join one jax.distributed runtime and train over the global
+(2 x 4) dcn x dp mesh with real cross-process collectives — the CI-side
+equivalent of a 2-host TPU pod run (SURVEY.md §5.8b)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_launch_trains():
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        code = f"""
+import jax; jax.config.update('jax_platforms','cpu')
+from asyncrl_tpu.cli.launch import main
+raise SystemExit(main(["cartpole_impala",
+    "--coordinator", "127.0.0.1:{port}",
+    "--num-processes", "2", "--process-id", "{pid}",
+    "--steps", "2048",
+    "num_envs=32", "unroll_len=8", "precision=f32", "log_every=4"]))
+"""
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", code],
+                env=env,
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = [p.communicate(timeout=480) for p in procs]
+    for p, (o, e) in zip(procs, outs):
+        assert p.returncode == 0, e[-2000:]
+
+    lead_out, follower_out = outs[0][0], outs[1][0]
+    lines = [l for l in lead_out.splitlines() if l.startswith("{")]
+    header = json.loads(lines[0])
+    assert header["processes"] == 2
+    assert header["global_devices"] == 8
+    assert header["local_devices"] == 4
+    assert header["mesh"] == {"dcn": 2, "dp": 4}
+    final = json.loads(lines[-1])["final"]
+    assert final["env_steps"] == 2048.0
+    # Only the lead process reports.
+    assert "final" not in follower_out

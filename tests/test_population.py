@@ -127,3 +127,18 @@ def test_population_window_accumulates_episodes():
     # count must reflect the whole window.
     assert np.all(hist[-1]["episode_count"] >= 5)
     assert np.all(hist[-1]["episode_return"] > 0)
+
+
+def test_population_eager_ppo_geometry_validation():
+    cfg = CFG.replace(algo="ppo", ppo_epochs=2, ppo_minibatches=3)
+    with pytest.raises(ValueError, match="ppo_minibatches"):
+        PopulationTrainer(cfg, pop_size=2)  # 16*8=128 not divisible by 3
+
+
+def test_population_budget_ceils():
+    """A budget that is not an exact multiple still gets fully consumed
+    (ceil semantics, matching Trainer.train)."""
+    cfg = CFG.replace(total_env_steps=16 * 8 * 2 + 1, log_every=100)
+    pop = PopulationTrainer(cfg, pop_size=2)
+    hist = pop.train()
+    assert hist[-1]["env_steps"] == 16 * 8 * 3  # 3 updates, not 2
