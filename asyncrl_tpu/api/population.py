@@ -33,6 +33,7 @@ from asyncrl_tpu.learn.learner import (
     make_optimizer,
     make_train_step,
     resolve_scan_impl,
+    validate_ppo_geometry,
 )
 from asyncrl_tpu.models.networks import build_model, is_recurrent
 from asyncrl_tpu.parallel.mesh import dp_axes, dp_size, make_mesh
@@ -48,9 +49,27 @@ class PopulationTrainer:
     population advances in a single fused program.
     """
 
-    def __init__(self, config: Config, pop_size: int, mesh=None):
+    def __init__(
+        self,
+        config: Config,
+        pop_size: int,
+        mesh=None,
+        learning_rates=None,
+    ):
+        """``learning_rates`` (optional, [pop_size]) turns the population
+        into a hyperparameter sweep: member i trains with its own learning
+        rate. Implemented with ``optax.inject_hyperparams`` — the rate
+        lives inside the (vmapped, per-member) optimizer state, so the
+        fused program is unchanged; only the init differs. Note this
+        breaks the member==standalone-with-seed-base+i equivalence unless
+        the standalone uses the matching learning_rate."""
         if pop_size < 1:
             raise ValueError(f"pop_size={pop_size} must be >= 1")
+        if learning_rates is not None and len(learning_rates) != pop_size:
+            raise ValueError(
+                f"learning_rates has {len(learning_rates)} entries for "
+                f"pop_size={pop_size}"
+            )
         if mesh is not None:
             self.mesh = mesh
         else:
@@ -80,15 +99,7 @@ class PopulationTrainer:
             )
         # Same eager geometry validation as Learner.__init__ (clearer than
         # a trace-time failure inside the first update).
-        if config.algo == "ppo" and (
-            config.ppo_epochs > 1 or config.ppo_minibatches > 1
-        ):
-            member_frag = config.num_envs * config.unroll_len
-            if member_frag % config.ppo_minibatches:
-                raise ValueError(
-                    f"per-member fragment of {member_frag} samples not "
-                    f"divisible by ppo_minibatches={config.ppo_minibatches}"
-                )
+        validate_ppo_geometry(config, config.num_envs, "per-member")
         self.config = config
         self.pop_size = pop_size
         self.env = make_env(config.env_id)
@@ -97,7 +108,21 @@ class PopulationTrainer:
             raise NotImplementedError(
                 "population training with recurrent cores is not wired yet"
             )
-        self.optimizer = make_optimizer(config)
+        if learning_rates is None:
+            self.optimizer = make_optimizer(config)
+            self._member_lrs = None
+        else:
+            import optax
+
+            # Same chain as make_optimizer, but with the adam step's rate
+            # injected through opt_state so it can differ per member.
+            self.optimizer = optax.chain(
+                optax.clip_by_global_norm(config.max_grad_norm),
+                optax.inject_hyperparams(optax.adam)(
+                    learning_rate=config.learning_rate, eps=config.adam_eps
+                ),
+            )
+            self._member_lrs = jnp.asarray(learning_rates, jnp.float32)
 
         # Self-contained body (axes=()) -> vmap over members -> shard_map
         # the member axis over dp.
@@ -130,13 +155,25 @@ class PopulationTrainer:
         )
         self.state = self._init_population(config.seed)
 
-    def _member_init(self, key: jax.Array) -> TrainState:
+    def _member_init(
+        self, key: jax.Array, lr: jax.Array | None = None
+    ) -> TrainState:
         """Identical state derivation to Learner.init_state (dp=1 case),
         via the shared helpers — see learn.learner.derive_init_keys."""
         cfg = self.config
         pkey, akey = derive_init_keys(key)
         params = init_params(self.model, self.env, pkey)
         opt_state = self.optimizer.init(params)
+        if lr is not None:
+            # inject_hyperparams keeps the rate in opt_state: the chain's
+            # second element carries hyperparams["learning_rate"].
+            inject = opt_state[1]
+            opt_state = (
+                opt_state[0],
+                inject._replace(
+                    hyperparams={**inject.hyperparams, "learning_rate": lr}
+                ),
+            )
         # Matches init_state's per-device key derivation at dp=1:
         # split(akey, dp)[device] with dp=1, device=0.
         actor = actor_init(
@@ -155,7 +192,9 @@ class PopulationTrainer:
         keys = jnp.stack(
             [jax.random.PRNGKey(base_seed + i) for i in range(self.pop_size)]
         )
-        return jax.jit(jax.vmap(self._member_init))(keys)
+        if self._member_lrs is None:
+            return jax.jit(jax.vmap(self._member_init))(keys)
+        return jax.jit(jax.vmap(self._member_init))(keys, self._member_lrs)
 
     def update(self) -> dict[str, jax.Array]:
         """Advance every member one update; metrics leaves are [pop_size]."""

@@ -1,0 +1,29 @@
+"""Shared CLI plumbing: preset/override resolution and the cpu_async
+platform guard, used by every entry point (train / suite / play / launch)
+so fixes cannot drift between them."""
+
+from __future__ import annotations
+
+
+def resolve_config(
+    preset: str, overrides: list[str], steps: int | None = None
+):
+    """Preset + ``key=value`` overrides + optional --steps, resolved."""
+    from asyncrl_tpu.configs import presets
+    from asyncrl_tpu.utils.config import override
+
+    cfg = override(presets.get(preset), overrides)
+    if steps is not None:
+        cfg = cfg.replace(total_env_steps=steps)
+    return cfg
+
+
+def apply_platform_guard(cfg) -> None:
+    """The cpu_async parity backend is CPU-only by contract: restrict the
+    platform list BEFORE any backend initializes, so JAX's global init
+    never touches an attached accelerator (jax initializes ALL registered
+    platforms on first device query)."""
+    if cfg.backend == "cpu_async":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -62,12 +62,9 @@ def main(argv: list[str] | None = None) -> int:
     import jax
 
     from asyncrl_tpu.api.trainer import Trainer
-    from asyncrl_tpu.configs import presets
-    from asyncrl_tpu.utils.config import override
+    from asyncrl_tpu.cli.common import resolve_config
 
-    cfg = override(presets.get(args.preset), args.overrides)
-    if args.steps is not None:
-        cfg = cfg.replace(total_env_steps=args.steps)
+    cfg = resolve_config(args.preset, args.overrides, args.steps)
     if cfg.backend != "tpu":
         raise SystemExit(
             f"multi-host launch is Anakin-only (backend='tpu'); "

@@ -45,17 +45,10 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     from asyncrl_tpu.api.factory import make_agent
-    from asyncrl_tpu.configs import presets
-    from asyncrl_tpu.utils.config import override
+    from asyncrl_tpu.cli.common import apply_platform_guard, resolve_config
 
-    cfg = override(presets.get(args.preset), args.overrides)
-
-    if cfg.backend == "cpu_async":
-        # Same guard as cli/train.py: the parity backend is CPU-only by
-        # contract; keep global backend init from touching an accelerator.
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    cfg = resolve_config(args.preset, args.overrides)
+    apply_platform_guard(cfg)
 
     agent = make_agent(cfg, restore=args.restore)
     try:

@@ -65,9 +65,8 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     from asyncrl_tpu.api.factory import make_agent
-    from asyncrl_tpu.configs import presets
+    from asyncrl_tpu.cli.common import apply_platform_guard, resolve_config
     from asyncrl_tpu.envs import registered
-    from asyncrl_tpu.utils.config import override
 
     games = args.games or ATARI_FAMILY
     if games == ["all"]:
@@ -80,16 +79,8 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 2
 
-    base = override(presets.get(args.preset), args.overrides)
-    if args.steps is not None:
-        base = base.replace(total_env_steps=args.steps)
-
-    if base.backend == "cpu_async":
-        # Same guard as cli/train.py: the parity backend is CPU-only by
-        # contract; keep global backend init from touching an accelerator.
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    base = resolve_config(args.preset, args.overrides, args.steps)
+    apply_platform_guard(base)
 
     from asyncrl_tpu.envs.registry import make as make_env
     from asyncrl_tpu.utils.metrics import JsonlSink

@@ -46,21 +46,10 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     from asyncrl_tpu.api.factory import make_agent
-    from asyncrl_tpu.configs import presets
-    from asyncrl_tpu.utils.config import override
+    from asyncrl_tpu.cli.common import apply_platform_guard, resolve_config
 
-    cfg = override(presets.get(args.preset), args.overrides)
-    if args.steps is not None:
-        cfg = cfg.replace(total_env_steps=args.steps)
-
-    if cfg.backend == "cpu_async":
-        # The parity backend is CPU-only by contract; restricting the
-        # platform list before any backend initializes keeps JAX's global
-        # backend init from even touching an attached accelerator (jax
-        # initializes ALL registered platforms on first device query).
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    cfg = resolve_config(args.preset, args.overrides, args.steps)
+    apply_platform_guard(cfg)
 
     agent = make_agent(cfg)
 

@@ -76,6 +76,7 @@ NATIVE_ENV_IDS = {
     "CartPole-v1": "CartPole-v1",
     "JaxPong-v0": "Pong",  # same rules as the JAX env (envs/pong.py)
     "JaxBreakout-v0": "Breakout",  # same rules as envs/breakout.py
+    "JaxFreeway-v0": "Freeway",  # same rules as envs/minatari.py::Freeway
 }
 
 

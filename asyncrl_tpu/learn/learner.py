@@ -237,12 +237,9 @@ def _ppo_multipass(
     )
 
     T, B = rollout.actions.shape[:2]
+    validate_ppo_geometry(config, B, "trace-time local")
     n = T * B
     mb = config.ppo_minibatches
-    if n % mb:
-        raise ValueError(
-            f"unroll_len*local_envs={n} not divisible by ppo_minibatches={mb}"
-        )
     flat = {
         "obs": rollout.obs.reshape(n, *rollout.obs.shape[2:]),
         "actions": rollout.actions.reshape(n, *rollout.actions.shape[2:]),
@@ -299,6 +296,21 @@ def _ppo_multipass(
     loss = metrics.pop("loss")
     grad_norm = metrics.pop("grad_norm")
     return params, opt_state, loss, grad_norm, metrics
+
+
+def validate_ppo_geometry(config: Config, local_envs: int, label: str) -> None:
+    """One rule, three callers (Learner.__init__, PopulationTrainer,
+    _ppo_multipass's trace-time check): a multipass-PPO fragment must split
+    evenly into minibatches."""
+    if config.algo == "ppo" and (
+        config.ppo_epochs > 1 or config.ppo_minibatches > 1
+    ):
+        frag = local_envs * config.unroll_len
+        if frag % config.ppo_minibatches:
+            raise ValueError(
+                f"{label} fragment of {frag} samples not divisible by "
+                f"ppo_minibatches={config.ppo_minibatches}"
+            )
 
 
 def derive_init_keys(key: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -460,15 +472,7 @@ class Learner:
             raise ValueError(
                 f"num_envs={config.num_envs} not divisible by dp={dp}"
             )
-        if config.algo == "ppo" and (
-            config.ppo_epochs > 1 or config.ppo_minibatches > 1
-        ):
-            local = (config.num_envs // dp) * config.unroll_len
-            if local % config.ppo_minibatches:
-                raise ValueError(
-                    f"per-device fragment of {local} samples not divisible "
-                    f"by ppo_minibatches={config.ppo_minibatches}"
-                )
+        validate_ppo_geometry(config, config.num_envs // dp, "per-device")
 
         spec = state_partition_spec(dp_axes(mesh))
         body = make_train_step(config, env, model.apply, self.optimizer, mesh)

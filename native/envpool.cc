@@ -433,10 +433,89 @@ struct EnvPool {
   }
 };
 
+// Freeway (MinAtar-style), matching asyncrl_tpu/envs/minatari.py::Freeway
+// rule for rule: 10x10 grid, chicken in column 4 crossing 8 traffic lanes,
+// +1 at the top row (back to start), collision sends it back, fixed
+// 2500-step episode (truncation only). Observation layout mirrors the JAX
+// env's [10, 10, 2] uint8 planes (chicken, cars), flattened row-major, so
+// tests can seed the JAX env from a native reset and step both in
+// lockstep (the step itself is deterministic).
+struct FreewayEnv final : EnvBase {
+  static constexpr int kG = 10, kLanes = 8;
+  static constexpr int kMaxSteps = 2500, kMoveCooldown = 1;
+  // Lane speeds: a car advances one cell every |speed| steps; sign is the
+  // direction (matches minatari._LANE_SPEED).
+  static constexpr int kSpeed[kLanes] = {1, 2, 3, 4, -1, -2, -3, -4};
+
+  int chicken, move_cd, t;
+  int cars[kLanes], timers[kLanes];
+
+  int obs_dim() const override { return kG * kG * 2; }
+  int num_actions() const override { return 3; }
+
+  void reset(Rng& rng, float* obs) override {
+    chicken = kG - 1;
+    move_cd = 0;
+    t = 0;
+    for (int i = 0; i < kLanes; ++i) {
+      cars[i] = static_cast<int>(rng.uniform(0.0f, (float)kG)) % kG;
+      timers[i] = kSpeed[i] < 0 ? -kSpeed[i] : kSpeed[i];
+    }
+    observe(obs);
+  }
+
+  void observe(float* obs) const {
+    std::memset(obs, 0, sizeof(float) * kG * kG * 2);
+    obs[(chicken * kG + 4) * 2 + 0] = 1.0f;
+    for (int i = 0; i < kLanes; ++i)
+      obs[((i + 1) * kG + cars[i]) * 2 + 1] = 1.0f;
+  }
+
+  void step(int action, Rng& rng, float* obs, float* reward,
+            uint8_t* terminated, uint8_t* truncated) override {
+    const bool can_move = move_cd <= 0;
+    const int delta = action == 1 ? -1 : (action == 2 ? 1 : 0);
+    if (can_move && delta != 0) {
+      chicken += delta;
+      if (chicken < 0) chicken = 0;
+      if (chicken > kG - 1) chicken = kG - 1;
+      move_cd = kMoveCooldown;
+    } else {
+      move_cd -= 1;
+    }
+
+    for (int i = 0; i < kLanes; ++i) {
+      if (timers[i] <= 1) {
+        const int dir = kSpeed[i] < 0 ? -1 : 1;
+        cars[i] = ((cars[i] + dir) % kG + kG) % kG;
+        timers[i] = kSpeed[i] < 0 ? -kSpeed[i] : kSpeed[i];
+      } else {
+        timers[i] -= 1;
+      }
+    }
+
+    const bool in_traffic = chicken >= 1 && chicken <= kLanes;
+    const bool hit = in_traffic && cars[chicken - 1] == 4;
+    const bool scored = chicken == 0;
+    *reward = scored ? 1.0f : 0.0f;
+    if (scored || hit) chicken = kG - 1;
+
+    t += 1;
+    *terminated = 0;
+    *truncated = t >= kMaxSteps ? 1 : 0;
+    if (*truncated) {
+      reset(rng, obs);
+      return;
+    }
+    observe(obs);
+  }
+};
+
 EnvBase* make_env(const std::string& id) {
   if (id == "CartPole-v1") return new CartPoleEnv();
   if (id == "Pong") return new PongEnv();
   if (id == "Breakout") return new BreakoutEnv();
+  if (id == "Freeway") return new FreewayEnv();
   return nullptr;
 }
 

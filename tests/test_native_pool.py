@@ -202,3 +202,49 @@ def test_native_pool_threaded_equals_single_threaded():
 def test_native_pool_unknown_env():
     with pytest.raises(KeyError, match="native"):
         NativeEnvPool("NopeEnv-v0", 4)
+
+
+def test_native_freeway_matches_jax_dynamics():
+    """Seed the JAX Freeway from a native reset (cars from the obs planes,
+    timers/cooldown at their known reset values), then step both in
+    lockstep: Freeway's step is fully deterministic, so obs and rewards
+    must agree exactly until truncation."""
+    import jax
+    import jax.numpy as jnp
+
+    from asyncrl_tpu.envs.minatari import _LANE_SPEED, Freeway, FreewayState, G
+
+    pool = NativeEnvPool("JaxFreeway-v0", 4, num_threads=1, seed=3)
+    try:
+        obs = pool.reset().reshape(4, G, G, 2)
+        env = Freeway()
+        # Reconstruct per-env state from the car plane (one car per lane);
+        # timers reset to |_LANE_SPEED| — the env's own table, so a retune
+        # cannot desynchronize this reconstruction.
+        cars = np.argmax(obs[:, 1:9, :, 1], axis=2)  # [4, 8]
+        states = FreewayState(
+            chicken=jnp.full((4,), G - 1, jnp.int32),
+            cars=jnp.asarray(cars, jnp.int32),
+            timers=jnp.tile(jnp.abs(_LANE_SPEED)[None], (4, 1)),
+            move_cd=jnp.zeros((4,), jnp.int32),
+            t=jnp.zeros((4,), jnp.int32),
+        )
+        step = jax.jit(jax.vmap(env.step))
+        rng = np.random.default_rng(0)
+        key = jax.random.PRNGKey(0)
+        for i in range(300):
+            actions = rng.integers(0, 3, 4).astype(np.int32)
+            nobs, nrew, nterm, ntrunc = pool.step(actions)
+            key, sub = jax.random.split(key)
+            states, ts = step(
+                states, jnp.asarray(actions), jax.random.split(sub, 4)
+            )
+            np.testing.assert_array_equal(
+                nobs.reshape(4, G, G, 2),
+                np.asarray(ts.obs, np.float32),
+                err_msg=f"obs diverged at step {i}",
+            )
+            np.testing.assert_array_equal(nrew, np.asarray(ts.reward))
+            assert not nterm.any()  # freeway never terminates
+    finally:
+        pool.close()

@@ -142,3 +142,24 @@ def test_population_budget_ceils():
     pop = PopulationTrainer(cfg, pop_size=2)
     hist = pop.train()
     assert hist[-1]["env_steps"] == 16 * 8 * 3  # 3 updates, not 2
+
+
+def test_population_learning_rate_sweep():
+    """Per-member learning rates ride the vmapped optimizer state: lr=0
+    must freeze its member while others train."""
+    lrs = [0.0, 1e-3, 1e-2, 1e-3]
+    pop = PopulationTrainer(CFG, pop_size=4, learning_rates=lrs)
+    init0 = _params_of(pop.member_params(0))
+    init1 = _params_of(pop.member_params(1))
+    for _ in range(3):
+        pop.update()
+    after0 = _params_of(pop.member_params(0))
+    after1 = _params_of(pop.member_params(1))
+    for a, b in zip(init0, after0):
+        np.testing.assert_array_equal(a, b)  # lr=0: frozen
+    assert any(
+        not np.allclose(a, b) for a, b in zip(init1, after1)
+    )  # lr>0: moved
+
+    with pytest.raises(ValueError, match="learning_rates"):
+        PopulationTrainer(CFG, pop_size=2, learning_rates=[1e-3])
