@@ -55,6 +55,7 @@ class PopulationTrainer:
         pop_size: int,
         mesh=None,
         learning_rates=None,
+        restore: str | None = None,
     ):
         """``learning_rates`` (optional, [pop_size]) turns the population
         into a hyperparameter sweep: member i trains with its own learning
@@ -153,7 +154,28 @@ class PopulationTrainer:
         self.member_seeds = jnp.arange(
             config.seed, config.seed + pop_size, dtype=jnp.int32
         )
-        self.state = self._init_population(config.seed)
+        self.state = self._place(self._init_population(config.seed))
+
+        # Checkpointing: the stacked population state is one pytree, so the
+        # shared trainer wiring handles it unchanged — including
+        # auto-resume from checkpoint_dir's latest step after a crash and
+        # the ahead-of-history guard (utils/checkpoint.py::setup).
+        from asyncrl_tpu.utils import checkpoint as checkpoint_mod
+
+        self._ckpt, state, self._env_steps = checkpoint_mod.setup(
+            config, restore, self.state
+        )
+        self.state = self._place(state)
+
+    def _place(self, state: TrainState) -> TrainState:
+        """Commit every leaf to the population sharding (leading member
+        axis over the mesh's dp axes) — restored or freshly-built arrays
+        otherwise arrive committed to one device, which conflicts with the
+        shard_map'd step."""
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, P(dp_axes(self.mesh)))
+        return jax.device_put(state, sharding)
 
     def _member_init(
         self, key: jax.Array, lr: jax.Array | None = None
@@ -219,10 +241,28 @@ class PopulationTrainer:
         num_updates = max(
             1, -(-cfg.total_env_steps // frames_per_update)
         )
-        history = []
+        # Resume: a restored run continues from its recorded env budget.
+        start_update = self._env_steps // frames_per_update
+        history: list[dict] = []
+        try:
+            self._train_loop(
+                start_update, num_updates, frames_per_update, history,
+                callback,
+            )
+        finally:
+            # Crash path included: flush the final state (no-op without a
+            # checkpoint_dir; idempotent when the run is already complete).
+            self._ckpt.finalize(self.state, self._env_steps)
+        return history
+
+    def _train_loop(
+        self, start_update, num_updates, frames_per_update, history, callback
+    ) -> None:
+        cfg = self.config
         pending: list[dict] = []
-        for step in range(1, num_updates + 1):
+        for step in range(start_update + 1, num_updates + 1):
             pending.append(self.update())
+            self._ckpt.after_update(self.state, step * frames_per_update)
             if step % cfg.log_every == 0 or step == num_updates:
                 # One host sync per window, not per update.
                 drained = [
@@ -242,10 +282,10 @@ class PopulationTrainer:
                 window["episode_length"] = len_sum / safe
                 window["episode_count"] = counts
                 window["env_steps"] = step * frames_per_update
+                self._env_steps = step * frames_per_update
                 history.append(window)
                 if callback is not None:
                     callback(window)
-        return history
 
     def member_params(self, i: int):
         """Extract one member's params (e.g. the best seed, for eval)."""

@@ -237,7 +237,7 @@ def _ppo_multipass(
     )
 
     T, B = rollout.actions.shape[:2]
-    validate_ppo_geometry(config, B, "trace-time local")
+    validate_ppo_geometry(config, B, "trace-time local", unroll=T)
     n = T * B
     mb = config.ppo_minibatches
     flat = {
@@ -298,14 +298,23 @@ def _ppo_multipass(
     return params, opt_state, loss, grad_norm, metrics
 
 
-def validate_ppo_geometry(config: Config, local_envs: int, label: str) -> None:
+def validate_ppo_geometry(
+    config: Config,
+    local_envs: int,
+    label: str,
+    unroll: int | None = None,
+) -> None:
     """One rule, three callers (Learner.__init__, PopulationTrainer,
     _ppo_multipass's trace-time check): a multipass-PPO fragment must split
-    evenly into minibatches."""
+    evenly into minibatches. The trace-time caller passes the ACTUAL
+    fragment length as ``unroll`` (host-fed rollouts can differ from
+    config.unroll_len); eager callers omit it."""
     if config.algo == "ppo" and (
         config.ppo_epochs > 1 or config.ppo_minibatches > 1
     ):
-        frag = local_envs * config.unroll_len
+        frag = local_envs * (
+            config.unroll_len if unroll is None else unroll
+        )
         if frag % config.ppo_minibatches:
             raise ValueError(
                 f"{label} fragment of {frag} samples not divisible by "

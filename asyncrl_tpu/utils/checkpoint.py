@@ -163,6 +163,15 @@ class Checkpointer:
         self.close()
 
 
+def _step_of(state) -> int:
+    """The save key. A Trainer's ``update_step`` is a scalar; a population
+    state carries one per member, all equal by construction — use the first.
+    """
+    import numpy as np
+
+    return int(np.asarray(state.update_step).reshape(-1)[0])
+
+
 class TrainerCheckpointing:
     """The trainer-side checkpoint policy, shared by every backend: periodic
     save cadence, the end-of-train/crash-path flush, and lifecycle. Holds an
@@ -179,9 +188,7 @@ class TrainerCheckpointing:
             raise RuntimeError(
                 "no checkpoint_dir configured; set config.checkpoint_dir"
             )
-        self.checkpointer.save(
-            int(state.update_step), state, env_steps
-        )
+        self.checkpointer.save(_step_of(state), state, env_steps)
 
     def after_update(self, state: Any, env_steps: int) -> None:
         """Periodic cadence: call once per learner update."""
@@ -251,12 +258,12 @@ def setup(config, restore: str | None, state):
         # auto-resume would pick the old run's higher-numbered step and
         # silently load another run's state.
         latest = ckpt.latest_step()
-        if latest > int(state.update_step):
+        if latest > _step_of(state):
             ckpt.close()
             raise ValueError(
                 f"checkpoint_dir {config.checkpoint_dir!r} already holds "
                 f"steps up to {latest}, ahead of the restored step "
-                f"{int(state.update_step)} from {restore!r}; use a fresh "
+                f"{_step_of(state)} from {restore!r}; use a fresh "
                 "checkpoint_dir or clean the old run's checkpoints"
             )
     return (

@@ -163,3 +163,61 @@ def test_population_learning_rate_sweep():
 
     with pytest.raises(ValueError, match="learning_rates"):
         PopulationTrainer(CFG, pop_size=2, learning_rates=[1e-3])
+
+
+def test_population_checkpoint_resume_bit_exact(tmp_path):
+    """Save mid-run, restore into a fresh PopulationTrainer, continue: the
+    resumed run must land bit-identical to an uninterrupted one."""
+    ckdir = str(tmp_path / "popck")
+    cfg = CFG.replace(
+        total_env_steps=16 * 8 * 6,
+        log_every=3,
+        checkpoint_dir=ckdir,
+        checkpoint_every=3,
+    )
+    # Uninterrupted reference: 6 updates straight (no checkpointing).
+    ref = PopulationTrainer(CFG.replace(total_env_steps=16 * 8 * 6), 2)
+    for _ in range(6):
+        ref.update()
+
+    # Interrupted run: train writes a checkpoint at update 3 (and 6).
+    first = PopulationTrainer(cfg, 2)
+    first.train()
+
+    # Resume from the step-3 checkpoint and continue to 6.
+    resumed = PopulationTrainer(cfg.replace(checkpoint_dir=""), 2, restore=ckdir)
+    # Restore picks the LATEST step (6); to test the resume path, restore
+    # explicitly from step 3 instead.
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    src = Checkpointer(ckdir, create=False)
+    resumed.state, resumed._env_steps = src.restore(resumed.state, step=3)
+    assert resumed._env_steps == 16 * 8 * 3
+    resumed.train()
+
+    for a, b in zip(
+        _params_of(ref.state.params), _params_of(resumed.state.params)
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_population_auto_resumes_after_crash(tmp_path):
+    """Relaunching with the same checkpoint_dir and NO explicit restore
+    must auto-resume from the latest step (crash recovery), not restart
+    from scratch and overwrite the history."""
+    ckdir = str(tmp_path / "crashck")
+    cfg = CFG.replace(
+        total_env_steps=16 * 8 * 4, checkpoint_every=2, checkpoint_dir=ckdir
+    )
+    first = PopulationTrainer(cfg, 2)
+    first.train()
+    assert first._env_steps == 16 * 8 * 4
+
+    relaunched = PopulationTrainer(cfg, 2)  # same dir, no restore
+    assert relaunched._env_steps == 16 * 8 * 4  # picked up latest
+    hist = relaunched.train()  # budget already met: no further updates
+    assert hist == []
+    for a, b in zip(
+        _params_of(first.state.params), _params_of(relaunched.state.params)
+    ):
+        np.testing.assert_array_equal(a, b)
