@@ -36,7 +36,7 @@ from asyncrl_tpu.learn.learner import (
     validate_ppo_geometry,
 )
 from asyncrl_tpu.models.networks import build_model, is_recurrent
-from asyncrl_tpu.parallel.mesh import dp_axes, dp_size, make_mesh
+from asyncrl_tpu.parallel.mesh import dp_axes, dp_sharded, dp_size, make_mesh
 from asyncrl_tpu.rollout.anakin import actor_init
 from asyncrl_tpu.utils.config import Config
 
@@ -172,10 +172,7 @@ class PopulationTrainer:
         axis over the mesh's dp axes) — restored or freshly-built arrays
         otherwise arrive committed to one device, which conflicts with the
         shard_map'd step."""
-        from jax.sharding import NamedSharding
-
-        sharding = NamedSharding(self.mesh, P(dp_axes(self.mesh)))
-        return jax.device_put(state, sharding)
+        return jax.device_put(state, dp_sharded(self.mesh))
 
     def _member_init(
         self, key: jax.Array, lr: jax.Array | None = None
@@ -243,11 +240,9 @@ class PopulationTrainer:
         )
         # Resume: a restored run continues from its recorded env budget.
         start_update = self._env_steps // frames_per_update
-        history: list[dict] = []
         try:
-            self._train_loop(
-                start_update, num_updates, frames_per_update, history,
-                callback,
+            history = self._train_loop(
+                start_update, num_updates, frames_per_update, callback
             )
         finally:
             # Crash path included: flush the final state (no-op without a
@@ -256,13 +251,18 @@ class PopulationTrainer:
         return history
 
     def _train_loop(
-        self, start_update, num_updates, frames_per_update, history, callback
-    ) -> None:
+        self, start_update, num_updates, frames_per_update, callback
+    ) -> list[dict]:
         cfg = self.config
+        history: list[dict] = []
         pending: list[dict] = []
         for step in range(start_update + 1, num_updates + 1):
             pending.append(self.update())
-            self._ckpt.after_update(self.state, step * frames_per_update)
+            # Track consumed budget EVERY update (not just at log windows):
+            # the crash-path finalize stamps env_steps into the checkpoint,
+            # and a stale value would make auto-resume re-run updates.
+            self._env_steps = step * frames_per_update
+            self._ckpt.after_update(self.state, self._env_steps)
             if step % cfg.log_every == 0 or step == num_updates:
                 # One host sync per window, not per update.
                 drained = [
@@ -282,10 +282,14 @@ class PopulationTrainer:
                 window["episode_length"] = len_sum / safe
                 window["episode_count"] = counts
                 window["env_steps"] = step * frames_per_update
-                self._env_steps = step * frames_per_update
                 history.append(window)
                 if callback is not None:
                     callback(window)
+        return history
+
+    def close(self) -> None:
+        """Release checkpoint resources (orbax background threads)."""
+        self._ckpt.close()
 
     def member_params(self, i: int):
         """Extract one member's params (e.g. the best seed, for eval)."""

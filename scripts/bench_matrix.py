@@ -1,0 +1,89 @@
+"""Throughput matrix: one JSON line PER WORKLOAD (unlike bench.py, whose
+contract is a single line for the driver). Usage:
+
+    python scripts/bench_matrix.py [preset ...] [key=value ...]
+
+Defaults to a representative slice of every workload family: vector/pixel
+Atari stand-ins, procedural gridworlds, on-TPU physics locomotion, and the
+CartPole smoke. Each preset runs the same warmup+timed pipelined loop as
+bench.py (including its execution-integrity guard logic) at the preset's
+own geometry.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+DEFAULT_PRESETS = [
+    "cartpole_impala",
+    "pong_impala",
+    "atari_impala",
+    "procgen_ppo",
+    "halfcheetah_ppo",
+    "brax_ant_ppo",
+]
+
+
+def bench_one(preset_name: str, overrides: list[str]) -> dict:
+    import jax
+
+    from asyncrl_tpu.api.trainer import Trainer
+    from asyncrl_tpu.configs import presets
+    from asyncrl_tpu.utils.config import override
+
+    cfg = override(presets.get(preset_name), overrides)
+    trainer = Trainer(cfg)
+    state = trainer.state
+    params0 = jax.tree.map(lambda x: x.copy(), state.params)
+
+    warmup, timed = 3, 20
+    for _ in range(warmup):
+        state, metrics = trainer.learner.update(state)
+    jax.block_until_ready(metrics)
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        state, metrics = trainer.learner.update(state)
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+
+    import numpy as np
+
+    delta = sum(
+        float(jax.numpy.sum(jax.numpy.abs(a - b)))
+        for a, b in zip(
+            jax.tree.leaves(state.params), jax.tree.leaves(params0)
+        )
+    )
+    fps = timed * cfg.updates_per_call * cfg.num_envs * cfg.unroll_len / elapsed
+    return {
+        "preset": preset_name,
+        "env_id": cfg.env_id,
+        "num_envs": cfg.num_envs,
+        "unroll_len": cfg.unroll_len,
+        "frames_per_sec": round(fps),
+        "device": f"{jax.devices()[0].device_kind} x{jax.device_count()}",
+        "integrity_ok": bool(np.isfinite(delta) and delta > 0.0),
+    }
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    overrides = [a for a in args if "=" in a]
+    names = [a for a in args if "=" not in a] or DEFAULT_PRESETS
+    for name in names:
+        try:
+            print(json.dumps(bench_one(name, overrides)), flush=True)
+        except Exception as e:
+            print(
+                json.dumps(
+                    {"preset": name, "error": f"{type(e).__name__}: {e}"}
+                ),
+                flush=True,
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
