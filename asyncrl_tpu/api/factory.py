@@ -27,9 +27,9 @@ def make_agent(
 
     # Fail fast on enum-like fields the backends only consult at trace time
     # (a bad algo would otherwise surface mid-train, after env/model build).
-    if config.algo not in ("a3c", "impala", "ppo"):
+    if config.algo not in ("a3c", "impala", "ppo", "qlearn"):
         raise ValueError(
-            f"unknown algo {config.algo!r}; expected a3c|impala|ppo"
+            f"unknown algo {config.algo!r}; expected a3c|impala|ppo|qlearn"
         )
     if config.torso not in ("mlp", "nature_cnn", "impala_cnn"):
         raise ValueError(

@@ -164,7 +164,7 @@ class Trainer:
             env = self.env
             model = self.model
             apply_fn = self.model.apply
-            dist = distributions.for_spec(env.spec)
+            dist = distributions.for_config(self.config, env.spec)
             recurrent = is_recurrent(model)
 
             def eval_rollout(params, key):

@@ -118,7 +118,7 @@ def _dump_trajectory(agent, cfg, path: str, max_steps: int) -> None:
         )
     model = agent.model
     params = agent.state.params
-    dist = distributions.for_spec(env.spec)
+    dist = distributions.for_config(cfg, env.spec)
     if is_recurrent(model):
         raise NotImplementedError(
             "--save with recurrent cores is not wired yet; use a ff preset"

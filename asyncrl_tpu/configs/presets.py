@@ -127,6 +127,35 @@ brax_humanoid_ppo = hopper_ppo.replace(env_id="JaxHumanoid-v0")
 cartpole_impala = cartpole_a3c.replace(algo="impala", actor_staleness=2)
 cartpole_ppo = cartpole_a3c.replace(algo="ppo", learning_rate=3e-4)
 
+# Async n-step Q-learning (the A3C paper's value-based sibling family):
+# ε-greedy actors on the per-env Ape-X ε ladder, double-Q bootstrap from the
+# target network (= the stale actor_params copy, refreshed every
+# actor_staleness updates).
+# Hyperparameters from an on-chip sweep (2026-07-30): value-based learning
+# off the on-policy stream (no replay; the parallel env batch decorrelates
+# instead, as in the A3C paper) wants a FAST target refresh, light gradient
+# clipping, and long n-step unrolls for value propagation — slow targets
+# (staleness >= 10) stall CartPole completely.
+cartpole_qlearn = cartpole_a3c.replace(
+    algo="qlearn",
+    num_envs=128,
+    unroll_len=32,
+    learning_rate=1e-3,
+    max_grad_norm=10.0,
+    actor_staleness=4,
+    exploration_steps=30_000,
+    eps_base=0.3,
+    eps_alpha=5.0,
+    total_env_steps=2_000_000,
+)
+pong_qlearn = pong_impala.replace(
+    algo="qlearn",
+    learning_rate=5e-4,
+    max_grad_norm=10.0,
+    actor_staleness=4,
+    exploration_steps=500_000,
+)
+
 # The reference's literal default layout (BASELINE.json:7): 4 async CPU
 # actor threads, one env each, A3C — the cpu_async differential-testing
 # baseline (SURVEY.md §7.2 M4, §8-Q7).
@@ -169,6 +198,8 @@ PRESETS: dict[str, Config] = {
     "cartpole_a3c_cpu": cartpole_a3c_cpu,
     "cartpole_impala": cartpole_impala,
     "cartpole_ppo": cartpole_ppo,
+    "cartpole_qlearn": cartpole_qlearn,
+    "pong_qlearn": pong_qlearn,
     "pong_impala": pong_impala,
     "atari_impala": atari_impala,
     "breakout_impala": breakout_impala,

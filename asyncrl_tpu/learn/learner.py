@@ -25,7 +25,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from asyncrl_tpu.envs.core import Environment
 from asyncrl_tpu.ops.gae import gae
 from asyncrl_tpu.models.networks import is_recurrent, reset_core
-from asyncrl_tpu.ops.losses import a3c_loss, impala_loss, ppo_loss
+from asyncrl_tpu.ops.losses import (
+    a3c_loss,
+    impala_loss,
+    ppo_loss,
+    qlearn_loss,
+)
 from asyncrl_tpu.parallel.mesh import DP_AXIS, dp_axes, dp_size
 from asyncrl_tpu.rollout.anakin import ActorState, actor_init, unroll
 from asyncrl_tpu.rollout.buffer import Rollout
@@ -157,18 +162,37 @@ def _forward_fragment(apply_fn, params, rollout: Rollout):
 
 def _algo_loss(
     config: Config, apply_fn, params, rollout: Rollout,
-    axis_name: str | None = None, dist=None,
+    axis_name: str | None = None, dist=None, target_params=None,
 ):
     """Forward the learner net over [T+1, B] obs and apply the configured
     algorithm's loss. Returns (loss, metrics). ``axis_name`` is the dp mesh
     axis when called inside shard_map (for losses needing global batch
     moments, i.e. PPO advantage normalization). ``dist`` interprets the
-    policy head (ops.distributions)."""
+    policy head (ops.distributions). ``target_params`` is the Q-learning
+    family's target network (required for algo='qlearn', unused otherwise)."""
     logits, values = _forward_fragment(apply_fn, params, rollout)
     logits_t, values_t = logits[:-1], values[:-1]
     bootstrap_value = values[-1]
     discounts = rollout.discounts(config.gamma)
 
+    if config.algo == "qlearn":
+        # ``logits`` ARE the online Q-values here (QNetwork head). The
+        # bootstrap comes from the target network (the stale actor_params
+        # copy, refreshed every actor_staleness updates — the async-Q target
+        # network θ⁻): max_a Q_target, or the double-Q selection (argmax
+        # under ONLINE q, evaluated under target) to damp the max bias.
+        q_target = jax.lax.stop_gradient(
+            apply_fn(target_params, rollout.bootstrap_obs)[0]
+        )
+        if config.double_q:
+            sel = jnp.argmax(jax.lax.stop_gradient(logits[-1]), axis=-1)
+            boot = jnp.take_along_axis(q_target, sel[..., None], axis=-1)[..., 0]
+        else:
+            boot = jnp.max(q_target, axis=-1)
+        return qlearn_loss(
+            logits_t, rollout.actions, rollout.rewards, discounts, boot,
+            scan_impl=config.scan_impl,
+        )
     if config.algo == "a3c":
         return a3c_loss(
             logits_t, values_t, rollout.actions, rollout.rewards, discounts,
@@ -298,6 +322,25 @@ def _ppo_multipass(
     return params, opt_state, loss, grad_norm, metrics
 
 
+def qlearn_epsilon(
+    config: Config, update_step: jax.Array, local_envs: int, axes
+) -> jax.Array:
+    """Per-env behaviour ε for the async Q-learning family: each global env
+    slot gets its own final ε on the Ape-X ladder
+    ``eps_base ** (1 + alpha * i / (N-1))`` (the TPU-vectorized analogue of
+    the A3C paper's per-thread sampled ε), annealed from 1.0 over the first
+    ``exploration_steps`` env frames. Returns [local_envs] f32; constant
+    across one fragment (anneal granularity = one update)."""
+    gidx = _axis_index(axes) * local_envs + jnp.arange(local_envs)
+    frac = gidx.astype(jnp.float32) / max(config.num_envs - 1, 1)
+    final_eps = config.eps_base ** (1.0 + config.eps_alpha * frac)
+    env_steps = update_step.astype(jnp.float32) * (
+        config.num_envs * config.unroll_len
+    )
+    anneal = jnp.minimum(1.0, env_steps / max(config.exploration_steps, 1))
+    return (1.0 + anneal * (final_eps - 1.0)).astype(jnp.float32)
+
+
 def validate_ppo_geometry(
     config: Config,
     local_envs: int,
@@ -357,13 +400,14 @@ def make_train_step(
     """
     from asyncrl_tpu.ops import distributions
 
-    dist = distributions.for_spec(env.spec)
+    dist = distributions.for_config(config, env.spec)
 
     # Static choice: PPO with epochs/minibatches > 1 takes the multipass
     # update path; everything else is one fused gradient step.
     ppo_multipass = config.algo == "ppo" and (
         config.ppo_epochs > 1 or config.ppo_minibatches > 1
     )
+    qlearn = config.algo == "qlearn"
 
     if axes is None:
         axes = dp_axes(mesh)
@@ -374,10 +418,19 @@ def make_train_step(
         # reproduce exactly. None everywhere else.
         # named_scope: sections show up as labeled blocks in jax.profiler
         # traces (SURVEY.md §5.1; CLI --profile).
+        dist_extra = None
+        if qlearn:
+            # ε rides the dist_params channel (ops.distributions
+            # .EpsilonGreedy): per-env final values, annealed by env frames.
+            eps = qlearn_epsilon(
+                config, state.update_step, state.actor.keys.shape[0], axes
+            )
+            dist_extra = eps[:, None]
         with jax.named_scope("rollout"):
             actor, rollout, stats = unroll(
                 apply_fn, state.actor_params, env, state.actor,
                 config.unroll_len, dist=dist, reward_scale=config.reward_scale,
+                dist_extra=dist_extra,
             )
 
         if ppo_multipass:
@@ -400,6 +453,7 @@ def make_train_step(
                 loss, metrics = _algo_loss(
                     config, apply_fn, p, rollout,
                     axis_name=axes or None, dist=dist,
+                    target_params=state.actor_params,
                 )
                 return loss / _axis_size(axes), (loss, metrics)
 
@@ -418,7 +472,13 @@ def make_train_step(
         loss = _pmean(loss, axes)
 
         step = state.update_step + 1
-        if config.algo == "impala" and config.actor_staleness > 1:
+        if (
+            config.algo in ("impala", "qlearn")
+            and config.actor_staleness > 1
+        ):
+            # IMPALA: the stale behaviour-policy copy. Q-learning: the SAME
+            # stale copy doubles as the target network θ⁻ (and the ε-greedy
+            # behaviour net), so actor_staleness is the target-update period.
             refresh = (step % config.actor_staleness) == 0
             actor_params = jax.tree.map(
                 lambda new, old: jnp.where(refresh, new, old),
@@ -472,6 +532,14 @@ class Learner:
 
         # Eager geometry validation (clearer than a trace-time failure).
         validate_recurrent_config(config, model)
+        if config.algo == "qlearn" and config.actor_staleness < 2:
+            raise ValueError(
+                "algo='qlearn' needs actor_staleness >= 2: that field is the "
+                "target-network update period for this algo, and at 1 the "
+                "bootstrap comes from the net being optimized (double_q "
+                "degenerates to max-Q too). The cartpole_qlearn preset "
+                "uses 4."
+            )
         if config.updates_per_call < 1:
             raise ValueError(
                 f"updates_per_call={config.updates_per_call} must be >= 1"

@@ -111,6 +111,12 @@ class RolloutLearner:
 
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
+        if config.algo == "qlearn":
+            raise NotImplementedError(
+                "algo='qlearn' is Anakin-only for now: the host-actor "
+                "backends don't thread the annealed ε / target-network "
+                "plumbing yet; use backend='tpu'"
+            )
         time_sharded = TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1
         if time_sharded:
             sp = mesh.shape[TIME_AXIS]

@@ -142,6 +142,33 @@ class ActorCritic(nn.Module):
         return _apply_heads(self, _apply_torso(self, obs))
 
 
+class QNetwork(nn.Module):
+    """Q-value network for the async Q-learning family (the A3C paper's
+    value-based siblings — async one-step/n-step Q; PAPERS.md:8).
+
+    Same torso zoo as ``ActorCritic``; the head emits one Q-value per action.
+    Returns ``(q_values, max_q)`` so it satisfies the generic
+    ``(dist_params, value)`` apply contract — the rollout interprets
+    ``q_values`` through ``ops.distributions.EpsilonGreedy`` and the learner
+    reads them directly in ``qlearn_loss``.
+    """
+
+    num_actions: int
+    torso: str = "mlp"
+    hidden_sizes: Sequence[int] = (64, 64)
+    channels: Sequence[int] = (16, 32, 32)
+    compute_dtype: jnp.dtype = jnp.float32
+    obs_rank: int = 1
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
+        h = _apply_torso(self, obs)
+        q = nn.Dense(
+            self.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01)
+        )(h).astype(jnp.float32)
+        return q, jnp.max(q, axis=-1)
+
+
 class RecurrentActorCritic(nn.Module):
     """Recurrent policy + value network: torso -> LSTM core -> heads.
 
@@ -196,6 +223,25 @@ def build_model(config, env_spec):
     compute_dtype = (
         jnp.bfloat16 if config.precision == "bf16_matmul" else jnp.float32
     )
+    if config.algo == "qlearn":
+        if env_spec.continuous:
+            raise ValueError(
+                "algo='qlearn' requires a discrete action space; "
+                f"{config.env_id!r} is continuous"
+            )
+        if config.core == "lstm":
+            raise NotImplementedError(
+                "recurrent (DRQN-style) Q networks are not supported; "
+                "use core='ff' with algo='qlearn'"
+            )
+        return QNetwork(
+            num_actions=env_spec.num_actions,
+            torso=config.torso,
+            hidden_sizes=tuple(config.hidden_sizes),
+            channels=tuple(config.channels),
+            compute_dtype=compute_dtype,
+            obs_rank=len(env_spec.obs_shape),
+        )
     common = dict(
         num_actions=env_spec.num_actions,
         torso=config.torso,

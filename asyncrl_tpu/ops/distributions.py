@@ -105,8 +105,87 @@ class DiagGaussian:
         return mean
 
 
+@dataclasses.dataclass(frozen=True)
+class EpsilonGreedy:
+    """ε-greedy behaviour "distribution" over Q-values (the async Q-learning
+    family's exploration policy — the A3C paper's value-based siblings,
+    PAPERS.md:8).
+
+    ``dist_params`` layout: either raw Q-values ``[..., A]`` (greedy-only
+    contexts: eval ``mode``), or ``[..., A + 1]`` with a per-sample ε
+    appended as the last column (the rollout appends it via ``unroll``'s
+    ``dist_extra`` hook — ε varies per env slot and anneals over training,
+    so it cannot live on this frozen object).
+    """
+
+    num_actions: int
+
+    @property
+    def param_size(self) -> int:
+        return self.num_actions + 1  # Q-values + appended ε column
+
+    @property
+    def action_dtype(self):
+        return jnp.int32
+
+    def _split(self, params: jax.Array) -> tuple[jax.Array, jax.Array]:
+        if params.shape[-1] == self.num_actions + 1:
+            return params[..., : self.num_actions], params[..., -1]
+        return params, jnp.zeros(params.shape[:-1], params.dtype)
+
+    def _probs(self, params: jax.Array) -> jax.Array:
+        q, eps = self._split(params)
+        greedy = jax.nn.one_hot(jnp.argmax(q, axis=-1), self.num_actions)
+        return (
+            greedy * (1.0 - eps[..., None])
+            + eps[..., None] / self.num_actions
+        )
+
+    def sample(self, key: jax.Array, params: jax.Array) -> jax.Array:
+        """Unbatched sample: params [A(+1)] -> scalar action (vmap for
+        batches). Greedy w.p. 1-ε, uniform-random w.p. ε."""
+        q, eps = self._split(params)
+        explore_key, action_key = jax.random.split(key)
+        random_action = jax.random.randint(
+            action_key, (), 0, self.num_actions
+        )
+        explore = jax.random.uniform(explore_key, ()) < eps
+        return jnp.where(
+            explore, random_action, jnp.argmax(q, axis=-1)
+        ).astype(jnp.int32)
+
+    def logp(self, params: jax.Array, actions: jax.Array) -> jax.Array:
+        p = jnp.take_along_axis(
+            self._probs(params), actions[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        return jnp.log(jnp.maximum(p, 1e-12))
+
+    def entropy(self, params: jax.Array) -> jax.Array:
+        p = self._probs(params)
+        return -jnp.sum(p * jnp.log(jnp.maximum(p, 1e-12)), axis=-1)
+
+    def mode(self, params: jax.Array) -> jax.Array:
+        q, _ = self._split(params)
+        return jnp.argmax(q, axis=-1)
+
+
 def for_spec(spec) -> Categorical | DiagGaussian:
     """Distribution matching an ``EnvSpec``."""
     if getattr(spec, "continuous", False):
         return DiagGaussian(spec.action_dim)
     return Categorical(spec.num_actions)
+
+
+def for_config(config, spec):
+    """Distribution matching a Config + EnvSpec: the algorithm family decides
+    how the model's head output is interpreted (``algo="qlearn"`` heads emit
+    Q-values acted on ε-greedily; the policy-gradient family emits
+    logits / Gaussian parameters)."""
+    if config.algo == "qlearn":
+        if getattr(spec, "continuous", False):
+            raise ValueError(
+                "algo='qlearn' requires a discrete action space "
+                f"(env {getattr(spec, 'env_id', spec)!r} is continuous)"
+            )
+        return EpsilonGreedy(spec.num_actions)
+    return for_spec(spec)

@@ -1,6 +1,7 @@
-"""Loss functions for the three algorithm families the reference supports:
-A3C n-step policy gradient, IMPALA V-trace, PPO clipped surrogate
-(BASELINE.json:6-12; SURVEY.md §2). All pure functions over time-major
+"""Loss functions for the algorithm families the reference's lineage
+supports: A3C n-step policy gradient, IMPALA V-trace, PPO clipped surrogate
+(BASELINE.json:6-12; SURVEY.md §2), and async n-step Q-learning (the A3C
+paper's value-based siblings). All pure functions over time-major
 [T, B, ...] arrays; no classes, fully jittable.
 
 Each returns ``(scalar_loss, metrics_dict)`` where metrics are scalars safe
@@ -113,6 +114,43 @@ def impala_loss(
     return loss, metrics
 
 
+def qlearn_loss(
+    q_values: jax.Array,
+    actions: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    bootstrap_value: jax.Array,
+    scan_impl: str = "associative",
+):
+    """Async n-step Q-learning loss (the A3C paper's value-based sibling,
+    PAPERS.md:8): every step in the fragment regresses Q(s_t, a_t) onto the
+    n-step return bootstrapped from the fragment end —
+
+        G_t = r_t + gamma_t * G_{t+1},   G_T = bootstrap_value
+
+    the same reverse affine recurrence as the A3C returns (so it shares
+    ``n_step_returns``' associative-scan / Pallas implementations).
+    ``bootstrap_value`` [B] is the caller-selected target-network bootstrap
+    (``max_a Q_target`` or the double-Q selection); ``q_values`` [T, B, A]
+    come from the online params.
+    """
+    returns = n_step_returns(
+        rewards, discounts, bootstrap_value, scan_impl=scan_impl
+    )
+    q_taken = jnp.take_along_axis(
+        q_values, actions[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    td_error = jax.lax.stop_gradient(returns) - q_taken
+    loss = 0.5 * jnp.mean(jnp.square(td_error))
+    metrics = {
+        "value_loss": loss,
+        "td_abs": jnp.mean(jnp.abs(td_error)),
+        "mean_value": jnp.mean(q_taken),
+        "mean_max_q": jnp.mean(jnp.max(q_values, axis=-1)),
+    }
+    return loss, metrics
+
+
 def ppo_loss(
     logits: jax.Array,
     values: jax.Array,
@@ -167,6 +205,7 @@ __all__ = [
     "a3c_loss",
     "impala_loss",
     "ppo_loss",
+    "qlearn_loss",
     "gae",
     "GAEOutput",
     "vtrace",

@@ -72,6 +72,7 @@ def unroll(
     unroll_len: int,
     dist=None,
     reward_scale: float = 1.0,
+    dist_extra: jax.Array | None = None,
 ) -> tuple[ActorState, Rollout, EpisodeStats]:
     """Roll the policy forward ``unroll_len`` steps over the env batch.
 
@@ -80,6 +81,12 @@ def unroll(
     own params); only the behaviour log-prob is recorded — exactly what
     V-trace needs (SURVEY.md §3.3). ``dist`` (ops.distributions) interprets
     the policy head; defaults to the spec's distribution.
+
+    ``dist_extra`` ([B, E], optional) is concatenated onto the model's
+    dist_params at every step — the channel for per-env, training-schedule-
+    dependent behaviour knobs the frozen ``dist`` object can't carry (the
+    Q-learning family's annealed per-env ε rides here, constant across the
+    fragment).
     """
     if dist is None:
         from asyncrl_tpu.ops import distributions
@@ -97,6 +104,10 @@ def unroll(
         else:
             dist_params, _ = apply_fn(params, carry.obs)
             core = None
+        if dist_extra is not None:
+            dist_params = jnp.concatenate(
+                [dist_params, dist_extra.astype(dist_params.dtype)], axis=-1
+            )
         actions = jax.vmap(dist.sample)(act_keys, dist_params)
         behaviour_logp = dist.logp(dist_params, actions)
 

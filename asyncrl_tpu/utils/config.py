@@ -22,7 +22,7 @@ class Config:
 
     # --- workload ---
     env_id: str = "CartPole-v1"
-    algo: str = "a3c"  # "a3c" | "impala" | "ppo"
+    algo: str = "a3c"  # "a3c" | "impala" | "ppo" | "qlearn"
     backend: str = "tpu"  # "tpu" (anakin) | "sebulba" | "cpu_async"
 
     # --- rollout geometry ---
@@ -70,6 +70,17 @@ class Config:
     ppo_clip_eps: float = 0.2
     ppo_epochs: int = 4
     ppo_minibatches: int = 4
+
+    # --- qlearn (async n-step Q-learning; Anakin backend) ---
+    # Double-Q bootstrap: argmax under the online net, value under the
+    # target net (the stale actor_params copy; actor_staleness is the
+    # target-update period for this algo).
+    double_q: bool = True
+    # Per-env final ε ladder (Ape-X form): eps_base ** (1 + eps_alpha * i/(N-1)),
+    # annealed from 1.0 over the first exploration_steps env frames.
+    eps_base: float = 0.4
+    eps_alpha: float = 7.0
+    exploration_steps: int = 100_000
 
     # --- parallelism ---
     mesh_shape: tuple[int, ...] = (-1,)  # -1: all local devices on axis "dp"
