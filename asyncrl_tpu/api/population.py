@@ -32,6 +32,7 @@ from asyncrl_tpu.learn.learner import (
     init_params,
     make_optimizer,
     make_train_step,
+    validate_qlearn_config,
     resolve_scan_impl,
     validate_ppo_geometry,
 )
@@ -101,6 +102,7 @@ class PopulationTrainer:
         # Same eager geometry validation as Learner.__init__ (clearer than
         # a trace-time failure inside the first update).
         validate_ppo_geometry(config, config.num_envs, "per-member")
+        validate_qlearn_config(config)
         self.config = config
         self.pop_size = pop_size
         self.env = make_env(config.env_id)

@@ -134,13 +134,15 @@ def qlearn_loss(
     (``max_a Q_target`` or the double-Q selection); ``q_values`` [T, B, A]
     come from the online params.
     """
+    # n_step_returns stop-gradients its inputs (fixed-target contract, same
+    # as the a3c path); no second guard needed here.
     returns = n_step_returns(
         rewards, discounts, bootstrap_value, scan_impl=scan_impl
     )
     q_taken = jnp.take_along_axis(
         q_values, actions[..., None].astype(jnp.int32), axis=-1
     )[..., 0]
-    td_error = jax.lax.stop_gradient(returns) - q_taken
+    td_error = returns - q_taken
     loss = 0.5 * jnp.mean(jnp.square(td_error))
     metrics = {
         "value_loss": loss,

@@ -5,9 +5,10 @@ contract is a single line for the driver). Usage:
 
 Defaults to a representative slice of every workload family: vector/pixel
 Atari stand-ins, procedural gridworlds, on-TPU physics locomotion, and the
-CartPole smoke. Each preset runs the same warmup+timed pipelined loop as
-bench.py (including its execution-integrity guard logic) at the preset's
-own geometry.
+CartPole smoke. Each preset runs the same measurement discipline as
+bench.py — D2H-read sync boundaries (axon's block_until_ready returns
+early), a time-targeted >=2s window, and the device-side update-counter
+execution guard — at the preset's own geometry.
 """
 
 from __future__ import annotations
@@ -38,15 +39,37 @@ def bench_one(preset_name: str, overrides: list[str]) -> dict:
     state = trainer.state
     params0 = jax.tree.map(lambda x: x.copy(), state.params)
 
-    warmup, timed = 3, 20
+    # Timing boundaries are D2H reads, NOT jax.block_until_ready: the axon
+    # plugin's block_until_ready returns before execution finishes (see
+    # bench.py's sync discipline note, 2026-07-30), which inflated fps far
+    # beyond the chip's FLOP peak.
+    def sync(s) -> int:
+        return int(s.update_step)
+
+    warmup = 3
     for _ in range(warmup):
         state, metrics = trainer.learner.update(state)
-    jax.block_until_ready(metrics)
+    sync(state)
+
+    # Time-targeted window, same rationale as bench.py: a fixed small call
+    # count gives a dispatch-jitter-dominated device window on fast configs.
+    min_seconds, min_calls = 2.0, 10
+    timed = 0
     t0 = time.perf_counter()
-    for _ in range(timed):
+    while True:
         state, metrics = trainer.learner.update(state)
-    jax.block_until_ready(state)
+        timed += 1
+        if timed % min_calls == 0:
+            executed = sync(state)
+            if time.perf_counter() - t0 >= min_seconds:
+                break
     elapsed = time.perf_counter() - t0
+    dispatched = (warmup + timed) * cfg.updates_per_call
+    if executed != dispatched:
+        raise RuntimeError(
+            f"device executed {executed} updates, dispatched {dispatched}: "
+            "refusing to report a throughput number"
+        )
 
     import numpy as np
 
@@ -64,6 +87,8 @@ def bench_one(preset_name: str, overrides: list[str]) -> dict:
         "unroll_len": cfg.unroll_len,
         "frames_per_sec": round(fps),
         "device": f"{jax.devices()[0].device_kind} x{jax.device_count()}",
+        # Counter mismatch raised above, so this reflects the param-delta
+        # check only (training actually moved the weights).
         "integrity_ok": bool(np.isfinite(delta) and delta > 0.0),
     }
 

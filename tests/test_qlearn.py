@@ -189,6 +189,17 @@ def test_default_staleness_rejected():
         make_agent(Config(algo="qlearn", num_envs=8, unroll_len=4))
 
 
+def test_population_rejects_default_staleness():
+    """The guard must live at the shared-validator altitude: population
+    builds the train-step body without going through Learner.__init__."""
+    from asyncrl_tpu.api.population import PopulationTrainer
+
+    with pytest.raises(ValueError, match="target-network update period"):
+        PopulationTrainer(
+            Config(algo="qlearn", num_envs=8, unroll_len=4), pop_size=2
+        )
+
+
 def test_host_backends_reject_qlearn():
     cfg = presets.get("cartpole_qlearn").replace(
         backend="cpu_async", host_pool="jax"
