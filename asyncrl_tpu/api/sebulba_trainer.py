@@ -101,7 +101,7 @@ class SebulbaTrainer:
         )
         self.checkpointer = self._ckpt.checkpointer
 
-        self._inference_fn = make_inference_fn(self.model, self.spec)
+        self._inference_fn = make_inference_fn(self.model, self.spec, config)
         self._initial_core = (
             self.model.initial_core if is_recurrent(self.model) else None
         )
@@ -120,6 +120,32 @@ class SebulbaTrainer:
 
     # --------------------------------------------------------------- actors
 
+    def _epsilon_fn(self, index: int):
+        """Per-thread behaviour-ε schedule for the Q-learning family: thread
+        ``index``'s env slots take their rungs of the shared schedule
+        (``learn.learner.qlearn_epsilon_schedule`` — one formula for every
+        backend), annealed by estimated GLOBAL frames. A thread only knows
+        its own frame count, so global frames ≈ own * actor_threads (exact
+        when threads progress evenly); restarted actors resume the anneal
+        from the trainer's env_steps instead of re-exploring from ε=1."""
+        cfg = self.config
+        if cfg.algo != "qlearn":
+            return None
+        from asyncrl_tpu.learn.learner import qlearn_epsilon_schedule
+
+        B = self._envs_per_actor
+        gidx = index * B + np.arange(B, dtype=np.float32)
+        threads = cfg.actor_threads
+        start = self.env_steps // threads  # resume anneal after restart
+
+        def epsilon_fn(thread_frames: int) -> np.ndarray:
+            frames = (start + thread_frames) * threads
+            return np.asarray(
+                qlearn_epsilon_schedule(cfg, gidx, float(frames))
+            )
+
+        return epsilon_fn
+
     def _spawn_actor(self, index: int) -> ActorThread:
         seed = self._next_actor_seed
         self._next_actor_seed += 104729
@@ -136,6 +162,7 @@ class SebulbaTrainer:
             errors=self._errors,
             device=self._actor_device,
             initial_core=self._initial_core,
+            epsilon_fn=self._epsilon_fn(index),
         )
         actor.start()
         return actor
@@ -286,7 +313,7 @@ class SebulbaTrainer:
         Each env counts only its FIRST completed episode (pools auto-reset).
         """
         pool = make_host_pool(self.config, num_episodes, seed=seed)
-        dist = distributions.for_spec(self.spec)
+        dist = distributions.for_config(self.config, self.spec)
         apply_fn = self.model.apply
         recurrent = is_recurrent(self.model)
 

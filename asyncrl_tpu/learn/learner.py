@@ -341,23 +341,39 @@ def _ppo_multipass(
     return params, opt_state, loss, grad_norm, metrics
 
 
+def qlearn_epsilon_schedule(config: Config, global_env_index, env_frames):
+    """THE ε schedule for the async Q-learning family — single source of
+    truth for every backend (Anakin's in-jit ``qlearn_epsilon`` and the host
+    backends' per-thread ``SebulbaTrainer._epsilon_fn`` both call this, so
+    the ladder/anneal can never drift between them).
+
+    Each global env slot gets its own final ε on the Ape-X ladder
+    ``eps_base ** (1 + alpha * i / (N-1))`` (the vectorized analogue of the
+    A3C paper's per-thread sampled ε), annealed from 1.0 over the first
+    ``exploration_steps`` global env frames. Accepts np or jnp inputs;
+    returns f32 of ``global_env_index``'s shape."""
+    frac = global_env_index / max(config.num_envs - 1, 1)
+    final_eps = config.eps_base ** (1.0 + config.eps_alpha * frac)
+    anneal = jnp.minimum(
+        1.0, env_frames / max(config.exploration_steps, 1)
+    )
+    return (1.0 + anneal * (final_eps - 1.0)).astype(jnp.float32)
+
+
 def qlearn_epsilon(
     config: Config, update_step: jax.Array, local_envs: int, axes
 ) -> jax.Array:
-    """Per-env behaviour ε for the async Q-learning family: each global env
-    slot gets its own final ε on the Ape-X ladder
-    ``eps_base ** (1 + alpha * i / (N-1))`` (the TPU-vectorized analogue of
-    the A3C paper's per-thread sampled ε), annealed from 1.0 over the first
-    ``exploration_steps`` env frames. Returns [local_envs] f32; constant
-    across one fragment (anneal granularity = one update)."""
+    """Anakin per-shard view of ``qlearn_epsilon_schedule``: global env
+    indices from the shard's mesh position, global frames from the update
+    counter. Returns [local_envs] f32; constant across one fragment (anneal
+    granularity = one update)."""
     gidx = _axis_index(axes) * local_envs + jnp.arange(local_envs)
-    frac = gidx.astype(jnp.float32) / max(config.num_envs - 1, 1)
-    final_eps = config.eps_base ** (1.0 + config.eps_alpha * frac)
-    env_steps = update_step.astype(jnp.float32) * (
+    env_frames = update_step.astype(jnp.float32) * (
         config.num_envs * config.unroll_len
     )
-    anneal = jnp.minimum(1.0, env_steps / max(config.exploration_steps, 1))
-    return (1.0 + anneal * (final_eps - 1.0)).astype(jnp.float32)
+    return qlearn_epsilon_schedule(
+        config, gidx.astype(jnp.float32), env_frames
+    )
 
 
 def validate_ppo_geometry(

@@ -28,6 +28,7 @@ from asyncrl_tpu.learn.learner import (
     _ppo_multipass,
     make_optimizer,
     resolve_scan_impl,
+    validate_qlearn_config,
     validate_recurrent_config,
 )
 from asyncrl_tpu.models.networks import is_recurrent
@@ -44,16 +45,23 @@ class LearnerState:
 
     Unlike the Anakin ``TrainState`` there is no ``actor`` (env states live
     on the host) and no ``actor_params`` (weight publishing to host actors
-    goes through ``rollout.sebulba.ParamStore``).
+    goes through ``rollout.sebulba.ParamStore``). ``target_params`` is the
+    Q-learning family's target network θ⁻ (None — an empty subtree — for
+    the policy-gradient algos): unlike Anakin, where the in-program
+    actor_params copy doubles as the target, the host path's behaviour
+    params live outside the jit, so the target needs its own slot.
     """
 
     params: Any
     opt_state: Any
     update_step: jax.Array  # int32 scalar
+    target_params: Any = None
 
 
 def learner_state_spec() -> LearnerState:
-    return LearnerState(params=P(), opt_state=P(), update_step=P())
+    return LearnerState(
+        params=P(), opt_state=P(), update_step=P(), target_params=P()
+    )
 
 
 def rollout_partition_spec(
@@ -111,12 +119,7 @@ class RolloutLearner:
 
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
-        if config.algo == "qlearn":
-            raise NotImplementedError(
-                "algo='qlearn' is Anakin-only for now: the host-actor "
-                "backends don't thread the annealed ε / target-network "
-                "plumbing yet; use backend='tpu'"
-            )
+        validate_qlearn_config(config)
         time_sharded = TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1
         if time_sharded:
             sp = mesh.shape[TIME_AXIS]
@@ -138,13 +141,19 @@ class RolloutLearner:
                     "multi-epoch/minibatched PPO is not time-shardable; "
                     "use ppo_epochs=ppo_minibatches=1"
                 )
+            if config.algo == "qlearn":
+                raise NotImplementedError(
+                    "algo='qlearn' is not time-shardable yet (its n-step "
+                    "returns lack the timeshard plumbing); use a dp-only "
+                    "mesh"
+                )
         config = resolve_scan_impl(config, mesh)
         self.config = config
         self.spec = spec
         self.model = model
         self.mesh = mesh
         self.optimizer = make_optimizer(config)
-        dist = distributions.for_spec(spec)
+        dist = distributions.for_config(config, spec)
 
         ppo_multipass = config.algo == "ppo" and (
             config.ppo_epochs > 1 or config.ppo_minibatches > 1
@@ -180,6 +189,7 @@ class RolloutLearner:
                         loss, metrics = _algo_loss(
                             config, apply_fn, p, rollout,
                             axis_name=axes, dist=dist,
+                            target_params=state.target_params,
                         )
                     return (
                         loss / jax.lax.axis_size(reduce_axes),
@@ -198,10 +208,22 @@ class RolloutLearner:
             metrics = dict(jax.lax.pmean(metrics, reduce_axes))
             metrics["loss"] = jax.lax.pmean(loss, reduce_axes)
             metrics["grad_norm"] = grad_norm
+            step = state.update_step + 1
+            if config.algo == "qlearn":
+                # Target-network refresh every actor_staleness updates
+                # (same recipe as the Anakin learner's actor_params).
+                refresh = (step % config.actor_staleness) == 0
+                target_params = jax.tree.map(
+                    lambda new, old: jnp.where(refresh, new, old),
+                    params, state.target_params,
+                )
+            else:
+                target_params = state.target_params  # None subtree
             new_state = LearnerState(
                 params=params,
                 opt_state=opt_state,
-                update_step=state.update_step + 1,
+                update_step=step,
+                target_params=target_params,
             )
             return new_state, metrics
 
@@ -246,10 +268,14 @@ class RolloutLearner:
             params = self.model.init(key, dummy_obs)
         opt_state = self.optimizer.init(params)
         rep = NamedSharding(self.mesh, P())
+        params = jax.device_put(params, rep)
         return LearnerState(
-            params=jax.device_put(params, rep),
+            params=params,
             opt_state=jax.device_put(opt_state, rep),
             update_step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+            # qlearn: target net starts equal to the online net (device
+            # arrays are immutable, so sharing the reference is safe).
+            target_params=params if self.config.algo == "qlearn" else None,
         )
 
     # --------------------------------------------------------------- update

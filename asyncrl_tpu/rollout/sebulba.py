@@ -165,16 +165,37 @@ def make_host_pool(config, num_envs: int, seed: int):
     )
 
 
-def make_inference_fn(model, spec: EnvSpec) -> Callable:
+def make_inference_fn(model, spec: EnvSpec, config: Any) -> Callable:
     """Jitted batched action selection for ``model`` (a flax module; the
     recurrent/ff call shape is derived from it, so the wrong variant cannot
     be built). Feed-forward: (params, obs[B], key) ->
     (actions, behaviour_logp, new_key). Recurrent (LSTM) models:
     (params, obs, key, core, done_prev) -> (..., new_core) — the core stays
     ON DEVICE across calls (only actions/logp sync to host), and is reset
-    where the PREVIOUS step ended an episode, mirroring the Anakin scan."""
-    dist = distributions.for_spec(spec)
+    where the PREVIOUS step ended an episode, mirroring the Anakin scan.
+
+    With ``config.algo == "qlearn"`` the signature instead is
+    (params, obs, key, eps[B]) — ε-greedy over the model's Q-values, the
+    per-env ε appended onto dist_params exactly as the Anakin ``dist_extra``
+    channel does (ops.distributions.EpsilonGreedy)."""
+    dist = distributions.for_config(config, spec)
     apply_fn = model.apply
+
+    if config.algo == "qlearn":
+
+        @jax.jit
+        def infer_eps(params, obs, key, eps):
+            key, sub = jax.random.split(key)
+            q, _ = apply_fn(params, obs)
+            dist_params = jnp.concatenate(
+                [q, eps[:, None].astype(q.dtype)], axis=-1
+            )
+            act_keys = jax.random.split(sub, obs.shape[0])
+            actions = jax.vmap(dist.sample)(act_keys, dist_params)
+            logp = dist.logp(dist_params, actions)
+            return actions, logp, key
+
+        return infer_eps
 
     if is_recurrent(model):
 
@@ -224,6 +245,7 @@ class ActorThread(threading.Thread):
         errors: "queue.Queue[tuple[int, BaseException]]",
         device=None,
         initial_core: Callable[[int], Any] | None = None,
+        epsilon_fn: Callable[[int], np.ndarray] | None = None,
     ):
         super().__init__(name=f"actor-{index}", daemon=True)
         self.index = index
@@ -238,6 +260,10 @@ class ActorThread(threading.Thread):
         # Recurrent policies: builds the initial (c, h) carry for B envs;
         # None for feed-forward.
         self.initial_core = initial_core
+        # Q-learning family: maps this thread's cumulative env frames -> the
+        # per-env behaviour ε vector [B] (the A3C paper's per-thread ε,
+        # annealed). None for the policy-gradient algos.
+        self.epsilon_fn = epsilon_fn
         # ``jax.default_device`` is thread-local, so a device pin must be
         # re-established INSIDE the thread: the cpu_async backend pins actors
         # to host CPU (never touching an attached accelerator); sebulba
@@ -272,9 +298,16 @@ class ActorThread(threading.Thread):
         running_length = np.zeros((B,), np.float64)
         core = self.initial_core(B) if self.initial_core else None
         done_prev = np.zeros((B,), bool)
+        frames = 0  # this thread's cumulative env frames (for epsilon_fn)
 
         while not self.stop_event.is_set():
             params, version = self.store.get()
+            # ε is fragment-constant (same anneal granularity as Anakin).
+            eps = (
+                jnp.asarray(self.epsilon_fn(frames))
+                if self.epsilon_fn is not None
+                else None
+            )
             ret_sum = 0.0
             len_sum = 0.0
             count = 0.0
@@ -290,6 +323,10 @@ class ActorThread(threading.Thread):
                     actions_d, logp_d, key, core = self.inference_fn(
                         params, obs, key, core, done_prev
                     )
+                elif eps is not None:
+                    actions_d, logp_d, key = self.inference_fn(
+                        params, obs, key, eps
+                    )
                 else:
                     actions_d, logp_d, key = self.inference_fn(params, obs, key)
                 actions = np.asarray(actions_d)
@@ -297,6 +334,7 @@ class ActorThread(threading.Thread):
                 obs, rew, term, trunc = pool.step(actions)
                 buffer.append(prev_obs, actions, np.asarray(logp_d), rew, term, trunc)
                 done_prev = np.logical_or(term, trunc)
+                frames += B
 
                 running_return += rew
                 running_length += 1.0

@@ -14,7 +14,6 @@ from __future__ import annotations
 import json
 import subprocess
 import sys
-import time
 
 
 def _accelerator_alive(timeout: float = 120.0) -> bool:
@@ -49,6 +48,65 @@ def jnp_abs_sum(x):
     import jax.numpy as jnp
 
     return jnp.sum(jnp.abs(x.astype(jnp.float32)))
+
+
+def timed_update_window(
+    update,
+    state,
+    updates_per_call: int,
+    warmup: int = 3,
+    min_seconds: float = 2.0,
+    min_calls: int = 10,
+):
+    """Shared measurement harness (bench.py + scripts/bench_matrix.py — ONE
+    copy, so a sync-discipline fix can never drift between them).
+
+    SYNC DISCIPLINE: on the axon plugin, ``jax.block_until_ready`` returns
+    before execution finishes (verified 2026-07-30: 500 fused calls
+    "completed" in 84ms by block_until_ready, 4.6s by an actual D2H read —
+    a 55x phantom speedup that put the apparent fps above the chip's FLOP
+    peak). Only a device->host copy truly synchronizes, so every timing
+    boundary here reads a scalar off the dependency chain's tail.
+
+    Time-targeted window: run for >= ``min_seconds`` of wall clock (and >=
+    ``min_calls`` calls). A fixed small iteration count gave a ~5ms device
+    window on fast configs, where per-call dispatch jitter swung results by
+    ±40% run to run (observed 30-52M fps on identical configs, 2026-07-30).
+
+    Returns ``(state, timed_calls, elapsed_seconds)``. Raises RuntimeError
+    if the device-side update counter disagrees with the number of updates
+    dispatched (the counter cannot ack work that never ran).
+    """
+    import time
+
+    def sync(s) -> int:
+        return int(s.update_step)  # D2H read: forces all queued work
+
+    # Counter base: the state may be non-fresh (checkpoint auto-resume), so
+    # the guard compares counter DELTA, not the absolute value.
+    base = sync(state)
+    for _ in range(warmup):
+        state, _ = update(state)
+    sync(state)
+
+    timed = 0
+    t0 = time.perf_counter()
+    while True:
+        state, _ = update(state)
+        timed += 1
+        if timed % min_calls == 0:
+            executed = sync(state)
+            if time.perf_counter() - t0 >= min_seconds:
+                break
+    elapsed = time.perf_counter() - t0
+
+    dispatched = (warmup + timed) * updates_per_call
+    if executed - base != dispatched:
+        raise RuntimeError(
+            f"device executed {executed - base} updates, "
+            f"dispatched {dispatched}"
+        )
+    return state, timed, elapsed
 
 
 def main() -> None:
@@ -99,44 +157,13 @@ def main() -> None:
     # buffers, and an aliasing snapshot would be deleted from under us.
     params0 = jax.tree.map(lambda x: x.copy(), state.params)
 
-    # SYNC DISCIPLINE: on the axon plugin, ``jax.block_until_ready`` returns
-    # before execution finishes (verified 2026-07-30: 500 fused calls
-    # "completed" in 84ms by block_until_ready, 4.6s by an actual D2H read —
-    # a 55x phantom speedup that put the apparent fps above the chip's FLOP
-    # peak). Only a device->host copy truly synchronizes, so every timing
-    # boundary below reads a scalar off the dependency chain's tail.
-    def sync(s) -> int:
-        return int(s.update_step)  # D2H read: forces all queued work
-
-    warmup = 3
-    for _ in range(warmup):
-        state, metrics = trainer.learner.update(state)
-    sync(state)
-
-    # Time-targeted window: run for >= min_seconds of wall clock (and >= 10
-    # calls). A fixed small iteration count gave a ~5ms device window on
-    # fast configs, where per-call dispatch jitter swung results by ±40%
-    # run to run (observed 30-52M fps on identical configs, 2026-07-30).
-    min_seconds, min_calls = 2.0, 10
-    timed = 0
-    t0 = time.perf_counter()
-    while True:
-        state, metrics = trainer.learner.update(state)
-        timed += 1
-        if timed % min_calls == 0:
-            sync(state)
-            if time.perf_counter() - t0 >= min_seconds:
-                break
-    elapsed = time.perf_counter() - t0
-
-    # The device-side step counter cannot lie: it must equal exactly the
-    # number of updates dispatched, or executions were dropped.
-    expected = (warmup + timed) * cfg.updates_per_call
-    got = sync(state)
-    if got != expected:
+    try:
+        state, timed, elapsed = timed_update_window(
+            trainer.learner.update, state, cfg.updates_per_call
+        )
+    except RuntimeError as e:
         print(
-            f"bench: device executed {got} updates, dispatched {expected}; "
-            "refusing to report a throughput number",
+            f"bench: {e}; refusing to report a throughput number",
             file=sys.stderr,
         )
         sys.exit(1)

@@ -14,8 +14,13 @@ execution guard — at the preset's own geometry.
 from __future__ import annotations
 
 import json
+import os
 import sys
-import time
+
+# Shared measurement harness (liveness probe, sync discipline, execution
+# guard) lives in bench.py at the repo root — ONE copy for both entry points.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import _accelerator_alive, timed_update_window  # noqa: E402
 
 DEFAULT_PRESETS = [
     "cartpole_impala",
@@ -39,37 +44,9 @@ def bench_one(preset_name: str, overrides: list[str]) -> dict:
     state = trainer.state
     params0 = jax.tree.map(lambda x: x.copy(), state.params)
 
-    # Timing boundaries are D2H reads, NOT jax.block_until_ready: the axon
-    # plugin's block_until_ready returns before execution finishes (see
-    # bench.py's sync discipline note, 2026-07-30), which inflated fps far
-    # beyond the chip's FLOP peak.
-    def sync(s) -> int:
-        return int(s.update_step)
-
-    warmup = 3
-    for _ in range(warmup):
-        state, metrics = trainer.learner.update(state)
-    sync(state)
-
-    # Time-targeted window, same rationale as bench.py: a fixed small call
-    # count gives a dispatch-jitter-dominated device window on fast configs.
-    min_seconds, min_calls = 2.0, 10
-    timed = 0
-    t0 = time.perf_counter()
-    while True:
-        state, metrics = trainer.learner.update(state)
-        timed += 1
-        if timed % min_calls == 0:
-            executed = sync(state)
-            if time.perf_counter() - t0 >= min_seconds:
-                break
-    elapsed = time.perf_counter() - t0
-    dispatched = (warmup + timed) * cfg.updates_per_call
-    if executed != dispatched:
-        raise RuntimeError(
-            f"device executed {executed} updates, dispatched {dispatched}: "
-            "refusing to report a throughput number"
-        )
+    state, timed, elapsed = timed_update_window(
+        trainer.learner.update, state, cfg.updates_per_call
+    )
 
     import numpy as np
 
@@ -79,6 +56,10 @@ def bench_one(preset_name: str, overrides: list[str]) -> dict:
             jax.tree.leaves(state.params), jax.tree.leaves(params0)
         )
     )
+    # Same refusal policy as bench.py: don't emit an fps figure training
+    # didn't earn (frozen params = dropped/ineffective executions).
+    if not (np.isfinite(delta) and delta > 0.0):
+        raise RuntimeError(f"param delta {delta}: training did not move")
     fps = timed * cfg.updates_per_call * cfg.num_envs * cfg.unroll_len / elapsed
     return {
         "preset": preset_name,
@@ -87,13 +68,21 @@ def bench_one(preset_name: str, overrides: list[str]) -> dict:
         "unroll_len": cfg.unroll_len,
         "frames_per_sec": round(fps),
         "device": f"{jax.devices()[0].device_kind} x{jax.device_count()}",
-        # Counter mismatch raised above, so this reflects the param-delta
-        # check only (training actually moved the weights).
-        "integrity_ok": bool(np.isfinite(delta) and delta > 0.0),
     }
 
 
 def main() -> int:
+    import jax
+
+    if not _accelerator_alive():
+        # Same guard as bench.py: a hung axon tunnel would otherwise block
+        # the first device query forever.
+        jax.config.update("jax_platforms", "cpu")
+        print(
+            "bench_matrix: accelerator backend hung/unavailable; falling "
+            "back to CPU (device field carries the kind)",
+            file=sys.stderr,
+        )
     args = sys.argv[1:]
     overrides = [a for a in args if "=" in a]
     names = [a for a in args if "=" not in a] or DEFAULT_PRESETS

@@ -200,12 +200,45 @@ def test_population_rejects_default_staleness():
         )
 
 
-def test_host_backends_reject_qlearn():
+def test_cpu_async_qlearn_pipeline():
+    """The thread-based host path (the A3C paper's literal async-Q layout):
+    ε-greedy ActorWorker threads feed the queue; the learner's target net
+    refreshes on the actor_staleness cadence."""
     cfg = presets.get("cartpole_qlearn").replace(
-        backend="cpu_async", host_pool="jax"
+        backend="cpu_async", host_pool="jax", num_envs=4, actor_threads=2,
+        unroll_len=8, actor_staleness=2, precision="f32", log_every=2,
     )
-    with pytest.raises(NotImplementedError, match="Anakin-only"):
-        make_agent(cfg)
+    agent = make_agent(cfg)
+    try:
+        assert agent.state.target_params is not None
+        history = agent.train(total_env_steps=4 * 8 * 6)
+        assert all("td_abs" in h for h in history)
+        # After an even number of updates the target just refreshed; params
+        # and target coincide. (Cadence asserted precisely in the Anakin
+        # test; here we check the target actually moved off init.)
+        init_leaf = np.asarray(
+            jax.tree.leaves(agent.learner.init_state(cfg.seed).target_params)[0]
+        )
+        t_leaf = np.asarray(jax.tree.leaves(agent.state.target_params)[0])
+        assert np.any(t_leaf != init_leaf)
+        ret = agent.evaluate(num_episodes=4, max_steps=50)
+        assert np.isfinite(ret)
+    finally:
+        agent.close()
+
+
+def test_qlearn_rejects_time_sharding():
+    from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.learn.rollout_learner import RolloutLearner
+    from asyncrl_tpu.models.networks import build_model
+    from asyncrl_tpu.parallel.mesh import make_mesh
+
+    cfg = presets.get("cartpole_qlearn").replace(unroll_len=8)
+    env = CartPole()
+    model = build_model(cfg, env.spec)
+    mesh = make_mesh((4, 2), ("dp", "sp"))
+    with pytest.raises(NotImplementedError, match="time-shard"):
+        RolloutLearner(cfg, env.spec, model, mesh)
 
 
 @pytest.mark.slow

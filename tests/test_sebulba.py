@@ -75,7 +75,7 @@ def test_actor_thread_fragment_shapes():
     actor = ActorThread(
         index=0,
         pool=JaxHostPool(env, B, seed=1),
-        inference_fn=make_inference_fn(model, env.spec),
+        inference_fn=make_inference_fn(model, env.spec, cfg),
         store=ParamStore(params),
         out_queue=out_q,
         unroll_len=T,
