@@ -10,8 +10,8 @@ greedy-evaluates, and emits a per-game JSONL plus an aggregate summary
 Atari-57 protocol, with raw returns since these games have no human
 baseline).
 
-Default game set: the five-game Atari stand-in family (JaxPong, JaxBreakout,
-and the MinAtar-style trio) — swap with ``--games`` for e.g. the procedural
+Default game set: the six-game Atari stand-in family (JaxPong, JaxBreakout,
+and the MinAtar-style four) — swap with ``--games`` for e.g. the procedural
 or locomotion families.
 """
 
@@ -30,6 +30,7 @@ ATARI_FAMILY = [
     "JaxSpaceInvaders-v0",
     "JaxFreeway-v0",
     "JaxAsterix-v0",
+    "JaxSeaquest-v0",
 ]
 
 

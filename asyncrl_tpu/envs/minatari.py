@@ -1,14 +1,15 @@
-"""MinAtar-style pure-JAX arcade games: SpaceInvaders, Freeway, Asterix.
+"""MinAtar-style pure-JAX arcade games: SpaceInvaders, Freeway, Asterix,
+Seaquest.
 
 Together with JaxPong / JaxBreakout (envs/pong.py, envs/breakout.py) these
 widen the Atari-suite stand-in (BASELINE.json:9 — "Atari-57 suite, IMPALA,
 1024 envs/chip"; ale-py is unavailable in this image, SURVEY.md §7.4 R1)
-to a five-game family, mirroring how the MinAtar suite (Young & Tian 2019,
+to a six-game family, mirroring how the MinAtar suite (Young & Tian 2019,
 a public 10×10 re-implementation of five ALE games) substitutes for full
 Atari in RL research. Swapping games is one ``env_id`` override, exactly
 like swapping ALE roms in the reference suite.
 
-All three run on the TPU under ``vmap``: 10×10×C uint8 {0,1} feature-plane
+All games run on the TPU under ``vmap``: 10×10×C uint8 {0,1} feature-plane
 observations (the same plane convention as envs/gridworlds.py), entity
 state kept as fixed-size masks/slots — no dynamic shapes. The games follow
 MinAtar's rules in structure (action sets, reward events, termination) but
@@ -209,6 +210,45 @@ _LANE_SPEED = jnp.array([1, 2, 3, 4, -1, -2, -3, -4], jnp.int32)
 _LANE_ROWS = jnp.arange(1, 9)  # rows 1..8 carry traffic
 
 
+def _lane_stream_step(
+    key_spawn, key_side, active, cols, dirs, timers, period, spawn_prob
+):
+    """One step of a lane-entity stream — THE shared implementation for
+    every slot-per-lane entity family (Asterix entities, Seaquest fish and
+    divers): entities advance when their lane timer expires, deactivate
+    off-grid, and inactive slots respawn at a random edge with
+    ``spawn_prob``. Returns (active, cols, dirs, timers, spawn_mask);
+    ``spawn_mask`` lets callers attach per-entity attributes (e.g.
+    Asterix's treasure flag) to fresh spawns."""
+    fire = timers <= 1
+    cols = jnp.where(fire, cols + dirs, cols).astype(jnp.int32)
+    off = (cols < 0) | (cols >= G)
+    active = active & ~off
+    cols = jnp.clip(cols, 0, G - 1)
+    timers = jnp.where(fire, period, timers - 1).astype(jnp.int32)
+
+    spawn = jax.random.bernoulli(key_spawn, spawn_prob, (8,)) & ~active
+    from_left = jax.random.bernoulli(key_side, 0.5, (8,))
+    dirs = jnp.where(spawn, jnp.where(from_left, 1, -1), dirs).astype(
+        jnp.int32
+    )
+    cols = jnp.where(spawn, jnp.where(from_left, 0, G - 1), cols).astype(
+        jnp.int32
+    )
+    return active | spawn, cols, dirs, timers, spawn
+
+
+def _lane_contact(row, col, active, cols):
+    """Agent cell vs its lane's entity slot (lanes = rows 1..8): returns
+    (same_cell, slot). Callers check BEFORE and AFTER the entity march so
+    agent/entity cell swaps cannot pass through each other."""
+    lane = row - 1
+    in_lane = (row >= 1) & (row <= 8)
+    slot = jnp.clip(lane, 0, 7)
+    same = in_lane & active[slot] & (cols[slot] == col)
+    return same, slot
+
+
 class Freeway(Environment):
     """MinAtar freeway analogue.
 
@@ -289,6 +329,237 @@ class Freeway(Environment):
 
 
 # ---------------------------------------------------------------------------
+# Seaquest
+
+
+@struct.dataclass
+class SeaquestState:
+    pos: jax.Array  # [2] int32 (row, col); rows 0..8 (row 0 = surface)
+    facing: jax.Array  # int32 +1 right / -1 left (bullet direction)
+    bul_l: jax.Array  # [G, G] bool, friendly bullets travelling left
+    bul_r: jax.Array  # [G, G] bool, friendly bullets travelling right
+    fish_active: jax.Array  # [8] bool — one fish slot per lane (rows 1..8)
+    fish_cols: jax.Array  # [8] int32
+    fish_dirs: jax.Array  # [8] int32 +-1
+    fish_timers: jax.Array  # [8] int32 countdown to fish move
+    div_active: jax.Array  # [8] bool — one diver slot per lane
+    div_cols: jax.Array  # [8] int32
+    div_dirs: jax.Array  # [8] int32 +-1
+    div_timers: jax.Array  # [8] int32
+    oxygen: jax.Array  # int32 countdown; 0 = drowned
+    divers: jax.Array  # int32 divers on board (0..MAX_DIVERS)
+    t: jax.Array
+
+
+class Seaquest(Environment):
+    """MinAtar seaquest analogue (simplified: no enemy submarines — fish,
+    divers, bullets, and the oxygen/surfacing economy carry the game).
+
+    Actions: 0 noop, 1 up, 2 down, 3 left, 4 right, 5 fire. The sub swims
+    rows 0..8 (row 0 is the surface; lanes 1..8 carry traffic; row 9 shows
+    the meters). Shooting a fish pays +1; touching one ends the episode.
+    Swimming over a diver picks it up (max 6 aboard). Oxygen drains every
+    submerged step and ends the episode at 0; surfacing with divers aboard
+    cashes them (+1 each) and refills oxygen, while surfacing with NONE
+    aboard ends the episode — MinAtar's forced-dive pressure.
+    """
+
+    MAX_STEPS = 2000
+    OXYGEN_MAX = 200
+    MAX_DIVERS = 6
+    FISH_PERIOD = 3
+    DIVER_PERIOD = 4
+    FISH_SPAWN_PROB = 0.25
+    DIVER_SPAWN_PROB = 0.1
+
+    spec = EnvSpec(obs_shape=(G, G, 7), num_actions=6, obs_dtype=jnp.uint8)
+
+    def init(self, key: jax.Array) -> SeaquestState:
+        zeros8 = jnp.zeros((8,), jnp.int32)
+        return SeaquestState(
+            pos=jnp.array([G // 2, G // 2], jnp.int32),
+            facing=jnp.asarray(1, jnp.int32),
+            bul_l=jnp.zeros((G, G), bool),
+            bul_r=jnp.zeros((G, G), bool),
+            fish_active=jnp.zeros((8,), bool),
+            fish_cols=zeros8,
+            fish_dirs=jnp.ones((8,), jnp.int32),
+            fish_timers=jnp.full((8,), self.FISH_PERIOD, jnp.int32),
+            div_active=jnp.zeros((8,), bool),
+            div_cols=zeros8,
+            div_dirs=jnp.ones((8,), jnp.int32),
+            div_timers=jnp.full((8,), self.DIVER_PERIOD, jnp.int32),
+            oxygen=jnp.asarray(self.OXYGEN_MAX, jnp.int32),
+            divers=jnp.zeros((), jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def observe(self, state: SeaquestState) -> jax.Array:
+        agent = jnp.zeros((G, G), jnp.uint8).at[
+            state.pos[0], state.pos[1]
+        ].set(1)
+        fish = jnp.zeros((G, G), jnp.uint8).at[_LANE_ROWS, state.fish_cols].max(
+            state.fish_active.astype(jnp.uint8)
+        )
+        divers = jnp.zeros((G, G), jnp.uint8).at[_LANE_ROWS, state.div_cols].max(
+            state.div_active.astype(jnp.uint8)
+        )
+        # Meters rendered as filled cell runs along the bottom (meter) row:
+        # oxygen 0..G cells, carried divers 0..MAX_DIVERS cells.
+        idx = jnp.arange(G)
+        o2_cells = (state.oxygen * G) // self.OXYGEN_MAX
+        o2 = jnp.zeros((G, G), jnp.uint8).at[G - 1, :].set(
+            (idx < o2_cells).astype(jnp.uint8)
+        )
+        carried = jnp.zeros((G, G), jnp.uint8).at[G - 1, :].set(
+            (idx < state.divers).astype(jnp.uint8)
+        )
+        return jnp.stack(
+            [
+                agent,
+                fish,
+                divers,
+                state.bul_l.astype(jnp.uint8),
+                state.bul_r.astype(jnp.uint8),
+                o2,
+                carried,
+            ],
+            axis=-1,
+        )
+
+    def _fish_hits(self, bul_l, bul_r, fish_active, fish_cols):
+        """Bullets vs fish on the lane rows: returns (hit_mask[8], bul_l,
+        bul_r) with hit bullets consumed."""
+        bullets = bul_l | bul_r
+        hit = fish_active & bullets[_LANE_ROWS, fish_cols]
+        clear = jnp.zeros((G, G), bool).at[_LANE_ROWS, fish_cols].max(hit)
+        return hit, bul_l & ~clear, bul_r & ~clear
+
+    def step(
+        self, state: SeaquestState, action: jax.Array, key: jax.Array
+    ) -> tuple[SeaquestState, TimeStep]:
+        k_fs, k_fside, k_ds, k_dside = jax.random.split(key, 4)
+
+        # Agent swim (rows 0..8; row G-1 is the meter row) + facing.
+        dr = jnp.where(action == 1, -1, jnp.where(action == 2, 1, 0))
+        dc = jnp.where(action == 3, -1, jnp.where(action == 4, 1, 0))
+        row = jnp.clip(state.pos[0] + dr, 0, G - 2).astype(jnp.int32)
+        col = jnp.clip(state.pos[1] + dc, 0, G - 1).astype(jnp.int32)
+        pos = jnp.stack([row, col])
+        facing = jnp.where(dc != 0, jnp.sign(dc), state.facing).astype(
+            jnp.int32
+        )
+
+        # Bullets advance; fire spawns one at the agent's cell.
+        bul_l = jnp.roll(state.bul_l, -1, axis=1).at[:, G - 1].set(False)
+        bul_r = jnp.roll(state.bul_r, 1, axis=1).at[:, 0].set(False)
+        fire = action == 5
+        bul_l = jnp.where(
+            fire & (facing < 0), bul_l.at[row, col].set(True), bul_l
+        )
+        bul_r = jnp.where(
+            fire & (facing > 0), bul_r.at[row, col].set(True), bul_r
+        )
+
+        # Agent/entity contact check #1 — BEFORE the march, so a same-step
+        # cell swap (agent moves onto the entity's old cell while it marches
+        # onto the agent's) cannot pass through: the moved agent meets the
+        # entity at its pre-march position here.
+        hit_fish_1, _ = _lane_contact(
+            row, col, state.fish_active, state.fish_cols
+        )
+        grab_1, dslot_1 = _lane_contact(
+            row, col, state.div_active, state.div_cols
+        )
+        grab_1 = grab_1 & (state.divers < self.MAX_DIVERS)
+        div_active = state.div_active & ~jnp.zeros((8,), bool).at[
+            dslot_1
+        ].set(grab_1)
+        divers = state.divers + grab_1.astype(jnp.int32)
+
+        # Bullet/fish hits before and after the fish march (no pass-through
+        # for bullets either).
+        hit1, bul_l, bul_r = self._fish_hits(
+            bul_l, bul_r, state.fish_active, state.fish_cols
+        )
+        fish_active = state.fish_active & ~hit1
+
+        fish_active, fish_cols, fish_dirs, fish_timers, _ = _lane_stream_step(
+            k_fs, k_fside, fish_active, state.fish_cols, state.fish_dirs,
+            state.fish_timers, self.FISH_PERIOD, self.FISH_SPAWN_PROB,
+        )
+        hit2, bul_l, bul_r = self._fish_hits(
+            bul_l, bul_r, fish_active, fish_cols
+        )
+        fish_active = fish_active & ~hit2
+
+        # Divers drift (slower), despawn off-grid, spawn at edges.
+        div_active, div_cols, div_dirs, div_timers, _ = _lane_stream_step(
+            k_ds, k_dside, div_active, state.div_cols, state.div_dirs,
+            state.div_timers, self.DIVER_PERIOD, self.DIVER_SPAWN_PROB,
+        )
+
+        # Contact check #2 — after the march (entity steps onto the agent).
+        hit_fish_2, _ = _lane_contact(row, col, fish_active, fish_cols)
+        hit_fish = hit_fish_1 | hit_fish_2
+        grab_2, dslot_2 = _lane_contact(row, col, div_active, div_cols)
+        grab_2 = grab_2 & (divers < self.MAX_DIVERS)
+        div_active = div_active & ~jnp.zeros((8,), bool).at[dslot_2].set(
+            grab_2
+        )
+        divers = divers + grab_2.astype(jnp.int32)
+
+        # Surfacing economy + oxygen.
+        at_surface = row == 0
+        cash = at_surface & (divers > 0)
+        reward = (
+            (jnp.sum(hit1) + jnp.sum(hit2)).astype(jnp.float32)
+            + jnp.where(cash, divers.astype(jnp.float32), 0.0)
+        )
+        drowned = ~at_surface & (state.oxygen <= 1)
+        oxygen = jnp.where(
+            cash,
+            self.OXYGEN_MAX,
+            jnp.where(at_surface, state.oxygen, state.oxygen - 1),
+        ).astype(jnp.int32)
+        surfaced_empty = at_surface & (divers == 0)
+        divers = jnp.where(cash, 0, divers).astype(jnp.int32)
+
+        t = state.t + 1
+        terminated = hit_fish | drowned | surfaced_empty
+        truncated = (t >= self.MAX_STEPS) & ~terminated
+        done = terminated | truncated
+        ended = SeaquestState(
+            pos=pos,
+            facing=facing,
+            bul_l=bul_l,
+            bul_r=bul_r,
+            fish_active=fish_active,
+            fish_cols=fish_cols,
+            fish_dirs=fish_dirs,
+            fish_timers=fish_timers,
+            div_active=div_active,
+            div_cols=div_cols,
+            div_dirs=div_dirs,
+            div_timers=div_timers,
+            oxygen=oxygen,
+            divers=divers,
+            t=t,
+        )
+        fresh = self.init(key)
+        new_state = jax.tree.map(
+            lambda f, e: jnp.where(done, f, e), fresh, ended
+        )
+        return new_state, TimeStep(
+            obs=self.observe(new_state),
+            reward=reward,
+            terminated=terminated,
+            truncated=truncated,
+            last_obs=self.observe(ended),
+        )
+
+
+# ---------------------------------------------------------------------------
 # Asterix
 
 
@@ -345,11 +616,8 @@ class Asterix(Environment):
 
     def _collide(self, state: AsterixState) -> tuple[jax.Array, jax.Array]:
         """(hit_enemy, hit_gold_slot_mask) for the agent's current cell."""
-        lane = state.pos[0] - 1
-        in_lane = (state.pos[0] >= 1) & (state.pos[0] <= 8)
-        slot = jnp.clip(lane, 0, 7)
-        same_cell = in_lane & state.active[slot] & (
-            state.cols[slot] == state.pos[1]
+        same_cell, slot = _lane_contact(
+            state.pos[0], state.pos[1], state.active, state.cols
         )
         hit_enemy = same_cell & ~state.gold[slot]
         gold_mask = jnp.zeros((8,), bool).at[slot].set(
@@ -376,33 +644,15 @@ class Asterix(Environment):
         hit1, gold1 = self._collide(moved)
         pre_active = state.active & ~gold1
 
-        # Entities advance; leaving the grid deactivates the slot.
-        fire = state.timers <= 1
-        cols = jnp.where(fire, state.cols + state.dirs, state.cols).astype(
-            jnp.int32
-        )
-        off = (cols < 0) | (cols >= G)
-        active = pre_active & ~off
-        cols = jnp.clip(cols, 0, G - 1)
-        timers = jnp.where(
-            fire, self.MOVE_PERIOD, state.timers - 1
-        ).astype(jnp.int32)
-
-        # Spawns fill inactive slots with fresh edge entities.
-        spawn = (
-            jax.random.bernoulli(k_spawn, self.SPAWN_PROB, (8,)) & ~active
-        )
-        from_left = jax.random.bernoulli(k_side, 0.5, (8,))
-        dirs = jnp.where(
-            spawn, jnp.where(from_left, 1, -1), state.dirs
-        ).astype(jnp.int32)
-        cols = jnp.where(spawn, jnp.where(from_left, 0, G - 1), cols).astype(
-            jnp.int32
+        # Entities march/despawn/spawn (shared lane-stream step); fresh
+        # spawns roll their treasure flag.
+        active, cols, dirs, timers, spawn = _lane_stream_step(
+            k_spawn, k_side, pre_active, state.cols, state.dirs,
+            state.timers, self.MOVE_PERIOD, self.SPAWN_PROB,
         )
         gold = jnp.where(
             spawn, jax.random.bernoulli(k_gold, self.GOLD_PROB, (8,)), state.gold
         )
-        active = active | spawn
 
         # Collisions after movement (entity steps onto the agent).
         after = state.replace(

@@ -50,12 +50,18 @@ def _register_builtins() -> None:
     register("JaxBreakoutPixels-v0", BreakoutPixels)
     register("JaxPendulum-v0", Pendulum)
     from asyncrl_tpu.envs.gridworlds import Chaser, Maze
-    from asyncrl_tpu.envs.minatari import Asterix, Freeway, SpaceInvaders
+    from asyncrl_tpu.envs.minatari import (
+        Asterix,
+        Freeway,
+        Seaquest,
+        SpaceInvaders,
+    )
 
     # MinAtar-style games widening the Atari family (BASELINE.json:9).
     register("JaxSpaceInvaders-v0", SpaceInvaders)
     register("JaxFreeway-v0", Freeway)
     register("JaxAsterix-v0", Asterix)
+    register("JaxSeaquest-v0", Seaquest)
 
     # Procedurally-generated family (Procgen stand-ins, BASELINE.json:10).
     register("JaxMaze-v0", Maze)

@@ -13,6 +13,7 @@ from asyncrl_tpu.envs.minatari import (
     Freeway,
     FreewayState,
     InvadersState,
+    Seaquest,
     SpaceInvaders,
 )
 
@@ -20,6 +21,7 @@ ALL_GAMES = [
     ("space_invaders", SpaceInvaders, 4, 4),
     ("freeway", Freeway, 2, 3),
     ("asterix", Asterix, 3, 5),
+    ("seaquest", Seaquest, 7, 6),
 ]
 
 
@@ -190,7 +192,128 @@ def test_asterix_entities_spawn_and_cross():
     assert seen_active >= 2  # spawns happen
 
 
-def test_registry_has_the_five_game_family():
+def test_seaquest_oxygen_drowns_and_surfacing_economy():
+    """Oxygen drains submerged and kills at 0; surfacing with divers cashes
+    them (+1 each, refill); surfacing empty terminates."""
+    env = Seaquest()
+    key = jax.random.PRNGKey(0)
+    state = env.init(key)
+
+    # Drowning: pin the sub below surface with 2 oxygen left.
+    s = state.replace(oxygen=jnp.asarray(2, jnp.int32))
+    s, ts = env.step(s, jnp.asarray(0), key)  # oxygen 2 -> 1
+    assert not bool(ts.terminated)
+    _, ts = env.step(s, jnp.asarray(0), key)  # oxygen hits 0
+    assert bool(ts.terminated)
+
+    # Cash-in: at row 1 with 3 divers aboard, swimming up pays +3 and
+    # refills oxygen.
+    s = state.replace(
+        pos=jnp.array([1, 5], jnp.int32),
+        divers=jnp.asarray(3, jnp.int32),
+        oxygen=jnp.asarray(17, jnp.int32),
+        # Clear lane traffic so nothing collides en route.
+        fish_active=jnp.zeros((8,), bool),
+        div_active=jnp.zeros((8,), bool),
+    )
+    s2, ts = env.step(s, jnp.asarray(1), key)  # up -> surface
+    assert float(ts.reward) == 3.0
+    assert not bool(ts.terminated)
+    assert int(s2.divers) == 0
+    assert int(s2.oxygen) == Seaquest.OXYGEN_MAX
+
+    # Surfacing empty: same move with no divers terminates.
+    s3 = s.replace(divers=jnp.asarray(0, jnp.int32))
+    _, ts = env.step(s3, jnp.asarray(1), key)
+    assert bool(ts.terminated)
+
+
+def test_seaquest_shooting_fish_scores_and_contact_kills():
+    env = Seaquest()
+    key = jax.random.PRNGKey(1)
+    state = env.init(key)
+    # A fish two cells right of the sub in its lane (row 5 = slot 4), not
+    # due to move for a while; fire right: the bullet covers one cell per
+    # step and hits on the second.
+    s = state.replace(
+        pos=jnp.array([5, 3], jnp.int32),
+        facing=jnp.asarray(1, jnp.int32),
+        fish_active=jnp.zeros((8,), bool).at[4].set(True),
+        fish_cols=jnp.zeros((8,), jnp.int32).at[4].set(6),
+        fish_dirs=jnp.ones((8,), jnp.int32),
+        fish_timers=jnp.full((8,), 9, jnp.int32),
+    )
+    s, ts = env.step(s, jnp.asarray(5), key)  # fire (bullet at col 3)
+    total = float(ts.reward)
+    for _ in range(4):
+        s, ts = env.step(s, jnp.asarray(0), key)
+        total += float(ts.reward)
+        if bool(ts.terminated):
+            break
+    assert total >= 1.0, "bullet never scored the fish"
+
+    # Contact: swim right into an adjacent fish -> terminal.
+    s = state.replace(
+        pos=jnp.array([5, 3], jnp.int32),
+        fish_active=jnp.zeros((8,), bool).at[4].set(True),
+        fish_cols=jnp.zeros((8,), jnp.int32).at[4].set(4),
+        fish_timers=jnp.full((8,), 9, jnp.int32),
+    )
+    _, ts = env.step(s, jnp.asarray(4), key)
+    assert bool(ts.terminated)
+
+
+def test_seaquest_collects_divers_up_to_cap():
+    env = Seaquest()
+    key = jax.random.PRNGKey(2)
+    state = env.init(key)
+    s = state.replace(
+        pos=jnp.array([5, 3], jnp.int32),
+        div_active=jnp.zeros((8,), bool).at[4].set(True),
+        div_cols=jnp.zeros((8,), jnp.int32).at[4].set(4),
+        div_timers=jnp.full((8,), 9, jnp.int32),
+    )
+    s2, ts = env.step(s, jnp.asarray(4), key)  # swim onto the diver
+    assert int(s2.divers) == 1
+    assert not bool(s2.div_active[4])
+    assert float(ts.reward) == 0.0  # pickup itself pays nothing
+
+    full = s.replace(divers=jnp.asarray(Seaquest.MAX_DIVERS, jnp.int32))
+    s3, _ = env.step(full, jnp.asarray(4), key)
+    assert int(s3.divers) == Seaquest.MAX_DIVERS  # cap holds
+    assert bool(s3.div_active[4])  # diver NOT consumed at cap
+
+
+def test_seaquest_cell_swap_cannot_pass_through():
+    """Agent and a marching entity exchanging cells in the same step must
+    still interact: the fish swap kills, the diver swap collects."""
+    env = Seaquest()
+    key = jax.random.PRNGKey(3)
+    state = env.init(key)
+    # Fish at (row 5, col 4) moving left with its timer due; agent at col 3
+    # moves right — a perfect swap.
+    s = state.replace(
+        pos=jnp.array([5, 3], jnp.int32),
+        fish_active=jnp.zeros((8,), bool).at[4].set(True),
+        fish_cols=jnp.zeros((8,), jnp.int32).at[4].set(4),
+        fish_dirs=-jnp.ones((8,), jnp.int32),
+        fish_timers=jnp.ones((8,), jnp.int32),
+    )
+    _, ts = env.step(s, jnp.asarray(4), key)
+    assert bool(ts.terminated), "fish swap passed through the agent"
+
+    s = state.replace(
+        pos=jnp.array([5, 3], jnp.int32),
+        div_active=jnp.zeros((8,), bool).at[4].set(True),
+        div_cols=jnp.zeros((8,), jnp.int32).at[4].set(4),
+        div_dirs=-jnp.ones((8,), jnp.int32),
+        div_timers=jnp.ones((8,), jnp.int32),
+    )
+    s2, _ = env.step(s, jnp.asarray(4), key)
+    assert int(s2.divers) == 1, "diver swap was not collected"
+
+
+def test_registry_has_the_six_game_family():
     from asyncrl_tpu.envs import registered
 
     suite = {
@@ -199,6 +322,7 @@ def test_registry_has_the_five_game_family():
         "JaxSpaceInvaders-v0",
         "JaxFreeway-v0",
         "JaxAsterix-v0",
+        "JaxSeaquest-v0",
     }
     assert suite <= set(registered())
 
