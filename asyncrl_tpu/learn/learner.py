@@ -143,8 +143,9 @@ def validate_recurrent_config(config: Config, model) -> None:
         )
     if config.core == "lstm" and not is_recurrent(model):
         raise ValueError(
-            "config.core='lstm' but the given model is not a "
-            "RecurrentActorCritic — pass a recurrent model or core='ff'"
+            "config.core='lstm' but the given model is not recurrent — "
+            "pass a RecurrentActorCritic (policy-gradient algos) / "
+            "RecurrentQNetwork (qlearn), or use core='ff'"
         )
 
 
@@ -200,9 +201,17 @@ def _algo_loss(
         # copy, refreshed every actor_staleness updates — the async-Q target
         # network θ⁻): max_a Q_target, or the double-Q selection (argmax
         # under ONLINE q, evaluated under target) to damp the max bias.
-        q_target = jax.lax.stop_gradient(
-            apply_fn(target_params, rollout.bootstrap_obs)[0]
-        )
+        if rollout.init_core is None:
+            q_target = apply_fn(target_params, rollout.bootstrap_obs)[0]
+        else:
+            # DRQN: the target net needs ITS OWN core at the bootstrap
+            # step, so re-forward the whole fragment under target params
+            # from the stored behaviour-initial carry (the stored-state
+            # DRQN recipe; same shape of work as the online re-forward).
+            q_target = _forward_fragment(
+                apply_fn, target_params, rollout
+            )[0][-1]
+        q_target = jax.lax.stop_gradient(q_target)
         if config.double_q:
             sel = jnp.argmax(jax.lax.stop_gradient(logits[-1]), axis=-1)
             boot = jnp.take_along_axis(q_target, sel[..., None], axis=-1)[..., 0]

@@ -142,6 +142,21 @@ class ActorCritic(nn.Module):
         return _apply_heads(self, _apply_torso(self, obs))
 
 
+def _q_head(module: nn.Module, h: jax.Array) -> jax.Array:
+    """Shared Q head for the (Recurrent)QNetwork pair: one Q-value per
+    action, f32 regardless of compute dtype (same drift-prevention role as
+    ``_apply_heads`` for the actor-critic pair)."""
+    return nn.Dense(
+        module.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01)
+    )(h).astype(jnp.float32)
+
+
+def _zero_core(batch_size: int, core_size: int):
+    """Zero LSTM (c, h) carry — shared by every recurrent module."""
+    zeros = jnp.zeros((batch_size, core_size), jnp.float32)
+    return (zeros, zeros)
+
+
 class QNetwork(nn.Module):
     """Q-value network for the async Q-learning family (the A3C paper's
     value-based siblings — async one-step/n-step Q; PAPERS.md:8).
@@ -162,10 +177,7 @@ class QNetwork(nn.Module):
 
     @nn.compact
     def __call__(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
-        h = _apply_torso(self, obs)
-        q = nn.Dense(
-            self.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01)
-        )(h).astype(jnp.float32)
+        q = _q_head(self, _apply_torso(self, obs))
         return q, jnp.max(q, axis=-1)
 
 
@@ -203,8 +215,40 @@ class RecurrentActorCritic(nn.Module):
 
     def initial_core(self, batch_size: int):
         """Zero (c, h) carry for ``batch_size`` envs."""
-        zeros = jnp.zeros((batch_size, self.core_size), jnp.float32)
-        return (zeros, zeros)
+        return _zero_core(batch_size, self.core_size)
+
+
+class RecurrentQNetwork(nn.Module):
+    """DRQN-style recurrent Q network: torso -> LSTM core -> Q head.
+
+    The Q-learning family's answer to partial observability (Hausknecht &
+    Stone's DRQN recipe applied the A3C-LSTM way): same call/carry contract
+    as ``RecurrentActorCritic`` — ``apply(params, obs[B], core) ->
+    (q_values, max_q, new_core)`` with the CALLER resetting the core at
+    episode boundaries — so every recurrent code path (rollout scan,
+    learner re-forward, eval) works unchanged.
+    """
+
+    num_actions: int
+    torso: str = "mlp"
+    hidden_sizes: Sequence[int] = (64, 64)
+    channels: Sequence[int] = (16, 32, 32)
+    core_size: int = 256
+    compute_dtype: jnp.dtype = jnp.float32
+    obs_rank: int = 1
+
+    @nn.compact
+    def __call__(self, obs, core):
+        h = _apply_torso(self, obs)
+        # LSTM math in f32 for the same carry-rounding reason as
+        # RecurrentActorCritic.
+        cell = nn.OptimizedLSTMCell(self.core_size, dtype=jnp.float32)
+        core, h = cell(core, h.astype(jnp.float32))
+        q = _q_head(self, h)
+        return q, jnp.max(q, axis=-1), core
+
+    def initial_core(self, batch_size: int):
+        return _zero_core(batch_size, self.core_size)
 
 
 def reset_core(core, done):
@@ -215,7 +259,7 @@ def reset_core(core, done):
 
 
 def is_recurrent(model) -> bool:
-    return isinstance(model, RecurrentActorCritic)
+    return isinstance(model, (RecurrentActorCritic, RecurrentQNetwork))
 
 
 def build_model(config, env_spec):
@@ -229,12 +273,7 @@ def build_model(config, env_spec):
                 "algo='qlearn' requires a discrete action space; "
                 f"{config.env_id!r} is continuous"
             )
-        if config.core == "lstm":
-            raise NotImplementedError(
-                "recurrent (DRQN-style) Q networks are not supported; "
-                "use core='ff' with algo='qlearn'"
-            )
-        return QNetwork(
+        q_common = dict(
             num_actions=env_spec.num_actions,
             torso=config.torso,
             hidden_sizes=tuple(config.hidden_sizes),
@@ -242,6 +281,11 @@ def build_model(config, env_spec):
             compute_dtype=compute_dtype,
             obs_rank=len(env_spec.obs_shape),
         )
+        if config.core == "lstm":
+            return RecurrentQNetwork(core_size=config.core_size, **q_common)
+        if config.core != "ff":
+            raise ValueError(f"unknown core {config.core!r}; expected ff|lstm")
+        return QNetwork(**q_common)
     common = dict(
         num_actions=env_spec.num_actions,
         torso=config.torso,

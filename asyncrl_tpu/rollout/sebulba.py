@@ -177,11 +177,29 @@ def make_inference_fn(model, spec: EnvSpec, config: Any) -> Callable:
     With ``config.algo == "qlearn"`` the signature instead is
     (params, obs, key, eps[B]) — ε-greedy over the model's Q-values, the
     per-env ε appended onto dist_params exactly as the Anakin ``dist_extra``
-    channel does (ops.distributions.EpsilonGreedy)."""
+    channel does (ops.distributions.EpsilonGreedy). Recurrent (DRQN) Q
+    models combine both contracts: (params, obs, key, core, done_prev, eps)
+    -> (actions, logp, key, core)."""
     dist = distributions.for_config(config, spec)
     apply_fn = model.apply
 
     if config.algo == "qlearn":
+        if is_recurrent(model):
+
+            @jax.jit
+            def infer_eps_recurrent(params, obs, key, core, done_prev, eps):
+                core = reset_core(core, done_prev)
+                key, sub = jax.random.split(key)
+                q, _, core = apply_fn(params, obs, core)
+                dist_params = jnp.concatenate(
+                    [q, eps[:, None].astype(q.dtype)], axis=-1
+                )
+                act_keys = jax.random.split(sub, obs.shape[0])
+                actions = jax.vmap(dist.sample)(act_keys, dist_params)
+                logp = dist.logp(dist_params, actions)
+                return actions, logp, key, core
+
+            return infer_eps_recurrent
 
         @jax.jit
         def infer_eps(params, obs, key, eps):
@@ -319,7 +337,11 @@ class ActorThread(threading.Thread):
                 done_prev = np.zeros((B,), bool)
                 init_core = jax.tree.map(np.asarray, core)
             while not buffer.full:
-                if core is not None:
+                if core is not None and eps is not None:
+                    actions_d, logp_d, key, core = self.inference_fn(
+                        params, obs, key, core, done_prev, eps
+                    )
+                elif core is not None:
                     actions_d, logp_d, key, core = self.inference_fn(
                         params, obs, key, core, done_prev
                     )

@@ -298,6 +298,45 @@ def test_population_runs_qlearn():
         pop.close()
 
 
+def test_drqn_anakin_update_and_eval(devices):
+    """Recurrent (DRQN) Q-learning: the LSTM carry rides the rollout scan,
+    the target net re-forwards the fragment from the stored behaviour carry,
+    and greedy eval runs the recurrent path."""
+    from asyncrl_tpu.models.networks import RecurrentQNetwork
+
+    cfg = presets.get("cartpole_qlearn").replace(
+        num_envs=16, unroll_len=4, core="lstm", core_size=32,
+        precision="f32",
+    )
+    agent = make_agent(cfg)
+    try:
+        assert isinstance(agent.model, RecurrentQNetwork)
+        state, metrics = agent.learner.update(agent.state)
+        assert np.isfinite(float(metrics["loss"]))
+        assert state.actor.core is not None
+        ret = agent.evaluate(num_episodes=4, max_steps=25)
+        assert np.isfinite(ret)
+    finally:
+        agent.close()
+
+
+def test_drqn_host_pipeline():
+    """DRQN on the thread-based host path: core stays device-resident across
+    steps while ε rides the combined inference signature."""
+    cfg = presets.get("cartpole_qlearn").replace(
+        backend="cpu_async", host_pool="jax", num_envs=4, actor_threads=2,
+        unroll_len=8, actor_staleness=2, core="lstm", core_size=32,
+        precision="f32", log_every=2,
+    )
+    agent = make_agent(cfg)
+    try:
+        history = agent.train(total_env_steps=4 * 8 * 4)
+        assert all("td_abs" in h for h in history)
+        assert np.isfinite(agent.evaluate(num_episodes=4, max_steps=25))
+    finally:
+        agent.close()
+
+
 def test_qlearn_rejects_time_sharding():
     from asyncrl_tpu.envs.cartpole import CartPole
     from asyncrl_tpu.learn.rollout_learner import RolloutLearner

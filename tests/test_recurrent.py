@@ -148,7 +148,7 @@ def test_recurrent_guards():
         Trainer(lstm_cfg(algo="ppo", ppo_epochs=4, ppo_minibatches=4))
     from asyncrl_tpu.models.networks import ActorCritic
 
-    with pytest.raises(ValueError, match="not a\n?.*Recurrent"):
+    with pytest.raises(ValueError, match="not recurrent"):
         Trainer(
             lstm_cfg(),
             model=ActorCritic(num_actions=2, torso="mlp"),
