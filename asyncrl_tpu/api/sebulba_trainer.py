@@ -117,6 +117,7 @@ class SebulbaTrainer:
         self._RESTART_WINDOW_S = 300.0
         self._next_actor_seed = config.seed * 7919 + 1
         self._actor_device = None  # CpuAsyncTrainer pins actors to host CPU
+        self._server = None  # shared inference server (config.inference_server)
 
     # --------------------------------------------------------------- actors
 
@@ -150,10 +151,15 @@ class SebulbaTrainer:
         seed = self._next_actor_seed
         self._next_actor_seed += 104729
         pool = make_host_pool(self.config, self._envs_per_actor, seed=seed)
+        inference_fn = (
+            self._server.client(index)
+            if self._server is not None
+            else self._inference_fn
+        )
         actor = ActorThread(
             index=index,
             pool=pool,
-            inference_fn=self._inference_fn,
+            inference_fn=inference_fn,
             store=self._store,
             out_queue=self._queue,
             unroll_len=self.config.unroll_len,
@@ -171,6 +177,20 @@ class SebulbaTrainer:
         if self._actors:
             return
         self._stop.clear()
+        if self.config.inference_server:
+            from asyncrl_tpu.rollout.inference_server import InferenceServer
+            from asyncrl_tpu.rollout.sebulba import inference_mode
+
+            self._server = InferenceServer(
+                self._inference_fn,
+                self._store,
+                num_clients=self.config.actor_threads,
+                stop_event=self._stop,
+                mode=inference_mode(self.config, self.model),
+                seed=self.config.seed,
+                device=self._actor_device,
+            )
+            self._server.start()
         self._actors = [
             self._spawn_actor(i) for i in range(self.config.actor_threads)
         ]
@@ -202,7 +222,7 @@ class SebulbaTrainer:
             pass
 
     def stop(self) -> None:
-        """Stop actor threads and drain the queue."""
+        """Stop actor threads (and the inference server), drain the queue."""
         self._stop.set()
         # Unblock producers stuck on a full queue.
         try:
@@ -213,6 +233,9 @@ class SebulbaTrainer:
         for actor in self._actors:
             actor.join(timeout=5.0)
         self._actors = []
+        if self._server is not None:
+            self._server.join(timeout=5.0)
+            self._server = None
 
     # ---------------------------------------------------------------- train
 
@@ -256,8 +279,12 @@ class SebulbaTrainer:
                 ret_sum += fragment.return_sum
                 len_sum += fragment.length_sum
                 count += fragment.count
-                # Actual policy lag of this fragment, in learner updates:
+                # Policy lag of this fragment, in learner updates:
                 # fragment.version was published at update version*staleness.
+                # With inference_server=True this is an UPPER BOUND — the
+                # server evaluates under the latest published params, so
+                # later steps of a fragment can be fresher than its
+                # fragment-start version implies.
                 lag_sum += self._updates - fragment.version * max(
                     cfg.actor_staleness, 1
                 )

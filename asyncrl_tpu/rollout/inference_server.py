@@ -1,0 +1,223 @@
+"""Shared inference server: ONE device call serving every actor thread.
+
+The podracer/Sebulba architecture dedicates an inference thread so the
+accelerator sees one large action-selection batch per env step instead of
+one small batch per actor (SURVEY.md §7.3 "host↔device throughput"). With
+per-thread inference (the default), T actor threads cost T dispatches per
+step; on a high-latency link (the tunneled chip here pays ~8 ms per
+dispatch — see bench.py's sync-discipline note) that serializes into the
+hot loop T times over. The server coalesces: actor threads submit their
+observation slices, a dedicated thread concatenates them, runs the SAME
+jitted ``make_inference_fn`` callable once over the combined batch, and
+hands each client its slice of the results.
+
+Batching policy: serve once every live client has a request pending, or
+after ``max_wait_s`` — whichever comes first. In steady state all actors
+block on inference every step, so full batches are the norm; the timeout
+only covers clients that are mid-fragment-emit, dead, or restarting.
+Partial batches change the call's batch size and recompile once per
+distinct size (jit cache keyed on shape) — rare by construction.
+
+Semantics note vs per-thread inference: the server always evaluates under
+the LATEST published params, so behaviour params can refresh mid-fragment
+(per-thread actors pin params for a whole fragment). The per-step
+``behaviour_logp`` recorded with each action remains exact — which is all
+V-trace / the ε-greedy Q recording need — and this is precisely the
+published-weights semantics of the podracer inference thread.
+
+Client façade: ``server.client(i)`` returns a callable with the exact
+``make_inference_fn`` signature (params and key arguments are accepted and
+ignored — the server uses the ParamStore and its own key stream), so
+``ActorThread`` runs unchanged whether it holds the jitted function or a
+server client.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ServerClosed(RuntimeError):
+    """Raised into clients when the server stops while they wait."""
+
+
+def _concat(values):
+    """Concatenate request pytrees along the leading (batch) dim."""
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *values)
+
+
+def _slice(tree, start, stop):
+    return jax.tree.map(lambda x: x[start:stop], tree)
+
+
+class InferenceServer(threading.Thread):
+    """Coalesces actor-thread inference requests into one batched call.
+
+    ``mode`` names the wrapped callable's signature (the four
+    ``make_inference_fn`` variants):
+
+    - ``"ff"``:      (params, obs, key)                    -> (a, logp, key)
+    - ``"eps"``:     (params, obs, key, eps)               -> (a, logp, key)
+    - ``"rec"``:     (params, obs, key, core, done)        -> (..., core)
+    - ``"rec_eps"``: (params, obs, key, core, done, eps)   -> (..., core)
+    """
+
+    MODES = ("ff", "eps", "rec", "rec_eps")
+
+    def __init__(
+        self,
+        inference_fn: Callable,
+        store,
+        num_clients: int,
+        stop_event: threading.Event,
+        mode: str = "ff",
+        seed: int = 0,
+        max_wait_s: float = 0.002,
+        device=None,
+    ):
+        super().__init__(name="inference-server", daemon=True)
+        if mode not in self.MODES:
+            raise ValueError(f"unknown mode {mode!r}; expected {self.MODES}")
+        if num_clients < 1:
+            raise ValueError(f"num_clients must be >= 1, got {num_clients}")
+        self._fn = inference_fn
+        self._store = store
+        self._n = num_clients
+        self._stop_event = stop_event
+        self._mode = mode
+        self._max_wait = max_wait_s
+        # ``jax.default_device`` is thread-local (same constraint as
+        # ActorThread.device): cpu_async pins the server to host CPU so its
+        # concat/dispatch cannot land on an attached accelerator.
+        self._device = device
+        self._key = jax.random.PRNGKey(seed ^ 0x5E21EA)
+        self._cond = threading.Condition()
+        self._pending: list[Any] = [None] * num_clients
+        self._results: list[Any] = [None] * num_clients
+        self._errors: list[BaseException | None] = [None] * num_clients
+        self._events = [threading.Event() for _ in range(num_clients)]
+
+    # ------------------------------------------------------------- client
+
+    def client(self, index: int) -> Callable:
+        """A drop-in replacement for the jitted inference callable (same
+        signature per ``mode``; params/key arguments are ignored)."""
+        if not 0 <= index < self._n:
+            raise IndexError(f"client index {index} out of range 0..{self._n - 1}")
+
+        def call(params, obs, key, *rest):
+            del params  # server reads the ParamStore
+            out = self._submit(index, (jnp.asarray(obs), *rest))
+            if self._mode in ("rec", "rec_eps"):
+                actions, logp, core = out
+                return actions, logp, key, core
+            actions, logp = out
+            return actions, logp, key
+
+        return call
+
+    def _submit(self, index: int, args):
+        event = self._events[index]
+        event.clear()
+        with self._cond:
+            self._pending[index] = args
+            self._cond.notify_all()
+        while not event.wait(timeout=0.2):
+            if self._stop_event.is_set() or not self.is_alive():
+                raise ServerClosed("inference server stopped")
+        err = self._errors[index]
+        if err is not None:
+            self._errors[index] = None
+            raise err
+        result, self._results[index] = self._results[index], None
+        if result is None:
+            # The event can also fire from run()'s shutdown wakeup with
+            # neither a result nor an error written (stop raced our wait).
+            raise ServerClosed("inference server stopped")
+        return result
+
+    # ------------------------------------------------------------- server
+
+    def run(self) -> None:  # noqa: D102 — thread entry
+        try:
+            if self._device is not None:
+                with jax.default_device(self._device):
+                    self._run()
+            else:
+                self._run()
+        finally:
+            # Wake anyone still waiting so they observe the closed server.
+            for event in self._events:
+                event.set()
+
+    def _run(self) -> None:
+        while not self._stop_event.is_set():
+            batch = self._collect()
+            if batch:
+                self._serve(batch)
+
+    def _collect(self):
+        """Wait for requests; return [(client_index, args), ...] in index
+        order, clearing the pending slots."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._stop_event.is_set()
+                or any(p is not None for p in self._pending),
+                timeout=0.1,
+            )
+            if self._stop_event.is_set():
+                return []
+            deadline = time.monotonic() + self._max_wait
+            while any(p is None for p in self._pending):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop_event.is_set():
+                    break
+                self._cond.wait_for(
+                    lambda: self._stop_event.is_set()
+                    or all(p is not None for p in self._pending),
+                    timeout=remaining,
+                )
+            batch = [
+                (i, p) for i, p in enumerate(self._pending) if p is not None
+            ]
+            for i, _ in batch:
+                self._pending[i] = None
+            return batch
+
+    def _serve(self, batch) -> None:
+        indices = [i for i, _ in batch]
+        try:
+            sizes = [int(args[0].shape[0]) for _, args in batch]
+            merged = [
+                _concat([args[pos] for _, args in batch])
+                for pos in range(len(batch[0][1]))
+            ]
+            params, _ = self._store.get()
+            out = self._fn(params, merged[0], self._key, *merged[1:])
+            if self._mode in ("rec", "rec_eps"):
+                actions, logp, self._key, core = out
+            else:
+                actions, logp, self._key = out
+                core = None
+
+            offsets = np.cumsum([0] + sizes)
+            actions = np.asarray(actions)
+            logp = np.asarray(logp)
+            for (i, _), a, b in zip(batch, offsets[:-1], offsets[1:]):
+                if core is None:
+                    self._results[i] = (actions[a:b], logp[a:b])
+                else:
+                    self._results[i] = (
+                        actions[a:b], logp[a:b], _slice(core, a, b)
+                    )
+                self._events[i].set()
+        except BaseException as e:  # deliver, keep serving
+            for i in indices:
+                self._errors[i] = e
+                self._events[i].set()

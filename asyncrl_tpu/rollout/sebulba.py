@@ -165,10 +165,21 @@ def make_host_pool(config, num_envs: int, seed: int):
     )
 
 
+def inference_mode(config, model) -> str:
+    """THE (config, model) -> inference-signature mapping — the single
+    dispatch site shared by ``make_inference_fn`` (which builds the
+    callable) and the ``InferenceServer`` (which must unpack the same
+    arity): "ff" | "eps" | "rec" | "rec_eps"."""
+    recurrent = is_recurrent(model)
+    if config.algo == "qlearn":
+        return "rec_eps" if recurrent else "eps"
+    return "rec" if recurrent else "ff"
+
+
 def make_inference_fn(model, spec: EnvSpec, config: Any) -> Callable:
     """Jitted batched action selection for ``model`` (a flax module; the
-    recurrent/ff call shape is derived from it, so the wrong variant cannot
-    be built). Feed-forward: (params, obs[B], key) ->
+    signature follows ``inference_mode(config, model)``, so the wrong
+    variant cannot be built). Feed-forward: (params, obs[B], key) ->
     (actions, behaviour_logp, new_key). Recurrent (LSTM) models:
     (params, obs, key, core, done_prev) -> (..., new_core) — the core stays
     ON DEVICE across calls (only actions/logp sync to host), and is reset
@@ -182,9 +193,10 @@ def make_inference_fn(model, spec: EnvSpec, config: Any) -> Callable:
     -> (actions, logp, key, core)."""
     dist = distributions.for_config(config, spec)
     apply_fn = model.apply
+    mode = inference_mode(config, model)
 
-    if config.algo == "qlearn":
-        if is_recurrent(model):
+    if mode in ("eps", "rec_eps"):
+        if mode == "rec_eps":
 
             @jax.jit
             def infer_eps_recurrent(params, obs, key, core, done_prev, eps):
@@ -215,7 +227,7 @@ def make_inference_fn(model, spec: EnvSpec, config: Any) -> Callable:
 
         return infer_eps
 
-    if is_recurrent(model):
+    if mode == "rec":
 
         @jax.jit
         def infer_recurrent(params, obs, key, core, done_prev):
@@ -296,7 +308,10 @@ class ActorThread(threading.Thread):
             else:
                 self._run()
         except BaseException as e:  # report, don't die silently (§5.3)
-            self.errors.put((self.index, e))
+            # ...unless the run is shutting down: an inference call (or
+            # server client) interrupted by stop() is not a failure.
+            if not self.stop_event.is_set():
+                self.errors.put((self.index, e))
         finally:
             close = getattr(self.pool, "close", None)
             if close is not None:

@@ -90,6 +90,11 @@ class Config:
     actor_threads: int = 2  # host actor threads; each owns num_envs/threads
     queue_capacity: int = 0  # actor→learner queue bound; 0 = 2*actor_threads
     host_pool: str = "auto"  # "auto" | "native" | "gym" | "jax"
+    # Shared inference server (rollout/inference_server.py): coalesce every
+    # actor thread's action-selection query into ONE batched device call per
+    # env step (the podracer inference-thread design). Pays off with many
+    # threads and/or a high-latency device link; off = per-thread dispatch.
+    inference_server: bool = False
 
     # --- runtime ---
     seed: int = 0
