@@ -35,6 +35,11 @@ from asyncrl_tpu.models.networks import is_recurrent
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.ops.losses import a3c_loss, impala_loss, ppo_loss
 from asyncrl_tpu.parallel.mesh import TIME_AXIS, dp_axes
+from asyncrl_tpu.parallel.timeshard import (
+    gae_timesharded,
+    n_step_returns_timesharded,
+    vtrace_timesharded,
+)
 from asyncrl_tpu.rollout.buffer import Rollout
 from asyncrl_tpu.utils.config import Config
 
@@ -108,6 +113,69 @@ def rollout_sharding(mesh: Mesh, rollout: Rollout) -> Rollout:
             else jax.tree.map(lambda _: batch_first, rollout.init_core)
         ),
     )
+
+
+def _algo_loss_timesharded(
+    config: Config, apply_fn, params, rollout: Rollout, *, reduce_axes, dist
+):
+    """Time-sharded variant of ``learner._algo_loss``: runs inside shard_map
+    with the fragment's T dim sharded over ``TIME_AXIS`` (SURVEY.md §5.7).
+    Every input is the LOCAL [T_local, B_local] segment; the reverse
+    recurrences run as two-level distributed scans with one-hop ``ppermute``
+    boundary exchanges (parallel/timeshard.py). Returned loss/metrics are
+    local means — the caller pmean's them over ``reduce_axes`` (which
+    includes the time axis), and equal-sized shards make that the global
+    mean."""
+    logits_t, values_t = apply_fn(params, rollout.obs)
+    # ``bootstrap_obs`` is replicated over the time axis; every shard
+    # computes the (tiny) bootstrap forward, only the last consumes it.
+    _, bootstrap_value = apply_fn(params, rollout.bootstrap_obs)
+    bootstrap_value = jax.lax.stop_gradient(bootstrap_value)
+    discounts = rollout.discounts(config.gamma)
+
+    if config.algo == "a3c":
+        returns = n_step_returns_timesharded(
+            rollout.rewards, discounts, bootstrap_value
+        )
+        return a3c_loss(
+            logits_t, values_t, rollout.actions, rollout.rewards, discounts,
+            bootstrap_value, value_coef=config.value_coef,
+            entropy_coef=config.entropy_coef, dist=dist, returns=returns,
+        )
+    if config.algo == "impala":
+        target_logp = dist.logp(logits_t, rollout.actions)
+        vt = vtrace_timesharded(
+            rollout.behaviour_logp, target_logp, rollout.rewards, discounts,
+            jax.lax.stop_gradient(values_t), bootstrap_value,
+            rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
+        )
+        # rho_clip_frac comes back already pmean'd over the time axis
+        # (sp-invariant); re-mark it sp-varying so the caller's uniform
+        # pmean over (dp axes + sp) is legal under vma tracking.
+        vt = vt._replace(
+            rho_clip_frac=jax.lax.pcast(
+                vt.rho_clip_frac, TIME_AXIS, to="varying"
+            )
+        )
+        return impala_loss(
+            logits_t, values_t, rollout.actions, rollout.behaviour_logp,
+            rollout.rewards, discounts, bootstrap_value,
+            value_coef=config.value_coef, entropy_coef=config.entropy_coef,
+            rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
+            dist=dist, vtrace_out=vt,
+        )
+    if config.algo == "ppo":
+        adv = gae_timesharded(
+            rollout.rewards, discounts, jax.lax.stop_gradient(values_t),
+            bootstrap_value, config.gae_lambda,
+        )
+        return ppo_loss(
+            logits_t, values_t, rollout.actions, rollout.behaviour_logp,
+            adv.advantages, adv.returns, clip_eps=config.ppo_clip_eps,
+            value_coef=config.value_coef, entropy_coef=config.entropy_coef,
+            axis_name=reduce_axes, dist=dist,
+        )
+    raise ValueError(f"unknown algo {config.algo!r} for time sharding")
 
 
 class RolloutLearner:

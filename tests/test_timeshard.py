@@ -184,3 +184,58 @@ def test_gae_timesharded_matches_single_device(devices):
         np.asarray(sharded.returns), np.asarray(want.returns),
         rtol=1e-5, atol=1e-6,
     )
+
+
+@pytest.mark.parametrize("algo", ["a3c", "impala", "ppo"])
+def test_rollout_learner_timesharded_equals_dp_only(algo, devices):
+    """The HOST-FRAGMENT learner on a (dp x sp) mesh must produce the same
+    post-update params as on a dp-only mesh — the end-to-end check that the
+    time-sharded loss glue (rollout_learner._algo_loss_timesharded) matches
+    the unsharded path (regression: this glue was once referenced but
+    undefined, so any sp>1 mesh crashed with NameError at trace time)."""
+    from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.learn.rollout_learner import RolloutLearner
+    from asyncrl_tpu.models.networks import build_model
+    from asyncrl_tpu.rollout.buffer import Rollout
+    from asyncrl_tpu.utils.config import Config
+
+    cfg = Config(
+        algo=algo, unroll_len=8, num_envs=8, precision="f32",
+        ppo_epochs=1, ppo_minibatches=1,
+    )
+    env = CartPole()
+    model = build_model(cfg, env.spec)
+
+    T, B = 8, 8
+    rng = np.random.default_rng(0)
+    ro = Rollout(
+        obs=jnp.asarray(rng.normal(size=(T, B, 4)).astype(np.float32)),
+        actions=jnp.asarray(rng.integers(0, 2, (T, B)).astype(np.int32)),
+        behaviour_logp=jnp.asarray(
+            rng.normal(-0.7, 0.1, (T, B)).astype(np.float32)
+        ),
+        rewards=jnp.asarray(rng.normal(size=(T, B)).astype(np.float32)),
+        terminated=jnp.asarray(rng.uniform(size=(T, B)) < 0.1),
+        truncated=jnp.zeros((T, B), bool),
+        bootstrap_obs=jnp.asarray(rng.normal(size=(B, 4)).astype(np.float32)),
+    )
+
+    results = {}
+    for name, shape, axes in [
+        ("dp", (8,), ("dp",)),
+        ("dp_sp", (2, 4), ("dp", "sp")),
+    ]:
+        mesh = make_mesh(shape, axes)
+        learner = RolloutLearner(cfg, env.spec, model, mesh)
+        state = learner.init_state(0)
+        state, metrics = learner.update(state, learner.put_rollout(ro))
+        results[name] = (
+            jax.tree.leaves(jax.device_get(state.params)),
+            float(metrics["loss"]),
+        )
+
+    for a, b in zip(results["dp"][0], results["dp_sp"][0]):
+        np.testing.assert_allclose(a, b, rtol=5e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        results["dp"][1], results["dp_sp"][1], rtol=5e-5
+    )
