@@ -117,6 +117,11 @@ class PopulationTrainer:
         else:
             import optax
 
+            if config.lr_schedule != "constant":
+                raise NotImplementedError(
+                    "per-member learning_rates and lr_schedule are mutually "
+                    "exclusive (the injected rate is a constant per member)"
+                )
             # Same chain as make_optimizer, but with the adam step's rate
             # injected through opt_state so it can differ per member.
             self.optimizer = optax.chain(

@@ -87,10 +87,46 @@ def state_partition_spec(axes: tuple[str, ...]) -> TrainState:
     )
 
 
+def _total_optimizer_steps(config: Config) -> int:
+    """Projected count of ``optimizer.update`` calls over a full run — the
+    LR schedule's horizon. optax schedules tick once per optimizer call, so
+    this must model the configured backend and algorithm:
+
+    - Anakin consumes ``num_envs * unroll_len`` frames per learner update;
+      the host backends (sebulba/cpu_async) consume one ACTOR's fragment,
+      ``(num_envs / actor_threads) * unroll_len``, per update;
+    - multipass PPO takes ``ppo_epochs * ppo_minibatches`` optimizer steps
+      inside each learner update.
+    """
+    frames_per_update = config.batch_steps_per_update
+    if config.backend in ("sebulba", "cpu_async"):
+        frames_per_update //= max(config.actor_threads, 1)
+    updates = max(1, config.total_env_steps // max(frames_per_update, 1))
+    if config.algo == "ppo":
+        updates *= max(1, config.ppo_epochs) * max(1, config.ppo_minibatches)
+    return updates
+
+
 def make_optimizer(config: Config) -> optax.GradientTransformation:
+    """Global-norm clip + Adam, with the configured LR schedule. The
+    schedule is indexed by Adam's own update count; its horizon is the
+    projected optimizer-step total for this backend/algorithm
+    (``_total_optimizer_steps``), so "linear" reaches zero at the run's
+    step budget — not a fraction of the way through it."""
+    if config.lr_schedule == "constant":
+        lr = config.learning_rate
+    elif config.lr_schedule == "linear":
+        lr = optax.linear_schedule(
+            config.learning_rate, 0.0, _total_optimizer_steps(config)
+        )
+    else:
+        raise ValueError(
+            f"unknown lr_schedule {config.lr_schedule!r}; "
+            "expected constant|linear"
+        )
     return optax.chain(
         optax.clip_by_global_norm(config.max_grad_norm),
-        optax.adam(config.learning_rate, eps=config.adam_eps),
+        optax.adam(lr, eps=config.adam_eps),
     )
 
 

@@ -145,7 +145,19 @@ class ActorCritic(nn.Module):
 def _q_head(module: nn.Module, h: jax.Array) -> jax.Array:
     """Shared Q head for the (Recurrent)QNetwork pair: one Q-value per
     action, f32 regardless of compute dtype (same drift-prevention role as
-    ``_apply_heads`` for the actor-critic pair)."""
+    ``_apply_heads`` for the actor-critic pair).
+
+    ``module.dueling`` switches to the dueling decomposition (Wang et al.
+    2016): Q(s,a) = V(s) + A(s,a) - mean_a A(s,a) — separate value and
+    advantage streams, identifiable via the mean-advantage constraint."""
+    if getattr(module, "dueling", False):
+        value = nn.Dense(
+            1, dtype=jnp.float32, kernel_init=ORTHO(1.0)
+        )(h).astype(jnp.float32)
+        adv = nn.Dense(
+            module.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01)
+        )(h).astype(jnp.float32)
+        return value + adv - jnp.mean(adv, axis=-1, keepdims=True)
     return nn.Dense(
         module.num_actions, dtype=jnp.float32, kernel_init=ORTHO(0.01)
     )(h).astype(jnp.float32)
@@ -174,6 +186,7 @@ class QNetwork(nn.Module):
     channels: Sequence[int] = (16, 32, 32)
     compute_dtype: jnp.dtype = jnp.float32
     obs_rank: int = 1
+    dueling: bool = False
 
     @nn.compact
     def __call__(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -236,6 +249,7 @@ class RecurrentQNetwork(nn.Module):
     core_size: int = 256
     compute_dtype: jnp.dtype = jnp.float32
     obs_rank: int = 1
+    dueling: bool = False
 
     @nn.compact
     def __call__(self, obs, core):
@@ -280,6 +294,7 @@ def build_model(config, env_spec):
             channels=tuple(config.channels),
             compute_dtype=compute_dtype,
             obs_rank=len(env_spec.obs_shape),
+            dueling=config.dueling,
         )
         if config.core == "lstm":
             return RecurrentQNetwork(core_size=config.core_size, **q_common)

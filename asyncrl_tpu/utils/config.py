@@ -46,6 +46,9 @@ class Config:
 
     # --- optimization ---
     learning_rate: float = 3e-4
+    # "constant", or "linear": anneal from learning_rate to 0 over the run's
+    # total_env_steps (the IMPALA recipe for its Atari/DMLab suites).
+    lr_schedule: str = "constant"
     adam_eps: float = 1e-8
     max_grad_norm: float = 0.5
     gamma: float = 0.99
@@ -71,7 +74,7 @@ class Config:
     ppo_epochs: int = 4
     ppo_minibatches: int = 4
 
-    # --- qlearn (async n-step Q-learning; Anakin backend) ---
+    # --- qlearn (async n-step Q-learning) ---
     # Double-Q bootstrap: argmax under the online net, value under the
     # target net (the stale actor_params copy; actor_staleness is the
     # target-update period for this algo).
@@ -81,6 +84,9 @@ class Config:
     eps_base: float = 0.4
     eps_alpha: float = 7.0
     exploration_steps: int = 100_000
+    # Dueling Q decomposition (Wang et al. 2016): separate value/advantage
+    # streams, Q = V + A - mean(A).
+    dueling: bool = False
 
     # --- parallelism ---
     mesh_shape: tuple[int, ...] = (-1,)  # -1: all local devices on axis "dp"

@@ -298,6 +298,112 @@ def test_population_runs_qlearn():
         pop.close()
 
 
+def test_dueling_head_structure_and_update():
+    """Dueling decomposition: Q has separate value/advantage streams whose
+    advantages are mean-zero, and the fused update runs end to end."""
+    from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.models.networks import build_model
+
+    cfg = presets.get("cartpole_qlearn").replace(
+        num_envs=8, unroll_len=4, dueling=True, precision="f32"
+    )
+    env = CartPole()
+    model = build_model(cfg, env.spec)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    # Two head streams: a 1-unit value Dense exists only in dueling mode.
+    def head_widths(p):
+        return {
+            name: leaf["kernel"].shape[-1]
+            for name, leaf in p["params"].items()
+            if name.startswith("Dense")
+        }
+
+    widths = head_widths(params)
+    assert 1 in widths.values(), f"no value stream: {widths}"
+    adv_layers = [n for n, w in widths.items() if w == env.spec.num_actions]
+    assert adv_layers, f"no advantage stream: {widths}"
+    plain = build_model(cfg.replace(dueling=False), env.spec)
+    plain_widths = head_widths(plain.init(jax.random.PRNGKey(0), jnp.zeros((1, 4))))
+    assert 1 not in plain_widths.values()
+
+    # The combine must use BOTH streams: zeroing the advantage stream makes
+    # Q constant across actions (Q = V + A - mean(A) with A ≡ 0 => Q = V),
+    # while still varying across states (the value stream).
+    import flax
+
+    zeroed = flax.core.unfreeze(params)
+    for layer in adv_layers:
+        zeroed["params"][layer] = jax.tree.map(
+            jnp.zeros_like, zeroed["params"][layer]
+        )
+    obs = jax.random.normal(jax.random.PRNGKey(1), (16, 4))
+    q0, _ = model.apply(zeroed, obs)
+    np.testing.assert_allclose(
+        np.asarray(q0.max(-1) - q0.min(-1)), 0.0, atol=1e-6
+    )
+    assert float(np.std(np.asarray(q0[:, 0]))) > 1e-4
+    # ...and with the advantage stream live, Q varies across actions.
+    q, _ = model.apply(params, obs)
+    assert float(np.max(np.asarray(q.max(-1) - q.min(-1)))) > 1e-5
+
+    agent = make_agent(cfg)
+    try:
+        state, metrics = agent.learner.update(agent.state)
+        assert np.isfinite(float(metrics["loss"]))
+    finally:
+        agent.close()
+
+
+def test_linear_lr_schedule_decays_updates():
+    """With lr_schedule='linear' the same gradient produces shrinking Adam
+    steps as update_step advances; an unknown schedule fails fast."""
+    from asyncrl_tpu.utils.config import Config
+
+    cfg = presets.get("cartpole_a3c").replace(
+        num_envs=8, unroll_len=4, total_env_steps=8 * 4 * 10,
+        lr_schedule="linear", precision="f32", max_grad_norm=1e9,
+    )
+    agent = make_agent(cfg)
+    try:
+        leaf0 = np.asarray(jax.tree.leaves(agent.state.params)[0])
+        state = agent.state
+        deltas = []
+        prev = leaf0
+        for _ in range(10):
+            state, _ = agent.learner.update(state)
+            cur = np.asarray(jax.tree.leaves(state.params)[0])
+            deltas.append(float(np.abs(cur - prev).sum()))
+            prev = cur
+        # The LAST step (lr ~ 0) must be far smaller than the first.
+        assert deltas[-1] < deltas[0] * 0.2, deltas
+    finally:
+        agent.close()
+
+    with pytest.raises(ValueError, match="unknown lr_schedule"):
+        make_agent(Config(lr_schedule="cosine", num_envs=8, unroll_len=4))
+
+
+def test_lr_schedule_horizon_models_backend_and_algo():
+    """The schedule horizon must count OPTIMIZER steps: multipass PPO takes
+    epochs*minibatches per update, host backends consume one actor's
+    fragment per update — miscounting either anneals lr to zero early."""
+    from asyncrl_tpu.learn.learner import _total_optimizer_steps
+    from asyncrl_tpu.utils.config import Config
+
+    base = Config(num_envs=64, unroll_len=10, total_env_steps=64_000)
+    assert _total_optimizer_steps(base) == 100  # anakin a3c: frames/update
+    assert (
+        _total_optimizer_steps(base.replace(algo="ppo", ppo_epochs=4,
+                                            ppo_minibatches=4))
+        == 100 * 16
+    )
+    assert (
+        _total_optimizer_steps(base.replace(backend="sebulba",
+                                            actor_threads=4))
+        == 400
+    )
+
+
 def test_drqn_anakin_update_and_eval(devices):
     """Recurrent (DRQN) Q-learning: the LSTM carry rides the rollout scan,
     the target net re-forwards the fragment from the stored behaviour carry,
