@@ -118,6 +118,10 @@ class PopulationTrainer:
             import optax
 
             if config.lr_schedule != "constant":
+                # Validate the schedule string FIRST (make_optimizer raises
+                # the precise error for unknown values), so a typo isn't
+                # misreported as a feature conflict.
+                make_optimizer(config)
                 raise NotImplementedError(
                     "per-member learning_rates and lr_schedule are mutually "
                     "exclusive (the injected rate is a constant per member)"
@@ -145,6 +149,7 @@ class PopulationTrainer:
             opt_state=P(axes),
             actor=P(axes),
             update_step=P(axes),
+            obs_stats=P(axes),
         )
         self._step = jax.jit(
             jax.shard_map(
@@ -206,12 +211,19 @@ class PopulationTrainer:
             self.env, cfg.num_envs, jax.random.split(akey, 1)[0],
             model=self.model,
         )
+        from asyncrl_tpu.ops.normalize import init_stats
+
         return TrainState(
             params=params,
             actor_params=params,
             opt_state=opt_state,
             actor=actor,
             update_step=jnp.zeros((), jnp.int32),
+            obs_stats=(
+                init_stats(self.env.spec.obs_shape)
+                if cfg.normalize_obs
+                else None
+            ),
         )
 
     def _init_population(self, base_seed: int) -> TrainState:

@@ -251,6 +251,14 @@ class SebulbaTrainer:
         """
         cfg = self.config
         target = total_env_steps or cfg.total_env_steps
+        if cfg.lr_schedule != "constant" and target > cfg.total_env_steps:
+            raise ValueError(
+                f"train(total_env_steps={target}) exceeds the "
+                f"lr_schedule horizon (config.total_env_steps="
+                f"{cfg.total_env_steps}): the annealed rate would sit at 0 "
+                "for the excess steps. Set config.total_env_steps to the "
+                "real budget instead."
+            )
         steps_per_fragment = self._envs_per_actor * cfg.unroll_len
         history: list[dict[str, Any]] = []
 

@@ -85,6 +85,14 @@ class Trainer:
         """
         cfg = self.config
         target = total_env_steps or cfg.total_env_steps
+        if cfg.lr_schedule != "constant" and target > cfg.total_env_steps:
+            raise ValueError(
+                f"train(total_env_steps={target}) exceeds the "
+                f"lr_schedule horizon (config.total_env_steps="
+                f"{cfg.total_env_steps}): the annealed rate would sit at 0 "
+                "for the excess steps. Set config.total_env_steps to the "
+                "real budget instead."
+            )
         steps_per_update = cfg.batch_steps_per_update * cfg.updates_per_call
         history: list[dict[str, Any]] = []
 
@@ -167,7 +175,12 @@ class Trainer:
             dist = distributions.for_config(self.config, env.spec)
             recurrent = is_recurrent(model)
 
-            def eval_rollout(params, key):
+            def eval_rollout(params, obs_stats, key):
+                # Greedy eval must see the same normalized observations the
+                # policy trained on (ops/normalize.py; identity when None).
+                from asyncrl_tpu.ops.normalize import normalizing_apply
+
+                napply = normalizing_apply(apply_fn, obs_stats)
                 init_keys = jax.random.split(key, num_episodes + 1)
                 env_state = jax.vmap(env.init)(init_keys[:-1])
                 obs = jax.vmap(env.observe)(env_state)
@@ -177,9 +190,9 @@ class Trainer:
                 def body(carry, _):
                     env_state, obs, ret, alive, k, core = carry
                     if recurrent:
-                        dist_params, _, core = apply_fn(params, obs, core)
+                        dist_params, _, core = napply(params, obs, core)
                     else:
-                        dist_params, _ = apply_fn(params, obs)
+                        dist_params, _ = napply(params, obs)
                     actions = dist.mode(dist_params)
                     k, sub = jax.random.split(k)
                     step_keys = jax.random.split(sub, num_episodes)
@@ -201,7 +214,7 @@ class Trainer:
 
             self._eval_fns[cache_key] = jax.jit(eval_rollout)
         returns = self._eval_fns[cache_key](
-            self.state.params, jax.random.PRNGKey(seed)
+            self.state.params, self.state.obs_stats, jax.random.PRNGKey(seed)
         )
         if return_episodes:
             import numpy as np

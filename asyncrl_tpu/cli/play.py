@@ -124,10 +124,16 @@ def _dump_trajectory(agent, cfg, path: str, max_steps: int) -> None:
             "--save with recurrent cores is not wired yet; use a ff preset"
         )
 
+    from asyncrl_tpu.ops.normalize import normalizing_apply
+
+    napply = normalizing_apply(
+        model.apply, getattr(agent.state, "obs_stats", None)
+    )
+
     def body(carry, _):
         env_state, obs, done, key = carry
         key, step_key = jax.random.split(key)
-        dist_params, _ = model.apply(params, obs[None])
+        dist_params, _ = napply(params, obs[None])
         action = dist.mode(dist_params)[0]
         new_state, ts = env.step(env_state, action, step_key)
         # Freeze the trajectory after the first episode end.

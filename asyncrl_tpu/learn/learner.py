@@ -24,6 +24,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from asyncrl_tpu.envs.core import Environment
 from asyncrl_tpu.ops.gae import gae
+from asyncrl_tpu.ops.normalize import (
+    init_stats,
+    normalizing_apply,
+    update_stats,
+)
 from asyncrl_tpu.models.networks import is_recurrent, reset_core
 from asyncrl_tpu.ops.losses import (
     a3c_loss,
@@ -63,7 +68,9 @@ class TrainState:
 
     ``params`` are the learner weights; ``actor_params`` the stale copy the
     rollout uses (equal for on-policy algos, lagged for IMPALA). ``actor``
-    holds env states/obs/keys, sharded over the dp axis.
+    holds env states/obs/keys, sharded over the dp axis. ``obs_stats`` is
+    the running observation-normalization state (ops/normalize.py) — None
+    (empty subtree) unless ``config.normalize_obs``.
     """
 
     params: Any
@@ -71,6 +78,7 @@ class TrainState:
     opt_state: Any
     actor: ActorState
     update_step: jax.Array  # int32 scalar
+    obs_stats: Any = None
 
 
 def state_partition_spec(axes: tuple[str, ...]) -> TrainState:
@@ -84,6 +92,7 @@ def state_partition_spec(axes: tuple[str, ...]) -> TrainState:
         opt_state=P(),
         actor=P(axes),
         update_step=P(),
+        obs_stats=P(),
     )
 
 
@@ -498,6 +507,10 @@ def make_train_step(
         # reproduce exactly. None everywhere else.
         # named_scope: sections show up as labeled blocks in jax.profiler
         # traces (SURVEY.md §5.1; CLI --profile).
+        # Observation normalization: behaviour, learner, and (this step's)
+        # target forwards all see the SAME pre-update stats; the stats fold
+        # in this rollout's observations afterwards, for the next step.
+        napply = normalizing_apply(apply_fn, state.obs_stats)
         dist_extra = None
         if qlearn:
             # ε rides the dist_params channel (ops.distributions
@@ -508,7 +521,7 @@ def make_train_step(
             dist_extra = eps[:, None]
         with jax.named_scope("rollout"):
             actor, rollout, stats = unroll(
-                apply_fn, state.actor_params, env, state.actor,
+                napply, state.actor_params, env, state.actor,
                 config.unroll_len, dist=dist, reward_scale=config.reward_scale,
                 dist_extra=dist_extra,
             )
@@ -516,7 +529,7 @@ def make_train_step(
         if ppo_multipass:
             with jax.named_scope("ppo_multipass"):
                 params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
-                    config, apply_fn, optimizer, dist,
+                    config, napply, optimizer, dist,
                     state.params, state.opt_state, rollout, state.update_step,
                     axes=axes, member_seed=member_seed,
                 )
@@ -531,7 +544,7 @@ def make_train_step(
             # 8-device CPU mesh, tests/test_learner).
             def scaled_loss(p):
                 loss, metrics = _algo_loss(
-                    config, apply_fn, p, rollout,
+                    config, napply, p, rollout,
                     axis_name=axes or None, dist=dist,
                     target_params=state.actor_params,
                 )
@@ -570,6 +583,11 @@ def make_train_step(
             # minimum true-IMPALA staleness.
             actor_params = params
 
+        obs_stats = state.obs_stats
+        if obs_stats is not None:
+            with jax.named_scope("obs_stats"):
+                obs_stats = update_stats(obs_stats, rollout.obs, axes)
+
         metrics = dict(metrics)
         metrics["loss"] = loss
         metrics["grad_norm"] = grad_norm
@@ -583,6 +601,7 @@ def make_train_step(
             opt_state=opt_state,
             actor=actor,
             update_step=step,
+            obs_stats=obs_stats,
         )
         return new_state, metrics
 
@@ -679,25 +698,23 @@ class Learner:
             )
         )(per_device_keys)
 
-        state = TrainState(
-            params=params,
-            actor_params=params,
-            opt_state=opt_state,
-            actor=actor,
-            update_step=jnp.zeros((), jnp.int32),
+        obs_stats = (
+            init_stats(self.env.spec.obs_shape) if cfg.normalize_obs else None
         )
         # Place replicated leaves explicitly on the mesh.
         from jax.sharding import NamedSharding
 
         rep = NamedSharding(self.mesh, P())
-        state = TrainState(
-            params=jax.device_put(state.params, rep),
-            actor_params=jax.device_put(state.actor_params, rep),
-            opt_state=jax.device_put(state.opt_state, rep),
-            actor=state.actor,
-            update_step=jax.device_put(state.update_step, rep),
+        return TrainState(
+            params=jax.device_put(params, rep),
+            actor_params=jax.device_put(params, rep),
+            opt_state=jax.device_put(opt_state, rep),
+            actor=actor,
+            update_step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+            obs_stats=(
+                None if obs_stats is None else jax.device_put(obs_stats, rep)
+            ),
         )
-        return state
 
     def update(self, state: TrainState):
         """One train step: rollout + loss + pmean(grads) + Adam. Donates

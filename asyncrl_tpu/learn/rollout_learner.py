@@ -188,6 +188,13 @@ class RolloutLearner:
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
         validate_qlearn_config(config)
+        if config.normalize_obs:
+            raise NotImplementedError(
+                "normalize_obs is Anakin-only (backend='tpu'): the host "
+                "backends would need the stats published to actor-side "
+                "inference alongside the params; use reward_scale or "
+                "normalize on the env side for host pools"
+            )
         time_sharded = TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1
         if time_sharded:
             sp = mesh.shape[TIME_AXIS]

@@ -1,0 +1,89 @@
+"""Running observation normalization (the VecNormalize / Brax-PPO recipe).
+
+Continuous-control observations span wildly different scales per dimension
+(joint angles vs velocities); normalizing to running mean/unit-variance is
+the standard fix. TPU-first shape: the statistics are a tiny pytree riding
+``TrainState`` (checkpointed like everything else), updated INSIDE the
+fused train step from each rollout's observations with one ``psum`` of
+(count, sum, sum-of-squares) over the data-parallel axes — every shard
+then holds identical global stats, no host round trips.
+
+Moment accumulation uses plain (count, mean, m2) in f64-free form: m2 is
+the sum of squared deviations (Chan et al.'s parallel update), numerically
+safe for the episode counts RL runs see.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RunningStats(NamedTuple):
+    count: jax.Array  # f32 scalar (soft count; starts at ~1 for stability)
+    mean: jax.Array  # [*obs_shape] f32
+    m2: jax.Array  # [*obs_shape] f32 — sum of squared deviations
+
+
+def init_stats(obs_shape) -> RunningStats:
+    return RunningStats(
+        count=jnp.ones((), jnp.float32),  # epsilon-count: var defined at t=0
+        mean=jnp.zeros(obs_shape, jnp.float32),
+        m2=jnp.ones(obs_shape, jnp.float32),
+    )
+
+
+def update_stats(stats: RunningStats, obs: jax.Array, axes=()) -> RunningStats:
+    """Fold a batch of observations (ANY leading dims) into the stats.
+
+    ``axes``: mesh axis name(s) to ``psum`` the batch moments over, so every
+    shard folds the GLOBAL batch (pass ``()`` outside shard_map / in
+    population mode)."""
+    obs_dims = stats.mean.ndim
+    batch_dims = tuple(range(obs.ndim - obs_dims))
+    x = obs.astype(jnp.float32)
+
+    n = 1
+    for d in batch_dims:  # static shapes: a Python int at trace time
+        n *= x.shape[d]
+    b_count = jnp.asarray(float(n), jnp.float32)
+    b_sum = jnp.sum(x, axis=batch_dims)
+    b_sumsq = jnp.sum(jnp.square(x), axis=batch_dims)
+    if axes:
+        b_count = jax.lax.psum(b_count, axes)
+        b_sum = jax.lax.psum(b_sum, axes)
+        b_sumsq = jax.lax.psum(b_sumsq, axes)
+
+    b_mean = b_sum / b_count
+    b_m2 = b_sumsq - b_count * jnp.square(b_mean)
+
+    # Chan parallel merge of (count, mean, m2) pairs.
+    delta = b_mean - stats.mean
+    total = stats.count + b_count
+    mean = stats.mean + delta * (b_count / total)
+    m2 = stats.m2 + b_m2 + jnp.square(delta) * stats.count * b_count / total
+    return RunningStats(count=total, mean=mean, m2=m2)
+
+
+def normalize(obs: jax.Array, stats: RunningStats, clip: float = 10.0):
+    """(obs - mean) / std, clipped to ±``clip`` (the VecNormalize guard
+    against early-run outliers)."""
+    var = stats.m2 / stats.count
+    inv_std = jax.lax.rsqrt(jnp.maximum(var, 1e-8))
+    scaled = (obs.astype(jnp.float32) - stats.mean) * inv_std
+    return jnp.clip(scaled, -clip, clip)
+
+
+def normalizing_apply(apply_fn, stats: RunningStats | None):
+    """Wrap a model apply so observations are normalized with ``stats``
+    first (identity wrapper when stats is None). Works for every apply
+    arity (ff / recurrent): obs is always the second positional arg."""
+    if stats is None:
+        return apply_fn
+
+    def wrapped(params, obs, *rest):
+        return apply_fn(params, normalize(obs, stats), *rest)
+
+    return wrapped

@@ -63,6 +63,11 @@ class Config:
     # it the value loss dwarfs the policy gradient under grad-norm clipping.
     # Brax's PPO does the same for Ant/Humanoid (BASELINE.json:11).
     reward_scale: float = 1.0
+    # Running observation normalization (the VecNormalize / Brax-PPO recipe,
+    # ops/normalize.py): stats ride the TrainState, update inside the fused
+    # step (psum'd over the mesh), and normalize the actor's, learner's, and
+    # eval's model inputs alike. Anakin backend only.
+    normalize_obs: bool = False
 
     # --- IMPALA / V-trace ---
     vtrace_rho_clip: float = 1.0

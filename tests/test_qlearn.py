@@ -383,6 +383,21 @@ def test_linear_lr_schedule_decays_updates():
         make_agent(Config(lr_schedule="cosine", num_envs=8, unroll_len=4))
 
 
+def test_linear_schedule_rejects_budget_overrun():
+    """Training past the schedule horizon would silently run at lr=0; the
+    trainer must refuse instead."""
+    cfg = presets.get("cartpole_a3c").replace(
+        num_envs=8, unroll_len=4, total_env_steps=8 * 4 * 5,
+        lr_schedule="linear", precision="f32",
+    )
+    agent = make_agent(cfg)
+    try:
+        with pytest.raises(ValueError, match="lr_schedule horizon"):
+            agent.train(total_env_steps=8 * 4 * 50)
+    finally:
+        agent.close()
+
+
 def test_lr_schedule_horizon_models_backend_and_algo():
     """The schedule horizon must count OPTIMIZER steps: multipass PPO takes
     epochs*minibatches per update, host backends consume one actor's
