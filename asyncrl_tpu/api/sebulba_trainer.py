@@ -105,7 +105,7 @@ class SebulbaTrainer:
         self._initial_core = (
             self.model.initial_core if is_recurrent(self.model) else None
         )
-        self._store = ParamStore(self.state.params)
+        self._store = ParamStore(self._published(self.state))
         cap = config.queue_capacity or 2 * config.actor_threads
         self._queue: "queue.Queue[Fragment]" = queue.Queue(maxsize=cap)
         self._errors: "queue.Queue[tuple[int, BaseException]]" = queue.Queue()
@@ -118,6 +118,13 @@ class SebulbaTrainer:
         self._next_actor_seed = config.seed * 7919 + 1
         self._actor_device = None  # CpuAsyncTrainer pins actors to host CPU
         self._server = None  # shared inference server (config.inference_server)
+
+    def _published(self, state):
+        """What actors act under: the params, bundled with the obs-
+        normalization stats when enabled (make_inference_fn unpacks)."""
+        if self.config.normalize_obs:
+            return (state.params, state.obs_stats)
+        return state.params
 
     # --------------------------------------------------------------- actors
 
@@ -249,16 +256,11 @@ class SebulbaTrainer:
         Metric dicts match ``Trainer.train``'s contract (env_steps, fps,
         episode_return/length/count + loss terms).
         """
+        from asyncrl_tpu.learn.learner import validate_train_target
+
         cfg = self.config
         target = total_env_steps or cfg.total_env_steps
-        if cfg.lr_schedule != "constant" and target > cfg.total_env_steps:
-            raise ValueError(
-                f"train(total_env_steps={target}) exceeds the "
-                f"lr_schedule horizon (config.total_env_steps="
-                f"{cfg.total_env_steps}): the annealed rate would sit at 0 "
-                "for the excess steps. Set config.total_env_steps to the "
-                "real budget instead."
-            )
+        validate_train_target(cfg, target)
         steps_per_fragment = self._envs_per_actor * cfg.unroll_len
         history: list[dict[str, Any]] = []
 
@@ -299,7 +301,7 @@ class SebulbaTrainer:
 
                 self._updates += 1
                 if self._updates % max(cfg.actor_staleness, 1) == 0:
-                    self._store.publish(self.state.params)
+                    self._store.publish(self._published(self.state))
                 self._ckpt.after_update(self.state, self.env_steps)
 
                 if len(pending) >= cfg.log_every or self.env_steps >= target:
@@ -355,19 +357,26 @@ class SebulbaTrainer:
         if recurrent:
 
             @jax.jit
-            def greedy_rec(params, obs, core, done_prev):
+            def greedy_rec(params, obs_stats, obs, core, done_prev):
+                from asyncrl_tpu.ops.normalize import normalizing_apply
+
+                napply = normalizing_apply(apply_fn, obs_stats)
                 core = reset_core(core, done_prev)
-                dist_params, _, core = apply_fn(params, obs, core)
+                dist_params, _, core = napply(params, obs, core)
                 return dist.mode(dist_params), core
 
         else:
 
             @jax.jit
-            def greedy(params, obs):
-                dist_params, _ = apply_fn(params, obs)
+            def greedy(params, obs_stats, obs):
+                from asyncrl_tpu.ops.normalize import normalizing_apply
+
+                napply = normalizing_apply(apply_fn, obs_stats)
+                dist_params, _ = napply(params, obs)
                 return dist.mode(dist_params)
 
         params = self.state.params
+        obs_stats = self.state.obs_stats
         core = self.model.initial_core(num_episodes) if recurrent else None
         done_prev = np.zeros((num_episodes,), bool)
         try:
@@ -377,10 +386,12 @@ class SebulbaTrainer:
             final_return = np.zeros((num_episodes,), np.float64)
             for _ in range(max_steps):
                 if recurrent:
-                    actions_d, core = greedy_rec(params, obs, core, done_prev)
+                    actions_d, core = greedy_rec(
+                        params, obs_stats, obs, core, done_prev
+                    )
                     actions = np.asarray(actions_d)
                 else:
-                    actions = np.asarray(greedy(params, obs))
+                    actions = np.asarray(greedy(params, obs_stats, obs))
                 obs, rew, term, trunc = pool.step(actions)
                 done_prev = np.logical_or(term, trunc)
                 ep_return += np.where(finished, 0.0, rew)

@@ -83,16 +83,11 @@ class Trainer:
         including ``env_steps``, ``fps``, and ``episode_return`` (mean over
         episodes completed in the window).
         """
+        from asyncrl_tpu.learn.learner import validate_train_target
+
         cfg = self.config
         target = total_env_steps or cfg.total_env_steps
-        if cfg.lr_schedule != "constant" and target > cfg.total_env_steps:
-            raise ValueError(
-                f"train(total_env_steps={target}) exceeds the "
-                f"lr_schedule horizon (config.total_env_steps="
-                f"{cfg.total_env_steps}): the annealed rate would sit at 0 "
-                "for the excess steps. Set config.total_env_steps to the "
-                "real budget instead."
-            )
+        validate_train_target(cfg, target)
         steps_per_update = cfg.batch_steps_per_update * cfg.updates_per_call
         history: list[dict[str, Any]] = []
 

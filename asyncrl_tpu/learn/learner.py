@@ -174,6 +174,19 @@ def validate_qlearn_config(config: Config) -> None:
         )
 
 
+def validate_train_target(config: Config, target: int) -> None:
+    """Shared guard for Trainer.train / SebulbaTrainer.train: with an
+    annealing LR schedule, training past the configured horizon would
+    silently run at lr=0 — refuse instead."""
+    if config.lr_schedule != "constant" and target > config.total_env_steps:
+        raise ValueError(
+            f"train(total_env_steps={target}) exceeds the lr_schedule "
+            f"horizon (config.total_env_steps={config.total_env_steps}): "
+            "the annealed rate would sit at 0 for the excess steps. Set "
+            "config.total_env_steps to the real budget instead."
+        )
+
+
 def validate_recurrent_config(config: Config, model) -> None:
     """Shared constructor-time checks for recurrent policies (Anakin and
     host-fragment learners alike)."""

@@ -34,6 +34,11 @@ from asyncrl_tpu.learn.learner import (
 from asyncrl_tpu.models.networks import is_recurrent
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.ops.losses import a3c_loss, impala_loss, ppo_loss
+from asyncrl_tpu.ops.normalize import (
+    init_stats,
+    normalizing_apply,
+    update_stats,
+)
 from asyncrl_tpu.parallel.mesh import TIME_AXIS, dp_axes
 from asyncrl_tpu.parallel.timeshard import (
     gae_timesharded,
@@ -61,11 +66,16 @@ class LearnerState:
     opt_state: Any
     update_step: jax.Array  # int32 scalar
     target_params: Any = None
+    # Running observation-normalization stats (ops/normalize.py); None
+    # unless config.normalize_obs. Published to host actors alongside the
+    # params (SebulbaTrainer bundles them through the ParamStore).
+    obs_stats: Any = None
 
 
 def learner_state_spec() -> LearnerState:
     return LearnerState(
-        params=P(), opt_state=P(), update_step=P(), target_params=P()
+        params=P(), opt_state=P(), update_step=P(), target_params=P(),
+        obs_stats=P(),
     )
 
 
@@ -188,13 +198,6 @@ class RolloutLearner:
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
         validate_qlearn_config(config)
-        if config.normalize_obs:
-            raise NotImplementedError(
-                "normalize_obs is Anakin-only (backend='tpu'): the host "
-                "backends would need the stats published to actor-side "
-                "inference alongside the params; use reward_scale or "
-                "normalize on the env side for host pools"
-            )
         time_sharded = TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1
         if time_sharded:
             sp = mesh.shape[TIME_AXIS]
@@ -243,9 +246,13 @@ class RolloutLearner:
         reduce_axes = axes + ((TIME_AXIS,) if time_sharded else ())
 
         def update_body(state: LearnerState, rollout: Rollout):
+            # Observation normalization (ops/normalize.py): this step's
+            # forwards all use the pre-update stats; the fragment's obs
+            # fold in afterwards.
+            napply = normalizing_apply(apply_fn, state.obs_stats)
             if ppo_multipass:
                 params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
-                    config, apply_fn, optimizer, dist,
+                    config, napply, optimizer, dist,
                     state.params, state.opt_state, rollout, state.update_step,
                     axes=axes,
                 )
@@ -257,12 +264,12 @@ class RolloutLearner:
                 def scaled_loss(p):
                     if time_sharded:
                         loss, metrics = _algo_loss_timesharded(
-                            config, apply_fn, p, rollout,
+                            config, napply, p, rollout,
                             reduce_axes=reduce_axes, dist=dist,
                         )
                     else:
                         loss, metrics = _algo_loss(
-                            config, apply_fn, p, rollout,
+                            config, napply, p, rollout,
                             axis_name=axes, dist=dist,
                             target_params=state.target_params,
                         )
@@ -294,11 +301,17 @@ class RolloutLearner:
                 )
             else:
                 target_params = state.target_params  # None subtree
+            obs_stats = state.obs_stats
+            if obs_stats is not None:
+                obs_stats = update_stats(
+                    obs_stats, rollout.obs, reduce_axes
+                )
             new_state = LearnerState(
                 params=params,
                 opt_state=opt_state,
                 update_step=step,
                 target_params=target_params,
+                obs_stats=obs_stats,
             )
             return new_state, metrics
 
@@ -351,6 +364,11 @@ class RolloutLearner:
             # qlearn: target net starts equal to the online net (device
             # arrays are immutable, so sharing the reference is safe).
             target_params=params if self.config.algo == "qlearn" else None,
+            obs_stats=(
+                jax.device_put(init_stats(self.spec.obs_shape), rep)
+                if self.config.normalize_obs
+                else None
+            ),
         )
 
     # --------------------------------------------------------------- update

@@ -28,10 +28,17 @@ class RunningStats(NamedTuple):
 
 
 def init_stats(obs_shape) -> RunningStats:
+    # Epsilon pseudo-count (the VecNormalize convention): variance is
+    # defined at t=0 (m2/count = 1), yet the zero-mean pseudo-sample is
+    # light enough that it cannot inflate the variance of large-mean
+    # observation dims (a count of 1 at mean 0 would add mean^2/n to the
+    # variance of mean~1e3 data — a 2x std error tens of thousands of
+    # samples in).
+    eps = 1e-4
     return RunningStats(
-        count=jnp.ones((), jnp.float32),  # epsilon-count: var defined at t=0
+        count=jnp.full((), eps, jnp.float32),
         mean=jnp.zeros(obs_shape, jnp.float32),
-        m2=jnp.ones(obs_shape, jnp.float32),
+        m2=jnp.full(obs_shape, eps, jnp.float32),
     )
 
 
@@ -50,14 +57,19 @@ def update_stats(stats: RunningStats, obs: jax.Array, axes=()) -> RunningStats:
         n *= x.shape[d]
     b_count = jnp.asarray(float(n), jnp.float32)
     b_sum = jnp.sum(x, axis=batch_dims)
-    b_sumsq = jnp.sum(jnp.square(x), axis=batch_dims)
     if axes:
         b_count = jax.lax.psum(b_count, axes)
         b_sum = jax.lax.psum(b_sum, axes)
-        b_sumsq = jax.lax.psum(b_sumsq, axes)
-
     b_mean = b_sum / b_count
-    b_m2 = b_sumsq - b_count * jnp.square(b_mean)
+
+    # Two-pass m2: sum of squared deviations from the (global) batch mean.
+    # NOT the naive sumsq - n*mean^2 form — that cancels catastrophically
+    # in f32 for large-mean/low-variance dims (mean ~1e3, std ~0.1 turns
+    # the variance into rounding noise), precisely the coordinate-style
+    # observations continuous control produces.
+    b_m2 = jnp.sum(jnp.square(x - b_mean), axis=batch_dims)
+    if axes:
+        b_m2 = jax.lax.psum(b_m2, axes)
 
     # Chan parallel merge of (count, mean, m2) pairs.
     delta = b_mean - stats.mean

@@ -190,9 +190,24 @@ def make_inference_fn(model, spec: EnvSpec, config: Any) -> Callable:
     per-env ε appended onto dist_params exactly as the Anakin ``dist_extra``
     channel does (ops.distributions.EpsilonGreedy). Recurrent (DRQN) Q
     models combine both contracts: (params, obs, key, core, done_prev, eps)
-    -> (actions, logp, key, core)."""
+    -> (actions, logp, key, core).
+
+    With ``config.normalize_obs`` the ``params`` argument is the PUBLISHED
+    BUNDLE ``(params, obs_stats)`` (what SebulbaTrainer puts in the
+    ParamStore): observations normalize under the bundled stats before the
+    model apply, so host actors act under exactly the learner's view."""
     dist = distributions.for_config(config, spec)
-    apply_fn = model.apply
+    if config.normalize_obs:
+        from asyncrl_tpu.ops.normalize import normalize
+
+        raw_apply = model.apply
+
+        def apply_fn(bundle, obs, *rest):
+            params, stats = bundle
+            return raw_apply(params, normalize(obs, stats), *rest)
+
+    else:
+        apply_fn = model.apply
     mode = inference_mode(config, model)
 
     if mode in ("eps", "rec_eps"):

@@ -64,9 +64,10 @@ class Config:
     # Brax's PPO does the same for Ant/Humanoid (BASELINE.json:11).
     reward_scale: float = 1.0
     # Running observation normalization (the VecNormalize / Brax-PPO recipe,
-    # ops/normalize.py): stats ride the TrainState, update inside the fused
-    # step (psum'd over the mesh), and normalize the actor's, learner's, and
-    # eval's model inputs alike. Anakin backend only.
+    # ops/normalize.py): stats ride the train state, update inside the
+    # jitted step (psum'd over the mesh), and normalize the actor's,
+    # learner's, and eval's model inputs alike. On host backends the stats
+    # publish to actors bundled with the params.
     normalize_obs: bool = False
 
     # --- IMPALA / V-trace ---

@@ -25,16 +25,33 @@ def test_streamed_moments_match_numpy():
     for batch in data:
         stats = update_stats(stats, jnp.asarray(batch))
     flat = data.reshape(-1, 4)
-    # init_stats seeds a soft count of 1 with m2=1 (variance defined at
-    # t=0), so compare against moments that include that pseudo-sample.
-    n = flat.shape[0]
+    # init_stats seeds only an epsilon pseudo-count, so the running moments
+    # track the data's own to high accuracy.
     np.testing.assert_allclose(
-        np.asarray(stats.mean), flat.sum(0) / (n + 1), rtol=1e-4, atol=1e-4
+        np.asarray(stats.mean), flat.mean(0), rtol=1e-4, atol=1e-4
     )
     var = np.asarray(stats.m2 / stats.count)
     np.testing.assert_allclose(var, flat.var(0), rtol=0.05)
     z = np.asarray(normalize(jnp.asarray(flat), stats))
     assert abs(z.mean()) < 0.05 and abs(z.std() - 1.0) < 0.05
+
+
+def test_large_mean_low_variance_no_cancellation():
+    """f32 regression: mean ~1e3 with std ~0.1 (MuJoCo world coordinates)
+    must keep an accurate variance — the naive sumsq - n*mean^2 form turns
+    it into rounding noise."""
+    rng = np.random.default_rng(2)
+    data = rng.normal(1000.0, 0.1, size=(40, 64, 3)).astype(np.float32)
+    stats = init_stats((3,))
+    for batch in data:
+        stats = update_stats(stats, jnp.asarray(batch))
+    var = np.asarray(stats.m2 / stats.count)
+    # The epsilon pseudo-sample at mean 0 adds ~mean^2 * eps / n to the
+    # variance — negligible here; the recovered std must be ~0.1, neither
+    # collapsed (cancellation) nor inflated (heavy pseudo-count).
+    n = data.size // 3
+    inflation = (1000.0**2) * 1e-4 / n
+    np.testing.assert_allclose(var, 0.01 + inflation, rtol=0.15)
 
 
 def test_normalize_clips_outliers():
@@ -117,12 +134,33 @@ def test_normalize_obs_checkpoint_roundtrip(tmp_path):
         resumed.close()
 
 
-def test_host_backends_reject_normalize_obs():
+def test_host_backend_normalize_end_to_end():
+    """Host path: stats ride LearnerState, fold each fragment, publish to
+    actors bundled with the params, and steer greedy eval."""
     cfg = presets.get("cartpole_a3c_cpu").replace(
-        normalize_obs=True, host_pool="jax"
+        normalize_obs=True, host_pool="jax", num_envs=4, actor_threads=2,
+        unroll_len=8, log_every=2, precision="f32",
     )
-    with pytest.raises(NotImplementedError, match="Anakin-only"):
-        make_agent(cfg)
+    agent = make_agent(cfg)
+    try:
+        assert agent.state.obs_stats is not None
+        c0 = float(agent.state.obs_stats.count)
+        history = agent.train(total_env_steps=4 * 8 * 6)
+        assert history
+        # Each update folds ONE actor's fragment of (num_envs/threads)*T
+        # obs, and the budget of 192 frames takes 12 such updates.
+        frames_per_update = (4 // 2) * 8
+        expect = c0 + (4 * 8 * 6 // frames_per_update) * frames_per_update
+        assert float(agent.state.obs_stats.count) == pytest.approx(
+            expect, rel=1e-6
+        )
+        # Published bundle carries the stats.
+        bundle, _ = agent._store.get()
+        assert isinstance(bundle, tuple) and len(bundle) == 2
+        assert np.isfinite(agent.evaluate(num_episodes=4, max_steps=25))
+        assert agent._errors.empty()
+    finally:
+        agent.close()
 
 
 @pytest.mark.slow

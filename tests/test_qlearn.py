@@ -396,6 +396,15 @@ def test_linear_schedule_rejects_budget_overrun():
             agent.train(total_env_steps=8 * 4 * 50)
     finally:
         agent.close()
+    # Same guard on the host-backend trainer (shared validate_train_target).
+    host = make_agent(
+        cfg.replace(backend="cpu_async", host_pool="jax", actor_threads=2)
+    )
+    try:
+        with pytest.raises(ValueError, match="lr_schedule horizon"):
+            host.train(total_env_steps=8 * 4 * 50)
+    finally:
+        host.close()
 
 
 def test_lr_schedule_horizon_models_backend_and_algo():
