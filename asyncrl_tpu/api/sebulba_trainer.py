@@ -22,9 +22,11 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from asyncrl_tpu.learn.learner import validate_train_target
 from asyncrl_tpu.learn.rollout_learner import LearnerState, RolloutLearner
 from asyncrl_tpu.models.networks import build_model, is_recurrent, reset_core
 from asyncrl_tpu.ops import distributions
+from asyncrl_tpu.ops.normalize import normalizing_apply
 from asyncrl_tpu.parallel.mesh import dp_size, make_mesh
 from asyncrl_tpu.rollout.sebulba import (
     ActorThread,
@@ -256,8 +258,6 @@ class SebulbaTrainer:
         Metric dicts match ``Trainer.train``'s contract (env_steps, fps,
         episode_return/length/count + loss terms).
         """
-        from asyncrl_tpu.learn.learner import validate_train_target
-
         cfg = self.config
         target = total_env_steps or cfg.total_env_steps
         validate_train_target(cfg, target)
@@ -358,8 +358,6 @@ class SebulbaTrainer:
 
             @jax.jit
             def greedy_rec(params, obs_stats, obs, core, done_prev):
-                from asyncrl_tpu.ops.normalize import normalizing_apply
-
                 napply = normalizing_apply(apply_fn, obs_stats)
                 core = reset_core(core, done_prev)
                 dist_params, _, core = napply(params, obs, core)
@@ -369,8 +367,6 @@ class SebulbaTrainer:
 
             @jax.jit
             def greedy(params, obs_stats, obs):
-                from asyncrl_tpu.ops.normalize import normalizing_apply
-
                 napply = normalizing_apply(apply_fn, obs_stats)
                 dist_params, _ = napply(params, obs)
                 return dist.mode(dist_params)

@@ -18,8 +18,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from asyncrl_tpu.envs import registry
-from asyncrl_tpu.learn.learner import Learner, TrainState
+from asyncrl_tpu.learn.learner import (
+    Learner,
+    TrainState,
+    validate_train_target,
+)
 from asyncrl_tpu.models.networks import build_model, is_recurrent, reset_core
+from asyncrl_tpu.ops.normalize import normalizing_apply
 from asyncrl_tpu.parallel.mesh import make_mesh
 from asyncrl_tpu.utils.config import Config
 
@@ -83,8 +88,6 @@ class Trainer:
         including ``env_steps``, ``fps``, and ``episode_return`` (mean over
         episodes completed in the window).
         """
-        from asyncrl_tpu.learn.learner import validate_train_target
-
         cfg = self.config
         target = total_env_steps or cfg.total_env_steps
         validate_train_target(cfg, target)
@@ -173,8 +176,6 @@ class Trainer:
             def eval_rollout(params, obs_stats, key):
                 # Greedy eval must see the same normalized observations the
                 # policy trained on (ops/normalize.py; identity when None).
-                from asyncrl_tpu.ops.normalize import normalizing_apply
-
                 napply = normalizing_apply(apply_fn, obs_stats)
                 init_keys = jax.random.split(key, num_episodes + 1)
                 env_state = jax.vmap(env.init)(init_keys[:-1])

@@ -32,6 +32,7 @@ import numpy as np
 from asyncrl_tpu.envs.core import Environment, EnvSpec
 from asyncrl_tpu.models.networks import is_recurrent, reset_core
 from asyncrl_tpu.ops import distributions
+from asyncrl_tpu.ops.normalize import normalize
 from asyncrl_tpu.rollout.buffer import Rollout, RolloutBuffer
 
 
@@ -198,8 +199,6 @@ def make_inference_fn(model, spec: EnvSpec, config: Any) -> Callable:
     model apply, so host actors act under exactly the learner's view."""
     dist = distributions.for_config(config, spec)
     if config.normalize_obs:
-        from asyncrl_tpu.ops.normalize import normalize
-
         raw_apply = model.apply
 
         def apply_fn(bundle, obs, *rest):
