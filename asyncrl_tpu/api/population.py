@@ -150,6 +150,7 @@ class PopulationTrainer:
             actor=P(axes),
             update_step=P(axes),
             obs_stats=P(axes),
+            ret_stats=P(axes),
         )
         self._step = jax.jit(
             jax.shard_map(
@@ -209,7 +210,7 @@ class PopulationTrainer:
         # split(akey, dp)[device] with dp=1, device=0.
         actor = actor_init(
             self.env, cfg.num_envs, jax.random.split(akey, 1)[0],
-            model=self.model,
+            model=self.model, track_returns=cfg.normalize_returns,
         )
         from asyncrl_tpu.ops.normalize import init_stats
 
@@ -224,6 +225,7 @@ class PopulationTrainer:
                 if cfg.normalize_obs
                 else None
             ),
+            ret_stats=init_stats(()) if cfg.normalize_returns else None,
         )
 
     def _init_population(self, base_seed: int) -> TrainState:

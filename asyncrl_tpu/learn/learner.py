@@ -79,6 +79,9 @@ class TrainState:
     actor: ActorState
     update_step: jax.Array  # int32 scalar
     obs_stats: Any = None
+    # Running scalar stats of the per-env discounted return (reward
+    # normalization, config.normalize_returns); None when disabled.
+    ret_stats: Any = None
 
 
 def state_partition_spec(axes: tuple[str, ...]) -> TrainState:
@@ -93,6 +96,7 @@ def state_partition_spec(axes: tuple[str, ...]) -> TrainState:
         actor=P(axes),
         update_step=P(),
         obs_stats=P(),
+        ret_stats=P(),
     )
 
 
@@ -537,6 +541,18 @@ def make_train_step(
                 napply, state.actor_params, env, state.actor,
                 config.unroll_len, dist=dist, reward_scale=config.reward_scale,
                 dist_extra=dist_extra,
+                return_discount=(
+                    config.gamma if config.normalize_returns else 0.0
+                ),
+            )
+        if config.normalize_returns:
+            # Scale this fragment's rewards by the PRE-update return std
+            # (mean is NOT subtracted — shifting rewards changes the MDP);
+            # fold the fragment's discounted-return stream in afterwards.
+            ret_var = state.ret_stats.m2 / state.ret_stats.count
+            rollout = rollout.replace(
+                rewards=rollout.rewards
+                * jax.lax.rsqrt(jnp.maximum(ret_var, 1e-8))
             )
 
         if ppo_multipass:
@@ -600,6 +616,9 @@ def make_train_step(
         if obs_stats is not None:
             with jax.named_scope("obs_stats"):
                 obs_stats = update_stats(obs_stats, rollout.obs, axes)
+        ret_stats = state.ret_stats
+        if ret_stats is not None:
+            ret_stats = update_stats(ret_stats, rollout.disc_returns, axes)
 
         metrics = dict(metrics)
         metrics["loss"] = loss
@@ -615,6 +634,7 @@ def make_train_step(
             actor=actor,
             update_step=step,
             obs_stats=obs_stats,
+            ret_stats=ret_stats,
         )
         return new_state, metrics
 
@@ -699,7 +719,10 @@ class Learner:
         axes = dp_axes(self.mesh)
 
         def shard_actor_init(keys):
-            return actor_init(self.env, local_envs, keys[0], model=self.model)
+            return actor_init(
+                self.env, local_envs, keys[0], model=self.model,
+                track_returns=cfg.normalize_returns,
+            )
 
         per_device_keys = jax.random.split(akey, dp)
         actor = jax.jit(
@@ -714,6 +737,7 @@ class Learner:
         obs_stats = (
             init_stats(self.env.spec.obs_shape) if cfg.normalize_obs else None
         )
+        ret_stats = init_stats(()) if cfg.normalize_returns else None
         # Place replicated leaves explicitly on the mesh.
         from jax.sharding import NamedSharding
 
@@ -726,6 +750,9 @@ class Learner:
             update_step=jax.device_put(jnp.zeros((), jnp.int32), rep),
             obs_stats=(
                 None if obs_stats is None else jax.device_put(obs_stats, rep)
+            ),
+            ret_stats=(
+                None if ret_stats is None else jax.device_put(ret_stats, rep)
             ),
         )
 

@@ -198,6 +198,13 @@ class RolloutLearner:
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
         validate_qlearn_config(config)
+        if config.normalize_returns:
+            raise NotImplementedError(
+                "normalize_returns is Anakin-only (backend='tpu'): host "
+                "fragments carry no discounted-return stream (the per-env "
+                "accumulator lives in the device actor state); use "
+                "reward_scale on host backends"
+            )
         time_sharded = TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1
         if time_sharded:
             sp = mesh.shape[TIME_AXIS]

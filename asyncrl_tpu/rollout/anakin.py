@@ -38,11 +38,21 @@ class ActorState:
     keys: jax.Array  # [B, 2] uint32 raw PRNG keys
     running_return: jax.Array  # [B] f32
     running_length: jax.Array  # [B] f32
+    # Per-env DISCOUNTED return accumulator (G = discount*G + r, reset at
+    # done) — the statistic behind normalize_returns reward scaling
+    # (VecNormalize/Brax recipe). None (empty subtree) unless tracking:
+    # an always-present leaf would break restore of checkpoints saved
+    # before the field existed, even with the feature off.
+    disc_return: Any = None  # [B] f32 when tracking
     core: Any = None  # recurrent policy carry, leading dim B
 
 
 def actor_init(
-    env: Environment, num_envs: int, seed_key: jax.Array, model=None
+    env: Environment,
+    num_envs: int,
+    seed_key: jax.Array,
+    model=None,
+    track_returns: bool = False,
 ) -> ActorState:
     init_keys, carry_keys = jax.random.split(seed_key)
     env_keys = jax.random.split(init_keys, num_envs)
@@ -60,6 +70,7 @@ def actor_init(
         keys=jax.random.split(carry_keys, num_envs),
         running_return=zeros,
         running_length=zeros,
+        disc_return=zeros if track_returns else None,
         core=core,
     )
 
@@ -73,6 +84,7 @@ def unroll(
     dist=None,
     reward_scale: float = 1.0,
     dist_extra: jax.Array | None = None,
+    return_discount: float = 0.0,
 ) -> tuple[ActorState, Rollout, EpisodeStats]:
     """Roll the policy forward ``unroll_len`` steps over the env batch.
 
@@ -87,6 +99,14 @@ def unroll(
     dependent behaviour knobs the frozen ``dist`` object can't carry (the
     Q-learning family's annealed per-env ε rides here, constant across the
     fragment).
+
+    The discounted-return stream ``G_t = return_discount * G_{t-1} + r_t``
+    (reset at episode ends; built from the learner's SCALED reward view)
+    records into ``rollout.disc_returns`` whenever the actor state tracks
+    it (``actor_init(track_returns=True)``) — ONE predicate, shared with
+    the learner's stats fold, so the carry, the stream, and the consumer
+    cannot disagree (a ``return_discount`` of 0 degrades to reward-std
+    tracking rather than crashing).
     """
     if dist is None:
         from asyncrl_tpu.ops import distributions
@@ -94,6 +114,7 @@ def unroll(
         dist = distributions.for_spec(env.spec)
 
     recurrent = actor_state.core is not None
+    track_returns = actor_state.disc_return is not None
 
     def step_fn(carry: ActorState, _):
         split = jax.vmap(lambda k: jax.random.split(k, 3))(carry.keys)  # [B,3,2]
@@ -119,12 +140,19 @@ def unroll(
         done_f = ts.done.astype(jnp.float32)
         ep_return = carry.running_return + ts.reward
         ep_length = carry.running_length + 1.0
+        # Discounted-return stream for reward normalization (scaled view).
+        g = (
+            carry.disc_return * return_discount + ts.reward * reward_scale
+            if track_returns
+            else None
+        )
         new_carry = ActorState(
             env_state=env_state,
             obs=ts.obs,
             keys=next_keys,
             running_return=ep_return * (1.0 - done_f),
             running_length=ep_length * (1.0 - done_f),
+            disc_return=g * (1.0 - done_f) if track_returns else None,
             core=core,
         )
         out = (
@@ -137,12 +165,13 @@ def unroll(
             ep_return * done_f,
             ep_length * done_f,
             done_f,
+            g,
         )
         return new_carry, out
 
     final_state, outs = jax.lax.scan(step_fn, actor_state, None, length=unroll_len)
     (obs, actions, behaviour_logp, rewards, terminated, truncated,
-     done_returns, done_lengths, dones) = outs
+     done_returns, done_lengths, dones, disc_returns) = outs
 
     rollout = Rollout(
         obs=obs,
@@ -155,6 +184,7 @@ def unroll(
         # Fragment-initial recurrent carry (behaviour policy's), for the
         # learner's re-forward — the IMPALA "stale core state" recipe.
         init_core=actor_state.core,
+        disc_returns=disc_returns,
     )
     stats = EpisodeStats(
         completed_return_sum=jnp.sum(done_returns),

@@ -39,6 +39,10 @@ class Rollout:
     # (behaviour policy's), used by the learner to re-forward the fragment.
     # None (empty subtree) for feed-forward policies.
     init_core: Any = None
+    # Per-step discounted-return stream [T, B] for reward normalization
+    # (rollout.anakin.unroll with return_discount > 0); None otherwise
+    # (host fragments, or the feature disabled).
+    disc_returns: Any = None
 
     @property
     def done(self) -> jax.Array:

@@ -69,6 +69,11 @@ class Config:
     # learner's, and eval's model inputs alike. On host backends the stats
     # publish to actors bundled with the params.
     normalize_obs: bool = False
+    # Return-based reward scaling (VecNormalize's other half / the Brax
+    # recipe): rewards divide by the running std of the per-env discounted
+    # return before the loss — an adaptive, workload-independent
+    # reward_scale. Episode-return metrics stay raw. Anakin backend only.
+    normalize_returns: bool = False
 
     # --- IMPALA / V-trace ---
     vtrace_rho_clip: float = 1.0
