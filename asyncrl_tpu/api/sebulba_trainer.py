@@ -269,6 +269,9 @@ class SebulbaTrainer:
         ret_sum = len_sum = count = lag_sum = 0.0
         window_start = time.perf_counter()
         window_steps = 0
+        # Cumulative-counter baseline: a SECOND train() call on this agent
+        # must not fire an eval at its first log boundary.
+        updates_at_eval = self._updates
         try:
             while self.env_steps < target:
                 self._supervise()
@@ -321,6 +324,21 @@ class SebulbaTrainer:
                     agg["fps"] = window_steps / max(elapsed, 1e-9)
                     ret_sum = len_sum = count = lag_sum = 0.0
                     window_steps = 0
+                    # In-training greedy eval on the log boundary. Actors
+                    # keep filling the (bounded) queue during the pause, so
+                    # window_start is deliberately NOT reset: the eval's
+                    # wall time counts against the next window (an honest
+                    # under-report) rather than letting the queue backlog
+                    # drain into a shortened window and report fps above
+                    # hardware throughput.
+                    if (
+                        cfg.eval_every > 0
+                        and self._updates - updates_at_eval >= cfg.eval_every
+                    ):
+                        updates_at_eval = self._updates
+                        agg["eval_return"] = self.evaluate(
+                            num_episodes=cfg.eval_episodes
+                        )
                     history.append(agg)
                     if callback:
                         callback(agg)
@@ -350,26 +368,34 @@ class SebulbaTrainer:
         Each env counts only its FIRST completed episode (pools auto-reset).
         """
         pool = make_host_pool(self.config, num_episodes, seed=seed)
-        dist = distributions.for_config(self.config, self.spec)
-        apply_fn = self.model.apply
         recurrent = is_recurrent(self.model)
+        # One jitted greedy fn for the trainer's lifetime (in-training
+        # evals would otherwise redefine-and-retrace it every period; jit
+        # still specializes per num_episodes batch shape, cached).
+        if not hasattr(self, "_greedy_fn"):
+            dist = distributions.for_config(self.config, self.spec)
+            apply_fn = self.model.apply
 
-        if recurrent:
+            if recurrent:
 
-            @jax.jit
-            def greedy_rec(params, obs_stats, obs, core, done_prev):
-                napply = normalizing_apply(apply_fn, obs_stats)
-                core = reset_core(core, done_prev)
-                dist_params, _, core = napply(params, obs, core)
-                return dist.mode(dist_params), core
+                @jax.jit
+                def greedy_rec(params, obs_stats, obs, core, done_prev):
+                    napply = normalizing_apply(apply_fn, obs_stats)
+                    core = reset_core(core, done_prev)
+                    dist_params, _, core = napply(params, obs, core)
+                    return dist.mode(dist_params), core
 
-        else:
+                self._greedy_fn = greedy_rec
+            else:
 
-            @jax.jit
-            def greedy(params, obs_stats, obs):
-                napply = normalizing_apply(apply_fn, obs_stats)
-                dist_params, _ = napply(params, obs)
-                return dist.mode(dist_params)
+                @jax.jit
+                def greedy(params, obs_stats, obs):
+                    napply = normalizing_apply(apply_fn, obs_stats)
+                    dist_params, _ = napply(params, obs)
+                    return dist.mode(dist_params)
+
+                self._greedy_fn = greedy
+        greedy_fn = self._greedy_fn
 
         params = self.state.params
         obs_stats = self.state.obs_stats
@@ -382,12 +408,12 @@ class SebulbaTrainer:
             final_return = np.zeros((num_episodes,), np.float64)
             for _ in range(max_steps):
                 if recurrent:
-                    actions_d, core = greedy_rec(
+                    actions_d, core = greedy_fn(
                         params, obs_stats, obs, core, done_prev
                     )
                     actions = np.asarray(actions_d)
                 else:
-                    actions = np.asarray(greedy(params, obs_stats, obs))
+                    actions = np.asarray(greedy_fn(params, obs_stats, obs))
                 obs, rew, term, trunc = pool.step(actions)
                 done_prev = np.logical_or(term, trunc)
                 ep_return += np.where(finished, 0.0, rew)

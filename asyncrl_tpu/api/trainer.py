@@ -97,12 +97,14 @@ class Trainer:
         pending: list[dict[str, jax.Array]] = []
         window_start = time.perf_counter()
         window_steps = 0
+        calls = calls_at_eval = 0
 
         try:
             while self.env_steps < target:
                 self.state, metrics = self.learner.update(self.state)
                 self.env_steps += steps_per_update
                 window_steps += steps_per_update
+                calls += 1
                 pending.append(metrics)
                 self._ckpt.after_update(self.state, self.env_steps)
 
@@ -138,6 +140,18 @@ class Trainer:
                     agg["env_steps"] = self.env_steps
                     agg["fps"] = window_steps / max(elapsed, 1e-9)
                     window_steps = 0
+                    # In-training greedy eval on the log boundary (so the
+                    # eval never lands mid-window and its wall time never
+                    # pollutes a window's fps).
+                    if (
+                        cfg.eval_every > 0
+                        and calls - calls_at_eval >= cfg.eval_every
+                    ):
+                        calls_at_eval = calls
+                        agg["eval_return"] = self.evaluate(
+                            num_episodes=cfg.eval_episodes
+                        )
+                        window_start = time.perf_counter()
                     history.append(agg)
                     if callback:
                         callback(agg)

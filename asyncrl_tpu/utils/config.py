@@ -121,6 +121,11 @@ class Config:
     # Checkpoint/log cadences count CALLS, i.e. multiples of this.
     updates_per_call: int = 1
     log_every: int = 20  # learner update CALLS between metric drains
+    # In-training greedy evaluation: every `eval_every` update calls
+    # (rounded up to the next log boundary), run `eval_episodes` greedy
+    # episodes and report `eval_return` in that metrics window. 0 = off.
+    eval_every: int = 0
+    eval_episodes: int = 32
     # Updates between periodic checkpoint saves; 0 disables the periodic
     # cadence (with checkpoint_dir set, a final save on train() exit — clean
     # or crashed — still happens).

@@ -72,3 +72,37 @@ def test_train_smoke_learns_a_bit(devices):
     )
     history = agent.train()
     assert history[-1]["episode_return"] > 80, history[-1]
+
+
+@pytest.mark.parametrize("backend", ["tpu", "cpu_async"])
+def test_in_training_eval_cadence(backend):
+    """eval_every: eval_return appears on the expected log boundaries, on
+    both the Anakin and host trainers."""
+    kw = dict(
+        env_id="CartPole-v1",
+        algo="a3c",
+        backend=backend,
+        num_envs=8,  # divisible by the 8-device test mesh
+        unroll_len=8,
+        precision="f32",
+        log_every=2,
+        eval_every=4,
+        eval_episodes=4,
+    )
+    if backend == "cpu_async":
+        kw.update(actor_threads=2, host_pool="jax")
+    agent = make_agent(Config(**kw))
+    try:
+        # 12 updates -> 6 log windows; evals land on windows where >= 4
+        # new update calls have run since the last eval: windows 2, 4, 6.
+        frames_per_update = (
+            8 * 8 if backend == "tpu" else (8 // 2) * 8
+        )
+        history = agent.train(total_env_steps=frames_per_update * 12)
+        with_eval = [i for i, h in enumerate(history) if "eval_return" in h]
+        assert with_eval == [1, 3, 5], (with_eval, len(history))
+        assert all(
+            np.isfinite(history[i]["eval_return"]) for i in with_eval
+        )
+    finally:
+        agent.close()
