@@ -356,6 +356,9 @@ class SebulbaTrainer:
     def close(self) -> None:
         """Stop actors, flush pending checkpoint saves, release resources."""
         self.stop()
+        for pool in getattr(self, "_eval_pools", {}).values():
+            _close(pool)
+        self._eval_pools = {}
         self._ckpt.close()
 
     # ----------------------------------------------------------------- eval
@@ -365,9 +368,19 @@ class SebulbaTrainer:
     ) -> float:
         """Mean greedy-policy return over ``num_episodes`` fresh host envs.
 
-        Each env counts only its FIRST completed episode (pools auto-reset).
+        Each env counts only its FIRST completed episode (pools auto-reset;
+        ``pool.reset()`` below starts the fresh episodes).
         """
-        pool = make_host_pool(self.config, num_episodes, seed=seed)
+        # Eval pools are cached per (num_episodes, seed) for the trainer's
+        # lifetime: in-training evals would otherwise rebuild the pool —
+        # and, for JaxHostPool, re-jit its env step — every eval period.
+        if not hasattr(self, "_eval_pools"):
+            self._eval_pools = {}
+        pool_key = (num_episodes, seed)
+        pool = self._eval_pools.get(pool_key)
+        if pool is None:
+            pool = make_host_pool(self.config, num_episodes, seed=seed)
+            self._eval_pools[pool_key] = pool
         recurrent = is_recurrent(self.model)
         # One jitted greedy fn for the trainer's lifetime (in-training
         # evals would otherwise redefine-and-retrace it every period; jit
@@ -424,8 +437,11 @@ class SebulbaTrainer:
                     break
             final_return = np.where(finished, final_return, ep_return)
             return float(final_return.mean())
-        finally:
+        except BaseException:
+            # A broken pool must not be reused; drop it from the cache.
+            self._eval_pools.pop(pool_key, None)
             _close(pool)
+            raise
 
 
 def _pool_spec(pool, config: Config):

@@ -60,6 +60,11 @@ def load_library() -> ctypes.CDLL:
         ]
         lib.envpool_reset.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
         lib.envpool_step.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 5
+        lib.envpool_step_continuous.argtypes = (
+            [ctypes.c_void_p] + [ctypes.c_void_p] * 5
+        )
+        lib.envpool_action_dim.argtypes = [ctypes.c_void_p]
+        lib.envpool_action_dim.restype = ctypes.c_int
         lib.envpool_obs_dim.argtypes = [ctypes.c_void_p]
         lib.envpool_obs_dim.restype = ctypes.c_int
         lib.envpool_num_actions.argtypes = [ctypes.c_void_p]
@@ -77,13 +82,17 @@ NATIVE_ENV_IDS = {
     "JaxPong-v0": "Pong",  # same rules as the JAX env (envs/pong.py)
     "JaxBreakout-v0": "Breakout",  # same rules as envs/breakout.py
     "JaxFreeway-v0": "Freeway",  # same rules as envs/minatari.py::Freeway
+    # Continuous control: same dynamics as envs/pendulum.py (float
+    # [B, 1] torque actions through envpool_step_continuous).
+    "JaxPendulum-v0": "Pendulum",
 }
 
 
 class NativeEnvPool:
     """A batch of C++ envs stepped in one call.
 
-    ``step`` takes int32 actions [B] and returns
+    ``step`` takes int32 actions [B] (discrete pools) or float32 actions
+    [B, action_dim] (continuous pools, ``self.continuous``) and returns
     ``(obs [B, D] f32, reward [B] f32, terminated [B] bool, truncated [B]
     bool)``; envs auto-reset (post-reset obs returned), matching the
     functional env contract (envs/core.py).
@@ -115,6 +124,8 @@ class NativeEnvPool:
         self.num_envs = num_envs
         self.obs_dim = self._lib.envpool_obs_dim(self._handle)
         self.num_actions = self._lib.envpool_num_actions(self._handle)
+        self.action_dim = self._lib.envpool_action_dim(self._handle)
+        self.continuous = self.action_dim > 0
         # Reused output buffers: zero allocation in the hot loop.
         self._obs = np.empty((num_envs, self.obs_dim), np.float32)
         self._rew = np.empty((num_envs,), np.float32)
@@ -153,11 +164,20 @@ class NativeEnvPool:
         """Zero-copy step: writes results into caller-owned C-contiguous
         arrays (obs [B, D] f32, rew [B] f32, term/trunc [B] u8). This is the
         Sebulba hot path — results land directly in the fragment staging
-        buffer."""
-        actions = np.ascontiguousarray(actions, np.int32)
+        buffer. Discrete pools take int32 [B] actions; continuous pools
+        take float32 [B, action_dim]."""
         B = self.num_envs
-        if actions.shape != (B,):
-            raise ValueError(f"actions shape {actions.shape} != ({B},)")
+        if self.continuous:
+            actions = np.ascontiguousarray(actions, np.float32)
+            if actions.shape != (B, self.action_dim):
+                raise ValueError(
+                    f"actions shape {actions.shape} != "
+                    f"({B}, {self.action_dim})"
+                )
+        else:
+            actions = np.ascontiguousarray(actions, np.int32)
+            if actions.shape != (B,):
+                raise ValueError(f"actions shape {actions.shape} != ({B},)")
         # The C side writes raw bytes through these pointers: every output
         # buffer must match the ABI's dtype/contiguity exactly or writes
         # corrupt the heap silently (no asserts: they vanish under -O).
@@ -173,13 +193,35 @@ class NativeEnvPool:
                     f"{shape}; got {arr.dtype}{arr.shape} "
                     f"contiguous={arr.flags.c_contiguous}"
                 )
-        self._lib.envpool_step(
+        step_fn = (
+            self._lib.envpool_step_continuous
+            if self.continuous
+            else self._lib.envpool_step
+        )
+        step_fn(
             self._handle,
             actions.ctypes.data,
             obs_out.ctypes.data,
             rew_out.ctypes.data,
             term_out.ctypes.data,
             trunc_out.ctypes.data,
+        )
+
+    @property
+    def spec(self):
+        """EnvSpec for the Sebulba trainer (continuous pools need the
+        action_dim/continuous flags a bare obs_dim/num_actions fallback
+        cannot express)."""
+        from asyncrl_tpu.envs.core import EnvSpec
+
+        if self.continuous:
+            return EnvSpec(
+                obs_shape=(self.obs_dim,),
+                continuous=True,
+                action_dim=self.action_dim,
+            )
+        return EnvSpec(
+            obs_shape=(self.obs_dim,), num_actions=self.num_actions
         )
 
     def close(self) -> None:

@@ -7,9 +7,11 @@
 // no per-env Python, no GIL contention in the hot loop (the Python side
 // releases the GIL around envpool_step via ctypes).
 //
-// Envs implemented: CartPole-v1 (gymnasium dynamics) and Pong (the same
-// rules as asyncrl_tpu/envs/pong.py, so the native pool and the JAX env are
-// cross-checkable trajectory-for-trajectory in tests).
+// Envs implemented: CartPole-v1 (gymnasium dynamics), Pong, Breakout,
+// Freeway (the same rules as their JAX twins, so the native pool and the
+// JAX envs are cross-checkable trajectory-for-trajectory in tests), and
+// Pendulum — the first CONTINUOUS-action env (float [B, action_dim]
+// actions through envpool_step_continuous).
 //
 // Threading: a persistent worker pool with a generation-counted barrier.
 // Each step, workers wake, step their contiguous env slice, and report done.
@@ -20,6 +22,8 @@
 
 #include <atomic>
 #include <cmath>
+#include <cstdio>
+#include <cstdlib>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -67,11 +71,34 @@ struct EnvBase {
   virtual ~EnvBase() = default;
   virtual int obs_dim() const = 0;
   virtual int num_actions() const = 0;
+  // Continuous-control envs report their action dimension (> 0) and
+  // implement step_continuous; discrete envs report 0 and implement step.
+  virtual int action_dim() const { return 0; }
   virtual void reset(Rng& rng, float* obs) = 0;
   // Steps; fills obs (post-reset on episode end), reward, terminated,
   // truncated. Auto-resets internally.
+  // The unimplemented variant aborts loudly: a silent default would let a
+  // mismatched action_dim()/override pair return uninitialized buffers to
+  // Python (heap garbage read as observations) with no error.
   virtual void step(int action, Rng& rng, float* obs, float* reward,
-                    uint8_t* terminated, uint8_t* truncated) = 0;
+                    uint8_t* terminated, uint8_t* truncated) {
+    (void)action; (void)rng; (void)obs; (void)reward; (void)terminated;
+    (void)truncated;
+    std::fprintf(stderr,
+                 "envpool: env reports action_dim()==0 but implements no "
+                 "discrete step()\n");
+    std::abort();
+  }
+  virtual void step_continuous(const float* action, Rng& rng, float* obs,
+                               float* reward, uint8_t* terminated,
+                               uint8_t* truncated) {
+    (void)action; (void)rng; (void)obs; (void)reward; (void)terminated;
+    (void)truncated;
+    std::fprintf(stderr,
+                 "envpool: env reports action_dim()>0 but implements no "
+                 "step_continuous()\n");
+    std::abort();
+  }
 };
 
 // CartPole-v1, gymnasium dynamics (matches asyncrl_tpu/envs/cartpole.py).
@@ -347,6 +374,74 @@ struct BreakoutEnv final : EnvBase {
   }
 };
 
+// Pendulum-v1 swing-up, matching asyncrl_tpu/envs/pendulum.py (itself
+// gymnasium-exact): g=10, m=1, l=1, dt=0.05, torque clip ±2, speed clip
+// ±8, 200-step truncation-only episodes, reward −(θ²+0.1·θ̇²+0.001·u²).
+// The first CONTINUOUS-action env in the native pool; observation
+// [cosθ, sinθ, θ̇] lets tests reconstruct the state and run the JAX twin
+// in lockstep (the step itself is deterministic).
+struct PendulumEnv final : EnvBase {
+  static constexpr float kG = 10.0f, kMass = 1.0f, kLength = 1.0f;
+  static constexpr float kDt = 0.05f, kMaxSpeed = 8.0f, kMaxTorque = 2.0f;
+  static constexpr int kMaxSteps = 200;
+
+  float theta, theta_dot;
+  int t;
+
+  int obs_dim() const override { return 3; }
+  int num_actions() const override { return 0; }
+  int action_dim() const override { return 1; }
+
+  static float angle_normalize(float x) {
+    const float two_pi = 2.0f * kPi;
+    float y = std::fmod(x + kPi, two_pi);
+    if (y < 0.0f) y += two_pi;
+    return y - kPi;
+  }
+
+  void reset(Rng& rng, float* obs) override {
+    theta = rng.uniform(-kPi, kPi);
+    theta_dot = rng.uniform(-1.0f, 1.0f);
+    t = 0;
+    observe(obs);
+  }
+
+  void observe(float* obs) const {
+    obs[0] = std::cos(theta);
+    obs[1] = std::sin(theta);
+    obs[2] = theta_dot;
+  }
+
+  void step_continuous(const float* action, Rng& rng, float* obs,
+                       float* reward, uint8_t* terminated,
+                       uint8_t* truncated) override {
+    float u = action[0];
+    if (u > kMaxTorque) u = kMaxTorque;
+    if (u < -kMaxTorque) u = -kMaxTorque;
+
+    const float an = angle_normalize(theta);
+    *reward = -(an * an + 0.1f * theta_dot * theta_dot + 0.001f * u * u);
+
+    // Semi-implicit Euler (theta advances with the NEW velocity), exactly
+    // as the JAX twin.
+    theta_dot += (3.0f * kG / (2.0f * kLength) * std::sin(theta) +
+                  3.0f / (kMass * kLength * kLength) * u) *
+                 kDt;
+    if (theta_dot > kMaxSpeed) theta_dot = kMaxSpeed;
+    if (theta_dot < -kMaxSpeed) theta_dot = -kMaxSpeed;
+    theta += theta_dot * kDt;
+
+    t += 1;
+    *terminated = 0;
+    *truncated = t >= kMaxSteps ? 1 : 0;
+    if (*truncated) {
+      reset(rng, obs);
+    } else {
+      observe(obs);
+    }
+  }
+};
+
 // ----------------------------------------------------------------- pool
 struct EnvPool {
   std::vector<EnvBase*> envs;
@@ -354,9 +449,11 @@ struct EnvPool {
   int num_envs = 0;
   int obs_dim_ = 0;
   int num_actions_ = 0;
+  int action_dim_ = 0;  // > 0: continuous pool (step_continuous path)
 
   // step-call shared pointers (set by step(), read by workers)
   const int32_t* actions = nullptr;
+  const float* actions_f = nullptr;
   float* obs_out = nullptr;
   float* rew_out = nullptr;
   uint8_t* term_out = nullptr;
@@ -399,25 +496,31 @@ struct EnvPool {
     }
   }
 
-  void step_slice(int tid) {
-    const int per = (num_envs + num_threads - 1) / num_threads;
-    const int lo = tid * per;
-    const int hi = std::min(num_envs, lo + per);
-    for (int i = lo; i < hi; ++i) {
+  void step_one(int i) {
+    if (action_dim_ > 0) {
+      envs[i]->step_continuous(actions_f + (size_t)i * action_dim_, rngs[i],
+                               obs_out + (size_t)i * obs_dim_, rew_out + i,
+                               term_out + i, trunc_out + i);
+    } else {
       envs[i]->step(actions[i], rngs[i], obs_out + (size_t)i * obs_dim_,
                     rew_out + i, term_out + i, trunc_out + i);
     }
   }
 
-  void step(const int32_t* acts, float* obs, float* rew, uint8_t* term,
-            uint8_t* trunc) {
-    actions = acts; obs_out = obs; rew_out = rew; term_out = term;
-    trunc_out = trunc;
+  void step_slice(int tid) {
+    const int per = (num_envs + num_threads - 1) / num_threads;
+    const int lo = tid * per;
+    const int hi = std::min(num_envs, lo + per);
+    for (int i = lo; i < hi; ++i) step_one(i);
+  }
+
+  // Shared fan-out for both action types; exactly one of acts/acts_f set.
+  void run(const int32_t* acts, const float* acts_f, float* obs, float* rew,
+           uint8_t* term, uint8_t* trunc) {
+    actions = acts; actions_f = acts_f; obs_out = obs; rew_out = rew;
+    term_out = term; trunc_out = trunc;
     if (num_threads <= 1) {
-      for (int i = 0; i < num_envs; ++i) {
-        envs[i]->step(acts[i], rngs[i], obs + (size_t)i * obs_dim_, rew + i,
-                      term + i, trunc + i);
-      }
+      for (int i = 0; i < num_envs; ++i) step_one(i);
       return;
     }
     {
@@ -430,6 +533,16 @@ struct EnvPool {
       std::unique_lock<std::mutex> lk(mu);
       cv_done.wait(lk, [&] { return pending == 0; });
     }
+  }
+
+  void step(const int32_t* acts, float* obs, float* rew, uint8_t* term,
+            uint8_t* trunc) {
+    run(acts, nullptr, obs, rew, term, trunc);
+  }
+
+  void step_continuous(const float* acts, float* obs, float* rew,
+                       uint8_t* term, uint8_t* trunc) {
+    run(nullptr, acts, obs, rew, term, trunc);
   }
 };
 
@@ -516,6 +629,7 @@ EnvBase* make_env(const std::string& id) {
   if (id == "Pong") return new PongEnv();
   if (id == "Breakout") return new BreakoutEnv();
   if (id == "Freeway") return new FreewayEnv();
+  if (id == "Pendulum") return new PendulumEnv();
   return nullptr;
 }
 
@@ -537,6 +651,7 @@ EnvPool* envpool_create(const char* env_id, int num_envs, int num_threads,
   }
   pool->obs_dim_ = pool->envs[0]->obs_dim();
   pool->num_actions_ = pool->envs[0]->num_actions();
+  pool->action_dim_ = pool->envs[0]->action_dim();
   pool->num_threads = num_threads;
   if (num_threads > 1) {
     pool->workers.reserve(num_threads);
@@ -559,8 +674,16 @@ void envpool_step(EnvPool* pool, const int32_t* actions, float* obs_out,
   pool->step(actions, obs_out, rew_out, term_out, trunc_out);
 }
 
+// Continuous pools: actions are [num_envs, action_dim] f32 row-major.
+void envpool_step_continuous(EnvPool* pool, const float* actions,
+                             float* obs_out, float* rew_out,
+                             uint8_t* term_out, uint8_t* trunc_out) {
+  pool->step_continuous(actions, obs_out, rew_out, term_out, trunc_out);
+}
+
 int envpool_obs_dim(EnvPool* pool) { return pool->obs_dim_; }
 int envpool_num_actions(EnvPool* pool) { return pool->num_actions_; }
+int envpool_action_dim(EnvPool* pool) { return pool->action_dim_; }
 int envpool_num_envs(EnvPool* pool) { return pool->num_envs; }
 
 void envpool_destroy(EnvPool* pool) { delete pool; }

@@ -248,3 +248,66 @@ def test_native_freeway_matches_jax_dynamics():
             assert not nterm.any()  # freeway never terminates
     finally:
         pool.close()
+
+
+def test_native_pendulum_matches_jax_dynamics():
+    """Continuous-action native env: reconstruct the JAX Pendulum state from
+    the native reset obs ([cos, sin, thdot] is invertible), then step both
+    in lockstep with identical float torques — the step is deterministic,
+    so obs and rewards must agree to f32 tolerance until truncation."""
+    import jax
+    import jax.numpy as jnp
+
+    from asyncrl_tpu.envs.pendulum import Pendulum, PendulumState
+
+    pool = NativeEnvPool("JaxPendulum-v0", 4, num_threads=1, seed=5)
+    try:
+        assert pool.continuous and pool.action_dim == 1
+        assert pool.spec.continuous and pool.spec.action_dim == 1
+        obs = pool.reset()
+        env = Pendulum()
+        states = PendulumState(
+            theta=jnp.asarray(np.arctan2(obs[:, 1], obs[:, 0]), jnp.float32),
+            theta_dot=jnp.asarray(obs[:, 2], jnp.float32),
+            t=jnp.zeros((4,), jnp.int32),
+        )
+        step = jax.jit(jax.vmap(env.step))
+        rng = np.random.default_rng(0)
+        key = jax.random.PRNGKey(0)
+        for i in range(150):  # < 200: no truncation resets inside the run
+            actions = rng.uniform(-2.0, 2.0, (4, 1)).astype(np.float32)
+            nobs, nrew, nterm, ntrunc = pool.step(actions)
+            key, sub = jax.random.split(key)
+            states, ts = step(
+                states, jnp.asarray(actions), jax.random.split(sub, 4)
+            )
+            np.testing.assert_allclose(
+                nobs, np.asarray(ts.obs), rtol=2e-4, atol=2e-4,
+                err_msg=f"obs diverged at step {i}",
+            )
+            np.testing.assert_allclose(
+                nrew, np.asarray(ts.reward), rtol=2e-4, atol=2e-4
+            )
+            assert not nterm.any() and not ntrunc.any()
+    finally:
+        pool.close()
+
+
+def test_native_pendulum_sebulba_end_to_end():
+    """The continuous native pool drives the host path: Gaussian-head PPO
+    fragments flow through the queue and update the learner."""
+    from asyncrl_tpu import make_agent
+    from asyncrl_tpu.utils.config import Config
+
+    agent = make_agent(Config(
+        env_id="JaxPendulum-v0", algo="ppo", backend="sebulba",
+        host_pool="native", num_envs=32, actor_threads=2, unroll_len=8,
+        ppo_epochs=1, ppo_minibatches=1, precision="f32", log_every=2,
+    ))
+    try:
+        history = agent.train(total_env_steps=32 * 8 * 4)
+        assert history and all(np.isfinite(h["loss"]) for h in history)
+        assert agent._errors.empty()
+        assert np.isfinite(agent.evaluate(num_episodes=4, max_steps=50))
+    finally:
+        agent.close()
