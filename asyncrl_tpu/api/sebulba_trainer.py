@@ -120,6 +120,12 @@ class SebulbaTrainer:
         self._next_actor_seed = config.seed * 7919 + 1
         self._actor_device = None  # CpuAsyncTrainer pins actors to host CPU
         self._server = None  # shared inference server (config.inference_server)
+        # Caches built on first use but DECLARED here (no hasattr dances):
+        # evaluation host pools per (num_episodes, seed), and the jitted
+        # greedy fn (set lazily in evaluate — model apply shape is known
+        # only there for recurrent cores).
+        self._eval_pools = {}
+        self._greedy_fn = None
 
     def _published(self, state):
         """What actors act under: the params, bundled with the obs-
@@ -356,7 +362,7 @@ class SebulbaTrainer:
     def close(self) -> None:
         """Stop actors, flush pending checkpoint saves, release resources."""
         self.stop()
-        for pool in getattr(self, "_eval_pools", {}).values():
+        for pool in self._eval_pools.values():
             _close(pool)
         self._eval_pools = {}
         self._ckpt.close()
@@ -374,8 +380,6 @@ class SebulbaTrainer:
         # Eval pools are cached per (num_episodes, seed) for the trainer's
         # lifetime: in-training evals would otherwise rebuild the pool —
         # and, for JaxHostPool, re-jit its env step — every eval period.
-        if not hasattr(self, "_eval_pools"):
-            self._eval_pools = {}
         pool_key = (num_episodes, seed)
         pool = self._eval_pools.get(pool_key)
         if pool is None:
@@ -385,7 +389,7 @@ class SebulbaTrainer:
         # One jitted greedy fn for the trainer's lifetime (in-training
         # evals would otherwise redefine-and-retrace it every period; jit
         # still specializes per num_episodes batch shape, cached).
-        if not hasattr(self, "_greedy_fn"):
+        if self._greedy_fn is None:
             dist = distributions.for_config(self.config, self.spec)
             apply_fn = self.model.apply
 

@@ -193,6 +193,26 @@ mujoco_ant_ppo = Config(
 )
 mujoco_humanoid_ppo = mujoco_ant_ppo.replace(env_id="Humanoid-v5")
 
+# Continuous control through the NATIVE C++ pool (envpool.cc Pendulum, the
+# float-action C ABI): the host-path twin of brax_ppo — same Gaussian-head
+# PPO, envs stepped by the GIL-releasing engine instead of living in HBM.
+pendulum_native_ppo = Config(
+    env_id="JaxPendulum-v0",
+    algo="ppo",
+    backend="sebulba",
+    host_pool="native",
+    num_envs=128,
+    actor_threads=4,
+    unroll_len=64,
+    total_env_steps=2_000_000,
+    learning_rate=1e-3,
+    gamma=0.95,
+    entropy_coef=0.001,
+    reward_scale=0.1,
+    ppo_epochs=4,
+    ppo_minibatches=8,
+)
+
 PRESETS: dict[str, Config] = {
     "cartpole_a3c": cartpole_a3c,
     "cartpole_a3c_cpu": cartpole_a3c_cpu,
@@ -212,6 +232,7 @@ PRESETS: dict[str, Config] = {
     "brax_humanoid_ppo": brax_humanoid_ppo,
     "mujoco_ant_ppo": mujoco_ant_ppo,
     "mujoco_humanoid_ppo": mujoco_humanoid_ppo,
+    "pendulum_native_ppo": pendulum_native_ppo,
 }
 
 

@@ -59,6 +59,7 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
         ]
         lib.envpool_reset.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.envpool_reseed.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.envpool_step.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 5
         lib.envpool_step_continuous.argtypes = (
             [ctypes.c_void_p] + [ctypes.c_void_p] * 5
@@ -116,6 +117,7 @@ class NativeEnvPool:
         if num_threads <= 0:
             # Threads pay off only for biggish batches.
             num_threads = min(8, max(1, num_envs // 64))
+        self._seed = seed
         self._handle = self._lib.envpool_create(
             NATIVE_ENV_IDS[env_id].encode(), num_envs, num_threads, seed
         )
@@ -133,6 +135,11 @@ class NativeEnvPool:
         self._trunc = np.empty((num_envs,), np.uint8)
 
     def reset(self) -> np.ndarray:
+        """Re-seed (to the construction seed) and reset every env:
+        ``reset()`` is deterministic no matter how far a reused pool's RNGs
+        have advanced — evaluation pools cached across calls depend on
+        this."""
+        self._lib.envpool_reseed(self._handle, self._seed)
         self._lib.envpool_reset(self._handle, self._obs.ctypes.data)
         return self._obs.copy()
 

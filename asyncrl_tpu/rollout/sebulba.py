@@ -83,6 +83,7 @@ class JaxHostPool:
     def __init__(self, env: Environment, num_envs: int, seed: int = 0):
         self.num_envs = num_envs
         self.spec = env.spec
+        self._seed = seed
         self._cpu = jax.devices("cpu")[0]
         with jax.default_device(self._cpu):
             self._init = jax.jit(lambda keys: _pool_init(env, keys))
@@ -93,7 +94,11 @@ class JaxHostPool:
         self._state = None
 
     def reset(self) -> np.ndarray:
+        """Deterministic: restart the key stream from the construction
+        seed, so a pool reused across evaluations replays the same initial
+        states (matching the gymnasium adapter's reset(seed=...))."""
         with jax.default_device(self._cpu):
+            self._key = jax.random.PRNGKey(self._seed)
             self._key, sub = jax.random.split(self._key)
             keys = jax.random.split(sub, self.num_envs)
             self._state, obs = self._init(keys)

@@ -662,6 +662,14 @@ EnvPool* envpool_create(const char* env_id, int num_envs, int num_threads,
   return pool;
 }
 
+// Re-seed every per-env RNG exactly as envpool_create did: a pool reused
+// across evaluations can restore determinism before each reset.
+void envpool_reseed(EnvPool* pool, uint64_t seed) {
+  for (int i = 0; i < pool->num_envs; ++i) {
+    pool->rngs[i].seed(seed * 0x9E3779B97F4A7C15ULL + (uint64_t)i);
+  }
+}
+
 void envpool_reset(EnvPool* pool, float* obs_out) {
   for (int i = 0; i < pool->num_envs; ++i) {
     pool->envs[i]->reset(pool->rngs[i],

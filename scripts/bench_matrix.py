@@ -24,6 +24,7 @@ from bench import _accelerator_alive, timed_update_window  # noqa: E402
 
 DEFAULT_PRESETS = [
     "cartpole_impala",
+    "cartpole_qlearn",
     "pong_impala",
     "atari_impala",
     "procgen_ppo",

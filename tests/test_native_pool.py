@@ -311,3 +311,24 @@ def test_native_pendulum_sebulba_end_to_end():
         assert np.isfinite(agent.evaluate(num_episodes=4, max_steps=50))
     finally:
         agent.close()
+
+
+def test_cached_eval_pool_is_deterministic():
+    """evaluate() must return the identical value when called twice with
+    the same seed, even though the pool is cached and its RNGs advanced
+    during the first call (reset re-seeds)."""
+    from asyncrl_tpu import make_agent
+    from asyncrl_tpu.utils.config import Config
+
+    agent = make_agent(Config(
+        env_id="JaxPendulum-v0", algo="ppo", backend="sebulba",
+        host_pool="native", num_envs=16, actor_threads=2, unroll_len=8,
+        ppo_epochs=1, ppo_minibatches=1, precision="f32",
+    ))
+    try:
+        a = agent.evaluate(num_episodes=8, max_steps=40, seed=7)
+        b = agent.evaluate(num_episodes=8, max_steps=40, seed=7)
+        assert a == b, (a, b)
+        assert len(agent._eval_pools) == 1  # pool reused, not rebuilt
+    finally:
+        agent.close()
