@@ -242,6 +242,20 @@ def _forward_fragment(apply_fn, params, rollout: Rollout):
     return logits, values
 
 
+def qlearn_bootstrap(config: Config, online_boot_q, target_boot_q):
+    """THE target-network bootstrap selection for the Q-learning family
+    (shared by the unsharded and time-sharded loss paths): ``max_a
+    Q_target``, or the double-Q selection — argmax under the ONLINE net,
+    evaluated under the target — to damp the max bias."""
+    target_boot_q = jax.lax.stop_gradient(target_boot_q)
+    if config.double_q:
+        sel = jnp.argmax(jax.lax.stop_gradient(online_boot_q), axis=-1)
+        return jnp.take_along_axis(target_boot_q, sel[..., None], axis=-1)[
+            ..., 0
+        ]
+    return jnp.max(target_boot_q, axis=-1)
+
+
 def _algo_loss(
     config: Config, apply_fn, params, rollout: Rollout,
     axis_name: str | None = None, dist=None, target_params=None,
@@ -261,8 +275,7 @@ def _algo_loss(
         # ``logits`` ARE the online Q-values here (QNetwork head). The
         # bootstrap comes from the target network (the stale actor_params
         # copy, refreshed every actor_staleness updates — the async-Q target
-        # network θ⁻): max_a Q_target, or the double-Q selection (argmax
-        # under ONLINE q, evaluated under target) to damp the max bias.
+        # network θ⁻) via the shared ``qlearn_bootstrap`` selection.
         if rollout.init_core is None:
             q_target = apply_fn(target_params, rollout.bootstrap_obs)[0]
         else:
@@ -273,12 +286,7 @@ def _algo_loss(
             q_target = _forward_fragment(
                 apply_fn, target_params, rollout
             )[0][-1]
-        q_target = jax.lax.stop_gradient(q_target)
-        if config.double_q:
-            sel = jnp.argmax(jax.lax.stop_gradient(logits[-1]), axis=-1)
-            boot = jnp.take_along_axis(q_target, sel[..., None], axis=-1)[..., 0]
-        else:
-            boot = jnp.max(q_target, axis=-1)
+        boot = qlearn_bootstrap(config, logits[-1], q_target)
         return qlearn_loss(
             logits_t, rollout.actions, rollout.rewards, discounts, boot,
             scan_impl=config.scan_impl,

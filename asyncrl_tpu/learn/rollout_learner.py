@@ -27,13 +27,19 @@ from asyncrl_tpu.learn.learner import (
     _algo_loss,
     _ppo_multipass,
     make_optimizer,
+    qlearn_bootstrap,
     resolve_scan_impl,
     validate_qlearn_config,
     validate_recurrent_config,
 )
 from asyncrl_tpu.models.networks import is_recurrent
 from asyncrl_tpu.ops import distributions
-from asyncrl_tpu.ops.losses import a3c_loss, impala_loss, ppo_loss
+from asyncrl_tpu.ops.losses import (
+    a3c_loss,
+    impala_loss,
+    ppo_loss,
+    qlearn_loss,
+)
 from asyncrl_tpu.ops.normalize import (
     init_stats,
     normalizing_apply,
@@ -126,7 +132,8 @@ def rollout_sharding(mesh: Mesh, rollout: Rollout) -> Rollout:
 
 
 def _algo_loss_timesharded(
-    config: Config, apply_fn, params, rollout: Rollout, *, reduce_axes, dist
+    config: Config, apply_fn, params, rollout: Rollout, *, reduce_axes, dist,
+    target_params=None,
 ):
     """Time-sharded variant of ``learner._algo_loss``: runs inside shard_map
     with the fragment's T dim sharded over ``TIME_AXIS`` (SURVEY.md §5.7).
@@ -139,10 +146,25 @@ def _algo_loss_timesharded(
     logits_t, values_t = apply_fn(params, rollout.obs)
     # ``bootstrap_obs`` is replicated over the time axis; every shard
     # computes the (tiny) bootstrap forward, only the last consumes it.
-    _, bootstrap_value = apply_fn(params, rollout.bootstrap_obs)
+    boot_logits, bootstrap_value = apply_fn(params, rollout.bootstrap_obs)
     bootstrap_value = jax.lax.stop_gradient(bootstrap_value)
     discounts = rollout.discounts(config.gamma)
 
+    if config.algo == "qlearn":
+        # Same construction as the unsharded branch, via the same shared
+        # pieces: online Q locally per time shard, the shared
+        # ``qlearn_bootstrap`` target selection, the distributed
+        # n-step-return solve, and the canonical ``qlearn_loss`` fed the
+        # precomputed returns (its ``returns=`` kwarg, like a3c's).
+        q_target = apply_fn(target_params, rollout.bootstrap_obs)[0]
+        boot = qlearn_bootstrap(config, boot_logits, q_target)
+        returns = n_step_returns_timesharded(
+            rollout.rewards, discounts, boot
+        )
+        return qlearn_loss(
+            logits_t, rollout.actions, rollout.rewards, discounts, boot,
+            returns=returns,
+        )
     if config.algo == "a3c":
         returns = n_step_returns_timesharded(
             rollout.rewards, discounts, bootstrap_value
@@ -226,12 +248,9 @@ class RolloutLearner:
                     "multi-epoch/minibatched PPO is not time-shardable; "
                     "use ppo_epochs=ppo_minibatches=1"
                 )
-            if config.algo == "qlearn":
-                raise NotImplementedError(
-                    "algo='qlearn' is not time-shardable yet (its n-step "
-                    "returns lack the timeshard plumbing); use a dp-only "
-                    "mesh"
-                )
+            # (qlearn time-shards via n_step_returns_timesharded; its
+            # recurrent DRQN variant is excluded by the is_recurrent check
+            # above like every recurrent core.)
         config = resolve_scan_impl(config, mesh)
         self.config = config
         self.spec = spec
@@ -273,6 +292,7 @@ class RolloutLearner:
                         loss, metrics = _algo_loss_timesharded(
                             config, napply, p, rollout,
                             reduce_axes=reduce_axes, dist=dist,
+                            target_params=state.target_params,
                         )
                     else:
                         loss, metrics = _algo_loss(

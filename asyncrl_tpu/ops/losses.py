@@ -121,6 +121,7 @@ def qlearn_loss(
     discounts: jax.Array,
     bootstrap_value: jax.Array,
     scan_impl: str = "associative",
+    returns=None,
 ):
     """Async n-step Q-learning loss (the A3C paper's value-based sibling,
     PAPERS.md:8): every step in the fragment regresses Q(s_t, a_t) onto the
@@ -132,13 +133,18 @@ def qlearn_loss(
     ``n_step_returns``' associative-scan / Pallas implementations).
     ``bootstrap_value`` [B] is the caller-selected target-network bootstrap
     (``max_a Q_target`` or the double-Q selection); ``q_values`` [T, B, A]
-    come from the online params.
+    come from the online params. ``returns`` may be passed precomputed
+    (the time-sharded learner builds them with
+    ``parallel.timeshard.n_step_returns_timesharded``), mirroring
+    ``a3c_loss``'s kwarg.
     """
-    # n_step_returns stop-gradients its inputs (fixed-target contract, same
-    # as the a3c path); no second guard needed here.
-    returns = n_step_returns(
-        rewards, discounts, bootstrap_value, scan_impl=scan_impl
-    )
+    if returns is None:
+        # n_step_returns stop-gradients its inputs (fixed-target contract,
+        # same as the a3c path); no second guard needed here.
+        returns = n_step_returns(
+            rewards, discounts, bootstrap_value, scan_impl=scan_impl
+        )
+    returns = jax.lax.stop_gradient(returns)
     q_taken = jnp.take_along_axis(
         q_values, actions[..., None].astype(jnp.int32), axis=-1
     )[..., 0]

@@ -467,17 +467,21 @@ def test_drqn_host_pipeline():
         agent.close()
 
 
-def test_qlearn_rejects_time_sharding():
+def test_drqn_rejects_time_sharding():
+    """Feed-forward qlearn time-shards (equality-tested in test_timeshard);
+    the recurrent DRQN variant cannot (sequential carry)."""
     from asyncrl_tpu.envs.cartpole import CartPole
     from asyncrl_tpu.learn.rollout_learner import RolloutLearner
     from asyncrl_tpu.models.networks import build_model
     from asyncrl_tpu.parallel.mesh import make_mesh
 
-    cfg = presets.get("cartpole_qlearn").replace(unroll_len=8)
+    cfg = presets.get("cartpole_qlearn").replace(
+        unroll_len=8, core="lstm", core_size=16
+    )
     env = CartPole()
     model = build_model(cfg, env.spec)
     mesh = make_mesh((4, 2), ("dp", "sp"))
-    with pytest.raises(NotImplementedError, match="time-shard"):
+    with pytest.raises(NotImplementedError, match="recurrent cores"):
         RolloutLearner(cfg, env.spec, model, mesh)
 
 

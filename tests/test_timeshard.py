@@ -186,7 +186,7 @@ def test_gae_timesharded_matches_single_device(devices):
     )
 
 
-@pytest.mark.parametrize("algo", ["a3c", "impala", "ppo"])
+@pytest.mark.parametrize("algo", ["a3c", "impala", "ppo", "qlearn"])
 def test_rollout_learner_timesharded_equals_dp_only(algo, devices):
     """The HOST-FRAGMENT learner on a (dp x sp) mesh must produce the same
     post-update params as on a dp-only mesh — the end-to-end check that the
@@ -201,7 +201,7 @@ def test_rollout_learner_timesharded_equals_dp_only(algo, devices):
 
     cfg = Config(
         algo=algo, unroll_len=8, num_envs=8, precision="f32",
-        ppo_epochs=1, ppo_minibatches=1,
+        ppo_epochs=1, ppo_minibatches=1, actor_staleness=2,
     )
     env = CartPole()
     model = build_model(cfg, env.spec)
