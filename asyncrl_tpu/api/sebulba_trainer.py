@@ -184,6 +184,8 @@ class SebulbaTrainer:
             device=self._actor_device,
             initial_core=self._initial_core,
             epsilon_fn=self._epsilon_fn(index),
+            track_returns=self.config.normalize_returns,
+            return_discount=self.config.gamma,
         )
         actor.start()
         return actor
@@ -287,8 +289,15 @@ class SebulbaTrainer:
                     continue
                 rollout = fragment.rollout
                 if cfg.reward_scale != 1.0:
+                    # Scale the discounted-return stream with the rewards:
+                    # the stats must track the learner's reward view.
                     rollout = rollout.replace(
-                        rewards=rollout.rewards * cfg.reward_scale
+                        rewards=rollout.rewards * cfg.reward_scale,
+                        disc_returns=(
+                            None
+                            if rollout.disc_returns is None
+                            else rollout.disc_returns * cfg.reward_scale
+                        ),
                     )
                 rollout = self.learner.put_rollout(rollout)
                 self.state, metrics = self.learner.update(self.state, rollout)

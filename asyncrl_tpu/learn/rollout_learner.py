@@ -76,12 +76,15 @@ class LearnerState:
     # unless config.normalize_obs. Published to host actors alongside the
     # params (SebulbaTrainer bundles them through the ParamStore).
     obs_stats: Any = None
+    # Running scalar stats of the per-env discounted return (reward
+    # normalization, config.normalize_returns); None when disabled.
+    ret_stats: Any = None
 
 
 def learner_state_spec() -> LearnerState:
     return LearnerState(
         params=P(), opt_state=P(), update_step=P(), target_params=P(),
-        obs_stats=P(),
+        obs_stats=P(), ret_stats=P(),
     )
 
 
@@ -104,6 +107,7 @@ def rollout_partition_spec(
         truncated=tm,
         bootstrap_obs=P(axes),
         init_core=P(axes),
+        disc_returns=tm,
     )
 
 
@@ -127,6 +131,9 @@ def rollout_sharding(mesh: Mesh, rollout: Rollout) -> Rollout:
             None
             if rollout.init_core is None
             else jax.tree.map(lambda _: batch_first, rollout.init_core)
+        ),
+        disc_returns=(
+            None if rollout.disc_returns is None else time_major
         ),
     )
 
@@ -220,13 +227,6 @@ class RolloutLearner:
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
         validate_qlearn_config(config)
-        if config.normalize_returns:
-            raise NotImplementedError(
-                "normalize_returns is Anakin-only (backend='tpu'): host "
-                "fragments carry no discounted-return stream (the per-env "
-                "accumulator lives in the device actor state); use "
-                "reward_scale on host backends"
-            )
         time_sharded = TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1
         if time_sharded:
             sp = mesh.shape[TIME_AXIS]
@@ -274,8 +274,15 @@ class RolloutLearner:
         def update_body(state: LearnerState, rollout: Rollout):
             # Observation normalization (ops/normalize.py): this step's
             # forwards all use the pre-update stats; the fragment's obs
-            # fold in afterwards.
+            # fold in afterwards. Reward normalization likewise scales this
+            # fragment by the PRE-update return std.
             napply = normalizing_apply(apply_fn, state.obs_stats)
+            if config.normalize_returns:
+                ret_var = state.ret_stats.m2 / state.ret_stats.count
+                rollout = rollout.replace(
+                    rewards=rollout.rewards
+                    * jax.lax.rsqrt(jnp.maximum(ret_var, 1e-8))
+                )
             if ppo_multipass:
                 params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
                     config, napply, optimizer, dist,
@@ -333,12 +340,18 @@ class RolloutLearner:
                 obs_stats = update_stats(
                     obs_stats, rollout.obs, reduce_axes
                 )
+            ret_stats = state.ret_stats
+            if ret_stats is not None:
+                ret_stats = update_stats(
+                    ret_stats, rollout.disc_returns, reduce_axes
+                )
             new_state = LearnerState(
                 params=params,
                 opt_state=opt_state,
                 update_step=step,
                 target_params=target_params,
                 obs_stats=obs_stats,
+                ret_stats=ret_stats,
             )
             return new_state, metrics
 
@@ -367,6 +380,10 @@ class RolloutLearner:
             obs=None, actions=None, behaviour_logp=None, rewards=None,
             terminated=None, truncated=None, bootstrap_obs=None,
             init_core=model.initial_core(1) if is_recurrent(model) else None,
+            # Placeholder non-None leaf: the stream must get its time-major
+            # sharding like every other fragment field (a None here would
+            # device_put it uncommitted).
+            disc_returns=0.0 if config.normalize_returns else None,
         )
         self._rollout_sharding = rollout_sharding(mesh, template)
 
@@ -394,6 +411,11 @@ class RolloutLearner:
             obs_stats=(
                 jax.device_put(init_stats(self.spec.obs_shape), rep)
                 if self.config.normalize_obs
+                else None
+            ),
+            ret_stats=(
+                jax.device_put(init_stats(()), rep)
+                if self.config.normalize_returns
                 else None
             ),
         )

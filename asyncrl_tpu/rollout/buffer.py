@@ -76,7 +76,14 @@ class RolloutBuffer:
     ``emit`` copies, so fragments are safe to retain after ``reset``.
     """
 
-    def __init__(self, unroll_len: int, num_envs: int, obs_shape, obs_dtype):
+    def __init__(
+        self,
+        unroll_len: int,
+        num_envs: int,
+        obs_shape,
+        obs_dtype,
+        track_returns: bool = False,
+    ):
         T, B = unroll_len, num_envs
         self.unroll_len = T
         self.num_envs = B
@@ -85,6 +92,11 @@ class RolloutBuffer:
         self.rewards = np.empty((T, B), np.float32)
         self.terminated = np.empty((T, B), bool)
         self.truncated = np.empty((T, B), bool)
+        # Per-step discounted-return stream for reward normalization
+        # (mirrors rollout.anakin's disc_returns); None unless tracked.
+        self.disc_returns = (
+            np.empty((T, B), np.float32) if track_returns else None
+        )
         self.actions: np.ndarray | None = None
         self._t = 0
 
@@ -95,12 +107,22 @@ class RolloutBuffer:
     def full(self) -> bool:
         return self._t == self.unroll_len
 
-    def append(self, obs, action, logp, reward, terminated, truncated) -> None:
+    def append(
+        self, obs, action, logp, reward, terminated, truncated,
+        disc_return=None,
+    ) -> None:
         """Record one transition: ``obs`` is what the policy saw choosing
-        ``action``; reward/terminated/truncated describe the step outcome."""
+        ``action``; reward/terminated/truncated describe the step outcome.
+        ``disc_return`` is required exactly when the buffer tracks the
+        discounted-return stream."""
         t = self._t
         if t >= self.unroll_len:
             raise IndexError(f"buffer full at t={t}; call emit()/reset()")
+        if (disc_return is None) != (self.disc_returns is None):
+            raise ValueError(
+                "disc_return must be passed iff the buffer was built with "
+                "track_returns=True"
+            )
         action = np.asarray(action)
         if self.actions is None:
             self.actions = np.empty(
@@ -113,6 +135,8 @@ class RolloutBuffer:
         self.rewards[t] = reward
         self.terminated[t] = terminated
         self.truncated[t] = truncated
+        if self.disc_returns is not None:
+            self.disc_returns[t] = disc_return
         self._t = t + 1
 
     def emit(self, bootstrap_obs) -> Rollout:
@@ -129,6 +153,9 @@ class RolloutBuffer:
             terminated=self.terminated.copy(),
             truncated=self.truncated.copy(),
             bootstrap_obs=np.asarray(bootstrap_obs).copy(),
+            disc_returns=(
+                None if self.disc_returns is None else self.disc_returns.copy()
+            ),
         )
         self._t = 0
         return rollout

@@ -295,6 +295,8 @@ class ActorThread(threading.Thread):
         device=None,
         initial_core: Callable[[int], Any] | None = None,
         epsilon_fn: Callable[[int], np.ndarray] | None = None,
+        track_returns: bool = False,
+        return_discount: float = 0.0,
     ):
         super().__init__(name=f"actor-{index}", daemon=True)
         self.index = index
@@ -313,6 +315,13 @@ class ActorThread(threading.Thread):
         # per-env behaviour ε vector [B] (the A3C paper's per-thread ε,
         # annealed). None for the policy-gradient algos.
         self.epsilon_fn = epsilon_fn
+        # normalize_returns: when ``track_returns`` (the SAME predicate the
+        # learner keys its stats on — a discount of 0 must degrade to
+        # reward-std tracking, not disagree), record the per-env
+        # discounted-return stream G = discount*G + r (RAW rewards; the
+        # trainer scales the stream together with the rewards).
+        self.track_returns = track_returns
+        self.return_discount = return_discount
         # ``jax.default_device`` is thread-local, so a device pin must be
         # re-established INSIDE the thread: the cpu_async backend pins actors
         # to host CPU (never touching an attached accelerator); sebulba
@@ -345,7 +354,11 @@ class ActorThread(threading.Thread):
         obs = pool.reset()
         key = jax.random.PRNGKey(self.seed)
 
-        buffer = RolloutBuffer(T, B, obs.shape[1:], obs.dtype)
+        track_returns = self.track_returns
+        buffer = RolloutBuffer(
+            T, B, obs.shape[1:], obs.dtype, track_returns=track_returns
+        )
+        disc_g = np.zeros((B,), np.float32)
         running_return = np.zeros((B,), np.float64)
         running_length = np.zeros((B,), np.float64)
         core = self.initial_core(B) if self.initial_core else None
@@ -388,7 +401,19 @@ class ActorThread(threading.Thread):
                 actions = np.asarray(actions_d)
                 prev_obs = obs
                 obs, rew, term, trunc = pool.step(actions)
-                buffer.append(prev_obs, actions, np.asarray(logp_d), rew, term, trunc)
+                if track_returns:
+                    disc_g = self.return_discount * disc_g + rew
+                    buffer.append(
+                        prev_obs, actions, np.asarray(logp_d), rew, term,
+                        trunc, disc_return=disc_g,
+                    )
+                    disc_g = np.where(
+                        np.logical_or(term, trunc), 0.0, disc_g
+                    ).astype(np.float32)
+                else:
+                    buffer.append(
+                        prev_obs, actions, np.asarray(logp_d), rew, term, trunc
+                    )
                 done_prev = np.logical_or(term, trunc)
                 frames += B
 

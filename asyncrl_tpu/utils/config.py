@@ -72,7 +72,8 @@ class Config:
     # Return-based reward scaling (VecNormalize's other half / the Brax
     # recipe): rewards divide by the running std of the per-env discounted
     # return before the loss — an adaptive, workload-independent
-    # reward_scale. Episode-return metrics stay raw. Anakin backend only.
+    # reward_scale. Episode-return metrics stay raw. All backends (host
+    # actors record the discounted-return stream into each fragment).
     normalize_returns: bool = False
 
     # --- IMPALA / V-trace ---
