@@ -109,10 +109,32 @@ def timed_update_window(
     return state, timed, elapsed
 
 
+def _accelerator_alive_with_retry(
+    attempts: int = 3, wait_s: float = 60.0
+) -> bool:
+    """The axon tunnel goes down for stretches and recovers on its own
+    (observed multiple multi-hour outages); a benchmark run is rare and
+    valuable enough to wait out a transient blip before settling for the
+    CPU-fallback datapoint."""
+    import time
+
+    for attempt in range(attempts):
+        if _accelerator_alive():
+            return True
+        if attempt + 1 < attempts:
+            print(
+                f"bench: accelerator probe {attempt + 1}/{attempts} failed; "
+                f"retrying in {wait_s:.0f}s",
+                file=sys.stderr,
+            )
+            time.sleep(wait_s)
+    return False
+
+
 def main() -> None:
     import jax
 
-    if not _accelerator_alive():
+    if not _accelerator_alive_with_retry():
         jax.config.update("jax_platforms", "cpu")
         print(
             "bench: accelerator backend hung/unavailable; falling back to "

@@ -20,7 +20,10 @@ import sys
 # Shared measurement harness (liveness probe, sync discipline, execution
 # guard) lives in bench.py at the repo root — ONE copy for both entry points.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from bench import _accelerator_alive, timed_update_window  # noqa: E402
+from bench import (  # noqa: E402
+    _accelerator_alive_with_retry,
+    timed_update_window,
+)
 
 DEFAULT_PRESETS = [
     "cartpole_impala",
@@ -75,7 +78,7 @@ def bench_one(preset_name: str, overrides: list[str]) -> dict:
 def main() -> int:
     import jax
 
-    if not _accelerator_alive():
+    if not _accelerator_alive_with_retry():
         # Same guard as bench.py: a hung axon tunnel would otherwise block
         # the first device query forever.
         jax.config.update("jax_platforms", "cpu")
