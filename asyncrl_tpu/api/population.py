@@ -99,6 +99,12 @@ class PopulationTrainer:
                 "updates_per_call > 1 is not wired for population training "
                 "(the fused-K scan lives in Learner); use the default of 1"
             )
+        if config.checkpoint_best:
+            raise NotImplementedError(
+                "checkpoint_best is not wired for population training "
+                "(no in-training eval path ranks the members); use the "
+                "single-run trainers"
+            )
         # Same eager geometry validation as Learner.__init__ (clearer than
         # a trace-time failure inside the first update).
         validate_ppo_geometry(config, config.num_envs, "per-member")

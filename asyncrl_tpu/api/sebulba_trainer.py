@@ -354,6 +354,9 @@ class SebulbaTrainer:
                         agg["eval_return"] = self.evaluate(
                             num_episodes=cfg.eval_episodes
                         )
+                        self._ckpt.maybe_save_best(
+                            self.state, self.env_steps, agg["eval_return"]
+                        )
                     history.append(agg)
                     if callback:
                         callback(agg)

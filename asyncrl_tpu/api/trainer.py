@@ -151,6 +151,9 @@ class Trainer:
                         agg["eval_return"] = self.evaluate(
                             num_episodes=cfg.eval_episodes
                         )
+                        self._ckpt.maybe_save_best(
+                            self.state, self.env_steps, agg["eval_return"]
+                        )
                         window_start = time.perf_counter()
                     history.append(agg)
                     if callback:

@@ -56,6 +56,7 @@ class Checkpointer:
             )
         self._last_saved: int | None = None
         self._restored_step: int | None = None
+        self._extra_meta: dict = {}
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -100,15 +101,35 @@ class Checkpointer:
         self._last_saved = step
 
     def _do_save(self, step: int, state: Any, env_steps: int) -> None:
+        meta = {"env_steps": int(env_steps)}
+        meta.update(self._extra_meta)
         self._mngr.save(
             int(step),
             args=ocp.args.Composite(
                 **{
                     STATE_KEY: ocp.args.StandardSave(state),
-                    META_KEY: ocp.args.JsonSave({"env_steps": int(env_steps)}),
+                    META_KEY: ocp.args.JsonSave(meta),
                 }
             ),
         )
+
+    def set_extra_meta(self, **kv) -> None:
+        """Additional JSON-able metadata carried by subsequent saves (e.g.
+        the best-eval score for the best-checkpoint policy)."""
+        self._extra_meta = dict(kv)
+
+    def read_meta(self, step: int | None = None) -> dict:
+        """The metadata dict of ``step`` (latest by default) without
+        restoring the state pytree."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return {}
+        restored = self._mngr.restore(
+            int(step),
+            args=ocp.args.Composite(**{META_KEY: ocp.args.JsonRestore()}),
+        )
+        return restored[META_KEY] or {}
 
     # --------------------------------------------------------------- restore
 
@@ -178,10 +199,21 @@ class TrainerCheckpointing:
     optional ``Checkpointer`` (None → everything is a no-op except
     ``save_now``, which raises)."""
 
-    def __init__(self, checkpointer: "Checkpointer | None", every: int):
+    def __init__(
+        self,
+        checkpointer: "Checkpointer | None",
+        every: int,
+        best_dir: str | None = None,
+    ):
         self.checkpointer = checkpointer
         self.every = every
         self._since = 0
+        # Best-eval retention (config.checkpoint_best): its own one-slot
+        # Checkpointer beside the main directory, created lazily; the best
+        # score survives resume via the checkpoint metadata.
+        self._best_dir = best_dir
+        self._best: "Checkpointer | None" = None
+        self._best_score: float | None = None
 
     def save_now(self, state: Any, env_steps: int) -> None:
         if self.checkpointer is None:
@@ -199,6 +231,34 @@ class TrainerCheckpointing:
             self._since = 0
             self.save_now(state, env_steps)
 
+    def maybe_save_best(
+        self, state: Any, env_steps: int, score: float
+    ) -> bool:
+        """Save ``state`` to the best-checkpoint slot if ``score`` beats the
+        best seen (including across resumes). Returns whether it saved.
+
+        Non-finite scores never qualify: NaN compares False against
+        everything, so without the guard a diverged run's NaN eval would
+        overwrite the genuine best and then lose every later comparison."""
+        import math
+
+        if self._best_dir is None or not math.isfinite(score):
+            return False
+        if self._best is None:
+            self._best = Checkpointer(self._best_dir, max_to_keep=1)
+            prev = self._best.read_meta().get("eval_return")
+            self._best_score = (
+                float(prev)
+                if prev is not None and math.isfinite(float(prev))
+                else None
+            )
+        if self._best_score is not None and score <= self._best_score:
+            return False
+        self._best_score = float(score)
+        self._best.set_extra_meta(eval_return=float(score))
+        self._best.save(_step_of(state), state, env_steps)
+        return True
+
     def finalize(self, state: Any, env_steps: int) -> None:
         """Call from the train loop's ``finally``: save final state and
         flush async writes. When an exception is already propagating, a
@@ -210,6 +270,10 @@ class TrainerCheckpointing:
         try:
             self.save_now(state, env_steps)
             self.checkpointer.wait()
+            if self._best is not None:
+                # The crash contract covers the best slot too: an in-flight
+                # async best save must be durable before the process dies.
+                self._best.wait()
         except Exception:
             if not in_flight:
                 raise
@@ -221,6 +285,9 @@ class TrainerCheckpointing:
             )
 
     def close(self) -> None:
+        if self._best is not None:
+            self._best.close()
+            self._best = None
         if self.checkpointer is not None:
             self.checkpointer.close()
 
@@ -239,6 +306,13 @@ def setup(config, restore: str | None, state):
       auto-resumes from its latest step — crash recovery (SURVEY.md §5.3/5.4);
     - both unset → a no-op hook.
     """
+    if config.checkpoint_best and not (
+        config.checkpoint_dir and config.eval_every > 0
+    ):
+        raise ValueError(
+            "checkpoint_best requires BOTH checkpoint_dir (somewhere to "
+            "save) and eval_every > 0 (a score to rank by)"
+        )
     env_steps = 0
     if restore is not None:
         with Checkpointer(restore, create=False) as src:
@@ -266,8 +340,28 @@ def setup(config, restore: str | None, state):
                 f"{_step_of(state)} from {restore!r}; use a fresh "
                 "checkpoint_dir or clean the old run's checkpoints"
             )
+    best_dir = (
+        config.checkpoint_dir.rstrip("/") + "-best"
+        if config.checkpoint_best
+        else None
+    )
+    if (
+        best_dir is not None
+        and ckpt.latest_step() is None  # fresh run (nothing to resume)
+        and os.path.isdir(best_dir)
+        and any(d.isdigit() for d in os.listdir(best_dir))
+    ):
+        # Same cross-run protection the main dir gets above: a stale best
+        # slot from another run would silently gate (and keep) that run's
+        # state instead of this one's.
+        ckpt.close()
+        raise ValueError(
+            f"{best_dir!r} holds another run's best checkpoint but "
+            f"{config.checkpoint_dir!r} has no history to resume; clean "
+            "the stale -best directory or use a fresh checkpoint_dir"
+        )
     return (
-        TrainerCheckpointing(ckpt, config.checkpoint_every),
+        TrainerCheckpointing(ckpt, config.checkpoint_every, best_dir),
         state,
         env_steps,
     )

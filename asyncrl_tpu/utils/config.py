@@ -132,6 +132,12 @@ class Config:
     # or crashed — still happens).
     checkpoint_every: int = 0
     checkpoint_dir: str = ""
+    # Keep the best-evaluation checkpoint (requires checkpoint_dir AND
+    # eval_every): whenever an in-training eval improves on the best
+    # eval_return so far, the full state also saves under
+    # "<checkpoint_dir>-best" (one retained copy; the best score survives
+    # resume via the checkpoint metadata).
+    checkpoint_best: bool = False
     precision: str = "bf16_matmul"  # "f32" | "bf16_matmul"
     # V-trace/GAE reverse-scan implementation (ops/scan.py). "auto"
     # currently resolves to "associative" everywhere (see

@@ -300,3 +300,93 @@ def test_sharded_actor_state_restores_sharded(tmp_path):
     assert restored.params is not t.state.params
     leaf = jax.tree.leaves(restored.params)[0]
     assert leaf.sharding == jax.tree.leaves(t.state.params)[0].sharding
+
+
+def test_checkpoint_best_saves_improvements_only(tmp_path, monkeypatch):
+    """The best slot saves exactly when eval_return improves, carries the
+    score in its metadata, and survives resume (a worse later eval must not
+    overwrite it after restart)."""
+    from asyncrl_tpu import make_agent
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    cfg = small_cfg(
+        algo="a3c",
+        checkpoint_dir=str(tmp_path / "ck"),
+        eval_every=1,
+        eval_episodes=2,
+        checkpoint_best=True,
+        log_every=1,
+    )
+    agent = make_agent(cfg)
+    scores = iter([10.0, 30.0, 20.0])
+
+    def fake_eval(self, num_episodes=32, max_steps=3200, seed=1234,
+                  return_episodes=False):
+        return next(scores)
+
+    monkeypatch.setattr(type(agent), "evaluate", fake_eval)
+    try:
+        agent.train(total_env_steps=3 * cfg.batch_steps_per_update)
+    finally:
+        agent.close()
+    with Checkpointer(str(tmp_path / "ck-best"), create=False) as best:
+        meta = best.read_meta()
+        assert meta["eval_return"] == 30.0
+        assert len(best.all_steps()) == 1  # one retained slot
+
+    # Resume: the persisted best score must gate later, WORSE evals.
+    agent2 = make_agent(cfg)
+    scores2 = iter([25.0])
+    monkeypatch.setattr(
+        type(agent2), "evaluate",
+        lambda self, **kw: next(scores2),
+    )
+    try:
+        agent2.train(total_env_steps=4 * cfg.batch_steps_per_update)
+    finally:
+        agent2.close()
+    with Checkpointer(str(tmp_path / "ck-best"), create=False) as best:
+        assert best.read_meta()["eval_return"] == 30.0
+
+
+def test_checkpoint_best_requires_dir_and_eval(tmp_path):
+    from asyncrl_tpu import make_agent
+
+    with pytest.raises(ValueError, match="checkpoint_best requires"):
+        make_agent(small_cfg(checkpoint_best=True))
+    with pytest.raises(ValueError, match="checkpoint_best requires"):
+        make_agent(
+            small_cfg(
+                checkpoint_best=True, checkpoint_dir=str(tmp_path / "x")
+            )
+        )
+
+
+def test_checkpoint_best_rejects_nan_and_stale_dir(tmp_path, monkeypatch):
+    from asyncrl_tpu import make_agent
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    cfg = small_cfg(
+        algo="a3c", checkpoint_dir=str(tmp_path / "ck"), eval_every=1,
+        eval_episodes=2, checkpoint_best=True, log_every=1,
+    )
+    agent = make_agent(cfg)
+    scores = iter([20.0, float("nan"), 5.0])
+    monkeypatch.setattr(
+        type(agent), "evaluate", lambda self, **kw: next(scores)
+    )
+    try:
+        agent.train(total_env_steps=3 * cfg.batch_steps_per_update)
+    finally:
+        agent.close()
+    with Checkpointer(str(tmp_path / "ck-best"), create=False) as best:
+        # NaN never saves, and 5.0 < 20.0 never saves: the real best holds.
+        assert best.read_meta()["eval_return"] == 20.0
+
+    # Stale -best with a FRESH main dir must refuse, like the main-dir
+    # cross-run guard.
+    import shutil
+
+    shutil.rmtree(tmp_path / "ck")
+    with pytest.raises(ValueError, match="another run's best"):
+        make_agent(cfg)
