@@ -289,7 +289,7 @@ def _algo_loss(
         boot = qlearn_bootstrap(config, logits[-1], q_target)
         return qlearn_loss(
             logits_t, rollout.actions, rollout.rewards, discounts, boot,
-            scan_impl=config.scan_impl,
+            scan_impl=config.scan_impl, huber_delta=config.huber_delta,
         )
     if config.algo == "a3c":
         return a3c_loss(

@@ -170,7 +170,7 @@ def _algo_loss_timesharded(
         )
         return qlearn_loss(
             logits_t, rollout.actions, rollout.rewards, discounts, boot,
-            returns=returns,
+            returns=returns, huber_delta=config.huber_delta,
         )
     if config.algo == "a3c":
         returns = n_step_returns_timesharded(

@@ -122,6 +122,7 @@ def qlearn_loss(
     bootstrap_value: jax.Array,
     scan_impl: str = "associative",
     returns=None,
+    huber_delta: float = 0.0,
 ):
     """Async n-step Q-learning loss (the A3C paper's value-based sibling,
     PAPERS.md:8): every step in the fragment regresses Q(s_t, a_t) onto the
@@ -149,7 +150,14 @@ def qlearn_loss(
         q_values, actions[..., None].astype(jnp.int32), axis=-1
     )[..., 0]
     td_error = returns - q_taken
-    loss = 0.5 * jnp.mean(jnp.square(td_error))
+    if huber_delta > 0.0:
+        # Huber TD loss (the DQN default, delta=1): quadratic near zero,
+        # linear beyond delta — caps the gradient of outlier TD errors.
+        import optax
+
+        loss = jnp.mean(optax.losses.huber_loss(td_error, delta=huber_delta))
+    else:
+        loss = 0.5 * jnp.mean(jnp.square(td_error))
     metrics = {
         "value_loss": loss,
         "td_abs": jnp.mean(jnp.abs(td_error)),

@@ -347,18 +347,22 @@ def setup(config, restore: str | None, state):
     )
     if (
         best_dir is not None
-        and ckpt.latest_step() is None  # fresh run (nothing to resume)
+        and ckpt.latest_step() is None  # no main history to resume
         and os.path.isdir(best_dir)
         and any(d.isdigit() for d in os.listdir(best_dir))
     ):
-        # Same cross-run protection the main dir gets above: a stale best
-        # slot from another run would silently gate (and keep) that run's
-        # state instead of this one's.
-        ckpt.close()
-        raise ValueError(
-            f"{best_dir!r} holds another run's best checkpoint but "
-            f"{config.checkpoint_dir!r} has no history to resume; clean "
-            "the stale -best directory or use a fresh checkpoint_dir"
+        # A populated -best beside an empty main dir is ambiguous: either a
+        # stale slot from ANOTHER run (whose score would now gate this
+        # run's saves), or THIS run crashed before its first main save —
+        # indistinguishable, so warn loudly rather than lock the operator
+        # out of a legitimate restart. The existing best keeps gating by
+        # score, exactly as a resumed run would.
+        print(
+            f"asyncrl_tpu: {best_dir!r} already holds a best checkpoint "
+            f"but {config.checkpoint_dir!r} has no history — if that slot "
+            "is from a DIFFERENT run, delete it; its recorded score will "
+            "otherwise gate this run's best saves.",
+            file=sys.stderr,
         )
     return (
         TrainerCheckpointing(ckpt, config.checkpoint_every, best_dir),

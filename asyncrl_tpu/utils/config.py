@@ -99,6 +99,11 @@ class Config:
     # Dueling Q decomposition (Wang et al. 2016): separate value/advantage
     # streams, Q = V + A - mean(A).
     dueling: bool = False
+    # Huber TD loss delta (the DQN default is 1.0); 0 = plain squared TD.
+    # Pair with normalize_returns or reward_scale: Huber caps the TD
+    # gradient at delta, so unscaled returns-sized TDs learn very slowly
+    # (DQN uses it WITH reward clipping).
+    huber_delta: float = 0.0
 
     # --- parallelism ---
     mesh_shape: tuple[int, ...] = (-1,)  # -1: all local devices on axis "dp"

@@ -383,10 +383,13 @@ def test_checkpoint_best_rejects_nan_and_stale_dir(tmp_path, monkeypatch):
         # NaN never saves, and 5.0 < 20.0 never saves: the real best holds.
         assert best.read_meta()["eval_return"] == 20.0
 
-    # Stale -best with a FRESH main dir must refuse, like the main-dir
-    # cross-run guard.
+    # Stale/orphaned -best beside an empty main dir: warn (the crashed-
+    # before-first-main-save case must stay restartable), keep gating.
     import shutil
 
     shutil.rmtree(tmp_path / "ck")
-    with pytest.raises(ValueError, match="another run's best"):
-        make_agent(cfg)
+    agent3 = make_agent(cfg)  # warns on stderr, does not raise
+    try:
+        assert agent3._ckpt._best_dir is not None
+    finally:
+        agent3.close()

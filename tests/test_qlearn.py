@@ -90,6 +90,42 @@ def test_qlearn_loss_fixture():
     )
 
 
+def test_huber_td_loss_fixture():
+    """delta=1: quadratic inside |td|<=1, linear outside; delta=0 is MSE."""
+    q = jnp.zeros((2, 1, 2))
+    actions = jnp.zeros((2, 1), jnp.int32)
+    rewards = jnp.asarray([[0.5], [0.0]])
+    discounts = jnp.zeros((2, 1))
+    bootstrap = jnp.zeros((1,))
+    # returns: [0.5, 0.0]; with q=0: td = [0.5, 0.0]
+    loss_mse, _ = qlearn_loss(q, actions, rewards, discounts, bootstrap)
+    np.testing.assert_allclose(
+        float(loss_mse), 0.5 * (0.25 + 0.0) / 2, rtol=1e-6
+    )
+    loss_h, _ = qlearn_loss(
+        q, actions, rewards, discounts, bootstrap, huber_delta=1.0
+    )
+    np.testing.assert_allclose(float(loss_h), float(loss_mse), rtol=1e-6)
+    # Large TD (returns 10): huber caps it at delta*(10 - 0.5).
+    big = jnp.asarray([[10.0], [0.0]])
+    loss_big, _ = qlearn_loss(
+        q, actions, big, discounts, bootstrap, huber_delta=1.0
+    )
+    np.testing.assert_allclose(
+        float(loss_big), (1.0 * (10.0 - 0.5) + 0.0) / 2, rtol=1e-6
+    )
+    agent = make_agent(
+        presets.get("cartpole_qlearn").replace(
+            num_envs=8, unroll_len=4, huber_delta=1.0, precision="f32"
+        )
+    )
+    try:
+        _, metrics = agent.learner.update(agent.state)
+        assert np.isfinite(float(metrics["loss"]))
+    finally:
+        agent.close()
+
+
 def test_terminal_cuts_bootstrap():
     """A terminated step inside the fragment must stop the return from
     leaking the bootstrap across the episode boundary."""
