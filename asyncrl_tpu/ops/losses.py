@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import optax
 
 from asyncrl_tpu.ops.gae import GAEOutput, gae, n_step_returns
 from asyncrl_tpu.ops.vtrace import vtrace
@@ -153,8 +154,6 @@ def qlearn_loss(
     if huber_delta > 0.0:
         # Huber TD loss (the DQN default, delta=1): quadratic near zero,
         # linear beyond delta — caps the gradient of outlier TD errors.
-        import optax
-
         loss = jnp.mean(optax.losses.huber_loss(td_error, delta=huber_delta))
     else:
         loss = 0.5 * jnp.mean(jnp.square(td_error))

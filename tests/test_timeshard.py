@@ -202,6 +202,8 @@ def test_rollout_learner_timesharded_equals_dp_only(algo, devices):
     cfg = Config(
         algo=algo, unroll_len=8, num_envs=8, precision="f32",
         ppo_epochs=1, ppo_minibatches=1, actor_staleness=2,
+        # qlearn additionally exercises the Huber branch on both paths.
+        huber_delta=1.0 if algo == "qlearn" else 0.0,
     )
     env = CartPole()
     model = build_model(cfg, env.spec)
