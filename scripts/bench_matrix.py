@@ -65,13 +65,18 @@ def bench_one(preset_name: str, overrides: list[str]) -> dict:
     if not (np.isfinite(delta) and delta > 0.0):
         raise RuntimeError(f"param delta {delta}: training did not move")
     fps = timed * cfg.updates_per_call * cfg.num_envs * cfg.unroll_len / elapsed
+
+    from asyncrl_tpu.utils import bench_history
+
+    dev = bench_history.device_entry()
+    bench_history.record_throughput(preset_name, cfg, fps)
     return {
         "preset": preset_name,
         "env_id": cfg.env_id,
         "num_envs": cfg.num_envs,
         "unroll_len": cfg.unroll_len,
         "frames_per_sec": round(fps),
-        "device": f"{jax.devices()[0].device_kind} x{jax.device_count()}",
+        "device": f"{dev['device_kind']} x{dev['device_count']}",
     }
 
 
