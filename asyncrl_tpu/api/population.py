@@ -132,12 +132,15 @@ class PopulationTrainer:
                     "per-member learning_rates and lr_schedule are mutually "
                     "exclusive (the injected rate is a constant per member)"
                 )
-            # Same chain as make_optimizer, but with the adam step's rate
+            # Same chain as make_optimizer, but with the base step's rate
             # injected through opt_state so it can differ per member.
+            from asyncrl_tpu.learn.learner import base_optimizer
+
+            base, base_kwargs = base_optimizer(config)
             self.optimizer = optax.chain(
                 optax.clip_by_global_norm(config.max_grad_norm),
-                optax.inject_hyperparams(optax.adam)(
-                    learning_rate=config.learning_rate, eps=config.adam_eps
+                optax.inject_hyperparams(base)(
+                    learning_rate=config.learning_rate, **base_kwargs
                 ),
             )
             self._member_lrs = jnp.asarray(learning_rates, jnp.float32)

@@ -107,7 +107,7 @@ class SebulbaTrainer:
         self._initial_core = (
             self.model.initial_core if is_recurrent(self.model) else None
         )
-        self._store = ParamStore(self._published(self.state))
+        self._store = ParamStore(self._published(self.state), self.env_steps)
         cap = config.queue_capacity or 2 * config.actor_threads
         self._queue: "queue.Queue[Fragment]" = queue.Queue(maxsize=cap)
         self._errors: "queue.Queue[tuple[int, BaseException]]" = queue.Queue()
@@ -140,10 +140,13 @@ class SebulbaTrainer:
         """Per-thread behaviour-ε schedule for the Q-learning family: thread
         ``index``'s env slots take their rungs of the shared schedule
         (``learn.learner.qlearn_epsilon_schedule`` — one formula for every
-        backend), annealed by estimated GLOBAL frames. A thread only knows
-        its own frame count, so global frames ≈ own * actor_threads (exact
-        when threads progress evenly); restarted actors resume the anneal
-        from the trainer's env_steps instead of re-exploring from ε=1."""
+        backend), annealed by the trainer's AUTHORITATIVE global frame
+        counter, published to the ParamStore alongside params. (An earlier
+        design extrapolated global frames from the thread's own count
+        times actor_threads, which drifted under uneven thread progress
+        and after actor restarts — ADVICE.md round 1.) The thread's own
+        frames since its last store read are added so the anneal still
+        advances between publishes (cadence: actor_staleness updates)."""
         cfg = self.config
         if cfg.algo != "qlearn":
             return None
@@ -151,14 +154,24 @@ class SebulbaTrainer:
 
         B = self._envs_per_actor
         gidx = index * B + np.arange(B, dtype=np.float32)
-        threads = cfg.actor_threads
-        start = self.env_steps // threads  # resume anneal after restart
+        store = self._store
+        last = {"steps": store.env_steps(), "frames": 0, "anneal": 0.0}
 
         def epsilon_fn(thread_frames: int) -> np.ndarray:
-            frames = (start + thread_frames) * threads
-            return np.asarray(
-                qlearn_epsilon_schedule(cfg, gidx, float(frames))
+            published = store.env_steps()
+            if published != last["steps"]:
+                last["steps"] = published
+                last["frames"] = thread_frames
+            frames = published + (thread_frames - last["frames"]) * max(
+                cfg.actor_threads, 1
             )
+            # Monotone anneal: the between-publish extrapolation can
+            # OVERshoot true global progress (this thread faster than the
+            # others), and the next publish would snap frames back down —
+            # epsilon must never rise again once lowered.
+            frames = max(float(frames), last["anneal"])
+            last["anneal"] = frames
+            return np.asarray(qlearn_epsilon_schedule(cfg, gidx, frames))
 
         return epsilon_fn
 
@@ -319,7 +332,9 @@ class SebulbaTrainer:
 
                 self._updates += 1
                 if self._updates % max(cfg.actor_staleness, 1) == 0:
-                    self._store.publish(self._published(self.state))
+                    self._store.publish(
+                        self._published(self.state), self.env_steps
+                    )
                 self._ckpt.after_update(self.state, self.env_steps)
 
                 if len(pending) >= cfg.log_every or self.env_steps >= target:

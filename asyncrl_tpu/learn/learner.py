@@ -120,12 +120,31 @@ def _total_optimizer_steps(config: Config) -> int:
     return updates
 
 
+def base_optimizer(config: Config):
+    """The per-step transform factory (rate injected later): Adam (the
+    reference Learner's optimizer, BASELINE.json:5) or shared-statistics
+    RMSProp (the A3C-paper family default, SURVEY.md:143 — "shared" holds
+    by construction here: one mesh-wide optimizer state fed by psum'd
+    gradients). Returned as a factory so population training can wrap it
+    in ``optax.inject_hyperparams`` for per-member rates."""
+    if config.optimizer == "adam":
+        return optax.adam, {"eps": config.adam_eps}
+    if config.optimizer == "rmsprop":
+        return optax.rmsprop, {
+            "decay": config.rmsprop_decay,
+            "eps": config.rmsprop_eps,
+        }
+    raise ValueError(
+        f"unknown optimizer {config.optimizer!r}; expected adam|rmsprop"
+    )
+
+
 def make_optimizer(config: Config) -> optax.GradientTransformation:
-    """Global-norm clip + Adam, with the configured LR schedule. The
-    schedule is indexed by Adam's own update count; its horizon is the
-    projected optimizer-step total for this backend/algorithm
-    (``_total_optimizer_steps``), so "linear" reaches zero at the run's
-    step budget — not a fraction of the way through it."""
+    """Global-norm clip + the configured base optimizer, with the configured
+    LR schedule. The schedule is indexed by the optimizer's own update
+    count; its horizon is the projected optimizer-step total for this
+    backend/algorithm (``_total_optimizer_steps``), so "linear" reaches
+    zero at the run's step budget — not a fraction of the way through it."""
     if config.lr_schedule == "constant":
         lr = config.learning_rate
     elif config.lr_schedule == "linear":
@@ -137,9 +156,10 @@ def make_optimizer(config: Config) -> optax.GradientTransformation:
             f"unknown lr_schedule {config.lr_schedule!r}; "
             "expected constant|linear"
         )
+    base, kwargs = base_optimizer(config)
     return optax.chain(
         optax.clip_by_global_norm(config.max_grad_norm),
-        optax.adam(lr, eps=config.adam_eps),
+        base(lr, **kwargs),
     )
 
 

@@ -45,19 +45,30 @@ class ParamStore:
     fragment is always generated under one consistent ``behaviour`` policy.
     """
 
-    def __init__(self, params: Any):
+    def __init__(self, params: Any, env_steps: int = 0):
         self._lock = threading.Lock()
         self._params = params
         self._version = 0
+        # Authoritative global frame counter, published by the trainer loop
+        # alongside params. Epsilon/anneal schedules read THIS rather than
+        # extrapolating from a single thread's frame count (which drifts
+        # when threads progress unevenly or after an actor restart).
+        self._env_steps = int(env_steps)
 
-    def publish(self, params: Any) -> None:
+    def publish(self, params: Any, env_steps: int | None = None) -> None:
         with self._lock:
             self._params = params
             self._version += 1
+            if env_steps is not None:
+                self._env_steps = int(env_steps)
 
     def get(self) -> tuple[Any, int]:
         with self._lock:
             return self._params, self._version
+
+    def env_steps(self) -> int:
+        with self._lock:
+            return self._env_steps
 
 
 class Fragment:

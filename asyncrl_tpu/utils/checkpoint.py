@@ -139,6 +139,17 @@ class Checkpointer:
     def all_steps(self) -> list[int]:
         return sorted(self._mngr.all_steps())
 
+    def delete_step(self, step: int) -> None:
+        """Remove one retained step (used to evict stale higher-numbered
+        saves that orbax's keep-highest retention would otherwise favor).
+        Flushes in-flight async saves first: deleting a step whose write is
+        still landing leaves partial .orbax-checkpoint-tmp debris."""
+        step = int(step)
+        self._mngr.wait_until_finished()
+        self._mngr.delete(step)
+        if step == self._last_saved:
+            self._last_saved = None
+
     def restore(self, state_like: Any, step: int | None = None):
         """Restore ``(state, env_steps)``.
 
@@ -256,7 +267,16 @@ class TrainerCheckpointing:
             return False
         self._best_score = float(score)
         self._best.set_extra_meta(eval_return=float(score))
-        self._best.save(_step_of(state), state, env_steps)
+        step = _step_of(state)
+        for stale in self._best.all_steps():
+            # After a crash-resume from a main checkpoint older than the
+            # last best save, update_step can rewind below the retained
+            # best's step; orbax's max_to_keep=1 retention keeps the
+            # HIGHEST step, so without evicting first, this (better) save
+            # would be garbage-collected in favor of the stale one.
+            if stale > step:
+                self._best.delete_step(stale)
+        self._best.save(step, state, env_steps)
         return True
 
     def finalize(self, state: Any, env_steps: int) -> None:

@@ -45,11 +45,20 @@ class Config:
     core_size: int = 256
 
     # --- optimization ---
+    # "adam" (the reference's Learner optimizer, BASELINE.json:5) or
+    # "rmsprop" — the A3C-paper family default (SURVEY.md:143): RMSProp
+    # whose statistics the paper's async threads SHARED. Here sharing is
+    # by construction: gradients psum over the mesh into one optimizer
+    # state, which is exactly the shared-statistics recipe without races.
+    optimizer: str = "adam"
     learning_rate: float = 3e-4
     # "constant", or "linear": anneal from learning_rate to 0 over the run's
     # total_env_steps (the IMPALA recipe for its Atari/DMLab suites).
     lr_schedule: str = "constant"
     adam_eps: float = 1e-8
+    # RMSProp knobs (A3C paper, Mnih et al. 2016 §8: decay 0.99, eps 0.1).
+    rmsprop_decay: float = 0.99
+    rmsprop_eps: float = 0.1
     max_grad_norm: float = 0.5
     gamma: float = 0.99
     gae_lambda: float = 0.95

@@ -227,9 +227,10 @@ def main() -> None:
         sys.exit(1)
 
     fps = timed * cfg.updates_per_call * cfg.num_envs * cfg.unroll_len / elapsed
-    target = 1_000_000.0  # BASELINE.json:5 north-star (v4-8 target)
 
     from asyncrl_tpu.utils import bench_history
+
+    target = bench_history.NORTH_STAR_FPS
 
     dev = bench_history.device_entry()
     bench_history.record_throughput(preset_name, cfg, fps)

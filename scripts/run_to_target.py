@@ -63,7 +63,7 @@ def main() -> int:
             file=sys.stderr,
         )
 
-    from asyncrl_tpu.api.trainer import Trainer
+    from asyncrl_tpu.api.factory import make_agent
     from asyncrl_tpu.configs import presets
     from asyncrl_tpu.utils import bench_history
     from asyncrl_tpu.utils.config import override
@@ -75,7 +75,9 @@ def main() -> int:
         cfg = cfg.replace(eval_every=cfg.log_every, eval_episodes=32)
     cfg = override(cfg, overrides)
 
-    trainer = Trainer(cfg)
+    # make_agent dispatches on cfg.backend — a sebulba/cpu_async preset must
+    # be measured on ITS architecture, not silently retimed on Anakin.
+    trainer = make_agent(cfg)
     dev = bench_history.device_entry()
     status = {"reached": False, "seconds": None, "eval_return": None}
     fps_log: list[float] = []
@@ -84,6 +86,8 @@ def main() -> int:
     def on_metrics(agg: dict) -> None:
         fps_log.append(agg["fps"])
         ev = agg.get("eval_return")
+        if ev is not None:
+            status["eval_return"] = round(ev, 3)
         line = {
             "t": round(time.perf_counter() - t0, 1),
             "env_steps": agg["env_steps"],
@@ -95,20 +99,19 @@ def main() -> int:
         print(json.dumps(line), file=sys.stderr, flush=True)
         if ev is not None and ev >= target_return:
             status.update(
-                reached=True,
-                seconds=round(time.perf_counter() - t0, 1),
-                eval_return=round(ev, 3),
+                reached=True, seconds=round(time.perf_counter() - t0, 1)
             )
             raise _TargetReached
         if time.perf_counter() - t0 > budget_seconds:
-            status.update(
-                seconds=round(time.perf_counter() - t0, 1),
-                eval_return=None if ev is None else round(ev, 3),
-            )
+            status["seconds"] = round(time.perf_counter() - t0, 1)
             raise _TargetReached  # budget exhausted; reached stays False
 
     try:
         trainer.train(callback=on_metrics)
+        if status["seconds"] is None:
+            # total_env_steps ran out before target or budget: the attempt's
+            # duration and last eval are still evidence, not silence.
+            status["seconds"] = round(time.perf_counter() - t0, 1)
     except _TargetReached:
         pass
     finally:

@@ -393,3 +393,38 @@ def test_checkpoint_best_rejects_nan_and_stale_dir(tmp_path, monkeypatch):
         assert agent3._ckpt._best_dir is not None
     finally:
         agent3.close()
+
+
+def test_checkpoint_best_lower_step_after_resume_wins(tmp_path):
+    """Crash-resume rewind scenario (ADVICE.md round 1): a best save exists
+    at a HIGH update_step; after resuming from an older main checkpoint, a
+    better-scoring eval arrives at a LOWER step. Orbax max_to_keep=1
+    retention keeps the highest step, so without stale-step eviction the
+    better save would be garbage-collected in favor of the stale one."""
+    from asyncrl_tpu.utils.checkpoint import (
+        Checkpointer,
+        TrainerCheckpointing,
+    )
+
+    cfg = small_cfg()
+    t = Trainer(cfg)
+    best_dir = str(tmp_path / "best")
+    hook = TrainerCheckpointing(None, every=0, best_dir=best_dir)
+
+    # Best at step 10, score 5.
+    state10 = t.state.replace(
+        update_step=jax.numpy.asarray(10, t.state.update_step.dtype)
+    )
+    assert hook.maybe_save_best(state10, env_steps=100, score=5.0)
+
+    # Resume rewound to step 3; score 7 beats 5 and must be THE retained
+    # slot, with consistent metadata.
+    state3 = t.state.replace(
+        update_step=jax.numpy.asarray(3, t.state.update_step.dtype)
+    )
+    assert hook.maybe_save_best(state3, env_steps=30, score=7.0)
+    hook.close()
+
+    with Checkpointer(best_dir, create=False) as best:
+        assert best.all_steps() == [3]
+        assert best.read_meta()["eval_return"] == 7.0

@@ -187,3 +187,52 @@ def test_updates_per_call_matches_sequential():
         * t_fused.config.batch_steps_per_update
     )
     assert history and np.isfinite(history[-1]["loss"])
+
+
+def test_rmsprop_optimizer_trains(devices):
+    """optimizer="rmsprop" (the A3C-paper shared-statistics default,
+    SURVEY.md:143): numerics match a hand-built optax chain on the same
+    gradients, and the learner trains with it on the mesh."""
+    import optax
+
+    cfg = Config(
+        algo="a3c", num_envs=16, unroll_len=8, precision="f32",
+        optimizer="rmsprop", rmsprop_decay=0.95, rmsprop_eps=0.01,
+    )
+    from asyncrl_tpu.learn.learner import make_optimizer
+
+    opt = make_optimizer(cfg)
+    ref = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.rmsprop(cfg.learning_rate, decay=0.95, eps=0.01),
+    )
+    params = {"w": jnp.arange(4.0), "b": jnp.ones((2,))}
+    grads = {"w": jnp.full((4,), 2.0), "b": jnp.array([-1.0, 3.0])}
+    s1, s2 = opt.init(params), ref.init(params)
+    for _ in range(3):
+        u1, s1 = opt.update(grads, s1, params)
+        u2, s2 = ref.update(grads, s2, params)
+        for a, b in zip(jax.tree.leaves(u1), jax.tree.leaves(u2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    env = CartPole()
+    model = build_model(cfg, env.spec)
+    learner = Learner(cfg, env, model, make_mesh())
+    state = learner.init_state(seed=0)
+    p0 = jax.device_get(state.params)
+    for _ in range(3):
+        state, metrics = learner.update(state)
+    assert np.isfinite(float(jax.device_get(metrics)["loss"]))
+    p1 = jax.device_get(state.params)
+    assert any(
+        not np.allclose(a, b)
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1))
+    )
+
+
+def test_unknown_optimizer_rejected():
+    cfg = Config(optimizer="sgd")
+    from asyncrl_tpu.learn.learner import make_optimizer
+
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        make_optimizer(cfg)

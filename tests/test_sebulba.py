@@ -210,3 +210,56 @@ def test_actor_supervision_restarts_failed_actor(devices):
         st_mod.make_host_pool = real_make_pool
     assert agent._actor_restarts >= 1
     assert len(history) >= 1
+
+
+def test_epsilon_anneal_tracks_published_env_steps():
+    """The behaviour-ε anneal derives from the trainer's authoritative
+    env_steps counter published to the ParamStore (ADVICE.md round 1: the
+    old own-frames*threads extrapolation drifted under uneven thread
+    progress and across restarts)."""
+    agent = make_agent(
+        Config(
+            env_id="CartPole-v1", algo="qlearn", backend="sebulba",
+            num_envs=32, unroll_len=4, actor_threads=2, host_pool="jax",
+            exploration_steps=1000, precision="f32", actor_staleness=4,
+        )
+    )
+    try:
+        fn = agent._epsilon_fn(0)
+        eps_start = fn(0)
+
+        # Publishing global progress must advance the anneal even with the
+        # thread's own frame count frozen at 0.
+        agent.env_steps = 500
+        agent._store.publish(agent._published(agent.state), agent.env_steps)
+        eps_mid = fn(0)
+        assert np.all(eps_mid < eps_start)
+
+        # A RESTARTED actor (fresh epsilon_fn, own frames reset to 0)
+        # resumes from the published counter rather than re-exploring:
+        # its fragment-start epsilon equals the pre-restart published point.
+        fn2 = agent._epsilon_fn(0)
+        np.testing.assert_allclose(fn2(0), eps_mid)
+
+        # Between publishes, the thread's own frames scaled by thread count
+        # keep the anneal moving (monotone, never backwards).
+        eps_local = fn(100)
+        assert np.all(eps_local <= eps_mid)
+
+        # A publish BELOW the extrapolated progress (this thread was the
+        # fast one) must not push epsilon back up: the anneal is clamped
+        # monotone within the thread.
+        agent.env_steps = 600  # < 500 + 100*actor_threads
+        agent._store.publish(agent._published(agent.state), agent.env_steps)
+        assert np.all(fn(100) <= eps_local)
+
+        # Past the exploration horizon the anneal has converged: more
+        # published frames no longer change epsilon.
+        agent.env_steps = 2000
+        agent._store.publish(agent._published(agent.state), agent.env_steps)
+        eps_end = np.asarray(fn(0))
+        agent.env_steps = 4000
+        agent._store.publish(agent._published(agent.state), agent.env_steps)
+        np.testing.assert_allclose(np.asarray(fn(0)), eps_end)
+    finally:
+        agent.close()
