@@ -15,6 +15,7 @@ the reference (SURVEY.md §7.3).
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 from typing import Any, Callable
@@ -31,6 +32,7 @@ from asyncrl_tpu.parallel.mesh import dp_size, make_mesh
 from asyncrl_tpu.rollout.sebulba import (
     ActorThread,
     Fragment,
+    FragmentSequenceChecker,
     ParamStore,
     make_host_pool,
     make_inference_fn,
@@ -110,9 +112,18 @@ class SebulbaTrainer:
         self._store = ParamStore(self._published(self.state), self.env_steps)
         cap = config.queue_capacity or 2 * config.actor_threads
         self._queue: "queue.Queue[Fragment]" = queue.Queue(maxsize=cap)
+        # §5.2b debug mode: transport invariants on drained fragments.
+        from asyncrl_tpu.utils.debug import sync_debug_enabled
+
+        self._seq_checker = (
+            FragmentSequenceChecker() if sync_debug_enabled() else None
+        )
         self._errors: "queue.Queue[tuple[int, BaseException]]" = queue.Queue()
         self._stop = threading.Event()
         self._actors: list[ActorThread] = []
+        # Per-slot restart counters (monotone across stop/start cycles;
+        # stamped into fragments for the §5.2b transport checker).
+        self._actor_gens = [0] * config.actor_threads
         self._updates = 0
         self._actor_restarts = 0
         self._recent_restarts: list[float] = []
@@ -199,6 +210,7 @@ class SebulbaTrainer:
             epsilon_fn=self._epsilon_fn(index),
             track_returns=self.config.normalize_returns,
             return_discount=self.config.gamma,
+            generation=self._actor_gens[index],
         )
         actor.start()
         return actor
@@ -206,7 +218,14 @@ class SebulbaTrainer:
     def _start_actors(self) -> None:
         if self._actors:
             return
-        self._stop.clear()
+        # A FRESH stop event per cohort (never .clear() the old one): if a
+        # previous stop()'s join timed out, the zombie thread still holds
+        # the old event — which stays set, so the zombie exits at its next
+        # check instead of being revived alongside its replacement. Every
+        # new cohort also bumps all generation stamps, so a zombie's late
+        # fragments can never collide with the new cohort's seq streams.
+        self._stop = threading.Event()
+        self._actor_gens = [g + 1 for g in self._actor_gens]
         if self.config.inference_server:
             from asyncrl_tpu.rollout.inference_server import InferenceServer
             from asyncrl_tpu.rollout.sebulba import inference_mode
@@ -230,9 +249,16 @@ class SebulbaTrainer:
         (SURVEY.md §5.3 — dead actor restarted with fresh env). "Rapidly"
         means within ``_RESTART_WINDOW_S``: sporadic transient failures over
         a long run recover indefinitely; a crash loop aborts."""
+        from asyncrl_tpu.rollout.inference_server import InvariantViolation
+
         try:
             while True:
                 index, err = self._errors.get_nowait()
+                if isinstance(err, InvariantViolation):
+                    # §5.2b failures are integrity bugs, not transient actor
+                    # faults: abort NOW instead of churning restarts.
+                    self.stop()
+                    raise err
                 now = time.monotonic()
                 self._actor_restarts += 1
                 self._recent_restarts.append(now)
@@ -247,7 +273,21 @@ class SebulbaTrainer:
                         f"({len(self._recent_restarts)} restarts in "
                         f"{self._RESTART_WINDOW_S}s)"
                     ) from err
+                self._actor_gens[index] += 1
                 self._actors[index] = self._spawn_actor(index)
+        except queue.Empty:
+            pass
+
+    def _drain_queue(self) -> None:
+        """Discard queued fragments — THROUGH the §5.2b checker when armed,
+        so a discarded fragment still advances its stream (a later gap from
+        skipping it unchecked would be a false positive, and a real
+        transport bug hiding among discards would go unseen)."""
+        try:
+            while True:
+                fragment = self._queue.get_nowait()
+                if self._seq_checker is not None:
+                    self._seq_checker.check(fragment)
         except queue.Empty:
             pass
 
@@ -255,13 +295,24 @@ class SebulbaTrainer:
         """Stop actor threads (and the inference server), drain the queue."""
         self._stop.set()
         # Unblock producers stuck on a full queue.
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
+        self._drain_queue()
         for actor in self._actors:
             actor.join(timeout=5.0)
+            if actor.is_alive():
+                # Loud, not silent: the thread outlived the join window
+                # (e.g. wedged in pool.step). Its cohort's stop event stays
+                # set forever — it can only exit, never resume — and the
+                # next cohort gets a fresh event + bumped generations.
+                print(
+                    f"asyncrl_tpu: actor {actor.index} did not join within "
+                    "5s; abandoning thread (it will exit at its next "
+                    "stop-event check)",
+                    file=sys.stderr,
+                )
+        # Drain AGAIN after the joins: an actor mid-put when the first drain
+        # ran can still land one fragment; left queued, it would feed the
+        # next train() a stale-cohort fragment.
+        self._drain_queue()
         self._actors = []
         if self._server is not None:
             self._server.join(timeout=5.0)
@@ -300,6 +351,8 @@ class SebulbaTrainer:
                     fragment = self._queue.get(timeout=1.0)
                 except queue.Empty:
                     continue
+                if self._seq_checker is not None:
+                    self._seq_checker.check(fragment)
                 rollout = fragment.rollout
                 if cfg.reward_scale != 1.0:
                     # Scale the discounted-return stream with the rewards:

@@ -47,6 +47,13 @@ class ServerClosed(RuntimeError):
     """Raised into clients when the server stops while they wait."""
 
 
+class InvariantViolation(RuntimeError):
+    """§5.2b debug-mode failure: the serve/consume handshake discipline is
+    broken. FATAL — kills the server thread and surfaces to every client;
+    never downgraded to a per-request error (a transport-integrity bug must
+    abort the run, not feed the actor-restart loop)."""
+
+
 def _concat(values):
     """Concatenate request pytrees along the leading (batch) dim."""
     return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *values)
@@ -102,6 +109,13 @@ class InferenceServer(threading.Thread):
         self._results: list[Any] = [None] * num_clients
         self._errors: list[BaseException | None] = [None] * num_clients
         self._events = [threading.Event() for _ in range(num_clients)]
+        from asyncrl_tpu.utils.debug import sync_debug_enabled
+
+        # §5.2b debug mode: a result slot must be EMPTY when served (a
+        # non-empty slot means a double-serve or an unconsumed reply —
+        # the handshake discipline is broken).
+        self._debug = sync_debug_enabled()
+        self._fatal: InvariantViolation | None = None
 
     # ------------------------------------------------------------- client
 
@@ -130,7 +144,13 @@ class InferenceServer(threading.Thread):
             self._cond.notify_all()
         while not event.wait(timeout=0.2):
             if self._stop_event.is_set() or not self.is_alive():
+                if self._fatal is not None:
+                    raise self._fatal
                 raise ServerClosed("inference server stopped")
+        if self._fatal is not None:
+            # Integrity violation: no slot content can be trusted anymore
+            # (including a stale result that was about to be consumed).
+            raise self._fatal
         err = self._errors[index]
         if err is not None:
             self._errors[index] = None
@@ -139,6 +159,8 @@ class InferenceServer(threading.Thread):
         if result is None:
             # The event can also fire from run()'s shutdown wakeup with
             # neither a result nor an error written (stop raced our wait).
+            if self._fatal is not None:
+                raise self._fatal
             raise ServerClosed("inference server stopped")
         return result
 
@@ -151,6 +173,12 @@ class InferenceServer(threading.Thread):
                     self._run()
             else:
                 self._run()
+        except InvariantViolation as e:
+            # Fatal: remember why the server died so every subsequent
+            # client call re-raises the VIOLATION (not a bland
+            # ServerClosed) — the run aborts with the real cause.
+            self._fatal = e
+            raise
         finally:
             # Wake anyone still waiting so they observe the closed server.
             for event in self._events:
@@ -191,6 +219,17 @@ class InferenceServer(threading.Thread):
             return batch
 
     def _serve(self, batch) -> None:
+        if self._debug:
+            # Checked for the WHOLE batch before any slot is written, so a
+            # violation can't poison already-served clients; raised outside
+            # the per-request try so it escalates (fatal) instead of being
+            # delivered as an ordinary per-client error.
+            occupied = [i for i, _ in batch if self._results[i] is not None]
+            if occupied:
+                raise InvariantViolation(
+                    f"inference-server handshake invariant broken: result "
+                    f"slot(s) {occupied} served while occupied"
+                )
         indices = [i for i, _ in batch]
         try:
             sizes = [int(args[0].shape[0]) for _, args in batch]

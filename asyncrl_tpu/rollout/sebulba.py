@@ -45,7 +45,9 @@ class ParamStore:
     fragment is always generated under one consistent ``behaviour`` policy.
     """
 
-    def __init__(self, params: Any, env_steps: int = 0):
+    def __init__(
+        self, params: Any, env_steps: int = 0, debug: bool | None = None
+    ):
         self._lock = threading.Lock()
         self._params = params
         self._version = 0
@@ -54,36 +56,119 @@ class ParamStore:
         # extrapolating from a single thread's frame count (which drifts
         # when threads progress unevenly or after an actor restart).
         self._env_steps = int(env_steps)
+        # §5.2b debug mode: seqlock-style write stamp around every mutation
+        # (odd = publish in flight). With the lock held this is invisible;
+        # if the lock discipline is ever broken, a concurrent get() observes
+        # an odd or changed stamp and raises instead of serving a torn
+        # params/version pair. Kept unconditionally cheap (two int adds);
+        # the read-side verification only arms under ASYNCRL_DEBUG_SYNC=1.
+        self._seq = 0
+        if debug is None:
+            from asyncrl_tpu.utils.debug import sync_debug_enabled
+
+            debug = sync_debug_enabled()
+        self._debug = debug
 
     def publish(self, params: Any, env_steps: int | None = None) -> None:
         with self._lock:
+            self._seq += 1
             self._params = params
             self._version += 1
             if env_steps is not None:
                 self._env_steps = int(env_steps)
+            self._seq += 1
+
+    def _torn(self, s1: int, s2: int) -> bool:
+        return s1 != s2 or s1 % 2 == 1
 
     def get(self) -> tuple[Any, int]:
         with self._lock:
+            if self._debug:
+                s1 = self._seq
+                pair = (self._params, self._version)
+                if self._torn(s1, self._seq):
+                    raise RuntimeError(
+                        "ParamStore torn read: a publish was observed mid-get"
+                        " — the store's lock discipline is broken"
+                    )
+                return pair
             return self._params, self._version
 
     def env_steps(self) -> int:
         with self._lock:
+            if self._debug:
+                s1 = self._seq
+                steps = self._env_steps
+                if self._torn(s1, self._seq):
+                    raise RuntimeError(
+                        "ParamStore torn read: a publish was observed "
+                        "mid-env_steps — the store's lock discipline is "
+                        "broken"
+                    )
+                return steps
             return self._env_steps
 
 
 class Fragment:
     """One host-side rollout fragment + the episode stats gathered while
-    producing it. Arrays are owned copies, safe to retain."""
+    producing it. Arrays are owned copies, safe to retain. ``actor``/``seq``
+    stamp the producing thread and its fragment counter for the §5.2b
+    transport invariants (``FragmentSequenceChecker``)."""
 
-    __slots__ = ("rollout", "return_sum", "length_sum", "count", "version")
+    __slots__ = (
+        "rollout", "return_sum", "length_sum", "count", "version",
+        "actor", "gen", "seq",
+    )
 
     def __init__(self, rollout: Rollout, return_sum: float, length_sum: float,
-                 count: float, version: int):
+                 count: float, version: int, actor: int = 0, gen: int = 0,
+                 seq: int = 0):
         self.rollout = rollout
         self.return_sum = return_sum
         self.length_sum = length_sum
         self.count = count
         self.version = version
+        self.actor = actor
+        self.gen = gen
+        self.seq = seq
+
+
+class FragmentSequenceChecker:
+    """§5.2b debug invariant on the actor→learner transport: within one
+    actor thread lifetime — keyed (actor, gen), where the trainer bumps
+    ``gen`` on every restart — fragments must reach the learner gapless
+    (seq 0,1,2,…), duplicate-free, and in production order; and per actor
+    (across restarts) the behaviour-param version must never decrease.
+    ``queue.Queue`` guarantees all of this today; the checker exists so a
+    future transport swap or refactor that silently drops, duplicates, or
+    reorders fragments fails loudly under ASYNCRL_DEBUG_SYNC=1 instead of
+    corrupting training. Generations (not a reset) distinguish a restarted
+    actor's fresh stream from its predecessor's fragments still queued.
+    Single-consumer use (the trainer's learner loop)."""
+
+    def __init__(self) -> None:
+        self._next_seq: dict[tuple[int, int], int] = {}
+        self._last_version: dict[int, int] = {}
+
+    def check(self, fragment: "Fragment") -> None:
+        key = (fragment.actor, fragment.gen)
+        expect = self._next_seq.get(key, 0)
+        if fragment.seq != expect:
+            raise RuntimeError(
+                f"fragment transport invariant broken: actor "
+                f"{fragment.actor} (gen {fragment.gen}) delivered seq "
+                f"{fragment.seq}, expected {expect} (fragments lost, "
+                f"duplicated, or reordered)"
+            )
+        self._next_seq[key] = expect + 1
+        last = self._last_version.get(fragment.actor, -1)
+        if fragment.version < last:
+            raise RuntimeError(
+                f"fragment transport invariant broken: actor "
+                f"{fragment.actor} param version went backwards "
+                f"({last} -> {fragment.version})"
+            )
+        self._last_version[fragment.actor] = fragment.version
 
 
 class JaxHostPool:
@@ -308,9 +393,14 @@ class ActorThread(threading.Thread):
         epsilon_fn: Callable[[int], np.ndarray] | None = None,
         track_returns: bool = False,
         return_discount: float = 0.0,
+        generation: int = 0,
     ):
         super().__init__(name=f"actor-{index}", daemon=True)
         self.index = index
+        # Restart counter for this actor slot (stamped into fragments so
+        # the §5.2b checker can tell a restarted thread's fresh seq stream
+        # from its predecessor's fragments still sitting in the queue).
+        self.generation = generation
         self.pool = pool
         self.inference_fn = inference_fn
         self.store = store
@@ -375,6 +465,7 @@ class ActorThread(threading.Thread):
         core = self.initial_core(B) if self.initial_core else None
         done_prev = np.zeros((B,), bool)
         frames = 0  # this thread's cumulative env frames (for epsilon_fn)
+        seq = 0  # fragment counter (§5.2b transport invariant stamp)
 
         while not self.stop_event.is_set():
             params, version = self.store.get()
@@ -444,7 +535,9 @@ class ActorThread(threading.Thread):
             fragment = Fragment(
                 rollout,
                 ret_sum, len_sum, count, version,
+                actor=self.index, gen=self.generation, seq=seq,
             )
+            seq += 1
             # Bounded put that stays responsive to shutdown.
             while not self.stop_event.is_set():
                 try:

@@ -1,0 +1,33 @@
+"""Race-debug mode (SURVEY.md §5.2b): opt-in invariant checking for the
+host-side concurrency substrate — the one part of the framework that is NOT
+race-free by construction (the device path exchanges data only through XLA
+collectives; the host path uses threads, a queue, and a param store).
+
+Enable with ``ASYNCRL_DEBUG_SYNC=1``. Two families of invariants arm:
+
+- ``ParamStore`` publish/get run under a seqlock-style write stamp; a torn
+  read (possible only if the store's lock discipline were broken) raises
+  instead of silently serving an inconsistent params/version pair.
+- Actor→learner fragments carry (actor, seq) stamps; the trainer asserts
+  each actor's fragments arrive gapless, duplicate-free, and in order with
+  non-decreasing param versions (``FragmentSequenceChecker`` in
+  ``rollout.sebulba``).
+
+The thread-stress CI job (tests/test_race_debug.py) hammers both under
+contention — with the real locks it must stay silent, and with the lock
+removed the seqlock must fire: the checks are proven able to detect the
+races they guard against, not just assumed to.
+"""
+
+from __future__ import annotations
+
+import os
+
+_FALSEY = ("", "0", "false", "no")
+
+
+def sync_debug_enabled() -> bool:
+    """True when ASYNCRL_DEBUG_SYNC requests host-concurrency invariant
+    checks. Read at construction time by the objects that honor it (a
+    running trainer never flips modes mid-flight)."""
+    return os.environ.get("ASYNCRL_DEBUG_SYNC", "").lower() not in _FALSEY
