@@ -35,6 +35,7 @@ from asyncrl_tpu.learn.learner import (
     validate_qlearn_config,
     resolve_scan_impl,
     validate_ppo_geometry,
+    validate_recurrent_config,
 )
 from asyncrl_tpu.models.networks import build_model, is_recurrent
 from asyncrl_tpu.parallel.mesh import dp_axes, dp_sharded, dp_size, make_mesh
@@ -105,18 +106,20 @@ class PopulationTrainer:
                 "(no in-training eval path ranks the members); use the "
                 "single-run trainers"
             )
-        # Same eager geometry validation as Learner.__init__ (clearer than
-        # a trace-time failure inside the first update).
-        validate_ppo_geometry(config, config.num_envs, "per-member")
         validate_qlearn_config(config)
         self.config = config
         self.pop_size = pop_size
         self.env = make_env(config.env_id)
         self.model = build_model(config, self.env.spec)
-        if is_recurrent(self.model):
-            raise NotImplementedError(
-                "population training with recurrent cores is not wired yet"
-            )
+        # Same eager geometry/consistency validation as Learner.__init__
+        # (clearer than a trace-time failure inside the first update).
+        # Recurrent members work like recurrent single runs: the core rides
+        # the per-member actor state through the vmapped train step.
+        validate_recurrent_config(config, self.model)
+        validate_ppo_geometry(
+            config, config.num_envs, "per-member",
+            recurrent=is_recurrent(self.model),
+        )
         if learning_rates is None:
             self.optimizer = make_optimizer(config)
             self._member_lrs = None

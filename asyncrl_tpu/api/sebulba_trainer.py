@@ -23,7 +23,10 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
-from asyncrl_tpu.learn.learner import validate_train_target
+from asyncrl_tpu.learn.learner import (
+    validate_ppo_geometry,
+    validate_train_target,
+)
 from asyncrl_tpu.learn.rollout_learner import LearnerState, RolloutLearner
 from asyncrl_tpu.models.networks import build_model, is_recurrent, reset_core
 from asyncrl_tpu.ops import distributions
@@ -82,15 +85,10 @@ class SebulbaTrainer:
                 f"num_envs/actor_threads={self._envs_per_actor} not "
                 f"divisible by dp={dp}"
             )
-        if config.algo == "ppo" and (
-            config.ppo_epochs > 1 or config.ppo_minibatches > 1
-        ):
-            local = (self._envs_per_actor // dp) * config.unroll_len
-            if local % config.ppo_minibatches:
-                raise ValueError(
-                    f"per-device fragment of {local} samples not divisible "
-                    f"by ppo_minibatches={config.ppo_minibatches}"
-                )
+        validate_ppo_geometry(
+            config, self._envs_per_actor // dp, "per-device",
+            recurrent=is_recurrent(self.model),
+        )
         self.learner = RolloutLearner(config, self.spec, self.model, self.mesh)
         self.state: LearnerState = self.learner.init_state(config.seed)
         self.env_steps = 0

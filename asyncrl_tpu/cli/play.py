@@ -119,10 +119,7 @@ def _dump_trajectory(agent, cfg, path: str, max_steps: int) -> None:
     model = agent.model
     params = agent.state.params
     dist = distributions.for_config(cfg, env.spec)
-    if is_recurrent(model):
-        raise NotImplementedError(
-            "--save with recurrent cores is not wired yet; use a ff preset"
-        )
+    recurrent = is_recurrent(model)
 
     from asyncrl_tpu.ops.normalize import normalizing_apply
 
@@ -131,9 +128,14 @@ def _dump_trajectory(agent, cfg, path: str, max_steps: int) -> None:
     )
 
     def body(carry, _):
-        env_state, obs, done, key = carry
+        env_state, obs, done, key, core = carry
         key, step_key = jax.random.split(key)
-        dist_params, _ = napply(params, obs[None])
+        if recurrent:
+            # Single-episode rollout: no mid-trajectory reset needed (the
+            # scan freezes at the first done), batch dim of 1 for the core.
+            dist_params, _, core = napply(params, obs[None], core)
+        else:
+            dist_params, _ = napply(params, obs[None])
         action = dist.mode(dist_params)[0]
         new_state, ts = env.step(env_state, action, step_key)
         # Freeze the trajectory after the first episode end.
@@ -142,7 +144,7 @@ def _dump_trajectory(agent, cfg, path: str, max_steps: int) -> None:
         new_done = jnp.logical_or(done, ts.done)
         carry = jax.tree.map(
             lambda n, o: jnp.where(keep, n, o), (new_state, ts.obs), (env_state, obs)
-        ) + (new_done, key)
+        ) + (new_done, key, core)
         return carry, out
 
     @jax.jit
@@ -150,9 +152,10 @@ def _dump_trajectory(agent, cfg, path: str, max_steps: int) -> None:
         init_key, run_key = jax.random.split(key)
         env_state = env.init(init_key)
         obs = env.observe(env_state)
+        core = model.initial_core(1) if recurrent else None
         _, (obs_traj, act_traj, rew_traj, done_traj) = jax.lax.scan(
             body,
-            (env_state, obs, jnp.zeros((), bool), run_key),
+            (env_state, obs, jnp.zeros((), bool), run_key, core),
             None,
             length=max_steps,
         )

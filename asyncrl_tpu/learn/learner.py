@@ -213,16 +213,10 @@ def validate_train_target(config: Config, target: int) -> None:
 
 def validate_recurrent_config(config: Config, model) -> None:
     """Shared constructor-time checks for recurrent policies (Anakin and
-    host-fragment learners alike)."""
-    if is_recurrent(model) and config.algo == "ppo" and (
-        config.ppo_epochs > 1 or config.ppo_minibatches > 1
-    ):
-        raise NotImplementedError(
-            "recurrent (core='lstm') policies are not supported with "
-            "multi-epoch/minibatched PPO (shuffled minibatches break "
-            "the temporal structure the core needs); use "
-            "ppo_epochs=ppo_minibatches=1, or algo='impala'/'a3c'"
-        )
+    host-fragment learners alike). Recurrent multipass PPO is supported
+    via sequence-preserving minibatching (see ``_ppo_multipass``); its
+    geometry constraint (envs, not samples, divide into minibatches) is
+    enforced by ``validate_ppo_geometry(recurrent=True)``."""
     if config.core == "lstm" and not is_recurrent(model):
         raise ValueError(
             "config.core='lstm' but the given model is not recurrent — "
@@ -365,9 +359,16 @@ def _ppo_multipass(
     gradients and advantage-normalization moments ride the implicit/explicit
     psum over the dp axis, so every device applies identical parameter
     updates.
+
+    Recurrent policies (``rollout.init_core`` present) use SEQUENCE-
+    PRESERVING minibatching: the shuffle permutes ENVS, never time — each
+    minibatch is a [T, B/mb] block of whole fragments re-forwarded by a
+    time scan from its slice of the stored fragment-initial carries (with
+    episode-boundary resets), so the core always sees the exact temporal
+    structure the behaviour policy generated. Feed-forward keeps the flat
+    [T*B] sample shuffle (strictly more decorrelated, and cheaper).
     """
-    obs_all = jnp.concatenate([rollout.obs, rollout.bootstrap_obs[None]], axis=0)
-    _, values_all = apply_fn(params, obs_all)
+    _, values_all = _forward_fragment(apply_fn, params, rollout)
     values_t, bootstrap_value = values_all[:-1], values_all[-1]
     adv = gae(
         rollout.rewards,
@@ -379,16 +380,11 @@ def _ppo_multipass(
     )
 
     T, B = rollout.actions.shape[:2]
-    validate_ppo_geometry(config, B, "trace-time local", unroll=T)
-    n = T * B
+    recurrent = rollout.init_core is not None
+    validate_ppo_geometry(
+        config, B, "trace-time local", unroll=T, recurrent=recurrent
+    )
     mb = config.ppo_minibatches
-    flat = {
-        "obs": rollout.obs.reshape(n, *rollout.obs.shape[2:]),
-        "actions": rollout.actions.reshape(n, *rollout.actions.shape[2:]),
-        "behaviour_logp": rollout.behaviour_logp.reshape(n),
-        "advantages": jax.lax.stop_gradient(adv.advantages).reshape(n),
-        "returns": jax.lax.stop_gradient(adv.returns).reshape(n),
-    }
 
     # Deterministic per-(step, device, epoch) shuffle key; no PRNG state
     # threads through TrainState.
@@ -401,33 +397,86 @@ def _ppo_multipass(
     )
     base_key = jax.random.fold_in(base_key, _axis_index(axes))
 
-    def minibatch_step(carry, batch):
-        params, opt_state = carry
+    def minibatch_step_with(forward):
+        def minibatch_step(carry, batch):
+            params, opt_state = carry
 
-        def scaled_loss(p):
-            logits, values = apply_fn(p, batch["obs"])
-            loss, metrics = ppo_loss(
-                logits, values, batch["actions"], batch["behaviour_logp"],
-                batch["advantages"], batch["returns"],
-                clip_eps=config.ppo_clip_eps, value_coef=config.value_coef,
-                entropy_coef=config.entropy_coef, axis_name=axes or None,
-                dist=dist,
+            def scaled_loss(p):
+                logits, values = forward(p, batch)
+                loss, metrics = ppo_loss(
+                    logits, values, batch["actions"],
+                    batch["behaviour_logp"],
+                    batch["advantages"], batch["returns"],
+                    clip_eps=config.ppo_clip_eps,
+                    value_coef=config.value_coef,
+                    entropy_coef=config.entropy_coef, axis_name=axes or None,
+                    dist=dist,
+                )
+                metrics = dict(metrics, loss=loss)
+                return loss / _axis_size(axes), metrics
+
+            grads, metrics = jax.grad(scaled_loss, has_aux=True)(params)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), metrics
+
+        return minibatch_step
+
+    if recurrent:
+        per_env = {
+            "obs": rollout.obs,
+            "actions": rollout.actions,
+            "behaviour_logp": rollout.behaviour_logp,
+            "advantages": jax.lax.stop_gradient(adv.advantages),
+            "returns": jax.lax.stop_gradient(adv.returns),
+            "done": rollout.done,
+        }  # every leaf [T, B, ...]
+
+        def forward(p, batch):
+            def fwd(core, inputs):
+                obs_t, done_t = inputs
+                dist_params, value, new_core = apply_fn(p, obs_t, core)
+                return reset_core(new_core, done_t), (dist_params, value)
+
+            _, (logits, values) = jax.lax.scan(
+                fwd, batch["init_core"], (batch["obs"], batch["done"])
             )
-            metrics = dict(metrics, loss=loss)
-            return loss / _axis_size(axes), metrics
+            return logits, values
 
-        grads, metrics = jax.grad(scaled_loss, has_aux=True)(params)
-        metrics["grad_norm"] = optax.global_norm(grads)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return (params, opt_state), metrics
+        def epoch_step(carry, ekey):
+            perm = jax.random.permutation(ekey, B)
 
-    def epoch_step(carry, ekey):
-        perm = jax.random.permutation(ekey, n)
-        batches = jax.tree.map(
-            lambda x: x[perm].reshape(mb, n // mb, *x.shape[1:]), flat
-        )
-        return jax.lax.scan(minibatch_step, carry, batches)
+            def split_envs(x):  # [T, B, ...] -> [mb, T, B/mb, ...]
+                x = x[:, perm].reshape(T, mb, B // mb, *x.shape[2:])
+                return jnp.moveaxis(x, 1, 0)
+
+            batches = jax.tree.map(split_envs, per_env)
+            batches["init_core"] = jax.tree.map(
+                lambda c: c[perm].reshape(mb, B // mb, *c.shape[1:]),
+                rollout.init_core,
+            )
+            return jax.lax.scan(minibatch_step_with(forward), carry, batches)
+
+    else:
+        n = T * B
+        flat = {
+            "obs": rollout.obs.reshape(n, *rollout.obs.shape[2:]),
+            "actions": rollout.actions.reshape(n, *rollout.actions.shape[2:]),
+            "behaviour_logp": rollout.behaviour_logp.reshape(n),
+            "advantages": jax.lax.stop_gradient(adv.advantages).reshape(n),
+            "returns": jax.lax.stop_gradient(adv.returns).reshape(n),
+        }
+
+        def forward(p, batch):
+            return apply_fn(p, batch["obs"])
+
+        def epoch_step(carry, ekey):
+            perm = jax.random.permutation(ekey, n)
+            batches = jax.tree.map(
+                lambda x: x[perm].reshape(mb, n // mb, *x.shape[1:]), flat
+            )
+            return jax.lax.scan(minibatch_step_with(forward), carry, batches)
 
     epoch_keys = jax.random.split(base_key, config.ppo_epochs)
     (params, opt_state), metrics = jax.lax.scan(
@@ -480,15 +529,27 @@ def validate_ppo_geometry(
     local_envs: int,
     label: str,
     unroll: int | None = None,
+    recurrent: bool = False,
 ) -> None:
     """One rule, three callers (Learner.__init__, PopulationTrainer,
     _ppo_multipass's trace-time check): a multipass-PPO fragment must split
-    evenly into minibatches. The trace-time caller passes the ACTUAL
-    fragment length as ``unroll`` (host-fed rollouts can differ from
-    config.unroll_len); eager callers omit it."""
+    evenly into minibatches — flat samples for feed-forward, whole-fragment
+    ENV groups for recurrent (sequence-preserving minibatching never splits
+    the time axis). The trace-time caller passes the ACTUAL fragment length
+    as ``unroll`` (host-fed rollouts can differ from config.unroll_len);
+    eager callers omit it."""
     if config.algo == "ppo" and (
         config.ppo_epochs > 1 or config.ppo_minibatches > 1
     ):
+        if recurrent:
+            if local_envs % config.ppo_minibatches:
+                raise ValueError(
+                    f"{label}: recurrent multipass PPO minibatches over "
+                    f"envs (time is never split), but {local_envs} envs "
+                    f"are not divisible by "
+                    f"ppo_minibatches={config.ppo_minibatches}"
+                )
+            return
         frag = local_envs * (
             config.unroll_len if unroll is None else unroll
         )
@@ -702,7 +763,10 @@ class Learner:
             raise ValueError(
                 f"num_envs={config.num_envs} not divisible by dp={dp}"
             )
-        validate_ppo_geometry(config, config.num_envs // dp, "per-device")
+        validate_ppo_geometry(
+            config, config.num_envs // dp, "per-device",
+            recurrent=is_recurrent(model),
+        )
 
         spec = state_partition_spec(dp_axes(mesh))
         body = make_train_step(config, env, model.apply, self.optimizer, mesh)

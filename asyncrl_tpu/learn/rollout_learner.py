@@ -237,9 +237,14 @@ class RolloutLearner:
                 )
             if is_recurrent(model):
                 raise NotImplementedError(
-                    "recurrent cores cannot be time-sharded (the carry is "
-                    "sequential across the whole fragment); use a dp-only "
-                    "mesh for core='lstm'"
+                    "recurrent cores cannot be time-sharded: an LSTM carry "
+                    "composes nonlinearly, so unlike the affine V-trace/GAE "
+                    "recurrences it has no exact parallel decomposition — "
+                    "a time-sharded LSTM degenerates to a pipeline that "
+                    "re-serializes the sp axis (full rationale: "
+                    "docs/ARCHITECTURE.md, 'Recurrent cores are "
+                    "deliberately NOT time-shardable'). Use a dp-only mesh "
+                    "for core='lstm'"
                 )
             if config.algo == "ppo" and (
                 config.ppo_epochs > 1 or config.ppo_minibatches > 1

@@ -107,3 +107,29 @@ def test_play_save_rejects_host_backends():
                 "total_env_steps=128",
             ]
         )
+
+
+def test_play_save_recurrent(tmp_path):
+    """LSTM-core trajectory dump: the greedy rollout threads the core
+    through the scan (VERDICT.md round 1, Weak #3 closure)."""
+    npz = tmp_path / "lstm.npz"
+    rc = main(
+        [
+            "cartpole_a3c",
+            "--episodes",
+            "0",
+            "--max-steps",
+            "80",
+            "--save",
+            str(npz),
+            "num_envs=16",
+            "precision=f32",
+            "core=lstm",
+            "core_size=16",
+        ]
+    )
+    assert rc == 0
+    z = np.load(npz)
+    t = z["obs"].shape[0]
+    assert t > 0 and z["actions"].shape[0] == t
+    assert float(z["episode_return"]) == float(z["rewards"].sum()) == t
