@@ -43,6 +43,15 @@ from asyncrl_tpu.rollout.sebulba import (
 from asyncrl_tpu.utils.config import Config
 
 
+def _stack_fragments(rollouts):
+    """K host fragments -> one [K, T, B, ...] stack for the fused-dispatch
+    learner (updates_per_call > 1); a single fragment passes through
+    unstacked (the K=1 learner expects the plain [T, B, ...] layout)."""
+    if len(rollouts) == 1:
+        return rollouts[0]
+    return jax.tree.map(lambda *xs: np.stack(xs), *rollouts)
+
+
 class SebulbaTrainer:
     """Owns host actor threads, the param store, and the device learner."""
 
@@ -75,11 +84,6 @@ class SebulbaTrainer:
         # construction, not with a cryptic sharding error mid-train after
         # actor threads have already started.
         dp = dp_size(self.mesh)
-        if self.config.updates_per_call != 1:
-            raise NotImplementedError(
-                "updates_per_call is Anakin-only (backend='tpu'): host-"
-                "fragment learners consume one queued fragment per update"
-            )
         if self._envs_per_actor % dp:
             raise ValueError(
                 f"num_envs/actor_threads={self._envs_per_actor} not "
@@ -123,6 +127,11 @@ class SebulbaTrainer:
         # stamped into fragments for the §5.2b transport checker).
         self._actor_gens = [0] * config.actor_threads
         self._updates = 0
+        # version -> update count at publish, for the param_lag metric
+        # (with fused dispatch, publishes are no longer every
+        # actor_staleness updates, so the mapping must be recorded, not
+        # derived). Version 0 is the constructor-published initial params.
+        self._published_updates: dict[int, int] = {0: 0}
         self._actor_restarts = 0
         self._recent_restarts: list[float] = []
         self._RESTART_WINDOW_S = 300.0
@@ -342,6 +351,8 @@ class SebulbaTrainer:
         # Cumulative-counter baseline: a SECOND train() call on this agent
         # must not fire an eval at its first log boundary.
         updates_at_eval = self._updates
+        K = cfg.updates_per_call
+        fragments: list[Fragment] = []
         try:
             while self.env_steps < target:
                 self._supervise()
@@ -351,7 +362,13 @@ class SebulbaTrainer:
                     continue
                 if self._seq_checker is not None:
                     self._seq_checker.check(fragment)
-                rollout = fragment.rollout
+                fragments.append(fragment)
+                if len(fragments) < K:
+                    # Fused-dispatch mode: keep draining until K fragments
+                    # are in hand (actors keep producing; supervision keeps
+                    # running between gets).
+                    continue
+                rollout = _stack_fragments([f.rollout for f in fragments])
                 if cfg.reward_scale != 1.0:
                     # Scale the discounted-return stream with the rewards:
                     # the stats must track the learner's reward view.
@@ -365,27 +382,46 @@ class SebulbaTrainer:
                     )
                 rollout = self.learner.put_rollout(rollout)
                 self.state, metrics = self.learner.update(self.state, rollout)
-                self.env_steps += steps_per_fragment
-                window_steps += steps_per_fragment
+                self.env_steps += steps_per_fragment * K
+                window_steps += steps_per_fragment * K
                 pending.append(metrics)
-                ret_sum += fragment.return_sum
-                len_sum += fragment.length_sum
-                count += fragment.count
-                # Policy lag of this fragment, in learner updates:
-                # fragment.version was published at update version*staleness.
-                # With inference_server=True this is an UPPER BOUND — the
-                # server evaluates under the latest published params, so
-                # later steps of a fragment can be fresher than its
-                # fragment-start version implies.
-                lag_sum += self._updates - fragment.version * max(
-                    cfg.actor_staleness, 1
-                )
+                for i, f in enumerate(fragments):
+                    ret_sum += f.return_sum
+                    len_sum += f.length_sum
+                    count += f.count
+                    # Policy lag of each fragment, in learner updates: it
+                    # was consumed by fused inner update self._updates + i,
+                    # and its behaviour params were published at the
+                    # RECORDED update count of its version (publishes are
+                    # per-boundary, not per-update, under fused dispatch).
+                    # With inference_server=True this is an UPPER BOUND —
+                    # the server evaluates under the latest published
+                    # params, so later steps of a fragment can be fresher
+                    # than its fragment-start version implies.
+                    lag_sum += (self._updates + i) - self._published_updates.get(
+                        f.version, self._updates
+                    )
+                fragments = []
 
-                self._updates += 1
-                if self._updates % max(cfg.actor_staleness, 1) == 0:
-                    self._store.publish(
+                before = self._updates
+                self._updates += K
+                staleness = max(cfg.actor_staleness, 1)
+                if before // staleness != self._updates // staleness:
+                    # A publish boundary was crossed inside this call (with
+                    # K >= staleness, every call). Publish cadence coarsens
+                    # to one per call — the price of fused dispatch, same
+                    # trade the Anakin backend makes.
+                    version = self._store.publish(
                         self._published(self.state), self.env_steps
                     )
+                    self._published_updates[version] = self._updates
+                    # Bound the map: anything older than the deepest
+                    # possible in-flight fragment is unreachable.
+                    for old in [
+                        v for v in self._published_updates
+                        if v < version - 4 * (self._queue.maxsize + 2)
+                    ]:
+                        del self._published_updates[old]
                 self._ckpt.after_update(self.state, self.env_steps)
 
                 if len(pending) >= cfg.log_every or self.env_steps >= target:
@@ -393,14 +429,16 @@ class SebulbaTrainer:
                     pending = []
                     elapsed = time.perf_counter() - window_start
                     window_start = time.perf_counter()
+                    # Metric leaves are scalars (K=1) or [K] stacks (fused
+                    # dispatch): np handles both.
                     agg = {
-                        k: float(sum(m[k] for m in drained) / len(drained))
+                        k: float(np.mean([np.mean(m[k]) for m in drained]))
                         for k in drained[0]
                     }
                     agg["episode_count"] = count
                     agg["episode_return"] = ret_sum / max(count, 1.0)
                     agg["episode_length"] = len_sum / max(count, 1.0)
-                    agg["param_lag"] = lag_sum / len(drained)
+                    agg["param_lag"] = lag_sum / (len(drained) * K)
                     agg["env_steps"] = self.env_steps
                     agg["fps"] = window_steps / max(elapsed, 1e-9)
                     ret_sum = len_sum = count = lag_sum = 0.0
@@ -414,7 +452,10 @@ class SebulbaTrainer:
                     # hardware throughput.
                     if (
                         cfg.eval_every > 0
-                        and self._updates - updates_at_eval >= cfg.eval_every
+                        # eval_every counts update CALLS (config.py), and a
+                        # fused call is K updates — match Anakin's cadence.
+                        and self._updates - updates_at_eval
+                        >= cfg.eval_every * K
                     ):
                         updates_at_eval = self._updates
                         agg["eval_return"] = self.evaluate(

@@ -89,15 +89,18 @@ def learner_state_spec() -> LearnerState:
 
 
 def rollout_partition_spec(
-    axes: tuple[str, ...], time_axis: str | None = None
+    axes: tuple[str, ...], time_axis: str | None = None, stacked: bool = False
 ) -> Rollout:
     """Time-major [T, B, ...] fragments, batch dim sharded over all
     data-parallel axes; with ``time_axis`` set (sequence parallelism,
-    SURVEY.md §5.7) the T dim shards over it too. ``init_core``'s P is a
-    pytree PREFIX: it applies to every leaf of the recurrent (c, h) carry
-    when present, and to nothing for feed-forward fragments (None = empty
-    subtree)."""
-    tm = P(time_axis, axes)
+    SURVEY.md §5.7) the T dim shards over it too. ``stacked`` prepends an
+    unsharded leading axis for [K, T, B, ...] fused-update stacks
+    (``updates_per_call``). ``init_core``'s P is a pytree PREFIX: it
+    applies to every leaf of the recurrent (c, h) carry when present, and
+    to nothing for feed-forward fragments (None = empty subtree)."""
+    lead = (None,) if stacked else ()
+    tm = P(*lead, time_axis, axes)
+    bf = P(*lead, axes)
     return Rollout(
         obs=tm,
         actions=tm,
@@ -105,20 +108,24 @@ def rollout_partition_spec(
         rewards=tm,
         terminated=tm,
         truncated=tm,
-        bootstrap_obs=P(axes),
-        init_core=P(axes),
+        bootstrap_obs=bf,
+        init_core=bf,
         disc_returns=tm,
     )
 
 
-def rollout_sharding(mesh: Mesh, rollout: Rollout) -> Rollout:
-    """NamedShardings for ``jax.device_put`` of one host fragment — built
-    against the fragment's own pytree structure (device_put needs an exact
-    structural match, unlike shard_map's prefix specs)."""
+def rollout_sharding(
+    mesh: Mesh, rollout: Rollout, stacked: bool = False
+) -> Rollout:
+    """NamedShardings for ``jax.device_put`` of one host fragment (or a
+    [K, ...] fused stack) — built against the fragment's own pytree
+    structure (device_put needs an exact structural match, unlike
+    shard_map's prefix specs)."""
     axes = dp_axes(mesh)
     time_axis = TIME_AXIS if TIME_AXIS in mesh.axis_names else None
-    time_major = NamedSharding(mesh, P(time_axis, axes))
-    batch_first = NamedSharding(mesh, P(axes))
+    lead = (None,) if stacked else ()
+    time_major = NamedSharding(mesh, P(*lead, time_axis, axes))
+    batch_first = NamedSharding(mesh, P(*lead, axes))
     return Rollout(
         obs=time_major,
         actions=time_major,
@@ -360,6 +367,21 @@ class RolloutLearner:
             )
             return new_state, metrics
 
+        K = config.updates_per_call
+        if K < 1:
+            raise ValueError(f"updates_per_call={K} must be >= 1")
+        if K > 1:
+            # Fuse K sequential updates into ONE dispatch: the trainer
+            # stacks K queued fragments [K, T, B, ...] and the scan applies
+            # them in arrival order — identical training semantics, one
+            # host->device round trip instead of K (the dominant cost on a
+            # high-latency device link; VERDICT.md round 1, Weak #4).
+            # Metrics come back stacked [K].
+            single_body = update_body
+
+            def update_body(state: LearnerState, stacked: Rollout):
+                return jax.lax.scan(single_body, state, stacked)
+
         sspec = learner_state_spec()
         # NEVER donate here, regardless of config.donate_buffers: the params
         # in this state are published to concurrently-running actor threads
@@ -373,7 +395,8 @@ class RolloutLearner:
                 in_specs=(
                     sspec,
                     rollout_partition_spec(
-                        axes, TIME_AXIS if time_sharded else None
+                        axes, TIME_AXIS if time_sharded else None,
+                        stacked=K > 1,
                     ),
                 ),
                 out_specs=(sspec, P()),
@@ -390,7 +413,7 @@ class RolloutLearner:
             # device_put it uncommitted).
             disc_returns=0.0 if config.normalize_returns else None,
         )
-        self._rollout_sharding = rollout_sharding(mesh, template)
+        self._rollout_sharding = rollout_sharding(mesh, template, stacked=K > 1)
 
     # ---------------------------------------------------------------- state
 

@@ -69,7 +69,10 @@ class ParamStore:
             debug = sync_debug_enabled()
         self._debug = debug
 
-    def publish(self, params: Any, env_steps: int | None = None) -> None:
+    def publish(self, params: Any, env_steps: int | None = None) -> int:
+        """Swap in new params; returns the new version number (the trainer
+        records what update count each version was published at, for the
+        param_lag metric)."""
         with self._lock:
             self._seq += 1
             self._params = params
@@ -77,6 +80,7 @@ class ParamStore:
             if env_steps is not None:
                 self._env_steps = int(env_steps)
             self._seq += 1
+            return self._version
 
     def _torn(self, s1: int, s2: int) -> bool:
         return s1 != s2 or s1 % 2 == 1

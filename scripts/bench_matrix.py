@@ -33,7 +33,77 @@ DEFAULT_PRESETS = [
     "procgen_ppo",
     "halfcheetah_ppo",
     "brax_ant_ppo",
+    # Host-actor (Sebulba/cpu_async) rows: measured over the live pipeline
+    # (actor threads + device learner), not a bare update loop. The
+    # inference_server variant quantifies the batched-dispatch win.
+    "pendulum_native_ppo",
+    "pendulum_native_ppo+server",
+    "mujoco_ant_ppo",
+    "cartpole_a3c_cpu",
 ]
+
+# Named variants: "<preset>+server" etc. map to extra overrides.
+VARIANTS = {
+    "+server": ["inference_server=true"],
+}
+
+
+def split_variant(name: str) -> tuple[str, list[str]]:
+    for suffix, extra in VARIANTS.items():
+        if name.endswith(suffix):
+            return name[: -len(suffix)], list(extra)
+    return name, []
+
+
+def bench_host(preset_name: str, cfg, min_seconds: float = 8.0) -> dict:
+    """Pipeline throughput for host-backend presets: train() for a wall
+    window and average the steady-state metric-window fps (first window
+    dropped — it pays the jit compiles). This measures what a user gets —
+    actor threads, queue, learner dispatch overlapped — not a bare device
+    loop."""
+    import time
+
+    from asyncrl_tpu.api.factory import make_agent
+
+    agent = make_agent(cfg)
+    windows: list[float] = []
+    t0 = time.perf_counter()
+
+    class _Done(Exception):
+        pass
+
+    def cb(m):
+        windows.append(m["fps"])
+        if time.perf_counter() - t0 > min_seconds and len(windows) >= 5:
+            raise _Done
+
+    try:
+        agent.train(total_env_steps=1 << 40, callback=cb)
+    except _Done:
+        pass
+    finally:
+        agent.close()
+    if len(windows) < 2:
+        raise RuntimeError(f"only {len(windows)} metric windows in window")
+    fps = sum(windows[1:]) / len(windows[1:])
+
+    from asyncrl_tpu.utils import bench_history
+
+    dev = bench_history.device_entry()
+    bench_history.record_throughput(preset_name, cfg, fps)
+    return {
+        "preset": preset_name,
+        "env_id": cfg.env_id,
+        "backend": cfg.backend,
+        "host_pool": cfg.host_pool,
+        "inference_server": cfg.inference_server,
+        "actor_threads": cfg.actor_threads,
+        "num_envs": cfg.num_envs,
+        "unroll_len": cfg.unroll_len,
+        "updates_per_call": cfg.updates_per_call,
+        "frames_per_sec": round(fps),
+        "device": f"{dev['device_kind']} x{dev['device_count']}",
+    }
 
 
 def bench_one(preset_name: str, overrides: list[str]) -> dict:
@@ -43,7 +113,10 @@ def bench_one(preset_name: str, overrides: list[str]) -> dict:
     from asyncrl_tpu.configs import presets
     from asyncrl_tpu.utils.config import override
 
-    cfg = override(presets.get(preset_name), overrides)
+    base_name, extra = split_variant(preset_name)
+    cfg = override(presets.get(base_name), extra + overrides)
+    if cfg.backend in ("sebulba", "cpu_async"):
+        return bench_host(preset_name, cfg)
     trainer = Trainer(cfg)
     state = trainer.state
     params0 = jax.tree.map(lambda x: x.copy(), state.params)
