@@ -109,7 +109,7 @@ class PopulationTrainer:
         validate_qlearn_config(config)
         self.config = config
         self.pop_size = pop_size
-        self.env = make_env(config.env_id)
+        self.env = make_env(config.env_id, config)
         self.model = build_model(config, self.env.spec)
         # Same eager geometry/consistency validation as Learner.__init__
         # (clearer than a trace-time failure inside the first update).

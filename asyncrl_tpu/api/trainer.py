@@ -45,7 +45,9 @@ class Trainer:
         self, config: Config, env=None, model=None, mesh=None, restore=None
     ):
         self.config = config
-        self.env = env if env is not None else registry.make(config.env_id)
+        self.env = (
+            env if env is not None else registry.make(config.env_id, config)
+        )
         self.model = (
             model if model is not None else build_model(config, self.env.spec)
         )

@@ -233,7 +233,7 @@ class BreakoutPixels(FrameStackPixels):
     obs[1]=ball_y, obs[4]=paddle_x, obs[6:]=brick-alive bits.
     """
 
-    def __init__(self):
+    def __init__(self, frame_skip: int = 1, frame_pool: bool = True):
         super().__init__(
             Breakout(),
             render_state=render,
@@ -241,4 +241,6 @@ class BreakoutPixels(FrameStackPixels):
                 lo[0], lo[1], lo[4], lo[6:].reshape(ROWS, COLS) > 0.5
             ),
             frame=FRAME,
+            frame_skip=frame_skip,
+            frame_pool=frame_pool,
         )

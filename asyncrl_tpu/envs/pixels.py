@@ -41,10 +41,20 @@ class FrameStackPixels(Environment):
         render_state: Callable[[Any], jax.Array],
         render_last_obs: Callable[[jax.Array], jax.Array],
         frame: int = 84,
+        frame_skip: int = 1,
+        frame_pool: bool = True,
     ):
+        """``frame_skip`` repeats the action over that many core steps per
+        env step (rewards summed, frozen at episode end) and, with
+        ``frame_pool``, pushes the elementwise MAX of the last two rendered
+        raw frames — the ALE flicker recipe (SURVEY.md §3.3). Pooling is a
+        visual no-op for these flicker-free renderers; the knob exists for
+        semantic parity with the reference's preprocessing."""
         self._core = core
         self._render = render_state
         self._render_last = render_last_obs
+        self._skip = frame_skip
+        self._pool = frame_pool and frame_skip > 1
         self.spec = EnvSpec(
             obs_shape=(frame, frame, 4),
             num_actions=core.spec.num_actions,
@@ -64,8 +74,23 @@ class FrameStackPixels(Environment):
     def step(
         self, state: PixelState, action: jax.Array, key: jax.Array
     ) -> tuple[PixelState, TimeStep]:
-        new_core, ts = self._core.step(state.core, action, key)
-        frame = self._render(new_core)
+        if self._skip > 1:
+            from asyncrl_tpu.envs.wrappers import frame_skip_scan
+
+            new_core, ts, prev_core = frame_skip_scan(
+                self._core, state.core, action, key, self._skip
+            )
+            frame = self._render(new_core)
+            if self._pool:
+                # ALE 2-frame max pool over the window's last two raw
+                # frames. On an auto-reset boundary new_core is already the
+                # fresh episode — skip pooling there (the done branch below
+                # rebuilds the stack from the fresh frame anyway).
+                pooled = jnp.maximum(frame, self._render(prev_core))
+                frame = jnp.where(ts.done, frame, pooled)
+        else:
+            new_core, ts = self._core.step(state.core, action, key)
+            frame = self._render(new_core)
         shifted = jnp.concatenate(
             [state.frames[..., 1:], frame[..., None]], axis=-1
         )

@@ -62,6 +62,44 @@ def _serve(key: jax.Array, toward_agent: jax.Array) -> jax.Array:
     return jnp.stack([jnp.float32(0.5), jnp.float32(0.5), vx, vy])
 
 
+def time_to_plane(ball: jax.Array, plane_x) -> jax.Array:
+    """Steps until the ball reaches ``plane_x`` at its current velocity."""
+    return jnp.abs(ball[0] - plane_x) / jnp.maximum(jnp.abs(ball[2]), 1e-6)
+
+
+def predict_intercept(ball: jax.Array, plane_x) -> jax.Array:
+    """Where the ball's y will be when it reaches ``plane_x``, folding wall
+    reflections with the triangle-wave identity (shared by the predictive
+    opponent and the scripted reference policy in tests)."""
+    y = ball[1] + ball[3] * time_to_plane(ball, plane_x)
+    m = jnp.mod(y, 2.0)
+    return jnp.where(m > 1.0, 2.0 - m, m)
+
+
+def reference_policy(
+    obs: jax.Array, offset_frac: float = 0.6, late_steps: float = 5.0
+) -> jax.Array:
+    """The scripted near-optimal policy used to calibrate opponent
+    difficulty (class docstring; pinned in tests/test_pong.py): park at the
+    predicted intercept, then in the final ``late_steps`` before contact
+    shift toward the paddle edge that spins the ball away from the
+    opponent. Greedy and oscillation-free — a ceiling ESTIMATE for
+    unlearned play, deliberately short of the 18.0 learned-play bar."""
+    ball = jnp.stack(
+        [obs[0], obs[1], obs[2] * BALL_VX, obs[3] * MAX_SPIN]
+    )
+    intercept = predict_intercept(ball, AGENT_X)
+    t_hit = time_to_plane(ball, AGENT_X)
+    aim_up = obs[5] > obs[1]  # opponent above the ball path -> aim down
+    offset = jnp.where(aim_up, 1.0, -1.0) * offset_frac * PADDLE_HALF
+    target = jnp.where(t_hit > late_steps, intercept, intercept + offset)
+    target = jnp.where(obs[2] > 0, target, 0.5)
+    dy = target - obs[4]
+    return jnp.where(
+        dy > 0.026, 2, jnp.where(dy < -0.026, 3, 0)
+    ).astype(jnp.int32)
+
+
 def _action_dir(action: jax.Array) -> jax.Array:
     """ALE Pong mapping: {2,4} move up (+), {3,5} move down (−), else hold."""
     up = (action == 2) | (action == 4)
@@ -69,10 +107,49 @@ def _action_dir(action: jax.Array) -> jax.Array:
     return jnp.where(up, 1.0, 0.0) - jnp.where(down, 1.0, 0.0)
 
 
+PREDICTIVE_SPEED = 0.012  # calibrated 2026-07-30, see class docstring
+
+
 class Pong(Environment):
-    """Vector-observation Pong (6-dim state)."""
+    """Vector-observation Pong (6-dim state).
+
+    ``opponent`` selects the scripted rival (Config.pong_opponent):
+
+    - ``"tracker"`` (default): rate-limited pursuit of the ball's CURRENT
+      y. The 18.0-mean target (BASELINE.json:2) is calibrated against it.
+    - ``"predictive"``: while the ball approaches, pursue its PREDICTED
+      intercept y (linear extrapolation with wall reflections,
+      ``predict_intercept``); recenter while it recedes. Strictly harder:
+      aiming away from the opponent's current position stops working
+      because it heads for where the ball will be.
+
+    Difficulty calibration (2026-07-30, 64 games each, pinned by
+    tests/test_pong.py): the best greedy scripted policy found
+    (``reference_policy`` — intercept prediction, late edge-aim away from
+    the opponent, swept over aim offsets and timing) scores **+14.8** mean
+    vs the tracker and **+10.2** vs predictive@0.012, while a random
+    policy scores ~-20 vs both. So the 18.0 bar is NOT reachable by the
+    greedy exploit family — it demands learned play strictly better than
+    the scripted reference — yet clearly not impossible (the scripted
+    policy already wins most rallies; a learner can additionally exploit
+    paddle wall-clamp phase control and opponent-aware shot selection the
+    script lacks).
+    """
 
     spec = EnvSpec(obs_shape=(6,), num_actions=NUM_ACTIONS)
+
+    def __init__(
+        self, opponent: str = "tracker", opponent_speed: float = 0.0
+    ):
+        if opponent not in ("tracker", "predictive"):
+            raise ValueError(
+                f"unknown pong_opponent {opponent!r}; "
+                "expected tracker|predictive"
+            )
+        self._opponent = opponent
+        self._opp_speed = opponent_speed or (
+            OPP_SPEED if opponent == "tracker" else PREDICTIVE_SPEED
+        )
 
     def init(self, key: jax.Array) -> PongState:
         serve_key, side_key = jax.random.split(key)
@@ -109,7 +186,17 @@ class Pong(Environment):
             PADDLE_HALF,
             1.0 - PADDLE_HALF,
         )
-        track = jnp.clip(state.ball[1] - state.opp_y, -OPP_SPEED, OPP_SPEED)
+        if self._opponent == "tracker":
+            target = state.ball[1]
+        else:
+            target = jnp.where(
+                state.ball[2] < 0,
+                predict_intercept(state.ball, OPP_X),
+                0.5,  # recenter while the ball recedes (classic AI habit)
+            )
+        track = jnp.clip(
+            target - state.opp_y, -self._opp_speed, self._opp_speed
+        )
         opp_y = jnp.clip(state.opp_y + track, PADDLE_HALF, 1.0 - PADDLE_HALF)
 
         # Ball advance + wall bounce.
@@ -210,12 +297,20 @@ class PongPixels(FrameStackPixels):
     obs[1]=ball_y, obs[4]=agent_y, obs[5]=opp_y.
     """
 
-    def __init__(self):
+    def __init__(
+        self,
+        opponent: str = "tracker",
+        opponent_speed: float = 0.0,
+        frame_skip: int = 1,
+        frame_pool: bool = True,
+    ):
         super().__init__(
-            Pong(),
+            Pong(opponent, opponent_speed),
             render_state=render,
             render_last_obs=lambda lo: render_positions(
                 lo[0], lo[1], lo[4], lo[5]
             ),
             frame=FRAME,
+            frame_skip=frame_skip,
+            frame_pool=frame_pool,
         )

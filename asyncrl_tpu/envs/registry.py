@@ -11,19 +11,34 @@ from typing import Callable
 
 from asyncrl_tpu.envs.core import Environment
 
-_REGISTRY: dict[str, Callable[[], Environment]] = {}
+_REGISTRY: dict[str, tuple[Callable[..., Environment], bool]] = {}
 
 
-def register(env_id: str, factory: Callable[[], Environment]) -> None:
-    _REGISTRY[env_id] = factory
+def register(
+    env_id: str,
+    factory: Callable[..., Environment],
+    configurable: bool = False,
+) -> None:
+    """``configurable=True`` factories take one argument — the Config (or
+    None) — and read their env-specific knobs from it (e.g. JaxPong's
+    opponent mode, the pixel envs' frame_skip); plain factories take no
+    arguments. Either way ``make`` applies the generic ALE-semantics
+    wrappers (frame skip / sticky actions) afterwards."""
+    _REGISTRY[env_id] = (factory, configurable)
 
 
-def make(env_id: str) -> Environment:
+def make(env_id: str, config=None) -> Environment:
     if env_id not in _REGISTRY:
         raise KeyError(
             f"unknown env {env_id!r}; registered: {sorted(_REGISTRY)}"
         )
-    return _REGISTRY[env_id]()
+    factory, configurable = _REGISTRY[env_id]
+    env = factory(config) if configurable else factory()
+    if config is not None:
+        from asyncrl_tpu.envs.wrappers import apply_ale_knobs
+
+        env = apply_ale_knobs(env, config)
+    return env
 
 
 def registered() -> list[str]:
@@ -43,11 +58,32 @@ def _register_builtins() -> None:
     from asyncrl_tpu.envs.pendulum import Pendulum
     from asyncrl_tpu.envs.pong import Pong, PongPixels
 
+    def pong_kwargs(cfg):
+        if cfg is None:
+            return {}
+        return {
+            "opponent": cfg.pong_opponent,
+            "opponent_speed": cfg.pong_opponent_speed,
+        }
+
+    def pixel_kwargs(cfg):
+        if cfg is None:
+            return {}
+        return {"frame_skip": cfg.frame_skip}
+
     register("CartPole-v1", CartPole)
-    register("JaxPong-v0", Pong)
-    register("JaxPongPixels-v0", PongPixels)
+    register("JaxPong-v0", lambda cfg: Pong(**pong_kwargs(cfg)), True)
+    register(
+        "JaxPongPixels-v0",
+        lambda cfg: PongPixels(**pong_kwargs(cfg), **pixel_kwargs(cfg)),
+        True,
+    )
     register("JaxBreakout-v0", Breakout)
-    register("JaxBreakoutPixels-v0", BreakoutPixels)
+    register(
+        "JaxBreakoutPixels-v0",
+        lambda cfg: BreakoutPixels(**pixel_kwargs(cfg)),
+        True,
+    )
     register("JaxPendulum-v0", Pendulum)
     from asyncrl_tpu.envs.gridworlds import Chaser, Maze
     from asyncrl_tpu.envs.minatari import (

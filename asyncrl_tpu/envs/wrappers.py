@@ -1,0 +1,150 @@
+"""ALE-semantics knobs for the JAX-native envs (SURVEY.md §3.3; VERDICT.md
+round 1, Next #7): frame-skip (action repeat with reward summation, frozen
+at episode end) and sticky actions (Machado et al. 2018, the ALE
+determinism-breaking standard, p=0.25). Both are functional wrappers over
+the ``Environment`` protocol, so they vmap/scan exactly like the envs they
+wrap; the pixel envs additionally max-pool the last two rendered frames of
+each skip window inside ``FrameStackPixels`` (the ALE flicker recipe —
+a no-op for flicker-free renderers, kept for semantic parity).
+
+Applied centrally by ``envs.registry.make(env_id, config)`` from the
+``Config.frame_skip`` / ``Config.sticky_actions`` knobs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from asyncrl_tpu.envs.core import Environment, TimeStep
+
+
+def frame_skip_scan(env: Environment, state, action, key, skip: int):
+    """Step ``env`` ``skip`` times with one action, freezing at the first
+    episode end (the episode boundary stays a *skip-window* boundary, as in
+    ALE: the remaining repeats of the window are not played into the next
+    episode). Returns ``(final_state, ts, prev_state)``:
+
+    - ``ts.reward`` is the SUM over the live steps of the window;
+      obs/terminated/truncated/last_obs are from the final live step.
+    - ``prev_state`` is the env state one live step BEFORE the final one
+      (== the window's first carry state when it ends early), for 2-frame
+      max pooling by pixel wrappers.
+    """
+    keys = jax.random.split(key, skip)
+    new_state, ts0 = env.step(state, action, keys[0])
+
+    # shard_map vma alignment: the body gates every carry leaf through
+    # ``done`` (the freeze), so outputs carry done's varying-axes metadata.
+    # A leaf that happens to be CONSTANT on the first step (e.g. CartPole's
+    # reward == 1.0) would enter the scan unvarying and trip the
+    # carry-type check inside a sharded learner. where(gate, x, x) is a
+    # value no-op that joins the metadata.
+    gate = ts0.done
+
+    def align(tree):
+        return jax.tree.map(lambda x: jnp.where(gate, x, x), tree)
+
+    new_state, state, ts0 = align(new_state), align(state), align(ts0)
+
+    def body(carry, k):
+        cur, prev, ts_acc, done = carry
+        nxt, ts = env.step(cur, action, k)
+        keep = jnp.logical_not(done)
+
+        def freeze(new, old):
+            return jnp.where(keep, new, old)
+
+        merged = jax.tree.map(freeze, nxt, cur)
+        prev2 = jax.tree.map(freeze, cur, prev)
+        ts_merged = TimeStep(
+            obs=jnp.where(keep, ts.obs, ts_acc.obs),
+            reward=ts_acc.reward + jnp.where(keep, ts.reward, 0.0),
+            terminated=jnp.where(keep, ts.terminated, ts_acc.terminated),
+            truncated=jnp.where(keep, ts.truncated, ts_acc.truncated),
+            last_obs=jnp.where(keep, ts.last_obs, ts_acc.last_obs),
+        )
+        return (merged, prev2, ts_merged, done | ts.done), None
+
+    (final, prev, ts, _), _ = jax.lax.scan(
+        body, (new_state, state, ts0, ts0.done), keys[1:]
+    )
+    return final, ts, prev
+
+
+class FrameSkip(Environment):
+    """Action repeat for vector-observation envs (pixel envs get skip +
+    pooling inside ``FrameStackPixels`` instead, where raw frames exist)."""
+
+    def __init__(self, env: Environment, skip: int):
+        if skip < 2:
+            raise ValueError(f"frame_skip={skip} must be >= 2 to wrap")
+        self._env = env
+        self._skip = skip
+        self.spec = env.spec
+
+    def init(self, key):
+        return self._env.init(key)
+
+    def observe(self, state):
+        return self._env.observe(state)
+
+    def step(self, state, action, key):
+        new_state, ts, _ = frame_skip_scan(
+            self._env, state, action, key, self._skip
+        )
+        return new_state, ts
+
+
+class StickyActions(Environment):
+    """Machado et al. 2018 sticky actions: with probability ``p`` the env
+    executes the PREVIOUS action instead of the agent's. State grows a
+    ``prev_action`` slot (reset to no-op/zero on episode start)."""
+
+    def __init__(self, env: Environment, p: float):
+        if not 0.0 < p < 1.0:
+            raise ValueError(f"sticky_actions={p} must be in (0, 1) to wrap")
+        self._env = env
+        self._p = p
+        self.spec = env.spec
+
+    def _noop(self):
+        if self.spec.continuous:
+            return jnp.zeros((self.spec.action_dim,), jnp.float32)
+        return jnp.zeros((), jnp.int32)
+
+    def init(self, key):
+        return (self._env.init(key), self._noop())
+
+    def observe(self, state):
+        return self._env.observe(state[0])
+
+    def step(self, state, action, key):
+        inner, prev = state
+        sticky_key, step_key = jax.random.split(key)
+        stick = jax.random.bernoulli(sticky_key, self._p)
+        if self.spec.continuous:
+            action = jnp.asarray(action, jnp.float32)
+        else:
+            action = jnp.asarray(action, prev.dtype)
+        executed = jnp.where(stick, prev, action)
+        new_inner, ts = self._env.step(inner, executed, step_key)
+        # Fresh episode starts from the no-op, not the dead episode's last
+        # action (stickiness must not leak across the reset).
+        next_prev = jnp.where(ts.done, self._noop(), executed)
+        return (new_inner, next_prev), ts
+
+
+def apply_ale_knobs(env: Environment, config) -> Environment:
+    """Wrap ``env`` per the config's ALE-semantics knobs. Pixel envs
+    (``FrameStackPixels``) implement frame_skip themselves at the raw-frame
+    level — their factories consume the knob — so only the vector path
+    wraps here; sticky actions apply uniformly, outermost (per agent
+    decision, as ALE does)."""
+    from asyncrl_tpu.envs.pixels import FrameStackPixels
+
+    if config.frame_skip > 1 and not isinstance(env, FrameStackPixels):
+        env = FrameSkip(env, config.frame_skip)
+    if config.sticky_actions > 0.0:
+        env = StickyActions(env, config.sticky_actions)
+    return env

@@ -229,14 +229,40 @@ def _pool_step(env: Environment, state, actions, key):
     return jax.vmap(env.step)(state, actions, keys)
 
 
+def _env_knobs_set(config) -> bool:
+    """True when the config requests env-modifying knobs only the JAX
+    registry implements (ALE semantics, opponent modes)."""
+    return (
+        config.frame_skip > 1
+        or config.sticky_actions > 0.0
+        or config.pong_opponent != "tracker"
+        or config.pong_opponent_speed != 0.0
+    )
+
+
 def make_host_pool(config, num_envs: int, seed: int):
     """Pick the fastest available host pool for ``config.env_id``.
 
     Preference order for ``host_pool="auto"``: native C++ pool (GIL-releasing
     batched stepping) → gymnasium vector adapter → CPU-jitted JAX env.
+
+    The ALE-semantics / opponent knobs (frame_skip, sticky_actions,
+    pong_opponent*) exist only in the JAX registry: "auto" routes to the
+    JAX pool when any is set, and an explicit native/gym pool choice
+    REFUSES rather than silently training against the unmodified env.
     """
     kind = config.host_pool
     env_id = config.env_id
+
+    if _env_knobs_set(config):
+        if kind in ("native", "gym"):
+            raise ValueError(
+                f"host_pool={kind!r} cannot honor the configured env knobs "
+                "(frame_skip/sticky_actions/pong_opponent*): they are "
+                "implemented by the JAX env registry only. Use "
+                "host_pool='jax' (or 'auto')."
+            )
+        kind = "jax"
 
     if kind in ("auto", "native"):
         from asyncrl_tpu.envs import native_pool
@@ -264,7 +290,9 @@ def make_host_pool(config, num_envs: int, seed: int):
     if kind in ("auto", "jax"):
         from asyncrl_tpu.envs import registry
 
-        return JaxHostPool(registry.make(env_id), num_envs, seed=seed)
+        return JaxHostPool(
+            registry.make(env_id, config), num_envs, seed=seed
+        )
 
     raise ValueError(
         f"unknown host_pool {kind!r}; expected auto|native|gym|jax"

@@ -114,6 +114,24 @@ class Config:
     # (DQN uses it WITH reward clipping).
     huber_delta: float = 0.0
 
+    # --- ALE-semantics knobs (JAX-native env registry; SURVEY.md §3.3) ---
+    # Action repeat: each env step plays the action frame_skip times
+    # (rewards summed, frozen at episode end). Pixel envs additionally
+    # max-pool the last two raw frames of each window (the ALE flicker
+    # recipe; envs/pixels.py). 1 = off.
+    frame_skip: int = 1
+    # Machado et al. 2018 sticky actions: probability the env repeats the
+    # previous action instead of the agent's. ALE-standard value 0.25;
+    # 0 = off.
+    sticky_actions: float = 0.0
+    # JaxPong opponent (envs/pong.py): "tracker" follows the ball's current
+    # y (rate-limited; beatable by persistent spin), "predictive"
+    # extrapolates the ball's intercept with wall bounces while it
+    # approaches — a strictly harder opponent that punishes the lazy
+    # constant-spin exploit. Speed 0.0 = the mode's tuned default.
+    pong_opponent: str = "tracker"
+    pong_opponent_speed: float = 0.0
+
     # --- parallelism ---
     mesh_shape: tuple[int, ...] = (-1,)  # -1: all local devices on axis "dp"
     mesh_axes: tuple[str, ...] = ("dp",)
