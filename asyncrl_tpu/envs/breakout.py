@@ -233,7 +233,12 @@ class BreakoutPixels(FrameStackPixels):
     obs[1]=ball_y, obs[4]=paddle_x, obs[6:]=brick-alive bits.
     """
 
-    def __init__(self, frame_skip: int = 1, frame_pool: bool = True):
+    def __init__(
+        self,
+        frame_skip: int = 1,
+        frame_pool: bool = False,
+        sticky_actions: float = 0.0,
+    ):
         super().__init__(
             Breakout(),
             render_state=render,
@@ -243,4 +248,5 @@ class BreakoutPixels(FrameStackPixels):
             frame=FRAME,
             frame_skip=frame_skip,
             frame_pool=frame_pool,
+            sticky_actions=sticky_actions,
         )

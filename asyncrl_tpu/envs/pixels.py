@@ -42,15 +42,29 @@ class FrameStackPixels(Environment):
         render_last_obs: Callable[[jax.Array], jax.Array],
         frame: int = 84,
         frame_skip: int = 1,
-        frame_pool: bool = True,
+        frame_pool: bool = False,
+        sticky_actions: float = 0.0,
     ):
         """``frame_skip`` repeats the action over that many core steps per
-        env step (rewards summed, frozen at episode end) and, with
-        ``frame_pool``, pushes the elementwise MAX of the last two rendered
-        raw frames — the ALE flicker recipe (SURVEY.md §3.3). Pooling is a
-        visual no-op for these flicker-free renderers; the knob exists for
-        semantic parity with the reference's preprocessing."""
-        self._core = core
+        env step (rewards summed, frozen at episode end); ``frame_pool``
+        additionally pushes the elementwise MAX of the last two rendered
+        raw frames — the ALE flicker recipe (SURVEY.md §3.3). Pooling
+        defaults OFF: these renderers never flicker, so the pooled frame is
+        bit-identical to the last frame and the second render would be pure
+        hot-loop cost; the knob exists for future flickering renderers and
+        strict-parity runs. ``sticky_actions`` applies at the RAW frame
+        level (each core step of the window redraws the stick — the
+        Machado et al. 2018 / ALE semantics), which is why it lives here
+        and not in an outer wrapper."""
+        self._sticky = sticky_actions
+        if sticky_actions > 0.0:
+            from asyncrl_tpu.envs.wrappers import StickyActions
+
+            self._core = StickyActions(core, sticky_actions)
+            self._game = lambda s: s[0]  # sticky state = (inner, prev)
+        else:
+            self._core = core
+            self._game = lambda s: s
         self._render = render_state
         self._render_last = render_last_obs
         self._skip = frame_skip
@@ -63,7 +77,7 @@ class FrameStackPixels(Environment):
 
     def init(self, key: jax.Array) -> PixelState:
         core = self._core.init(key)
-        frame = self._render(core)
+        frame = self._render(self._game(core))
         return PixelState(
             core=core, frames=jnp.repeat(frame[..., None], 4, axis=-1)
         )
@@ -80,17 +94,17 @@ class FrameStackPixels(Environment):
             new_core, ts, prev_core = frame_skip_scan(
                 self._core, state.core, action, key, self._skip
             )
-            frame = self._render(new_core)
+            frame = self._render(self._game(new_core))
             if self._pool:
                 # ALE 2-frame max pool over the window's last two raw
                 # frames. On an auto-reset boundary new_core is already the
                 # fresh episode — skip pooling there (the done branch below
                 # rebuilds the stack from the fresh frame anyway).
-                pooled = jnp.maximum(frame, self._render(prev_core))
+                pooled = jnp.maximum(frame, self._render(self._game(prev_core)))
                 frame = jnp.where(ts.done, frame, pooled)
         else:
             new_core, ts = self._core.step(state.core, action, key)
-            frame = self._render(new_core)
+            frame = self._render(self._game(new_core))
         shifted = jnp.concatenate(
             [state.frames[..., 1:], frame[..., None]], axis=-1
         )

@@ -302,7 +302,8 @@ class PongPixels(FrameStackPixels):
         opponent: str = "tracker",
         opponent_speed: float = 0.0,
         frame_skip: int = 1,
-        frame_pool: bool = True,
+        frame_pool: bool = False,
+        sticky_actions: float = 0.0,
     ):
         super().__init__(
             Pong(opponent, opponent_speed),
@@ -313,4 +314,5 @@ class PongPixels(FrameStackPixels):
             frame=FRAME,
             frame_skip=frame_skip,
             frame_pool=frame_pool,
+            sticky_actions=sticky_actions,
         )

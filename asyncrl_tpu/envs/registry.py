@@ -67,9 +67,15 @@ def _register_builtins() -> None:
         }
 
     def pixel_kwargs(cfg):
+        # Pixel envs take BOTH knobs internally at the raw-frame level
+        # (per-core-step stick draws, skip-window pooling hooks); the
+        # generic make() wrappers skip FrameStackPixels instances.
         if cfg is None:
             return {}
-        return {"frame_skip": cfg.frame_skip}
+        return {
+            "frame_skip": cfg.frame_skip,
+            "sticky_actions": cfg.sticky_actions,
+        }
 
     register("CartPole-v1", CartPole)
     register("JaxPong-v0", lambda cfg: Pong(**pong_kwargs(cfg)), True)

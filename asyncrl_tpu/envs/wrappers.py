@@ -136,15 +136,18 @@ class StickyActions(Environment):
 
 
 def apply_ale_knobs(env: Environment, config) -> Environment:
-    """Wrap ``env`` per the config's ALE-semantics knobs. Pixel envs
-    (``FrameStackPixels``) implement frame_skip themselves at the raw-frame
-    level — their factories consume the knob — so only the vector path
-    wraps here; sticky actions apply uniformly, outermost (per agent
-    decision, as ALE does)."""
+    """Wrap ``env`` per the config's ALE-semantics knobs. Order matters:
+    sticky actions go INSIDE frame skip, because ALE draws the stick at
+    every emulator frame — the executed action can flip mid-window — not
+    once per agent decision. Pixel envs (``FrameStackPixels``) implement
+    both knobs internally at the raw-frame level (their factories consume
+    them), so they pass through untouched here."""
     from asyncrl_tpu.envs.pixels import FrameStackPixels
 
-    if config.frame_skip > 1 and not isinstance(env, FrameStackPixels):
-        env = FrameSkip(env, config.frame_skip)
+    if isinstance(env, FrameStackPixels):
+        return env
     if config.sticky_actions > 0.0:
         env = StickyActions(env, config.sticky_actions)
+    if config.frame_skip > 1:
+        env = FrameSkip(env, config.frame_skip)
     return env

@@ -231,11 +231,13 @@ def _pool_step(env: Environment, state, actions, key):
 
 def _env_knobs_set(config) -> bool:
     """True when the config requests env-modifying knobs only the JAX
-    registry implements (ALE semantics, opponent modes)."""
-    return (
-        config.frame_skip > 1
-        or config.sticky_actions > 0.0
-        or config.pong_opponent != "tracker"
+    registry implements (ALE semantics; opponent modes for the envs that
+    HAVE an opponent — the pong_* knobs are inert on every other env and
+    must not disqualify its native/gym pool)."""
+    if config.frame_skip > 1 or config.sticky_actions > 0.0:
+        return True
+    return config.env_id in ("JaxPong-v0", "JaxPongPixels-v0") and (
+        config.pong_opponent != "tracker"
         or config.pong_opponent_speed != 0.0
     )
 

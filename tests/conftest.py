@@ -23,6 +23,122 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------- quick tier
+#
+# `pytest -m "not slow"` is the BOUNDED quick tier: a curated correctness
+# slice that must stay green in < 5 minutes on this 1-core box (VERDICT.md
+# round 1, Next #6 — a judge/CI needs a red/green signal in bounded time).
+# Everything NOT on this allowlist is auto-marked `slow` at collection, so
+# a new test defaults into the full suite and must be promoted here
+# deliberately (with an eye on its measured cost; per-file wall times from
+# the 2026-07-30 sweep are noted). The FULL suite (~35 min) remains the
+# completeness bar: `python -m pytest tests/ -q`.
+#
+# "all" keeps the whole file; a set keeps only those test functions
+# (parametrized variants included).
+QUICK: dict[str, object] = {
+    # Pure numerics / fast units (whole files).
+    "test_vtrace.py": "all",  # 5s
+    "test_gae.py": "all",  # 4s
+    "test_scan.py": "all",  # 14s
+    "test_losses.py": "all",  # 15s
+    "test_distributions.py": "all",  # 13s
+    "test_envs.py": "all",  # 4s
+    "test_bench_history.py": "all",  # 1s
+    "test_multiprocess.py": "all",  # (slow-marked inside already)
+    "test_differential.py": "all",  # 12s
+    "test_metrics.py": "all",  # 13s
+    "test_breakout.py": "all",  # 10s
+    "test_anakin.py": "all",  # 16s
+    "test_cpu_async.py": "all",  # 16s
+    # Curated cores of the heavier files.
+    "test_timeshard.py": {
+        "test_vtrace_timesharded_matches_single_device",  # 6s
+        "test_gae_timesharded_matches_single_device",  # 6s
+    },
+    "test_learner.py": {
+        "test_sharded_grads_equal_full_batch_grads",  # 3 algos, ~25s
+        "test_impala_actor_staleness",  # 9s
+        "test_unknown_optimizer_rejected",
+    },
+    "test_qlearn.py": {"test_huber_td_loss_fixture"},  # 11s
+    "test_sebulba.py": {
+        "test_param_store_versioning",
+        "test_jax_host_pool_contract",
+        "test_rollout_learner_improves_on_fixed_fragment",  # 3s
+        "test_fused_host_updates_match_sequential",  # 5s
+    },
+    "test_checkpoint.py": {"test_save_restore_bit_exact_next_step"},  # 16s
+    "test_api.py": {
+        "test_config_override_parsing",
+        "test_presets_exist",
+        "test_make_agent_unknown_backend",
+        "test_make_agent_rejects_bad_enums_eagerly",
+        "test_make_agent_train_smoke",  # 13s
+    },
+    "test_pong.py": {
+        "test_pong_scoring_and_serve",
+        "test_pong_agent_bounce",
+        "test_pong_episode_ends_at_win_score",
+        "test_pong_opponent_validation",
+    },
+    "test_race_debug.py": {
+        "test_paramstore_detects_removed_lock",  # the §5.2b proof
+        "test_fragment_checker_accepts_gapless_and_restarts",
+        "test_fragment_checker_detects_violations",
+        "test_inference_server_invariant_is_fatal",
+    },
+    "test_ppo_multipass.py": {
+        "test_ppo_multipass_minibatch_divisibility_error",
+        "test_ppo_multipass_dp_consistency",  # 8s
+    },
+    "test_wrappers.py": {
+        "test_frame_skip_sums_rewards_and_freezes_at_done",
+        "test_frame_skip_wrapper_contract",
+        "test_host_pool_refuses_unhonorable_knobs",
+        "test_registry_applies_knobs",
+    },
+    "test_recurrent.py": {"test_recurrent_apply_and_reset"},
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    slow = pytest.mark.slow
+    seen_files: set[str] = set()
+    seen_names: set[tuple[str, str]] = set()
+    for item in items:
+        fname = item.fspath.basename
+        seen_files.add(fname)
+        entry = QUICK.get(fname)
+        if entry == "all":
+            continue
+        name = item.name.split("[")[0]
+        if isinstance(entry, set) and name in entry:
+            seen_names.add((fname, name))
+            continue
+        item.add_marker(slow)
+
+    # The quick tier must not thin out silently: a renamed/deleted test
+    # that a QUICK entry still points at is a collection-time ERROR, not a
+    # quietly-skipped check. (Only enforced on full-tests collections, so
+    # running a single file doesn't trip the other entries.)
+    if len(seen_files) < len(QUICK):
+        return
+    stale = [
+        (fname, name)
+        for fname, entry in QUICK.items()
+        if isinstance(entry, set)
+        for name in entry
+        if (fname, name) not in seen_names
+    ]
+    missing_files = [f for f in QUICK if f not in seen_files]
+    if stale or missing_files:
+        raise pytest.UsageError(
+            f"tests/conftest.py QUICK allowlist is stale: missing files "
+            f"{missing_files}, missing tests {stale} — update the quick "
+            "tier so its curated checks don't silently drop out"
+        )
+
 
 @pytest.fixture(scope="session")
 def devices():

@@ -132,15 +132,17 @@ def test_registry_applies_knobs():
 
     cfg = Config(frame_skip=4, sticky_actions=0.25)
     env = registry.make("CartPole-v1", cfg)
-    assert isinstance(env, StickyActions)
-    assert isinstance(env._env, FrameSkip)
+    # Sticky INSIDE skip: ALE redraws the stick at every raw frame of the
+    # window, not once per agent decision.
+    assert isinstance(env, FrameSkip)
+    assert isinstance(env._env, StickyActions)
 
-    # Pixel envs take the skip internally (raw-frame pooling); the generic
-    # FrameSkip wrapper must NOT stack on top.
+    # Pixel envs take both knobs internally (raw-frame stick draws +
+    # pooling hooks); the generic wrappers must NOT stack on top.
     env = registry.make("JaxPongPixels-v0", cfg)
-    assert isinstance(env, StickyActions)
-    assert isinstance(env._env, FrameStackPixels)
-    assert env._env._skip == 4
+    assert isinstance(env, FrameStackPixels)
+    assert env._skip == 4 and env._sticky == 0.25
+    assert isinstance(env._core, StickyActions)
 
     env = registry.make("JaxPong-v0", Config(pong_opponent="predictive"))
     assert env._opponent == "predictive"
