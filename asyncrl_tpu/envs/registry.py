@@ -74,6 +74,7 @@ def _register_builtins() -> None:
             return {}
         return {
             "frame_skip": cfg.frame_skip,
+            "frame_pool": cfg.frame_pool,
             "sticky_actions": cfg.sticky_actions,
         }
 

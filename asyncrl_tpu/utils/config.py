@@ -116,10 +116,13 @@ class Config:
 
     # --- ALE-semantics knobs (JAX-native env registry; SURVEY.md §3.3) ---
     # Action repeat: each env step plays the action frame_skip times
-    # (rewards summed, frozen at episode end). Pixel envs additionally
-    # max-pool the last two raw frames of each window (the ALE flicker
-    # recipe; envs/pixels.py). 1 = off.
+    # (rewards summed, frozen at episode end). 1 = off.
     frame_skip: int = 1
+    # Pixel envs + frame_skip: max-pool the last two RAW frames of each
+    # window (the ALE flicker recipe; envs/pixels.py). Off by default —
+    # the built-in renderers never flicker, so pooling is a bit-identical
+    # second render; enable for strict ALE-preprocessing parity runs.
+    frame_pool: bool = False
     # Machado et al. 2018 sticky actions: probability the env repeats the
     # previous action instead of the agent's. ALE-standard value 0.25;
     # 0 = off.

@@ -24,6 +24,10 @@ run python bench.py
 run python bench.py atari_impala updates_per_call=8
 run python bench.py atari_impala updates_per_call=8 num_envs=256
 run python scripts/bench_matrix.py
+# Roofline/MFU + dispatch-vs-compute for the pixel flagship and the
+# vector config (VERDICT #2's requested breakdown).
+run python scripts/roofline.py atari_impala updates_per_call=8
+run python scripts/roofline.py pong_impala updates_per_call=32
 
 if [ "$QUICK" != "--quick" ]; then
   # North-star outcomes: wall-clock to target (VERDICT #1 / BASELINE.md).
@@ -33,15 +37,15 @@ if [ "$QUICK" != "--quick" ]; then
       --target 18.0 --budget-seconds 2400 eval_every=40
 fi
 
-# Persist the ledger. Artifact-only commit: no product behavior changed.
-if ! git diff --quiet -- BENCH_HISTORY.json 2>/dev/null \
-    || [ -n "$(git status --porcelain BENCH_HISTORY.json)" ]; then
-  git add BENCH_HISTORY.json
+# Persist the ledger. Artifact-only, PATH-LIMITED commit: anything else
+# staged or modified in the tree stays out of it.
+if [ -n "$(git status --porcelain BENCH_HISTORY.json)" ]; then
   git -c core.editor=true commit -q -m "Record real-TPU benchmark evidence in BENCH_HISTORY
 
 Automated ledger update from scripts/collect_tpu_evidence.sh on a live
 accelerator window; see the entries' device_kind/ts fields.
 
 No-Verification-Needed: benchmark-artifact-only commit" \
+    -- BENCH_HISTORY.json \
     && echo "=== BENCH_HISTORY.json committed"
 fi

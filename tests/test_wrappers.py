@@ -204,3 +204,17 @@ def test_host_pool_refuses_unhonorable_knobs():
 
     pool = make_host_pool(cfg.replace(host_pool="auto"), num_envs=2, seed=0)
     assert isinstance(pool, JaxHostPool)
+
+
+def test_frame_pool_reachable_from_config():
+    """frame_pool is a real Config knob plumbed to the pixel envs (a doc
+    claimed it before the plumbing existed — regression guard)."""
+    from asyncrl_tpu.envs import registry
+
+    env = registry.make(
+        "JaxPongPixels-v0", Config(frame_skip=4, frame_pool=True)
+    )
+    assert env._pool is True
+    state = env.init(jax.random.PRNGKey(0))
+    state, ts = jax.jit(env.step)(state, 0, jax.random.PRNGKey(1))
+    assert ts.obs.shape == (84, 84, 4)
