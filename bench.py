@@ -189,6 +189,19 @@ def main() -> None:
     if not any(o.startswith("updates_per_call=") for o in overrides):
         cfg = cfg.replace(updates_per_call=32)
     cfg = override(cfg, overrides)
+    if cfg.backend != "tpu":
+        # Checked on the EFFECTIVE config (preset + overrides): this
+        # harness times the Anakin learner's bare update loop; a
+        # host-backend config measured that way would record a
+        # wrong-architecture fps entry. The pipeline-aware harness
+        # handles those.
+        print(
+            f"bench: effective backend={cfg.backend!r}; measure host "
+            "backends with scripts/bench_matrix.py (pipeline-aware) "
+            "instead",
+            file=sys.stderr,
+        )
+        sys.exit(2)
 
     trainer = Trainer(cfg)
     state = trainer.state

@@ -40,6 +40,9 @@ fi
 # Persist the ledger. Artifact-only, PATH-LIMITED commit: anything else
 # staged or modified in the tree stays out of it.
 if [ -n "$(git status --porcelain BENCH_HISTORY.json)" ]; then
+  # add is required while the ledger is still untracked; the pathspec on
+  # commit keeps everything else (staged or not) out of this commit.
+  git add BENCH_HISTORY.json
   git -c core.editor=true commit -q -m "Record real-TPU benchmark evidence in BENCH_HISTORY
 
 Automated ledger update from scripts/collect_tpu_evidence.sh on a live

@@ -119,11 +119,22 @@ def main() -> int:
     from asyncrl_tpu.utils.config import override
 
     cfg = override(presets.get(preset_name), overrides)
+    if cfg.backend != "tpu":
+        print(
+            f"roofline: effective backend={cfg.backend!r}; this analysis "
+            "times the Anakin update program — host backends are measured "
+            "by scripts/bench_matrix.py",
+            file=sys.stderr,
+        )
+        return 2
 
     fused = measure(cfg, preset_name)
-    # Dispatch-vs-compute: the SAME geometry without fusion. The fps gap is
-    # pure per-call latency (identical math per update).
-    unfused = measure(cfg.replace(updates_per_call=1), preset_name)
+    if cfg.updates_per_call > 1:
+        # Dispatch-vs-compute: the SAME geometry without fusion. The fps
+        # gap is pure per-call latency (identical math per update).
+        unfused = measure(cfg.replace(updates_per_call=1), preset_name)
+    else:
+        unfused = fused  # K=1: a second identical compile proves nothing
     dispatch_overhead = max(
         0.0,
         unfused["seconds_per_call"]
