@@ -106,6 +106,11 @@ class PopulationTrainer:
                 "(no in-training eval path ranks the members); use the "
                 "single-run trainers"
             )
+        if config.selfplay:
+            raise NotImplementedError(
+                "selfplay is not wired for population training (member "
+                "init has no opponent slot); use the single-run Trainer"
+            )
         validate_qlearn_config(config)
         self.config = config
         self.pop_size = pop_size

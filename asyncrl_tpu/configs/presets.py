@@ -213,6 +213,18 @@ pendulum_native_ppo = Config(
     ppo_minibatches=8,
 )
 
+# Self-play ladder (Config.selfplay): the rival paddle is a frozen snapshot
+# of the agent itself, promoted every selfplay_refresh updates; greedy eval
+# still measures vs the calibrated scripted tracker (the 18.0-bar metric).
+pong_selfplay = pong_impala.replace(
+    env_id="JaxPongDuel-v0",
+    selfplay=True,
+    selfplay_refresh=200,
+    # Symmetric-game entropy: self-play collapses faster than fixed-
+    # opponent training, keep exploration pressure a bit higher.
+    entropy_coef=0.02,
+)
+
 PRESETS: dict[str, Config] = {
     "cartpole_a3c": cartpole_a3c,
     "cartpole_a3c_cpu": cartpole_a3c_cpu,
@@ -221,6 +233,7 @@ PRESETS: dict[str, Config] = {
     "cartpole_qlearn": cartpole_qlearn,
     "pong_qlearn": pong_qlearn,
     "pong_impala": pong_impala,
+    "pong_selfplay": pong_selfplay,
     "atari_impala": atari_impala,
     "breakout_impala": breakout_impala,
     "procgen_ppo": procgen_ppo,

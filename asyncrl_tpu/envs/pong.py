@@ -175,8 +175,33 @@ class Pong(Environment):
             ]
         )
 
+    def _scripted_opp_delta(self, state: PongState) -> jax.Array:
+        """The scripted rival's desired paddle move for this step."""
+        if self._opponent == "tracker":
+            target = state.ball[1]
+        else:
+            target = jnp.where(
+                state.ball[2] < 0,
+                predict_intercept(state.ball, OPP_X),
+                0.5,  # recenter while the ball recedes (classic AI habit)
+            )
+        return jnp.clip(
+            target - state.opp_y, -self._opp_speed, self._opp_speed
+        )
+
     def step(
         self, state: PongState, action: jax.Array, key: jax.Array
+    ) -> tuple[PongState, TimeStep]:
+        return self._step_with_opp_delta(
+            state, action, self._scripted_opp_delta(state), key
+        )
+
+    def _step_with_opp_delta(
+        self,
+        state: PongState,
+        action: jax.Array,
+        opp_delta: jax.Array,
+        key: jax.Array,
     ) -> tuple[PongState, TimeStep]:
         serve_key, reset_key = jax.random.split(key)
 
@@ -186,18 +211,9 @@ class Pong(Environment):
             PADDLE_HALF,
             1.0 - PADDLE_HALF,
         )
-        if self._opponent == "tracker":
-            target = state.ball[1]
-        else:
-            target = jnp.where(
-                state.ball[2] < 0,
-                predict_intercept(state.ball, OPP_X),
-                0.5,  # recenter while the ball recedes (classic AI habit)
-            )
-        track = jnp.clip(
-            target - state.opp_y, -self._opp_speed, self._opp_speed
+        opp_y = jnp.clip(
+            state.opp_y + opp_delta, PADDLE_HALF, 1.0 - PADDLE_HALF
         )
-        opp_y = jnp.clip(state.opp_y + track, PADDLE_HALF, 1.0 - PADDLE_HALF)
 
         # Ball advance + wall bounce.
         x = state.ball[0] + state.ball[2]
@@ -315,4 +331,43 @@ class PongPixels(FrameStackPixels):
             frame_skip=frame_skip,
             frame_pool=frame_pool,
             sticky_actions=sticky_actions,
+        )
+
+
+class DuelPong(Pong):
+    """Two-player Pong for self-play training (the ladder alternative the
+    round-1 review floated beside the opponent-difficulty calibration).
+
+    The SAME policy network can drive both paddles: ``observe_opponent``
+    returns the mirrored egocentric view (court flipped in x, paddle slots
+    swapped), and ``step_duel`` moves the opponent paddle by a real action
+    at FULL agent speed — a learned rival is strictly stronger hardware
+    than any scripted one. The single-action ``step`` inherits the
+    scripted opponent, so greedy evaluation of a self-play-trained agent
+    measures it against the calibrated tracker/predictive ladder (the
+    18.0-bar metric) without any extra machinery.
+    """
+
+    def observe_opponent(self, state: PongState) -> jax.Array:
+        b = state.ball
+        return jnp.stack(
+            [
+                1.0 - b[0],
+                b[1],
+                -b[2] / BALL_VX,
+                b[3] / MAX_SPIN,
+                state.opp_y,
+                state.agent_y,
+            ]
+        )
+
+    def step_duel(
+        self,
+        state: PongState,
+        action: jax.Array,
+        opp_action: jax.Array,
+        key: jax.Array,
+    ) -> tuple[PongState, TimeStep]:
+        return self._step_with_opp_delta(
+            state, action, AGENT_SPEED * _action_dir(opp_action), key
         )

@@ -80,6 +80,12 @@ def _register_builtins() -> None:
 
     register("CartPole-v1", CartPole)
     register("JaxPong-v0", lambda cfg: Pong(**pong_kwargs(cfg)), True)
+    # Duel variant for self-play (Config.selfplay); its single-action step
+    # keeps the scripted opponent, so eval measures vs the calibrated
+    # ladder.
+    from asyncrl_tpu.envs.pong import DuelPong
+
+    register("JaxPongDuel-v0", lambda cfg: DuelPong(**pong_kwargs(cfg)), True)
     register(
         "JaxPongPixels-v0",
         lambda cfg: PongPixels(**pong_kwargs(cfg), **pixel_kwargs(cfg)),

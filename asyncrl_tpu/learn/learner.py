@@ -82,6 +82,10 @@ class TrainState:
     # Running scalar stats of the per-env discounted return (reward
     # normalization, config.normalize_returns); None when disabled.
     ret_stats: Any = None
+    # Self-play (config.selfplay): the frozen rival snapshot the duel env
+    # plays against, refreshed from params every selfplay_refresh updates.
+    # None (empty subtree) otherwise — keeps old checkpoints restorable.
+    opponent_params: Any = None
 
 
 def state_partition_spec(axes: tuple[str, ...]) -> TrainState:
@@ -97,6 +101,7 @@ def state_partition_spec(axes: tuple[str, ...]) -> TrainState:
         update_step=P(),
         obs_stats=P(),
         ret_stats=P(),
+        opponent_params=P(),
     )
 
 
@@ -208,6 +213,38 @@ def validate_train_target(config: Config, target: int) -> None:
             f"horizon (config.total_env_steps={config.total_env_steps}): "
             "the annealed rate would sit at 0 for the excess steps. Set "
             "config.total_env_steps to the real budget instead."
+        )
+
+
+def validate_selfplay_config(config: Config, env, model) -> None:
+    """Eager self-play checks (Anakin Learner only): the env must be a duel
+    env, the policy feed-forward (the frozen rival has no core-state
+    plumbing in v1), and the backend the fused one."""
+    if not config.selfplay:
+        return
+    if config.backend != "tpu":
+        raise NotImplementedError(
+            "selfplay is Anakin-only (backend='tpu'): host actor threads "
+            "have no opponent-snapshot channel"
+        )
+    if config.frame_skip > 1 or config.sticky_actions > 0.0:
+        raise NotImplementedError(
+            "selfplay is incompatible with frame_skip/sticky_actions: the "
+            "ALE wrappers don't forward the duel protocol (step_duel / "
+            "observe_opponent), and their wrapped state would hide the "
+            "game state the mirror view reads"
+        )
+    if not (
+        hasattr(env, "step_duel") and hasattr(env, "observe_opponent")
+    ):
+        raise ValueError(
+            f"selfplay needs a duel env (step_duel + observe_opponent); "
+            f"{config.env_id!r} is not one — use JaxPongDuel-v0"
+        )
+    if is_recurrent(model):
+        raise NotImplementedError(
+            "selfplay with recurrent cores is not wired (the frozen rival "
+            "would need its own carry); use core='ff'"
         )
 
 
@@ -633,6 +670,9 @@ def make_train_step(
                 return_discount=(
                     config.gamma if config.normalize_returns else 0.0
                 ),
+                opponent_params=(
+                    state.opponent_params if config.selfplay else None
+                ),
             )
         if config.normalize_returns:
             # Scale this fragment's rewards by the PRE-update return std
@@ -709,6 +749,18 @@ def make_train_step(
         if ret_stats is not None:
             ret_stats = update_stats(ret_stats, rollout.disc_returns, axes)
 
+        if config.selfplay:
+            # Ladder refresh: the frozen rival becomes the CURRENT policy
+            # every selfplay_refresh updates (same select pattern as the
+            # actor_params staleness refresh).
+            promote = (step % max(config.selfplay_refresh, 1)) == 0
+            opponent_params = jax.tree.map(
+                lambda new, old: jnp.where(promote, new, old),
+                params, state.opponent_params,
+            )
+        else:
+            opponent_params = state.opponent_params  # None subtree
+
         metrics = dict(metrics)
         metrics["loss"] = loss
         metrics["grad_norm"] = grad_norm
@@ -724,6 +776,7 @@ def make_train_step(
             update_step=step,
             obs_stats=obs_stats,
             ret_stats=ret_stats,
+            opponent_params=opponent_params,
         )
         return new_state, metrics
 
@@ -754,6 +807,7 @@ class Learner:
         # Eager geometry validation (clearer than a trace-time failure).
         validate_recurrent_config(config, model)
         validate_qlearn_config(config)
+        validate_selfplay_config(config, env, model)
         if config.updates_per_call < 1:
             raise ValueError(
                 f"updates_per_call={config.updates_per_call} must be >= 1"
@@ -845,6 +899,9 @@ class Learner:
             ),
             ret_stats=(
                 None if ret_stats is None else jax.device_put(ret_stats, rep)
+            ),
+            opponent_params=(
+                jax.device_put(params, rep) if cfg.selfplay else None
             ),
         )
 

@@ -234,6 +234,11 @@ class RolloutLearner:
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
         validate_qlearn_config(config)
+        if config.selfplay:
+            raise NotImplementedError(
+                "selfplay is Anakin-only (backend='tpu'): host actor "
+                "threads have no opponent-snapshot channel"
+            )
         time_sharded = TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1
         if time_sharded:
             sp = mesh.shape[TIME_AXIS]

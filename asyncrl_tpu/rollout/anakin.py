@@ -85,6 +85,7 @@ def unroll(
     reward_scale: float = 1.0,
     dist_extra: jax.Array | None = None,
     return_discount: float = 0.0,
+    opponent_params: Any = None,
 ) -> tuple[ActorState, Rollout, EpisodeStats]:
     """Roll the policy forward ``unroll_len`` steps over the env batch.
 
@@ -107,6 +108,15 @@ def unroll(
     the learner's stats fold, so the carry, the stream, and the consumer
     cannot disagree (a ``return_discount`` of 0 degrades to reward-std
     tracking rather than crashing).
+
+    ``opponent_params`` (self-play, Config.selfplay): the env must be a
+    duel env (``observe_opponent`` + ``step_duel``); each step the SAME
+    ``apply_fn`` evaluates the frozen opponent snapshot on the mirrored
+    observation and its sampled action drives the rival paddle. The
+    fragment records only the AGENT's side (actions/logp/rewards), so
+    every learner consumes it unchanged. When None (the default), the
+    PRNG stream and the compiled program are bit-identical to before the
+    feature existed.
     """
     if dist is None:
         from asyncrl_tpu.ops import distributions
@@ -116,8 +126,11 @@ def unroll(
     recurrent = actor_state.core is not None
     track_returns = actor_state.disc_return is not None
 
+    selfplay = opponent_params is not None
+
     def step_fn(carry: ActorState, _):
-        split = jax.vmap(lambda k: jax.random.split(k, 3))(carry.keys)  # [B,3,2]
+        n_keys = 4 if selfplay else 3
+        split = jax.vmap(lambda k: jax.random.split(k, n_keys))(carry.keys)
         next_keys, act_keys, step_keys = split[:, 0], split[:, 1], split[:, 2]
 
         if recurrent:
@@ -132,7 +145,29 @@ def unroll(
         actions = jax.vmap(dist.sample)(act_keys, dist_params)
         behaviour_logp = dist.logp(dist_params, actions)
 
-        env_state, ts = jax.vmap(env.step)(carry.env_state, actions, step_keys)
+        if selfplay:
+            opp_obs = jax.vmap(env.observe_opponent)(carry.env_state)
+            opp_dist_params, _ = apply_fn(opponent_params, opp_obs)
+            if dist_extra is not None:
+                # The rival samples under the SAME behaviour knobs as the
+                # agent (e.g. the Q-family's annealed ε) — without this, an
+                # EpsilonGreedy dist would default the opponent to ε=0 and
+                # the frozen snapshot would play deterministic argmax.
+                opp_dist_params = jnp.concatenate(
+                    [
+                        opp_dist_params,
+                        dist_extra.astype(opp_dist_params.dtype),
+                    ],
+                    axis=-1,
+                )
+            opp_actions = jax.vmap(dist.sample)(split[:, 3], opp_dist_params)
+            env_state, ts = jax.vmap(env.step_duel)(
+                carry.env_state, actions, opp_actions, step_keys
+            )
+        else:
+            env_state, ts = jax.vmap(env.step)(
+                carry.env_state, actions, step_keys
+            )
 
         if recurrent:
             core = reset_core(core, ts.done)

@@ -134,6 +134,14 @@ class Config:
     # constant-spin exploit. Speed 0.0 = the mode's tuned default.
     pong_opponent: str = "tracker"
     pong_opponent_speed: float = 0.0
+    # Self-play (Anakin backend, duel envs like JaxPongDuel-v0): the rival
+    # paddle is driven by a FROZEN SNAPSHOT of the agent's own policy,
+    # refreshed from the live params every selfplay_refresh updates — the
+    # ladder alternative to scripted opponents. Greedy evaluation still
+    # runs against the calibrated scripted opponent (the duel env's
+    # single-action step), so the 18.0-bar metric is unchanged.
+    selfplay: bool = False
+    selfplay_refresh: int = 200
 
     # --- parallelism ---
     mesh_shape: tuple[int, ...] = (-1,)  # -1: all local devices on axis "dp"

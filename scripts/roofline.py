@@ -133,20 +133,27 @@ def main() -> int:
         # Dispatch-vs-compute: the SAME geometry without fusion. The fps
         # gap is pure per-call latency (identical math per update).
         unfused = measure(cfg.replace(updates_per_call=1), preset_name)
+        dispatch_overhead = round(
+            max(
+                0.0,
+                unfused["seconds_per_call"]
+                - fused["seconds_per_call"] / cfg.updates_per_call,
+            ),
+            5,
+        )
+        unfused_fps = unfused["frames_per_sec"]
     else:
-        unfused = fused  # K=1: a second identical compile proves nothing
-    dispatch_overhead = max(
-        0.0,
-        unfused["seconds_per_call"]
-        - fused["seconds_per_call"] / max(cfg.updates_per_call, 1),
-    )
+        # K=1: nothing to compare against — record the fields as
+        # UNMEASURED (null), never as a fabricated zero-overhead datapoint.
+        dispatch_overhead = None
+        unfused_fps = None
 
     result = {
         "kind": "roofline",
         **bench_history.device_entry(),
         **fused,
-        "unfused_frames_per_sec": unfused["frames_per_sec"],
-        "dispatch_overhead_s_per_update": round(dispatch_overhead, 5),
+        "unfused_frames_per_sec": unfused_fps,
+        "dispatch_overhead_s_per_update": dispatch_overhead,
         "compute_s_per_update": round(
             fused["seconds_per_call"] / max(cfg.updates_per_call, 1), 5
         ),

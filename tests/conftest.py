@@ -99,6 +99,12 @@ QUICK: dict[str, object] = {
         "test_registry_applies_knobs",
     },
     "test_recurrent.py": {"test_recurrent_apply_and_reset"},
+    "test_selfplay.py": {
+        "test_observe_opponent_is_the_mirror_view",
+        "test_duel_dynamics_are_symmetric",
+        "test_duel_single_action_step_keeps_scripted_opponent",
+        "test_selfplay_guards",
+    },
 }
 
 
