@@ -259,33 +259,50 @@ def main() -> None:
     }
 
     if dev["platform"] == "cpu":
-        # The CPU number is a liveness datapoint, not perf evidence. When a
-        # committed real-accelerator measurement exists (BENCH_HISTORY.json)
-        # FOR THIS PRESET — a different preset's number must never stand in
-        # for the workload the driver asked about — report that as the
-        # headline, clearly labeled with its capture time, so a dead tunnel
-        # at driver-capture time can no longer erase the round's perf
-        # evidence (VERDICT.md round 1, Missing #1).
-        lkg = bench_history.last_known_good("throughput", preset=preset_name)
-        if lkg is not None:
-            result = {
-                "metric": f"env_frames_per_sec ({lkg['preset']}, "
-                f"{lkg['num_envs']} envs x {lkg['unroll_len']} unroll x "
-                f"{lkg['updates_per_call']} fused updates/call, "
-                f"{lkg['device_kind']} x{lkg['device_count']}, "
-                f"last-known-good {lkg['ts']}; live tunnel down, fresh "
-                f"measurement in cpu_fallback)",
-                "value": lkg["frames_per_sec"],
-                "unit": "frames/sec",
-                "vs_baseline": lkg["vs_baseline"],
-                "cpu_fallback": {
-                    "frames_per_sec": round(fps),
-                    "device_kind": dev["device_kind"],
-                    "device_count": dev["device_count"],
-                },
-            }
+        attach_last_known_good(result, preset_name)
 
     print(json.dumps(result))
+
+
+def attach_last_known_good(
+    result: dict, preset_name: str, path: str | None = None
+) -> dict:
+    """Headline provenance (VERDICT.md round 2, Weak #1/Next #3): the
+    freshly measured number stays in ``result["value"]`` even when it is a
+    CPU fallback — a consumer parsing ``value``/``vs_baseline`` must always
+    get something this very run measured, never a remembered one. The
+    newest committed accelerator measurement for THIS preset rides along
+    under the explicitly-named ``last_known_good`` key, carrying its
+    capture time and ``captured_by`` provenance verbatim so a
+    hand-backfilled entry can never masquerade as harness-captured."""
+    from asyncrl_tpu.utils import bench_history
+
+    lkg = bench_history.last_known_good(
+        "throughput", preset=preset_name, path=path
+    )
+    if lkg is not None:
+        result["metric"] += " [CPU fallback; tunnel down]"
+        # .get() throughout: ledger entries may be hand-backfilled and are
+        # not schema-validated — a sparse one degrades this annotation, it
+        # must never crash the freshly-measured headline.
+        result["last_known_good"] = {
+            k: lkg.get(k)
+            for k in (
+                "frames_per_sec",
+                "vs_baseline",
+                "ts",
+                "preset",
+                "num_envs",
+                "unroll_len",
+                "updates_per_call",
+                "device_kind",
+                "device_count",
+            )
+        }
+        result["last_known_good"]["captured_by"] = lkg.get(
+            "captured_by", "manual"
+        )
+    return result
 
 
 if __name__ == "__main__":

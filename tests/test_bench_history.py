@@ -84,6 +84,62 @@ def test_last_known_good_skips_cpu_and_filters(tmp_path):
     assert got["seconds"] == 480.0
 
 
+def test_record_stamps_harness_provenance(tmp_path):
+    """VERDICT round 2 Weak #1: every entry carries captured_by; record()
+    stamps "harness" (it runs inside the measuring process) unless the
+    caller explicitly says otherwise (manual backfills)."""
+    path = str(tmp_path / "hist.json")
+    e = bench_history.record(
+        {"kind": "throughput", "platform": "tpu"}, path=path
+    )
+    assert e["captured_by"] == "harness"
+    e2 = bench_history.record(
+        {"kind": "throughput", "platform": "tpu", "captured_by": "manual"},
+        path=path,
+    )
+    assert e2["captured_by"] == "manual"
+
+
+def test_bench_headline_is_always_the_fresh_measurement(tmp_path):
+    """VERDICT round 2 Next #3: a dead tunnel yields a headline that is
+    measured, not remembered — last-known-good is an auxiliary key with
+    its provenance attached verbatim."""
+    import bench
+
+    path = str(tmp_path / "hist.json")
+    bench_history.record(
+        {
+            "kind": "throughput",
+            "preset": "pong_impala",
+            "platform": "tpu",
+            "device_kind": "TPU v5 lite",
+            "device_count": 1,
+            "num_envs": 256,
+            "unroll_len": 32,
+            "updates_per_call": 32,
+            "frames_per_sec": 17_000_000,
+            "vs_baseline": 17.0,
+            "captured_by": "manual",
+        },
+        path=path,
+    )
+    result = {"metric": "env_frames_per_sec (pong_impala)", "value": 56_000,
+              "unit": "frames/sec", "vs_baseline": 0.056}
+    out = bench.attach_last_known_good(result, "pong_impala", path=path)
+    assert out["value"] == 56_000  # fresh stays headline
+    assert out["vs_baseline"] == 0.056
+    assert out["last_known_good"]["frames_per_sec"] == 17_000_000
+    assert out["last_known_good"]["captured_by"] == "manual"
+    assert "CPU fallback" in out["metric"]
+    # No accelerator history for the preset: result passes through untouched.
+    out2 = bench.attach_last_known_good(
+        {"metric": "m", "value": 1, "unit": "u", "vs_baseline": 0.0},
+        "atari_impala",
+        path=path,
+    )
+    assert "last_known_good" not in out2
+
+
 def test_atomic_write_leaves_no_tmp_droppings(tmp_path):
     path = str(tmp_path / "hist.json")
     for i in range(3):
