@@ -369,11 +369,17 @@ class SebulbaTrainer:
                     # running between gets).
                     continue
                 rollout = _stack_fragments([f.rollout for f in fragments])
-                if cfg.reward_scale != 1.0:
-                    # Scale the discounted-return stream with the rewards:
-                    # the stats must track the learner's reward view.
+                if cfg.reward_scale != 1.0 or cfg.step_cost != 0.0:
+                    # Learner's reward view (living cost, then scale). Host
+                    # fragments carry RAW rewards, so the cost applies here.
+                    # The disc_returns stream (normalize_returns' std
+                    # tracker) is scaled but NOT cost-shifted — the same
+                    # cost-free stream the anakin path tracks (see
+                    # rollout/anakin.py), so both backends normalize by the
+                    # same statistic for the same config.
                     rollout = rollout.replace(
-                        rewards=rollout.rewards * cfg.reward_scale,
+                        rewards=(rollout.rewards - cfg.step_cost)
+                        * cfg.reward_scale,
                         disc_returns=(
                             None
                             if rollout.disc_returns is None

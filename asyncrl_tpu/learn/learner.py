@@ -666,6 +666,7 @@ def make_train_step(
             actor, rollout, stats = unroll(
                 napply, state.actor_params, env, state.actor,
                 config.unroll_len, dist=dist, reward_scale=config.reward_scale,
+                step_cost=config.step_cost,
                 dist_extra=dist_extra,
                 return_discount=(
                     config.gamma if config.normalize_returns else 0.0

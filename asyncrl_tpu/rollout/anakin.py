@@ -83,6 +83,7 @@ def unroll(
     unroll_len: int,
     dist=None,
     reward_scale: float = 1.0,
+    step_cost: float = 0.0,
     dist_extra: jax.Array | None = None,
     return_discount: float = 0.0,
     opponent_params: Any = None,
@@ -176,6 +177,13 @@ def unroll(
         ep_return = carry.running_return + ts.reward
         ep_length = carry.running_length + 1.0
         # Discounted-return stream for reward normalization (scaled view).
+        learner_reward = (ts.reward - step_cost) * reward_scale
+        # The return-std stream deliberately EXCLUDES step_cost (scaled raw
+        # rewards only): the host backends' actor-built streams cannot
+        # reconstruct the cost's time-since-reset-dependent offset, so both
+        # paths track the same cost-free stream and stay comparable; the
+        # constant living cost is not what return normalization exists to
+        # equalize anyway.
         g = (
             carry.disc_return * return_discount + ts.reward * reward_scale
             if track_returns
@@ -194,7 +202,7 @@ def unroll(
             carry.obs,
             actions,
             behaviour_logp,
-            ts.reward * reward_scale,  # learner's view; metrics stay raw
+            learner_reward,  # learner's view (cost + scale); metrics stay raw
             ts.terminated,
             ts.truncated,
             ep_return * done_f,

@@ -72,6 +72,15 @@ class Config:
     # it the value loss dwarfs the policy gradient under grad-norm clipping.
     # Brax's PPO does the same for Ant/Humanoid (BASELINE.json:11).
     reward_scale: float = 1.0
+    # Per-step living cost subtracted from the LEARNER's reward view before
+    # reward_scale (episode-return metrics and eval stay raw, same contract
+    # as reward_scale). The survival-vs-decisiveness shaping knob: a policy
+    # that can defend forever but rarely converts (the measured JaxPong
+    # plateau — perfect defense, 3000-step truncated rallies,
+    # scripts/pong_diagnose.py) gets an explicit gradient toward ENDING
+    # rallies. Potential-free shaping: it changes the training objective,
+    # so the headline metric must always be the raw eval return.
+    step_cost: float = 0.0
     # Running observation normalization (the VecNormalize / Brax-PPO recipe,
     # ops/normalize.py): stats ride the train state, update inside the
     # jitted step (psum'd over the mesh), and normalize the actor's,

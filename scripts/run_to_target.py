@@ -75,14 +75,6 @@ def main() -> int:
         cfg = cfg.replace(eval_every=cfg.log_every, eval_episodes=32)
     cfg = override(cfg, overrides)
 
-    # make_agent dispatches on cfg.backend — a sebulba/cpu_async preset must
-    # be measured on ITS architecture, not silently retimed on Anakin.
-    trainer = make_agent(cfg)
-    dev = bench_history.device_entry()
-    status = {"reached": False, "seconds": None, "eval_return": None}
-    fps_log: list[float] = []
-    t0 = time.perf_counter()
-
     # Cross-session accumulation (VERDICT.md round 2, Next #1): with a
     # checkpoint_dir, Trainer auto-resumes training state bit-exact, and the
     # wall clock accumulates through a sidecar — so a target reached on the
@@ -137,6 +129,17 @@ def main() -> int:
                 f"session(s), {prior['seconds']:.0f}s accumulated",
                 file=sys.stderr,
             )
+
+    # The completed-measurement refusal above must run BEFORE backend init:
+    # a refusal should be instant and side-effect-free, not pay a (possibly
+    # hung-tunnel) accelerator bring-up and an orbax auto-restore first.
+    # make_agent dispatches on cfg.backend — a sebulba/cpu_async preset must
+    # be measured on ITS architecture, not silently retimed on Anakin.
+    trainer = make_agent(cfg)
+    dev = bench_history.device_entry()
+    status = {"reached": False, "seconds": None, "eval_return": None}
+    fps_log: list[float] = []
+    t0 = time.perf_counter()
 
     def total_elapsed() -> float:
         return prior["seconds"] + time.perf_counter() - t0

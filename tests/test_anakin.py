@@ -80,3 +80,29 @@ def test_unroll_deterministic():
     _, ro2, _ = f(params, actor)
     np.testing.assert_array_equal(np.asarray(ro1.actions), np.asarray(ro2.actions))
     np.testing.assert_allclose(np.asarray(ro1.obs), np.asarray(ro2.obs))
+
+
+def test_step_cost_shapes_learner_view_only():
+    """Config.step_cost: the learner's reward view subtracts the living
+    cost (before reward_scale), while episode-return metrics stay raw —
+    the same contract reward_scale pins."""
+    cfg, env, model, params, actor = setup()
+    run = jax.jit(
+        lambda p, a, c, s: unroll(
+            model.apply, p, env, a, cfg.unroll_len,
+            reward_scale=s, step_cost=c,
+        )
+    )
+    _, ro_raw, stats_raw = run(params, actor, 0.0, 1.0)
+    _, ro_cost, stats_cost = run(params, actor, 0.01, 2.0)
+    # Same PRNG path -> identical trajectories; only the learner view moves.
+    np.testing.assert_allclose(
+        np.asarray(ro_cost.rewards),
+        (np.asarray(ro_raw.rewards) - 0.01) * 2.0,
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats_cost.completed_return_sum),
+        np.asarray(stats_raw.completed_return_sum),
+        rtol=1e-6,
+    )
