@@ -29,6 +29,56 @@ from asyncrl_tpu.parallel.mesh import make_mesh
 from asyncrl_tpu.utils.config import Config
 
 
+def make_eval_rollout(config, env, model, num_episodes: int, max_steps: int):
+    """Build ``eval_rollout(params, obs_stats, key) -> [num_episodes]``:
+    one fully-on-device greedy rollout returning per-episode returns
+    (SURVEY.md §3.5). Shared by ``Trainer.evaluate`` and the population
+    trainer's per-member ranking (``jax.vmap`` over the params axis —
+    api/population.py)."""
+    from asyncrl_tpu.ops import distributions
+
+    apply_fn = model.apply
+    dist = distributions.for_config(config, env.spec)
+    recurrent = is_recurrent(model)
+
+    def eval_rollout(params, obs_stats, key):
+        # Greedy eval must see the same normalized observations the
+        # policy trained on (ops/normalize.py; identity when None).
+        napply = normalizing_apply(apply_fn, obs_stats)
+        init_keys = jax.random.split(key, num_episodes + 1)
+        env_state = jax.vmap(env.init)(init_keys[:-1])
+        obs = jax.vmap(env.observe)(env_state)
+        step_key = init_keys[-1]
+        core = model.initial_core(num_episodes) if recurrent else None
+
+        def body(carry, _):
+            env_state, obs, ret, alive, k, core = carry
+            if recurrent:
+                dist_params, _, core = napply(params, obs, core)
+            else:
+                dist_params, _ = napply(params, obs)
+            actions = dist.mode(dist_params)
+            k, sub = jax.random.split(k)
+            step_keys = jax.random.split(sub, num_episodes)
+            env_state, ts = jax.vmap(env.step)(env_state, actions, step_keys)
+            if recurrent:
+                core = reset_core(core, ts.done)
+            ret = ret + ts.reward * alive
+            alive = alive * (1.0 - ts.done.astype(jnp.float32))
+            return (env_state, ts.obs, ret, alive, k, core), None
+
+        zeros = jnp.zeros((num_episodes,), jnp.float32)
+        (_, _, ret, _, _, _), _ = jax.lax.scan(
+            body,
+            (env_state, obs, zeros, zeros + 1.0, step_key, core),
+            None,
+            length=max_steps,
+        )
+        return ret
+
+    return eval_rollout
+
+
 class Trainer:
     """Owns env, model, mesh, learner, and the training loop.
 
@@ -184,50 +234,11 @@ class Trainer:
         batched rollout either way)."""
         cache_key = (num_episodes, max_steps)
         if cache_key not in self._eval_fns:
-            from asyncrl_tpu.ops import distributions
-
-            env = self.env
-            model = self.model
-            apply_fn = self.model.apply
-            dist = distributions.for_config(self.config, env.spec)
-            recurrent = is_recurrent(model)
-
-            def eval_rollout(params, obs_stats, key):
-                # Greedy eval must see the same normalized observations the
-                # policy trained on (ops/normalize.py; identity when None).
-                napply = normalizing_apply(apply_fn, obs_stats)
-                init_keys = jax.random.split(key, num_episodes + 1)
-                env_state = jax.vmap(env.init)(init_keys[:-1])
-                obs = jax.vmap(env.observe)(env_state)
-                step_key = init_keys[-1]
-                core = model.initial_core(num_episodes) if recurrent else None
-
-                def body(carry, _):
-                    env_state, obs, ret, alive, k, core = carry
-                    if recurrent:
-                        dist_params, _, core = napply(params, obs, core)
-                    else:
-                        dist_params, _ = napply(params, obs)
-                    actions = dist.mode(dist_params)
-                    k, sub = jax.random.split(k)
-                    step_keys = jax.random.split(sub, num_episodes)
-                    env_state, ts = jax.vmap(env.step)(env_state, actions, step_keys)
-                    if recurrent:
-                        core = reset_core(core, ts.done)
-                    ret = ret + ts.reward * alive
-                    alive = alive * (1.0 - ts.done.astype(jnp.float32))
-                    return (env_state, ts.obs, ret, alive, k, core), None
-
-                zeros = jnp.zeros((num_episodes,), jnp.float32)
-                (_, _, ret, _, _, _), _ = jax.lax.scan(
-                    body,
-                    (env_state, obs, zeros, zeros + 1.0, step_key, core),
-                    None,
-                    length=max_steps,
+            self._eval_fns[cache_key] = jax.jit(
+                make_eval_rollout(
+                    self.config, self.env, self.model, num_episodes, max_steps
                 )
-                return ret
-
-            self._eval_fns[cache_key] = jax.jit(eval_rollout)
+            )
         returns = self._eval_fns[cache_key](
             self.state.params, self.state.obs_stats, jax.random.PRNGKey(seed)
         )

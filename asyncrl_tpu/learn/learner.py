@@ -616,6 +616,28 @@ def init_params(model, env: Environment, pkey: jax.Array):
     return model.init(pkey, dummy_obs)
 
 
+def fuse_updates(body: Callable, updates_per_call: int) -> Callable:
+    """Fuse K sequential train-step updates into ONE XLA program via
+    ``lax.scan`` — zero host dispatch between them (the amortization that
+    matters on a high-latency device link; bench.py's measured ~8 ms/call
+    tunnel round trip). Metrics leaves stack to [K].
+
+    Shared by Learner (single-run) and PopulationTrainer (vmapped members —
+    VERDICT r2 Next #4): extra positional args (e.g. the member seed) pass
+    through to every fused step unchanged.
+    """
+    if updates_per_call <= 1:
+        return body
+
+    def multi_step(state: TrainState, *args):
+        return jax.lax.scan(
+            lambda s, _: body(s, *args), state, None,
+            length=updates_per_call,
+        )
+
+    return multi_step
+
+
 def make_train_step(
     config: Config,
     env: Environment,
@@ -826,19 +848,7 @@ class Learner:
         spec = state_partition_spec(dp_axes(mesh))
         body = make_train_step(config, env, model.apply, self.optimizer, mesh)
 
-        if config.updates_per_call > 1:
-            # Fuse K updates into one XLA program: zero host dispatch
-            # between them; metrics stack to [K] leaves.
-            K = config.updates_per_call
-
-            def multi_step(state: TrainState):
-                return jax.lax.scan(
-                    lambda s, _: body(s), state, None, length=K
-                )
-
-            wrapped = multi_step
-        else:
-            wrapped = body
+        wrapped = fuse_updates(body, config.updates_per_call)
 
         self._step = jax.jit(
             jax.shard_map(

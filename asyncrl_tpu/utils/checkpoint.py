@@ -243,10 +243,12 @@ class TrainerCheckpointing:
             self.save_now(state, env_steps)
 
     def maybe_save_best(
-        self, state: Any, env_steps: int, score: float
+        self, state: Any, env_steps: int, score: float, **extra_meta
     ) -> bool:
         """Save ``state`` to the best-checkpoint slot if ``score`` beats the
         best seen (including across resumes). Returns whether it saved.
+        ``extra_meta`` rides into the slot's metadata with the score (e.g.
+        the population trainer's winning member index).
 
         Non-finite scores never qualify: NaN compares False against
         everything, so without the guard a diverged run's NaN eval would
@@ -266,7 +268,7 @@ class TrainerCheckpointing:
         if self._best_score is not None and score <= self._best_score:
             return False
         self._best_score = float(score)
-        self._best.set_extra_meta(eval_return=float(score))
+        self._best.set_extra_meta(eval_return=float(score), **extra_meta)
         step = _step_of(state)
         for stale in self._best.all_steps():
             # After a crash-resume from a main checkpoint older than the

@@ -79,8 +79,10 @@ def timed_update_window(
     """
     import time
 
+    from asyncrl_tpu.utils.checkpoint import _step_of
+
     def sync(s) -> int:
-        return int(s.update_step)  # D2H read: forces all queued work
+        return _step_of(s)  # D2H read: forces all queued work
 
     # Counter base: the state may be non-fresh (checkpoint auto-resume), so
     # the guard compares counter DELTA, not the absolute value.

@@ -32,7 +32,8 @@ import numpy as np
 
 from asyncrl_tpu.configs import presets
 from asyncrl_tpu.envs.pong import PADDLE_HALF, Pong
-from asyncrl_tpu.models.networks import build_model
+from asyncrl_tpu.models.networks import is_recurrent
+from asyncrl_tpu.ops.normalize import normalizing_apply
 from asyncrl_tpu.utils import checkpoint as ckpt_mod
 from asyncrl_tpu.utils.config import override
 
@@ -63,11 +64,7 @@ def diagnose(apply_fn, params, games: int, seed: int = 7):
             obs = env.observe(st)
             logits = apply_fn(params, obs[None])[0][0]
             a = jnp.argmax(logits).astype(jnp.int32)
-            pre_ay, pre_oy = st.agent_y, st.opp_y
             st2, ts = env.step(st, a, k)
-            # Contact/score forensics from the PRE-step state geometry: the
-            # step moves paddles first, so re-derive their post-move, pre-
-            # bounce positions the same way the env does.
             rec = {
                 "reward": jnp.where(done, 0.0, ts.reward),
                 # last_obs is the un-reset end-of-step view.
@@ -95,7 +92,14 @@ def main() -> int:
     cfg = override(cfg, [a for a in sys.argv[3:] if "=" in a])
 
     trainer, params, model, step = load_params(run_dir, cfg)
-    apply_fn = model.apply
+    if is_recurrent(model):
+        raise SystemExit(
+            "pong_diagnose analyzes feed-forward policies only; use "
+            "cli/play.py --save for recurrent trajectory dumps"
+        )
+    # Same normalized view the policy trained on (identity when stats are
+    # None) — raw obs into a normalized-trained net would misdescribe it.
+    apply_fn = normalizing_apply(model.apply, trainer.state.obs_stats)
 
     recs = diagnose(apply_fn, params, games)
     # vmap(one) stacks games on the LEADING axis: every rec is [games, T].

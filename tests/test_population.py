@@ -112,9 +112,67 @@ def test_member_equals_standalone_ppo_multipass(devices):
             np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
-def test_population_rejects_updates_per_call():
-    with pytest.raises(NotImplementedError, match="updates_per_call"):
-        PopulationTrainer(CFG.replace(updates_per_call=4), pop_size=2)
+def test_population_fused_updates_match_sequential():
+    """updates_per_call=K for a population (the shared fuse_updates
+    wrapper, VERDICT r2 Next #4): one fused call must advance every member
+    exactly like K sequential calls — same math, fewer dispatches."""
+    fused = PopulationTrainer(
+        CFG.replace(seed=3, updates_per_call=4), pop_size=2
+    )
+    m = fused.update()
+    # Metrics carry the fused [pop, K] axis pre-drain.
+    assert np.asarray(m["loss"]).shape == (2, 4)
+
+    seq = PopulationTrainer(CFG.replace(seed=3), pop_size=2)
+    for _ in range(4):
+        seq.update()
+    assert int(np.asarray(fused.state.update_step)[0]) == 4
+    for a, b in zip(
+        _params_of(fused.state.params), _params_of(seq.state.params)
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_population_fused_train_loop_window_reduces_k():
+    """The train loop's window leaves stay [pop] with K-fused calls, and
+    episode counts add over the fused axis."""
+    cfg = CFG.replace(
+        updates_per_call=2, log_every=2, total_env_steps=16 * 8 * 2 * 2
+    )
+    pop = PopulationTrainer(cfg, pop_size=2)
+    hist = pop.train()
+    assert hist[-1]["episode_count"].shape == (2,)
+    assert hist[-1]["loss"].shape == (2,)
+    assert np.all(hist[-1]["episode_count"] >= 1)
+    assert hist[-1]["env_steps"] == 16 * 8 * 2 * 2
+
+
+def test_population_eval_and_checkpoint_best(tmp_path):
+    """Per-member greedy eval ([pop] vector) and best-member retention
+    (VERDICT r2 Next #4): the best slot records the winning member's index
+    and score in its metadata."""
+    cfg = CFG.replace(
+        eval_every=2,
+        eval_episodes=4,
+        log_every=2,
+        total_env_steps=16 * 8 * 4,
+        checkpoint_dir=str(tmp_path / "pop"),
+        checkpoint_best=True,
+    )
+    pop = PopulationTrainer(cfg, pop_size=2)
+    ev = pop.evaluate(num_episodes=4, max_steps=200)
+    assert ev.shape == (2,)
+    hist = pop.train()
+    pop.close()
+    evals = [h["eval_return"] for h in hist if "eval_return" in h]
+    assert evals and evals[-1].shape == (2,)
+
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    with Checkpointer(str(tmp_path / "pop-best"), create=False) as best:
+        meta = best.read_meta()
+    assert "eval_return" in meta and "best_member" in meta
+    assert meta["best_member"] in (0, 1)
 
 
 def test_population_window_accumulates_episodes():
