@@ -33,6 +33,7 @@ from asyncrl_tpu.learn.learner import (
     init_params,
     make_optimizer,
     make_train_step,
+    validate_grad_accum_config,
     validate_qlearn_config,
     resolve_scan_impl,
     validate_ppo_geometry,
@@ -119,6 +120,7 @@ class PopulationTrainer:
             config, config.num_envs, "per-member",
             recurrent=is_recurrent(self.model),
         )
+        validate_grad_accum_config(config, config.num_envs)
         if learning_rates is None:
             self.optimizer = make_optimizer(config)
             self._member_lrs = None

@@ -638,6 +638,90 @@ def fuse_updates(body: Callable, updates_per_call: int) -> Callable:
     return multi_step
 
 
+def _chunk_envs(rollout, n: int):
+    """Reshape a fragment into ``n`` env-axis chunks with a leading scan
+    axis: time-major leaves [T, B, ...] -> [n, T, B/n, ...], batch-major
+    leaves (bootstrap_obs, init_core) [B, ...] -> [n, B/n, ...]. Chunks
+    are whole envs — time stays intact, so V-trace/GAE per-env scans are
+    untouched; only the batch mean is split (see grad_accum)."""
+
+    def tm(x):
+        return jnp.moveaxis(
+            x.reshape(x.shape[0], n, -1, *x.shape[2:]), 1, 0
+        )
+
+    def bm(x):
+        return x.reshape(n, -1, *x.shape[1:])
+
+    return rollout.replace(
+        obs=tm(rollout.obs),
+        actions=tm(rollout.actions),
+        behaviour_logp=tm(rollout.behaviour_logp),
+        rewards=tm(rollout.rewards),
+        terminated=tm(rollout.terminated),
+        truncated=tm(rollout.truncated),
+        bootstrap_obs=bm(rollout.bootstrap_obs),
+        init_core=jax.tree.map(bm, rollout.init_core),
+        disc_returns=jax.tree.map(tm, rollout.disc_returns),
+    )
+
+
+def validate_grad_accum_config(config: Config, envs_per_shard: int) -> None:
+    """grad_accum must split the per-shard env axis into equal whole
+    chunks (equality of chunk means is what makes the summed gradient
+    exact), and is refused for PPO entirely: multipass PPO has
+    ppo_minibatches as the same memory lever, and single-pass PPO
+    normalizes advantages over the batch — chunk-local moments would
+    silently change the gradient, breaking grad_accum's exactness
+    contract."""
+    if config.grad_accum <= 1:
+        return
+    if config.algo == "ppo":
+        raise ValueError(
+            "grad_accum > 1 is not supported for PPO: advantage"
+            " normalization computes batch moments, which chunking would"
+            " silently localize. Use ppo_minibatches — PPO's native"
+            " microbatching knob — instead."
+        )
+    if envs_per_shard % config.grad_accum != 0:
+        raise ValueError(
+            f"grad_accum={config.grad_accum} must divide the per-shard env"
+            f" count ({envs_per_shard}): unequal chunks would bias the"
+            " accumulated gradient."
+        )
+
+
+def accumulate_grads(scaled_loss, params, rollout, n_accum: int):
+    """Microbatched gradient: scan over env-axis chunks (``_chunk_envs``),
+    summing per-chunk grads of ``scaled_loss(params, chunk)``. Each chunk's
+    backward materializes only its own activations, so peak HBM drops
+    ~n_accum-fold; the summed gradient equals the full-batch one exactly
+    (equal chunks + the caller's 1/n_accum loss scaling). Losses/metrics
+    are per-env means, so the chunk mean recovers the batch mean. Chunk
+    count is identical on every shard, so per-chunk collectives (e.g.
+    time-sharded V-trace psums) stay in lockstep across the mesh.
+
+    Shared by the Anakin train step and the host-fragment RolloutLearner —
+    the two must never diverge. Returns ``(grads, loss, metrics)``."""
+
+    def accum_body(g_acc, frag):
+        (_, aux), g = jax.value_and_grad(scaled_loss, has_aux=True)(
+            params, frag
+        )
+        return jax.tree.map(jnp.add, g_acc, g), aux
+
+    grads, (loss_k, metrics_k) = jax.lax.scan(
+        accum_body,
+        jax.tree.map(jnp.zeros_like, params),
+        _chunk_envs(rollout, n_accum),
+    )
+    return (
+        grads,
+        jnp.mean(loss_k),
+        jax.tree.map(lambda m: jnp.mean(m, 0), metrics_k),
+    )
+
+
 def make_train_step(
     config: Config,
     env: Environment,
@@ -723,18 +807,26 @@ def make_train_step(
             # the global-batch-mean gradient, with no explicit pmean(grads)
             # (which would double-count: verified 8x inflation on the
             # 8-device CPU mesh, tests/test_learner).
-            def scaled_loss(p):
+            n_accum = max(config.grad_accum, 1)
+
+            def scaled_loss(p, frag):
                 loss, metrics = _algo_loss(
-                    config, napply, p, rollout,
+                    config, napply, p, frag,
                     axis_name=axes or None, dist=dist,
                     target_params=state.actor_params,
                 )
-                return loss / _axis_size(axes), (loss, metrics)
+                return loss / (_axis_size(axes) * n_accum), (loss, metrics)
 
-            with jax.named_scope("loss_and_grad"):
-                (_, (loss, metrics)), grads = jax.value_and_grad(
-                    scaled_loss, has_aux=True
-                )(state.params)
+            if n_accum == 1:
+                with jax.named_scope("loss_and_grad"):
+                    (_, (loss, metrics)), grads = jax.value_and_grad(
+                        scaled_loss, has_aux=True
+                    )(state.params, rollout)
+            else:
+                with jax.named_scope("loss_and_grad_accum"):
+                    grads, loss, metrics = accumulate_grads(
+                        scaled_loss, state.params, rollout, n_accum
+                    )
             with jax.named_scope("optimizer"):
                 grad_norm = optax.global_norm(grads)
                 updates, opt_state = optimizer.update(
@@ -844,6 +936,7 @@ class Learner:
             config, config.num_envs // dp, "per-device",
             recurrent=is_recurrent(model),
         )
+        validate_grad_accum_config(config, config.num_envs // dp)
 
         spec = state_partition_spec(dp_axes(mesh))
         body = make_train_step(config, env, model.apply, self.optimizer, mesh)

@@ -26,9 +26,11 @@ from asyncrl_tpu.envs.core import EnvSpec
 from asyncrl_tpu.learn.learner import (
     _algo_loss,
     _ppo_multipass,
+    accumulate_grads,
     make_optimizer,
     qlearn_bootstrap,
     resolve_scan_impl,
+    validate_grad_accum_config,
     validate_qlearn_config,
     validate_recurrent_config,
 )
@@ -45,7 +47,7 @@ from asyncrl_tpu.ops.normalize import (
     normalizing_apply,
     update_stats,
 )
-from asyncrl_tpu.parallel.mesh import TIME_AXIS, dp_axes
+from asyncrl_tpu.parallel.mesh import TIME_AXIS, dp_axes, dp_size
 from asyncrl_tpu.parallel.timeshard import (
     gae_timesharded,
     n_step_returns_timesharded,
@@ -234,6 +236,12 @@ class RolloutLearner:
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
         validate_qlearn_config(config)
+        # Host fragments arrive with the FULL env batch on the sharded-in
+        # time/batch layout; the per-shard env count the chunker sees is
+        # num_envs / (product of dp axes).
+        validate_grad_accum_config(
+            config, config.num_envs // max(dp_size(mesh), 1)
+        )
         if config.selfplay:
             raise NotImplementedError(
                 "selfplay is Anakin-only (backend='tpu'): host actor "
@@ -311,27 +319,34 @@ class RolloutLearner:
                 # replicated-param grads are psum'd across every sharded
                 # axis during transposition, so local loss is scaled by
                 # 1/axis_size of ALL of them.
-                def scaled_loss(p):
+                n_accum = max(config.grad_accum, 1)
+
+                def scaled_loss(p, frag):
                     if time_sharded:
                         loss, metrics = _algo_loss_timesharded(
-                            config, napply, p, rollout,
+                            config, napply, p, frag,
                             reduce_axes=reduce_axes, dist=dist,
                             target_params=state.target_params,
                         )
                     else:
                         loss, metrics = _algo_loss(
-                            config, napply, p, rollout,
+                            config, napply, p, frag,
                             axis_name=axes, dist=dist,
                             target_params=state.target_params,
                         )
                     return (
-                        loss / jax.lax.axis_size(reduce_axes),
+                        loss / (jax.lax.axis_size(reduce_axes) * n_accum),
                         (loss, metrics),
                     )
 
-                (_, (loss, metrics)), grads = jax.value_and_grad(
-                    scaled_loss, has_aux=True
-                )(state.params)
+                if n_accum == 1:
+                    (_, (loss, metrics)), grads = jax.value_and_grad(
+                        scaled_loss, has_aux=True
+                    )(state.params, rollout)
+                else:
+                    grads, loss, metrics = accumulate_grads(
+                        scaled_loss, state.params, rollout, n_accum
+                    )
                 grad_norm = optax.global_norm(grads)
                 updates, opt_state = optimizer.update(
                     grads, state.opt_state, state.params

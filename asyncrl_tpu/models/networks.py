@@ -64,19 +64,35 @@ class ResidualBlock(nn.Module):
 
 
 class ImpalaCNN(nn.Module):
-    """IMPALA deep ResNet torso (Espeholt et al. 2018 'large' network)."""
+    """IMPALA deep ResNet torso (Espeholt et al. 2018 'large' network).
+
+    ``remat=True`` rematerializes at RESIDUAL-BLOCK granularity
+    (``nn.remat``): the backward pass keeps only stage-boundary
+    activations live and recomputes each block's conv intermediates when
+    its gradient is needed — block granularity bounds simultaneous
+    liveness by one block's internals, where whole-torso remat would
+    still need every conv activation alive at once during the replayed
+    backward. Param tree is identical either way (lifted transform), so
+    checkpoints swap freely between the two."""
 
     channels: Sequence[int] = (16, 32, 32)
     compute_dtype: jnp.dtype = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         x = x.astype(self.compute_dtype)
-        for ch in self.channels:
+        # Explicit names pin the param paths to the non-remat auto-naming
+        # (nn.remat would otherwise prefix the class name with "Checkpoint",
+        # silently forking the checkpoint format).
+        block = nn.remat(ResidualBlock) if self.remat else ResidualBlock
+        for i, ch in enumerate(self.channels):
             x = nn.Conv(ch, (3, 3), dtype=self.compute_dtype)(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-            x = ResidualBlock(ch, self.compute_dtype)(x)
-            x = ResidualBlock(ch, self.compute_dtype)(x)
+            x = block(ch, self.compute_dtype, name=f"ResidualBlock_{2 * i}")(x)
+            x = block(
+                ch, self.compute_dtype, name=f"ResidualBlock_{2 * i + 1}"
+            )(x)
         x = nn.relu(x)
         x = x.reshape(*x.shape[:-3], -1)
         x = nn.relu(nn.Dense(256, dtype=self.compute_dtype, kernel_init=ORTHO(jnp.sqrt(2)))(x))
@@ -87,13 +103,22 @@ def _apply_torso(module: nn.Module, obs: jax.Array) -> jax.Array:
     """Shared torso dispatch for the (Recurrent)ActorCritic modules; reads
     the torso hyperparameters off ``module``."""
     if module.torso == "mlp":
-        return MLPTorso(
-            module.hidden_sizes, module.compute_dtype, module.obs_rank
+        # name= pins the remat param path to the auto name (see ImpalaCNN).
+        cls = nn.remat(MLPTorso) if module.remat else MLPTorso
+        return cls(
+            module.hidden_sizes, module.compute_dtype, module.obs_rank,
+            name="MLPTorso_0" if module.remat else None,
         )(obs)
     if module.torso == "nature_cnn":
-        return NatureCNN(module.compute_dtype)(obs)
+        cls = nn.remat(NatureCNN) if module.remat else NatureCNN
+        return cls(
+            module.compute_dtype,
+            name="NatureCNN_0" if module.remat else None,
+        )(obs)
     if module.torso == "impala_cnn":
-        return ImpalaCNN(module.channels, module.compute_dtype)(obs)
+        return ImpalaCNN(
+            module.channels, module.compute_dtype, remat=module.remat
+        )(obs)
     raise ValueError(f"unknown torso {module.torso!r}")
 
 
@@ -136,6 +161,7 @@ class ActorCritic(nn.Module):
     obs_rank: int = 1  # rank of one observation (e.g. 3 for H,W,C images)
     continuous: bool = False
     action_dim: int = 0
+    remat: bool = False
 
     @nn.compact
     def __call__(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -187,6 +213,7 @@ class QNetwork(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     obs_rank: int = 1
     dueling: bool = False
+    remat: bool = False
 
     @nn.compact
     def __call__(self, obs: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -215,6 +242,7 @@ class RecurrentActorCritic(nn.Module):
     obs_rank: int = 1
     continuous: bool = False
     action_dim: int = 0
+    remat: bool = False
 
     @nn.compact
     def __call__(self, obs, core):
@@ -250,6 +278,7 @@ class RecurrentQNetwork(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     obs_rank: int = 1
     dueling: bool = False
+    remat: bool = False
 
     @nn.compact
     def __call__(self, obs, core):
@@ -295,6 +324,7 @@ def build_model(config, env_spec):
             compute_dtype=compute_dtype,
             obs_rank=len(env_spec.obs_shape),
             dueling=config.dueling,
+            remat=config.remat,
         )
         if config.core == "lstm":
             return RecurrentQNetwork(core_size=config.core_size, **q_common)
@@ -310,6 +340,7 @@ def build_model(config, env_spec):
         obs_rank=len(env_spec.obs_shape),
         continuous=env_spec.continuous,
         action_dim=env_spec.action_dim,
+        remat=config.remat,
     )
     if config.core == "lstm":
         return RecurrentActorCritic(core_size=config.core_size, **common)

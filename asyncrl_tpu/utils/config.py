@@ -191,6 +191,23 @@ class Config:
     # resume via the checkpoint metadata).
     checkpoint_best: bool = False
     precision: str = "bf16_matmul"  # "f32" | "bf16_matmul"
+    # Gradient accumulation (microbatching): split each fragment's env axis
+    # into this many sequential chunks inside the jitted step (lax.scan),
+    # summing chunk gradients before the ONE optimizer update. Numerically
+    # the full-batch gradient (equal chunks; pinned by tests/test_learner),
+    # but peak activation memory drops ~grad_accum-fold — THE lever that
+    # fits the reference's 1024-envs/chip pixel workload (BASELINE.json:9)
+    # into a 16G v5e HBM, where the fused backward otherwise allocates 21G+
+    # (measured OOM, BENCH notes r3). Applies to the single-pass learner
+    # (impala/a3c/qlearn/1-epoch PPO); multipass PPO already bounds memory
+    # via ppo_minibatches — combining the two is refused loudly.
+    grad_accum: int = 1
+    # Rematerialize the torso in the backward pass (jax.checkpoint /
+    # nn.remat at torso-stage granularity): store only stage boundaries
+    # forward, recompute conv intermediates when the gradient needs them.
+    # Composes with grad_accum; worth it on CNN torsos where stage
+    # intermediates dominate HBM, a no-op-ish trade on MLPs.
+    remat: bool = False
     # V-trace/GAE reverse-scan implementation (ops/scan.py). "auto"
     # currently resolves to "associative" everywhere (see
     # learn.learner.resolve_scan_impl — the Pallas VMEM kernel stays opt-in

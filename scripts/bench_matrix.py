@@ -21,7 +21,7 @@ import sys
 # guard) lives in bench.py at the repo root — ONE copy for both entry points.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import (  # noqa: E402
-    _accelerator_alive_with_retry,
+    cpu_fallback_or_refuse,
     timed_update_window,
 )
 
@@ -223,15 +223,7 @@ def bench_one(preset_name: str, overrides: list[str]) -> dict:
 def main() -> int:
     import jax
 
-    if not _accelerator_alive_with_retry():
-        # Same guard as bench.py: a hung axon tunnel would otherwise block
-        # the first device query forever.
-        jax.config.update("jax_platforms", "cpu")
-        print(
-            "bench_matrix: accelerator backend hung/unavailable; falling "
-            "back to CPU (device field carries the kind)",
-            file=sys.stderr,
-        )
+    cpu_fallback_or_refuse(jax, "bench_matrix")
     args = sys.argv[1:]
     overrides = [a for a in args if "=" in a]
     names = [a for a in args if "=" not in a] or DEFAULT_PRESETS

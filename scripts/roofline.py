@@ -26,7 +26,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from bench import _accelerator_alive_with_retry, timed_update_window  # noqa: E402
+from bench import cpu_fallback_or_refuse, timed_update_window  # noqa: E402
 
 # Dense peak FLOP/s by device kind prefix (bf16 for TPUs). Sources: public
 # cloud TPU spec sheets; extend as kinds appear.
@@ -109,10 +109,7 @@ def main() -> int:
     names = [a for a in args if "=" not in a]
     preset_name = names[0] if names else "atari_impala"
 
-    if not _accelerator_alive_with_retry():
-        jax.config.update("jax_platforms", "cpu")
-        print("roofline: accelerator unavailable; CPU numbers (mfu n/a)",
-              file=sys.stderr)
+    cpu_fallback_or_refuse(jax, "roofline")
 
     from asyncrl_tpu.configs import presets
     from asyncrl_tpu.utils import bench_history

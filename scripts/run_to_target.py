@@ -22,7 +22,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from bench import _accelerator_alive_with_retry  # noqa: E402
+from bench import cpu_fallback_or_refuse  # noqa: E402
 
 
 class _TargetReached(Exception):
@@ -54,14 +54,11 @@ def main() -> int:
         else:
             preset_name = a
 
-    if not _accelerator_alive_with_retry():
-        jax.config.update("jax_platforms", "cpu")
-        print(
-            "run_to_target: accelerator unavailable; running on CPU "
-            "(record will carry platform=cpu and never count as "
-            "last-known-good)",
-            file=sys.stderr,
-        )
+    # CPU fallback is VALID evidence here (entry carries platform=cpu and
+    # never counts as last-known-good) — but the TPU-window queue sets
+    # BENCH_REQUIRE_ACCELERATOR so a flap aborts rather than polluting a
+    # TPU checkpoint_dir's accumulated clock with slow CPU sessions.
+    cpu_fallback_or_refuse(jax, "run_to_target")
 
     from asyncrl_tpu.api.factory import make_agent
     from asyncrl_tpu.configs import presets

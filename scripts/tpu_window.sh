@@ -1,0 +1,118 @@
+#!/bin/bash
+# TPU window watcher (VERDICT round 2, Next #2): the axon tunnel flaps —
+# minutes-long UP windows between outages. This loop probes liveness and,
+# on each UP window, burns down a prioritized queue of real-TPU evidence
+# jobs. One-shot jobs stamp a .done file on success and never re-run; the
+# time-to-target training job is resumable (checkpointed + elapsed sidecar)
+# and re-fires every window until its ledger entry says reached.
+#
+#   nohup bash scripts/tpu_window.sh > /tmp/tpu_window.log 2>&1 &
+#
+# Every job runs with BENCH_NO_WAIT=1 (the watcher already established
+# liveness; a mid-job flap should fail fast and surrender the window) and
+# under `timeout` with process-group kill (the axon plugin hangs, not
+# errors, when the tunnel dies under it — see bench._accelerator_alive).
+set -u
+cd "$(dirname "$0")/.."
+STAMPS=/tmp/tpu_window_stamps
+mkdir -p "$STAMPS"
+export BENCH_NO_WAIT=1
+# A flap between our probe and a job's own probe must FAIL the job (retry
+# next window), not silently bank a CPU row as real-chip evidence.
+export BENCH_REQUIRE_ACCELERATOR=1
+
+probe() {
+  timeout -k 5 90 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
+    >/dev/null 2>&1
+}
+
+# run_job <stamp-name> <timeout-s> <cmd...>: one-shot; stamps on rc=0.
+run_job() {
+  local stamp="$1" tmo="$2"; shift 2
+  [ -e "$STAMPS/$stamp" ] && return 0
+  echo "=== $(date -u +%FT%TZ) [$stamp] $*"
+  timeout -k 10 "$tmo" "$@"
+  local rc=$?
+  echo "=== rc=$rc [$stamp]"
+  if [ "$rc" -eq 0 ]; then touch "$STAMPS/$stamp"; else return 1; fi
+}
+
+commit_ledger() {
+  if [ -n "$(git status --porcelain BENCH_HISTORY.json)" ]; then
+    git add BENCH_HISTORY.json
+    git -c core.editor=true commit -q -m "Record real-TPU benchmark evidence in BENCH_HISTORY
+
+Automated ledger update from scripts/tpu_window.sh on a live
+accelerator window; see the entries' device_kind/ts fields.
+
+No-Verification-Needed: benchmark-artifact-only commit" \
+      -- BENCH_HISTORY.json runs/ 2>/dev/null \
+      && echo "=== ledger committed"
+  fi
+}
+
+target_reached() {
+  python - <<'EOF'
+import json, sys
+try:
+    entries = json.load(open("BENCH_HISTORY.json"))
+except Exception:
+    sys.exit(1)
+ok = any(
+    e.get("kind") == "time_to_target" and e.get("reached")
+    and e.get("platform") not in ("cpu",)
+    for e in entries
+)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+while true; do
+  if ! probe; then
+    echo "--- $(date -u +%FT%TZ) tunnel DOWN; sleeping 60s"
+    sleep 60
+    continue
+  fi
+  echo "--- $(date -u +%FT%TZ) tunnel UP; draining queue"
+
+  # Short one-shot evidence rows first: a window that dies early still
+  # banked something. Order = (value x brevity) descending.
+  run_job pixel_bench 420 python bench.py atari_impala updates_per_call=8 num_envs=256 || continue
+  commit_ledger
+  run_job roofline_pong 420 python scripts/roofline.py pong_impala updates_per_call=32 || continue
+  run_job roofline_atari 480 python scripts/roofline.py atari_impala updates_per_call=8 num_envs=256 || continue
+  # Pallas kernel gate: first-ever real-chip run of the VMEM reverse-scan
+  # (scan_impl note in utils/config.py — promotion blocked on this).
+  run_job pallas_validate 420 python scripts/validate_pallas_tpu.py || continue
+  # The reference's FULL 1024-envs/chip pixel geometry (BASELINE.json:9):
+  # OOMs at 21.3G without microbatching; grad_accum=4 + block remat fits
+  # it into the v5e's 15.75G (the r3 grad_accum/remat feature).
+  run_job pixel_bench_1024 480 python bench.py atari_impala updates_per_call=8 grad_accum=4 remat=true || continue
+  commit_ledger
+
+  # North star: wall-clock to 18.0 on the real chip (BASELINE.json:2).
+  # Resumable across windows; stops re-firing once a non-CPU reached=true
+  # entry lands. step_cost per scripts/pong_diagnose.py's offense finding.
+  if ! target_reached; then
+    echo "=== $(date -u +%FT%TZ) [t2t] run_to_target session"
+    timeout -k 10 900 python scripts/run_to_target.py pong_impala \
+      --target 18.0 --budget-seconds 7200 \
+      step_cost=0.005 checkpoint_dir=runs/pong18_tpu checkpoint_every=50 \
+      eval_every=40 eval_episodes=32 updates_per_call=32 \
+      total_env_steps=20000000000
+    echo "=== rc=$? [t2t]"
+    commit_ledger
+    target_reached && touch "$STAMPS/t2t"
+  fi
+
+  # Host-path rows last (long; lowest marginal value — CPU rows exist).
+  run_job bench_matrix 900 python scripts/bench_matrix.py || continue
+  commit_ledger
+
+  if [ -e "$STAMPS/pixel_bench" ] && [ -e "$STAMPS/roofline_pong" ] \
+     && [ -e "$STAMPS/roofline_atari" ] && [ -e "$STAMPS/t2t" ] \
+     && [ -e "$STAMPS/bench_matrix" ]; then
+    echo "--- $(date -u +%FT%TZ) queue complete"
+    break
+  fi
+done

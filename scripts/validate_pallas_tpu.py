@@ -1,0 +1,103 @@
+"""Real-chip validation + microbench for the Pallas reverse-scan kernel.
+
+``Config.scan_impl='auto'`` resolves to ``associative`` everywhere because
+the Pallas VMEM kernel had never run on actual TPU hardware (utils/config.py
+scan_impl note). This script is the validation gate: on a live chip it
+checks ``reverse_linear_scan_pallas`` against the ``lax.associative_scan``
+reference across the fragment geometries the presets use, times both, and
+appends a ``kind="kernel_validation"`` entry to BENCH_HISTORY.json.
+
+    python scripts/validate_pallas_tpu.py
+
+Exit 0 = every geometry matched (the kernel is safe to promote); exit 1 =
+mismatch (keep the associative default, entry records which geometry).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import functools
+
+from asyncrl_tpu.ops.scan import reverse_linear_scan
+from asyncrl_tpu.utils import bench_history
+
+# (T, B): preset fragment shapes (unroll_len x num_envs) plus a long-horizon
+# sequence-parallel shape (SURVEY.md §5.7) and a ragged-tile edge case.
+GEOMETRIES = [(32, 256), (32, 1024), (16, 64), (128, 4096), (20, 96)]
+
+
+def timed(fn, *args, reps=20):
+    jax.block_until_ready(fn(*args))  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> int:
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        print("validate_pallas_tpu: no accelerator; refusing (the whole "
+              "point is real-chip behaviour)", file=sys.stderr)
+        return 2
+
+    rng = np.random.default_rng(0)
+    results = []
+    ok = True
+    for T, B in GEOMETRIES:
+        a = jnp.asarray(rng.uniform(0.8, 1.0, (T, B)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+        ref_fn = jax.jit(
+            functools.partial(reverse_linear_scan, impl="associative")
+        )
+        pal_fn = jax.jit(
+            functools.partial(reverse_linear_scan, impl="pallas")
+        )
+        ref = jax.device_get(ref_fn(a, b))
+        try:
+            out = jax.device_get(pal_fn(a, b))
+        except Exception as e:  # noqa: BLE001 — record, don't crash the sweep
+            results.append({"T": T, "B": B, "error": str(e)[:300]})
+            ok = False
+            continue
+        # The kernel's sequential walk is MORE accurate than the
+        # associative tree (no re-association); tolerance covers the
+        # tree's f32 rounding across log2(T) rounds.
+        err = float(np.max(np.abs(out - ref) / (np.abs(ref) + 1e-6)))
+        match = bool(err < 1e-4)
+        ok = ok and match
+        t_ref = timed(ref_fn, a, b)
+        t_pal = timed(pal_fn, a, b)
+        results.append({
+            "T": T, "B": B, "max_rel_err": err, "match": match,
+            "associative_us": round(t_ref * 1e6, 1),
+            "pallas_us": round(t_pal * 1e6, 1),
+            "speedup": round(t_ref / t_pal, 2),
+        })
+        print(json.dumps(results[-1]))
+
+    entry = {
+        "kind": "kernel_validation",
+        "kernel": "reverse_linear_scan_pallas",
+        **bench_history.device_entry(),
+        "ok": ok,
+        "geometries": results,
+    }
+    bench_history.record(entry)
+    print(json.dumps({"ok": ok, "n": len(results)}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
