@@ -115,8 +115,10 @@ class Checkpointer:
 
     def set_extra_meta(self, **kv) -> None:
         """Additional JSON-able metadata carried by subsequent saves (e.g.
-        the best-eval score for the best-checkpoint policy)."""
-        self._extra_meta = dict(kv)
+        the best-eval score for the best-checkpoint policy). MERGES with
+        previous calls — the config snapshot (checkpoint.setup) and a
+        caller's per-save keys must coexist."""
+        self._extra_meta.update(kv)
 
     def read_meta(self, step: int | None = None) -> dict:
         """The metadata dict of ``step`` (latest by default) without
@@ -314,6 +316,72 @@ class TrainerCheckpointing:
             self.checkpointer.close()
 
 
+# Config fields whose change alters the TrainState PYTREE STRUCTURE (model
+# param tree, optimizer chain state, actor/env-state shapes, normalization
+# slots). Resuming across a change to any of these fails deep inside orbax
+# with an opaque structure diff (observed: "EmptyState vs dict" for a
+# lr_schedule flip) — the compat check below turns that into a named,
+# actionable refusal BEFORE the restore attempt.
+_STRUCTURAL_FIELDS = (
+    "algo", "optimizer", "lr_schedule", "torso", "hidden_sizes", "channels",
+    "core", "core_size", "dueling", "num_envs", "normalize_obs",
+    "normalize_returns", "selfplay", "backend", "env_id",
+)
+
+
+def _config_snapshot(config) -> dict:
+    """JSON-able snapshot of the full Config, saved in every checkpoint's
+    metadata so a resume can explain exactly how it differs from the run
+    that wrote the checkpoint (tuples become lists; that is fine for the
+    equality checks, which normalize)."""
+    import dataclasses
+
+    return dataclasses.asdict(config)
+
+
+def _check_config_compat(saved: dict | None, config) -> None:
+    """Compare a checkpoint's saved config against the resuming one.
+
+    Structural mismatches raise with the field names; any other drift
+    (hyperparameters: lr, entropy, step_cost, ...) is legitimate — resuming
+    with adjusted hyperparameters is a supported workflow — but is printed
+    so the operator knows the run is no longer homogeneous."""
+
+    def norm(v):
+        return list(v) if isinstance(v, tuple) else v
+
+    if not saved:
+        return  # pre-snapshot checkpoint: nothing to check against
+    current = _config_snapshot(config)
+    broken = [
+        f for f in _STRUCTURAL_FIELDS
+        if f in saved and norm(saved[f]) != norm(current.get(f))
+    ]
+    if broken:
+        detail = ", ".join(
+            f"{f}: checkpoint={saved[f]!r} vs current={current.get(f)!r}"
+            for f in broken
+        )
+        raise ValueError(
+            "checkpoint was written by a run whose config differs in "
+            f"state-structure-affecting fields — {detail}. Resume with a "
+            "matching config, or start a fresh checkpoint_dir."
+        )
+    drifted = sorted(
+        f for f in saved
+        if f not in _STRUCTURAL_FIELDS
+        and norm(saved[f]) != norm(current.get(f))
+    )
+    if drifted:
+        print(
+            "asyncrl_tpu: resuming with changed hyperparameters: "
+            + ", ".join(
+                f"{f} {saved[f]!r}->{current.get(f)!r}" for f in drifted
+            ),
+            file=sys.stderr,
+        )
+
+
 def setup(config, restore: str | None, state):
     """Shared trainer-side checkpoint wiring.
 
@@ -340,13 +408,18 @@ def setup(config, restore: str | None, state):
         with Checkpointer(restore, create=False) as src:
             if src.latest_step() is None:
                 raise FileNotFoundError(f"no checkpoint under {restore!r}")
+            _check_config_compat(src.read_meta().get("config"), config)
             state, env_steps = src.restore(state)
 
     if not config.checkpoint_dir:
         return TrainerCheckpointing(None, 0), state, env_steps
 
     ckpt = Checkpointer(config.checkpoint_dir)
+    # Every save from this run carries the full config snapshot, so the
+    # NEXT resume can diff configs by name instead of failing structurally.
+    ckpt.set_extra_meta(config=_config_snapshot(config))
     if restore is None and ckpt.latest_step() is not None:
+        _check_config_compat(ckpt.read_meta().get("config"), config)
         state, env_steps = ckpt.restore(state)
     elif restore is not None and ckpt.latest_step() is not None:
         # Explicit restore into a dir that already has history: refuse if

@@ -428,3 +428,27 @@ def test_checkpoint_best_lower_step_after_resume_wins(tmp_path):
     with Checkpointer(best_dir, create=False) as best:
         assert best.all_steps() == [3]
         assert best.read_meta()["eval_return"] == 7.0
+
+
+def test_config_snapshot_guards_structural_resume(tmp_path, capsys):
+    """Checkpoints carry a full config snapshot; resuming across a
+    STRUCTURE-affecting config change (e.g. an lr_schedule flip, whose
+    optimizer-state mismatch orbax reports as an opaque tree diff) must
+    refuse BY FIELD NAME, while pure hyperparameter drift resumes with a
+    printed notice (that workflow — tune-and-continue — is supported)."""
+    ck_dir = str(tmp_path / "snap")
+    cfg = small_cfg(checkpoint_dir=ck_dir)
+    t = Trainer(cfg)
+    t.train(total_env_steps=2 * cfg.batch_steps_per_update)
+    t.close()
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="lr_schedule"):
+        Trainer(cfg.replace(lr_schedule="linear"))
+
+    # Hyperparameter drift: allowed, but announced on stderr.
+    t2 = Trainer(cfg.replace(learning_rate=cfg.learning_rate * 0.5))
+    assert int(t2.state.update_step) == 2
+    t2.close()
+    assert "learning_rate" in capsys.readouterr().err
