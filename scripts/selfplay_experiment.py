@@ -29,7 +29,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")  # paired CPU runs; axon hangs when down
+from bench import cpu_fallback_or_refuse  # noqa: E402
+
+# Paired runs on whatever is alive: the real chip when the tunnel is up
+# (matched-budget arms are cheap there), CPU otherwise — the comparison is
+# within-platform either way, so both arms always share one device kind.
+cpu_fallback_or_refuse(jax, "selfplay_experiment")
 
 from asyncrl_tpu.api.trainer import Trainer
 from asyncrl_tpu.configs import presets

@@ -108,10 +108,16 @@ while true; do
   # Host-path rows last (long; lowest marginal value — CPU rows exist).
   run_job bench_matrix 900 python scripts/bench_matrix.py || continue
   commit_ledger
+  # Self-play payoff head-to-head (VERDICT r2 Next #5): matched-budget
+  # direct-vs-ladder arms, scored on the tracker metric. 400M frames/arm
+  # is minutes on the chip.
+  run_job selfplay_exp 900 python scripts/selfplay_experiment.py 400000000 updates_per_call=32 step_cost=0.005 || continue
+  commit_ledger
 
   if [ -e "$STAMPS/pixel_bench" ] && [ -e "$STAMPS/roofline_pong" ] \
      && [ -e "$STAMPS/roofline_atari" ] && [ -e "$STAMPS/t2t" ] \
-     && [ -e "$STAMPS/bench_matrix" ]; then
+     && [ -e "$STAMPS/pallas_validate" ] && [ -e "$STAMPS/pixel_bench_1024" ] \
+     && [ -e "$STAMPS/bench_matrix" ] && [ -e "$STAMPS/selfplay_exp" ]; then
     echo "--- $(date -u +%FT%TZ) queue complete"
     break
   fi
