@@ -241,11 +241,6 @@ def validate_selfplay_config(config: Config, env, model) -> None:
             f"selfplay needs a duel env (step_duel + observe_opponent); "
             f"{config.env_id!r} is not one — use JaxPongDuel-v0"
         )
-    if is_recurrent(model):
-        raise NotImplementedError(
-            "selfplay with recurrent cores is not wired (the frozen rival "
-            "would need its own carry); use core='ff'"
-        )
 
 
 def validate_recurrent_config(config: Config, model) -> None:
@@ -873,6 +868,16 @@ def make_train_step(
                 lambda new, old: jnp.where(promote, new, old),
                 params, state.opponent_params,
             )
+            if actor.opp_core is not None:
+                # The rival's recurrent carry belongs to the OLD snapshot;
+                # on promotion zero it (mid-episode amnesia beats feeding
+                # the new params a foreign hidden state).
+                keep = 1.0 - promote.astype(jnp.float32)
+                actor = actor.replace(
+                    opp_core=jax.tree.map(
+                        lambda c: c * keep, actor.opp_core
+                    )
+                )
         else:
             opponent_params = state.opponent_params  # None subtree
 
@@ -972,6 +977,7 @@ class Learner:
             return actor_init(
                 self.env, local_envs, keys[0], model=self.model,
                 track_returns=cfg.normalize_returns,
+                selfplay=cfg.selfplay,
             )
 
         per_device_keys = jax.random.split(akey, dp)

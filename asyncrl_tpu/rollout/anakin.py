@@ -45,6 +45,13 @@ class ActorState:
     # before the field existed, even with the feature off.
     disc_return: Any = None  # [B] f32 when tracking
     core: Any = None  # recurrent policy carry, leading dim B
+    # Frozen-rival recurrent carry (selfplay x lstm): the opponent snapshot
+    # plays through its OWN (c, h), reset at episode ends like the agent's
+    # and zeroed on ladder promotion (the old carry means nothing to the
+    # newly frozen params). None unless both selfplay and recurrent — the
+    # empty-subtree trick keeps old checkpoints restorable, like
+    # disc_return above.
+    opp_core: Any = None
 
 
 def actor_init(
@@ -53,6 +60,7 @@ def actor_init(
     seed_key: jax.Array,
     model=None,
     track_returns: bool = False,
+    selfplay: bool = False,
 ) -> ActorState:
     init_keys, carry_keys = jax.random.split(seed_key)
     env_keys = jax.random.split(init_keys, num_envs)
@@ -72,6 +80,7 @@ def actor_init(
         running_length=zeros,
         disc_return=zeros if track_returns else None,
         core=core,
+        opp_core=core if selfplay and core is not None else None,
     )
 
 
@@ -148,7 +157,13 @@ def unroll(
 
         if selfplay:
             opp_obs = jax.vmap(env.observe_opponent)(carry.env_state)
-            opp_dist_params, _ = apply_fn(opponent_params, opp_obs)
+            if carry.opp_core is not None:
+                opp_dist_params, _, opp_core = apply_fn(
+                    opponent_params, opp_obs, carry.opp_core
+                )
+            else:
+                opp_dist_params, _ = apply_fn(opponent_params, opp_obs)
+                opp_core = None
             if dist_extra is not None:
                 # The rival samples under the SAME behaviour knobs as the
                 # agent (e.g. the Q-family's annealed ε) — without this, an
@@ -169,9 +184,12 @@ def unroll(
             env_state, ts = jax.vmap(env.step)(
                 carry.env_state, actions, step_keys
             )
+            opp_core = None
 
         if recurrent:
             core = reset_core(core, ts.done)
+            if opp_core is not None:
+                opp_core = reset_core(opp_core, ts.done)
 
         done_f = ts.done.astype(jnp.float32)
         ep_return = carry.running_return + ts.reward
@@ -197,6 +215,7 @@ def unroll(
             running_length=ep_length * (1.0 - done_f),
             disc_return=g * (1.0 - done_f) if track_returns else None,
             core=core,
+            opp_core=opp_core,
         )
         out = (
             carry.obs,

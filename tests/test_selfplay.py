@@ -127,8 +127,6 @@ def test_selfplay_guards():
                 num_envs=16,
             )
         )
-    with pytest.raises(NotImplementedError, match="recurrent"):
-        Trainer(small_cfg(core="lstm", core_size=8))
     with pytest.raises(NotImplementedError, match="population"):
         from asyncrl_tpu.api.population import PopulationTrainer
 
@@ -185,3 +183,28 @@ def test_selfplay_qlearn_opponent_shares_epsilon():
     )
     s1, m1 = t.learner.update(t.state)
     assert np.isfinite(float(jax.device_get(m1)["loss"]))
+
+
+def test_selfplay_recurrent_rival_carries_and_resets():
+    """selfplay x lstm: the frozen rival plays through its own (c, h).
+    The carry must (a) exist and move during rollouts, (b) zero exactly at
+    ladder promotion (the new snapshot must not inherit the old rival's
+    hidden state)."""
+    t = Trainer(small_cfg(core="lstm", core_size=8, selfplay_refresh=2))
+    s0 = t.state
+    assert s0.actor.opp_core is not None
+
+    s1, _ = t.learner.update(s0)
+    # Step 1 (no promotion): the rival's carry has accumulated state.
+    assert any(
+        float(np.abs(np.asarray(c)).sum()) > 0.0
+        for c in jax.tree.leaves(jax.device_get(s1.actor.opp_core))
+    )
+    s2, _ = t.learner.update(s1)
+    # Step 2 (promotion): carry zeroed for the newly frozen snapshot.
+    for c in jax.tree.leaves(jax.device_get(s2.actor.opp_core)):
+        np.testing.assert_array_equal(np.asarray(c), np.zeros_like(c))
+    # Feed-forward runs carry no opp_core (empty subtree: old checkpoints
+    # restore unchanged).
+    t_ff = Trainer(small_cfg())
+    assert t_ff.state.actor.opp_core is None
