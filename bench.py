@@ -159,7 +159,16 @@ def cpu_fallback_or_refuse(jax, tool: str = "bench") -> bool:
     fallback reads as job FAILURE, not evidence: the tunnel flapped between
     their liveness probe and this run, and stamping a CPU row as the
     real-chip measurement would end the retry loop with the wrong row.
-    Shared by bench.py, scripts/roofline.py, scripts/bench_matrix.py."""
+    Shared by bench.py, scripts/roofline.py, scripts/bench_matrix.py.
+
+    ASYNCRL_FORCE_CPU=1 skips the probe and goes straight to CPU: the
+    long-running CPU baseline arm must keep its platform provenance pure
+    (and stay off the chip) even when the tunnel happens to be up."""
+    if os.environ.get("ASYNCRL_FORCE_CPU", "") not in ("", "0"):
+        jax.config.update("jax_platforms", "cpu")
+        print(f"{tool}: ASYNCRL_FORCE_CPU set; running on CPU",
+              file=sys.stderr)
+        return True
     if _accelerator_alive_with_retry():
         return False
     if os.environ.get("BENCH_REQUIRE_ACCELERATOR", "") not in ("", "0"):

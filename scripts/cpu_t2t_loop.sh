@@ -1,0 +1,31 @@
+#!/bin/bash
+# CPU arm of the 18.0-Pong time-to-target hunt: supervised, resumable
+# sessions pinned to the CPU backend (ASYNCRL_FORCE_CPU — never steals a
+# TPU window from scripts/tpu_window.sh; provenance stays platform=cpu).
+# Sessions checkpoint + accumulate wall clock; the loop exits when the
+# run records ANY time_to_target completion for this dir's preset (the
+# in-run budget decides reached true/false) or MAX_SESSIONS spend out.
+#
+#   nohup bash scripts/cpu_t2t_loop.sh [checkpoint_dir] [extra overrides...] &
+set -u
+cd "$(dirname "$0")/.."
+DIR=${1:-runs/pong18_cpu_sc}
+shift || true
+export ASYNCRL_FORCE_CPU=1
+export BENCH_NO_WAIT=1
+
+for i in $(seq 1 "${MAX_SESSIONS:-12}"); do
+  echo "=== $(date -u +%FT%TZ) cpu t2t session $i ($DIR)"
+  timeout -k 10 "${SESSION_SECONDS:-3600}" \
+    python scripts/run_to_target.py pong_impala \
+      --target 18.0 --budget-seconds "${BUDGET_SECONDS:-14400}" \
+      step_cost=0.005 checkpoint_dir="$DIR" checkpoint_every=50 \
+      eval_every=40 updates_per_call=8 total_env_steps=2000000000 "$@"
+  rc=$?
+  echo "=== rc=$rc session $i"
+  # rc 0 = the run recorded its ledger entry (reached or budget-exhausted):
+  # the measurement is COMPLETE either way — resuming a completed one is
+  # refused by run_to_target, so stop.
+  [ "$rc" -eq 0 ] && break
+  sleep 5
+done
