@@ -84,6 +84,11 @@ while true; do
   # Pallas kernel gate: first-ever real-chip run of the VMEM reverse-scan
   # (scan_impl note in utils/config.py — promotion blocked on this).
   run_job pallas_validate 420 python scripts/validate_pallas_tpu.py || continue
+  # Dispatch-amortization sweep: is 32 fused updates/call still the sweet
+  # spot, or does deeper fusion raise the headline? (Ledger rows carry the
+  # K in their label; compare offline, then retune bench.py's default.)
+  run_job upc64 300 python bench.py pong_impala updates_per_call=64 || continue
+  run_job upc128 300 python bench.py pong_impala updates_per_call=128 || continue
   # The reference's FULL 1024-envs/chip pixel geometry (BASELINE.json:9):
   # OOMs at 21.3G without microbatching; grad_accum=4 + block remat fits
   # it into the v5e's 15.75G (the r3 grad_accum/remat feature).
