@@ -158,6 +158,14 @@ class Checkpointer:
         ``state_like`` is a live (freshly initialized) TrainState used as the
         shape/dtype/sharding template — the restored pytree matches its
         structure and device placement exactly.
+
+        Forward-compat: a checkpoint written before an optional
+        (None-default) field was ADDED to a state dataclass has a different
+        saved treedef, which the strict restore rejects even though every
+        live leaf matches (the None field contributes no leaves). The
+        fallback restores the raw on-disk tree and grafts its leaves into
+        the template BY PATH — new None fields simply aren't looked up, and
+        a genuinely missing leaf still fails loudly with its path name.
         """
         if step is None:
             step = self.latest_step()
@@ -165,20 +173,87 @@ class Checkpointer:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}"
             )
-        restored = self._mngr.restore(
-            int(step),
-            args=ocp.args.Composite(
-                **{
-                    STATE_KEY: ocp.args.StandardRestore(
-                        _abstract_like(state_like)
-                    ),
-                    META_KEY: ocp.args.JsonRestore(),
-                }
-            ),
-        )
+        try:
+            restored = self._mngr.restore(
+                int(step),
+                args=ocp.args.Composite(
+                    **{
+                        STATE_KEY: ocp.args.StandardRestore(
+                            _abstract_like(state_like)
+                        ),
+                        META_KEY: ocp.args.JsonRestore(),
+                    }
+                ),
+            )
+            state = restored[STATE_KEY]
+        except ValueError as strict_err:
+            if "tree structures do not match" not in str(strict_err):
+                raise
+            state = self._restore_by_path(state_like, int(step), strict_err)
+            restored = self._mngr.restore(
+                int(step),
+                args=ocp.args.Composite(
+                    **{META_KEY: ocp.args.JsonRestore()}
+                ),
+            )
         meta = restored[META_KEY] or {}
         self._restored_step = int(step)
-        return restored[STATE_KEY], int(meta.get("env_steps", 0))
+        return state, int(meta.get("env_steps", 0))
+
+    def _restore_by_path(self, state_like: Any, step: int, strict_err):
+        """The grafting fallback: raw (template-free) restore, then match
+        template leaves to disk leaves by key path."""
+        import jax.tree_util as jtu
+
+        raw = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(**{STATE_KEY: ocp.args.StandardRestore()}),
+        )[STATE_KEY]
+
+        def lookup(node, path):
+            for k in path:
+                if isinstance(k, jtu.GetAttrKey):
+                    k = k.name
+                elif isinstance(k, (jtu.DictKey,)):
+                    k = k.key
+                elif isinstance(k, (jtu.SequenceKey,)):
+                    k = k.idx
+                if isinstance(node, dict):
+                    if str(k) not in node and k not in node:
+                        return None
+                    node = node.get(k, node.get(str(k)))
+                elif isinstance(node, (list, tuple)):
+                    idx = int(k)
+                    if idx >= len(node):
+                        return None
+                    node = node[idx]
+                else:
+                    node = getattr(node, str(k), None)
+                if node is None:
+                    return None
+            return node
+
+        def graft(path, tmpl_leaf):
+            disk = lookup(raw, path)
+            if disk is None:
+                raise ValueError(
+                    f"checkpoint step {step} is missing leaf "
+                    f"{jtu.keystr(path)} required by the current state "
+                    "structure (not an optional-field addition); original "
+                    f"strict-restore error: {strict_err}"
+                ) from strict_err
+            x = jnp_asarray_like(disk, tmpl_leaf)
+            return x
+
+        state = jtu.tree_map_with_path(graft, state_like)
+        print(
+            f"asyncrl_tpu: checkpoint step {step} predates "
+            "newer optional state fields; restored by path graft "
+            "(new fields keep their init values)",
+            file=sys.stderr,
+        )
+        return state
+
 
     # ------------------------------------------------------------- lifecycle
 
@@ -195,6 +270,16 @@ class Checkpointer:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def jnp_asarray_like(x, like):
+    """Place ``x`` on ``like``'s sharding/device with its dtype."""
+    import jax
+
+    sharding = getattr(like, "sharding", None)
+    if sharding is not None:
+        return jax.device_put(x, sharding)
+    return jax.numpy.asarray(x)
 
 
 def _step_of(state) -> int:

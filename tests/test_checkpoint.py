@@ -452,3 +452,35 @@ def test_config_snapshot_guards_structural_resume(tmp_path, capsys):
     assert int(t2.state.update_step) == 2
     t2.close()
     assert "learning_rate" in capsys.readouterr().err
+
+
+def test_restore_grafts_checkpoints_predating_new_state_fields(tmp_path):
+    """A checkpoint whose saved treedef predates an optional state field
+    (observed: ActorState.opp_core added while runs were mid-flight) must
+    restore by path-grafting: every live leaf lands bit-exact, new
+    None-default fields keep their init value, and a genuinely missing
+    leaf still fails loudly. Simulated by saving the flax state_dict form
+    (nested dicts — a different treedef with the same leaves, exactly the
+    strict-restore mismatch class)."""
+    import flax.serialization
+
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    cfg = small_cfg()
+    t = Trainer(cfg)
+    for _ in range(2):
+        t.state, _ = t.learner.update(t.state)
+
+    as_dicts = flax.serialization.to_state_dict(t.state)
+    with Checkpointer(str(tmp_path / "old")) as ck:
+        ck.save(2, as_dicts, env_steps=123)
+        ck.wait()
+        t2 = Trainer(cfg)
+        restored, env_steps = ck.restore(t2.state)
+
+    assert env_steps == 123
+    assert type(restored) is type(t.state)
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(t.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    t.close()
+    t2.close()
