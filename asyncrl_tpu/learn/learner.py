@@ -302,16 +302,39 @@ def qlearn_bootstrap(config: Config, online_boot_q, target_boot_q):
     return jnp.max(target_boot_q, axis=-1)
 
 
+def entropy_coef_at(config: Config, update_step) -> jax.Array | float:
+    """Effective entropy coefficient at ``update_step`` (traced scalar):
+    linear ramp entropy_coef -> entropy_coef_final over
+    entropy_anneal_steps updates, constant thereafter — and the plain
+    Python float when annealing is off, keeping the non-annealed program
+    bit-identical to before the feature existed."""
+    if config.entropy_anneal_steps <= 0:
+        return config.entropy_coef
+    frac = jnp.clip(
+        update_step.astype(jnp.float32) / float(config.entropy_anneal_steps),
+        0.0,
+        1.0,
+    )
+    return config.entropy_coef + frac * (
+        config.entropy_coef_final - config.entropy_coef
+    )
+
+
 def _algo_loss(
     config: Config, apply_fn, params, rollout: Rollout,
     axis_name: str | None = None, dist=None, target_params=None,
+    entropy_coef=None,
 ):
     """Forward the learner net over [T+1, B] obs and apply the configured
     algorithm's loss. Returns (loss, metrics). ``axis_name`` is the dp mesh
     axis when called inside shard_map (for losses needing global batch
     moments, i.e. PPO advantage normalization). ``dist`` interprets the
     policy head (ops.distributions). ``target_params`` is the Q-learning
-    family's target network (required for algo='qlearn', unused otherwise)."""
+    family's target network (required for algo='qlearn', unused otherwise).
+    ``entropy_coef`` overrides config.entropy_coef (the annealed traced
+    value, entropy_coef_at); None = the constant."""
+    if entropy_coef is None:
+        entropy_coef = config.entropy_coef
     logits, values = _forward_fragment(apply_fn, params, rollout)
     logits_t, values_t = logits[:-1], values[:-1]
     bootstrap_value = values[-1]
@@ -341,14 +364,14 @@ def _algo_loss(
         return a3c_loss(
             logits_t, values_t, rollout.actions, rollout.rewards, discounts,
             jax.lax.stop_gradient(bootstrap_value),
-            value_coef=config.value_coef, entropy_coef=config.entropy_coef,
+            value_coef=config.value_coef, entropy_coef=entropy_coef,
             dist=dist, scan_impl=config.scan_impl,
         )
     if config.algo == "impala":
         return impala_loss(
             logits_t, values_t, rollout.actions, rollout.behaviour_logp,
             rollout.rewards, discounts, jax.lax.stop_gradient(bootstrap_value),
-            value_coef=config.value_coef, entropy_coef=config.entropy_coef,
+            value_coef=config.value_coef, entropy_coef=entropy_coef,
             rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
             dist=dist, scan_impl=config.scan_impl,
         )
@@ -365,7 +388,7 @@ def _algo_loss(
             logits_t, values_t, rollout.actions, rollout.behaviour_logp,
             adv.advantages, adv.returns,
             clip_eps=config.ppo_clip_eps, value_coef=config.value_coef,
-            entropy_coef=config.entropy_coef, axis_name=axis_name,
+            entropy_coef=entropy_coef, axis_name=axis_name,
             dist=dist,
         )
     raise ValueError(f"unknown algo {config.algo!r}")
@@ -441,7 +464,8 @@ def _ppo_multipass(
                     batch["advantages"], batch["returns"],
                     clip_eps=config.ppo_clip_eps,
                     value_coef=config.value_coef,
-                    entropy_coef=config.entropy_coef, axis_name=axes or None,
+                    entropy_coef=entropy_coef_at(config, update_step),
+                    axis_name=axes or None,
                     dist=dist,
                 )
                 metrics = dict(metrics, loss=loss)
@@ -809,6 +833,7 @@ def make_train_step(
                     config, napply, p, frag,
                     axis_name=axes or None, dist=dist,
                     target_params=state.actor_params,
+                    entropy_coef=entropy_coef_at(config, state.update_step),
                 )
                 return loss / (_axis_size(axes) * n_accum), (loss, metrics)
 

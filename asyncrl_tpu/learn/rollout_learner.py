@@ -27,6 +27,7 @@ from asyncrl_tpu.learn.learner import (
     _algo_loss,
     _ppo_multipass,
     accumulate_grads,
+    entropy_coef_at,
     make_optimizer,
     qlearn_bootstrap,
     resolve_scan_impl,
@@ -149,7 +150,7 @@ def rollout_sharding(
 
 def _algo_loss_timesharded(
     config: Config, apply_fn, params, rollout: Rollout, *, reduce_axes, dist,
-    target_params=None,
+    target_params=None, entropy_coef=None,
 ):
     """Time-sharded variant of ``learner._algo_loss``: runs inside shard_map
     with the fragment's T dim sharded over ``TIME_AXIS`` (SURVEY.md §5.7).
@@ -159,6 +160,8 @@ def _algo_loss_timesharded(
     local means — the caller pmean's them over ``reduce_axes`` (which
     includes the time axis), and equal-sized shards make that the global
     mean."""
+    if entropy_coef is None:
+        entropy_coef = config.entropy_coef
     logits_t, values_t = apply_fn(params, rollout.obs)
     # ``bootstrap_obs`` is replicated over the time axis; every shard
     # computes the (tiny) bootstrap forward, only the last consumes it.
@@ -188,7 +191,7 @@ def _algo_loss_timesharded(
         return a3c_loss(
             logits_t, values_t, rollout.actions, rollout.rewards, discounts,
             bootstrap_value, value_coef=config.value_coef,
-            entropy_coef=config.entropy_coef, dist=dist, returns=returns,
+            entropy_coef=entropy_coef, dist=dist, returns=returns,
         )
     if config.algo == "impala":
         target_logp = dist.logp(logits_t, rollout.actions)
@@ -208,7 +211,7 @@ def _algo_loss_timesharded(
         return impala_loss(
             logits_t, values_t, rollout.actions, rollout.behaviour_logp,
             rollout.rewards, discounts, bootstrap_value,
-            value_coef=config.value_coef, entropy_coef=config.entropy_coef,
+            value_coef=config.value_coef, entropy_coef=entropy_coef,
             rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
             dist=dist, vtrace_out=vt,
         )
@@ -220,7 +223,7 @@ def _algo_loss_timesharded(
         return ppo_loss(
             logits_t, values_t, rollout.actions, rollout.behaviour_logp,
             adv.advantages, adv.returns, clip_eps=config.ppo_clip_eps,
-            value_coef=config.value_coef, entropy_coef=config.entropy_coef,
+            value_coef=config.value_coef, entropy_coef=entropy_coef,
             axis_name=reduce_axes, dist=dist,
         )
     raise ValueError(f"unknown algo {config.algo!r} for time sharding")
@@ -322,17 +325,20 @@ class RolloutLearner:
                 n_accum = max(config.grad_accum, 1)
 
                 def scaled_loss(p, frag):
+                    ec = entropy_coef_at(config, state.update_step)
                     if time_sharded:
                         loss, metrics = _algo_loss_timesharded(
                             config, napply, p, frag,
                             reduce_axes=reduce_axes, dist=dist,
                             target_params=state.target_params,
+                            entropy_coef=ec,
                         )
                     else:
                         loss, metrics = _algo_loss(
                             config, napply, p, frag,
                             axis_name=axes, dist=dist,
                             target_params=state.target_params,
+                            entropy_coef=ec,
                         )
                     return (
                         loss / (jax.lax.axis_size(reduce_axes) * n_accum),

@@ -66,6 +66,14 @@ class Config:
     # --- loss coefficients ---
     value_coef: float = 0.5
     entropy_coef: float = 0.01
+    # Entropy annealing (the A3C-family exploration schedule): with
+    # entropy_anneal_steps > 0 the effective coefficient ramps linearly
+    # from entropy_coef to entropy_coef_final over that many learner
+    # updates, then holds. Early exploration pressure, late policy
+    # sharpening — computed INSIDE the jitted step from update_step, so
+    # fused multi-update calls see per-update values. 0 = constant coef.
+    entropy_coef_final: float = 0.0
+    entropy_anneal_steps: int = 0
     # Reward scaling applied to the learner's view of rewards (episode-return
     # metrics stay raw). Essential for continuous-control workloads whose raw
     # returns are in the hundreds/thousands (e.g. Pendulum ≈ −1200): without
