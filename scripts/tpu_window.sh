@@ -104,6 +104,7 @@ while true; do
       --target 18.0 --budget-seconds 7200 \
       step_cost=0.005 checkpoint_dir=runs/pong18_tpu checkpoint_every=50 \
       eval_every=40 eval_episodes=32 updates_per_call=32 \
+      entropy_coef_final=0.002 entropy_anneal_steps=30000 \
       total_env_steps=20000000000
     echo "=== rc=$? [t2t]"
     commit_ledger
