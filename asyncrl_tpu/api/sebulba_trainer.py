@@ -31,7 +31,7 @@ from asyncrl_tpu.learn.rollout_learner import LearnerState, RolloutLearner
 from asyncrl_tpu.models.networks import build_model, is_recurrent, reset_core
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.ops.normalize import normalizing_apply
-from asyncrl_tpu.parallel.mesh import dp_size, make_mesh
+from asyncrl_tpu.parallel.mesh import TIME_AXIS, dp_size, make_mesh
 from asyncrl_tpu.rollout.sebulba import (
     ActorThread,
     Fragment,
@@ -89,8 +89,25 @@ class SebulbaTrainer:
                 f"num_envs/actor_threads={self._envs_per_actor} not "
                 f"divisible by dp={dp}"
             )
+        # On a time-sharded mesh each (dp, sp) shard shuffles its
+        # (unroll/sp)-step slice of the per-actor fragment, so the
+        # divisibility check runs on that local geometry.
+        sp = (
+            self.mesh.shape[TIME_AXIS]
+            if TIME_AXIS in self.mesh.axis_names
+            else 1
+        )
+        if config.unroll_len % sp:
+            # RolloutLearner re-raises this, but it must come BEFORE the
+            # minibatch check: a floored unroll_len//sp there would report
+            # a wrong-geometry error for what is really sp-indivisibility.
+            raise ValueError(
+                f"unroll_len={config.unroll_len} not divisible by the "
+                f"time-shard axis sp={sp}"
+            )
         validate_ppo_geometry(
             config, self._envs_per_actor // dp, "per-device",
+            unroll=config.unroll_len // sp,
             recurrent=is_recurrent(self.model),
         )
         self.learner = RolloutLearner(config, self.spec, self.model, self.mesh)

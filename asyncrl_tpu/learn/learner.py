@@ -402,6 +402,7 @@ def _ppo_multipass(
     # (population mode, no cross-shard reduction) — a silent default here
     # would turn a forgotten-axes call site into unsynchronized params.
     member_seed: jax.Array | None = None,
+    time_axis: str | None = None,
 ):
     """PPO's real update: ``ppo_epochs`` passes over the fragment, each a
     scan of ``ppo_minibatches`` shuffled minibatch Adam steps (the reference's
@@ -422,17 +423,48 @@ def _ppo_multipass(
     episode-boundary resets), so the core always sees the exact temporal
     structure the behaviour policy generated. Feed-forward keeps the flat
     [T*B] sample shuffle (strictly more decorrelated, and cheaper).
+
+    ``time_axis`` (host-fragment learner on an sp mesh): the fragment's T
+    dim is sequence-parallel, so GAE runs as the two-level distributed
+    reverse scan and every per-sample quantity is a LOCAL [T_local, B]
+    slice. Minibatching needs nothing else: PPO's per-sample loss has no
+    cross-time coupling (the only time recursion is the one-shot GAE), so
+    each (dp, sp) shard shuffles its local samples independently — the
+    global minibatch is time-stratified, the same decorrelation argument
+    as the dp-local shuffle above. ``axes`` must then be the FULL reduce
+    set (dp axes + time axis), making the loss scaling / advantage moments
+    / shuffle-key folding span the time shards like any other data axis.
+    Recurrent cores stay excluded from sp meshes (rollout_learner's
+    eager check; docs/ARCHITECTURE.md).
     """
-    _, values_all = _forward_fragment(apply_fn, params, rollout)
-    values_t, bootstrap_value = values_all[:-1], values_all[-1]
-    adv = gae(
-        rollout.rewards,
-        rollout.discounts(config.gamma),
-        jax.lax.stop_gradient(values_t),
-        jax.lax.stop_gradient(bootstrap_value),
-        config.gae_lambda,
-        scan_impl=config.scan_impl,
-    )
+    if time_axis is None:
+        _, values_all = _forward_fragment(apply_fn, params, rollout)
+        values_t, bootstrap_value = values_all[:-1], values_all[-1]
+        adv = gae(
+            rollout.rewards,
+            rollout.discounts(config.gamma),
+            jax.lax.stop_gradient(values_t),
+            jax.lax.stop_gradient(bootstrap_value),
+            config.gae_lambda,
+            scan_impl=config.scan_impl,
+        )
+    else:
+        from asyncrl_tpu.parallel.timeshard import gae_timesharded
+
+        # ``bootstrap_obs`` is replicated over the time axis (same calling
+        # contract as rollout_learner._algo_loss_timesharded): every shard
+        # computes the tiny bootstrap forward, the distributed scan
+        # consumes it on the last shard only.
+        _, values_t = apply_fn(params, rollout.obs)
+        _, bootstrap_value = apply_fn(params, rollout.bootstrap_obs)
+        adv = gae_timesharded(
+            rollout.rewards,
+            rollout.discounts(config.gamma),
+            jax.lax.stop_gradient(values_t),
+            jax.lax.stop_gradient(bootstrap_value),
+            config.gae_lambda,
+            axis_name=time_axis,
+        )
 
     T, B = rollout.actions.shape[:2]
     recurrent = rollout.init_core is not None

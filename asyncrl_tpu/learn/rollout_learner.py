@@ -250,6 +250,9 @@ class RolloutLearner:
                 "selfplay is Anakin-only (backend='tpu'): host actor "
                 "threads have no opponent-snapshot channel"
             )
+        ppo_multipass = config.algo == "ppo" and (
+            config.ppo_epochs > 1 or config.ppo_minibatches > 1
+        )
         time_sharded = TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1
         if time_sharded:
             sp = mesh.shape[TIME_AXIS]
@@ -269,13 +272,13 @@ class RolloutLearner:
                     "deliberately NOT time-shardable'). Use a dp-only mesh "
                     "for core='lstm'"
                 )
-            if config.algo == "ppo" and (
-                config.ppo_epochs > 1 or config.ppo_minibatches > 1
-            ):
-                raise NotImplementedError(
-                    "multi-epoch/minibatched PPO is not time-shardable; "
-                    "use ppo_epochs=ppo_minibatches=1"
-                )
+            # Multipass PPO time-shards fine (PPO's per-sample loss has no
+            # cross-time coupling; only the one-shot GAE recurses —
+            # _ppo_multipass's time_axis path). Minibatch geometry is NOT
+            # eager-checked here: this learner never knows the fragment's
+            # env batch (SebulbaTrainer feeds per-actor fragments) — the
+            # trainer runs the sp-aware eager check with the real B, and
+            # _ppo_multipass re-validates the local slice at trace time.
             # (qlearn time-shards via n_step_returns_timesharded; its
             # recurrent DRQN variant is excluded by the is_recurrent check
             # above like every recurrent core.)
@@ -286,10 +289,6 @@ class RolloutLearner:
         self.mesh = mesh
         self.optimizer = make_optimizer(config)
         dist = distributions.for_config(config, spec)
-
-        ppo_multipass = config.algo == "ppo" and (
-            config.ppo_epochs > 1 or config.ppo_minibatches > 1
-        )
         apply_fn = model.apply
         optimizer = self.optimizer
 
@@ -312,10 +311,14 @@ class RolloutLearner:
                     * jax.lax.rsqrt(jnp.maximum(ret_var, 1e-8))
                 )
             if ppo_multipass:
+                # ``axes=reduce_axes``: on an sp mesh the shuffle keys,
+                # loss scaling, and advantage moments must span the time
+                # shards too (== axes on a dp-only mesh).
                 params, opt_state, loss, grad_norm, metrics = _ppo_multipass(
                     config, napply, optimizer, dist,
                     state.params, state.opt_state, rollout, state.update_step,
-                    axes=axes,
+                    axes=reduce_axes,
+                    time_axis=TIME_AXIS if time_sharded else None,
                 )
             else:
                 # Same implicit-psum gradient scaling as the Anakin step:

@@ -186,32 +186,12 @@ def test_gae_timesharded_matches_single_device(devices):
     )
 
 
-@pytest.mark.parametrize("algo", ["a3c", "impala", "ppo", "qlearn"])
-def test_rollout_learner_timesharded_equals_dp_only(algo, devices):
-    """The HOST-FRAGMENT learner on a (dp x sp) mesh must produce the same
-    post-update params as on a dp-only mesh — the end-to-end check that the
-    time-sharded loss glue (rollout_learner._algo_loss_timesharded) matches
-    the unsharded path (regression: this glue was once referenced but
-    undefined, so any sp>1 mesh crashed with NameError at trace time)."""
-    from asyncrl_tpu.envs.cartpole import CartPole
-    from asyncrl_tpu.learn.rollout_learner import RolloutLearner
-    from asyncrl_tpu.models.networks import build_model
+def _ppo_rollout(T, B, obs_dim, seed=0):
+    rng = np.random.default_rng(seed)
     from asyncrl_tpu.rollout.buffer import Rollout
-    from asyncrl_tpu.utils.config import Config
 
-    cfg = Config(
-        algo=algo, unroll_len=8, num_envs=8, precision="f32",
-        ppo_epochs=1, ppo_minibatches=1, actor_staleness=2,
-        # qlearn additionally exercises the Huber branch on both paths.
-        huber_delta=1.0 if algo == "qlearn" else 0.0,
-    )
-    env = CartPole()
-    model = build_model(cfg, env.spec)
-
-    T, B = 8, 8
-    rng = np.random.default_rng(0)
-    ro = Rollout(
-        obs=jnp.asarray(rng.normal(size=(T, B, 4)).astype(np.float32)),
+    return Rollout(
+        obs=jnp.asarray(rng.normal(size=(T, B, obs_dim)).astype(np.float32)),
         actions=jnp.asarray(rng.integers(0, 2, (T, B)).astype(np.int32)),
         behaviour_logp=jnp.asarray(
             rng.normal(-0.7, 0.1, (T, B)).astype(np.float32)
@@ -219,9 +199,21 @@ def test_rollout_learner_timesharded_equals_dp_only(algo, devices):
         rewards=jnp.asarray(rng.normal(size=(T, B)).astype(np.float32)),
         terminated=jnp.asarray(rng.uniform(size=(T, B)) < 0.1),
         truncated=jnp.zeros((T, B), bool),
-        bootstrap_obs=jnp.asarray(rng.normal(size=(B, 4)).astype(np.float32)),
+        bootstrap_obs=jnp.asarray(
+            rng.normal(size=(B, obs_dim)).astype(np.float32)
+        ),
     )
 
+
+def _assert_sp_matches_dp(cfg, ro):
+    """One RolloutLearner.update on a dp-only vs a (dp x sp) mesh: the
+    post-update params and loss must agree."""
+    from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.learn.rollout_learner import RolloutLearner
+    from asyncrl_tpu.models.networks import build_model
+
+    env = CartPole()
+    model = build_model(cfg, env.spec)
     results = {}
     for name, shape, axes in [
         ("dp", (8,), ("dp",)),
@@ -241,3 +233,79 @@ def test_rollout_learner_timesharded_equals_dp_only(algo, devices):
     np.testing.assert_allclose(
         results["dp"][1], results["dp_sp"][1], rtol=5e-5
     )
+
+
+@pytest.mark.parametrize("algo", ["a3c", "impala", "ppo", "qlearn"])
+def test_rollout_learner_timesharded_equals_dp_only(algo, devices):
+    """The HOST-FRAGMENT learner on a (dp x sp) mesh must produce the same
+    post-update params as on a dp-only mesh — the end-to-end check that the
+    time-sharded loss glue (rollout_learner._algo_loss_timesharded) matches
+    the unsharded path (regression: this glue was once referenced but
+    undefined, so any sp>1 mesh crashed with NameError at trace time)."""
+    from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.learn.rollout_learner import RolloutLearner
+    from asyncrl_tpu.models.networks import build_model
+    from asyncrl_tpu.utils.config import Config
+
+    cfg = Config(
+        algo=algo, unroll_len=8, num_envs=8, precision="f32",
+        ppo_epochs=1, ppo_minibatches=1, actor_staleness=2,
+        # qlearn additionally exercises the Huber branch on both paths.
+        huber_delta=1.0 if algo == "qlearn" else 0.0,
+    )
+    _assert_sp_matches_dp(cfg, _ppo_rollout(8, 8, 4))
+
+
+def test_rollout_learner_timesharded_multipass_equals_dp_only(devices):
+    """Multi-epoch PPO on an sp mesh (the round-2 verdict's last time-shard
+    hole): with ppo_minibatches=1 the shuffle is a no-op up to sample order
+    inside one mean, so a (dp x sp) mesh must reproduce the dp-only params —
+    proving the time_axis path of _ppo_multipass (distributed GAE + local
+    slices) computes the same two full-batch passes."""
+    from asyncrl_tpu.utils.config import Config
+
+    cfg = Config(
+        algo="ppo", unroll_len=8, num_envs=8, precision="f32",
+        ppo_epochs=2, ppo_minibatches=1,
+    )
+    _assert_sp_matches_dp(cfg, _ppo_rollout(8, 8, 4))
+
+
+def test_rollout_learner_timesharded_multipass_minibatched(devices):
+    """Minibatched multipass PPO on the sp mesh: shuffled minibatches are
+    time-stratified (each shard shuffles its local slice) so no exact
+    unsharded twin exists — assert the step is deterministic, finite, and
+    actually moves the params."""
+    from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.learn.rollout_learner import RolloutLearner
+    from asyncrl_tpu.models.networks import build_model
+    from asyncrl_tpu.utils.config import Config
+
+    cfg = Config(
+        algo="ppo", unroll_len=8, num_envs=8, precision="f32",
+        ppo_epochs=2, ppo_minibatches=2,
+    )
+    env = CartPole()
+    model = build_model(cfg, env.spec)
+    ro = _ppo_rollout(8, 8, 4, seed=3)
+
+    mesh = make_mesh((2, 4), ("dp", "sp"))
+    learner = RolloutLearner(cfg, env.spec, model, mesh)
+    state0 = learner.init_state(0)
+    put = learner.put_rollout(ro)
+
+    outs = []
+    for _ in range(2):
+        state, metrics = learner.update(state0, put)
+        outs.append(
+            (jax.tree.leaves(jax.device_get(state.params)),
+             float(metrics["loss"]))
+        )
+    assert np.isfinite(outs[0][1])
+    for a, b in zip(outs[0][0], outs[1][0]):
+        np.testing.assert_array_equal(a, b)  # deterministic
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(outs[0][0], jax.tree.leaves(jax.device_get(state0.params)))
+    )
+    assert moved
