@@ -38,6 +38,7 @@ from asyncrl_tpu.learn.learner import (
     resolve_scan_impl,
     validate_ppo_geometry,
     validate_recurrent_config,
+    validate_selfplay_config,
 )
 from asyncrl_tpu.models.networks import build_model, is_recurrent
 from asyncrl_tpu.parallel.mesh import dp_axes, dp_sharded, dp_size, make_mesh
@@ -101,11 +102,6 @@ class PopulationTrainer:
             raise ValueError(
                 f"updates_per_call={config.updates_per_call} must be >= 1"
             )
-        if config.selfplay:
-            raise NotImplementedError(
-                "selfplay is not wired for population training (member "
-                "init has no opponent slot); use the single-run Trainer"
-            )
         validate_qlearn_config(config)
         self.config = config
         self.pop_size = pop_size
@@ -115,6 +111,11 @@ class PopulationTrainer:
         # (clearer than a trace-time failure inside the first update).
         # Recurrent members work like recurrent single runs: the core rides
         # the per-member actor state through the vmapped train step.
+        # Self-play likewise: each member carries its OWN frozen rival
+        # (opponent_params is just another vmapped TrainState leaf) and
+        # promotes it on its own update counter — a population of K
+        # independent self-play ladders.
+        validate_selfplay_config(config, self.env, self.model)
         validate_recurrent_config(config, self.model)
         validate_ppo_geometry(
             config, config.num_envs, "per-member",
@@ -169,6 +170,9 @@ class PopulationTrainer:
             update_step=P(axes),
             obs_stats=P(axes),
             ret_stats=P(axes),
+            # Unlike the single-run learner (replicated, P()): each member
+            # owns a rival, so the member axis shards over dp like params.
+            opponent_params=P(axes),
         )
         self._step = jax.jit(
             jax.shard_map(
@@ -230,6 +234,7 @@ class PopulationTrainer:
         actor = actor_init(
             self.env, cfg.num_envs, jax.random.split(akey, 1)[0],
             model=self.model, track_returns=cfg.normalize_returns,
+            selfplay=cfg.selfplay,
         )
         from asyncrl_tpu.ops.normalize import init_stats
 
@@ -245,6 +250,9 @@ class PopulationTrainer:
                 else None
             ),
             ret_stats=init_stats(()) if cfg.normalize_returns else None,
+            # Self-play: the member's first rival is its own init snapshot
+            # (same derivation as Learner.init_state).
+            opponent_params=params if cfg.selfplay else None,
         )
 
     def _init_population(self, base_seed: int) -> TrainState:

@@ -27,15 +27,36 @@ probe() {
 }
 
 # run_job <stamp-name> <timeout-s> <cmd...>: one-shot; stamps on rc=0.
+# On failure, re-probe: tunnel still UP means the failure is REAL (not a
+# flap) — stamp it .permfail and move on, or the queue would loop on one
+# deterministically-failing job and starve everything behind it (observed:
+# pallas_validate's genuine kernel mismatch blocked the t2t north star).
 run_job() {
   local stamp="$1" tmo="$2"; shift 2
   [ -e "$STAMPS/$stamp" ] && return 0
+  [ -e "$STAMPS/$stamp.permfail" ] && return 0
   echo "=== $(date -u +%FT%TZ) [$stamp] $*"
   timeout -k 10 "$tmo" "$@"
   local rc=$?
   echo "=== rc=$rc [$stamp]"
-  if [ "$rc" -eq 0 ]; then touch "$STAMPS/$stamp"; else return 1; fi
+  if [ "$rc" -eq 0 ]; then
+    touch "$STAMPS/$stamp"
+  elif [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    # timeout-killed: the axon plugin HANGS (not errors) when the tunnel
+    # dies under a job, so a kill is flap-shaped even if the tunnel is
+    # back up by now — always retryable.
+    return 1
+  elif probe; then
+    echo "=== [$stamp] failed with tunnel UP: permanent, not retrying"
+    touch "$STAMPS/$stamp.permfail"
+  else
+    return 1
+  fi
 }
+
+# A job counts as settled (for queue completion) once it succeeded OR
+# permanently failed — else one permfail spins the watcher forever.
+settled() { [ -e "$STAMPS/$1" ] || [ -e "$STAMPS/$1.permfail" ]; }
 
 commit_ledger() {
   if [ -n "$(git status --porcelain BENCH_HISTORY.json)" ]; then
@@ -120,10 +141,10 @@ while true; do
   run_job selfplay_exp 900 python scripts/selfplay_experiment.py 400000000 updates_per_call=32 step_cost=0.005 || continue
   commit_ledger
 
-  if [ -e "$STAMPS/pixel_bench" ] && [ -e "$STAMPS/roofline_pong" ] \
-     && [ -e "$STAMPS/roofline_atari" ] && [ -e "$STAMPS/t2t" ] \
-     && [ -e "$STAMPS/pallas_validate" ] && [ -e "$STAMPS/pixel_bench_1024" ] \
-     && [ -e "$STAMPS/bench_matrix" ] && [ -e "$STAMPS/selfplay_exp" ]; then
+  if settled pixel_bench && settled roofline_pong \
+     && settled roofline_atari && [ -e "$STAMPS/t2t" ] \
+     && settled pallas_validate && settled pixel_bench_1024 \
+     && settled bench_matrix && settled selfplay_exp; then
     echo "--- $(date -u +%FT%TZ) queue complete"
     break
   fi

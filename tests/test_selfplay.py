@@ -127,10 +127,9 @@ def test_selfplay_guards():
                 num_envs=16,
             )
         )
-    with pytest.raises(NotImplementedError, match="population"):
-        from asyncrl_tpu.api.population import PopulationTrainer
-
-        PopulationTrainer(small_cfg(), pop_size=2)
+    # population x selfplay is a SUPPORTED combination (round 3; each
+    # member carries its own rival) — covered by
+    # tests/test_population.py::test_selfplay_population_member_matches_standalone.
 
 
 def test_selfplay_checkpoint_roundtrip(tmp_path):
