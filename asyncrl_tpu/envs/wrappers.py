@@ -31,8 +31,17 @@ def frame_skip_scan(env: Environment, state, action, key, skip: int):
       (== the window's first carry state when it ends early), for 2-frame
       max pooling by pixel wrappers.
     """
+    return _frame_skip_scan(
+        lambda s, k: env.step(s, action, k), state, key, skip
+    )
+
+
+def _frame_skip_scan(step_fn, state, key, skip: int):
+    """``frame_skip_scan`` over an arbitrary ``step_fn(state, key)`` —
+    shared by the single-action and duel (``step_duel``) paths, which
+    differ only in what one raw step is."""
     keys = jax.random.split(key, skip)
-    new_state, ts0 = env.step(state, action, keys[0])
+    new_state, ts0 = step_fn(state, keys[0])
 
     # shard_map vma alignment: the body gates every carry leaf through
     # ``done`` (the freeze), so outputs carry done's varying-axes metadata.
@@ -49,7 +58,7 @@ def frame_skip_scan(env: Environment, state, action, key, skip: int):
 
     def body(carry, k):
         cur, prev, ts_acc, done = carry
-        nxt, ts = env.step(cur, action, k)
+        nxt, ts = step_fn(cur, k)
         keep = jnp.logical_not(done)
 
         def freeze(new, old):
@@ -82,6 +91,12 @@ class FrameSkip(Environment):
         self._env = env
         self._skip = skip
         self.spec = env.spec
+        # Duel protocol (self-play): forwarded ONLY when the inner env has
+        # it — instance attributes keep hasattr() truthful, so the eager
+        # selfplay validation can't be fooled by the wrapper.
+        if hasattr(env, "step_duel"):
+            self.step_duel = self._step_duel
+            self.observe_opponent = env.observe_opponent
 
     def init(self, key):
         return self._env.init(key)
@@ -92,6 +107,15 @@ class FrameSkip(Environment):
     def step(self, state, action, key):
         new_state, ts, _ = frame_skip_scan(
             self._env, state, action, key, self._skip
+        )
+        return new_state, ts
+
+    def _step_duel(self, state, action, opp_action, key):
+        # Both paddles' actions repeat across the window (one decision per
+        # skip window each), frozen at the first episode end like step.
+        new_state, ts, _ = _frame_skip_scan(
+            lambda s, k: self._env.step_duel(s, action, opp_action, k),
+            state, key, self._skip,
         )
         return new_state, ts
 
@@ -107,6 +131,14 @@ class StickyActions(Environment):
         self._env = env
         self._p = p
         self.spec = env.spec
+        # Duel protocol (self-play): state grows a SECOND prev slot and
+        # each paddle draws its own stick (ALE multiplayer semantics —
+        # stickiness is per player). Forwarded only when the inner env has
+        # the protocol, so hasattr() stays truthful for eager validation.
+        self._duel = hasattr(env, "step_duel")
+        if self._duel:
+            self.step_duel = self._step_duel
+            self.observe_opponent = self._observe_opponent
 
     def _noop(self):
         if self.spec.continuous:
@@ -114,25 +146,51 @@ class StickyActions(Environment):
         return jnp.zeros((), jnp.int32)
 
     def init(self, key):
-        return (self._env.init(key), self._noop())
+        inner = self._env.init(key)
+        if self._duel:
+            return (inner, self._noop(), self._noop())
+        return (inner, self._noop())
 
     def observe(self, state):
         return self._env.observe(state[0])
 
-    def step(self, state, action, key):
-        inner, prev = state
-        sticky_key, step_key = jax.random.split(key)
+    def _observe_opponent(self, state):
+        return self._env.observe_opponent(state[0])
+
+    def _execute(self, prev, action, sticky_key):
         stick = jax.random.bernoulli(sticky_key, self._p)
         if self.spec.continuous:
             action = jnp.asarray(action, jnp.float32)
         else:
             action = jnp.asarray(action, prev.dtype)
-        executed = jnp.where(stick, prev, action)
+        return jnp.where(stick, prev, action)
+
+    def step(self, state, action, key):
+        inner, prev, rest = state[0], state[1], state[2:]
+        sticky_key, step_key = jax.random.split(key)
+        executed = self._execute(prev, action, sticky_key)
         new_inner, ts = self._env.step(inner, executed, step_key)
         # Fresh episode starts from the no-op, not the dead episode's last
         # action (stickiness must not leak across the reset).
         next_prev = jnp.where(ts.done, self._noop(), executed)
-        return (new_inner, next_prev), ts
+        # Duel-capable env driven through the scripted-opponent path (e.g.
+        # greedy eval of a self-play run): the opponent slot just resets
+        # at episode ends.
+        rest = tuple(jnp.where(ts.done, self._noop(), r) for r in rest)
+        return (new_inner, next_prev, *rest), ts
+
+    def _step_duel(self, state, action, opp_action, key):
+        inner, prev_a, prev_o = state
+        ka, ko, step_key = jax.random.split(key, 3)
+        exec_a = self._execute(prev_a, action, ka)
+        exec_o = self._execute(prev_o, opp_action, ko)
+        new_inner, ts = self._env.step_duel(inner, exec_a, exec_o, step_key)
+        noop = self._noop()
+        return (
+            new_inner,
+            jnp.where(ts.done, noop, exec_a),
+            jnp.where(ts.done, noop, exec_o),
+        ), ts
 
 
 def apply_ale_knobs(env: Environment, config) -> Environment:

@@ -227,13 +227,10 @@ def validate_selfplay_config(config: Config, env, model) -> None:
             "selfplay is Anakin-only (backend='tpu'): host actor threads "
             "have no opponent-snapshot channel"
         )
-    if config.frame_skip > 1 or config.sticky_actions > 0.0:
-        raise NotImplementedError(
-            "selfplay is incompatible with frame_skip/sticky_actions: the "
-            "ALE wrappers don't forward the duel protocol (step_duel / "
-            "observe_opponent), and their wrapped state would hide the "
-            "game state the mirror view reads"
-        )
+    # frame_skip / sticky_actions compose with self-play: the ALE wrappers
+    # forward the duel protocol (both paddles' actions repeat across a skip
+    # window; each paddle draws its own stick — envs/wrappers.py), so the
+    # hasattr check below sees through them.
     if not (
         hasattr(env, "step_duel") and hasattr(env, "observe_opponent")
     ):

@@ -110,6 +110,10 @@ while true; do
   # K in their label; compare offline, then retune bench.py's default.)
   run_job upc64 300 python bench.py pong_impala updates_per_call=64 || continue
   run_job upc128 300 python bench.py pong_impala updates_per_call=128 || continue
+  # K=128 measured 24.2M fps (vs 14.8M at K=32); probe whether the curve
+  # keeps rising before the headline settles on K=128's plateau.
+  run_job upc256 300 python bench.py pong_impala updates_per_call=256 || continue
+  run_job upc512 300 python bench.py pong_impala updates_per_call=512 || continue
   # The reference's FULL 1024-envs/chip pixel geometry (BASELINE.json:9):
   # OOMs at 21.3G without microbatching; grad_accum=4 + block remat fits
   # it into the v5e's 15.75G (the r3 grad_accum/remat feature).

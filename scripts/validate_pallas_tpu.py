@@ -3,14 +3,18 @@
 ``Config.scan_impl='auto'`` resolves to ``associative`` everywhere because
 the Pallas VMEM kernel had never run on actual TPU hardware (utils/config.py
 scan_impl note). This script is the validation gate: on a live chip it
-checks ``reverse_linear_scan_pallas`` against the ``lax.associative_scan``
-reference across the fragment geometries the presets use, times both, and
-appends a ``kind="kernel_validation"`` entry to BENCH_HISTORY.json.
+judges BOTH ``reverse_linear_scan_pallas`` and the ``lax.associative_scan``
+reference against a float64 sequential truth across the fragment geometries
+the presets use (scale-aware RMS-relative error — a per-element relative
+metric falsely flags rounding tails at large T*B; see the inline comment),
+times both, and appends a ``kind="kernel_validation"`` entry to
+BENCH_HISTORY.json.
 
     python scripts/validate_pallas_tpu.py
 
-Exit 0 = every geometry matched (the kernel is safe to promote); exit 1 =
-mismatch (keep the associative default, entry records which geometry).
+Exit 0 = every geometry matched (the kernel is no less accurate than the
+associative reference — safe to promote); exit 1 = mismatch (keep the
+associative default, entry records which geometry).
 """
 
 from __future__ import annotations
@@ -71,16 +75,33 @@ def main() -> int:
             results.append({"T": T, "B": B, "error": str(e)[:300]})
             ok = False
             continue
-        # The kernel's sequential walk is MORE accurate than the
-        # associative tree (no re-association); tolerance covers the
-        # tree's f32 rounding across log2(T) rounds.
-        err = float(np.max(np.abs(out - ref) / (np.abs(ref) + 1e-6)))
-        match = bool(err < 1e-4)
+        # Judge BOTH f32 implementations against a float64 sequential
+        # truth, scale-aware (max abs error over the fragment's RMS).
+        # A per-element relative metric is unusable here: b is zero-mean,
+        # so some (t, col) entries cancel to near zero and the max over
+        # T*B samples of |d|/|ref| reads as "mismatch" purely from f32
+        # rounding tails — measured 0.013 between two CORRECT f32 impls
+        # on CPU at (128, 4096) while the scale-aware error was ~1e-6.
+        xs = np.zeros(B, np.float64)
+        truth = np.zeros((T, B), np.float64)
+        a64, b64 = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        for t in range(T - 1, -1, -1):
+            xs = b64[t] + a64[t] * xs
+            truth[t] = xs
+        rms = float(np.sqrt(np.mean(truth**2))) or 1.0
+        err_pal = float(np.max(np.abs(out - truth))) / rms
+        err_ref = float(np.max(np.abs(ref - truth))) / rms
+        # The kernel passes if it is no worse than the associative tree
+        # (2x margin for fma-ordering differences) and sane in absolute
+        # scale-aware terms.
+        match = bool(err_pal <= max(2.0 * err_ref, 1e-5))
+        err = err_pal
         ok = ok and match
         t_ref = timed(ref_fn, a, b)
         t_pal = timed(pal_fn, a, b)
         results.append({
-            "T": T, "B": B, "max_rel_err": err, "match": match,
+            "T": T, "B": B, "rms_rel_err": err,
+            "rms_rel_err_associative": err_ref, "match": match,
             "associative_us": round(t_ref * 1e6, 1),
             "pallas_us": round(t_pal * 1e6, 1),
             "speedup": round(t_ref / t_pal, 2),

@@ -165,9 +165,19 @@ def test_selfplay_learns_vs_scripted_ladder():
     assert ret > -12.0, f"no self-play transfer: eval vs tracker {ret}"
 
 
-def test_selfplay_rejects_ale_knobs():
-    with pytest.raises(NotImplementedError, match="frame_skip"):
-        Trainer(small_cfg(sticky_actions=0.25))
+def test_selfplay_composes_with_ale_knobs():
+    """frame_skip + sticky_actions forward the duel protocol through the
+    wrappers (round 3): a self-play trainer constructs and trains, and the
+    wrapped env still exposes the mirror view."""
+    cfg = small_cfg(frame_skip=2, sticky_actions=0.25, num_envs=8)
+    t = Trainer(cfg)
+    env = t.env
+    assert hasattr(env, "step_duel") and hasattr(env, "observe_opponent")
+    state = t.state
+    for _ in range(2):
+        state, metrics = t.learner.update(state)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.update_step) == 2
 
 
 def test_selfplay_qlearn_opponent_shares_epsilon():

@@ -218,3 +218,74 @@ def test_frame_pool_reachable_from_config():
     state = env.init(jax.random.PRNGKey(0))
     state, ts = jax.jit(env.step)(state, 0, jax.random.PRNGKey(1))
     assert ts.obs.shape == (84, 84, 4)
+
+
+def test_frame_skip_forwards_duel_protocol():
+    """FrameSkip.step_duel == manually repeating step_duel with both
+    actions held, frozen at the first done — and the mirror view passes
+    through untouched. Non-duel envs must NOT grow the protocol."""
+    from asyncrl_tpu.envs.cartpole import CartPole
+    from asyncrl_tpu.envs.pong import DuelPong
+    from asyncrl_tpu.envs.wrappers import FrameSkip
+
+    assert not hasattr(FrameSkip(CartPole(), 2), "step_duel")
+
+    env = DuelPong()
+    wrapped = FrameSkip(env, 3)
+    key = jax.random.PRNGKey(0)
+    state = env.init(key)
+    np.testing.assert_allclose(
+        np.asarray(wrapped.observe_opponent(state)),
+        np.asarray(env.observe_opponent(state)),
+    )
+
+    a, o = jnp.int32(1), jnp.int32(2)
+    step_key = jax.random.PRNGKey(7)
+    got_state, got_ts = wrapped.step_duel(state, a, o, step_key)
+
+    keys = jax.random.split(step_key, 3)
+    cur, ts = env.step_duel(state, a, o, keys[0])
+    total = ts.reward
+    done = ts.done
+    for k in keys[1:]:
+        nxt, ts2 = env.step_duel(cur, a, o, k)
+        keep = float(np.logical_not(done))
+        total = total + keep * ts2.reward
+        if not bool(done):
+            cur, ts = nxt, ts2
+        done = np.logical_or(done, ts2.done)
+    np.testing.assert_allclose(float(got_ts.reward), float(total), rtol=1e-6)
+    for g, w in zip(jax.tree.leaves(got_state), jax.tree.leaves(cur)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-6)
+
+
+def test_sticky_actions_duel_per_paddle_independence():
+    """Duel stickiness draws per paddle: state carries two prev slots that
+    reset independently at episode ends, and executed actions differ from
+    the requested ones at roughly rate p for EACH paddle."""
+    from asyncrl_tpu.envs.pong import DuelPong
+    from asyncrl_tpu.envs.wrappers import StickyActions
+
+    env = StickyActions(DuelPong(), 0.25)
+    assert hasattr(env, "step_duel")
+    key = jax.random.PRNGKey(1)
+    state = env.init(key)
+    assert len(state) == 3  # (inner, prev_agent, prev_opp)
+
+    # Alternate actions so a stick is visible as prev != requested.
+    sticks_a = sticks_o = 0
+    n = 400
+    k = key
+    for i in range(n):
+        k, sub = jax.random.split(k)
+        a = jnp.int32(1 + (i % 2))
+        o = jnp.int32(2 - (i % 2))
+        prev_a, prev_o = state[1], state[2]
+        state, ts = env.step_duel(state, a, o, sub)
+        # executed action is recorded in the new prev slots (unless done
+        # reset them); compare against the requested ones.
+        if not bool(ts.done):
+            sticks_a += int(state[1] != a)
+            sticks_o += int(state[2] != o)
+    for rate in (sticks_a / n, sticks_o / n):
+        assert 0.1 < rate < 0.45, f"sticky rate {rate} far from p=0.25"
