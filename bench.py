@@ -221,9 +221,10 @@ def main() -> None:
     # defaults to the measured sweet spot unless the caller overrides it.
     if not any(o.startswith("updates_per_call=") for o in overrides):
         # Sweep on the live chip (BENCH_HISTORY 2026-07-31): K=32 -> 14.8M,
-        # K=64 -> 20.8M, K=128 -> 24.2M fps on pong_impala; deeper fusion
-        # keeps paying on this link, so headline at the measured peak.
-        cfg = cfg.replace(updates_per_call=128)
+        # K=64 -> 20.8M, K=128 -> 24.2M, K=256 -> 26.6M, K=512 -> 27.3M
+        # fps on pong_impala — the dispatch-amortization curve plateaus
+        # by K=512, so the headline sits at the measured peak.
+        cfg = cfg.replace(updates_per_call=512)
     cfg = override(cfg, overrides)
     if cfg.backend != "tpu":
         # Checked on the EFFECTIVE config (preset + overrides): this

@@ -29,7 +29,10 @@ DEFAULT_PRESETS = [
     "cartpole_impala",
     "cartpole_qlearn",
     "pong_impala",
-    "atari_impala",
+    # The full 1024-env pixel geometry needs the r3 memory fit: the naive
+    # backward's conv activations want 21.3G on a 15.75G v5e (measured
+    # OOM 2026-07-31); env-chunked grad accumulation + block remat fit it.
+    "atari_impala+fit",
     "procgen_ppo",
     "halfcheetah_ppo",
     "brax_ant_ppo",
@@ -50,6 +53,8 @@ DEFAULT_PRESETS = [
 # "<preset>+popN" runs an N-member population of the preset.
 VARIANTS = {
     "+server": ["inference_server=true"],
+    # Memory fit for the full-geometry pixel preset (see DEFAULT_PRESETS).
+    "+fit": ["grad_accum=4", "remat=true"],
 }
 
 
@@ -227,17 +232,22 @@ def main() -> int:
     args = sys.argv[1:]
     overrides = [a for a in args if "=" in a]
     names = [a for a in args if "=" not in a] or DEFAULT_PRESETS
+    failed = 0
     for name in names:
         try:
             print(json.dumps(bench_one(name, overrides)), flush=True)
         except Exception as e:
+            failed += 1
             print(
                 json.dumps(
                     {"preset": name, "error": f"{type(e).__name__}: {e}"}
                 ),
                 flush=True,
             )
-    return 0
+    # Nonzero on any failed row: a caller stamping this run as complete
+    # (tpu_window.sh) must not record success for rows that never landed
+    # in the ledger.
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":
