@@ -123,24 +123,41 @@ while true; do
   # North star: wall-clock to 18.0 on the real chip (BASELINE.json:2).
   # Resumable across windows; stops re-firing once a non-CPU reached=true
   # entry lands. step_cost per scripts/pong_diagnose.py's offense finding.
-  if ! target_reached; then
+  if ! target_reached && [ ! -e "$STAMPS/t2t.permfail" ]; then
     echo "=== $(date -u +%FT%TZ) [t2t] run_to_target session"
-    # Finishing recipe (2026-07-31): the 0.002-entropy/6e-4-lr phase
-    # plateaued flat at eval ~14.6 for 2B+ steps (tpu_window2.log, t=250
-    # to t=729). Resume tune-and-continue: drop lr 4x and the entropy
-    # floor 5x to let the policy sharpen its endgame (the diagnose
-    # artifact says the gap is offense) — checkpoint metadata records the
-    # drift, run_to_target's clock keeps accumulating.
+    # Scoring-rate recipe (2026-07-31, pong_diagnose on runs/pong18_tpu @
+    # 2.2M updates): defense is PERFECT (0.5 conceded/game) but every game
+    # truncates at MAX_STEPS=3000 with only 16.3 points scored
+    # (~184 steps/point) — the 18.0 bar is purely points-per-step. Double
+    # the step cost (a 184-step point nets ~+0.08 at 0.005: the speed
+    # pressure had flattened out) and drop the entropy floor to sharpen
+    # shot selection; lr stays at the tuned 1.5e-4.
+    # gamma 0.99 -> 0.995: a winner usually needs 2-3 crossings of setup
+    # (~100 steps); 0.99^100 = 0.37 starves the setup shot of credit,
+    # 0.995^100 = 0.61 feeds it.
     timeout -k 10 900 python scripts/run_to_target.py pong_impala \
-      --target 18.0 --budget-seconds 7200 \
-      step_cost=0.005 checkpoint_dir=runs/pong18_tpu checkpoint_every=50 \
+      --target 18.0 --budget-seconds 10800 \
+      step_cost=0.01 gamma=0.995 \
+      checkpoint_dir=runs/pong18_tpu checkpoint_every=50 \
       eval_every=40 eval_episodes=32 updates_per_call=32 \
       learning_rate=1.5e-4 \
-      entropy_coef_final=0.0004 entropy_anneal_steps=30000 \
+      entropy_coef_final=0.0001 entropy_anneal_steps=30000 \
       total_env_steps=20000000000
     echo "=== rc=$? [t2t]"
     commit_ledger
     target_reached && touch "$STAMPS/t2t"
+    # Budget-exhausted settle: once the sidecar's accumulated clock
+    # passes --budget-seconds, further sessions would each burn a
+    # bring-up+compile only to immediately record ANOTHER reached=false
+    # row — retire the job instead of hot-spinning junk ledger commits.
+    python - <<'EOF' && touch "$STAMPS/t2t.permfail"
+import json, sys
+try:
+    prior = json.load(open("runs/pong18_tpu/run_to_target_elapsed.json"))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if prior.get("seconds", 0) >= 10800 else 1)
+EOF
   fi
 
   # Host-path rows last (long; lowest marginal value — CPU rows exist).
@@ -155,7 +172,7 @@ while true; do
   commit_ledger
 
   if settled pixel_bench && settled roofline_pong \
-     && settled roofline_atari && [ -e "$STAMPS/t2t" ] \
+     && settled roofline_atari && settled t2t \
      && settled pallas_validate && settled pixel_bench_1024 \
      && settled bench_matrix && settled selfplay_exp; then
     echo "--- $(date -u +%FT%TZ) queue complete"
