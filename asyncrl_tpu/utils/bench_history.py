@@ -10,7 +10,13 @@ Record schema (one JSON object per entry, newest last):
 
     {
       "ts": "2026-07-30T12:34:56Z",     # UTC capture time
-      "kind": "throughput" | "time_to_target" | "roofline",
+      "kind": "throughput" | "time_to_target" | "roofline"
+              | "kernel_validation"   # real-chip kernel gate (validate_pallas_tpu)
+              | "experiment"          # A/B arms (e.g. selfplay_vs_direct)
+              | "diagnosis",          # checkpoint play analysis (pong_diagnose;
+                                      # carries analysis_platform, not device
+                                      # fields — the analysis host is not the
+                                      # training hardware)
       "preset": "pong_impala",
       "platform": "tpu" | "cpu",
       "device_kind": "TPU v5 lite",

@@ -138,6 +138,26 @@ def main() -> int:
         "episode_len_mean": round(float(recs["alive"].sum(axis=1).mean()), 1),
     }
     print(json.dumps(out))
+    # Persist the diagnosis in the evidence trail: plateau-breaking recipe
+    # changes (e.g. the round-3 scoring-rate recipe in tpu_window.sh) cite
+    # these numbers, so the ledger should carry what was actually measured.
+    from asyncrl_tpu.utils import bench_history
+
+    try:
+        bench_history.record(
+            {
+                "kind": "diagnosis",
+                "name": "pong_points_decomposition",
+                "run_dir": run_dir,
+                # NOT device_entry(): this analysis tool pins the CPU
+                # backend, so those fields would mislabel a TPU-trained
+                # checkpoint's diagnosis as CPU evidence.
+                "analysis_platform": "cpu",
+                **out,
+            }
+        )
+    except OSError:
+        pass  # read-only checkout: the printed JSON is the result
     trainer.close()
     return 0
 
