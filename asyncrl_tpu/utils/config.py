@@ -217,10 +217,15 @@ class Config:
     # intermediates dominate HBM, a no-op-ish trade on MLPs.
     remat: bool = False
     # V-trace/GAE reverse-scan implementation (ops/scan.py). "auto"
-    # currently resolves to "associative" everywhere (see
-    # learn.learner.resolve_scan_impl — the Pallas VMEM kernel stays opt-in
-    # until validated on a real chip); force "pallas" to use the kernel on
-    # TPU, or "pallas_interpret" | "sequential" for debugging.
+    # resolves to "associative" everywhere. The Pallas VMEM kernel IS
+    # real-chip validated (scripts/validate_pallas_tpu.py on TPU v5 lite,
+    # 2026-07-31, BENCH_HISTORY kind=kernel_validation: accuracy on par
+    # with the associative tree against a float64 truth on all five preset
+    # geometries) — it stays OPT-IN because its measured win is only
+    # ~1.0-1.2x on a scan that is itself a small slice of the update, not
+    # worth a non-default codepath's risk by default. Force "pallas" to
+    # use it on TPU (long-T fragments benefit most), or
+    # "pallas_interpret" | "sequential" for debugging.
     scan_impl: str = "auto"
     # Donate the TrainState into the compiled step. Off by default: the
     # experimental axon PJRT plugin (the one real chip available here)
