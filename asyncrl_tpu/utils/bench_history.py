@@ -13,10 +13,12 @@ Record schema (one JSON object per entry, newest last):
       "kind": "throughput" | "time_to_target" | "roofline"
               | "kernel_validation"   # real-chip kernel gate (validate_pallas_tpu)
               | "experiment"          # A/B arms (e.g. selfplay_vs_direct)
-              | "diagnosis",          # checkpoint play analysis (pong_diagnose;
+              | "diagnosis"           # checkpoint play analysis (pong_diagnose;
                                       # carries analysis_platform, not device
                                       # fields — the analysis host is not the
                                       # training hardware)
+              | "feasibility",        # target-reachability probe (pong_oracle;
+                                      # analysis_platform likewise)
       "preset": "pong_impala",
       "platform": "tpu" | "cpu",
       "device_kind": "TPU v5 lite",

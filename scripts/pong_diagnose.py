@@ -156,8 +156,8 @@ def main() -> int:
                 **out,
             }
         )
-    except OSError:
-        pass  # read-only checkout: the printed JSON is the result
+    except OSError as e:
+        print(f"bench_history: could not persist: {e}", file=sys.stderr)
     trainer.close()
     return 0
 
