@@ -125,24 +125,12 @@ while true; do
   # entry lands. step_cost per scripts/pong_diagnose.py's offense finding.
   if ! target_reached && [ ! -e "$STAMPS/t2t.permfail" ]; then
     echo "=== $(date -u +%FT%TZ) [t2t] run_to_target session"
-    # Scoring-rate recipe (2026-07-31, pong_diagnose on runs/pong18_tpu @
-    # 2.2M updates): defense is PERFECT (0.5 conceded/game) but every game
-    # truncates at MAX_STEPS=3000 with only 16.3 points scored
-    # (~184 steps/point) — the 18.0 bar is purely points-per-step. Double
-    # the step cost (a 184-step point nets ~+0.08 at 0.005: the speed
-    # pressure had flattened out) and drop the entropy floor to sharpen
-    # shot selection; lr stays at the tuned 1.5e-4.
-    # gamma 0.99 -> 0.995: a winner usually needs 2-3 crossings of setup
-    # (~100 steps); 0.99^100 = 0.37 starves the setup shot of credit,
-    # 0.995^100 = 0.61 feeds it.
-    timeout -k 10 900 python scripts/run_to_target.py pong_impala \
+    # Recipe = the committed pong_t2t preset (configs/presets.py, where
+    # the scoring-rate rationale lives; derived from the ledger's
+    # kind=diagnosis truncation finding). Only run-dir plumbing here.
+    timeout -k 10 900 python scripts/run_to_target.py pong_t2t \
       --target 18.0 --budget-seconds 10800 \
-      step_cost=0.01 gamma=0.995 \
-      checkpoint_dir=runs/pong18_tpu checkpoint_every=50 \
-      eval_every=40 eval_episodes=32 updates_per_call=32 \
-      learning_rate=1.5e-4 \
-      entropy_coef_final=0.0001 entropy_anneal_steps=30000 \
-      total_env_steps=20000000000
+      checkpoint_dir=runs/pong18_tpu checkpoint_every=50
     echo "=== rc=$? [t2t]"
     commit_ledger
     target_reached && touch "$STAMPS/t2t"
