@@ -16,11 +16,14 @@ export BENCH_NO_WAIT=1
 
 for i in $(seq 1 "${MAX_SESSIONS:-12}"); do
   echo "=== $(date -u +%FT%TZ) cpu t2t session $i ($DIR)"
+  # Same committed pong_t2t recipe as the TPU arm (configs/presets.py) so
+  # the two arms stay comparable; only dispatch fusing differs (K=8: at
+  # CPU speeds a K=32 call would outlive the metric window).
   timeout -k 10 "${SESSION_SECONDS:-3600}" \
-    python scripts/run_to_target.py pong_impala \
+    python scripts/run_to_target.py pong_t2t \
       --target 18.0 --budget-seconds "${BUDGET_SECONDS:-14400}" \
-      step_cost=0.005 checkpoint_dir="$DIR" checkpoint_every=50 \
-      eval_every=40 updates_per_call=8 total_env_steps=2000000000 "$@"
+      checkpoint_dir="$DIR" checkpoint_every=50 \
+      updates_per_call=8 total_env_steps=2000000000 "$@"
   rc=$?
   echo "=== rc=$rc session $i"
   # rc 0 = the run recorded its ledger entry (reached or budget-exhausted):
