@@ -223,8 +223,12 @@ def main() -> None:
         # Sweep on the live chip (BENCH_HISTORY 2026-07-31): K=32 -> 14.8M,
         # K=64 -> 20.8M, K=128 -> 24.2M, K=256 -> 26.6M, K=512 -> 27.3M
         # fps on pong_impala — the dispatch-amortization curve plateaus
-        # by K=512, so the headline sits at the measured peak.
-        cfg = cfg.replace(updates_per_call=512)
+        # by K=512, so the headline sits at the measured peak. The CPU
+        # fallback keeps the historical K=8: one K=512 call is ~75 s of
+        # CPU work here, which would blow any caller's timeout before the
+        # first timed window completes.
+        on_cpu = jax.devices()[0].platform == "cpu"
+        cfg = cfg.replace(updates_per_call=8 if on_cpu else 512)
     cfg = override(cfg, overrides)
     if cfg.backend != "tpu":
         # Checked on the EFFECTIVE config (preset + overrides): this

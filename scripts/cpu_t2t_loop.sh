@@ -9,7 +9,10 @@
 #   nohup bash scripts/cpu_t2t_loop.sh [checkpoint_dir] [extra overrides...] &
 set -u
 cd "$(dirname "$0")/.."
-DIR=${1:-runs/pong18_cpu_sc}
+# Recipe-tagged default dir: resuming an OLD-recipe checkpoint dir would
+# silently credit its accumulated clock/optimizer state to the pong_t2t
+# label. Pass an explicit dir only to continue a same-recipe run.
+DIR=${1:-runs/pong18_cpu_t2t}
 shift || true
 export ASYNCRL_FORCE_CPU=1
 export BENCH_NO_WAIT=1
@@ -26,9 +29,11 @@ for i in $(seq 1 "${MAX_SESSIONS:-12}"); do
       updates_per_call=8 total_env_steps=2000000000 "$@"
   rc=$?
   echo "=== rc=$rc session $i"
-  # rc 0 = the run recorded its ledger entry (reached or budget-exhausted):
-  # the measurement is COMPLETE either way — resuming a completed one is
-  # refused by run_to_target, so stop.
-  [ "$rc" -eq 0 ] && break
+  # Relaunch ONLY on a timeout-kill (the session clock expired mid-run:
+  # resume next session). Any other exit means the measurement is settled
+  # — rc=0 reached, rc=1 budget-exhausted reached=false, rc=3 refused
+  # (already complete) — and relaunching would append one duplicate
+  # reached=false ledger row per leftover session.
+  if [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then break; fi
   sleep 5
 done
