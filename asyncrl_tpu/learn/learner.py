@@ -13,7 +13,6 @@ a pytree select every ``actor_staleness`` updates, staying entirely in HBM
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax
@@ -36,7 +35,7 @@ from asyncrl_tpu.ops.losses import (
     ppo_loss,
     qlearn_loss,
 )
-from asyncrl_tpu.parallel.mesh import DP_AXIS, dp_axes, dp_size
+from asyncrl_tpu.parallel.mesh import dp_axes, dp_size
 from asyncrl_tpu.rollout.anakin import ActorState, actor_init, unroll
 from asyncrl_tpu.rollout.buffer import Rollout
 from asyncrl_tpu.utils.config import Config

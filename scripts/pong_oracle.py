@@ -50,7 +50,6 @@ from asyncrl_tpu.envs.pong import (
     PADDLE_HALF,
     PREDICTIVE_SPEED,
     Pong,
-    predict_intercept,
 )
 
 SIM_STEPS = 80  # > two court crossings at |vx| = 0.03 over 0.9 width
