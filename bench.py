@@ -187,14 +187,38 @@ def cpu_fallback_or_refuse(jax, tool: str = "bench") -> bool:
     return True
 
 
+def resolve_bench_config(preset_name: str, overrides: list[str], on_cpu: bool):
+    """Effective config for one headline measurement (unit-tested: this is
+    the driver-run entry point's decision logic).
+
+    - cartpole geometry widens to saturate a chip;
+    - the fused-dispatch default: one tunnel round trip costs ~8 ms here,
+      capping an unfused loop at ~1M fps regardless of chip speed, so the
+      bench fuses K updates per jitted call (updates_per_call — identical
+      training semantics). The accelerator default sits at the measured
+      plateau of the live-chip sweep (BENCH_HISTORY 2026-07-31: K=32 ->
+      14.8M, K=64 -> 20.8M, K=128 -> 24.2M, K=256 -> 26.6M, K=512 -> 27.3M
+      fps on pong_impala); the CPU fallback keeps K=8 — one K=512 call is
+      ~75 s of CPU work, which blows any caller's timeout before the first
+      timed window completes. Explicit overrides always win.
+    """
+    from asyncrl_tpu.configs import presets
+    from asyncrl_tpu.utils.config import override
+
+    cfg = presets.get(preset_name)
+    if preset_name == "cartpole_impala":
+        cfg = cfg.replace(num_envs=8192)
+    if not any(o.startswith("updates_per_call=") for o in overrides):
+        cfg = cfg.replace(updates_per_call=8 if on_cpu else 512)
+    return override(cfg, overrides)
+
+
 def main() -> None:
     import jax
 
     cpu_fallback_or_refuse(jax, "bench")
     from asyncrl_tpu.api.trainer import Trainer
-    from asyncrl_tpu.configs import presets
     from asyncrl_tpu.envs import registered
-    from asyncrl_tpu.utils.config import override
 
     args = sys.argv[1:]
     preset_name = None
@@ -209,27 +233,9 @@ def main() -> None:
             "pong_impala" if "JaxPong-v0" in registered() else "cartpole_impala"
         )
 
-    cfg = presets.get(preset_name)
-    # Benchmark geometry: large env batch to saturate the chip.
-    if preset_name == "cartpole_impala":
-        cfg = cfg.replace(num_envs=8192)
-    # Dispatch amortization: one tunnel round trip costs ~8ms here, which
-    # caps an unfused loop at ~1M fps regardless of chip speed. Fusing K
-    # updates per jitted call (updates_per_call, a first-class Config
-    # feature — identical training semantics, K sequential updates) is how
-    # this framework actually runs on high-latency links, so the bench
-    # defaults to the measured sweet spot unless the caller overrides it.
-    if not any(o.startswith("updates_per_call=") for o in overrides):
-        # Sweep on the live chip (BENCH_HISTORY 2026-07-31): K=32 -> 14.8M,
-        # K=64 -> 20.8M, K=128 -> 24.2M, K=256 -> 26.6M, K=512 -> 27.3M
-        # fps on pong_impala — the dispatch-amortization curve plateaus
-        # by K=512, so the headline sits at the measured peak. The CPU
-        # fallback keeps the historical K=8: one K=512 call is ~75 s of
-        # CPU work here, which would blow any caller's timeout before the
-        # first timed window completes.
-        on_cpu = jax.devices()[0].platform == "cpu"
-        cfg = cfg.replace(updates_per_call=8 if on_cpu else 512)
-    cfg = override(cfg, overrides)
+    cfg = resolve_bench_config(
+        preset_name, overrides, jax.devices()[0].platform == "cpu"
+    )
     if cfg.backend != "tpu":
         # Checked on the EFFECTIVE config (preset + overrides): this
         # harness times the Anakin learner's bare update loop; a

@@ -147,3 +147,26 @@ def test_atomic_write_leaves_no_tmp_droppings(tmp_path):
             {"kind": "throughput", "platform": "tpu", "i": i}, path=path
         )
     assert sorted(os.listdir(tmp_path)) == ["hist.json"]
+
+
+def test_resolve_bench_config_platform_aware_fusion():
+    """The headline's fused-dispatch default: measured plateau (K=512) on
+    an accelerator, K=8 on the CPU fallback (a K=512 CPU call outlives any
+    caller timeout), explicit overrides always win."""
+    import bench
+
+    assert bench.resolve_bench_config(
+        "pong_impala", [], on_cpu=False
+    ).updates_per_call == 512
+    assert bench.resolve_bench_config(
+        "pong_impala", [], on_cpu=True
+    ).updates_per_call == 8
+    assert bench.resolve_bench_config(
+        "pong_impala", ["updates_per_call=64"], on_cpu=True
+    ).updates_per_call == 64
+    # cartpole widens its env batch to saturate a chip; other overrides
+    # still apply on top.
+    cfg = bench.resolve_bench_config(
+        "cartpole_impala", ["unroll_len=16"], on_cpu=False
+    )
+    assert cfg.num_envs == 8192 and cfg.unroll_len == 16
