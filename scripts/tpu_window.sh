@@ -124,27 +124,44 @@ while true; do
   # Resumable across windows; stops re-firing once a non-CPU reached=true
   # entry lands. step_cost per scripts/pong_diagnose.py's offense finding.
   if ! target_reached && [ ! -e "$STAMPS/t2t.permfail" ]; then
-    echo "=== $(date -u +%FT%TZ) [t2t] run_to_target session"
-    # Recipe = the committed pong_t2t preset (configs/presets.py, where
-    # the scoring-rate rationale lives; derived from the ledger's
-    # kind=diagnosis truncation finding). Only run-dir plumbing here.
+    # Two arms, alternating one 900s session each; first to 18.0 wins.
+    # (a) runs/pong18_tpu — the accumulated checkpoint, tune-and-continue:
+    #     tests whether the conservative-long-rally basin (learned under
+    #     weak speed pressure) can be escaped in place.
+    # (b) runs/pong18_tpu_fresh — the full pong_t2t recipe from step ONE:
+    #     shaping present during early policy formation, which a resumed
+    #     arm can never retrofit.
+    # Recipe = the committed pong_t2t preset in both cases.
+    if [ -e "$STAMPS/t2t_arm_toggle" ]; then
+      ARM_DIR=runs/pong18_tpu_fresh; rm -f "$STAMPS/t2t_arm_toggle"
+    else
+      ARM_DIR=runs/pong18_tpu; touch "$STAMPS/t2t_arm_toggle"
+    fi
+    echo "=== $(date -u +%FT%TZ) [t2t] run_to_target session (arm $ARM_DIR)"
     timeout -k 10 900 python scripts/run_to_target.py pong_t2t \
       --target 18.0 --budget-seconds 10800 \
-      checkpoint_dir=runs/pong18_tpu checkpoint_every=50
+      checkpoint_dir="$ARM_DIR" checkpoint_every=50
     echo "=== rc=$? [t2t]"
     commit_ledger
     target_reached && touch "$STAMPS/t2t"
-    # Budget-exhausted settle: once the sidecar's accumulated clock
-    # passes --budget-seconds, further sessions would each burn a
-    # bring-up+compile only to immediately record ANOTHER reached=false
-    # row — retire the job instead of hot-spinning junk ledger commits.
+    # Budget-exhausted settle: retire the job only when BOTH arms'
+    # accumulated clocks pass the budget — else each further session
+    # burns a bring-up+compile to immediately append ANOTHER
+    # reached=false row.
     python - <<'EOF' && touch "$STAMPS/t2t.permfail"
 import json, sys
-try:
-    prior = json.load(open("runs/pong18_tpu/run_to_target_elapsed.json"))
-except Exception:
-    sys.exit(1)
-sys.exit(0 if prior.get("seconds", 0) >= 10800 else 1)
+def secs(d):
+    try:
+        return json.load(
+            open(f"{d}/run_to_target_elapsed.json")
+        ).get("seconds", 0)
+    except Exception:
+        return 0
+done = all(
+    secs(d) >= 10800
+    for d in ("runs/pong18_tpu", "runs/pong18_tpu_fresh")
+)
+sys.exit(0 if done else 1)
 EOF
   fi
 
