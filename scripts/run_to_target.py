@@ -180,6 +180,17 @@ def main() -> int:
         if ev is not None:
             line["eval_return"] = round(ev, 2)
         print(json.dumps(line), file=sys.stderr, flush=True)
+        # Learning curve persisted WITH the run, not only in the (tmp-
+        # resident, reboot-mortal) supervisor log: the committed run dir
+        # then carries the eval trajectory across sessions as evidence.
+        if cfg.checkpoint_dir:
+            try:
+                with open(
+                    os.path.join(cfg.checkpoint_dir, "metrics.jsonl"), "a"
+                ) as f:
+                    f.write(json.dumps(line) + "\n")
+            except OSError:
+                pass  # read-only volume: stderr already has the line
         # Persist accumulated wall time on every drain, not just at exit: a
         # SIGKILL'd session's checkpointed training progress survives, so
         # its wall time must survive too (else a later session records an
