@@ -342,3 +342,20 @@ def test_selfplay_population_member_matches_standalone(devices):
             _params_of(pop.member_params(i)), _params_of(state.params)
         ):
             np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_selfplay_population_with_ale_knobs():
+    """Triple composition: population x selfplay x frame_skip/sticky — the
+    duel protocol forwards through the wrappers inside the vmapped member
+    step (each member's rival and each paddle's stick stay independent)."""
+    cfg = Config(
+        env_id="JaxPongDuel-v0", algo="impala", selfplay=True,
+        selfplay_refresh=2, frame_skip=2, sticky_actions=0.25,
+        num_envs=8, unroll_len=8, precision="f32",
+        torso="mlp", hidden_sizes=(16,), seed=5,
+    )
+    pop = PopulationTrainer(cfg, pop_size=2)
+    for _ in range(3):
+        m = pop.update()
+    assert np.all(np.isfinite(np.asarray(m["loss"])))
+    assert np.all(np.asarray(pop.state.update_step) == 3)
