@@ -40,7 +40,7 @@ from asyncrl_tpu.rollout.sebulba import (
     make_host_pool,
     make_inference_fn,
 )
-from asyncrl_tpu.utils.config import Config
+from asyncrl_tpu.utils.config import Config, default_eval_max_steps
 
 
 def _stack_fragments(rollouts):
@@ -512,13 +512,20 @@ class SebulbaTrainer:
     # ----------------------------------------------------------------- eval
 
     def evaluate(
-        self, num_episodes: int = 32, max_steps: int = 3200, seed: int = 1234
+        self,
+        num_episodes: int = 32,
+        max_steps: int | None = None,
+        seed: int = 1234,
     ) -> float:
         """Mean greedy-policy return over ``num_episodes`` fresh host envs.
 
         Each env counts only its FIRST completed episode (pools auto-reset;
         ``pool.reset()`` below starts the fresh episodes).
         """
+        if max_steps is None:
+            # Contain the longest builtin episode (same contract as
+            # Trainer.evaluate — shared helper).
+            max_steps = default_eval_max_steps(self.config)
         # Eval pools are cached per (num_episodes, seed) for the trainer's
         # lifetime: in-training evals would otherwise rebuild the pool —
         # and, for JaxHostPool, re-jit its env step — every eval period.

@@ -26,7 +26,7 @@ from asyncrl_tpu.learn.learner import (
 from asyncrl_tpu.models.networks import build_model, is_recurrent, reset_core
 from asyncrl_tpu.ops.normalize import normalizing_apply
 from asyncrl_tpu.parallel.mesh import make_mesh
-from asyncrl_tpu.utils.config import Config
+from asyncrl_tpu.utils.config import Config, default_eval_max_steps
 
 
 def make_eval_rollout(config, env, model, num_episodes: int, max_steps: int):
@@ -221,17 +221,18 @@ class Trainer:
     def evaluate(
         self,
         num_episodes: int = 32,
-        max_steps: int = 3200,
+        max_steps: int | None = None,
         seed: int = 1234,
         return_episodes: bool = False,
     ):
-        # Default max_steps must contain the longest builtin episode: a full
-        # first-to-21 JaxPong game can run to its 3000-step truncation limit;
-        # CartPole truncates at 500. Pass a smaller value for quick checks.
         """Mean greedy-policy episode return over ``num_episodes`` fresh envs,
         fully on device (one jitted scan). ``return_episodes=True`` returns
         the per-episode return vector instead of the mean (same single
         batched rollout either way)."""
+        # Default horizon: contain the longest builtin episode (shared
+        # helper; pass a smaller value explicitly for quick checks).
+        if max_steps is None:
+            max_steps = default_eval_max_steps(self.config)
         cache_key = (num_episodes, max_steps)
         if cache_key not in self._eval_fns:
             self._eval_fns[cache_key] = jax.jit(

@@ -40,7 +40,14 @@ BALL_VX = 0.03  # horizontal ball speed (constant magnitude)
 MAX_SPIN = 0.04  # max |vy| imparted by an off-center hit
 SERVE_VY = 0.02  # max |vy| on serve
 WIN_SCORE = 21
-MAX_STEPS = 3000  # truncation safety net (~8 rallies/player minimum)
+MAX_STEPS = 3000  # default truncation cap (~8 rallies/player minimum)
+# ALE-faithful cap: PongNoFrameskip-v4 truncates at 108,000 emulator frames
+# = 27,000 skip-4 agent decisions. Our default cap (3000) is ~9x TIGHTER
+# than the reference semantics — a deliberate, strictly-harder choice: it
+# forces the 18.0 bar to be met at a scoring RATE (~160 steps/point), not
+# by letting long games run to 21. Config.pong_max_steps selects the cap;
+# scripts/eval_caps.py records eval numbers under BOTH.
+ALE_MAX_STEPS = 27_000
 
 NUM_ACTIONS = 6  # ALE Pong action set
 FRAME = 84  # pixel variant resolution
@@ -139,7 +146,10 @@ class Pong(Environment):
     spec = EnvSpec(obs_shape=(6,), num_actions=NUM_ACTIONS)
 
     def __init__(
-        self, opponent: str = "tracker", opponent_speed: float = 0.0
+        self,
+        opponent: str = "tracker",
+        opponent_speed: float = 0.0,
+        max_steps: int = MAX_STEPS,
     ):
         if opponent not in ("tracker", "predictive"):
             raise ValueError(
@@ -150,6 +160,7 @@ class Pong(Environment):
         self._opp_speed = opponent_speed or (
             OPP_SPEED if opponent == "tracker" else PREDICTIVE_SPEED
         )
+        self._max_steps = max_steps
 
     def init(self, key: jax.Array) -> PongState:
         serve_key, side_key = jax.random.split(key)
@@ -258,7 +269,7 @@ class Pong(Environment):
 
         t = state.t + 1
         terminated = (score[0] >= WIN_SCORE) | (score[1] >= WIN_SCORE)
-        truncated = (t >= MAX_STEPS) & ~terminated
+        truncated = (t >= self._max_steps) & ~terminated
         done = terminated | truncated
 
         ended = PongState(ball=ball, agent_y=agent_y, opp_y=opp_y, score=score, t=t)
@@ -317,12 +328,18 @@ class PongPixels(FrameStackPixels):
         self,
         opponent: str = "tracker",
         opponent_speed: float = 0.0,
+        max_steps: int = MAX_STEPS,
         frame_skip: int = 1,
         frame_pool: bool = False,
         sticky_actions: float = 0.0,
     ):
+        # max_steps counts AGENT DECISIONS (the Config.pong_max_steps
+        # contract); the inner Pong's clock ticks once per CORE step, and
+        # frame_skip plays each decision frame_skip core steps — so the
+        # inner cap scales up, keeping 27,000 decisions x skip-4 =
+        # 108,000 raw frames, exactly ALE's max_num_frames_per_episode.
         super().__init__(
-            Pong(opponent, opponent_speed),
+            Pong(opponent, opponent_speed, max_steps * max(frame_skip, 1)),
             render_state=render,
             render_last_obs=lambda lo: render_positions(
                 lo[0], lo[1], lo[4], lo[5]

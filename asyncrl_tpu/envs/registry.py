@@ -64,6 +64,7 @@ def _register_builtins() -> None:
         return {
             "opponent": cfg.pong_opponent,
             "opponent_speed": cfg.pong_opponent_speed,
+            "max_steps": cfg.pong_max_steps,
         }
 
     def pixel_kwargs(cfg):

@@ -151,6 +151,13 @@ class Config:
     # constant-spin exploit. Speed 0.0 = the mode's tuned default.
     pong_opponent: str = "tracker"
     pong_opponent_speed: float = 0.0
+    # JaxPong episode truncation cap, in agent steps. Default 3000 is ~9x
+    # TIGHTER than ALE's PongNoFrameskip-v4 semantics (108,000 frames =
+    # 27,000 skip-4 decisions, envs/pong.py ALE_MAX_STEPS) — a deliberate,
+    # strictly-harder choice: the 18.0 target must be met at a scoring
+    # RATE, not by letting games run long. Set 27000 for ALE-faithful
+    # evaluation; scripts/eval_caps.py records numbers under both caps.
+    pong_max_steps: int = 3000
     # Self-play (Anakin backend, duel envs like JaxPongDuel-v0): the rival
     # paddle is driven by a FROZEN SNAPSHOT of the agent's own policy,
     # refreshed from the live params every selfplay_refresh updates — the
@@ -259,6 +266,19 @@ def _coerce(old: Any, raw: str) -> Any:
         elem = old[0] if old else raw
         return tuple(type(elem)(s.strip()) if old else s.strip() for s in items)
     return raw
+
+
+def default_eval_max_steps(config: Config) -> int:
+    """Eval-rollout horizon that contains the longest builtin episode for
+    ``config``'s env (shared by Trainer.evaluate and
+    SebulbaTrainer.evaluate — ONE copy, so a cap change cannot drift
+    between backends). JaxPong episodes run to Config.pong_max_steps
+    (27,000 under the ALE-faithful cap — a 3,200 horizon would silently
+    count partial returns); everything else builtin truncates well under
+    3,200 (CartPole 500)."""
+    if "JaxPong" in config.env_id:
+        return max(3200, config.pong_max_steps + 200)
+    return 3200
 
 
 def override(config: Config, kvs: Mapping[str, str] | list[str]) -> Config:

@@ -1,0 +1,112 @@
+"""Both-cap greedy evaluation of a trained Pong checkpoint (VERDICT round 3,
+Weak #4 / Next #1): the built-in JaxPong truncates episodes at 3,000 agent
+steps, while ALE's PongNoFrameskip-v4 allows 108,000 emulator frames =
+27,000 skip-4 decisions (envs/pong.py ALE_MAX_STEPS). The 18.0-bar hunt
+deliberately kept the tighter cap (scoring-RATE pressure, strictly harder);
+this script makes that choice measurable by evaluating the SAME checkpoint
+under both caps and appending one ``kind="eval_cap"`` ledger row per cap,
+with the cap in row metadata.
+
+    python scripts/eval_caps.py [preset] [--run-dir runs/pong18_tpu]
+        [--episodes 32] [key=value ...]
+
+The restore is read-only (``make_agent(restore=...)`` with an empty
+checkpoint_dir): nothing under --run-dir is modified, so the resumable
+time-to-target arm can keep accumulating in the same directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import cpu_fallback_or_refuse  # noqa: E402
+
+CAPS = (3000, 27_000)  # (repo default, ALE-faithful) — envs/pong.py
+
+
+def main() -> int:
+    import jax
+
+    preset_name = "pong_t2t"
+    run_dir = "runs/pong18_tpu"
+    episodes = 32
+    overrides = []
+    it = iter(sys.argv[1:])
+    for a in it:
+        if a == "--run-dir":
+            run_dir = next(it)
+        elif a == "--episodes":
+            episodes = int(next(it))
+        elif "=" in a:
+            overrides.append(a)
+        else:
+            preset_name = a
+
+    if not os.path.isdir(run_dir):
+        print(f"eval_caps: no run dir {run_dir!r}", file=sys.stderr)
+        return 2
+
+    # CPU is valid evidence here: greedy eval of a fixed policy measures the
+    # POLICY, not the hardware; rows carry platform fields either way.
+    cpu_fallback_or_refuse(jax, "eval_caps")
+
+    from asyncrl_tpu.api.factory import make_agent
+    from asyncrl_tpu.configs import presets
+    from asyncrl_tpu.utils import bench_history
+    from asyncrl_tpu.utils.config import override
+
+    dev = bench_history.device_entry()
+    for cap in CAPS:
+        cfg = presets.get(preset_name).replace(
+            pong_max_steps=cap,
+            checkpoint_dir="",  # read-only restore; never write to run_dir
+            checkpoint_best=False,
+        )
+        cfg = override(cfg, overrides)
+        if cfg.backend != "tpu":
+            # SebulbaTrainer.evaluate has no return_episodes path; this
+            # script's per-episode stats need the Anakin eval rollout.
+            print(
+                f"eval_caps: preset {preset_name!r} uses backend="
+                f"{cfg.backend!r}; only Anakin (tpu) presets are supported",
+                file=sys.stderr,
+            )
+            return 2
+        trainer = make_agent(cfg, restore=run_dir)
+        try:
+            returns = trainer.evaluate(
+                num_episodes=episodes,
+                # Contain a full game under this cap (cap + serve slack).
+                max_steps=cap + 200,
+                return_episodes=True,
+            )
+        finally:
+            trainer.close()
+        returns = np.asarray(returns, np.float64)
+        entry = bench_history.record(
+            {
+                "kind": "eval_cap",
+                "preset": preset_name,
+                **dev,
+                "run_dir": run_dir,
+                "pong_max_steps": cap,
+                "ale_faithful_cap": cap >= 27_000,
+                "episodes": int(returns.size),
+                "eval_return": round(float(returns.mean()), 3),
+                "eval_return_std": round(float(returns.std()), 3),
+                "eval_return_min": round(float(returns.min()), 3),
+                "eval_return_max": round(float(returns.max()), 3),
+                "frac_ge_18": round(float((returns >= 18.0).mean()), 3),
+            }
+        )
+        print(json.dumps(entry))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -226,6 +226,14 @@ def main() -> int:
         "num_envs": cfg.num_envs,
         "unroll_len": cfg.unroll_len,
         "updates_per_call": cfg.updates_per_call,
+        # The episode-cap bar this target was measured under (VERDICT r3
+        # Weak #4): 3000 = the repo's scoring-rate bar, 27000 =
+        # ALE-faithful win-margin semantics.
+        **(
+            {"pong_max_steps": cfg.pong_max_steps}
+            if "JaxPong" in cfg.env_id
+            else {}
+        ),
         # Consistent with "seconds": averaged over ALL accumulated sessions
         # (window-fps mean, weights carried through the sidecar).
         "mean_fps": round(
