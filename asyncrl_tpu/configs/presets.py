@@ -216,6 +216,10 @@ pendulum_native_ppo = Config(
 # Self-play ladder (Config.selfplay): the rival paddle is a frozen snapshot
 # of the agent itself, promoted every selfplay_refresh updates; greedy eval
 # still measures vs the calibrated scripted tracker (the 18.0-bar metric).
+# EXPERIMENTAL — measured NET-NEGATIVE for the flagship 18.0 metric at a
+# matched budget (BENCH_HISTORY selfplay_vs_direct: ladder 2.0 vs direct
+# 11.5 at 400M frames). Do not use for time-to-target work; see
+# docs/ARCHITECTURE.md "Self-play" for the descope decision.
 pong_selfplay = pong_impala.replace(
     env_id="JaxPongDuel-v0",
     selfplay=True,

@@ -333,13 +333,12 @@ class PongPixels(FrameStackPixels):
         frame_pool: bool = False,
         sticky_actions: float = 0.0,
     ):
-        # max_steps counts AGENT DECISIONS (the Config.pong_max_steps
-        # contract); the inner Pong's clock ticks once per CORE step, and
-        # frame_skip plays each decision frame_skip core steps — so the
-        # inner cap scales up, keeping 27,000 decisions x skip-4 =
-        # 108,000 raw frames, exactly ALE's max_num_frames_per_episode.
+        # max_steps counts CORE steps at this layer, like the vector
+        # Pong's (the decision-counted Config.pong_max_steps contract is
+        # applied ONCE, in registry.pong_kwargs, which pre-scales by
+        # frame_skip for all pong registrations alike).
         super().__init__(
-            Pong(opponent, opponent_speed, max_steps * max(frame_skip, 1)),
+            Pong(opponent, opponent_speed, max_steps),
             render_state=render,
             render_last_obs=lambda lo: render_positions(
                 lo[0], lo[1], lo[4], lo[5]

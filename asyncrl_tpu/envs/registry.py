@@ -64,7 +64,14 @@ def _register_builtins() -> None:
         return {
             "opponent": cfg.pong_opponent,
             "opponent_speed": cfg.pong_opponent_speed,
-            "max_steps": cfg.pong_max_steps,
+            # Config.pong_max_steps counts AGENT DECISIONS; the env-level
+            # cap counts core steps, and under frame_skip every decision
+            # plays skip core steps (FrameSkip wrapper on the vector/duel
+            # envs, frame_skip_scan inside the pixel env) — so the scale
+            # happens HERE, once, for all three pong registrations.
+            # 27,000 decisions x skip-4 = 108,000 core steps, exactly
+            # ALE's max_num_frames_per_episode.
+            "max_steps": cfg.pong_max_steps * max(cfg.frame_skip, 1),
         }
 
     def pixel_kwargs(cfg):

@@ -151,9 +151,11 @@ class Config:
     # constant-spin exploit. Speed 0.0 = the mode's tuned default.
     pong_opponent: str = "tracker"
     pong_opponent_speed: float = 0.0
-    # JaxPong episode truncation cap, in agent steps. Default 3000 is ~9x
-    # TIGHTER than ALE's PongNoFrameskip-v4 semantics (108,000 frames =
-    # 27,000 skip-4 decisions, envs/pong.py ALE_MAX_STEPS) — a deliberate,
+    # JaxPong episode truncation cap, in AGENT DECISIONS (the registry
+    # scales by frame_skip so the underlying core-step cap tracks ALE's
+    # raw-frame accounting). Default 3000 is ~9x TIGHTER than ALE's
+    # PongNoFrameskip-v4 semantics (108,000 frames = 27,000 skip-4
+    # decisions, envs/pong.py ALE_MAX_STEPS) — a deliberate,
     # strictly-harder choice: the 18.0 target must be met at a scoring
     # RATE, not by letting games run long. Set 27000 for ALE-faithful
     # evaluation; scripts/eval_caps.py records numbers under both caps.

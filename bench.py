@@ -372,10 +372,15 @@ def main() -> None:
             pixel = measure_preset(
                 PIXEL_FLAGSHIP_PRESET, list(PIXEL_FLAGSHIP_OVERRIDES)
             )
-        except SystemExit as e:
+        except (SystemExit, Exception) as e:  # noqa: BLE001 — any pixel
+            # failure (refusal exit, OOM, tunnel error mid-run) degrades
+            # the rider; it must never cost the vector headline.
+            detail = (
+                f"exit {e.code}" if isinstance(e, SystemExit) else repr(e)[:120]
+            )
             pixel = {
                 "metric": f"env_frames_per_sec ({PIXEL_FLAGSHIP_PRESET}) "
-                f"[measurement failed; exit {e.code}]",
+                f"[measurement failed; {detail}]",
                 "value": None,
                 "unit": "frames/sec",
             }

@@ -60,14 +60,26 @@ def main() -> int:
     from asyncrl_tpu.utils import bench_history
     from asyncrl_tpu.utils.config import override
 
+    if any(o.startswith("pong_max_steps=") for o in overrides):
+        # The script's whole contract is the fixed both-cap sweep; an
+        # override would run some third cap while the ledger rows still
+        # claim the loop's caps.
+        print(
+            "eval_caps: pong_max_steps is set by the sweep itself and "
+            "cannot be overridden",
+            file=sys.stderr,
+        )
+        return 2
+
     dev = bench_history.device_entry()
     for cap in CAPS:
-        cfg = presets.get(preset_name).replace(
+        # Overrides first, the sweep's own fields last — a user override
+        # must never displace the cap the row's metadata records.
+        cfg = override(presets.get(preset_name), overrides).replace(
             pong_max_steps=cap,
             checkpoint_dir="",  # read-only restore; never write to run_dir
             checkpoint_best=False,
         )
-        cfg = override(cfg, overrides)
         if cfg.backend != "tpu":
             # SebulbaTrainer.evaluate has no return_episodes path; this
             # script's per-episode stats need the Anakin eval rollout.

@@ -184,11 +184,13 @@ while true; do
     sleep 60
     continue
   fi
+  # Stamp key = UTC hour: unique across watcher restarts (a counter
+  # would reset and skip the per-window bench), and it ROLLS during a
+  # long stable window — so an hours-long window still gets an hourly
+  # fresh flagship pair, and a .fail1-deferred job's retry unblocks at
+  # the hour instead of waiting for a tunnel flap.
+  WINDOW="$(date -u +%Y%m%dT%H)"
   if [ "$PREV_UP" -eq 0 ]; then
-    # Stamp key is the window's OPEN TIME, not a counter: a restarted
-    # watcher resets a counter and would silently skip the fresh bench
-    # for every post-restart window.
-    WINDOW="$(date -u +%Y%m%dT%H%M)"
     echo "--- $(date -u +%FT%TZ) tunnel UP; window $WINDOW"
   fi
   PREV_UP=1

@@ -164,6 +164,44 @@ def test_pong_max_steps_configurable():
     )
     assert bool(ts.truncated) and not bool(ts.terminated)
 
+    # pong_max_steps counts DECISIONS: under frame_skip the registry
+    # scales the core-step cap so 27,000 skip-4 decisions = ALE's
+    # 108,000 raw frames, on the vector (FrameSkip-wrapped) and pixel
+    # (frame_skip_scan) paths alike.
+    for env_id in ("JaxPong-v0", "JaxPongPixels-v0"):
+        env = make(
+            env_id,
+            Config(env_id=env_id, frame_skip=4, pong_max_steps=27_000),
+        )
+        inner = env
+        while not hasattr(inner, "_max_steps"):
+            inner = inner._core if hasattr(inner, "_core") else inner._env
+        assert inner._max_steps == 108_000, env_id
+
+
+def test_default_eval_max_steps_tracks_cap():
+    """The eval-rollout horizon derives from the episode cap (one shared
+    helper for both trainer backends): a 27,000-cap Pong eval would
+    silently count partial returns under the old fixed 3,200 horizon."""
+    from asyncrl_tpu.utils.config import Config, default_eval_max_steps
+
+    assert default_eval_max_steps(Config(env_id="CartPole-v1")) == 3200
+    assert (
+        default_eval_max_steps(Config(env_id="JaxPong-v0")) == 3200
+    )  # default cap 3000 + 200 slack, floored at 3200
+    assert (
+        default_eval_max_steps(
+            Config(env_id="JaxPong-v0", pong_max_steps=27_000)
+        )
+        == 27_200
+    )
+    assert (
+        default_eval_max_steps(
+            Config(env_id="JaxPongPixels-v0", pong_max_steps=27_000)
+        )
+        == 27_200
+    )  # decision-counted on the pixel path too (env scales by skip)
+
 
 def test_pong_pixels_shapes_and_stack():
     env = PongPixels()
