@@ -50,6 +50,17 @@ pong_impala = Config(
 atari_impala = pong_impala.replace(
     env_id="JaxPongPixels-v0", num_envs=1024, torso="impala_cnn"
 )
+# Wide-channel variant (64/128/128 vs the parity 16/32/32): the IMPALA-CNN's
+# narrow output channels cap MXU lane utilization at ~22% (docs/MFU.md), so
+# per-chip pixel throughput at high MFU requires a wider torso. NOT a parity
+# config — it trains a bigger model — but the principled option when raw
+# pixel fps/chip is the goal rather than reference-equivalent training.
+# Geometry is pre-fit for one v5e: wide activations are ~4x narrow, so 256
+# envs + grad_accum microbatching + block remat lands at the footprint the
+# narrow 1024-env fit geometry measured (~15.7G of the v5e's HBM).
+atari_impala_wide = atari_impala.replace(
+    channels=(64, 128, 128), num_envs=256, grad_accum=4, remat=True
+)
 # Breakout's reward lands ~23 steps after the paddle hit that caused it and
 # returns run to 288/wall, so the learner sees scaled rewards (value loss
 # would otherwise dominate under grad clipping) and less entropy pressure.
@@ -271,6 +282,7 @@ PRESETS: dict[str, Config] = {
     "pong_t2t_ale": pong_t2t_ale,
     "pong_selfplay": pong_selfplay,
     "atari_impala": atari_impala,
+    "atari_impala_wide": atari_impala_wide,
     "breakout_impala": breakout_impala,
     "procgen_ppo": procgen_ppo,
     "brax_ppo": brax_ppo,

@@ -172,44 +172,69 @@ def main() -> int:
     # batch (2x/4x envs — the 4x needs the grad_accum+remat fit, matching
     # the 1024-env BASELINE geometry on chip) and a longer unroll (bigger
     # learner batch at the same per-step conv batch).
-    nv, ul = base.num_envs, base.unroll_len
+    # The watcher runs this under `timeout`, whose SIGTERM would normally
+    # kill the process without banking anything; convert it to SystemExit
+    # so the finally-block below records whatever rows completed.
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
+    nv = base.num_envs
     sweep = []
-    for label, variant in (
-        (f"{nv}envs", base),
-        (f"{2 * nv}envs", base.replace(num_envs=2 * nv)),
-        (f"{nv}envs_u{2 * ul}", base.replace(unroll_len=2 * ul)),
-        (
-            f"{4 * nv}envs_fit",
-            base.replace(num_envs=4 * nv, grad_accum=4, remat=True),
-        ),
-    ):
+    split = {"skipped": True}
+    try:
+        for label, variant in (
+            (f"{nv}envs", base),
+            (f"{2 * nv}envs", base.replace(num_envs=2 * nv)),
+            (
+                f"{4 * nv}envs_fit",
+                base.replace(num_envs=4 * nv, grad_accum=4, remat=True),
+            ),
+            # The MXU lane-utilization experiment (docs/MFU.md): channel
+            # widths 64/128/128 raise the conv N-dimension ceiling from
+            # ~22% to ~100% of the 128-wide array. If the analysis is
+            # right, this variant's MFU is ~4x the base at similar
+            # fps-per-FLOP — evidence that the base MFU is architecture-
+            # bound, not scheduling-bound. Wide activations are ~4x the
+            # narrow ones (same footprint as the narrow 4x-envs
+            # geometry), so it needs the same grad_accum+remat fit.
+            (
+                "wide_torso_fit",
+                base.replace(
+                    channels=(64, 128, 128), grad_accum=4, remat=True
+                ),
+            ),
+        ):
+            try:
+                row = measure(variant, preset_name)
+            except Exception as e:  # per-variant OOM must not kill the probe
+                sweep.append({"label": label, "error": str(e)[:300]})
+                continue
+            row["label"] = label
+            sweep.append(row)
+            print(json.dumps(row))
+
         try:
-            row = measure(variant, preset_name)
-        except Exception as e:  # OOM on a variant must not kill the probe
-            sweep.append({"label": label, "error": str(e)[:300]})
-            continue
-        row["label"] = label
-        sweep.append(row)
-        print(json.dumps(row))
-
-    try:
-        split = phase_split(base)
-        print(json.dumps(split))
-    except Exception as e:  # the sweep rows must get banked regardless
-        split = {"error": str(e)[:300]}
-        print(f"mfu_probe: phase split failed: {e}", file=sys.stderr)
-
-    entry = {
-        "kind": "mfu_probe",
-        "preset": preset_name,
-        **bench_history.device_entry(),
-        "sweep": sweep,
-        "phase_split_base": split,
-    }
-    try:
-        entry = bench_history.record(entry)
-    except OSError as e:
-        print(f"mfu_probe: could not persist: {e}", file=sys.stderr)
+            split = phase_split(base)
+            print(json.dumps(split))
+        except Exception as e:  # the sweep rows must get banked regardless
+            split = {"error": str(e)[:300]}
+            print(f"mfu_probe: phase split failed: {e}", file=sys.stderr)
+    finally:
+        # Bank whatever exists — a timeout/flap mid-probe loses only the
+        # in-flight variant, not the window's completed measurements.
+        if sweep:
+            entry = {
+                "kind": "mfu_probe",
+                "preset": preset_name,
+                **bench_history.device_entry(),
+                "sweep": sweep,
+                "phase_split_base": split,
+            }
+            try:
+                bench_history.record(entry)
+            except OSError as e:
+                print(f"mfu_probe: could not persist: {e}", file=sys.stderr)
     print(json.dumps({"ok": True, "rows": len(sweep)}))
     return 0
 

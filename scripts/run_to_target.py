@@ -83,7 +83,17 @@ def main() -> int:
         if cfg.checkpoint_dir
         else None
     )
-    prior = {"seconds": 0.0, "sessions": 0, "fps_sum": 0.0, "fps_n": 0}
+    prior = {
+        "seconds": 0.0,
+        "sessions": 0,
+        "fps_sum": 0.0,
+        "fps_n": 0,
+        # Which platforms contributed sessions (a checkpoint can resume
+        # across the tunnel boundary — TPU sessions then CPU ones). The
+        # wall-clock accumulation stays honest either way, but mean_fps
+        # blends platforms, so the entry must say so.
+        "platforms": [],
+    }
     # Prior time counts only when there is actually a checkpoint to resume
     # from — a stale sidecar next to deleted checkpoints must not credit a
     # fresh run with old wall time.
@@ -152,6 +162,9 @@ def main() -> int:
             "sessions": prior["sessions"] + 1,
             "fps_sum": prior["fps_sum"] + sum(fps_log),
             "fps_n": prior["fps_n"] + len(fps_log),
+            "platforms": sorted(
+                set(prior["platforms"]) | {dev["platform"]}
+            ),
         }
         if reached:
             payload["reached"] = True
@@ -243,6 +256,14 @@ def main() -> int:
     }
     if prior["sessions"]:
         entry["resumed_sessions"] = prior["sessions"]
+    session_platforms = sorted(set(prior["platforms"]) | {dev["platform"]})
+    if len(session_platforms) > 1:
+        # A cross-platform resume: seconds are wall-clock-honest, but the
+        # fps average blends device speeds — the row must carry the
+        # blend's provenance (the top-level platform field only names the
+        # FINAL session's device).
+        entry["platforms"] = session_platforms
+        entry["mean_fps_mixed_platforms"] = True
     if status["reached"]:
         # Mark the measurement finished. A rerun in this dir would resume
         # the already-trained checkpoint and "reach" the target in seconds

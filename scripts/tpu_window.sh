@@ -257,7 +257,9 @@ EOF
   # Pixel-path MFU probe (VERDICT r3 Next #2): dtype/layout/geometry
   # sweep + profile; gated on the script landing (added mid-round).
   if [ -e scripts/mfu_probe.py ]; then
-    run_job mfu_probe 1200 python scripts/mfu_probe.py || continue
+    # 5 variants x (compile + measure) incl. the wide-torso lane-
+    # utilization experiment — the pixel compiles are the cost.
+    run_job mfu_probe 1800 python scripts/mfu_probe.py || continue
     commit_ledger
   fi
   run_job pixel_bench 420 python bench.py atari_impala updates_per_call=8 num_envs=256 || continue
