@@ -49,6 +49,15 @@ _REPO_ROOT = os.path.dirname(
 HISTORY_PATH = os.path.join(_REPO_ROOT, "BENCH_HISTORY.json")
 
 
+def _default_path() -> str:
+    """Ledger path, resolved at CALL time: ASYNCRL_BENCH_HISTORY redirects
+    every read/write — for tests and for validation/smoke runs whose rows
+    must NOT enter the committed evidence trail (a smoke row in the real
+    ledger reads as a measurement). Read per call, not at import, so
+    setting the variable after an early `import bench` still redirects."""
+    return os.environ.get("ASYNCRL_BENCH_HISTORY") or HISTORY_PATH
+
+
 def _utc_now_iso() -> str:
     return (
         datetime.datetime.now(datetime.timezone.utc)
@@ -59,7 +68,7 @@ def _utc_now_iso() -> str:
 
 
 def load(path: str | None = None) -> list[dict]:
-    path = path or HISTORY_PATH
+    path = path or _default_path()
     try:
         with open(path) as f:
             entries = json.load(f)
@@ -72,7 +81,7 @@ def record(entry: dict, path: str | None = None) -> dict:
     """Append ``entry`` (stamped with UTC time and, unless the caller says
     otherwise, ``captured_by="harness"`` — this function runs inside the
     measuring process) to the history file."""
-    path = path or HISTORY_PATH
+    path = path or _default_path()
     stamped = {"ts": _utc_now_iso(), "captured_by": "harness", **entry}
     entries = load(path) + [stamped]
     fd, tmp = tempfile.mkstemp(

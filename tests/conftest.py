@@ -9,6 +9,14 @@ environment before the CPU backend is first initialized.
 """
 
 import os
+import sys
+
+# Repo root on sys.path: `import bench` (and other root-level entry
+# points) must resolve under plain `pytest` too, not only `python -m
+# pytest` from the root — same guard the scripts/ entry points carry.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,79 @@
+"""run_to_target's cross-session accounting (the time-to-target rows are
+the framework's north-star evidence — their provenance fields must not
+regress). Runs the real script in a subprocess against a throwaway ledger
+(ASYNCRL_BENCH_HISTORY) and checkpoint dir."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "run_to_target.py")
+
+
+def _run(tmp_path, ckpt_dir, budget="8"):
+    ledger = tmp_path / "ledger.json"
+    env = dict(
+        os.environ,
+        ASYNCRL_FORCE_CPU="1",
+        ASYNCRL_BENCH_HISTORY=str(ledger),
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            SCRIPT,
+            "cartpole_impala",
+            "--target",
+            "1000000",  # unreachable: we test accounting, not learning
+            "--budget-seconds",
+            budget,
+            f"checkpoint_dir={ckpt_dir}",
+            "checkpoint_every=5",
+            "num_envs=32",
+            "log_every=2",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd=REPO,
+    )
+    rows = json.loads(ledger.read_text()) if ledger.exists() else []
+    return proc, rows
+
+
+@pytest.mark.slow
+def test_cross_platform_resume_is_labeled(tmp_path):
+    ckpt = tmp_path / "arm"
+    proc, rows = _run(tmp_path, ckpt)
+    assert proc.returncode == 1, proc.stderr  # budget exhausted, not reached
+    (row,) = [r for r in rows if r["kind"] == "time_to_target"]
+    assert row["reached"] is False
+    assert "platforms" not in row  # single-platform run: no mixed flag
+
+    # Sidecar recorded this session's platform.
+    sidecar = json.loads(
+        (ckpt / "run_to_target_elapsed.json").read_text()
+    )
+    assert sidecar["platforms"] == ["cpu"]
+    assert sidecar["seconds"] > 0
+
+    # Simulate the arm's history having come from the chip: a resume on
+    # CPU must then label the blended stats.
+    sidecar["platforms"] = ["tpu"]
+    (ckpt / "run_to_target_elapsed.json").write_text(json.dumps(sidecar))
+    proc2, rows2 = _run(tmp_path, ckpt)
+    assert proc2.returncode == 1, proc2.stderr
+    row2 = [r for r in rows2 if r["kind"] == "time_to_target"][-1]
+    assert row2["platforms"] == ["cpu", "tpu"]
+    assert row2["mean_fps_mixed_platforms"] is True
+    assert row2["resumed_sessions"] == 1
+    sidecar2 = json.loads(
+        (ckpt / "run_to_target_elapsed.json").read_text()
+    )
+    assert sidecar2["platforms"] == ["cpu", "tpu"]
+    assert sidecar2["sessions"] == 2
