@@ -268,6 +268,16 @@ EOF
   run_job pallas_validate 420 python scripts/validate_pallas_tpu.py || continue
   # The reference's FULL 1024-envs/chip pixel geometry (BASELINE.json:9).
   run_job pixel_bench_1024 480 python bench.py atari_impala updates_per_call=8 grad_accum=4 remat=true || continue
+  # Vector-flagship env scaling: the 27.3M headline keeps the parity
+  # 256-env geometry; with mfu=0.0011 there, the chip has ~100x compute
+  # headroom — wider batches amortize the same per-call overhead over
+  # more frames. Via roofline.py (kind=roofline rows, with MFU): a
+  # kind=throughput row under the same preset would become the
+  # flagship's last_known_good despite the non-parity geometry.
+  run_job vec_envs1024 420 python scripts/roofline.py pong_impala updates_per_call=512 num_envs=1024 || continue
+  run_job vec_envs4096 420 python scripts/roofline.py pong_impala updates_per_call=512 num_envs=4096 || continue
+  # Wide-torso pixel preset: the committed fitted geometry end to end.
+  run_job pixel_wide 600 python bench.py atari_impala_wide updates_per_call=8 || continue
   commit_ledger
 
   # --- 5. Long, lower-marginal-value jobs last.
@@ -280,6 +290,8 @@ EOF
      && settled eval_caps_tpu && settled pixel_bench \
      && settled roofline_pong && settled roofline_atari \
      && settled pallas_validate && settled pixel_bench_1024 \
+     && settled vec_envs1024 && settled vec_envs4096 \
+     && settled pixel_wide \
      && settled bench_matrix && settled selfplay_exp \
      && { [ ! -e scripts/mfu_probe.py ] || settled mfu_probe; }; then
     echo "--- $(date -u +%FT%TZ) queue complete"

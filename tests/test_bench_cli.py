@@ -66,10 +66,12 @@ def test_driver_mode_cpu_attaches_pixel_lkg(monkeypatch, capsys, tmp_path):
     with a single 'not measured' label (no contradictory double label)
     and a null value."""
     import bench
-    from asyncrl_tpu.utils import bench_history
 
     ledger = _write_ledger(tmp_path, [TPU_PIXEL_ROW])
-    monkeypatch.setattr(bench_history, "HISTORY_PATH", ledger)
+    # The env var is the redirect mechanism and takes precedence over the
+    # module attribute — patch the var itself, or an operator with
+    # ASYNCRL_BENCH_HISTORY exported would have this test read theirs.
+    monkeypatch.setenv("ASYNCRL_BENCH_HISTORY", ledger)
     monkeypatch.setattr(bench, "cpu_fallback_or_refuse", lambda *a, **k: True)
 
     measured = []
