@@ -235,16 +235,21 @@ EOF
   run_job "bench_w$WINDOW" 900 python bench.py || continue
   commit_ledger
 
-  # --- 3. Strict-cap t2t (the harder scoring-rate bar; r3 arms).
+  # --- 3. Strict-cap t2t (the harder scoring-rate bar; r3 arms). The
+  # fresh arm trains the batch-scaled recipe (pong_t2t_1024: 4x frames
+  # per wall-second + shaping from step one); the resumed arm keeps its
+  # checkpoint's pong_t2t geometry.
   if ! target_reached 3000 && [ ! -e "$STAMPS/t2t.permfail" ]; then
     if [ -e "$STAMPS/t2t_arm_toggle" ]; then
-      ARM_DIR=runs/pong18_tpu_fresh; rm -f "$STAMPS/t2t_arm_toggle"
+      ARM_DIR=runs/pong18_fresh1024; ARM_PRESET=pong_t2t_1024
+      rm -f "$STAMPS/t2t_arm_toggle"
     else
-      ARM_DIR=runs/pong18_tpu; touch "$STAMPS/t2t_arm_toggle"
+      ARM_DIR=runs/pong18_tpu; ARM_PRESET=pong_t2t
+      touch "$STAMPS/t2t_arm_toggle"
     fi
-    t2t_session pong_t2t "$ARM_DIR"
+    t2t_session "$ARM_PRESET" "$ARM_DIR"
     target_reached 3000 && touch "$STAMPS/t2t"
-    budget_spent runs/pong18_tpu runs/pong18_tpu_fresh \
+    budget_spent runs/pong18_tpu runs/pong18_fresh1024 \
       && touch "$STAMPS/t2t.permfail"
   fi
 
