@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
@@ -34,7 +35,12 @@ ROWS, COLS = 6, 12
 BRICK_TOP = 0.88  # top of the brick band
 ROW_H = 0.04  # brick row height
 BRICK_BOT = BRICK_TOP - ROWS * ROW_H  # 0.64
-ROW_POINTS = jnp.array([1.0, 1.0, 4.0, 4.0, 7.0, 7.0], jnp.float32)  # bottom→top
+# numpy, not jnp: a module-level device array would initialize the jax
+# backend at import (registry imports every builtin env — a hung
+# accelerator tunnel then hangs ANY `import asyncrl_tpu.envs`, before the
+# entry points' guarded liveness probe can run). Converted to a traced
+# constant at the use site.
+ROW_POINTS = np.array([1.0, 1.0, 4.0, 4.0, 7.0, 7.0], np.float32)  # bottom→top
 
 PADDLE_Y = 0.06  # paddle plane (bottom)
 PADDLE_HALF = 0.075  # paddle half-width
@@ -145,7 +151,9 @@ class Breakout(Environment):
         bricks = state.bricks.at[row, col].set(
             jnp.where(hit_brick, False, state.bricks[row, col])
         )
-        reward = jnp.where(hit_brick, ROW_POINTS[row], 0.0).astype(jnp.float32)
+        reward = jnp.where(
+            hit_brick, jnp.asarray(ROW_POINTS)[row], 0.0
+        ).astype(jnp.float32)
         vy = jnp.where(hit_brick, -vy, vy)
 
         # Paddle bounce: offset sets outgoing vx (the aiming mechanic).

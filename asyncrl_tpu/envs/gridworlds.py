@@ -32,14 +32,17 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
 from asyncrl_tpu.utils.prng import masked_choice as _masked_choice
 
 # Actions: noop, up (r-1), down (r+1), left (c-1), right (c+1).
-_DR = jnp.array([0, -1, 1, 0, 0], jnp.int32)
-_DC = jnp.array([0, 0, 0, -1, 1], jnp.int32)
+# numpy, not jnp: module-level device arrays would initialize the jax
+# backend at import time (see envs/breakout.py ROW_POINTS).
+_DR = np.array([0, -1, 1, 0, 0], np.int32)
+_DC = np.array([0, 0, 0, -1, 1], np.int32)
 
 
 def generate_maze(key: jax.Array, k: int) -> jax.Array:
@@ -82,7 +85,7 @@ def _move(
     walls: jax.Array, pos: jax.Array, action: jax.Array
 ) -> jax.Array:
     """Move a cell-coordinate position by an action, blocked by walls."""
-    dr, dc = _DR[action], _DC[action]
+    dr, dc = jnp.asarray(_DR)[action], jnp.asarray(_DC)[action]
     blocked = walls[2 * pos[0] + 1 + dr, 2 * pos[1] + 1 + dc]
     return jnp.where(blocked, pos, pos + jnp.stack([dr, dc]))
 
@@ -257,7 +260,8 @@ class Chaser(Environment):
         def enemy_step(k, pos):
             dirs = jnp.arange(1, 5)
             open_dir = ~state.walls[
-                2 * pos[0] + 1 + _DR[dirs], 2 * pos[1] + 1 + _DC[dirs]
+                2 * pos[0] + 1 + jnp.asarray(_DR)[dirs],
+                2 * pos[1] + 1 + jnp.asarray(_DC)[dirs],
             ]
             d = dirs[_masked_choice(k, open_dir)]
             return _move(state.walls, pos, d)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from asyncrl_tpu.envs.core import Environment, EnvSpec, TimeStep
@@ -206,8 +207,11 @@ class FreewayState:
 
 
 # Lane speeds: a car moves one cell every `speed` steps; sign = direction.
-_LANE_SPEED = jnp.array([1, 2, 3, 4, -1, -2, -3, -4], jnp.int32)
-_LANE_ROWS = jnp.arange(1, 9)  # rows 1..8 carry traffic
+# numpy, not jnp: a module-level device array would initialize the jax
+# backend at import time (see envs/breakout.py ROW_POINTS); jnp ops at the
+# use sites convert it to a traced constant.
+_LANE_SPEED = np.array([1, 2, 3, 4, -1, -2, -3, -4], np.int32)
+_LANE_ROWS = np.arange(1, 9)  # rows 1..8 carry traffic (numpy: see above)
 
 
 def _lane_stream_step(

@@ -26,7 +26,11 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import cpu_fallback_or_refuse  # noqa: E402
 
-CAPS = (3000, 27_000)  # (repo default, ALE-faithful) — envs/pong.py
+# Single source of truth for the cap pair (ADVICE r4): the env constants,
+# not re-typed numbers — a cap change in envs/pong.py propagates here.
+from asyncrl_tpu.envs.pong import ALE_MAX_STEPS, MAX_STEPS  # noqa: E402
+
+CAPS = (MAX_STEPS, ALE_MAX_STEPS)  # (repo default, ALE-faithful)
 
 
 def main() -> int:

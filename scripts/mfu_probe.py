@@ -182,6 +182,7 @@ def main() -> int:
     nv = base.num_envs
     sweep = []
     split = {"skipped": True}
+    completed = False
     try:
         for label, variant in (
             (f"{nv}envs", base),
@@ -220,9 +221,13 @@ def main() -> int:
         except Exception as e:  # the sweep rows must get banked regardless
             split = {"error": str(e)[:300]}
             print(f"mfu_probe: phase split failed: {e}", file=sys.stderr)
+        completed = True
     finally:
         # Bank whatever exists — a timeout/flap mid-probe loses only the
-        # in-flight variant, not the window's completed measurements.
+        # in-flight variant, not the window's completed measurements. An
+        # interrupted probe exits nonzero and the watcher retries, so the
+        # retry's FULL row would sit next to this one: partial=true lets
+        # consumers prefer the complete row (ADVICE r4 — no silent dupes).
         if sweep:
             entry = {
                 "kind": "mfu_probe",
@@ -230,6 +235,7 @@ def main() -> int:
                 **bench_history.device_entry(),
                 "sweep": sweep,
                 "phase_split_base": split,
+                **({} if completed else {"partial": True}),
             }
             try:
                 bench_history.record(entry)

@@ -68,6 +68,7 @@ run_job() {
   echo "=== rc=$rc [$stamp]"
   if [ "$rc" -eq 0 ]; then
     touch "$STAMPS/$stamp"
+    rm -f "$STAMPS/$stamp.fail1"  # stale defer marker must not outlive success
   elif [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
     # timeout-killed: the axon plugin HANGS (not errors) when the tunnel
     # dies under a job, so a kill is flap-shaped even if the tunnel is
@@ -77,6 +78,7 @@ run_job() {
     if [ -e "$STAMPS/$stamp.fail1" ]; then
       echo "=== [$stamp] second real failure: permanent, not retrying"
       touch "$STAMPS/$stamp.permfail"
+      return 0  # settled: explicit, not touch's incidental rc
     else
       echo "=== [$stamp] failed with tunnel UP: will retry next window"
       echo "$WINDOW" > "$STAMPS/$stamp.fail1"

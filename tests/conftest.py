@@ -107,6 +107,12 @@ QUICK: dict[str, object] = {
         "test_registry_applies_knobs",
     },
     "test_recurrent.py": {"test_recurrent_apply_and_reset"},
+    "test_run_to_target.py": {
+        # In-process protocol tests (fake trainer, no training): the
+        # reached=true confirmation gate must stay on the quick signal.
+        "test_unconfirmed_crossing_is_not_banked",  # 2s
+        "test_crossing_banked_only_after_confirmation",
+    },
     "test_selfplay.py": {
         "test_observe_opponent_is_the_mirror_view",
         "test_duel_dynamics_are_symmetric",
