@@ -281,6 +281,53 @@ pong_t2t_1024 = pong_t2t.replace(num_envs=1024, learning_rate=2e-4)
 # pong_max_steps so the judge can tell the bars apart.
 pong_t2t_ale = pong_t2t.replace(pong_max_steps=ALE_MAX_STEPS)
 
+# The PIXEL-path 18.0 hunt (VERDICT r4 Next #2): the reference flagship's
+# real shape — BASELINE.json:8 is PongNoFrameskip-v4, i.e. 84x84x4 pixel
+# observations with ALE episode semantics — where the vector arms above
+# measure the same game from its 6-dim state. Semantics: frame_skip=4 +
+# 2-frame max-pool (the NoFrameskip-v4 preprocessing stack; sticky actions
+# stay 0.0 because v4 sets repeat_action_probability=0 — sticky is the
+# v5/Machado protocol) and the ALE cap (27,000 decisions x 4 = 108,000
+# frames). Geometry: the 1024-env/chip fit (atari_impala + grad_accum=4 +
+# block remat, the measured ~15.7G HBM footprint).
+#
+# Recipe, re-derived from pong_t2t at skip-4 (each decision now spans 4
+# core frames, so per-decision economics scale by 4):
+#   gamma    0.995^4 ~= 0.980 — same credit horizon in CORE frames.
+#   step_cost 0.01x4 = 0.04   — same per-point shaped price (a ~184
+#                               core-frame point is ~46 decisions).
+#   lr 3e-4 — between pong_t2t's 1.5e-4 (256 envs) and the 1024-env
+#             arm's 2e-4, scaled for the 4x larger per-update batch; a
+#             first-recipe hypothesis like pong_t2t_1024's lr (it gets no
+#             headline until it has a curve).
+#   updates_per_call 8 — the pixel benches' call fusion (compile cost).
+#
+# Frames-to-18 expectation (stated BEFORE the arm runs, so the curve can
+# falsify it): the vector arm reached 18.0 under this cap at ~18.0B agent
+# decisions = 18.0B core frames (runs/pong18_tpu metrics.jsonl, frame_skip
+# 1). If sample efficiency is bounded by game experience (core frames),
+# the pixel arm needs the same ~18B core frames = ~4.5B decisions; pixel
+# representation learning (recovering the 6-dim state from 84x84x4) adds
+# an unknown factor we bound at 1-3x, so the expectation is 4.5B-13.5B
+# decisions. At the measured 45,984 decisions/s 1024-fit throughput
+# (skip-4 rendering will shave this further), that is ~27-80 chip-hours —
+# a multi-window accumulation arm (runs/pong18_pixels), not a
+# single-session measurement.
+pong_pixels_t2t = pong_t2t.replace(
+    env_id="JaxPongPixels-v0",
+    torso="impala_cnn",
+    num_envs=1024,
+    grad_accum=4,
+    remat=True,
+    updates_per_call=8,
+    frame_skip=4,
+    frame_pool=True,
+    pong_max_steps=ALE_MAX_STEPS,
+    gamma=0.98,
+    step_cost=0.04,
+    learning_rate=3e-4,
+)
+
 PRESETS: dict[str, Config] = {
     "cartpole_a3c": cartpole_a3c,
     "cartpole_a3c_cpu": cartpole_a3c_cpu,
@@ -292,6 +339,7 @@ PRESETS: dict[str, Config] = {
     "pong_t2t": pong_t2t,
     "pong_t2t_1024": pong_t2t_1024,
     "pong_t2t_ale": pong_t2t_ale,
+    "pong_pixels_t2t": pong_pixels_t2t,
     "pong_selfplay": pong_selfplay,
     "atari_impala": atari_impala,
     "atari_impala_wide": atari_impala_wide,

@@ -271,6 +271,11 @@ def main() -> int:
                 break
             except _Crossed:
                 crossing_seconds = status.pop("crossing_seconds")
+                # Each crossing gets its own confirmation verdict: a stale
+                # value from an earlier rejected crossing must not pair
+                # with THIS crossing's numbers in the final row (e.g. when
+                # this confirmation attempt crashes below).
+                confirm["return"] = None
                 # Fresh-seed confirmation, independent of the in-training
                 # eval stream. Retries cycle through 8 seeds (params have
                 # moved between retries, so reuse is sound) — unbounded

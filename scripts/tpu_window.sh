@@ -1,19 +1,28 @@
 #!/bin/bash
-# TPU window watcher, round-4 queue (VERDICT r3 Next #1/#3/#4): the axon
-# tunnel flaps — minutes-long UP windows between outages. This loop probes
-# liveness and, on each UP window, burns down a prioritized queue of
-# real-TPU evidence jobs. Round-4 priority order inside a window:
+# TPU window watcher, round-5 queue (VERDICT r4 Next #1/#2/#4/#5/#6): the
+# axon tunnel flaps — minutes-long UP windows between outages. This loop
+# probes liveness and, on each UP window, burns down a prioritized queue
+# of real-TPU evidence jobs. Round-5 priority order inside a window:
 #
 #   1. ALE-faithful time-to-target (pong_t2t_ale, runs/pong18_ale seeded
-#      from the accumulated strict-cap arm) — the headline deliverable;
-#      potentially closes reached=true in one session.
+#      from the accumulated strict-cap arm) — the round-5 headline: a
+#      platform=tpu reached=true row (the r4 one was a CPU confirmation).
+#      run_to_target now banks reached=true only after a 64-episode
+#      fresh-seed confirmation eval.
 #   2. Fresh dual-flagship bench (bench.py driver mode: vector + pixel) —
 #      once per window, so every round's BENCH artifact has a same-round
-#      TPU pair (r3 Next #3).
-#   3. Strict-cap t2t sessions (the r3 arms, alternating) — the harder
-#      scoring-rate bar, resumable, budget-capped per arm.
-#   4. One-shot evidence rows (eval_caps on TPU, MFU probe, rooflines).
-#   5. Long low-marginal-value jobs (bench_matrix, selfplay).
+#      TPU pair.
+#   3. Pixel-path 18.0 hunt (pong_pixels_t2t -> runs/pong18_pixels, its
+#      own budget): the reference flagship's real shape (VERDICT r4 Next
+#      #2); a multi-window accumulation arm — expectation 4.5-13.5B
+#      decisions (see the preset), so each window banks curve + a
+#      reached=false row, not a finish.
+#   4. MFU probe incl. the wide-torso lane-ceiling experiment (r4 Next
+#      #4) and the host-path inference-batch RTT sweep (r4 Next #6) —
+#      promoted above the generic one-shots this round.
+#   5. Strict-cap t2t sessions (alternating arms) — the harder
+#      scoring-rate bar (r4 Next #5: drive it to a decision).
+#   6. Remaining one-shot evidence rows, then long low-marginal jobs.
 #
 # One-shot jobs stamp /tmp/tpu_window_stamps/<name> on success or
 # <name>.permfail on a deterministic failure (tunnel still up); the
@@ -37,6 +46,11 @@ export BENCH_REQUIRE_ACCELERATOR=1
 # definition, interpolated into the flag and the settle checks alike
 # (ADVICE r3: the duplicated constant drifted).
 BUDGET=10800
+# The pixel arm's own, larger budget (VERDICT r4 Next #2 "its own
+# budget"): the stated expectation is 27-80 chip-hours, so this arm is
+# expected to exhaust windows, not budget — the cap exists so the queue
+# can ever settle.
+PIXEL_BUDGET=43200
 
 probe() {
   timeout -k 5 90 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
@@ -94,8 +108,15 @@ run_job() {
 settled() { [ -e "$STAMPS/$1" ] || [ -e "$STAMPS/$1.permfail" ]; }
 
 commit_ledger() {
-  if [ -n "$(git status --porcelain BENCH_HISTORY.json)" ]; then
-    git add BENCH_HISTORY.json
+  # Stage the run dirs' CURVES and SIDECARS too: the r4 headline's run dir
+  # was never committed because only the ledger file was added (VERDICT r4
+  # Weak #1) — the learning curves ARE the auditable evidence. Orbax
+  # checkpoint step dirs are deliberately NOT staged here: committing
+  # rotating multi-MB binaries every window would balloon history; the
+  # final checkpoints land once in the driver's end-of-round commit.
+  if [ -n "$(git status --porcelain BENCH_HISTORY.json runs/)" ]; then
+    git add BENCH_HISTORY.json runs/README.md \
+      'runs/*/metrics.jsonl' 'runs/*/*.json' 2>/dev/null
     git -c core.editor=true commit -q -m "Record real-TPU benchmark evidence in BENCH_HISTORY
 
 Automated ledger update from scripts/tpu_window.sh on a live
@@ -107,13 +128,17 @@ No-Verification-Needed: benchmark-artifact-only commit" \
   fi
 }
 
-# target_reached <cap>: a non-CPU reached=true time_to_target row exists
-# for that episode cap (rows without pong_max_steps predate the field and
-# belong to the 3000 bar).
+# target_reached <cap> <presets...>: a non-CPU reached=true
+# time_to_target row exists for that episode cap AND one of the named
+# presets (rows without pong_max_steps predate the field and belong to
+# the 3000 bar). The preset filter keeps the three bars separate: the
+# pixel arm and the vector ALE arm share cap 27000 but are different
+# measurements — one reaching must not stop the other.
 target_reached() {
-  CAP="$1" python - <<'EOF'
+  CAP="$1" PRESETS="${2:?target_reached needs a preset list}" python - <<'EOF'
 import json, os, sys
 cap = int(os.environ["CAP"])
+presets = set(os.environ["PRESETS"].split())
 try:
     entries = json.load(open("BENCH_HISTORY.json"))
 except Exception:
@@ -122,19 +147,21 @@ ok = any(
     e.get("kind") == "time_to_target" and e.get("reached")
     and e.get("platform") not in ("cpu",)
     and int(e.get("pong_max_steps", 3000)) == cap
+    and e.get("preset") in presets
     for e in entries
 )
 sys.exit(0 if ok else 1)
 EOF
 }
 
-# budget_spent <dir>...: every listed arm's accumulated clock passed
-# BUDGET. An arm seeded by copying another arm's checkpoints inherits the
-# donor's elapsed sidecar (the t2t TOTAL must stay honest); its own
-# budget, though, starts at the copy — seed_offset.json records the
-# inherited seconds and is subtracted here.
+# budget_spent <budget-s> <dir>...: every listed arm's accumulated clock
+# passed the given budget. An arm seeded by copying another arm's
+# checkpoints inherits the donor's elapsed sidecar (the t2t TOTAL must
+# stay honest); its own budget, though, starts at the copy —
+# seed_offset.json records the inherited seconds and is subtracted here.
 budget_spent() {
-  DIRS="$*" BUDGET="$BUDGET" python - <<'EOF'
+  local budget="$1"; shift
+  DIRS="$*" BUDGET="$budget" python - <<'EOF'
 import json, os, sys
 def read(d, name):
     try:
@@ -150,16 +177,18 @@ sys.exit(0 if done else 1)
 EOF
 }
 
-# t2t_session <preset> <arm_dir> [budget]: one 900s resumable training
-# session. A seeded arm passes BUDGET + its inherited seed offset —
-# run_to_target's own budget check counts the inherited sidecar seconds,
-# so the raw BUDGET would stop it before the arm got BUDGET seconds of
-# its OWN training (and budget_spent, which subtracts the offset, would
-# then never be satisfied).
+# t2t_session <preset> <arm_dir> [budget] [session-timeout]: one
+# resumable training session (default 900s). A seeded arm passes BUDGET +
+# its inherited seed offset — run_to_target's own budget check counts the
+# inherited sidecar seconds, so the raw BUDGET would stop it before the
+# arm got BUDGET seconds of its OWN training (and budget_spent, which
+# subtracts the offset, would then never be satisfied). The pixel arm
+# passes a longer session timeout: its remat+grad_accum compile eats a
+# bigger fixed slice of each session.
 t2t_session() {
-  local preset="$1" arm="$2" budget="${3:-$BUDGET}"
+  local preset="$1" arm="$2" budget="${3:-$BUDGET}" tmo="${4:-900}"
   echo "=== $(date -u +%FT%TZ) [t2t] run_to_target session ($preset -> $arm)"
-  timeout -k 10 900 python scripts/run_to_target.py "$preset" \
+  timeout -k 10 "$tmo" python scripts/run_to_target.py "$preset" \
     --target 18.0 --budget-seconds "$budget" \
     checkpoint_dir="$arm" checkpoint_every=50
   echo "=== rc=$? [t2t $arm]"
@@ -200,14 +229,16 @@ while true; do
   # Re-arm settled stamps from the committed ledger: /tmp stamps die on
   # reboot/restart, but a reached=true row is durable — without this the
   # completion check could never pass after a restart.
-  target_reached 27000 && touch "$STAMPS/t2t_ale"
-  target_reached 3000 && touch "$STAMPS/t2t"
+  target_reached 27000 pong_t2t_ale && touch "$STAMPS/t2t_ale"
+  target_reached 3000 "pong_t2t pong_t2t_1024" && touch "$STAMPS/t2t"
+  target_reached 27000 pong_pixels_t2t && touch "$STAMPS/t2t_pix"
 
-  # --- 1. ALE-faithful t2t (headline; VERDICT r3 Next #1). Seed the arm
-  # from the accumulated strict-cap checkpoint so its 28.8 training
-  # minutes carry into the measurement honestly (sidecar copies along;
-  # seed_offset.json keeps the ALE arm's own BUDGET clock at zero).
-  if ! target_reached 27000 && [ ! -e "$STAMPS/t2t_ale.permfail" ]; then
+  # --- 1. ALE-faithful t2t (the round-5 headline; VERDICT r4 Next #1).
+  # Seed the arm from the accumulated strict-cap checkpoint so its 28.8
+  # training minutes carry into the measurement honestly (sidecar copies
+  # along; seed_offset.json keeps the ALE arm's own BUDGET clock at zero).
+  if ! target_reached 27000 pong_t2t_ale \
+     && [ ! -e "$STAMPS/t2t_ale.permfail" ]; then
     if [ ! -d runs/pong18_ale ] && [ -d runs/pong18_tpu ]; then
       cp -r runs/pong18_tpu runs/pong18_ale
       python - <<'EOF'
@@ -229,19 +260,48 @@ EOF
     fi
     t2t_session pong_t2t_ale runs/pong18_ale \
       $((BUDGET + $(seed_offset runs/pong18_ale)))
-    target_reached 27000 && touch "$STAMPS/t2t_ale"
-    budget_spent runs/pong18_ale && touch "$STAMPS/t2t_ale.permfail"
+    target_reached 27000 pong_t2t_ale && touch "$STAMPS/t2t_ale"
+    budget_spent "$BUDGET" runs/pong18_ale \
+      && touch "$STAMPS/t2t_ale.permfail"
   fi
 
-  # --- 2. Fresh dual-flagship bench, once per window (r3 Next #3).
+  # --- 2. Fresh dual-flagship bench, once per window.
   run_job "bench_w$WINDOW" 900 python bench.py || continue
   commit_ledger
 
-  # --- 3. Strict-cap t2t (the harder scoring-rate bar; r3 arms). The
-  # fresh arm trains the batch-scaled recipe (pong_t2t_1024: 4x frames
-  # per wall-second + shaping from step one); the resumed arm keeps its
+  # --- 3. Pixel-path 18.0 hunt (VERDICT r4 Next #2): the reference
+  # flagship's real shape. Fresh arm (no seeding — no prior pixel
+  # training exists); longer sessions because the remat+grad_accum pixel
+  # compile is the fixed per-session cost. Every session appends to the
+  # committed learning curve and banks a reached=false row on budget/
+  # session end — the multi-window expectation is in the preset comment.
+  if ! target_reached 27000 pong_pixels_t2t \
+     && [ ! -e "$STAMPS/t2t_pix.permfail" ]; then
+    t2t_session pong_pixels_t2t runs/pong18_pixels "$PIXEL_BUDGET" 1500
+    target_reached 27000 pong_pixels_t2t && touch "$STAMPS/t2t_pix"
+    budget_spent "$PIXEL_BUDGET" runs/pong18_pixels \
+      && touch "$STAMPS/t2t_pix.permfail"
+  fi
+
+  # --- 4. Promoted probes (VERDICT r4 Next #4/#6): the MFU question and
+  # the host-path RTT model need chip rows this round.
+  if [ -e scripts/mfu_probe.py ]; then
+    # 5 variants x (compile + measure) incl. the wide-torso lane-
+    # utilization experiment — the pixel compiles are the cost.
+    run_job mfu_probe 1800 python scripts/mfu_probe.py || continue
+    commit_ledger
+  fi
+  if [ -e scripts/host_rtt_sweep.py ]; then
+    run_job host_rtt_sweep 600 python scripts/host_rtt_sweep.py || continue
+    commit_ledger
+  fi
+
+  # --- 5. Strict-cap t2t (the harder scoring-rate bar). The fresh arm
+  # trains the batch-scaled recipe (pong_t2t_1024: 4x frames per
+  # wall-second + shaping from step one); the resumed arm keeps its
   # checkpoint's pong_t2t geometry.
-  if ! target_reached 3000 && [ ! -e "$STAMPS/t2t.permfail" ]; then
+  if ! target_reached 3000 "pong_t2t pong_t2t_1024" \
+     && [ ! -e "$STAMPS/t2t.permfail" ]; then
     if [ -e "$STAMPS/t2t_arm_toggle" ]; then
       ARM_DIR=runs/pong18_fresh1024; ARM_PRESET=pong_t2t_1024
       rm -f "$STAMPS/t2t_arm_toggle"
@@ -250,25 +310,17 @@ EOF
       touch "$STAMPS/t2t_arm_toggle"
     fi
     t2t_session "$ARM_PRESET" "$ARM_DIR"
-    target_reached 3000 && touch "$STAMPS/t2t"
-    budget_spent runs/pong18_tpu runs/pong18_fresh1024 \
+    target_reached 3000 "pong_t2t pong_t2t_1024" && touch "$STAMPS/t2t"
+    budget_spent "$BUDGET" runs/pong18_tpu runs/pong18_fresh1024 \
       && touch "$STAMPS/t2t.permfail"
   fi
 
-  # --- 4. One-shot evidence rows.
+  # --- 6. Remaining one-shot evidence rows.
   # Both-cap eval of the best checkpoint ON THE CHIP (the CPU rows exist;
   # this one carries TPU provenance for the cap-decision evidence).
   run_job eval_caps_tpu 900 python scripts/eval_caps.py pong_t2t \
     --run-dir runs/pong18_tpu --episodes 64 || continue
   commit_ledger
-  # Pixel-path MFU probe (VERDICT r3 Next #2): dtype/layout/geometry
-  # sweep + profile; gated on the script landing (added mid-round).
-  if [ -e scripts/mfu_probe.py ]; then
-    # 5 variants x (compile + measure) incl. the wide-torso lane-
-    # utilization experiment — the pixel compiles are the cost.
-    run_job mfu_probe 1800 python scripts/mfu_probe.py || continue
-    commit_ledger
-  fi
   run_job pixel_bench 420 python bench.py atari_impala updates_per_call=8 num_envs=256 || continue
   run_job roofline_pong 420 python scripts/roofline.py pong_impala updates_per_call=32 || continue
   run_job roofline_atari 480 python scripts/roofline.py atari_impala updates_per_call=8 num_envs=256 || continue
@@ -287,20 +339,22 @@ EOF
   run_job pixel_wide 600 python bench.py atari_impala_wide updates_per_call=8 || continue
   commit_ledger
 
-  # --- 5. Long, lower-marginal-value jobs last.
+  # --- 7. Long, lower-marginal-value jobs last.
   run_job bench_matrix 1500 python scripts/bench_matrix.py || continue
   commit_ledger
   run_job selfplay_exp 900 python scripts/selfplay_experiment.py 400000000 updates_per_call=32 step_cost=0.005 || continue
   commit_ledger
 
-  if settled t2t_ale && settled t2t && settled "bench_w$WINDOW" \
+  if settled t2t_ale && settled t2t && settled t2t_pix \
+     && settled "bench_w$WINDOW" \
      && settled eval_caps_tpu && settled pixel_bench \
      && settled roofline_pong && settled roofline_atari \
      && settled pallas_validate && settled pixel_bench_1024 \
      && settled vec_envs1024 && settled vec_envs4096 \
      && settled pixel_wide \
      && settled bench_matrix && settled selfplay_exp \
-     && { [ ! -e scripts/mfu_probe.py ] || settled mfu_probe; }; then
+     && { [ ! -e scripts/mfu_probe.py ] || settled mfu_probe; } \
+     && { [ ! -e scripts/host_rtt_sweep.py ] || settled host_rtt_sweep; }; then
     echo "--- $(date -u +%FT%TZ) queue complete"
     break
   fi
