@@ -75,10 +75,17 @@ def main() -> int:
     batches = np.array([r["batch"] for r in good], np.float64)
     per_call = 1.0 / np.array([r["calls_per_sec"] for r in good], np.float64)
     slope, intercept = np.polyfit(batches, per_call, 1)
-    fixed_ms = max(intercept, 0.0) * 1e3
+    # Clamp BOTH uses of the fit: a near-zero true intercept (the CPU
+    # control) can come out slightly negative from least-squares noise,
+    # and an unclamped share could then even exceed 1 on a ratio of two
+    # negatives — a self-contradictory row next to fixed_latency_ms=0.
+    fixed = max(float(intercept), 0.0)
+    per_item = max(float(slope), 0.0)
+    fixed_ms = fixed * 1e3
     # Share of a mid-sweep (batch-128) call spent in the fixed term: the
     # RTT model predicts this dominates on the tunneled chip.
-    mid = intercept / (intercept + slope * 128) if intercept + slope * 128 else 0
+    denom = fixed + per_item * 128
+    mid = fixed / denom if denom > 0 else 0.0
     entry = {
         "kind": "host_path",
         "probe": "rtt_sweep",
@@ -86,7 +93,7 @@ def main() -> int:
         **bench_history.device_entry(),
         "sweep": sweep,
         "fixed_latency_ms": round(fixed_ms, 3),
-        "per_item_us": round(max(slope, 0.0) * 1e6, 3),
+        "per_item_us": round(per_item * 1e6, 3),
         "fixed_share_at_batch128": round(float(mid), 3),
         # "Fixed-latency bound", not "RTT bound": on the tunneled chip the
         # fixed term IS dominated by link RTT; on a CPU control run it is

@@ -115,8 +115,14 @@ commit_ledger() {
   # rotating multi-MB binaries every window would balloon history; the
   # final checkpoints land once in the driver's end-of-round commit.
   if [ -n "$(git status --porcelain BENCH_HISTORY.json runs/)" ]; then
-    git add BENCH_HISTORY.json runs/README.md \
-      'runs/*/metrics.jsonl' 'runs/*/*.json' 2>/dev/null
+    # One guarded add per pathspec: git add is all-or-nothing across its
+    # pathspecs — a single zero-match glob (e.g. runs/ pruned) would
+    # abort the WHOLE add with nothing staged, silently dropping the
+    # ledger commit this function exists to make.
+    for spec in BENCH_HISTORY.json runs/README.md \
+        'runs/*/metrics.jsonl' 'runs/*/*.json'; do
+      git add -- $spec 2>/dev/null
+    done
     git -c core.editor=true commit -q -m "Record real-TPU benchmark evidence in BENCH_HISTORY
 
 Automated ledger update from scripts/tpu_window.sh on a live
