@@ -1,0 +1,19 @@
+#!/bin/bash
+# CPU learnability probe for the pixel-path recipe (round 5): before chip
+# windows are spent on pong_pixels_t2t, find out on the CPU whether the
+# skip-4 pixel recipe (shaping, gamma, CNN torso) produces a learning
+# signal AT ALL. This is NOT a time-to-target measurement — it runs a
+# CPU-feasible geometry (128 envs, no grad_accum/remat, lr scaled with
+# batch, rare 8-episode evals: the 27,200-step eval scan is minutes on
+# CPU) with the preset's shaping economics, into its own arm dir. Signal
+# sought: training episode_return clearly above the random floor within
+# the overnight frame budget; its absence falsifies the recipe before it
+# costs a window. Core-yielding supervision lives in cpu_probe_loop.sh
+# (sessions SIGSTOP during TPU windows).
+#
+#   nohup bash scripts/cpu_pixel_probe.sh > /tmp/cpu_pixel_probe.log 2>&1 &
+set -u
+exec bash "$(dirname "$0")/cpu_probe_loop.sh" \
+  pong_pixels_t2t "${1:-runs/pong18_pixels_cpu}" \
+  num_envs=128 grad_accum=1 remat=false updates_per_call=2 \
+  learning_rate=1.5e-4 eval_every=400 eval_episodes=8

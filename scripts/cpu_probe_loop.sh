@@ -1,0 +1,118 @@
+#!/bin/bash
+# Supervised CPU training loop that YIELDS the single CPU core to TPU
+# windows: run_to_target sessions pinned to the CPU backend
+# (ASYNCRL_FORCE_CPU — provenance stays platform=cpu; the watcher's
+# target_reached ignores cpu rows), with a supervisor that yields the
+# moment the window watcher reports the tunnel UP. Two yield modes:
+#
+#   YIELD_MODE=stop (default): SIGSTOP the session, SIGCONT when the
+#     tunnel is DOWN again. Preserves the session's XLA compile (minutes
+#     on this box) and costs the window zero CPU — but run_to_target's
+#     perf_counter clock KEEPS TICKING while stopped, so the arm's
+#     recorded time_to_target seconds include pause time. Use for
+#     LEARNABILITY probes whose evidence is the env_steps-vs-return
+#     curve, never for an arm whose wall-clock number will be cited.
+#   YIELD_MODE=term: SIGTERM the session (its sidecar persists training
+#     clock on every metrics drain, so inter-session gaps are excluded
+#     — clock-honest) and relaunch when the tunnel is DOWN. Pays a
+#     recompile per window; use for t2t measurement arms.
+#
+# Sessions resume from checkpoints; the loop exits when the run records
+# ANY time_to_target completion for this dir's preset (in-run budget
+# decides reached true/false) or MAX_SESSIONS spend out.
+#
+#   nohup bash scripts/cpu_probe_loop.sh <preset> <checkpoint_dir> \
+#       [extra overrides...] > /tmp/probe.log 2>&1 &
+#
+# Env knobs: YIELD_MODE (stop|term; default stop), SESSION_SECONDS
+# (running time per session, pause excluded; default 1200),
+# BUDGET_SECONDS (run_to_target budget; default 72000), MAX_SESSIONS
+# (default 40).
+set -u
+cd "$(dirname "$0")/.."
+PRESET=${1:?usage: cpu_probe_loop.sh <preset> <checkpoint_dir> [overrides...]}
+DIR=${2:?usage: cpu_probe_loop.sh <preset> <checkpoint_dir> [overrides...]}
+shift 2
+export ASYNCRL_FORCE_CPU=1
+export BENCH_NO_WAIT=1
+
+tunnel_down() {
+  local log mtime now
+  # A dead watcher must not wedge the probe forever behind its stale log:
+  # no live tpu_window.sh process means the core is free regardless of
+  # what the leftover log says. (This pgrep pattern cannot self-match:
+  # this script's own cmdline does not contain "tpu_window".)
+  pgrep -f "tpu_window.sh" >/dev/null 2>&1 || return 0
+  log=$(ls -t /tmp/tpu_window*.log 2>/dev/null | head -1)
+  [ -n "$log" ] || return 0  # watcher just started, no log yet
+  now=$(date +%s)
+  mtime=$(stat -c %Y "$log" 2>/dev/null || echo 0)
+  # The watcher prints a DOWN line every ~60-150s; during a window the
+  # last line is job output (and may sit unchanged for a long job) —
+  # only a fresh DOWN line proves the core is free.
+  [ $((now - mtime)) -lt 180 ] && tail -1 "$log" | grep -q "tunnel DOWN"
+}
+
+# supervise <pid>: STOP/CONT the session around tunnel windows; TERM it
+# once its RUNNING time (pauses excluded) exceeds SESSION_SECONDS.
+# Prints the session's exit code capture via wait.
+supervise() {
+  local pid="$1" ran=0 paused=0
+  while kill -0 "$pid" 2>/dev/null; do
+    if tunnel_down; then
+      if [ "$paused" -eq 1 ]; then
+        kill -CONT "$pid" 2>/dev/null
+        paused=0
+        echo "--- $(date -u +%FT%TZ) tunnel DOWN again; session resumed"
+      fi
+      sleep 30
+      ran=$((ran + 30))
+      if [ "$ran" -ge "${SESSION_SECONDS:-1200}" ]; then
+        kill -TERM "$pid" 2>/dev/null
+        sleep 10
+        kill -KILL "$pid" 2>/dev/null
+        wait "$pid" 2>/dev/null
+        return 124  # session clock expired: caller relaunches
+      fi
+    else
+      if [ "${YIELD_MODE:-stop}" = "term" ]; then
+        # Clock-honest yield: end the session (sidecar already holds its
+        # training clock up to the last drain) and relaunch on DOWN.
+        echo "--- $(date -u +%FT%TZ) tunnel window: session terminated (YIELD_MODE=term)"
+        kill -TERM "$pid" 2>/dev/null
+        sleep 10
+        kill -KILL "$pid" 2>/dev/null
+        wait "$pid" 2>/dev/null
+        return 124
+      fi
+      if [ "$paused" -eq 0 ]; then
+        kill -STOP "$pid" 2>/dev/null
+        paused=1
+        echo "--- $(date -u +%FT%TZ) tunnel window: session paused (SIGSTOP)"
+      fi
+      sleep 60
+    fi
+  done
+  wait "$pid" 2>/dev/null
+  return $?
+}
+
+for i in $(seq 1 "${MAX_SESSIONS:-40}"); do
+  until tunnel_down; do
+    echo "--- $(date -u +%FT%TZ) tunnel window active (or watcher stale); waiting to start"
+    sleep 120
+  done
+  echo "=== $(date -u +%FT%TZ) cpu probe session $i ($PRESET -> $DIR)"
+  python scripts/run_to_target.py "$PRESET" \
+    --target 18.0 --budget-seconds "${BUDGET_SECONDS:-72000}" \
+    checkpoint_dir="$DIR" checkpoint_every=50 "$@" &
+  supervise $!
+  rc=$?
+  echo "=== rc=$rc session $i"
+  # Relaunch ONLY on the supervisor's session-clock expiry (124) or an
+  # external kill (137/143): resume next session. Any other exit means
+  # the measurement settled — rc=0 reached, rc=1 budget-exhausted
+  # reached=false, rc=3 refused (already complete) — and relaunching
+  # would append one duplicate reached=false ledger row per session.
+  case "$rc" in 124|137|143) sleep 5 ;; *) break ;; esac
+done

@@ -1,39 +1,22 @@
 #!/bin/bash
-# CPU arm of the 18.0-Pong time-to-target hunt: supervised, resumable
-# sessions pinned to the CPU backend (ASYNCRL_FORCE_CPU — never steals a
-# TPU window from scripts/tpu_window.sh; provenance stays platform=cpu).
-# Sessions checkpoint + accumulate wall clock; the loop exits when the
-# run records ANY time_to_target completion for this dir's preset (the
-# in-run budget decides reached true/false) or MAX_SESSIONS spend out.
+# CPU arm of the 18.0-Pong time-to-target hunt: the committed pong_t2t
+# recipe at CPU-feasible dispatch fusing (K=8: at CPU speeds a K=32 call
+# would outlive the metric window). Provenance stays platform=cpu; the
+# session supervision (resume loop + SIGSTOP-yielding the single core to
+# TPU windows) lives in cpu_probe_loop.sh.
 #
 #   nohup bash scripts/cpu_t2t_loop.sh [checkpoint_dir] [extra overrides...] &
 set -u
-cd "$(dirname "$0")/.."
 # Recipe-tagged default dir: resuming an OLD-recipe checkpoint dir would
 # silently credit its accumulated clock/optimizer state to the pong_t2t
 # label. Pass an explicit dir only to continue a same-recipe run.
 DIR=${1:-runs/pong18_cpu_t2t}
 shift || true
-export ASYNCRL_FORCE_CPU=1
-export BENCH_NO_WAIT=1
-
-for i in $(seq 1 "${MAX_SESSIONS:-12}"); do
-  echo "=== $(date -u +%FT%TZ) cpu t2t session $i ($DIR)"
-  # Same committed pong_t2t recipe as the TPU arm (configs/presets.py) so
-  # the two arms stay comparable; only dispatch fusing differs (K=8: at
-  # CPU speeds a K=32 call would outlive the metric window).
-  timeout -k 10 "${SESSION_SECONDS:-3600}" \
-    python scripts/run_to_target.py pong_t2t \
-      --target 18.0 --budget-seconds "${BUDGET_SECONDS:-14400}" \
-      checkpoint_dir="$DIR" checkpoint_every=50 \
-      updates_per_call=8 total_env_steps=2000000000 "$@"
-  rc=$?
-  echo "=== rc=$rc session $i"
-  # Relaunch ONLY on a timeout-kill (the session clock expired mid-run:
-  # resume next session). Any other exit means the measurement is settled
-  # — rc=0 reached, rc=1 budget-exhausted reached=false, rc=3 refused
-  # (already complete) — and relaunching would append one duplicate
-  # reached=false ledger row per leftover session.
-  if [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then break; fi
-  sleep 5
-done
+# This arm's wall clock IS the measurement: yield by clean termination
+# (clock-honest), never SIGSTOP (which would credit pause time).
+export YIELD_MODE=term
+export SESSION_SECONDS=${SESSION_SECONDS:-3600}
+export BUDGET_SECONDS=${BUDGET_SECONDS:-14400}
+export MAX_SESSIONS=${MAX_SESSIONS:-12}
+exec bash "$(dirname "$0")/cpu_probe_loop.sh" pong_t2t "$DIR" \
+  updates_per_call=8 total_env_steps=2000000000 "$@"
