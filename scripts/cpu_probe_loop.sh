@@ -26,8 +26,9 @@
 #
 # Env knobs: YIELD_MODE (stop|term; default stop), SESSION_SECONDS
 # (running time per session, pause excluded; default 1200),
-# BUDGET_SECONDS (run_to_target budget; default 72000), MAX_SESSIONS
-# (default 40).
+# BUDGET_SECONDS (run_to_target budget), MAX_SESSIONS (default 40; only
+# sessions that got >= half their running time count — a yield-
+# terminated sliver must not burn the session budget).
 set -u
 cd "$(dirname "$0")/.."
 PRESET=${1:?usage: cpu_probe_loop.sh <preset> <checkpoint_dir> [overrides...]}
@@ -35,6 +36,17 @@ DIR=${2:?usage: cpu_probe_loop.sh <preset> <checkpoint_dir> [overrides...]}
 shift 2
 export ASYNCRL_FORCE_CPU=1
 export BENCH_NO_WAIT=1
+# In stop mode, SIGSTOP pause time still ticks inside run_to_target's
+# perf_counter budget check — a long tunnel window would exhaust a tight
+# budget with no training done and settle the arm reached=false. Default
+# the in-run budget effectively out of the way; the probe's real bound is
+# MAX_SESSIONS x SESSION_SECONDS of RUNNING time. term mode keeps an
+# honest (clock-meaningful) default.
+if [ "${YIELD_MODE:-stop}" = "stop" ]; then
+  BUDGET=${BUDGET_SECONDS:-600000}
+else
+  BUDGET=${BUDGET_SECONDS:-72000}
+fi
 
 tunnel_down() {
   local log mtime now
@@ -53,25 +65,39 @@ tunnel_down() {
   [ $((now - mtime)) -lt 180 ] && tail -1 "$log" | grep -q "tunnel DOWN"
 }
 
-# supervise <pid>: STOP/CONT the session around tunnel windows; TERM it
-# once its RUNNING time (pauses excluded) exceeds SESSION_SECONDS.
-# Prints the session's exit code capture via wait.
+# supervise <pid>: STOP/CONT (or term-yield) the session around tunnel
+# windows; TERM it once its RUNNING time (pauses excluded) exceeds
+# SESSION_SECONDS. Returns the session's exit code; leaves the running
+# seconds in RAN_SECONDS so the caller can tell a full session from a
+# yield-terminated sliver.
+RAN_SECONDS=0
 supervise() {
   local pid="$1" ran=0 paused=0
+  RAN_SECONDS=0
+  # The supervised pid is the timeout BACKSTOP wrapper; STOP/CONT/KILL
+  # must also reach its python child or the pause would stop only the
+  # wrapper. pgrep -P (exact parent-pid match, no pattern — immune to
+  # the cmdline self-match trap) finds it; TERM goes to the wrapper
+  # alone, which forwards it to the child.
+  sig_all() { kill -"$1" "$pid" $(pgrep -P "$pid") 2>/dev/null; }
+  end_session() {
+    kill -TERM "$pid" 2>/dev/null  # timeout forwards TERM to the child
+    sleep 10
+    sig_all KILL
+    wait "$pid" 2>/dev/null
+  }
   while kill -0 "$pid" 2>/dev/null; do
     if tunnel_down; then
       if [ "$paused" -eq 1 ]; then
-        kill -CONT "$pid" 2>/dev/null
+        sig_all CONT
         paused=0
         echo "--- $(date -u +%FT%TZ) tunnel DOWN again; session resumed"
       fi
       sleep 30
       ran=$((ran + 30))
+      RAN_SECONDS=$ran
       if [ "$ran" -ge "${SESSION_SECONDS:-1200}" ]; then
-        kill -TERM "$pid" 2>/dev/null
-        sleep 10
-        kill -KILL "$pid" 2>/dev/null
-        wait "$pid" 2>/dev/null
+        end_session
         return 124  # session clock expired: caller relaunches
       fi
     else
@@ -79,14 +105,11 @@ supervise() {
         # Clock-honest yield: end the session (sidecar already holds its
         # training clock up to the last drain) and relaunch on DOWN.
         echo "--- $(date -u +%FT%TZ) tunnel window: session terminated (YIELD_MODE=term)"
-        kill -TERM "$pid" 2>/dev/null
-        sleep 10
-        kill -KILL "$pid" 2>/dev/null
-        wait "$pid" 2>/dev/null
+        end_session
         return 124
       fi
       if [ "$paused" -eq 0 ]; then
-        kill -STOP "$pid" 2>/dev/null
+        sig_all STOP
         paused=1
         echo "--- $(date -u +%FT%TZ) tunnel window: session paused (SIGSTOP)"
       fi
@@ -97,21 +120,35 @@ supervise() {
   return $?
 }
 
-for i in $(seq 1 "${MAX_SESSIONS:-40}"); do
+sessions=0
+while [ "$sessions" -lt "${MAX_SESSIONS:-40}" ]; do
   until tunnel_down; do
     echo "--- $(date -u +%FT%TZ) tunnel window active (or watcher stale); waiting to start"
     sleep 120
   done
-  echo "=== $(date -u +%FT%TZ) cpu probe session $i ($PRESET -> $DIR)"
-  python scripts/run_to_target.py "$PRESET" \
-    --target 18.0 --budget-seconds "${BUDGET_SECONDS:-72000}" \
-    checkpoint_dir="$DIR" checkpoint_every=50 "$@" &
+  echo "=== $(date -u +%FT%TZ) cpu probe session $((sessions + 1)) ($PRESET -> $DIR)"
+  # The timeout wrapper is the orphan backstop: if this supervisor shell
+  # dies, the session's DIRECT parent still bounds it (3x the session
+  # clock covers stop-mode pauses; the final KILL ends even a process
+  # left SIGSTOPped). Normal sessions are ended by supervise long before
+  # this fires.
+  timeout -k 10 $((${SESSION_SECONDS:-1200} * 3)) \
+    python scripts/run_to_target.py "$PRESET" \
+      --target 18.0 --budget-seconds "$BUDGET" \
+      checkpoint_dir="$DIR" checkpoint_every=50 "$@" &
   supervise $!
   rc=$?
-  echo "=== rc=$rc session $i"
-  # Relaunch ONLY on the supervisor's session-clock expiry (124) or an
-  # external kill (137/143): resume next session. Any other exit means
-  # the measurement settled — rc=0 reached, rc=1 budget-exhausted
+  # A yield-terminated sliver (term mode: session ended by a tunnel
+  # window before half its running time) must not burn the session
+  # budget — a flappy night would otherwise exhaust MAX_SESSIONS on
+  # recompiles with almost no training done.
+  if [ "$RAN_SECONDS" -ge $((${SESSION_SECONDS:-1200} / 2)) ]; then
+    sessions=$((sessions + 1))
+  fi
+  echo "=== rc=$rc ran=${RAN_SECONDS}s session_count=$sessions"
+  # Relaunch ONLY on the supervisor's session-clock expiry / yield (124)
+  # or an external kill (137/143): resume next session. Any other exit
+  # means the measurement settled — rc=0 reached, rc=1 budget-exhausted
   # reached=false, rc=3 refused (already complete) — and relaunching
   # would append one duplicate reached=false ledger row per session.
   case "$rc" in 124|137|143) sleep 5 ;; *) break ;; esac

@@ -1,0 +1,31 @@
+#!/bin/bash
+# CPU learnability probe for the pixel-path RECIPE ECONOMICS (round 5).
+#
+# Why not probe the pixel preset itself on CPU: the IMPALA-CNN costs
+# ~540 MFLOP per env frame end to end (docs/MFU.md FLOP ledger), so one
+# CPU core sustains only ~100-300 pixel fps — an overnight run is
+# 10-40M frames, far below where pixel-Pong shows any learning signal.
+# A CPU pixel probe is uninformative either way (tried 2026-07-31;
+# session produced no measurable window in 10 minutes).
+#
+# What IS CPU-testable overnight: the part of pong_pixels_t2t that is
+# NEW relative to the proven vector recipe — the skip-4 episode
+# economics (gamma 0.995^4~=0.98, step_cost 0.01x4=0.04, ALE cap under
+# frame_skip=4). This probe runs those economics on the VECTOR env
+# (same game dynamics, 6-dim obs, MLP torso) at vector speeds (~50k
+# fps/core -> 1.5B+ frames overnight). Judgment: compare
+# runs/pong18_skip4_cpu/metrics.jsonl env_steps-vs-return against the
+# proven skip-1 vector trajectory (runs/pong18_tpu, which crossed ~0
+# return around 1-2B decisions) — per-CORE-FRAME learning efficiency
+# should be comparable (1 skip-4 decision = 4 core frames); stagnation
+# far below that line falsifies the re-derived gamma/step_cost before
+# they cost a chip window. The CNN-representation question remains
+# chip-gated either way.
+#
+#   nohup bash scripts/cpu_recipe_probe.sh > /tmp/cpu_recipe_probe.log 2>&1 &
+set -u
+exec bash "$(dirname "$0")/cpu_probe_loop.sh" \
+  pong_pixels_t2t "${1:-runs/pong18_skip4_cpu}" \
+  env_id=JaxPong-v0 torso=mlp frame_pool=false \
+  num_envs=256 grad_accum=1 remat=false updates_per_call=8 \
+  learning_rate=1.5e-4 eval_every=200 eval_episodes=8
