@@ -340,6 +340,11 @@ def main() -> int:
     entry = {
         "kind": "time_to_target",
         "preset": preset_name,
+        # The env actually trained (an override can retarget a preset —
+        # e.g. the CPU recipe probe runs pong_pixels_t2t's economics on
+        # the VECTOR env; without this field that row would read as a
+        # pixel-path result).
+        "env_id": cfg.env_id,
         **dev,
         "target_return": target_return,
         "reached": status["reached"],
@@ -382,6 +387,9 @@ def main() -> int:
             if "JaxPong" in cfg.env_id
             else {}
         ),
+        # Decisions-per-core-frame context: a skip-4 row's seconds/fps
+        # count agent decisions, 4 core frames each.
+        **({"frame_skip": cfg.frame_skip} if cfg.frame_skip != 1 else {}),
         # Consistent with "seconds": averaged over ALL accumulated sessions
         # (window-fps mean, weights carried through the sidecar).
         "mean_fps": round(

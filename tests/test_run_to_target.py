@@ -86,6 +86,7 @@ def test_unconfirmed_crossing_is_not_banked(monkeypatch, tmp_path):
     assert rc == 1  # not reached
     (row,) = rows
     assert row["reached"] is False
+    assert row["env_id"] == "CartPole-v1"  # the env actually trained
     assert row["unconfirmed_crossings"] == 1
     assert row["confirm_return"] == 10.0
     # The confirmation is the protocol's guarantee: >= 64 fresh-seed
