@@ -281,6 +281,22 @@ pong_t2t_1024 = pong_t2t.replace(num_envs=1024, learning_rate=2e-4)
 # pong_max_steps so the judge can tell the bars apart.
 pong_t2t_ale = pong_t2t.replace(pong_max_steps=ALE_MAX_STEPS)
 
+# ALE-faithful t2t at ALE's own frame skip: PongNoFrameskip-v4 is ALWAYS
+# played through skip-4 preprocessing (the "NoFrameskip" name means the
+# EMULATOR doesn't skip — the agent wrapper does), so the most faithful
+# vector reading of "wall-clock to 18.0" is 27,000 skip-4 decisions =
+# 108,000 core frames, not pong_t2t_ale's skip-1 compression. Recipe =
+# the skip-4 economics validated by the CPU probe (runs/pong18_skip4_cpu:
+# return crossed zero at ~48M decisions, eval ~10 by 150M — vs billions
+# for the skip-1 arms): gamma 0.995^4, step_cost 0.01x4. If the CPU
+# trajectory transfers to chip fps, this is the arm that attacks the
+# <10-minute BASELINE.json:2 target directly.
+pong_t2t_ale4 = pong_t2t_ale.replace(
+    frame_skip=4,
+    gamma=0.98,
+    step_cost=0.04,
+)
+
 # The PIXEL-path 18.0 hunt (VERDICT r4 Next #2): the reference flagship's
 # real shape — BASELINE.json:8 is PongNoFrameskip-v4, i.e. 84x84x4 pixel
 # observations with ALE episode semantics — where the vector arms above
@@ -339,6 +355,7 @@ PRESETS: dict[str, Config] = {
     "pong_t2t": pong_t2t,
     "pong_t2t_1024": pong_t2t_1024,
     "pong_t2t_ale": pong_t2t_ale,
+    "pong_t2t_ale4": pong_t2t_ale4,
     "pong_pixels_t2t": pong_pixels_t2t,
     "pong_selfplay": pong_selfplay,
     "atari_impala": atari_impala,
