@@ -281,16 +281,22 @@ pong_t2t_1024 = pong_t2t.replace(num_envs=1024, learning_rate=2e-4)
 # pong_max_steps so the judge can tell the bars apart.
 pong_t2t_ale = pong_t2t.replace(pong_max_steps=ALE_MAX_STEPS)
 
-# ALE-faithful t2t at ALE's own frame skip: PongNoFrameskip-v4 is ALWAYS
-# played through skip-4 preprocessing (the "NoFrameskip" name means the
-# EMULATOR doesn't skip — the agent wrapper does), so the most faithful
-# vector reading of "wall-clock to 18.0" is 27,000 skip-4 decisions =
-# 108,000 core frames, not pong_t2t_ale's skip-1 compression. Recipe =
-# the skip-4 economics validated by the CPU probe (runs/pong18_skip4_cpu:
-# return crossed zero at ~48M decisions, eval ~10 by 150M — vs billions
-# for the skip-1 arms): gamma 0.995^4, step_cost 0.01x4. If the CPU
-# trajectory transfers to chip fps, this is the arm that attacks the
-# <10-minute BASELINE.json:2 target directly.
+# ALE-style frame-skip EXPERIMENT (retired from the chip queue, round 5):
+# PongNoFrameskip-v4 is always played through skip-4 preprocessing, so
+# this preset reads the ALE bar at 27,000 skip-4 decisions = 108,000 core
+# frames, with the skip-4-scaled recipe (gamma 0.995^4, step_cost
+# 0.01x4). The CPU probe validated the recipe LEARNS fast (zero crossing
+# at ~48M decisions, runs/pong18_skip4_cpu) — but the skip-4 ORACLE
+# (scripts/pong_oracle.py, kind=feasibility) showed this game's
+# kinematics cap skip-4 greedy play far below the bar: one-ply ceiling
+# 7.9 vs the per-core-step rival, and 11.25 after the rival was
+# decision-quantized for balance AND the cap raised so every game runs
+# to completion (win-margin semantics, cap 6000; the skip-1 comparator
+# measures 19.25 at completion cap) — the paddle moves 2.5 half-heights
+# per decision, so the spin exploit's contact precision is unreachable. JaxPong's court physics are calibrated for skip-1
+# control; 18.0 under skip-4 is NOT a meaningful bar here, and the
+# skip-1 `pong_t2t_ale` remains the parity claim. Kept as a preset for
+# the CPU experiment arm; do not spend chip windows on it.
 pong_t2t_ale4 = pong_t2t_ale.replace(
     frame_skip=4,
     gamma=0.98,
@@ -300,35 +306,35 @@ pong_t2t_ale4 = pong_t2t_ale.replace(
 # The PIXEL-path 18.0 hunt (VERDICT r4 Next #2): the reference flagship's
 # real shape — BASELINE.json:8 is PongNoFrameskip-v4, i.e. 84x84x4 pixel
 # observations with ALE episode semantics — where the vector arms above
-# measure the same game from its 6-dim state. Semantics: frame_skip=4 +
-# 2-frame max-pool (the NoFrameskip-v4 preprocessing stack; sticky actions
-# stay 0.0 because v4 sets repeat_action_probability=0 — sticky is the
-# v5/Machado protocol) and the ALE cap (27,000 decisions x 4 = 108,000
-# frames). Geometry: the 1024-env/chip fit (atari_impala + grad_accum=4 +
-# block remat, the measured ~15.7G HBM footprint).
+# measure the same game from its 6-dim state. Geometry: the 1024-env/chip
+# fit (atari_impala + grad_accum=4 + block remat, the measured ~15.7G HBM
+# footprint); ALE cap (pong_max_steps=27,000 decisions).
 #
-# Recipe, re-derived from pong_t2t at skip-4 (each decision now spans 4
-# core frames, so per-decision economics scale by 4):
-#   gamma    0.995^4 ~= 0.980 — same credit horizon in CORE frames.
-#   step_cost 0.01x4 = 0.04   — same per-point shaped price (a ~184
-#                               core-frame point is ~46 decisions).
-#   lr 3e-4 — between pong_t2t's 1.5e-4 (256 envs) and the 1024-env
-#             arm's 2e-4, scaled for the 4x larger per-update batch; a
-#             first-recipe hypothesis like pong_t2t_1024's lr (it gets no
-#             headline until it has a curve).
-#   updates_per_call 8 — the pixel benches' call fusion (compile cost).
+# frame_skip=1, NOT ALE's skip-4 — a feasibility decision, not an
+# oversight (round 5): the skip-4 oracle (scripts/pong_oracle.py,
+# kind=feasibility rows) showed JaxPong's skip-1-calibrated kinematics
+# cap skip-4 greedy play at ~11 — the 18.0 bar is unreachable under
+# skip-4 regardless of observations (see pong_t2t_ale4 above). At skip-1
+# the bar is proven reachable: this preset's VECTOR twin (pong_t2t_ale)
+# evaluates 20+. The skip-4/max-pool/sticky knobs remain available
+# (frame_skip=4 frame_pool=true sticky_actions=0.25 overrides) for
+# strict-ALE-preprocessing runs that accept the lower ceiling.
+#
+# Recipe: the PROVEN skip-1 t2t economics (pong_t2t: gamma 0.995,
+# step_cost 0.01, entropy floor 1e-4), with lr 3e-4 for the 4x bigger
+# 1024-env per-update batch (a first-recipe hypothesis like
+# pong_t2t_1024's lr — no headline until it has a curve) and the pixel
+# benches' updates_per_call=8 call fusion.
 #
 # Frames-to-18 expectation (stated BEFORE the arm runs, so the curve can
-# falsify it): the vector arm reached 18.0 under this cap at ~18.0B agent
-# decisions = 18.0B core frames (runs/pong18_tpu metrics.jsonl, frame_skip
-# 1). If sample efficiency is bounded by game experience (core frames),
-# the pixel arm needs the same ~18B core frames = ~4.5B decisions; pixel
-# representation learning (recovering the 6-dim state from 84x84x4) adds
-# an unknown factor we bound at 1-3x, so the expectation is 4.5B-13.5B
-# decisions. At the measured 45,984 decisions/s 1024-fit throughput
-# (skip-4 rendering will shave this further), that is ~27-80 chip-hours —
-# a multi-window accumulation arm (runs/pong18_pixels), not a
-# single-session measurement.
+# falsify it): the vector twin reached 18.0 under this cap at ~18.0B
+# decisions (runs/pong18_tpu metrics.jsonl); pixel representation
+# learning (recovering the 6-dim state from 84x84x4) adds a factor we
+# bound at 1-3x => 18-54B decisions, i.e. ~110-330 chip-hours at the
+# measured 45,984 fps 1024-fit throughput. A multi-ROUND accumulation
+# arm (runs/pong18_pixels): each watcher window banks curve +
+# reached=false rows, and the MFU work (docs/MFU.md) is what shrinks the
+# wall-clock denominator.
 pong_pixels_t2t = pong_t2t.replace(
     env_id="JaxPongPixels-v0",
     torso="impala_cnn",
@@ -336,11 +342,7 @@ pong_pixels_t2t = pong_t2t.replace(
     grad_accum=4,
     remat=True,
     updates_per_call=8,
-    frame_skip=4,
-    frame_pool=True,
     pong_max_steps=ALE_MAX_STEPS,
-    gamma=0.98,
-    step_cost=0.04,
     learning_rate=3e-4,
 )
 

@@ -150,6 +150,7 @@ class Pong(Environment):
         opponent: str = "tracker",
         opponent_speed: float = 0.0,
         max_steps: int = MAX_STEPS,
+        opponent_every: int = 1,
     ):
         if opponent not in ("tracker", "predictive"):
             raise ValueError(
@@ -161,6 +162,18 @@ class Pong(Environment):
             OPP_SPEED if opponent == "tracker" else PREDICTIVE_SPEED
         )
         self._max_steps = max_steps
+        # Frame-skip game balance (round 5): with ``frame_skip`` the AGENT
+        # re-decides only every k core steps, and a per-core-step rival
+        # then plays a strictly harder game than the one the 18.0 bar was
+        # calibrated on — the skip-4 one-ply oracle collapses to ~8 vs the
+        # calibrated ~19 (scripts/pong_oracle.py, kind=feasibility).
+        # frame_skip is PREPROCESSING and must not retune difficulty, so
+        # the registry sets opponent_every = frame_skip: the rival also
+        # re-decides once per agent decision (one clipped pursuit move of
+        # k x speed on the boundary step — same per-window range, same
+        # 2x speed ratio, same variable-move-vs-fixed-move asymmetry as
+        # the calibrated skip-1 game).
+        self._opp_every = max(int(opponent_every), 1)
 
     def init(self, key: jax.Array) -> PongState:
         serve_key, side_key = jax.random.split(key)
@@ -196,8 +209,20 @@ class Pong(Environment):
                 predict_intercept(state.ball, OPP_X),
                 0.5,  # recenter while the ball recedes (classic AI habit)
             )
-        return jnp.clip(
-            target - state.opp_y, -self._opp_speed, self._opp_speed
+        if self._opp_every == 1:
+            return jnp.clip(
+                target - state.opp_y, -self._opp_speed, self._opp_speed
+            )
+        # Decision-quantized rival (see __init__): one pursuit move per
+        # agent decision, on the boundary core step, with the per-window
+        # range preserved. Stateless via state.t — episodes start at t=0
+        # and the frame-skip wrappers advance t by exactly k per decision,
+        # so t % k == 0 IS the decision boundary.
+        cap = self._opp_speed * self._opp_every
+        return jnp.where(
+            state.t % self._opp_every == 0,
+            jnp.clip(target - state.opp_y, -cap, cap),
+            0.0,
         )
 
     def step(
@@ -332,13 +357,14 @@ class PongPixels(FrameStackPixels):
         frame_skip: int = 1,
         frame_pool: bool = False,
         sticky_actions: float = 0.0,
+        opponent_every: int = 1,
     ):
         # max_steps counts CORE steps at this layer, like the vector
         # Pong's (the decision-counted Config.pong_max_steps contract is
         # applied ONCE, in registry.pong_kwargs, which pre-scales by
         # frame_skip for all pong registrations alike).
         super().__init__(
-            Pong(opponent, opponent_speed, max_steps),
+            Pong(opponent, opponent_speed, max_steps, opponent_every),
             render_state=render,
             render_last_obs=lambda lo: render_positions(
                 lo[0], lo[1], lo[4], lo[5]

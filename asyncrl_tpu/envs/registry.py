@@ -72,6 +72,10 @@ def _register_builtins() -> None:
             # 27,000 decisions x skip-4 = 108,000 core steps, exactly
             # ALE's max_num_frames_per_episode.
             "max_steps": cfg.pong_max_steps * max(cfg.frame_skip, 1),
+            # Game balance under frame_skip (envs/pong.py __init__): the
+            # scripted rival re-decides once per AGENT decision, so skip
+            # changes observation/action cadence — never difficulty.
+            "opponent_every": max(cfg.frame_skip, 1),
         }
 
     def pixel_kwargs(cfg):

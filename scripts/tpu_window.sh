@@ -9,9 +9,6 @@
 #      platform=tpu reached=true row (the r4 one was a CPU confirmation).
 #      run_to_target now banks reached=true only after a 64-episode
 #      fresh-seed confirmation eval.
-#   1b. ALE-faithful t2t at ALE's own skip-4 (pong_t2t_ale4, fresh arm)
-#      — the direct attack on the <10-minute wall-clock target; recipe
-#      CPU-validated, one session may close it.
 #   2. Fresh dual-flagship bench (bench.py driver mode: vector + pixel) —
 #      once per window, so every round's BENCH artifact has a same-round
 #      TPU pair.
@@ -239,7 +236,6 @@ while true; do
   # reboot/restart, but a reached=true row is durable — without this the
   # completion check could never pass after a restart.
   target_reached 27000 pong_t2t_ale && touch "$STAMPS/t2t_ale"
-  target_reached 27000 pong_t2t_ale4 && touch "$STAMPS/t2t_ale4"
   target_reached 3000 "pong_t2t pong_t2t_1024" && touch "$STAMPS/t2t"
   target_reached 27000 pong_pixels_t2t && touch "$STAMPS/t2t_pix"
 
@@ -275,17 +271,10 @@ EOF
       && touch "$STAMPS/t2t_ale.permfail"
   fi
 
-  # --- 1b. ALE-faithful t2t at ALE's own skip-4 (fresh arm): the direct
-  # attack on the <10-minute wall-clock target. The skip-4 economics are
-  # CPU-validated (runs/pong18_skip4_cpu, see the preset); if the
-  # per-decision trajectory transfers to chip fps, one session closes it.
-  if ! target_reached 27000 pong_t2t_ale4 \
-     && [ ! -e "$STAMPS/t2t_ale4.permfail" ]; then
-    t2t_session pong_t2t_ale4 runs/pong18_ale4
-    target_reached 27000 pong_t2t_ale4 && touch "$STAMPS/t2t_ale4"
-    budget_spent "$BUDGET" runs/pong18_ale4 \
-      && touch "$STAMPS/t2t_ale4.permfail"
-  fi
+  # (A skip-4 ALE arm briefly held this slot; retired after the skip-4
+  # oracle showed the bar is kinematically unreachable at frame_skip=4 —
+  # see pong_t2t_ale4's preset comment. The CPU probe arm continues the
+  # skip-4 experiment off-chip.)
 
   # --- 2. Fresh dual-flagship bench, once per window.
   run_job "bench_w$WINDOW" 900 python bench.py || continue
@@ -367,7 +356,7 @@ EOF
   run_job selfplay_exp 900 python scripts/selfplay_experiment.py 400000000 updates_per_call=32 step_cost=0.005 || continue
   commit_ledger
 
-  if settled t2t_ale && settled t2t_ale4 && settled t2t && settled t2t_pix \
+  if settled t2t_ale && settled t2t && settled t2t_pix \
      && settled "bench_w$WINDOW" \
      && settled eval_caps_tpu && settled pixel_bench \
      && settled roofline_pong && settled roofline_atari \

@@ -114,8 +114,12 @@ def test_pong_pixels_t2t_preset_trains(devices):
     and the fit geometry (grad_accum + remat) must train end to end at
     tiny shapes."""
     base = presets.get("pong_pixels_t2t")
-    assert base.frame_skip == 4
-    assert base.frame_pool is True
+    # frame_skip=1 is a FEASIBILITY decision (skip-4 greedy play is
+    # kinematically capped ~11, far below the 18.0 bar — see the preset
+    # and the kind=feasibility oracle rows); the proven skip-1 recipe
+    # rides along.
+    assert base.frame_skip == 1
+    assert base.gamma == 0.995 and base.step_cost == 0.01
     assert base.sticky_actions == 0.0  # v4 semantics: no sticky actions
     assert base.pong_max_steps == 27_000
     assert base.grad_accum == 4 and base.remat is True
