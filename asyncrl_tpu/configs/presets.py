@@ -295,8 +295,13 @@ pong_t2t_ale = pong_t2t.replace(pong_max_steps=ALE_MAX_STEPS)
 # measures 19.25 at completion cap) — the paddle moves 2.5 half-heights
 # per decision, so the spin exploit's contact precision is unreachable. JaxPong's court physics are calibrated for skip-1
 # control; 18.0 under skip-4 is NOT a meaningful bar here, and the
-# skip-1 `pong_t2t_ale` remains the parity claim. Kept as a preset for
-# the CPU experiment arm; do not spend chip windows on it.
+# skip-1 `pong_t2t_ale` remains the parity claim. Retired as a BAR —
+# but reborn as a CURRICULUM phase: the CPU probe showed skip-4
+# training + skip-1 finish crosses the ALE bar at ~6x fewer core frames
+# than pure skip-1 (runs/pong18_skip4_cpu reached=true at 0.74B
+# decisions, confirmation 18.72), so the watcher's pong18_curr arm runs
+# one short skip-4 burst under this preset before finishing under
+# pong_t2t_ale.
 pong_t2t_ale4 = pong_t2t_ale.replace(
     frame_skip=4,
     gamma=0.98,

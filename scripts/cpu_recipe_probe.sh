@@ -21,11 +21,19 @@
 # far below that line falsifies the re-derived gamma/step_cost before
 # they cost a chip window. The CNN-representation question remains
 # chip-gated either way.
+# STATUS: the original arm (runs/pong18_skip4_cpu) SETTLED reached=true
+# on 2026-08-01 via the coarse-to-fine path (skip-4 training + skip-1
+# finish after the preset's revert — see runs/README.md). Rerunning this
+# script against that dir refuses (completed measurement); use a fresh
+# dir for a new experiment. The skip-4 knobs are now explicit overrides
+# (the preset reverted to skip-1), so this script keeps meaning what its
+# header says regardless of preset evolution.
 #
 #   nohup bash scripts/cpu_recipe_probe.sh > /tmp/cpu_recipe_probe.log 2>&1 &
 set -u
 exec bash "$(dirname "$0")/cpu_probe_loop.sh" \
   pong_pixels_t2t "${1:-runs/pong18_skip4_cpu}" \
   env_id=JaxPong-v0 torso=mlp frame_pool=false \
+  frame_skip=4 gamma=0.98 step_cost=0.04 \
   num_envs=256 grad_accum=1 remat=false updates_per_call=8 \
   learning_rate=1.5e-4 eval_every=200 eval_episodes=8
