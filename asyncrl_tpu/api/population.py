@@ -41,7 +41,7 @@ from asyncrl_tpu.learn.learner import (
     validate_selfplay_config,
 )
 from asyncrl_tpu.models.networks import build_model, is_recurrent
-from asyncrl_tpu.parallel.mesh import dp_axes, dp_sharded, dp_size, make_mesh
+from asyncrl_tpu.parallel.mesh import dp_axes, dp_sharded, dp_size, make_mesh, shard_map
 from asyncrl_tpu.rollout.anakin import actor_init
 from asyncrl_tpu.utils.config import Config
 
@@ -175,7 +175,7 @@ class PopulationTrainer:
             opponent_params=P(axes),
         )
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 jax.vmap(body),
                 mesh=self.mesh,
                 in_specs=(spec, P(axes)),

@@ -14,6 +14,7 @@ the reference (SURVEY.md §7.3).
 
 from __future__ import annotations
 
+import os
 import queue
 import sys
 import threading
@@ -40,6 +41,7 @@ from asyncrl_tpu.rollout.sebulba import (
     make_host_pool,
     make_inference_fn,
 )
+from asyncrl_tpu.utils import faults
 from asyncrl_tpu.utils.config import Config, default_eval_max_steps
 
 
@@ -59,6 +61,25 @@ class SebulbaTrainer:
         self, config: Config, spec=None, model=None, mesh=None, restore=None
     ):
         self.config = config
+        # Chaos layer (utils/faults.py): config-armed unless the operator's
+        # ASYNCRL_FAULTS is set (env wins — it is the no-code-change knob).
+        # An empty fault_spec DISARMS, so constructing a fresh agent never
+        # inherits a previous agent's armed sites in the same process.
+        # Armed BEFORE the body so the constructor's own checkpoint restore
+        # and probe pool run under the spec'd sites; disarmed again if
+        # construction fails — a half-built trainer must not leave its
+        # faults armed for whatever runs next in the process.
+        armed = not os.environ.get(faults.ENV_VAR)
+        if armed:
+            faults.arm(config.fault_spec)
+        try:
+            self._init(config, spec, model, mesh, restore)
+        except BaseException:
+            if armed:
+                faults.disarm()
+            raise
+
+    def _init(self, config, spec, model, mesh, restore):
         if config.num_envs % config.actor_threads:
             raise ValueError(
                 f"num_envs={config.num_envs} not divisible by "
@@ -137,7 +158,9 @@ class SebulbaTrainer:
         self._seq_checker = (
             FragmentSequenceChecker() if sync_debug_enabled() else None
         )
-        self._errors: "queue.Queue[tuple[int, BaseException]]" = queue.Queue()
+        self._errors: "queue.Queue[tuple[int, int, BaseException]]" = (
+            queue.Queue()
+        )
         self._stop = threading.Event()
         self._actors: list[ActorThread] = []
         # Per-slot restart counters (monotone across stop/start cycles;
@@ -152,9 +175,20 @@ class SebulbaTrainer:
         self._actor_restarts = 0
         self._recent_restarts: list[float] = []
         self._RESTART_WINDOW_S = 300.0
+        # Supervised inference-server restarts (same storm window; the
+        # threshold is the actor rule at one instance: > 3 in the window).
+        self._server_restarts = 0
+        self._recent_server_restarts: list[float] = []
+        # Cumulative queue.Full retries of RETIRED actors; the live window
+        # metric adds the running actors' own counters on top.
+        self._backpressure_base = 0
         self._next_actor_seed = config.seed * 7919 + 1
         self._actor_device = None  # CpuAsyncTrainer pins actors to host CPU
         self._server = None  # shared inference server (config.inference_server)
+        # The server's OWN stop event (never the cohort's): a supervised
+        # server restart must be able to retire one server without taking
+        # every healthy actor down with it.
+        self._server_stop = threading.Event()
         # Caches built on first use but DECLARED here (no hasattr dances):
         # evaluation host pools per (num_episodes, seed), and the jitted
         # greedy fn (set lazily in evaluate — model apply shape is known
@@ -251,56 +285,184 @@ class SebulbaTrainer:
         self._stop = threading.Event()
         self._actor_gens = [g + 1 for g in self._actor_gens]
         if self.config.inference_server:
-            from asyncrl_tpu.rollout.inference_server import InferenceServer
-            from asyncrl_tpu.rollout.sebulba import inference_mode
-
-            self._server = InferenceServer(
-                self._inference_fn,
-                self._store,
-                num_clients=self.config.actor_threads,
-                stop_event=self._stop,
-                mode=inference_mode(self.config, self.model),
-                seed=self.config.seed,
-                device=self._actor_device,
-            )
-            self._server.start()
+            self._spawn_server()
         self._actors = [
             self._spawn_actor(i) for i in range(self.config.actor_threads)
         ]
 
+    def _spawn_server(self) -> None:
+        """(Re)build the shared inference server on a fresh personal stop
+        event. Callers re-wire actors separately: existing clients of a
+        dead/retired server raise into their actor threads, whose restarts
+        pick up ``self._server``'s new clients."""
+        from asyncrl_tpu.rollout.inference_server import InferenceServer
+        from asyncrl_tpu.rollout.sebulba import inference_mode
+
+        self._server_stop = threading.Event()
+        self._server = InferenceServer(
+            self._inference_fn,
+            self._store,
+            num_clients=self.config.actor_threads,
+            stop_event=self._server_stop,
+            # Decorrelate the restarted server's action-sampling key
+            # stream from its predecessor's.
+            seed=self.config.seed + 1_000_003 * self._server_restarts,
+            mode=inference_mode(self.config, self.model),
+            device=self._actor_device,
+        )
+        self._server.start()
+
     def _supervise(self) -> None:
-        """Restart dead actors; re-raise only if failures repeat rapidly
-        (SURVEY.md §5.3 — dead actor restarted with fresh env). "Rapidly"
-        means within ``_RESTART_WINDOW_S``: sporadic transient failures over
-        a long run recover indefinitely; a crash loop aborts."""
+        """The reap loop: rebuild a dead/hung inference server, restart
+        dead actors (SURVEY.md §5.3 — fresh env pool each time), retire and
+        replace HUNG actors via the heartbeat watchdog, and re-raise only
+        if failures repeat rapidly. "Rapidly" means within
+        ``_RESTART_WINDOW_S``: sporadic transient failures over a long run
+        recover indefinitely; a crash loop aborts."""
         from asyncrl_tpu.rollout.inference_server import InvariantViolation
 
+        self._supervise_server()
+        self._supervise_stalled_actors()
         try:
             while True:
-                index, err = self._errors.get_nowait()
+                index, gen, err = self._errors.get_nowait()
                 if isinstance(err, InvariantViolation):
                     # §5.2b failures are integrity bugs, not transient actor
-                    # faults: abort NOW instead of churning restarts.
+                    # faults: abort NOW instead of churning restarts (even
+                    # when reported by an already-replaced generation).
                     self.stop()
                     raise err
-                now = time.monotonic()
-                self._actor_restarts += 1
-                self._recent_restarts.append(now)
-                self._recent_restarts = [
-                    t for t in self._recent_restarts
-                    if now - t < self._RESTART_WINDOW_S
-                ]
-                if len(self._recent_restarts) > 3 * self.config.actor_threads:
-                    self.stop()
-                    raise RuntimeError(
-                        f"actor {index} failed repeatedly "
-                        f"({len(self._recent_restarts)} restarts in "
-                        f"{self._RESTART_WINDOW_S}s)"
-                    ) from err
-                self._actor_gens[index] += 1
-                self._actors[index] = self._spawn_actor(index)
+                if gen != self._actor_gens[index]:
+                    # A thread the supervisor already retired (watchdog
+                    # abandonment racing the thread's own death report):
+                    # ONE failure must not restart the slot twice — the
+                    # second restart would orphan the live replacement.
+                    continue
+                self._restart_actor(index, err)
         except queue.Empty:
             pass
+
+    def _storm_guard(
+        self,
+        stamps: list[float],
+        threshold: int,
+        what: str,
+        cause: BaseException | None,
+    ) -> None:
+        """ONE sliding-window storm policy for every supervised component:
+        record a restart, prune the window, abort past the threshold."""
+        now = time.monotonic()
+        stamps.append(now)
+        stamps[:] = [t for t in stamps if now - t < self._RESTART_WINDOW_S]
+        if len(stamps) > threshold:
+            self.stop()
+            raise RuntimeError(
+                f"{what} failed repeatedly ({len(stamps)} restarts in "
+                f"{self._RESTART_WINDOW_S}s)"
+            ) from cause
+
+    def _restart_actor(self, index: int, err: BaseException | None) -> None:
+        """Retire actor ``index`` (already dead or abandoned) and spawn its
+        replacement, aborting on a restart storm."""
+        self._actor_restarts += 1
+        self._storm_guard(
+            self._recent_restarts, 3 * self.config.actor_threads,
+            f"actor {index}", err,
+        )
+        self._actor_gens[index] += 1
+        self._backpressure_base += self._actors[index].backpressure
+        self._actors[index] = self._spawn_actor(index)
+
+    def _supervise_stalled_actors(self) -> None:
+        """Heartbeat watchdog (config.stall_timeout_s > 0): an actor whose
+        progress stamp went stale is HUNG — a raised exception would have
+        landed in the error queue — so retire it through its personal
+        abandon event and restart, under the same storm accounting as a
+        crash. A thread wedged past the join window is abandoned exactly
+        like stop()'s timeout path (it can only exit, never produce: its
+        puts check the abandon event, and generations already advanced)."""
+        timeout_s = self.config.stall_timeout_s
+        if timeout_s <= 0 or not self._actors:
+            return
+        now = time.monotonic()
+        for index, actor in enumerate(self._actors):
+            if not actor.is_alive():
+                continue  # crashed, not hung: the error path owns it
+            if now - actor.heartbeat <= timeout_s:
+                continue
+            actor.abandon.set()
+            actor.join(timeout=1.0)
+            if actor.is_alive():
+                print(
+                    f"asyncrl_tpu: hung actor {actor.index} did not join "
+                    "within 1s; abandoning thread (it exits at its next "
+                    "abandon-event check)",
+                    file=sys.stderr,
+                )
+            self._restart_actor(
+                index,
+                RuntimeError(
+                    f"actor {index} made no progress for more than "
+                    f"{timeout_s}s (heartbeat watchdog)"
+                ),
+            )
+
+    def _supervise_server(self) -> None:
+        """Supervised inference-server restart: a server thread that died
+        (any exception — recorded in ``_fatal``) or hung (stale heartbeat
+        under the watchdog) is retired via its personal stop event and
+        rebuilt. Its orphaned clients raise the real cause into their
+        actor threads, whose restarts wire up to the new server. An
+        ``InvariantViolation`` death aborts instead — transport-integrity
+        bugs must never feed a restart loop."""
+        server = self._server
+        if server is None or self._stop.is_set():
+            return
+        from asyncrl_tpu.rollout.inference_server import InvariantViolation
+
+        fatal = server._fatal
+        if isinstance(fatal, InvariantViolation):
+            self.stop()
+            raise fatal
+        hung = (
+            self.config.stall_timeout_s > 0
+            and server.is_alive()
+            and time.monotonic() - server.heartbeat
+            > self.config.stall_timeout_s
+        )
+        if server.is_alive() and not hung:
+            return
+        # Authoritative _fatal re-read: the cause is written just before
+        # the thread exits, so the first read above can race it — but once
+        # is_alive() is False the assignment is guaranteed visible. Without
+        # this, an InvariantViolation landing in that window would feed a
+        # rebuild instead of the abort the policy promises.
+        fatal = server._fatal or fatal
+        if isinstance(fatal, InvariantViolation):
+            self.stop()
+            raise fatal
+        self._server_restarts += 1
+        # The actor storm rule at one instance: > 3 in the window aborts.
+        self._storm_guard(
+            self._recent_server_restarts, 3, "inference server", fatal
+        )
+        self._server_stop.set()  # wake blocked clients of the old server
+        server.join(timeout=5.0)
+        if server.is_alive():
+            print(
+                "asyncrl_tpu: hung inference server did not join within "
+                "5s; abandoning thread (its stop event stays set)",
+                file=sys.stderr,
+            )
+        self._spawn_server()
+        # Actors were likely blocked on the dead server; their stamps are
+        # stale through no fault of their own — refresh so the stall
+        # watchdog doesn't double-count the outage against them. Stamped
+        # AFTER the join above (which can eat seconds on a wedged server);
+        # an earlier timestamp could already be past stall_timeout_s.
+        refreshed = time.monotonic()
+        for actor in self._actors:
+            actor.heartbeat = refreshed
 
     def _drain_queue(self) -> None:
         """Discard queued fragments — THROUGH the §5.2b checker when armed,
@@ -318,6 +480,11 @@ class SebulbaTrainer:
     def stop(self) -> None:
         """Stop actor threads (and the inference server), drain the queue."""
         self._stop.set()
+        # The server's personal event must be set BEFORE the actor joins:
+        # actors blocked in _submit wake on the SERVER's stop event, not
+        # the cohort's — setting it late would make every join below eat
+        # its full timeout against a wedged server.
+        self._server_stop.set()
         # Unblock producers stuck on a full queue.
         self._drain_queue()
         for actor in self._actors:
@@ -337,8 +504,11 @@ class SebulbaTrainer:
         # ran can still land one fragment; left queued, it would feed the
         # next train() a stale-cohort fragment.
         self._drain_queue()
+        for actor in self._actors:
+            self._backpressure_base += actor.backpressure
         self._actors = []
         if self._server is not None:
+            self._server_stop.set()
             self._server.join(timeout=5.0)
             self._server = None
 
@@ -464,6 +634,16 @@ class SebulbaTrainer:
                     agg["param_lag"] = lag_sum / (len(drained) * K)
                     agg["env_steps"] = self.env_steps
                     agg["fps"] = window_steps / max(elapsed, 1e-9)
+                    # Recovery/robustness counters (cumulative), so the
+                    # JSONL/TensorBoard record shows WHEN the pipeline
+                    # churned: supervisor restarts, actor->learner queue
+                    # backpressure, and per-site injected-fault counts.
+                    agg["actor_restarts"] = self._actor_restarts
+                    agg["server_restarts"] = self._server_restarts
+                    agg["queue_backpressure"] = self._backpressure_base + sum(
+                        a.backpressure for a in self._actors
+                    )
+                    agg.update(faults.counters())
                     ret_sum = len_sum = count = lag_sum = 0.0
                     window_steps = 0
                     # In-training greedy eval on the log boundary. Actors
@@ -538,6 +718,12 @@ class SebulbaTrainer:
         pool = self._eval_pools.get(pool_key)
         if pool is None:
             pool = make_host_pool(self.config, num_episodes, seed=seed)
+            # Evaluation runs OUTSIDE the supervised pipeline: an injected
+            # pool.step fault here would escape evaluate() un-recovered
+            # (and consume the site's deterministic RNG/max budget meant
+            # for the actor path under test), so eval pools always step
+            # unarmed.
+            pool.disarm_faults()
             self._eval_pools[pool_key] = pool
         recurrent = is_recurrent(self.model)
         # One jitted greedy fn for the trainer's lifetime (in-training

@@ -43,6 +43,14 @@ class GymnasiumHostPool:
     def __init__(self, env_id: str, num_envs: int, seed: int = 0):
         if not _HAVE_GYM:
             raise ImportError("gymnasium is not installed")
+        # Chaos layer (utils/faults.py): the SAME pool.step site the
+        # native and JAX pools wire — a chaos run must inject on whichever
+        # backend "auto" picked, never silently test nothing. The owner
+        # (ActorThread) wires ``fault_stop``; eval pools disarm.
+        from asyncrl_tpu.utils import faults
+
+        self._fault_step = faults.site("pool.step")
+        self.fault_stop = None
         self.num_envs = num_envs
         self._env = gymnasium.vector.SyncVectorEnv(
             [lambda: gymnasium.make(env_id) for _ in range(num_envs)],
@@ -76,12 +84,20 @@ class GymnasiumHostPool:
         if self.spec.continuous:
             actions = np.clip(actions, self._act_low, self._act_high)
         obs, rew, term, trunc, _info = self._env.step(actions)
-        return (
+        out = (
             np.asarray(obs, np.float32),
             np.asarray(rew, np.float32),
             np.asarray(term, bool),
             np.asarray(trunc, bool),
         )
+        if self._fault_step is not None:
+            out = self._fault_step.fire(stop=self.fault_stop, payload=out)
+        return out
+
+    def disarm_faults(self) -> None:
+        """Detach this pool from the chaos layer (evaluation pools step
+        outside the supervised pipeline; see SebulbaTrainer.evaluate)."""
+        self._fault_step = None
 
     def close(self) -> None:
         self._env.close()

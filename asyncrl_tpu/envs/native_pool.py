@@ -106,6 +106,11 @@ class NativeEnvPool:
         num_threads: int = 0,
         seed: int = 0,
     ):
+        # Declared FIRST: close()/__del__ must be safe when __init__ dies
+        # anywhere below (failed build, bad env id, envpool_create
+        # failure) — a half-constructed pool has no handle to free.
+        self._handle = None
+        self._lib = None
         if env_id not in NATIVE_ENV_IDS:
             raise KeyError(
                 f"no native implementation for {env_id!r}; "
@@ -133,6 +138,14 @@ class NativeEnvPool:
         self._rew = np.empty((num_envs,), np.float32)
         self._term = np.empty((num_envs,), np.uint8)
         self._trunc = np.empty((num_envs,), np.uint8)
+        # Chaos layer (utils/faults.py): one handle fetch; None when
+        # unarmed (the hot step then pays a single identity check). The
+        # owner (ActorThread) wires ``fault_stop`` so an injected stall
+        # wakes when the thread is stopped/abandoned.
+        from asyncrl_tpu.utils import faults
+
+        self._fault_step = faults.site("pool.step")
+        self.fault_stop = None
 
     def reset(self) -> np.ndarray:
         """Re-seed (to the construction seed) and reset every env:
@@ -213,6 +226,21 @@ class NativeEnvPool:
             term_out.ctypes.data,
             trunc_out.ctypes.data,
         )
+        if self._fault_step is not None:
+            # After the C call so crash/stall model a wedged engine and
+            # corrupt poisons the full transition the caller will read —
+            # the SAME field set the JAX pool's site damages, so the one
+            # spec exercises the one recovery matrix on every backend.
+            out = self._fault_step.fire(
+                stop=self.fault_stop,
+                payload=(obs_out, rew_out, term_out, trunc_out),
+            )
+            obs_out[...], rew_out[...], term_out[...], trunc_out[...] = out
+
+    def disarm_faults(self) -> None:
+        """Detach this pool from the chaos layer (evaluation pools step
+        outside the supervised pipeline; see SebulbaTrainer.evaluate)."""
+        self._fault_step = None
 
     @property
     def spec(self):
@@ -232,12 +260,16 @@ class NativeEnvPool:
         )
 
     def close(self) -> None:
-        if self._handle:
-            self._lib.envpool_destroy(self._handle)
-            self._handle = None
+        """Idempotent, and safe on a half-constructed pool: the handle is
+        cleared BEFORE the destroy call, so even a re-entrant close (or a
+        close racing __del__ at interpreter shutdown) can never double-free
+        the C-side pool."""
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle and self._lib is not None:
+            self._lib.envpool_destroy(handle)
 
     def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
+        # No blanket try/except: close() is idempotent and handles every
+        # partial-construction state, so an exception here is a REAL bug
+        # (e.g. a double-free) that must not be masked.
+        self.close()

@@ -35,7 +35,13 @@ from asyncrl_tpu.ops.losses import (
     ppo_loss,
     qlearn_loss,
 )
-from asyncrl_tpu.parallel.mesh import dp_axes, dp_size
+from asyncrl_tpu.parallel.mesh import (
+    axis_size,
+    dp_axes,
+    dp_size,
+    reduce_grads,
+    shard_map,
+)
 from asyncrl_tpu.rollout.anakin import ActorState, actor_init, unroll
 from asyncrl_tpu.rollout.buffer import Rollout
 from asyncrl_tpu.utils.config import Config
@@ -54,7 +60,7 @@ def _psum(x, axes):
 
 
 def _axis_size(axes) -> int:
-    return 1 if not axes else jax.lax.axis_size(axes)
+    return 1 if not axes else axis_size(axes)
 
 
 def _axis_index(axes):
@@ -500,6 +506,7 @@ def _ppo_multipass(
                 return loss / _axis_size(axes), metrics
 
             grads, metrics = jax.grad(scaled_loss, has_aux=True)(params)
+            grads = reduce_grads(grads, axes)
             metrics["grad_norm"] = optax.global_norm(grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -875,6 +882,7 @@ def make_train_step(
                     grads, loss, metrics = accumulate_grads(
                         scaled_loss, state.params, rollout, n_accum
                     )
+            grads = reduce_grads(grads, axes)
             with jax.named_scope("optimizer"):
                 grad_norm = optax.global_norm(grads)
                 updates, opt_state = optimizer.update(
@@ -1002,7 +1010,7 @@ class Learner:
         wrapped = fuse_updates(body, config.updates_per_call)
 
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 wrapped, mesh=mesh, in_specs=(spec,), out_specs=(spec, P())
             ),
             donate_argnums=(0,) if config.donate_buffers else (),
@@ -1035,7 +1043,7 @@ class Learner:
 
         per_device_keys = jax.random.split(akey, dp)
         actor = jax.jit(
-            jax.shard_map(
+            shard_map(
                 shard_actor_init,
                 mesh=self.mesh,
                 in_specs=(P(axes),),

@@ -48,7 +48,14 @@ from asyncrl_tpu.ops.normalize import (
     normalizing_apply,
     update_stats,
 )
-from asyncrl_tpu.parallel.mesh import TIME_AXIS, dp_axes, dp_size
+from asyncrl_tpu.parallel.mesh import (
+    TIME_AXIS,
+    axis_size,
+    dp_axes,
+    dp_size,
+    reduce_grads,
+    shard_map,
+)
 from asyncrl_tpu.parallel.timeshard import (
     gae_timesharded,
     n_step_returns_timesharded,
@@ -344,7 +351,7 @@ class RolloutLearner:
                             entropy_coef=ec,
                         )
                     return (
-                        loss / (jax.lax.axis_size(reduce_axes) * n_accum),
+                        loss / (axis_size(reduce_axes) * n_accum),
                         (loss, metrics),
                     )
 
@@ -356,6 +363,7 @@ class RolloutLearner:
                     grads, loss, metrics = accumulate_grads(
                         scaled_loss, state.params, rollout, n_accum
                     )
+                grads = reduce_grads(grads, reduce_axes)
                 grad_norm = optax.global_norm(grads)
                 updates, opt_state = optimizer.update(
                     grads, state.opt_state, state.params
@@ -418,7 +426,7 @@ class RolloutLearner:
         # ("Array has been deleted" in every actor). The Anakin learner can
         # donate because its params never escape the update loop.
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 update_body,
                 mesh=mesh,
                 in_specs=(

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from asyncrl_tpu.ops.scan import reverse_linear_scan
-from asyncrl_tpu.parallel.mesh import TIME_AXIS
+from asyncrl_tpu.parallel.mesh import TIME_AXIS, axis_size, shard_map
 
 
 def reverse_linear_scan_timesharded(
@@ -76,7 +76,7 @@ def shift_from_next_shard(
     ``ppermute`` riding ICI); the final shard's last slot gets ``fill``
     (the bootstrap). This is the boundary exchange every one-step-lookahead
     (V-trace/GAE deltas) needs once the time axis is sharded."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return jnp.concatenate([x[1:], fill[None]], axis=0)
     # Each shard i sends its first element to shard i-1.
@@ -175,7 +175,7 @@ def n_step_returns_timesharded(
     """Time-sharded discounted n-step returns (A3C targets): the bootstrap
     folds into the LAST shard's final step; everything else is the
     distributed reverse scan."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     is_last = (idx == n - 1).astype(rewards.dtype)
     rewards_ext = rewards.at[-1].add(
@@ -194,7 +194,7 @@ def make_timesharded_solver(
     """Wrap the in-shard solver as a standalone jitted function over global
     [T, ...] arrays, time-sharded on ``axis_name`` of ``mesh``."""
 
-    solver = jax.shard_map(
+    solver = shard_map(
         lambda a, b: reverse_linear_scan_timesharded(a, b, axis_name),
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name)),

@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from asyncrl_tpu.utils import faults
+
 
 class ServerClosed(RuntimeError):
     """Raised into clients when the server stops while they wait."""
@@ -116,7 +118,15 @@ class InferenceServer(threading.Thread):
         # non-empty slot means a double-serve or an unconsumed reply —
         # the handshake discipline is broken).
         self._debug = sync_debug_enabled()
-        self._fatal: InvariantViolation | None = None
+        # The exception that killed the server thread, whatever its type:
+        # clients re-raise the REAL cause from _submit instead of a bland
+        # ServerClosed, and the trainer's supervisor reads it to decide
+        # abort (InvariantViolation) vs rebuild (anything else).
+        self._fatal: BaseException | None = None
+        # Progress stamp for the trainer's heartbeat watchdog (refreshed
+        # every collect/serve loop iteration).
+        self.heartbeat = time.monotonic()
+        self._fault_serve = faults.site("server.serve")
 
     # ------------------------------------------------------------- client
 
@@ -174,18 +184,20 @@ class InferenceServer(threading.Thread):
                     self._run()
             else:
                 self._run()
-        except InvariantViolation as e:
+        except BaseException as e:  # noqa: BLE001 — see below
             # Fatal: remember why the server died so every subsequent
-            # client call re-raises the VIOLATION (not a bland
-            # ServerClosed) — the run aborts with the real cause. The
-            # exception is NOT re-raised out of the thread: delivery to
-            # clients is the contract, and an escaping thread exception
-            # would only feed Python's unhandled-thread hook (and, under
-            # pytest, a warning that can mask a REAL stray thread crash in
-            # the same run — VERDICT r2 Weak #5). Log it instead.
+            # client call re-raises the REAL cause (not a bland
+            # ServerClosed) — an InvariantViolation aborts the run, any
+            # other death lets the trainer's supervisor rebuild the server
+            # and re-wire clients. The exception is NOT re-raised out of
+            # the thread: delivery to clients is the contract, and an
+            # escaping thread exception would only feed Python's
+            # unhandled-thread hook (and, under pytest, a warning that can
+            # mask a REAL stray thread crash in the same run — VERDICT r2
+            # Weak #5). Log it instead.
             self._fatal = e
             print(
-                f"InferenceServer: fatal invariant violation: {e}",
+                f"InferenceServer: fatal {type(e).__name__}: {e}",
                 file=sys.stderr,
             )
         finally:
@@ -195,8 +207,14 @@ class InferenceServer(threading.Thread):
 
     def _run(self) -> None:
         while not self._stop_event.is_set():
+            self.heartbeat = time.monotonic()
             batch = self._collect()
             if batch:
+                if self._fault_serve is not None:
+                    # Outside _serve's per-request try: an injected crash
+                    # kills the SERVER (recorded in _fatal, recovered by
+                    # the trainer's rebuild), not just one batch.
+                    self._fault_serve.fire(stop=self._stop_event.is_set)
                 self._serve(batch)
 
     def _collect(self):

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -34,6 +35,7 @@ from asyncrl_tpu.models.networks import is_recurrent, reset_core
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.ops.normalize import normalize
 from asyncrl_tpu.rollout.buffer import Rollout, RolloutBuffer
+from asyncrl_tpu.utils import faults
 
 
 class ParamStore:
@@ -192,6 +194,12 @@ class JaxHostPool:
             )
             self._key = jax.random.PRNGKey(seed)
         self._state = None
+        # Chaos layer (utils/faults.py): one handle fetch; None when
+        # unarmed, so the hot step pays a single identity check. The owner
+        # (ActorThread) wires ``fault_stop`` so an injected stall wakes
+        # when the thread is stopped/abandoned.
+        self._fault_step = faults.site("pool.step")
+        self.fault_stop = None
 
     def reset(self) -> np.ndarray:
         """Deterministic: restart the key stream from the construction
@@ -208,12 +216,20 @@ class JaxHostPool:
         with jax.default_device(self._cpu):
             self._key, sub = jax.random.split(self._key)
             self._state, ts = self._step(self._state, jnp.asarray(actions), sub)
-        return (
+        out = (
             np.asarray(ts.obs),
             np.asarray(ts.reward),
             np.asarray(ts.terminated),
             np.asarray(ts.truncated),
         )
+        if self._fault_step is not None:
+            out = self._fault_step.fire(stop=self.fault_stop, payload=out)
+        return out
+
+    def disarm_faults(self) -> None:
+        """Detach this pool from the chaos layer (evaluation pools step
+        outside the supervised pipeline; see SebulbaTrainer.evaluate)."""
+        self._fault_step = None
 
     def close(self) -> None:
         self._state = None
@@ -421,7 +437,7 @@ class ActorThread(threading.Thread):
         unroll_len: int,
         seed: int,
         stop_event: threading.Event,
-        errors: "queue.Queue[tuple[int, BaseException]]",
+        errors: "queue.Queue[tuple[int, int, BaseException]]",
         device=None,
         initial_core: Callable[[int], Any] | None = None,
         epsilon_fn: Callable[[int], np.ndarray] | None = None,
@@ -462,6 +478,33 @@ class ActorThread(threading.Thread):
         # to host CPU (never touching an attached accelerator); sebulba
         # leaves None (batched inference on the accelerator is the point).
         self.device = device
+        # Per-thread retirement signal: the watchdog abandons a HUNG thread
+        # through this (the cohort stop event would take every healthy
+        # sibling down with it). An abandoned thread exits at its next
+        # check and its late error/fragment output is discarded.
+        self.abandon = threading.Event()
+        # Progress stamp for the trainer's heartbeat watchdog: refreshed
+        # every iteration of the production loop (including the bounded-
+        # queue retry loop — a backpressured actor is alive, not hung).
+        self.heartbeat = time.monotonic()
+        # queue.Full retries observed on the fragment handoff (exported via
+        # the metrics window as ``queue_backpressure``): how often actors
+        # out-ran the learner+queue. Plain int under the GIL; the trainer
+        # only ever reads it.
+        self.backpressure = 0
+        # Chaos layer handles (None when unarmed — hot loop pays one
+        # identity check per iteration; utils/faults.py).
+        self._fault_step = faults.site("actor.step")
+        self._fault_put = faults.site("actor.queue_put")
+        # An injected pool.step stall must wake when THIS thread is
+        # stopped/abandoned (a chaos stall has to stay abandonable, like
+        # the wedged engine it models); harmless no-op on pools without an
+        # armed site.
+        self.pool.fault_stop = self._stopped
+
+    def _stopped(self) -> bool:
+        """Cohort shutdown OR individual watchdog retirement."""
+        return self.stop_event.is_set() or self.abandon.is_set()
 
     def run(self) -> None:  # noqa: D102 — thread entry
         try:
@@ -471,10 +514,14 @@ class ActorThread(threading.Thread):
             else:
                 self._run()
         except BaseException as e:  # report, don't die silently (§5.3)
-            # ...unless the run is shutting down: an inference call (or
-            # server client) interrupted by stop() is not a failure.
-            if not self.stop_event.is_set():
-                self.errors.put((self.index, e))
+            # ...unless the run is shutting down (or the watchdog already
+            # retired this thread): an inference call (or server client)
+            # interrupted by stop()/abandonment is not a failure. The
+            # generation stamp lets the supervisor drop an error from a
+            # thread it ALREADY replaced (a wedged actor can both trip the
+            # watchdog and deliver its exception — one failure, not two).
+            if not self._stopped():
+                self.errors.put((self.index, self.generation, e))
         finally:
             close = getattr(self.pool, "close", None)
             if close is not None:
@@ -501,7 +548,7 @@ class ActorThread(threading.Thread):
         frames = 0  # this thread's cumulative env frames (for epsilon_fn)
         seq = 0  # fragment counter (§5.2b transport invariant stamp)
 
-        while not self.stop_event.is_set():
+        while not self._stopped():
             params, version = self.store.get()
             # ε is fragment-constant (same anneal granularity as Anakin).
             eps = (
@@ -520,6 +567,9 @@ class ActorThread(threading.Thread):
                 done_prev = np.zeros((B,), bool)
                 init_core = jax.tree.map(np.asarray, core)
             while not buffer.full:
+                self.heartbeat = time.monotonic()
+                if self._fault_step is not None:
+                    self._fault_step.fire(stop=self._stopped)
                 if core is not None and eps is not None:
                     actions_d, logp_d, key, core = self.inference_fn(
                         params, obs, key, core, done_prev, eps
@@ -572,10 +622,21 @@ class ActorThread(threading.Thread):
                 actor=self.index, gen=self.generation, seq=seq,
             )
             seq += 1
-            # Bounded put that stays responsive to shutdown.
-            while not self.stop_event.is_set():
+            if self._fault_put is not None:
+                corrupted = self._fault_put.fire(
+                    stop=self._stopped, payload=fragment.rollout.rewards
+                )
+                if corrupted is not fragment.rollout.rewards:
+                    fragment.rollout = fragment.rollout.replace(
+                        rewards=corrupted
+                    )
+            # Bounded put that stays responsive to shutdown (and to the
+            # watchdog retiring this thread mid-backpressure).
+            while not self._stopped():
                 try:
                     self.out_queue.put(fragment, timeout=0.1)
                     break
                 except queue.Full:
+                    self.backpressure += 1
+                    self.heartbeat = time.monotonic()
                     continue

@@ -18,11 +18,14 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 import traceback
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+from asyncrl_tpu.utils import faults
 
 STATE_KEY = "state"
 META_KEY = "meta"
@@ -44,7 +47,18 @@ class Checkpointer:
     Saves are keyed by learner ``update_step``; ``max_to_keep`` old steps are
     retained. ``meta`` carries host-side scalars (env_steps) that live
     outside the device pytree.
+
+    Resilience: each save attempt retries up to ``SAVE_RETRIES`` times with
+    exponential backoff (transient filesystem hiccups must not kill a
+    training run over a PERIODIC save), and a latest-step restore falls
+    back through older retained steps when the newest one is truncated or
+    structurally invalid — both paths exercised deterministically by the
+    ``checkpoint.save`` / ``checkpoint.restore`` fault sites
+    (utils/faults.py).
     """
+
+    SAVE_RETRIES = 3
+    SAVE_BACKOFF_S = 0.05
 
     def __init__(
         self, directory: str, max_to_keep: int = 3, create: bool = True
@@ -94,11 +108,36 @@ class Checkpointer:
             # to keep the no-checkpoint window (delete -> rewrite complete)
             # as short as possible.
             self._mngr.delete(step)
-            self._do_save(step, state, env_steps)
+            self._save_with_retry(step, state, env_steps)
             self._mngr.wait_until_finished()
         else:
-            self._do_save(step, state, env_steps)
+            self._save_with_retry(step, state, env_steps)
         self._last_saved = step
+
+    def _save_with_retry(self, step: int, state: Any, env_steps: int) -> None:
+        """Bounded retry with exponential backoff around one save. The
+        ``checkpoint.save`` fault site fires before each attempt, so an
+        injected crash exercises exactly this loop. Exhausted retries
+        re-raise — callers (``finalize``'s crash path) decide policy."""
+        fault = faults.site("checkpoint.save")
+        delay = self.SAVE_BACKOFF_S
+        for attempt in range(self.SAVE_RETRIES):
+            try:
+                if fault is not None:
+                    fault.fire()
+                self._do_save(step, state, env_steps)
+                return
+            except Exception as e:
+                if attempt == self.SAVE_RETRIES - 1:
+                    raise
+                print(
+                    f"asyncrl_tpu: checkpoint save of step {step} failed "
+                    f"({type(e).__name__}: {e}); retrying in {delay:.2f}s "
+                    f"({attempt + 1}/{self.SAVE_RETRIES - 1})",
+                    file=sys.stderr,
+                )
+                time.sleep(delay)
+                delay *= 2
 
     def _do_save(self, step: int, state: Any, env_steps: int) -> None:
         meta = {"env_steps": int(env_steps)}
@@ -166,13 +205,45 @@ class Checkpointer:
         fallback restores the raw on-disk tree and grafts its leaves into
         the template BY PATH — new None fields simply aren't looked up, and
         a genuinely missing leaf still fails loudly with its path name.
+
+        Resilience: with ``step=None`` (restore-the-latest — the crash
+        auto-resume path), a step that fails to restore — truncated files,
+        tree-structure validation failure the graft cannot repair, or an
+        injected ``checkpoint.restore`` fault — is SKIPPED with a logged
+        warning and the previous retained step is tried, oldest-last; only
+        when every retained step fails does the restore abort. An
+        EXPLICITLY requested step never falls back: the operator asked for
+        that state, silently serving another would be worse than failing.
         """
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        if step is not None:
+            return self._restore_step(state_like, int(step))
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}"
             )
+        for i, candidate in enumerate(steps):
+            try:
+                return self._restore_step(state_like, candidate)
+            except Exception as e:
+                if i == len(steps) - 1:
+                    raise
+                print(
+                    f"asyncrl_tpu: checkpoint step {candidate} failed to "
+                    f"restore ({type(e).__name__}: {e}); falling back to "
+                    f"retained step {steps[i + 1]}",
+                    file=sys.stderr,
+                )
+        raise AssertionError("unreachable")  # loop returns or raises
+
+    def _restore_step(self, state_like: Any, step: int):
+        """Restore exactly one retained step (the graft fallback for
+        optional-field additions stays inside this unit — it repairs a
+        COMPATIBLE checkpoint; anything it can't repair propagates to the
+        multi-step fallback in ``restore``)."""
+        fault = faults.site("checkpoint.restore")
+        if fault is not None:
+            fault.fire()
         try:
             restored = self._mngr.restore(
                 int(step),

@@ -183,6 +183,20 @@ class Config:
     # threads and/or a high-latency device link; off = per-thread dispatch.
     inference_server: bool = False
 
+    # --- fault tolerance (host backends; utils/faults.py) ---
+    # Heartbeat watchdog: an actor thread or the inference server whose
+    # progress stamp is older than this many seconds is declared hung and
+    # restarted exactly like a crashed one (counted in the same restart-
+    # storm window). 0 disables the watchdog — the safe default, because a
+    # first-fragment jit compile can legitimately take minutes on a slow
+    # host; enable with a margin over your measured step time.
+    stall_timeout_s: float = 0.0
+    # Deterministic fault injection, the ASYNCRL_FAULTS grammar
+    # ("site:kind:prob:seed[:k=v,...]", ';'-separated; see utils/faults.py).
+    # Empty = unarmed (every injection site is a no-op identity check).
+    # The env var takes precedence when both are set.
+    fault_spec: str = ""
+
     # --- runtime ---
     seed: int = 0
     # Anakin backend: learner updates fused into ONE jitted call via

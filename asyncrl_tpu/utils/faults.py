@@ -1,0 +1,328 @@
+"""Deterministic fault injection for the async pipeline (chaos layer).
+
+Asynchronous actor-learner systems live or die by their tolerance of slow,
+hung, and crashed workers (Mnih et al. 2016; Laminar, arXiv:2510.12633
+makes worker-failure isolation a first-class design goal). This module is
+the half of that story tests can hold in their hands: a seed-driven
+registry of *named fault sites* threaded through the hot paths —
+
+- ``actor.step``        each ActorThread env-step iteration
+- ``actor.queue_put``   the actor->learner fragment handoff
+- ``server.serve``      each InferenceServer batched serve
+- ``pool.step``         inside the host env pool's batched step
+- ``checkpoint.save``   each Checkpointer save attempt
+- ``checkpoint.restore``each Checkpointer restore attempt
+
+each able to inject a **crash** (raise ``InjectedFault``), a configurable
+**stall** (sleep, interruptible by the caller's stop predicate), or
+**payload corruption** (NaN-poison / bit-flip a value flowing through the
+site). Whether a given call fires is decided by a per-site
+``random.Random(seed)`` stream against ``prob`` — fully deterministic for
+a fixed call sequence, independent of wall clock and of other sites.
+
+Arming
+------
+Via config (``config.fault_spec``) or environment::
+
+    ASYNCRL_FAULTS="site:kind:prob:seed[:k=v[,k=v...]]{;more-specs}"
+
+e.g. ``actor.step:crash:1.0:0:max=1`` (crash the first actor step, then
+never again), ``pool.step:stall:0.05:7:stall_s=3`` (5% of pool steps stall
+3s), ``checkpoint.save:crash:1:0:max=2``. Options: ``max`` (cap on fires;
+default unlimited), ``stall_s`` (stall duration, default 1.0).
+
+Unarmed cost
+------------
+Hot loops fetch their site handle ONCE (``faults.site(name)``); when the
+registry is unarmed that returns ``None`` and the per-iteration cost is a
+single ``is None`` check — the chaos layer compiles away.
+
+Counters
+--------
+Every fire increments a per-site counter; ``faults.counters()`` feeds the
+metrics window (``fault_<site>`` keys) so recovery activity is visible in
+JSONL/TensorBoard next to ``actor_restarts``/``server_restarts``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Any, Callable
+
+import numpy as np
+
+SITES = (
+    "actor.step",
+    "actor.queue_put",
+    "server.serve",
+    "pool.step",
+    "checkpoint.save",
+    "checkpoint.restore",
+)
+
+KINDS = ("crash", "stall", "corrupt")
+
+ENV_VAR = "ASYNCRL_FAULTS"
+
+
+class InjectedFault(RuntimeError):
+    """The crash kind: raised out of an armed site. Deliberately a plain
+    RuntimeError subclass — recovery paths must treat it like any other
+    worker failure, never special-case it (that would test nothing)."""
+
+
+class FaultSpecError(ValueError):
+    """A malformed ``ASYNCRL_FAULTS`` / ``config.fault_spec`` string."""
+
+
+class FaultSite:
+    """One armed site: kind + probability + its own deterministic RNG
+    stream + fire counter. Thread-safe (a site can be shared by several
+    actor threads; the lock serializes the RNG draw and counter)."""
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        prob: float,
+        seed: int,
+        max_fires: int | None = None,
+        stall_s: float = 1.0,
+    ):
+        if name not in SITES:
+            raise FaultSpecError(
+                f"unknown fault site {name!r}; have {SITES}"
+            )
+        if kind not in KINDS:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r}; have {KINDS}"
+            )
+        if not 0.0 <= prob <= 1.0:
+            raise FaultSpecError(f"fault prob must be in [0, 1], got {prob}")
+        self.name = name
+        self.kind = kind
+        self.prob = prob
+        self.max_fires = max_fires
+        self.stall_s = stall_s
+        # zlib.crc32, not hash(): str hashing is salted per process and
+        # would silently break cross-run determinism.
+        self._rng = random.Random(seed ^ zlib.crc32(name.encode()))
+        self._lock = threading.Lock()
+        self.fires = 0
+        self.calls = 0
+
+    def _should_fire(self) -> bool:
+        with self._lock:
+            self.calls += 1
+            if self.max_fires is not None and self.fires >= self.max_fires:
+                return False
+            if self._rng.random() >= self.prob:
+                return False
+            self.fires += 1
+            return True
+
+    def fire(
+        self,
+        stop: Callable[[], bool] | None = None,
+        payload: Any = None,
+    ) -> Any:
+        """Evaluate the site once; returns ``payload`` (possibly corrupted).
+
+        - crash: raises :class:`InjectedFault`.
+        - stall: sleeps ``stall_s`` in 50 ms slices, waking early when the
+          caller's ``stop`` predicate turns true — a stalled worker must
+          stay abandonable, like a real wedged worker whose thread the
+          supervisor gives up on.
+        - corrupt: returns a damaged copy of ``payload`` (NaN-poison for
+          float arrays, bit-flip for ints/bools); payload-less sites
+          degrade corrupt to a no-op (nothing to damage).
+        """
+        if not self._should_fire():
+            return payload
+        if self.kind == "crash":
+            raise InjectedFault(
+                f"injected crash at fault site {self.name!r} "
+                f"(fire {self.fires}/{self.max_fires or 'inf'})"
+            )
+        if self.kind == "stall":
+            deadline = time.monotonic() + self.stall_s
+            while time.monotonic() < deadline:
+                if stop is not None and stop():
+                    break
+                time.sleep(min(0.05, max(deadline - time.monotonic(), 0.0)))
+            return payload
+        # corrupt
+        return _corrupt(payload)
+
+
+def _corrupt(payload: Any) -> Any:
+    """Deterministically damage a payload: floats go NaN in slot 0, ints
+    and bools bit-flip in slot 0; pytrees damage every array leaf. A None
+    payload passes through (the site has nothing to hand us)."""
+    if payload is None:
+        return None
+    if isinstance(payload, tuple):
+        return tuple(_corrupt(p) for p in payload)
+    if isinstance(payload, list):
+        return [_corrupt(p) for p in payload]
+    if isinstance(payload, dict):
+        return {k: _corrupt(v) for k, v in payload.items()}
+    arr = np.asarray(payload)
+    out = np.array(arr, copy=True)
+    flat = out.reshape(-1)
+    if flat.size == 0:
+        return out
+    if np.issubdtype(out.dtype, np.floating):
+        flat[0] = np.nan
+    elif out.dtype == np.bool_:
+        flat[0] = ~flat[0]
+    elif np.issubdtype(out.dtype, np.integer):
+        flat[0] = flat[0] ^ 0x55
+    return out
+
+
+def parse_spec(spec: str) -> list[FaultSite]:
+    """Parse the ``ASYNCRL_FAULTS`` grammar into sites.
+
+    ``site:kind:prob:seed[:k=v[,k=v...]]``, ``;``-separated for multiple
+    sites. Raises :class:`FaultSpecError` on any malformed field — an
+    operator's chaos run must never silently test nothing.
+    """
+    sites: list[FaultSite] = []
+    for chunk in spec.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        fields = chunk.split(":")
+        if len(fields) < 4:
+            raise FaultSpecError(
+                f"fault spec {chunk!r} needs site:kind:prob:seed "
+                "(optionally :k=v,k=v)"
+            )
+        name, kind = fields[0].strip(), fields[1].strip()
+        try:
+            prob = float(fields[2])
+            seed = int(fields[3])
+        except ValueError as e:
+            raise FaultSpecError(
+                f"fault spec {chunk!r}: bad prob/seed — {e}"
+            ) from None
+        max_fires: int | None = None
+        stall_s = 1.0
+        for extra in fields[4:]:
+            for kv in extra.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                if "=" not in kv:
+                    raise FaultSpecError(
+                        f"fault spec {chunk!r}: option {kv!r} is not k=v"
+                    )
+                k, v = kv.split("=", 1)
+                k = k.strip()
+                if k not in ("max", "stall_s"):
+                    raise FaultSpecError(
+                        f"fault spec {chunk!r}: unknown option {k!r} "
+                        "(have max, stall_s)"
+                    )
+                try:
+                    if k == "max":
+                        max_fires = int(v)
+                    else:
+                        stall_s = float(v)
+                except ValueError as e:
+                    raise FaultSpecError(
+                        f"fault spec {chunk!r}: bad value for {k!r} — {e}"
+                    ) from None
+        sites.append(
+            FaultSite(name, kind, prob, seed, max_fires=max_fires,
+                      stall_s=stall_s)
+        )
+    return sites
+
+
+class FaultRegistry:
+    """The armed site set. One registry is active per process at a time
+    (module-level ``arm``/``disarm``); hot paths hold per-site handles, so
+    re-arming mid-run only affects workers spawned afterwards — exactly the
+    semantics a supervisor restart has anyway."""
+
+    def __init__(self, spec: str = ""):
+        self._sites: dict[str, FaultSite] = {}
+        for site in parse_spec(spec):
+            if site.name in self._sites:
+                raise FaultSpecError(
+                    f"fault site {site.name!r} specified twice"
+                )
+            self._sites[site.name] = site
+
+    def site(self, name: str) -> FaultSite | None:
+        if name not in SITES:
+            raise FaultSpecError(f"unknown fault site {name!r}; have {SITES}")
+        return self._sites.get(name)
+
+    def counters(self) -> dict[str, int]:
+        """Per-site fire counts, keyed ``fault_<site>`` (dots kept —
+        JSONL/TensorBoard accept them; stdout elides zero counters)."""
+        return {
+            f"fault_{name}": site.fires
+            for name, site in self._sites.items()
+        }
+
+    def __bool__(self) -> bool:
+        return bool(self._sites)
+
+
+_ACTIVE: FaultRegistry | None = None
+_ENV_CHECKED = False
+_ARM_LOCK = threading.Lock()
+
+
+def arm(spec: str) -> FaultRegistry:
+    """Arm the process-wide registry from a spec string (empty disarms)."""
+    global _ACTIVE, _ENV_CHECKED
+    with _ARM_LOCK:
+        _ACTIVE = FaultRegistry(spec) if spec else None
+        _ENV_CHECKED = True
+        return _ACTIVE if _ACTIVE is not None else FaultRegistry("")
+
+
+def disarm() -> None:
+    """Back to zero-overhead: every ``site()`` lookup returns None."""
+    global _ACTIVE, _ENV_CHECKED
+    with _ARM_LOCK:
+        _ACTIVE = None
+        _ENV_CHECKED = True
+
+
+def active() -> FaultRegistry | None:
+    """The armed registry, lazily initialized from ``ASYNCRL_FAULTS`` on
+    first call (so plain scripts get chaos without code changes)."""
+    global _ACTIVE, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        with _ARM_LOCK:
+            if not _ENV_CHECKED:
+                spec = os.environ.get(ENV_VAR, "")
+                if spec:
+                    _ACTIVE = FaultRegistry(spec)
+                _ENV_CHECKED = True
+    return _ACTIVE
+
+
+def site(name: str) -> FaultSite | None:
+    """The one-time handle fetch for hot loops: ``None`` when unarmed (the
+    per-iteration cost is then a single identity check at the call site)."""
+    registry = active()
+    if registry is None:
+        return None
+    return registry.site(name)
+
+
+def counters() -> dict[str, int]:
+    """Metrics-window view; {} when unarmed."""
+    registry = active()
+    return registry.counters() if registry is not None else {}

@@ -56,6 +56,15 @@ class StdoutSink(MetricsSink):
             for k in ("loss", "entropy", "param_lag"):
                 if k in window:
                     parts.append(f"{k}={window[k]:8.4f}")
+            # Recovery activity (api/sebulba_trainer.py supervisor +
+            # utils/faults.py counters): shown only once NONZERO — a
+            # healthy run's one-liner stays unchanged, a churning run
+            # says so on every window.
+            for k, value in window.items():
+                if k in ("actor_restarts", "server_restarts",
+                         "queue_backpressure") or k.startswith("fault_"):
+                    if value:
+                        parts.append(f"{k}={int(value)}")
             print("  ".join(parts), file=self.stream)
         self.stream.flush()
 

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Chaos smoke: a short sebulba training job under EACH fault site in turn,
+# failing on any non-recovered death. This is the operator-facing sibling
+# of `pytest -m chaos` (tests/test_faults.py): same recovery matrix, but
+# driven through the public config surface (fault_spec / ASYNCRL_FAULTS
+# grammar, utils/faults.py) the way a cluster chaos run would drive it.
+#
+# Usage: scripts/chaos_smoke.sh            # CPU, ~1 min
+#        ASYNCRL_CHAOS_STEPS=1024 scripts/chaos_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+STEPS="${ASYNCRL_CHAOS_STEPS:-512}"
+
+run_one() {
+  local label="$1" spec="$2" extra="${3:-}"
+  echo "=== chaos_smoke: ${label} (${spec:-unarmed}) ==="
+  python - "$spec" "$STEPS" "$extra" <<'EOF'
+import sys
+
+spec, steps, extra = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.utils.config import Config, override
+
+cfg = Config(
+    env_id="CartPole-v1", algo="a3c", backend="sebulba", host_pool="jax",
+    num_envs=16, actor_threads=2, unroll_len=4, precision="f32",
+    log_every=2, fault_spec=spec,
+)
+if extra:
+    cfg = override(cfg, [kv for kv in extra.split(",") if kv])
+agent = make_agent(cfg)
+try:
+    history = agent.train(total_env_steps=steps)
+except Exception as e:
+    print(f"chaos_smoke FAILED: training did not recover: {e}", file=sys.stderr)
+    raise
+finally:
+    agent.close()
+
+if agent.env_steps < steps:
+    sys.exit(f"chaos_smoke FAILED: reached {agent.env_steps}/{steps} env steps")
+window = history[-1]
+recovered = (
+    window.get("actor_restarts", 0)
+    + window.get("server_restarts", 0)
+    + sum(v for k, v in window.items() if k.startswith("fault_checkpoint"))
+)
+if spec and not recovered:
+    sys.exit("chaos_smoke FAILED: armed fault produced no recovery activity")
+print(
+    "chaos_smoke OK:", agent.env_steps, "steps;",
+    {k: v for k, v in window.items()
+     if "restart" in k or k.startswith("fault_") or k == "queue_backpressure"},
+)
+EOF
+}
+
+# Baseline: unarmed sites must be invisible.
+run_one "baseline (no faults)" ""
+
+# One crash per component of the async pipeline.
+run_one "actor step crash"      "actor.step:crash:1.0:0:max=1"
+run_one "fragment handoff crash" "actor.queue_put:crash:1.0:0:max=1"
+run_one "env pool crash"        "pool.step:crash:1.0:0:max=1"
+run_one "inference server crash" "server.serve:crash:1.0:0:max=1" "inference_server=True"
+
+# A hung actor, recovered by the heartbeat watchdog.
+run_one "actor stall + watchdog" "actor.step:stall:1.0:0:max=1,stall_s=60" "stall_timeout_s=1.0"
+
+# Checkpoint save under injected failure (bounded retry absorbs it).
+TMP_CK="$(mktemp -d)"
+trap 'rm -rf "$TMP_CK"' EXIT
+run_one "checkpoint save crash" "checkpoint.save:crash:1.0:0:max=2" "checkpoint_dir=${TMP_CK}/ck,checkpoint_every=2"
+
+echo "=== chaos_smoke: all fault sites recovered ==="

@@ -96,6 +96,28 @@ QUICK: dict[str, object] = {
         "test_fragment_checker_detects_violations",
         "test_inference_server_invariant_is_fatal",
     },
+    # Fault-injection harness + supervised recovery (utils/faults.py):
+    # registry units are sub-second; the recovery-matrix smokes are ~5-8s
+    # each (8 envs, 4-step unrolls). The checkpoint-fallback pair stays in
+    # the full tier (orbax save/restore round trips, ~30s+).
+    "test_faults.py": {
+        "test_spec_grammar_round_trip",
+        "test_malformed_specs_are_refused",
+        "test_fire_sequence_is_deterministic",
+        "test_unarmed_sites_are_none_and_counters_empty",
+        "test_arm_from_environment",
+        "test_corrupt_poisons_payload_deterministically",
+        "test_max_fires_caps_and_counts",
+        "test_stall_wakes_on_stop_predicate",
+        "test_single_crash_in_actor_path_is_recovered",  # 3 sites, ~20s
+        "test_eval_pools_step_unarmed",  # 3s
+        "test_server_crash_is_recovered_and_counted",  # 7s
+        "test_watchdog_restarts_stalled_actor",  # 8s
+        "test_restart_storm_aborts_instead_of_churning",  # 4s
+        "test_native_pool_close_is_idempotent",
+        "test_native_pool_close_safe_after_failed_init",
+        "test_recovery_counters_flow_through_sinks",
+    },
     "test_ppo_multipass.py": {
         "test_ppo_multipass_minibatch_divisibility_error",
         "test_ppo_multipass_dp_consistency",  # 8s

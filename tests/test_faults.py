@@ -1,0 +1,423 @@
+"""Fault-injection harness (utils/faults.py) + supervised recovery across
+the async pipeline: the recovery matrix (one test per fault site asserting
+training reaches its target despite an injected crash), the heartbeat
+watchdog, the restart-storm abort, checkpoint save-retry/restore-fallback,
+and the NativeEnvPool close-safety regression."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.utils import faults
+from asyncrl_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """No test's armed registry may leak into the next (the trainer arms
+    from config.fault_spec at construction; unit tests arm directly)."""
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------------ registry units
+
+
+def test_spec_grammar_round_trip():
+    sites = faults.parse_spec(
+        "actor.step:crash:1.0:0:max=1;"
+        "pool.step:stall:0.25:7:stall_s=2.5,max=3"
+    )
+    assert [(s.name, s.kind) for s in sites] == [
+        ("actor.step", "crash"), ("pool.step", "stall")
+    ]
+    assert sites[0].max_fires == 1 and sites[0].prob == 1.0
+    assert sites[1].stall_s == 2.5 and sites[1].max_fires == 3
+    assert sites[1].prob == 0.25 and sites[1]._rng is not sites[0]._rng
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "actor.step:crash:1.0",  # missing seed
+        "nope.site:crash:1.0:0",  # unknown site
+        "actor.step:explode:1.0:0",  # unknown kind
+        "actor.step:crash:2.0:0",  # prob out of range
+        "actor.step:crash:1.0:0:bogus=1",  # unknown option
+        "actor.step:crash:1.0:0:max=one",  # malformed option value
+        "actor.step:stall:1.0:0:stall_s=abc",  # malformed option value
+        "actor.step:crash:1.0:0;actor.step:crash:1.0:1",  # duplicate site
+    ],
+)
+def test_malformed_specs_are_refused(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultRegistry(bad)
+
+
+def test_fire_sequence_is_deterministic():
+    """Same (site, seed) -> same fire/no-fire sequence, run to run."""
+
+    def sequence():
+        site = faults.FaultRegistry("actor.step:crash:0.5:42").site(
+            "actor.step"
+        )
+        out = []
+        for _ in range(32):
+            try:
+                site.fire()
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    first, second = sequence(), sequence()
+    assert first == second
+    assert 0 < sum(first) < 32  # actually mixes fires and passes
+
+
+def test_unarmed_sites_are_none_and_counters_empty():
+    faults.disarm()
+    for name in faults.SITES:
+        assert faults.site(name) is None
+    assert faults.counters() == {}
+
+
+def test_arm_from_environment(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "pool.step:crash:1.0:0:max=1")
+    faults.disarm()
+    # force the lazy env re-read
+    faults._ENV_CHECKED = False
+    site = faults.site("pool.step")
+    assert site is not None and site.kind == "crash"
+    assert faults.counters() == {"fault_pool.step": 0}
+
+
+def test_corrupt_poisons_payload_deterministically():
+    site = faults.FaultRegistry("pool.step:corrupt:1.0:0").site("pool.step")
+    obs = np.ones((4, 3), np.float32)
+    rew = np.ones((4,), np.float32)
+    term = np.zeros((4,), bool)
+    out_obs, out_rew, out_term = site.fire(payload=(obs, rew, term))
+    assert np.isnan(out_obs.reshape(-1)[0]) and np.isfinite(obs).all()
+    assert np.isnan(out_rew[0])
+    assert out_term[0] != term[0]
+    assert site.fires == 1
+
+
+def test_max_fires_caps_and_counts():
+    site = faults.FaultRegistry("actor.step:crash:1.0:0:max=2").site(
+        "actor.step"
+    )
+    for _ in range(2):
+        with pytest.raises(faults.InjectedFault):
+            site.fire()
+    site.fire()  # third call: cap reached, no-op
+    assert site.fires == 2 and site.calls == 3
+
+
+def test_stall_wakes_on_stop_predicate():
+    import time
+
+    site = faults.FaultRegistry(
+        "actor.step:stall:1.0:0:stall_s=30"
+    ).site("actor.step")
+    t0 = time.monotonic()
+    site.fire(stop=lambda: True)  # armed 30s stall, interrupted at once
+    assert time.monotonic() - t0 < 5.0
+
+
+# ------------------------------------------------------- recovery matrix e2e
+
+
+def _chaos_config(**kw):
+    base = dict(
+        # 16 envs / 2 threads = 8 per actor, divisible by the 8-virtual-
+        # device CPU test mesh (conftest).
+        env_id="CartPole-v1", algo="a3c", backend="sebulba",
+        host_pool="jax", num_envs=16, actor_threads=2, unroll_len=4,
+        precision="f32", log_every=2,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _train_steps(cfg, updates=8):
+    return (cfg.num_envs // cfg.actor_threads) * cfg.unroll_len * updates
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "site", ["actor.step", "actor.queue_put", "pool.step"]
+)
+def test_single_crash_in_actor_path_is_recovered(site):
+    """One injected crash at each actor-side site: training still reaches
+    the target, the restart shows up in the metrics window."""
+    cfg = _chaos_config(fault_spec=f"{site}:crash:1.0:0:max=1")
+    agent = make_agent(cfg)
+    try:
+        history = agent.train(total_env_steps=_train_steps(cfg))
+        assert agent.env_steps >= _train_steps(cfg)
+        assert agent._actor_restarts >= 1
+        last = history[-1]
+        assert last["actor_restarts"] >= 1
+        assert last[f"fault_{site}"] == 1
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+def test_server_crash_is_recovered_and_counted():
+    """An exception escaping the InferenceServer loop kills the server;
+    the supervisor rebuilds it, actors re-wire, training completes."""
+    cfg = _chaos_config(
+        inference_server=True,
+        fault_spec="server.serve:crash:1.0:0:max=1",
+    )
+    agent = make_agent(cfg)
+    try:
+        history = agent.train(total_env_steps=_train_steps(cfg))
+        assert agent.env_steps >= _train_steps(cfg)
+        assert agent._server_restarts >= 1
+        assert history[-1]["server_restarts"] >= 1
+        assert history[-1]["fault_server.serve"] == 1
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", ["actor.step", "pool.step"])
+def test_watchdog_restarts_stalled_actor(site):
+    """A HUNG actor (armed 60s stall, no exception — in the actor loop or
+    inside the env pool's step) is detected by the heartbeat watchdog
+    within stall_timeout_s and replaced; training completes instead of
+    stalling forever. The pool variant also proves the abandoned thread's
+    stall wakes on its stop predicate (pool.fault_stop wiring) instead of
+    sleeping out the full 60s."""
+    import time
+
+    cfg = _chaos_config(
+        stall_timeout_s=1.0,
+        fault_spec=f"{site}:stall:1.0:0:max=1,stall_s=60",
+    )
+    agent = make_agent(cfg)
+    try:
+        t0 = time.monotonic()
+        agent.train(total_env_steps=_train_steps(cfg))
+        took = time.monotonic() - t0
+        assert agent._actor_restarts >= 1
+        # Recovery must ride the watchdog (seconds), not the 60s stall.
+        assert took < 30.0, f"watchdog too slow: {took:.1f}s"
+    finally:
+        agent.close()
+
+
+def test_eval_pools_step_unarmed():
+    """Evaluation runs outside the supervised pipeline, so eval pools must
+    not inject faults: with pool.step armed to crash on EVERY step, a
+    greedy eval still completes (and spends none of the site's budget)."""
+    cfg = _chaos_config(fault_spec="pool.step:crash:1.0:0")
+    agent = make_agent(cfg)
+    try:
+        ret = agent.evaluate(num_episodes=4, max_steps=20)
+        assert np.isfinite(ret)
+        assert faults.counters()["fault_pool.step"] == 0
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+def test_restart_storm_aborts_instead_of_churning():
+    """Every actor iteration crashing (prob=1, uncapped) must trip the
+    storm threshold and abort the run with the real cause chained."""
+    cfg = _chaos_config(fault_spec="actor.step:crash:1.0:0")
+    agent = make_agent(cfg)
+    try:
+        with pytest.raises(RuntimeError, match="failed repeatedly"):
+            agent.train(total_env_steps=_train_steps(cfg, updates=500))
+    finally:
+        agent.close()
+
+
+# ------------------------------------------------------ checkpoint resilience
+
+
+@pytest.mark.chaos
+def test_checkpoint_save_retries_through_injected_crashes(tmp_path):
+    """checkpoint.save crashes twice (max=2); the bounded-backoff retry
+    absorbs both and the periodic saves still land."""
+    ck = str(tmp_path / "ck")
+    cfg = _chaos_config(
+        checkpoint_dir=ck, checkpoint_every=2,
+        fault_spec="checkpoint.save:crash:1.0:0:max=2",
+    )
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=_train_steps(cfg))
+        assert agent._ckpt.checkpointer.all_steps()
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+def test_truncated_latest_checkpoint_falls_back_to_previous(tmp_path):
+    """Damage the newest retained step on disk: auto-resume must skip it
+    (logged) and restore the previous step instead of aborting."""
+    ck = str(tmp_path / "ck")
+    cfg = _chaos_config(checkpoint_dir=ck, checkpoint_every=2)
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=_train_steps(cfg))
+        steps = agent._ckpt.checkpointer.all_steps()
+        assert len(steps) >= 2, steps
+    finally:
+        agent.close()
+
+    latest = max(int(d) for d in os.listdir(ck) if d.isdigit())
+    shutil.rmtree(os.path.join(ck, str(latest), "state"))  # truncate
+
+    resumed = make_agent(cfg)
+    try:
+        got = int(np.asarray(resumed.state.update_step))
+        assert got == max(s for s in steps if s != latest), (got, steps)
+        assert resumed.env_steps > 0
+    finally:
+        resumed.close()
+
+
+@pytest.mark.chaos
+def test_injected_restore_fault_falls_back(tmp_path):
+    """The checkpoint.restore site crashing on the first (latest-step)
+    attempt: restore retries the previous retained step."""
+    ck = str(tmp_path / "ck")
+    cfg = _chaos_config(checkpoint_dir=ck, checkpoint_every=2)
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=_train_steps(cfg))
+        steps = agent._ckpt.checkpointer.all_steps()
+        assert len(steps) >= 2
+    finally:
+        agent.close()
+
+    resumed = make_agent(
+        cfg.replace(fault_spec="checkpoint.restore:crash:1.0:0:max=1")
+    )
+    try:
+        assert int(np.asarray(resumed.state.update_step)) == steps[-2]
+    finally:
+        resumed.close()
+
+
+def test_explicit_step_restore_never_falls_back(tmp_path):
+    """An operator-requested step must fail loudly, not silently serve a
+    different state."""
+    from asyncrl_tpu.utils.checkpoint import Checkpointer
+
+    ck = str(tmp_path / "ck")
+    cfg = _chaos_config(checkpoint_dir=ck, checkpoint_every=2)
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=_train_steps(cfg))
+        steps = agent._ckpt.checkpointer.all_steps()
+        state_like = agent.state
+    finally:
+        agent.close()
+    shutil.rmtree(os.path.join(ck, str(steps[-1]), "state"))
+    with Checkpointer(ck, create=False) as src:
+        with pytest.raises(Exception):
+            src.restore(state_like, step=steps[-1])
+        # ...while the latest-step path falls back fine.
+        state, _ = src.restore(state_like)
+        assert int(np.asarray(state.update_step)) == steps[-2]
+
+
+# ------------------------------------------------- native pool close safety
+
+
+class _StubLib:
+    """Counts destroys; stands in for the C library so the close-safety
+    contract is testable without a native build."""
+
+    def __init__(self):
+        self.destroys = []
+
+    def envpool_create(self, name, num_envs, num_threads, seed):
+        return 1234
+
+    def envpool_obs_dim(self, handle):
+        return 4
+
+    def envpool_num_actions(self, handle):
+        return 2
+
+    def envpool_action_dim(self, handle):
+        return 0
+
+    def envpool_destroy(self, handle):
+        self.destroys.append(handle)
+
+
+def test_native_pool_close_is_idempotent(monkeypatch):
+    from asyncrl_tpu.envs import native_pool
+
+    stub = _StubLib()
+    monkeypatch.setattr(native_pool, "load_library", lambda: stub)
+    pool = native_pool.NativeEnvPool("CartPole-v1", 4)
+    pool.close()
+    pool.close()  # second close: no double-free
+    pool.__del__()  # nor from the finalizer
+    assert stub.destroys == [1234]
+
+
+def test_native_pool_close_safe_after_failed_init(monkeypatch):
+    from asyncrl_tpu.envs import native_pool
+
+    # __init__ dies before a handle exists (library build/load failure):
+    # close() and __del__ must be clean no-ops, not AttributeErrors that
+    # __del__ used to blanket-swallow.
+    def boom():
+        raise RuntimeError("injected build failure")
+
+    monkeypatch.setattr(native_pool, "load_library", boom)
+    with pytest.raises(RuntimeError, match="injected build failure"):
+        native_pool.NativeEnvPool("CartPole-v1", 4)
+    # ...and one that died even earlier (validation), via the public path:
+    with pytest.raises(KeyError):
+        native_pool.NativeEnvPool("NoSuchEnv-v0", 4)
+    # A half-built instance reproducing the mid-__init__ state:
+    pool = native_pool.NativeEnvPool.__new__(native_pool.NativeEnvPool)
+    pool.close()  # no handle, no lib: still safe
+    pool.__del__()
+
+
+# ------------------------------------------------------------ metrics export
+
+
+def test_recovery_counters_flow_through_sinks(tmp_path):
+    """The window dict's recovery counters land in JSONL records and on
+    the stdout one-liner (only when nonzero)."""
+    import io
+    import json
+
+    from asyncrl_tpu.utils.metrics import JsonlSink, StdoutSink
+
+    window = {
+        "env_steps": 100, "fps": 10.0, "episode_return": 1.0,
+        "loss": 0.5, "actor_restarts": 2, "server_restarts": 0,
+        "queue_backpressure": 7, "fault_actor.step": 1,
+    }
+    path = str(tmp_path / "m.jsonl")
+    with JsonlSink(path) as sink:
+        sink.write(window)
+    rec = json.loads(open(path).read().strip())
+    assert rec["actor_restarts"] == 2 and rec["fault_actor.step"] == 1
+
+    buf = io.StringIO()
+    StdoutSink(stream=buf).write(window)
+    line = buf.getvalue()
+    assert "actor_restarts=2" in line
+    assert "queue_backpressure=7" in line
+    assert "fault_actor.step=1" in line
+    assert "server_restarts" not in line  # zero counters stay quiet

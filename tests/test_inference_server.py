@@ -124,6 +124,34 @@ def test_error_delivery_keeps_server_alive():
         server.join(timeout=5)
 
 
+def test_generic_server_death_delivers_real_cause():
+    """An exception escaping the serve LOOP (not a per-request failure)
+    must be recorded as the fatal cause and re-raised into clients — not
+    surfaced as a bland ServerClosed (the pre-fix behavior let anything
+    but InvariantViolation escape to Python's thread hook)."""
+
+    def fn(params, obs, key):
+        return jnp.zeros(obs.shape[0], jnp.int32), jnp.zeros(obs.shape[0]), key
+
+    stop = threading.Event()
+    server = InferenceServer(
+        fn, ParamStore({"w": jnp.zeros(())}), 1, stop, max_wait_s=0.01
+    )
+
+    def exploding_collect():
+        raise OSError("injected loop failure")
+
+    server._collect = exploding_collect
+    server.start()
+    try:
+        with pytest.raises(OSError, match="injected loop failure"):
+            server.client(0)(None, np.zeros((2, 4), np.float32), None)
+        assert isinstance(server._fatal, OSError)
+    finally:
+        stop.set()
+        server.join(timeout=5)
+
+
 def test_stopped_server_raises_server_closed():
     def fn(params, obs, key):
         return jnp.zeros(obs.shape[0], jnp.int32), jnp.zeros(obs.shape[0]), key

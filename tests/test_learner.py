@@ -11,7 +11,13 @@ from jax.sharding import PartitionSpec as P
 from asyncrl_tpu.envs.cartpole import CartPole
 from asyncrl_tpu.learn.learner import Learner, _algo_loss
 from asyncrl_tpu.models.networks import build_model
-from asyncrl_tpu.parallel.mesh import DP_AXIS, make_mesh
+from asyncrl_tpu.parallel.mesh import (
+    DP_AXIS,
+    axis_size,
+    make_mesh,
+    reduce_grads,
+    shard_map,
+)
 from asyncrl_tpu.rollout.buffer import Rollout
 from asyncrl_tpu.utils.config import Config
 
@@ -46,12 +52,15 @@ def test_sharded_grads_equal_full_batch_grads(algo, devices):
 
     def sharded_grad(p, r):
         # Same pattern as the learner: scale the per-shard loss by
-        # 1/axis_size; shard_map's transpose auto-psums grads of the
-        # replicated params (no explicit pmean — that would double-reduce).
-        return jax.grad(
+        # 1/axis_size; on new jax shard_map's transpose auto-psums grads of
+        # the replicated params (no explicit pmean — that would
+        # double-reduce), and reduce_grads inserts the equivalent psum on
+        # jax versions whose in-body transpose doesn't.
+        g = jax.grad(
             lambda q: _algo_loss(cfg, model.apply, q, r, axis_name=DP_AXIS)[0]
-            / jax.lax.axis_size(DP_AXIS)
+            / axis_size(DP_AXIS)
         )(p)
+        return reduce_grads(g, DP_AXIS)
 
     ro_spec = Rollout(
         obs=P(None, DP_AXIS), actions=P(None, DP_AXIS),
@@ -60,7 +69,7 @@ def test_sharded_grads_equal_full_batch_grads(algo, devices):
         bootstrap_obs=P(DP_AXIS),
     )
     grad_sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             sharded_grad, mesh=mesh, in_specs=(P(), ro_spec), out_specs=P()
         )
     )(params, ro)

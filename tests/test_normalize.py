@@ -67,7 +67,7 @@ def test_normalize_clips_outliers():
 def test_sharded_stats_equal_global_batch(devices):
     """psum'd moment update inside shard_map == unsharded update on the
     concatenated batch: every shard must hold identical GLOBAL stats."""
-    from asyncrl_tpu.parallel.mesh import make_mesh
+    from asyncrl_tpu.parallel.mesh import make_mesh, shard_map
 
     mesh = make_mesh()
     rng = np.random.default_rng(1)
@@ -78,7 +78,7 @@ def test_sharded_stats_equal_global_batch(devices):
         return update_stats(stats, obs, axes=("dp",))
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh,
             in_specs=(P(), P("dp")),
             out_specs=P(),

@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 
 from asyncrl_tpu.ops.pallas_scan import reverse_linear_scan_pallas
+from asyncrl_tpu.parallel.mesh import shard_map
 from asyncrl_tpu.ops.scan import (
     reverse_linear_scan,
     reverse_linear_scan_sequential,
@@ -109,7 +110,7 @@ def test_kernel_inside_shard_map(devices):
         return reverse_linear_scan_pallas(a_sh, b_sh, interpret=True)
 
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(None, "dp"), P(None, "dp")),

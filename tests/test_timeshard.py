@@ -8,7 +8,7 @@ import pytest
 
 from asyncrl_tpu.ops.scan import reverse_linear_scan
 from asyncrl_tpu.ops.vtrace import vtrace
-from asyncrl_tpu.parallel.mesh import make_mesh
+from asyncrl_tpu.parallel.mesh import make_mesh, shard_map
 from asyncrl_tpu.parallel.timeshard import make_timesharded_solver
 
 
@@ -86,7 +86,7 @@ def test_shift_from_next_shard(devices):
     fill = jnp.full((B,), -1.0)
 
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: shift_from_next_shard(x, fill, "sp"),
             mesh=mesh,
             in_specs=(P("sp"),),
@@ -121,7 +121,7 @@ def test_vtrace_timesharded_matches_single_device(devices):
     )
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda bl, tl, r, d, v: vtrace_timesharded(
                 bl, tl, r, d, v, bootstrap, axis_name="sp"
             ),
@@ -166,7 +166,7 @@ def test_gae_timesharded_matches_single_device(devices):
 
     want = gae(rewards, discounts, values, bootstrap, gae_lambda=0.9)
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda r, d, v: gae_timesharded(
                 r, d, v, bootstrap, gae_lambda=0.9, axis_name="sp"
             ),
