@@ -10,6 +10,13 @@ the learner thread transfers them batch-sharded to the mesh and steps the
 actors run ahead of the learner by up to ``queue_capacity`` fragments, and
 V-trace (algo="impala") corrects the resulting off-policyness exactly as in
 the reference (SURVEY.md §7.3).
+
+With ``config.overlap_h2d`` (default on) the fragment data itself moves
+zero-copy: actors write into leased staging-slab rows (rollout/staging.py),
+the drain transfers whole slabs double-buffered against the learner's
+compute, and per-window pipeline metrics (h2d_wait_s, h2d_bytes,
+learner_stall_frac, slab_reuse_waits) make the overlap measurable — see
+docs/ARCHITECTURE.md "Data path & transfer overlap".
 """
 
 from __future__ import annotations
@@ -47,8 +54,11 @@ from asyncrl_tpu.utils.config import Config, default_eval_max_steps
 
 def _stack_fragments(rollouts):
     """K host fragments -> one [K, T, B, ...] stack for the fused-dispatch
-    learner (updates_per_call > 1); a single fragment passes through
-    unstacked (the K=1 learner expects the plain [T, B, ...] layout)."""
+    learner (updates_per_call > 1). K=1 fast path: the single fragment
+    passes through AS-IS — no stack, no copy (the K=1 learner expects the
+    plain [T, B, ...] layout anyway, and a redundant ``np.stack`` here
+    would tax every update of the default configuration). Legacy path
+    only; the staging ring (config.overlap_h2d) never stacks at all."""
     if len(rollouts) == 1:
         return rollouts[0]
     return jax.tree.map(lambda *xs: np.stack(xs), *rollouts)
@@ -152,6 +162,27 @@ class SebulbaTrainer:
         self._store = ParamStore(self._published(self.state), self.env_steps)
         cap = config.queue_capacity or 2 * config.actor_threads
         self._queue: "queue.Queue[Fragment]" = queue.Queue(maxsize=cap)
+        # Zero-copy staging ring (rollout/staging.py): actors write
+        # fragments straight into preallocated [K, T, B, ...] slabs and
+        # the drain transfers whole slabs, double-buffered against the
+        # learner's compute. config.overlap_h2d=False keeps the legacy
+        # copy-and-stack path (A/B-compared by scripts/perf_smoke.sh).
+        self._staging = None
+        if config.overlap_h2d:
+            from asyncrl_tpu.rollout import staging
+
+            template = staging.fragment_template(
+                config, self.spec, self.model, self._envs_per_actor
+            )
+            K = max(config.updates_per_call, 1)
+            self._staging = staging.StagingRing(
+                template,
+                rows_per_slab=K,
+                num_slabs=(
+                    config.staging_slabs
+                    or staging.auto_num_slabs(cap, config.actor_threads, K)
+                ),
+            )
         # §5.2b debug mode: transport invariants on drained fragments.
         from asyncrl_tpu.utils.debug import sync_debug_enabled
 
@@ -189,6 +220,12 @@ class SebulbaTrainer:
         # server restart must be able to retire one server without taking
         # every healthy actor down with it.
         self._server_stop = threading.Event()
+        # Inference-server coalescing snapshot for the per-window
+        # infer_coalesce_batch metric: (server incarnation, rounds, rows)
+        # at the last window close. Keyed on the monotonic restart counter
+        # — not id(server), whose freed address can be reused — so a
+        # rebuilt server's fresh counters never read as a negative delta.
+        self._infer_snap: tuple[int | None, int, int] = (None, 0, 0)
         # Caches built on first use but DECLARED here (no hasattr dances):
         # evaluation host pools per (num_episodes, seed), and the jitted
         # greedy fn (set lazily in evaluate — model apply shape is known
@@ -269,6 +306,7 @@ class SebulbaTrainer:
             track_returns=self.config.normalize_returns,
             return_discount=self.config.gamma,
             generation=self._actor_gens[index],
+            staging=self._staging,
         )
         actor.start()
         return actor
@@ -371,6 +409,14 @@ class SebulbaTrainer:
         )
         self._actor_gens[index] += 1
         self._backpressure_base += self._actors[index].backpressure
+        if self._staging is not None:
+            # Void the dead/abandoned thread's open slab lease: the row
+            # re-opens for the replacement under a fresh generation, and
+            # any late write/commit from a zombie raises StaleLeaseError
+            # instead of scribbling on the re-leased row.
+            lease = self._actors[index]._open_lease
+            if lease is not None:
+                self._staging.void(lease)
         self._actors[index] = self._spawn_actor(index)
 
     def _supervise_stalled_actors(self) -> None:
@@ -464,6 +510,26 @@ class SebulbaTrainer:
         for actor in self._actors:
             actor.heartbeat = refreshed
 
+    def _infer_coalesce_window(self) -> dict[str, float]:
+        """Mean coalesced inference-batch rows per served round since the
+        last window close ({} without a shared server). Snapshots per
+        server INCARNATION (the restart counter), so a supervised
+        rebuild's fresh counters never read as a negative delta."""
+        server = self._server
+        if server is None:
+            return {}
+        incarnation = self._server_restarts
+        rounds, rows = server.coalesce_rounds, server.coalesce_rows
+        snap_inc, snap_rounds, snap_rows = self._infer_snap
+        if snap_inc != incarnation:
+            snap_rounds = snap_rows = 0
+        d_rounds = rounds - snap_rounds
+        d_rows = rows - snap_rows
+        self._infer_snap = (incarnation, rounds, rows)
+        return {
+            "infer_coalesce_batch": d_rows / d_rounds if d_rounds else 0.0
+        }
+
     def _drain_queue(self) -> None:
         """Discard queued fragments — THROUGH the §5.2b checker when armed,
         so a discarded fragment still advances its stream (a later gap from
@@ -511,6 +577,12 @@ class SebulbaTrainer:
             self._server_stop.set()
             self._server.join(timeout=5.0)
             self._server = None
+        if self._staging is not None:
+            # Every lease (queued, open, or held by an abandoned zombie)
+            # goes stale and every slab frees: the next train() starts on
+            # a clean ring, and a zombie's late commit raises instead of
+            # landing in a recycled row.
+            self._staging.reset()
 
     # ---------------------------------------------------------------- train
 
@@ -535,27 +607,71 @@ class SebulbaTrainer:
         ret_sum = len_sum = count = lag_sum = 0.0
         window_start = time.perf_counter()
         window_steps = 0
+        # Pipeline instrumentation (utils/metrics.py window keys):
+        # learner_stall_frac = fraction of window wall time the drain spent
+        # waiting on the fragment queue (the learner starved for data);
+        # h2d_wait_s = time in host->device transfer the compute could not
+        # hide (overlap path: an explicit transfer barrier before the next
+        # dispatch; legacy path: the device_put call itself); h2d_bytes =
+        # host bytes shipped.
+        stall_s = 0.0
+        h2d_wait_s = 0.0
+        h2d_bytes = 0
         # Cumulative-counter baseline: a SECOND train() call on this agent
         # must not fire an eval at its first log boundary.
         updates_at_eval = self._updates
         K = cfg.updates_per_call
         fragments: list[Fragment] = []
+        # Staging mode: fragments grouped by slab until a slab has all K
+        # rows in hand (completion order, like the legacy arrival order).
+        slab_groups: dict[int, list[Fragment]] = {}
+        ring = self._staging
         try:
             while self.env_steps < target:
                 self._supervise()
+                t_wait = time.perf_counter()
                 try:
                     fragment = self._queue.get(timeout=1.0)
                 except queue.Empty:
+                    stall_s += time.perf_counter() - t_wait
                     continue
+                stall_s += time.perf_counter() - t_wait
                 if self._seq_checker is not None:
                     self._seq_checker.check(fragment)
-                fragments.append(fragment)
-                if len(fragments) < K:
-                    # Fused-dispatch mode: keep draining until K fragments
-                    # are in hand (actors keep producing; supervision keeps
-                    # running between gets).
-                    continue
-                rollout = _stack_fragments([f.rollout for f in fragments])
+                if ring is not None:
+                    lease = fragment.lease
+                    if lease is None or not lease.valid():
+                        # A zombie's fragment: its lease was voided when
+                        # the supervisor retired the thread, and the row
+                        # now belongs to the replacement. (The checker
+                        # above already advanced the old stream.)
+                        continue
+                    group = slab_groups.setdefault(lease.slab, [])
+                    group.append(fragment)
+                    if len(group) >= K:
+                        # Re-validate at the boundary: a lease can go
+                        # stale AFTER queueing (supervisor voiding racing
+                        # the actor's post-put bookkeeping) — the voided
+                        # row's replacement fragment completes the slab.
+                        group[:] = [f for f in group if f.lease.valid()]
+                    if len(group) < K:
+                        continue
+                    batch = sorted(
+                        slab_groups.pop(lease.slab),
+                        key=lambda f: f.lease.row,
+                    )
+                    slab_id = lease.slab
+                    rollout = ring.batch(slab_id)
+                else:
+                    fragments.append(fragment)
+                    if len(fragments) < K:
+                        # Fused-dispatch mode: keep draining until K
+                        # fragments are in hand (actors keep producing;
+                        # supervision keeps running between gets).
+                        continue
+                    batch, fragments = fragments, []
+                    slab_id = None
+                    rollout = _stack_fragments([f.rollout for f in batch])
                 if cfg.reward_scale != 1.0 or cfg.step_cost != 0.0:
                     # Learner's reward view (living cost, then scale). Host
                     # fragments carry RAW rewards, so the cost applies here.
@@ -573,12 +689,37 @@ class SebulbaTrainer:
                             else rollout.disc_returns * cfg.reward_scale
                         ),
                     )
-                rollout = self.learner.put_rollout(rollout)
-                self.state, metrics = self.learner.update(self.state, rollout)
+                t_put = time.perf_counter()
+                rollout_d = self.learner.put_rollout(rollout)
+                if ring is not None:
+                    # Transfer barrier: wait for slab i+1's H2D to finish
+                    # BEFORE dispatching its update — this wait runs while
+                    # the PREVIOUS update still computes on device, so
+                    # transfer time hides behind compute and h2d_wait_s
+                    # records only the part that didn't fit under it.
+                    jax.block_until_ready(rollout_d)
+                h2d_wait_s += time.perf_counter() - t_put
+                # Slab batches are constant-sized (precomputed); only the
+                # legacy stack path needs the per-update leaf walk.
+                h2d_bytes += (
+                    ring.slab_nbytes
+                    if ring is not None
+                    else int(
+                        sum(leaf.nbytes for leaf in jax.tree.leaves(rollout))
+                    )
+                )
+                self.state, metrics = self.learner.update(
+                    self.state, rollout_d
+                )
+                if ring is not None:
+                    # The slab frees only once this update's OUTPUT is
+                    # ready — the gate that makes reuse safe even where
+                    # the device buffer aliases host memory (CPU client).
+                    ring.retire(slab_id, self.state.update_step)
                 self.env_steps += steps_per_fragment * K
                 window_steps += steps_per_fragment * K
                 pending.append(metrics)
-                for i, f in enumerate(fragments):
+                for i, f in enumerate(batch):
                     ret_sum += f.return_sum
                     len_sum += f.length_sum
                     count += f.count
@@ -594,7 +735,6 @@ class SebulbaTrainer:
                     lag_sum += (self._updates + i) - self._published_updates.get(
                         f.version, self._updates
                     )
-                fragments = []
 
                 before = self._updates
                 self._updates += K
@@ -643,9 +783,23 @@ class SebulbaTrainer:
                     agg["queue_backpressure"] = self._backpressure_base + sum(
                         a.backpressure for a in self._actors
                     )
+                    # Pipeline metrics: the transfer-overlap story in
+                    # numbers, per window (see the accumulator comments
+                    # above and docs/ARCHITECTURE.md "Data path & transfer
+                    # overlap").
+                    agg["h2d_wait_s"] = h2d_wait_s
+                    agg["h2d_bytes"] = h2d_bytes
+                    agg["learner_stall_frac"] = min(
+                        stall_s / max(elapsed, 1e-9), 1.0
+                    )
+                    if ring is not None:
+                        agg["slab_reuse_waits"] = ring.reuse_waits
+                    agg.update(self._infer_coalesce_window())
                     agg.update(faults.counters())
                     ret_sum = len_sum = count = lag_sum = 0.0
                     window_steps = 0
+                    stall_s = h2d_wait_s = 0.0
+                    h2d_bytes = 0
                     # In-training greedy eval on the log boundary. Actors
                     # keep filling the (bounded) queue during the pause, so
                     # window_start is deliberately NOT reset: the eval's
@@ -764,13 +918,17 @@ class SebulbaTrainer:
             finished = np.zeros((num_episodes,), bool)
             final_return = np.zeros((num_episodes,), np.float64)
             for _ in range(max_steps):
+                # ONE batched jax.device_get per eval step (np.asarray
+                # was a separate blocking sync per leaf — measurably worse
+                # on a high-latency device link); the recurrent core stays
+                # on device.
                 if recurrent:
                     actions_d, core = greedy_fn(
                         params, obs_stats, obs, core, done_prev
                     )
-                    actions = np.asarray(actions_d)
+                    actions = jax.device_get(actions_d)
                 else:
-                    actions = np.asarray(greedy_fn(params, obs_stats, obs))
+                    actions = jax.device_get(greedy_fn(params, obs_stats, obs))
                 obs, rew, term, trunc = pool.step(actions)
                 done_prev = np.logical_or(term, trunc)
                 ep_return += np.where(finished, 0.0, rew)

@@ -420,11 +420,16 @@ class RolloutLearner:
                 return jax.lax.scan(single_body, state, stacked)
 
         sspec = learner_state_spec()
-        # NEVER donate here, regardless of config.donate_buffers: the params
-        # in this state are published to concurrently-running actor threads
+        # NEVER donate the STATE, regardless of config.donate_buffers: the
+        # params in it are published to concurrently-running actor threads
         # via ParamStore; donation would delete buffers mid-inference
         # ("Array has been deleted" in every actor). The Anakin learner can
         # donate because its params never escape the update loop.
+        # The ROLLOUT argument is donatable under config.donate_buffers:
+        # it is consumed exactly once, and the trainer's drain never
+        # touches the device fragment after dispatching the update (the
+        # staging ring gates host-slab reuse on the update's OUTPUT, so
+        # deletion of the consumed input is invisible to it).
         self._step = jax.jit(
             shard_map(
                 update_body,
@@ -438,6 +443,7 @@ class RolloutLearner:
                 ),
                 out_specs=(sspec, P()),
             ),
+            donate_argnums=(1,) if config.donate_buffers else (),
         )
         # Fragment structure is fixed for this trainer (ff vs recurrent), so
         # the device_put sharding pytree is built once, not per update.

@@ -74,6 +74,14 @@ class RolloutBuffer:
     Reusable: numpy storage is allocated once (action storage lazily, on the
     first append, when dtype/shape are known) and overwritten each fragment;
     ``emit`` copies, so fragments are safe to retain after ``reset``.
+
+    Slab mode (``storage=``): the buffer writes into CALLER-OWNED arrays —
+    one staging-slab row's views (rollout/staging.py) — and ``emit``
+    becomes a zero-copy pointer hand-off: the emitted ``Rollout`` shares
+    the storage, and the staging ring's lease protocol (not a copy) is
+    what makes retaining it safe. ``guard`` (if given) runs before every
+    append so a voided lease fails the write instead of scribbling on a
+    re-leased row.
     """
 
     def __init__(
@@ -83,10 +91,29 @@ class RolloutBuffer:
         obs_shape,
         obs_dtype,
         track_returns: bool = False,
+        storage: "Rollout | None" = None,
+        guard=None,
     ):
         T, B = unroll_len, num_envs
         self.unroll_len = T
         self.num_envs = B
+        self._guard = guard
+        if storage is not None:
+            if track_returns != (storage.disc_returns is not None):
+                raise ValueError(
+                    "storage disc_returns presence must match track_returns"
+                )
+            self.obs = storage.obs
+            self.behaviour_logp = storage.behaviour_logp
+            self.rewards = storage.rewards
+            self.terminated = storage.terminated
+            self.truncated = storage.truncated
+            self.disc_returns = storage.disc_returns
+            self.actions = storage.actions
+            self._bootstrap = storage.bootstrap_obs
+            self._t = 0
+            return
+        self._bootstrap = None
         self.obs = np.empty((T, B, *obs_shape), obs_dtype)
         self.behaviour_logp = np.empty((T, B), np.float32)
         self.rewards = np.empty((T, B), np.float32)
@@ -115,6 +142,8 @@ class RolloutBuffer:
         ``action``; reward/terminated/truncated describe the step outcome.
         ``disc_return`` is required exactly when the buffer tracks the
         discounted-return stream."""
+        if self._guard is not None:
+            self._guard()
         t = self._t
         if t >= self.unroll_len:
             raise IndexError(f"buffer full at t={t}; call emit()/reset()")
@@ -140,11 +169,27 @@ class RolloutBuffer:
         self._t = t + 1
 
     def emit(self, bootstrap_obs) -> Rollout:
-        """Copy out the completed fragment and reset for the next one."""
+        """Emit the completed fragment and reset for the next one: a copy
+        when the buffer owns its storage, a zero-copy view hand-off in
+        slab mode (the staging lease gates reuse instead)."""
         if not self.full:
             raise ValueError(
                 f"fragment incomplete: {self._t}/{self.unroll_len} steps"
             )
+        if self._bootstrap is not None:
+            np.copyto(self._bootstrap, np.asarray(bootstrap_obs))
+            rollout = Rollout(
+                obs=self.obs,
+                actions=self.actions,
+                behaviour_logp=self.behaviour_logp,
+                rewards=self.rewards,
+                terminated=self.terminated,
+                truncated=self.truncated,
+                bootstrap_obs=self._bootstrap,
+                disc_returns=self.disc_returns,
+            )
+            self._t = 0
+            return rollout
         rollout = Rollout(
             obs=self.obs.copy(),
             actions=self.actions.copy(),

@@ -30,6 +30,17 @@ Client façade: ``server.client(i)`` returns a callable with the exact
 ignored — the server uses the ParamStore and its own key stream), so
 ``ActorThread`` runs unchanged whether it holds the jitted function or a
 server client.
+
+Slab coalescing: clients submit raw HOST arrays (no per-client
+``jnp.asarray`` — that was one device transfer per client per round); the
+server packs them into a preallocated host batch slab and the jitted call
+transfers the whole slab ONCE per round. Device-resident request leaves
+(the recurrent core on an accelerator) still concatenate on device — they
+never round-trip through the host. Results slice on host: actions/logp
+are numpy row-slices, and on a CPU-backed server (cpu_async) the core
+slices are numpy VIEWS of the device buffer too — no copy-through-device
+per client (the ``_slice`` fix). ``coalesce_rounds``/``coalesce_rows``
+feed the ``infer_coalesce_batch`` metric.
 """
 
 from __future__ import annotations
@@ -57,12 +68,24 @@ class InvariantViolation(RuntimeError):
     abort the run, not feed the actor-restart loop)."""
 
 
-def _concat(values):
-    """Concatenate request pytrees along the leading (batch) dim."""
-    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *values)
+def _on_cpu(tree) -> bool:
+    """True when every device leaf of ``tree`` lives on a CPU device (the
+    cpu_async host-pinned server). Numpy leaves count as CPU."""
+    for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, np.ndarray):
+            continue
+        try:
+            if any(d.platform != "cpu" for d in leaf.devices()):
+                return False
+        except AttributeError:
+            return False
+    return True
 
 
 def _slice(tree, start, stop):
+    """Row-slice every leaf. Numpy leaves give zero-copy views; device
+    leaves give device-side slices (small, and they stay resident for the
+    client's next submit)."""
     return jax.tree.map(lambda x: x[start:stop], tree)
 
 
@@ -127,6 +150,14 @@ class InferenceServer(threading.Thread):
         # every collect/serve loop iteration).
         self.heartbeat = time.monotonic()
         self._fault_serve = faults.site("server.serve")
+        # Preallocated host batch slabs, one per flattened request-leaf
+        # position (grown to the largest batch seen); server-thread-only.
+        self._slabs: dict[int, np.ndarray] = {}
+        # Coalescing counters for the infer_coalesce_batch metric: total
+        # served rounds and total request rows (plain ints under the GIL;
+        # the trainer only reads them).
+        self.coalesce_rounds = 0
+        self.coalesce_rows = 0
 
     # ------------------------------------------------------------- client
 
@@ -138,7 +169,10 @@ class InferenceServer(threading.Thread):
 
         def call(params, obs, key, *rest):
             del params  # server reads the ParamStore
-            out = self._submit(index, (jnp.asarray(obs), *rest))
+            # Host arrays pass through untouched — the server packs them
+            # into its batch slab for ONE transfer per round (a client-side
+            # jnp.asarray here would be a per-client device transfer).
+            out = self._submit(index, (np.asarray(obs), *rest))
             if self._mode in ("rec", "rec_eps"):
                 actions, logp, core = out
                 return actions, logp, key, core
@@ -245,6 +279,47 @@ class InferenceServer(threading.Thread):
                 self._pending[i] = None
             return batch
 
+    def _coalesce(self, args_list, total_rows: int):
+        """Merge per-client request pytrees into one batch pytree.
+
+        Host (numpy) leaves pack into this server's preallocated slabs —
+        a host memcpy per client, then ONE device transfer of the slab
+        when the jitted call consumes it. Device-resident leaves (the
+        recurrent core on an accelerator) concatenate on device as before;
+        bouncing them through the host would add a D2H sync per round."""
+        flats = [jax.tree.flatten(args)[0] for args in args_list]
+        treedef = jax.tree.structure(args_list[0])
+        merged = []
+        for pos in range(len(flats[0])):
+            parts = [flat[pos] for flat in flats]
+            if all(isinstance(p, np.ndarray) for p in parts):
+                merged.append(self._pack(pos, parts, total_rows))
+            else:
+                merged.append(jnp.concatenate(parts, axis=0))
+        return jax.tree.unflatten(treedef, merged)
+
+    def _pack(self, pos: int, parts, total_rows: int) -> np.ndarray:
+        """Copy ``parts`` back-to-back into the slab for leaf ``pos``;
+        returns the ``[total_rows, ...]`` view. The slab grows to the
+        largest (rows, tail-shape, dtype) seen and is then reused forever
+        — steady state allocates nothing."""
+        tail, dtype = parts[0].shape[1:], parts[0].dtype
+        slab = self._slabs.get(pos)
+        if (
+            slab is None
+            or slab.shape[0] < total_rows
+            or slab.shape[1:] != tail
+            or slab.dtype != dtype
+        ):
+            slab = np.empty((total_rows, *tail), dtype)
+            self._slabs[pos] = slab
+        offset = 0
+        for part in parts:
+            n = part.shape[0]
+            np.copyto(slab[offset:offset + n], part)
+            offset += n
+        return slab[:total_rows]
+
     def _serve(self, batch) -> None:
         if self._debug:
             # Checked for the WHOLE batch before any slot is written, so a
@@ -260,10 +335,7 @@ class InferenceServer(threading.Thread):
         indices = [i for i, _ in batch]
         try:
             sizes = [int(args[0].shape[0]) for _, args in batch]
-            merged = [
-                _concat([args[pos] for _, args in batch])
-                for pos in range(len(batch[0][1]))
-            ]
+            merged = self._coalesce([args for _, args in batch], sum(sizes))
             params, _ = self._store.get()
             out = self._fn(params, merged[0], self._key, *merged[1:])
             if self._mode in ("rec", "rec_eps"):
@@ -273,8 +345,19 @@ class InferenceServer(threading.Thread):
                 core = None
 
             offsets = np.cumsum([0] + sizes)
+            # This blocks until the batched call finishes — which also
+            # means the input slabs are consumed and safe to overwrite at
+            # the next round's pack.
             actions = np.asarray(actions)
             logp = np.asarray(logp)
+            if core is not None and _on_cpu(core):
+                # cpu_async bugfix: a host-pinned server must hand back
+                # numpy VIEWS (np.asarray of a CPU jax array is zero-copy),
+                # not per-client device-sliced arrays — the old path paid
+                # one device slice op per client per round.
+                core = jax.tree.map(np.asarray, core)
+            self.coalesce_rounds += 1
+            self.coalesce_rows += int(offsets[-1])
             for (i, _), a, b in zip(batch, offsets[:-1], offsets[1:]):
                 if core is None:
                     self._results[i] = (actions[a:b], logp[a:b])

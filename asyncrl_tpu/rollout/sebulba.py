@@ -123,12 +123,12 @@ class Fragment:
 
     __slots__ = (
         "rollout", "return_sum", "length_sum", "count", "version",
-        "actor", "gen", "seq",
+        "actor", "gen", "seq", "lease",
     )
 
     def __init__(self, rollout: Rollout, return_sum: float, length_sum: float,
                  count: float, version: int, actor: int = 0, gen: int = 0,
-                 seq: int = 0):
+                 seq: int = 0, lease=None):
         self.rollout = rollout
         self.return_sum = return_sum
         self.length_sum = length_sum
@@ -137,6 +137,10 @@ class Fragment:
         self.actor = actor
         self.gen = gen
         self.seq = seq
+        # Staging-slab lease (rollout/staging.py) when the zero-copy path
+        # is on: the rollout's arrays are views of the leased row; None on
+        # the legacy copy path (the rollout owns its arrays).
+        self.lease = lease
 
 
 class FragmentSequenceChecker:
@@ -444,6 +448,7 @@ class ActorThread(threading.Thread):
         track_returns: bool = False,
         return_discount: float = 0.0,
         generation: int = 0,
+        staging=None,
     ):
         super().__init__(name=f"actor-{index}", daemon=True)
         self.index = index
@@ -492,6 +497,12 @@ class ActorThread(threading.Thread):
         # out-ran the learner+queue. Plain int under the GIL; the trainer
         # only ever reads it.
         self.backpressure = 0
+        # Zero-copy staging ring (rollout/staging.py); None = legacy
+        # copy-on-emit path. The actor leases one slab row per fragment
+        # and writes transitions straight into it; ``_open_lease`` is the
+        # not-yet-queued lease the supervisor voids if this thread dies.
+        self.staging = staging
+        self._open_lease = None
         # Chaos layer handles (None when unarmed — hot loop pays one
         # identity check per iteration; utils/faults.py).
         self._fault_step = faults.site("actor.step")
@@ -530,6 +541,9 @@ class ActorThread(threading.Thread):
                 except Exception:
                     pass
 
+    def _heartbeat(self) -> None:
+        self.heartbeat = time.monotonic()
+
     def _run(self) -> None:
         pool = self.pool
         T, B = self.unroll_len, pool.num_envs
@@ -537,9 +551,12 @@ class ActorThread(threading.Thread):
         key = jax.random.PRNGKey(self.seed)
 
         track_returns = self.track_returns
-        buffer = RolloutBuffer(
-            T, B, obs.shape[1:], obs.dtype, track_returns=track_returns
-        )
+        ring = self.staging
+        buffer = None
+        if ring is None:
+            buffer = RolloutBuffer(
+                T, B, obs.shape[1:], obs.dtype, track_returns=track_returns
+            )
         disc_g = np.zeros((B,), np.float32)
         running_return = np.zeros((B,), np.float64)
         running_length = np.zeros((B,), np.float64)
@@ -549,10 +566,25 @@ class ActorThread(threading.Thread):
         seq = 0  # fragment counter (§5.2b transport invariant stamp)
 
         while not self._stopped():
+            lease = None
+            if ring is not None:
+                # Lease one slab row for this fragment. A blocked acquire
+                # (ring under pressure) refreshes the heartbeat: a back-
+                # pressured actor is alive, not hung.
+                lease = ring.acquire(
+                    stop=self._stopped, on_wait=self._heartbeat
+                )
+                if lease is None:
+                    break  # stopped/abandoned while waiting
+                self._open_lease = lease
+                buffer = lease.buffer
             params, version = self.store.get()
             # ε is fragment-constant (same anneal granularity as Anakin).
+            # Kept as numpy: it rides the same device dispatch as obs (no
+            # extra round trip), and the inference server's slab coalescer
+            # packs host arrays without a per-client transfer.
             eps = (
-                jnp.asarray(self.epsilon_fn(frames))
+                np.asarray(self.epsilon_fn(frames))
                 if self.epsilon_fn is not None
                 else None
             )
@@ -584,13 +616,17 @@ class ActorThread(threading.Thread):
                     )
                 else:
                     actions_d, logp_d, key = self.inference_fn(params, obs, key)
-                actions = np.asarray(actions_d)
+                # ONE batched device→host sync for both leaves (two
+                # np.asarray calls were two round trips on a high-latency
+                # link); numpy passes through untouched (server clients
+                # already hand back host arrays).
+                actions, logp = jax.device_get((actions_d, logp_d))
                 prev_obs = obs
                 obs, rew, term, trunc = pool.step(actions)
                 if track_returns:
                     disc_g = self.return_discount * disc_g + rew
                     buffer.append(
-                        prev_obs, actions, np.asarray(logp_d), rew, term,
+                        prev_obs, actions, logp, rew, term,
                         trunc, disc_return=disc_g,
                     )
                     disc_g = np.where(
@@ -598,7 +634,7 @@ class ActorThread(threading.Thread):
                     ).astype(np.float32)
                 else:
                     buffer.append(
-                        prev_obs, actions, np.asarray(logp_d), rew, term, trunc
+                        prev_obs, actions, logp, rew, term, trunc
                     )
                 done_prev = np.logical_or(term, trunc)
                 frames += B
@@ -615,11 +651,15 @@ class ActorThread(threading.Thread):
 
             rollout = buffer.emit(bootstrap_obs=obs)
             if core is not None:
-                rollout = rollout.replace(init_core=init_core)
+                if lease is not None:
+                    rollout = lease.write_init_core(rollout, init_core)
+                else:
+                    rollout = rollout.replace(init_core=init_core)
             fragment = Fragment(
                 rollout,
                 ret_sum, len_sum, count, version,
                 actor=self.index, gen=self.generation, seq=seq,
+                lease=lease,
             )
             seq += 1
             if self._fault_put is not None:
@@ -627,14 +667,27 @@ class ActorThread(threading.Thread):
                     stop=self._stopped, payload=fragment.rollout.rewards
                 )
                 if corrupted is not fragment.rollout.rewards:
-                    fragment.rollout = fragment.rollout.replace(
-                        rewards=corrupted
-                    )
+                    if lease is not None:
+                        # Slab path: the drain reads the SLAB, so the
+                        # injected damage must land there (write-through
+                        # the view) — a detached copy would silently
+                        # un-corrupt the payload.
+                        np.copyto(fragment.rollout.rewards, corrupted)
+                    else:
+                        fragment.rollout = fragment.rollout.replace(
+                            rewards=corrupted
+                        )
+            if lease is not None:
+                # Content-complete: raises StaleLeaseError if the
+                # supervisor voided this lease (thread already retired) —
+                # caught by run()'s stopped-thread swallow.
+                lease.commit()
             # Bounded put that stays responsive to shutdown (and to the
             # watchdog retiring this thread mid-backpressure).
             while not self._stopped():
                 try:
                     self.out_queue.put(fragment, timeout=0.1)
+                    self._open_lease = None
                     break
                 except queue.Full:
                     self.backpressure += 1

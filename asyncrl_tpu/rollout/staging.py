@@ -1,0 +1,366 @@
+"""Pinned host staging rings for the actor→learner fragment path.
+
+The legacy Sebulba data path paid three host-memory taxes per learner
+update: every ``RolloutBuffer.emit`` copied a full fragment, every fused
+drain re-allocated a ``[K, T, B, ...]`` stack (``np.stack``), and the freed
+buffers churned the allocator at exactly the rate of the hot loop. IMPACT
+(arXiv:1912.00167) and "Parallel Actors and Learners" (arXiv:2110.01101)
+both identify this copy/dispatch overhead as the dominant tax in
+asynchronous actor-learner systems, so this module removes it structurally:
+
+- A :class:`StagingRing` owns a small pool of preallocated **slabs** —
+  numpy pytrees shaped ``[K, T, B, ...]`` (K = ``updates_per_call``), one
+  leaf per ``Rollout`` field, allocated once for the trainer's lifetime.
+- Actors **lease** one slab row per fragment (:meth:`StagingRing.acquire`)
+  and write transitions directly into the row's views through their
+  ``RolloutBuffer`` — emit is a pointer hand-off, not a copy.
+- The drain consumes a whole slab as the fused ``[K, T, B, ...]`` batch
+  (:meth:`StagingRing.batch`) — the stack already exists, ``np.stack``
+  never runs.
+- A slab is only reused after the learner update that consumed it has
+  executed on device (:meth:`StagingRing.retire` records a readiness
+  handle; acquisition blocks on it under pressure). This gate is what
+  makes the overlapped ``device_put`` safe even on backends where the
+  device buffer aliases host memory (the CPU client's zero-copy path).
+
+Lease protocol & generations
+----------------------------
+Every lease carries a ring-global **generation stamp**; the owning slab
+row records the stamp of its current lease. A supervisor that retires a
+crashed/hung actor *voids* the actor's open lease: the row re-opens for
+the replacement actor under a fresh generation, and the zombie's stamp no
+longer matches — its ``commit`` raises :class:`StaleLeaseError`, its
+buffer ``append``s raise through the lease guard, and any fragment it
+already queued is dropped at the drain (``lease.valid()`` is false). A
+restarted actor therefore can never scribble on a slab row it no longer
+owns (modulo the single-store race inherent to abandoning a live thread,
+which the watchdog design already accepts; the guard shrinks the window
+from a whole fragment to one array store).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from asyncrl_tpu.rollout.buffer import Rollout, RolloutBuffer
+
+
+class StaleLeaseError(RuntimeError):
+    """A voided/superseded lease was used to write or commit: the owning
+    actor was retired by the supervisor and its slab row re-leased. The
+    raising thread must stop producing — its output is already orphaned."""
+
+
+def fragment_template(config, spec, model, num_envs: int) -> Rollout:
+    """The ``jax.ShapeDtypeStruct`` pytree of ONE host fragment for this
+    (config, spec, model) — the single source of slab geometry, derived the
+    same way the learner derives its shapes (so a slab mismatch is
+    impossible by construction rather than checked at runtime)."""
+    from asyncrl_tpu.models.networks import is_recurrent
+    from asyncrl_tpu.ops import distributions
+
+    T, B = config.unroll_len, num_envs
+    obs_dtype = np.dtype(spec.obs_dtype)
+    f32 = np.dtype(np.float32)
+    dist = distributions.for_config(config, spec)
+    act_shape = (T, B, spec.action_dim) if spec.continuous else (T, B)
+    init_core = None
+    if model is not None and is_recurrent(model):
+        init_core = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(tuple(x.shape), np.dtype(x.dtype)),
+            model.initial_core(num_envs),
+        )
+    return Rollout(
+        obs=jax.ShapeDtypeStruct((T, B, *spec.obs_shape), obs_dtype),
+        actions=jax.ShapeDtypeStruct(act_shape, np.dtype(dist.action_dtype)),
+        behaviour_logp=jax.ShapeDtypeStruct((T, B), f32),
+        rewards=jax.ShapeDtypeStruct((T, B), f32),
+        terminated=jax.ShapeDtypeStruct((T, B), np.dtype(bool)),
+        truncated=jax.ShapeDtypeStruct((T, B), np.dtype(bool)),
+        bootstrap_obs=jax.ShapeDtypeStruct((B, *spec.obs_shape), obs_dtype),
+        init_core=init_core,
+        disc_returns=(
+            jax.ShapeDtypeStruct((T, B), f32)
+            if config.normalize_returns
+            else None
+        ),
+    )
+
+
+class _Slab:
+    """One preallocated ``[K, T, B, ...]`` numpy pytree + its row ledger."""
+
+    __slots__ = ("arrays", "row_gen", "committed", "state")
+
+    def __init__(self, template: Rollout, rows: int):
+        self.arrays = jax.tree.map(
+            lambda sds: np.empty((rows, *sds.shape), np.dtype(sds.dtype)),
+            template,
+        )
+        self.row_gen = [-1] * rows
+        self.committed = [False] * rows
+        self.state = "free"  # "free" | "filling" | "inflight"
+
+    def row(self, k: int) -> Rollout:
+        """Row ``k`` as a pytree of VIEWS (numpy basic slicing)."""
+        return jax.tree.map(lambda a: a[k], self.arrays)
+
+
+class SlabLease:
+    """One actor's write permit for one slab row, generation-stamped."""
+
+    __slots__ = ("ring", "slab", "row", "gen", "_buffer")
+
+    def __init__(self, ring: "StagingRing", slab: int, row: int, gen: int):
+        self.ring = ring
+        self.slab = slab
+        self.row = row
+        self.gen = gen
+        self._buffer: RolloutBuffer | None = None
+
+    def valid(self) -> bool:
+        """Still the row's current lease? Lock-free read (a list-element
+        load is atomic under the GIL; staleness here only delays, never
+        corrupts — the locked commit is the authoritative check)."""
+        return self.ring._slabs[self.slab].row_gen[self.row] == self.gen
+
+    def _check(self) -> None:
+        if not self.valid():
+            raise StaleLeaseError(
+                f"lease gen {self.gen} on slab {self.slab} row {self.row} "
+                "was voided (its actor was retired); refusing to write"
+            )
+
+    @property
+    def buffer(self) -> RolloutBuffer:
+        """A ``RolloutBuffer`` whose storage IS this row (zero-copy emit);
+        every append re-validates the lease through the guard."""
+        if self._buffer is None:
+            storage = self.ring._slabs[self.slab].row(self.row)
+            T, B = storage.obs.shape[:2]
+            self._buffer = RolloutBuffer(
+                T, B, storage.obs.shape[2:], storage.obs.dtype,
+                track_returns=storage.disc_returns is not None,
+                storage=storage, guard=self._check,
+            )
+        return self._buffer
+
+    def write_init_core(self, rollout: Rollout, init_core: Any) -> Rollout:
+        """Copy the fragment-initial recurrent carry into this row's slab
+        storage and return the rollout viewing it (the batched drain reads
+        ``init_core`` from the slab like every other leaf)."""
+        self._check()
+        views = jax.tree.map(
+            lambda a: a[self.row],
+            self.ring._slabs[self.slab].arrays.init_core,
+        )
+        jax.tree.map(
+            lambda dst, src: np.copyto(dst, np.asarray(src)), views, init_core
+        )
+        return rollout.replace(init_core=views)
+
+    def commit(self) -> None:
+        self.ring._commit(self)
+
+
+class StagingRing:
+    """The slab pool + lease ledger shared by all actors and the drain.
+
+    Thread-safety: one condition guards all ledger state; slab *contents*
+    are unguarded by design — the lease protocol guarantees single-writer
+    rows and reader/writer phase separation (filling → drained → inflight
+    → free)."""
+
+    def __init__(self, template: Rollout, rows_per_slab: int, num_slabs: int):
+        if rows_per_slab < 1:
+            raise ValueError(f"rows_per_slab={rows_per_slab} must be >= 1")
+        if num_slabs < 2:
+            # One slab cannot double-buffer: the fill of batch i+1 would
+            # wait for batch i's device consumption every time.
+            raise ValueError(f"num_slabs={num_slabs} must be >= 2")
+        self._K = rows_per_slab
+        self._slabs = [_Slab(template, rows_per_slab) for _ in range(num_slabs)]
+        self._cond = threading.Condition()
+        # Rows open for leasing: the current fill slab's rows in order,
+        # plus voided rows of older incomplete slabs (prepended, so old
+        # slabs complete before new ones open — the anti-starvation rule).
+        self._avail: "deque[tuple[int, int]]" = deque()
+        # Retired slabs awaiting device readiness: (slab_index, handle).
+        self._inflight: "deque[tuple[int, Any]]" = deque()
+        self._gen = 0
+        # Times an acquire had to wait on an in-flight slab's readiness
+        # (the ring was too shallow for the moment's pipeline depth).
+        self.reuse_waits = 0
+        self.slab_nbytes = int(
+            sum(leaf.nbytes for leaf in jax.tree.leaves(self._slabs[0].arrays))
+        )
+
+    @property
+    def rows_per_slab(self) -> int:
+        return self._K
+
+    @property
+    def num_slabs(self) -> int:
+        return len(self._slabs)
+
+    # ------------------------------------------------------------ actors
+
+    def acquire(
+        self,
+        stop: Callable[[], bool] | None = None,
+        on_wait: Callable[[], None] | None = None,
+    ) -> SlabLease | None:
+        """Lease the next free slab row, blocking until one exists.
+
+        Returns ``None`` when ``stop()`` turns true (cohort shutdown or
+        watchdog abandonment). ``on_wait`` is invoked on every internal
+        wait iteration — actors refresh their heartbeat through it so a
+        back-pressured acquire reads as alive, not hung."""
+        while True:
+            head = None
+            with self._cond:
+                if stop is not None and stop():
+                    return None
+                if not self._avail:
+                    for i, slab in enumerate(self._slabs):
+                        if slab.state == "free":
+                            slab.state = "filling"
+                            self._avail.extend(
+                                (i, r) for r in range(self._K)
+                            )
+                            break
+                if self._avail:
+                    s, r = self._avail.popleft()
+                    self._gen += 1
+                    self._slabs[s].row_gen[r] = self._gen
+                    self._slabs[s].committed[r] = False
+                    return SlabLease(self, s, r, self._gen)
+                if self._inflight:
+                    head = self._inflight[0]
+                    self.reuse_waits += 1
+            if on_wait is not None:
+                on_wait()
+            if head is not None:
+                self._await_release(head, stop, on_wait)
+            else:
+                # All rows are leased out or committed-but-undrained: the
+                # drain will retire their slabs; nothing to block on yet.
+                with self._cond:
+                    self._cond.wait(0.05)
+
+    def _await_release(self, head, stop, on_wait) -> None:
+        """Wait for the oldest in-flight slab's readiness handle WITHOUT
+        holding the ring lock, then release it. Polled (not a single
+        ``block_until_ready``) so a stopping run and the heartbeat stay
+        responsive even under a slow device."""
+        s, handle = head
+        while True:
+            try:
+                ready = bool(handle.is_ready())
+            except Exception:
+                # A deleted (donated/consumed) or handle-less array can
+                # only mean the update already ran: ready.
+                ready = True
+            if ready:
+                break
+            if stop is not None and stop():
+                return
+            if on_wait is not None:
+                on_wait()
+            time.sleep(0.002)
+        with self._cond:
+            if self._inflight and self._inflight[0] is head:
+                self._inflight.popleft()
+                self._release_locked(s)
+
+    def void(self, lease: SlabLease) -> None:
+        """Supervisor path: invalidate a retired actor's open lease and
+        re-open its row for the replacement (fresh generation on the next
+        acquire). Idempotent; a superseded lease is a no-op."""
+        with self._cond:
+            slab = self._slabs[lease.slab]
+            if slab.row_gen[lease.row] != lease.gen:
+                return
+            slab.row_gen[lease.row] = -1
+            slab.committed[lease.row] = False
+            if slab.state == "filling":
+                self._avail.appendleft((lease.slab, lease.row))
+            self._cond.notify_all()
+
+    def _commit(self, lease: SlabLease) -> None:
+        with self._cond:
+            slab = self._slabs[lease.slab]
+            if slab.row_gen[lease.row] != lease.gen:
+                raise StaleLeaseError(
+                    f"commit on voided lease gen {lease.gen} "
+                    f"(slab {lease.slab} row {lease.row})"
+                )
+            slab.committed[lease.row] = True
+
+    # ------------------------------------------------------------- drain
+
+    def batch(self, slab_id: int) -> Rollout:
+        """The consumable batch for a fully-committed slab: the raw
+        ``[K, T, B, ...]`` pytree (K > 1), or row 0's plain ``[T, B, ...]``
+        views (K == 1 — the unfused learner layout). Zero copies."""
+        slab = self._slabs[slab_id]
+        if not all(slab.committed):
+            raise RuntimeError(
+                f"slab {slab_id} batched with uncommitted rows "
+                f"{[i for i, c in enumerate(slab.committed) if not c]}"
+            )
+        if self._K == 1:
+            return slab.row(0)
+        return slab.arrays
+
+    def retire(self, slab_id: int, ready: Any) -> None:
+        """Hand a consumed slab to the in-flight ledger. ``ready`` is any
+        device array produced by the update that read the slab (the
+        trainer passes the new ``update_step``): once it is ready the
+        update has executed, so no device-side reader — including a
+        zero-copy CPU alias — can still see the slab's memory."""
+        with self._cond:
+            self._slabs[slab_id].state = "inflight"
+            self._inflight.append((slab_id, ready))
+            # Opportunistic reclamation: anything already ready frees now,
+            # so steady state never routes through the blocking path.
+            while self._inflight:
+                s, handle = self._inflight[0]
+                try:
+                    if not handle.is_ready():
+                        break
+                except Exception:
+                    pass
+                self._inflight.popleft()
+                self._release_locked(s)
+
+    def _release_locked(self, slab_id: int) -> None:
+        slab = self._slabs[slab_id]
+        slab.state = "free"
+        slab.row_gen = [-1] * self._K
+        slab.committed = [False] * self._K
+        self._cond.notify_all()
+
+    def reset(self) -> None:
+        """Invalidate every lease and free every slab (trainer ``stop()``:
+        actors are joined/abandoned, queued fragments discarded — any
+        straggler lease must read as stale, never as a live row)."""
+        with self._cond:
+            self._gen += 1
+            self._avail.clear()
+            self._inflight.clear()
+            for i in range(len(self._slabs)):
+                self._release_locked(i)
+
+
+def auto_num_slabs(queue_capacity: int, actor_threads: int, rows: int) -> int:
+    """Ring depth at which steady-state acquisition never blocks: rows for
+    every queued fragment + one open lease per actor, plus one slab filling
+    and one in flight."""
+    return max(2, -(-(queue_capacity + actor_threads) // max(rows, 1)) + 2)

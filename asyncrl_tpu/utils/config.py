@@ -182,6 +182,20 @@ class Config:
     # env step (the podracer inference-thread design). Pays off with many
     # threads and/or a high-latency device link; off = per-thread dispatch.
     inference_server: bool = False
+    # Zero-copy overlapped actor→learner data path (rollout/staging.py):
+    # actors write fragments straight into preallocated pinned staging
+    # slabs (no per-fragment emit copy, no per-drain np.stack) and the
+    # drain thread transfers slab i+1 while the learner computes update i
+    # (double-buffered H2D). Off = the legacy copy-and-stack path, kept for
+    # A/B measurement (scripts/perf_smoke.sh) and as the paranoia fallback;
+    # both paths are bit-identical on fragment content (tests/test_staging).
+    overlap_h2d: bool = True
+    # Staging-ring depth in SLABS (each slab holds updates_per_call
+    # fragments). 0 = auto: enough rows to cover the fragment queue bound +
+    # one open lease per actor + a filling and an in-flight slab, so
+    # steady-state acquisition never blocks (blocking is counted in the
+    # slab_reuse_waits metric either way).
+    staging_slabs: int = 0
 
     # --- fault tolerance (host backends; utils/faults.py) ---
     # Heartbeat watchdog: an actor thread or the inference server whose

@@ -56,13 +56,30 @@ class StdoutSink(MetricsSink):
             for k in ("loss", "entropy", "param_lag"):
                 if k in window:
                     parts.append(f"{k}={window[k]:8.4f}")
+            # Pipeline health (host backends; api/sebulba_trainer.py):
+            # data-starvation fraction and unhidden transfer time, so the
+            # overlap is visible per window, not asserted.
+            if "learner_stall_frac" in window:
+                parts.append(
+                    f"stall={100.0 * window['learner_stall_frac']:5.1f}%"
+                )
+            if "h2d_wait_s" in window:
+                parts.append(f"h2d={1e3 * window['h2d_wait_s']:7.1f}ms")
             # Recovery activity (api/sebulba_trainer.py supervisor +
             # utils/faults.py counters): shown only once NONZERO — a
             # healthy run's one-liner stays unchanged, a churning run
             # says so on every window.
+            # infer_coalesce_batch is a float MEAN (rows/round), not a
+            # counter — int() truncation would print 1.9 as "1".
+            if window.get("infer_coalesce_batch"):
+                parts.append(
+                    f"infer_coalesce_batch="
+                    f"{window['infer_coalesce_batch']:.1f}"
+                )
             for k, value in window.items():
                 if k in ("actor_restarts", "server_restarts",
-                         "queue_backpressure") or k.startswith("fault_"):
+                         "queue_backpressure", "slab_reuse_waits",
+                         ) or k.startswith("fault_"):
                     if value:
                         parts.append(f"{k}={int(value)}")
             print("  ".join(parts), file=self.stream)

@@ -118,6 +118,22 @@ QUICK: dict[str, object] = {
         "test_native_pool_close_safe_after_failed_init",
         "test_recovery_counters_flow_through_sinks",
     },
+    # Zero-copy staging pipeline (rollout/staging.py): ring/lease units
+    # are sub-second; the bit-identity A/B is ~25s (two tiny trainings).
+    # The two training smokes (chaos crash recovery, recurrent slabs)
+    # stay in the full tier / `-m chaos`.
+    "test_staging.py": {
+        "test_template_matches_buffer_geometry",
+        "test_zero_copy_emit_shares_slab_memory",
+        "test_no_reuse_before_transfer_complete",
+        "test_retire_reclaims_ready_slabs_without_blocking",
+        "test_generation_stamp_fences_restarted_actor",
+        "test_reset_invalidates_all_leases",
+        "test_auto_num_slabs_covers_pipeline_depth",
+        "test_slab_path_bit_identical_to_stack_path",
+    },
+    # overlap_h2d on/off A/B: identical losses + not-slower (~25s).
+    "test_perf_smoke.py": "all",
     "test_ppo_multipass.py": {
         "test_ppo_multipass_minibatch_divisibility_error",
         "test_ppo_multipass_dp_consistency",  # 8s
