@@ -1,0 +1,63 @@
+"""Framework-aware static checker for the async pipeline.
+
+``python -m asyncrl_tpu.analysis [paths...]`` runs four passes over the
+package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
+:mod:`asyncrl_tpu.analysis.annotations` for the annotation grammar):
+
+- ``locks``     — ``guarded-by`` lock discipline (LOCK*)
+- ``purity``    — host effects / state mutation inside jit (PURE*)
+- ``donation``  — donated-buffer and slab-lease aliasing safety (DON*)
+- ``ownership`` — cross-thread state audit + broad excepts (OWN*/EXC*)
+
+Annotation-grammar errors (ANN*) are produced by every run and cannot be
+waived. ``scripts/lint.sh`` wires this into CI next to ruff;
+``tests/test_analysis.py`` pins "the package lints clean" as a tier-1
+invariant.
+"""
+
+from __future__ import annotations
+
+from asyncrl_tpu.analysis.core import (  # noqa: F401  (public API)
+    Finding,
+    Project,
+    load_paths,
+    load_source,
+)
+
+PASSES = ("locks", "purity", "donation", "ownership")
+
+
+def run_passes(
+    project: Project, passes: tuple[str, ...] | list[str] = PASSES
+) -> list[Finding]:
+    """Annotation errors + every requested pass's findings, stably ordered
+    by (path, line, code)."""
+    from asyncrl_tpu.analysis import donation, locks, ownership, purity
+
+    impl = {
+        "locks": locks.run,
+        "purity": purity.run,
+        "donation": donation.run,
+        "ownership": ownership.run,
+    }
+    findings = list(project.annotation_errors())
+    for name in passes:
+        if name not in impl:
+            raise ValueError(f"unknown pass {name!r}; have {PASSES}")
+        findings.extend(impl[name](project))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.code))
+
+
+def check_paths(
+    paths: list[str], passes: tuple[str, ...] | list[str] = PASSES
+) -> list[Finding]:
+    return run_passes(load_paths(paths), passes)
+
+
+def check_source(
+    source: str,
+    path: str = "<string>",
+    passes: tuple[str, ...] | list[str] = PASSES,
+) -> list[Finding]:
+    """Lint a source string (tests; the lock-deletion detection proof)."""
+    return run_passes(load_source(source, path), passes)

@@ -1,0 +1,72 @@
+"""CLI: ``python -m asyncrl_tpu.analysis [paths...]``.
+
+Exit status 0 when every pass is clean, 1 when any finding (or annotation
+error) is reported, 2 on usage errors. With no paths, lints the installed
+``asyncrl_tpu`` package — the form ``scripts/lint.sh`` runs in CI.
+
+``--entries`` prints the thread-entry map (which functions each declared
+thread entry reaches) instead of linting — the audit's view of who runs
+where.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import asyncrl_tpu
+from asyncrl_tpu import analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m asyncrl_tpu.analysis",
+        description="framework-aware static checker (lock discipline, "
+        "JAX purity, donation safety, thread ownership)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the asyncrl_tpu package)",
+    )
+    parser.add_argument(
+        "--pass",
+        dest="passes",
+        action="append",
+        choices=analysis.PASSES,
+        help="run only the named pass(es); repeatable",
+    )
+    parser.add_argument(
+        "--entries",
+        action="store_true",
+        help="print the thread-entry map and exit",
+    )
+    args = parser.parse_args(argv)
+
+    paths = args.paths or [os.path.dirname(asyncrl_tpu.__file__)]
+    project = analysis.load_paths(paths)
+
+    if args.entries:
+        from asyncrl_tpu.analysis import ownership
+
+        for entry, reached in sorted(ownership.entry_map(project).items()):
+            print(f"{entry}:")
+            for name in reached:
+                print(f"  {name}")
+        return 0
+
+    findings = analysis.run_passes(project, args.passes or analysis.PASSES)
+    for finding in findings:
+        print(finding.render())
+    if findings:
+        print(
+            f"asyncrl_tpu.analysis: {len(findings)} finding(s)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,351 @@
+"""The annotation & waiver grammar the static passes understand.
+
+Annotations are trailing comments; they are *declarations* the passes then
+enforce. The full grammar (also documented in docs/ARCHITECTURE.md):
+
+``# guarded-by: <lockspec>``
+    On a ``self.<attr> = ...`` statement (any method, typically
+    ``__init__``) or a module-level ``NAME = ...`` statement. Declares the
+    attribute/global guarded. A single-identifier lockspec names a lock
+    attribute on the same object (``_lock``, ``_cond``) — validated to
+    exist and enforced by the lock pass on every access in the declaring
+    class. A dotted lockspec (``StagingRing._cond``) declares the guard
+    lives on a coordinating class: accesses to the attribute from within
+    that Owner class must hold ``self.<lock>``.
+
+``# holds: <lock>``
+    On a ``def`` line. The method is only ever called with ``self.<lock>``
+    already held (a ``*_locked`` helper); accesses inside it count as
+    guarded.
+
+``# thread-entry: <name>[@<group>]``
+    On a ``def`` or ``class`` line. Declares a thread-entry root for the
+    ownership audit: code reachable from it runs under entry ``<name>``.
+    Entries sharing ``<group>`` run on the same OS thread (the watchdog
+    runs inside the trainer drain's thread, so both map to group
+    ``learner``); group defaults to the entry name. On a ``class`` line,
+    every method of the class is a root.
+
+``# lint: <tag>(<reason>)``
+    A waiver for one finding on the same line (or the line directly
+    above). Tags: ``broad-except-ok`` (supervisor-boundary broad except),
+    ``unguarded-ok`` (deliberate lock-free access to a guarded attribute),
+    ``impure-ok`` (sanctioned host effect in jit-reachable code),
+    ``donated-read-ok`` (read after donation that is provably safe),
+    ``thread-shared-ok`` (cross-thread state with a non-lock discipline —
+    GIL-atomic stamp, single-writer latch, handshake ownership). The
+    reason is mandatory.
+
+Malformed annotations and unknown waiver tags are **hard lint errors**
+(ANN0xx findings) — a misspelled annotation must never silently enforce
+nothing. ANN findings cannot be waived.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from asyncrl_tpu.analysis.core import Finding, SourceModule, _self_attr_target
+
+WAIVER_TAGS = (
+    "broad-except-ok",
+    "unguarded-ok",
+    "impure-ok",
+    "donated-read-ok",
+    "thread-shared-ok",
+)
+
+_GUARDED_RE = re.compile(r"^guarded-by:\s*(\S+)\s*$")
+_LOCKSPEC_RE = re.compile(r"^[A-Za-z_]\w*(\.[A-Za-z_]\w*)*$")
+_HOLDS_RE = re.compile(r"^holds:\s*(\S+)\s*$")
+_ENTRY_RE = re.compile(r"^thread-entry:\s*([\w-]+)(?:@([\w-]+))?\s*$")
+_WAIVER_RE = re.compile(r"^lint:\s*([a-z][a-z-]*)\s*\(\s*(.*?)\s*\)\s*$")
+_WAIVER_LOOSE_RE = re.compile(r"^lint:")
+
+
+@dataclasses.dataclass(frozen=True)
+class Guard:
+    """A guarded-by declaration for (class_name, attr); class_name is None
+    for module globals. ``lock`` keeps the raw lockspec."""
+
+    class_name: str | None
+    attr: str
+    lock: str
+    line: int
+
+    @property
+    def simple(self) -> bool:
+        return "." not in self.lock
+
+    @property
+    def owner(self) -> str | None:
+        """For dotted specs ``Owner.lock``: the coordinating class name."""
+        return None if self.simple else self.lock.rsplit(".", 1)[0]
+
+    @property
+    def lock_attr(self) -> str:
+        return self.lock.rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    name: str
+    group: str
+    class_name: str | None
+    method: str | None  # None: every method of class_name is a root
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Waiver:
+    tag: str
+    reason: str
+    line: int
+    # A standalone comment line waives the line BELOW it; a waiver
+    # trailing code scopes strictly to its own line (a trailing waiver
+    # must never silently cover the next statement too).
+    standalone: bool = False
+
+
+class ModuleAnnotations:
+    def __init__(self) -> None:
+        self.guards: dict[tuple[str | None, str], Guard] = {}
+        self.holds: dict[tuple[str, str], str] = {}  # (class, method) -> lock
+        self.entries: list[Entry] = []
+        self.waivers: dict[int, Waiver] = {}
+        self.errors: list[Finding] = []
+
+    def waived(self, line: int, tag: str) -> bool:
+        """A waiver for ``tag`` on ``line`` itself, or a STANDALONE
+        waiver comment directly above it (a waiver trailing code never
+        covers the next line)."""
+        w = self.waivers.get(line)
+        if w is not None and w.tag == tag:
+            return True
+        w = self.waivers.get(line - 1)
+        return w is not None and w.tag == tag and w.standalone
+
+    def guard_for(self, class_name: str | None, attr: str) -> Guard | None:
+        return self.guards.get((class_name, attr))
+
+
+def _enclosing_class(
+    tree: ast.Module, target: ast.AST
+) -> ast.ClassDef | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in ast.walk(node):
+                if sub is target:
+                    return node
+    return None
+
+
+def _class_assigns_attr(cls: ast.ClassDef, attr: str) -> bool:
+    for node in ast.walk(cls):
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            if _self_attr_target(t) == attr:
+                return True
+    return False
+
+
+def _def_at_line(tree: ast.Module, line: int):
+    """The FunctionDef/ClassDef whose signature span covers ``line``
+    (a def signature can wrap; the annotation may trail any of its
+    lines)."""
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            body_start = node.body[0].lineno if node.body else node.lineno
+            if node.lineno <= line < max(body_start, node.lineno + 1):
+                return node
+    return None
+
+
+def parse_module(module: SourceModule) -> ModuleAnnotations:
+    out = ModuleAnnotations()
+    for line, text in sorted(module.comments.items()):
+        # Waivers dispatch FIRST, and annotations trigger only at the
+        # comment's start: a waiver whose reason mentions "guarded-by"
+        # (e.g. quoting this tool's own remediation text) must stay a
+        # waiver, and prose about the grammar must stay prose.
+        if _WAIVER_LOOSE_RE.match(text):
+            _parse_waiver(module, line, text, out)
+        elif text.startswith("guarded-by"):
+            _parse_guard(module, line, text, out)
+        elif text.startswith("holds:"):
+            _parse_holds(module, line, text, out)
+        elif text.startswith("thread-entry"):
+            _parse_entry(module, line, text, out)
+    return out
+
+
+def _parse_waiver(
+    module: SourceModule, line: int, text: str, out: ModuleAnnotations
+) -> None:
+    m = _WAIVER_RE.match(text)
+    if not m or not m.group(2):
+        out.errors.append(
+            Finding(
+                "ANN004", module.path, line,
+                f"malformed lint waiver {text!r}: expected "
+                "'# lint: <tag>(<reason>)' with a non-empty reason",
+            )
+        )
+        return
+    tag, reason = m.group(1), m.group(2)
+    if tag not in WAIVER_TAGS:
+        out.errors.append(
+            Finding(
+                "ANN005", module.path, line,
+                f"unknown lint waiver tag {tag!r}; known tags: "
+                + ", ".join(WAIVER_TAGS),
+            )
+        )
+        return
+    out.waivers[line] = Waiver(
+        tag, reason, line,
+        standalone=line in module.standalone_comments,
+    )
+
+
+def _parse_guard(
+    module: SourceModule, line: int, text: str, out: ModuleAnnotations
+) -> None:
+    m = _GUARDED_RE.match(text)
+    if not m or not _LOCKSPEC_RE.match(m.group(1)):
+        out.errors.append(
+            Finding(
+                "ANN001", module.path, line,
+                f"malformed guarded-by annotation {text!r}: expected "
+                "'# guarded-by: <lock>' or '# guarded-by: <Owner>.<lock>'",
+            )
+        )
+        return
+    lock = m.group(1)
+    stmt = module.statement_at(line)
+    attr = None
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for t in targets:
+            attr = _self_attr_target(t)
+            if attr is None and isinstance(t, ast.Name):
+                attr = t.id
+            if attr:
+                break
+    if attr is None or stmt is None:
+        out.errors.append(
+            Finding(
+                "ANN002", module.path, line,
+                "guarded-by annotation must trail a 'self.<attr> = ...' "
+                "or module-level 'NAME = ...' assignment",
+            )
+        )
+        return
+    cls = _enclosing_class(module.tree, stmt)
+    class_name = cls.name if cls is not None else None
+    guard = Guard(class_name, attr, lock, line)
+    if guard.simple and cls is not None:
+        if not _class_assigns_attr(cls, guard.lock_attr):
+            out.errors.append(
+                Finding(
+                    "ANN003", module.path, line,
+                    f"guarded-by lock {lock!r} is not an attribute "
+                    f"assigned anywhere in class {class_name}",
+                )
+            )
+            return
+    if guard.simple and cls is None:
+        # Module-global guard: the lock must itself be a module-level
+        # name, or the declaration enforces nothing.
+        top_names = {
+            t.id
+            for s in module.tree.body
+            if isinstance(s, (ast.Assign, ast.AnnAssign))
+            for t in (s.targets if isinstance(s, ast.Assign) else [s.target])
+            if isinstance(t, ast.Name)
+        }
+        if guard.lock_attr not in top_names:
+            out.errors.append(
+                Finding(
+                    "ANN003", module.path, line,
+                    f"guarded-by lock {lock!r} is not assigned at module "
+                    "level",
+                )
+            )
+            return
+    out.guards[(class_name, attr)] = guard
+
+
+def _parse_holds(
+    module: SourceModule, line: int, text: str, out: ModuleAnnotations
+) -> None:
+    m = _HOLDS_RE.match(text)
+    node = _def_at_line(module.tree, line)
+    if not m or not _LOCKSPEC_RE.match(m.group(1)) or "." in m.group(1):
+        out.errors.append(
+            Finding(
+                "ANN006", module.path, line,
+                f"malformed holds annotation {text!r}: expected "
+                "'# holds: <lock>' on a def line",
+            )
+        )
+        return
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        out.errors.append(
+            Finding(
+                "ANN007", module.path, line,
+                "holds annotation must trail a method's def line",
+            )
+        )
+        return
+    cls = _enclosing_class(module.tree, node)
+    if cls is None or not _class_assigns_attr(cls, m.group(1)):
+        out.errors.append(
+            Finding(
+                "ANN008", module.path, line,
+                f"holds lock {m.group(1)!r} is not an attribute of the "
+                "enclosing class",
+            )
+        )
+        return
+    out.holds[(cls.name, node.name)] = m.group(1)
+
+
+def _parse_entry(
+    module: SourceModule, line: int, text: str, out: ModuleAnnotations
+) -> None:
+    m = _ENTRY_RE.match(text)
+    node = _def_at_line(module.tree, line)
+    if not m:
+        out.errors.append(
+            Finding(
+                "ANN009", module.path, line,
+                f"malformed thread-entry annotation {text!r}: expected "
+                "'# thread-entry: <name>[@<group>]'",
+            )
+        )
+        return
+    if node is None:
+        out.errors.append(
+            Finding(
+                "ANN010", module.path, line,
+                "thread-entry annotation must trail a def or class line",
+            )
+        )
+        return
+    name, group = m.group(1), m.group(2) or m.group(1)
+    if isinstance(node, ast.ClassDef):
+        out.entries.append(Entry(name, group, node.name, None, line))
+        return
+    cls = _enclosing_class(module.tree, node)
+    out.entries.append(
+        Entry(name, group, cls.name if cls else None, node.name, line)
+    )

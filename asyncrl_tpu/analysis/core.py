@@ -1,0 +1,278 @@
+"""Shared infrastructure for the framework-aware static checker.
+
+The analysis subsystem (``python -m asyncrl_tpu.analysis``) enforces, at
+lint time and on every line, the concurrency and JAX disciplines the
+runtime checks (``ASYNCRL_DEBUG_SYNC``, ``tests/test_race_debug.py``) can
+only probe on the interleavings a stress test happens to hit. Four passes
+run over the package's ASTs (stdlib ``ast``/``tokenize`` only — no
+third-party linter dependency):
+
+- :mod:`asyncrl_tpu.analysis.locks`      — ``guarded-by`` lock discipline
+- :mod:`asyncrl_tpu.analysis.purity`     — host effects inside jit/scan
+- :mod:`asyncrl_tpu.analysis.donation`   — donated/retired buffer reads
+- :mod:`asyncrl_tpu.analysis.ownership`  — cross-thread state audit +
+  broad-except swallows
+
+This module holds what every pass shares: source loading, comment
+extraction, import/alias resolution, class/attribute indexing, a light
+``self.<attr> = ClassName(...)`` type map, and the :class:`Finding`
+record. The annotation grammar itself lives in
+:mod:`asyncrl_tpu.analysis.annotations`.
+
+The checker is deliberately approximate — a linter, not a verifier: it
+resolves calls by name (unique-name or typed-receiver only), it does not
+model closures handed across threads (declare those with a
+``# thread-entry:`` annotation), and it treats annotations as trusted
+declarations. What it guarantees is that every *declared* discipline is
+enforced on every line, every time ``scripts/lint.sh`` runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import tokenize
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``code`` identifies the rule (LOCK/PURE/DON/OWN/
+    EXC/ANN families); annotation-grammar errors (ANN*) are hard errors
+    that no waiver can silence."""
+
+    code: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class SourceModule:
+    """One parsed source file: AST + per-line comments + import aliases."""
+
+    def __init__(self, path: str, source: str, name: str | None = None):
+        self.path = path
+        self.name = name or os.path.splitext(os.path.basename(path))[0]
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        # line -> comment text (sans '#', stripped). tokenize is the only
+        # robust way to tell a comment from a '#' inside a string literal.
+        self.comments: dict[int, str] = {}
+        # Lines whose comment stands alone (no code before it): only these
+        # may waive the NEXT line; a trailing waiver scopes to its own.
+        self.standalone_comments: set[int] = set()
+        src_lines = source.split("\n")
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    line, col = tok.start
+                    self.comments[line] = tok.string.lstrip("#").strip()
+                    if not src_lines[line - 1][:col].strip():
+                        self.standalone_comments.add(line)
+        except (tokenize.TokenError, IndentationError):
+            pass  # a syntactically valid file that tokenize chokes on
+        # alias -> dotted module or module.symbol ("np" -> "numpy",
+        # "monotonic" -> "time.monotonic", "staging" ->
+        # "asyncrl_tpu.rollout.staging").
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname is not None:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        # `import a.b` binds the name `a` (references are
+                        # already fully dotted): mapping 'a' -> 'a.b'
+                        # would make `a.c` resolve to 'a.b.c'.
+                        head = a.name.split(".")[0]
+                        self.aliases[head] = head
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+        # Parsed annotations are attached by annotations.parse_module()
+        # (import cycle: that module needs SourceModule).
+        self.annotations = None
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Fully-aliased dotted name of a Name/Attribute chain: the first
+        segment is expanded through this module's imports, so ``np.random.x``
+        resolves to ``numpy.random.x``."""
+        dotted = _dotted(node)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        head = self.aliases.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+    def statement_at(self, line: int) -> ast.stmt | None:
+        """The innermost statement whose span covers ``line`` (how trailing
+        annotation comments bind to code)."""
+        best: ast.stmt | None = None
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                if best is None or node.lineno >= best.lineno:
+                    best = node
+        return best
+
+
+def _self_attr_target(node: ast.AST) -> str | None:
+    """The ``X`` of a ``self.X`` store target, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class ClassInfo:
+    """Per-class index: methods, declared instance attributes, base names,
+    and the light ``self.<attr> = ClassName(...)`` type map."""
+
+    def __init__(self, module: SourceModule, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.bases = [b for b in (_dotted(base) for base in node.bases) if b]
+        self.methods: dict[str, ast.FunctionDef] = {
+            n.name: n
+            for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # attr -> line of first `self.attr = ...` (any method). Class-body
+        # AnnAssign fields (flax struct dataclasses: Rollout, LearnerState,
+        # Config) are deliberately NOT registered — they are immutable
+        # pytree fields, not mutable instance state.
+        self.attrs: dict[str, int] = {}
+        # attrs written by a `self.attr = ...` outside __init__ (in the
+        # declaring class itself), attr -> [lines].
+        self.noninit_writes: dict[str, list[int]] = {}
+        # attr -> ClassName for `self.attr = ClassName(...)` bindings.
+        self.attr_types: dict[str, str] = {}
+        for mname, method in self.methods.items():
+            for sub in ast.walk(method):
+                targets: list[ast.AST] = []
+                if isinstance(sub, ast.Assign):
+                    targets = list(sub.targets)
+                elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [sub.target]
+                for target in targets:
+                    attr = _self_attr_target(target)
+                    if attr is None:
+                        continue
+                    self.attrs.setdefault(attr, sub.lineno)
+                    if mname != "__init__":
+                        self.noninit_writes.setdefault(attr, []).append(
+                            sub.lineno
+                        )
+                    if isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Call
+                    ):
+                        callee = _dotted(sub.value.func)
+                        if callee:
+                            self.attr_types[attr] = callee.split(".")[-1]
+
+
+class Project:
+    """A set of modules under analysis + the cross-module indexes every
+    pass shares."""
+
+    def __init__(self, modules: list[SourceModule]):
+        # Not `from asyncrl_tpu.analysis import annotations`: the package
+        # __init__'s `from __future__ import annotations` shadows the
+        # submodule as a package attribute.
+        import asyncrl_tpu.analysis.annotations as annotations
+
+        self.modules = modules
+        self.classes: dict[str, list[ClassInfo]] = {}
+        self.class_list: list[ClassInfo] = []
+        for module in modules:
+            if module.annotations is None:
+                module.annotations = annotations.parse_module(module)
+            for node in module.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    info = ClassInfo(module, node)
+                    self.classes.setdefault(info.name, []).append(info)
+                    self.class_list.append(info)
+        # method name -> [ClassInfo] (for unique-name call resolution).
+        self.methods_by_name: dict[str, list[ClassInfo]] = {}
+        for info in self.class_list:
+            for mname in info.methods:
+                self.methods_by_name.setdefault(mname, []).append(info)
+        # attr name -> [ClassInfo] declaring it (for foreign-touch
+        # attribution; only unambiguous names are attributed).
+        self.attrs_by_name: dict[str, list[ClassInfo]] = {}
+        for info in self.class_list:
+            for attr in info.attrs:
+                self.attrs_by_name.setdefault(attr, []).append(info)
+        # Names that are ALSO fields of (data)classes declared via
+        # class-body AnnAssign — immutable pytree fields (Rollout,
+        # LearnerState, Config). An untyped `x.rewards` cannot be told
+        # apart from a Rollout field read, so name-based foreign
+        # attribution skips these.
+        self.dataclass_fields: set[str] = set()
+        for info in self.class_list:
+            for stmt in info.node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    self.dataclass_fields.add(stmt.target.id)
+
+    def annotation_errors(self) -> list[Finding]:
+        out: list[Finding] = []
+        for module in self.modules:
+            out.extend(module.annotations.errors)
+        return out
+
+
+def load_paths(paths: list[str]) -> Project:
+    """Build a Project from files and/or directories (``.py`` under a
+    directory, recursively, skipping hidden and build directories)."""
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [
+                    d
+                    for d in dirnames
+                    if not d.startswith((".", "__pycache__", "build"))
+                ]
+                files.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        else:
+            files.append(path)
+    modules = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            modules.append(SourceModule(f, fh.read()))
+    return Project(modules)
+
+
+def load_source(source: str, path: str = "<string>") -> Project:
+    """A single-source Project (tests and the lock-deletion proof)."""
+    return Project([SourceModule(path, source)])

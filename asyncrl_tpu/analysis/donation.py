@@ -1,0 +1,502 @@
+"""Donation / aliasing-safety pass (DON0xx).
+
+PR 2 made two kinds of buffer hand-off load-bearing: ``jax.jit(...,
+donate_argnums=...)`` deletes its donated inputs (any later read raises
+"Array has been deleted" — or worse, silently reads reused memory on a
+zero-copy backend), and staging-slab rows (rollout/staging.py) are only
+valid between lease acquire and ``StagingRing.retire``. Both disciplines
+are invisible to the type system; this pass enforces them statically:
+
+- DON001 — a variable passed at a donated position is read again on a
+  path after the donating call (before being rebound). Donating bindings
+  are discovered from ``jax.jit(..., donate_argnums=(k,))`` assignments
+  (conditional ``(k,) if cfg else ()`` counts as donating — the lint must
+  hold for every config), and donation propagates one level through
+  forwarding methods that pass their own parameter straight into a
+  donated position (``RolloutLearner.update``).
+- DON002 — a slab batch read after retire: a variable bound from
+  ``<ring>.batch(...)`` is read after a ``<ring>.retire(...)`` call in
+  the same function.
+- DON003 — a slab row view escapes its lease scope: a variable bound
+  directly from ``.batch(...)``/``.row(...)`` is stored onto ``self``
+  (outliving the lease) outside the staging module itself.
+- DON004 — ``donate_argnames`` strings the scan cannot map to positions
+  (callee not a local def/lambda): reported as "this donation is
+  unchecked" rather than silently skipped.
+
+Loop approximation: after a donating call inside a loop, back-edge reads
+are flagged only when the variable is never rebound anywhere in the loop
+body (if it is rebound, the next iteration's read order is not decidable
+lexically and the straight-line check already covers the common bug).
+``# lint: donated-read-ok(<reason>)`` waives one read.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from asyncrl_tpu.analysis.core import Finding, Project, SourceModule, _dotted
+
+
+def _callee_params(module: SourceModule, call: ast.Call) -> list[str] | None:
+    """Parameter names of the function being jitted (``jax.jit(f, ...)``),
+    when ``f`` is a lambda or a def in the same module — how
+    donate_argnames strings map to positions."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Lambda):
+        return [a.arg for a in target.args.args]
+    name = None
+    if isinstance(target, ast.Name):
+        name = target.id
+    elif isinstance(target, ast.Attribute) and isinstance(
+        target.value, ast.Name
+    ) and target.value.id == "self":
+        name = target.attr
+    if name is None:
+        return None
+    for node in ast.walk(module.tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == name
+        ):
+            params = [a.arg for a in node.args.args]
+            return params[1:] if params[:1] == ["self"] else params
+    return None
+
+
+def _donated_positions(
+    module: SourceModule, call: ast.Call
+) -> tuple[set[int], list[str]]:
+    """Donated arg indices of a ``jax.jit`` call: ints from donate_argnums
+    (union over conditional branches — donation must be SAFE, so a maybe-
+    donated arg counts as donated), plus donate_argnames strings resolved
+    through the callee's parameter list. Returns (positions, unresolved
+    argnames) — unresolved names become DON004, never a silent skip."""
+    positions: set[int] = set()
+    unresolved: list[str] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, int
+                ):
+                    positions.add(node.value)
+        elif kw.arg == "donate_argnames":
+            params = _callee_params(module, call)
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    if params is not None and node.value in params:
+                        positions.add(params.index(node.value))
+                    else:
+                        unresolved.append(node.value)
+    return positions, unresolved
+
+
+class _DonatingBindings:
+    """Names/attrs bound to donating jitted callables, plus one level of
+    forwarding methods. Resolution is class-scoped and typed-receiver
+    only: ``self._step(...)`` resolves inside the class that bound it,
+    and ``self.learner.update(...)`` resolves through the
+    ``self.learner = RolloutLearner(...)`` type binding — never by bare
+    method name (``.update()`` is every dict and set in the codebase)."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        # DON004: donate_argnames the scan could not map to positions —
+        # reported, so an argnames donation is never silently unchecked.
+        self.findings: list[Finding] = []
+        # (class_name, attr) -> donated positions, for self._step = jit(...)
+        self.attr_bindings: dict[tuple[str, str], set[int]] = {}
+        # (module id, name) -> donated positions, for g = jit(...) at
+        # module or function scope.
+        self.name_bindings: dict[tuple[int, str], set[int]] = {}
+        # Typed-attribute map, shared with the ownership pass: core's
+        # ClassInfo already records `self.attr = ClassName(...)` bindings.
+        self.attr_types: dict[tuple[str, str], str] = {
+            (info.name, attr): type_name
+            for info in project.class_list
+            for attr, type_name in info.attr_types.items()
+            if type_name in project.classes
+        }
+        for module in project.modules:
+            self._scan_bindings(module)
+        # (ClassName, method) -> donated parameter positions (self-less).
+        self.forwarders: dict[tuple[str, str], set[int]] = {}
+        for module in project.modules:
+            self._scan_forwarders(module)
+
+    def _scan_bindings(self, module: SourceModule) -> None:
+        class_of: dict[int, str] = {}
+        for cls in module.tree.body:
+            if isinstance(cls, ast.ClassDef):
+                for sub in ast.walk(cls):
+                    class_of[id(sub)] = cls.name
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            cls_name = class_of.get(id(node))
+            resolved = module.resolve(call.func)
+            if not resolved or resolved.rsplit(".", 1)[-1] != "jit":
+                continue
+            positions, unresolved = _donated_positions(module, call)
+            if unresolved and not module.annotations.waived(
+                call.lineno, "donated-read-ok"
+            ):
+                self.findings.append(
+                    Finding(
+                        "DON004", module.path, call.lineno,
+                        f"donate_argnames {unresolved} could not be "
+                        "resolved to argument positions (callee not a "
+                        "local def/lambda): the donation is UNCHECKED — "
+                        "use donate_argnums or a locally-defined callee",
+                    )
+                )
+            if not positions:
+                continue
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and cls_name is not None
+                ):
+                    self.attr_bindings[(cls_name, target.attr)] = positions
+                elif isinstance(target, ast.Name):
+                    self.name_bindings[(id(module), target.id)] = positions
+
+    def _scan_forwarders(self, module: SourceModule) -> None:
+        for cls in module.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for method in cls.body:
+                if not isinstance(method, ast.FunctionDef):
+                    continue
+                params = [a.arg for a in method.args.args]
+                if not params or params[0] != "self":
+                    continue
+                for sub in ast.walk(method):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    positions = self.call_positions(module, cls.name, sub)
+                    fwd: set[int] = set()
+                    for k in positions:
+                        if k < len(sub.args) and isinstance(
+                            sub.args[k], ast.Name
+                        ):
+                            name = sub.args[k].id
+                            if name in params[1:]:
+                                fwd.add(params.index(name) - 1)
+                    if fwd:
+                        self.forwarders.setdefault(
+                            (cls.name, method.name), set()
+                        ).update(fwd)
+
+    def call_positions(
+        self, module: SourceModule, cls_name: str | None, call: ast.Call
+    ) -> set[int]:
+        """Donated positions for a call through a recorded binding or a
+        typed-receiver forwarding method."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.name_bindings.get((id(module), func.id), set())
+        if not isinstance(func, ast.Attribute):
+            return set()
+        recv = func.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if cls_name is None:
+                return set()
+            hit = self.attr_bindings.get((cls_name, func.attr))
+            if hit is not None:
+                return hit
+            return self.forwarders.get((cls_name, func.attr), set())
+        # self.<typed attr>.m(...)
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and cls_name is not None
+        ):
+            type_name = self.attr_types.get((cls_name, recv.attr))
+            if type_name is not None:
+                return self.forwarders.get((type_name, func.attr), set())
+        return set()
+
+
+def _stmt_rebinds(stmt: ast.stmt, name: str) -> bool:
+    """Does this statement rebind ``name`` at its top level (plain or
+    tuple-unpacking assignment)? The canonical donation idiom
+    ``state = self._step(state, rollout)`` rebinds in the donating
+    statement itself — reads of the FRESH binding are fine."""
+    if not isinstance(stmt, ast.Assign):
+        return False
+    for target in stmt.targets:
+        elts = (
+            target.elts
+            if isinstance(target, (ast.Tuple, ast.List))
+            else [target]
+        )
+        for e in elts:
+            if isinstance(e, ast.Starred):
+                e = e.value
+            if isinstance(e, ast.Name) and e.id == name:
+                return True
+    return False
+
+
+def _reads_after(
+    body: list[ast.stmt],
+    start_index: int,
+    name: str,
+) -> list[ast.AST]:
+    """Name loads of ``name`` in ``body[start_index:]``, stopping at the
+    first statement that unconditionally rebinds it."""
+    reads: list[ast.AST] = []
+    for stmt in body[start_index:]:
+        rebound = False
+        if _stmt_rebinds(stmt, name):
+            # Reads on the RHS of the rebinding statement itself are fine
+            # only if they are the rebind (x = f(y)); a self-referential
+            # rebind (x = g(x)) still reads the dead value.
+            for sub in ast.walk(stmt.value):
+                if (
+                    isinstance(sub, ast.Name)
+                    and sub.id == name
+                    and isinstance(sub.ctx, ast.Load)
+                ):
+                    reads.append(sub)
+            rebound = True
+        else:
+            for sub in ast.walk(stmt):
+                if (
+                    isinstance(sub, ast.Name)
+                    and sub.id == name
+                    and isinstance(sub.ctx, ast.Load)
+                ):
+                    reads.append(sub)
+        if rebound:
+            break
+    return reads
+
+
+def _enclosing_chain(
+    fn: ast.AST, target: ast.stmt
+) -> list[tuple[list[ast.stmt], int]] | None:
+    """(block, index) pairs from the statement's own block outward to the
+    function body — the lexical "what runs after this" chain."""
+
+    def search(body: list[ast.stmt]) -> list[tuple[list[ast.stmt], int]] | None:
+        for i, stmt in enumerate(body):
+            if stmt is target:
+                return [(body, i)]
+            for field in ("body", "orelse", "finalbody"):
+                child = getattr(stmt, field, None)
+                if isinstance(child, list) and child:
+                    found = search(child)
+                    if found is not None:
+                        return found + [(body, i)]
+            for handler in getattr(stmt, "handlers", []) or []:
+                found = search(handler.body)
+                if found is not None:
+                    return found + [(body, i)]
+        return None
+
+    return search(fn.body)
+
+
+def _loop_ancestors(fn: ast.AST, target: ast.stmt) -> list[ast.stmt]:
+    loops: list[ast.stmt] = []
+
+    def walk(node: ast.AST, stack: list[ast.stmt]) -> bool:
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                loops.extend(
+                    s for s in stack if isinstance(s, (ast.For, ast.While))
+                )
+                return True
+            pushed = isinstance(child, (ast.For, ast.While))
+            if pushed:
+                stack.append(child)
+            if walk(child, stack):
+                return True
+            if pushed:
+                stack.pop()
+        return False
+
+    walk(fn, [])
+    return loops
+
+
+def _rebound_in(body: list[ast.stmt], name: str) -> bool:
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if (
+                isinstance(sub, ast.Name)
+                and sub.id == name
+                and isinstance(sub.ctx, ast.Store)
+            ):
+                return True
+    return False
+
+
+def _dead_name_reads(
+    fn: ast.AST, kill_stmt: ast.stmt, name: str
+) -> list[ast.AST]:
+    """Reads of ``name`` that lexically follow ``kill_stmt`` (same block
+    onward, and enclosing blocks' later statements), plus back-edge reads
+    when the name is never rebound in the enclosing loop."""
+    chain = _enclosing_chain(fn, kill_stmt)
+    if chain is None:
+        return []
+    reads: list[ast.AST] = []
+    (block, i), *outer = chain
+    reads.extend(_reads_after(block, i + 1, name))
+    for outer_block, j in outer:
+        reads.extend(_reads_after(outer_block, j + 1, name))
+    for loop in _loop_ancestors(fn, kill_stmt):
+        if not _rebound_in(loop.body, name):
+            for sub in ast.walk(loop):
+                if (
+                    isinstance(sub, ast.Name)
+                    and sub.id == name
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.lineno < kill_stmt.lineno
+                ):
+                    reads.append(sub)
+    return reads
+
+
+def _stmt_of(fn: ast.AST, node: ast.AST) -> ast.stmt | None:
+    """The innermost statement of ``fn`` containing ``node``."""
+    best = None
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.stmt):
+            for sub in ast.walk(stmt):
+                if sub is node:
+                    if best is None or stmt.lineno >= best.lineno:
+                        best = stmt
+                    break
+    return best
+
+
+def run(project: Project) -> list[Finding]:
+    bindings = _DonatingBindings(project)
+    findings: list[Finding] = list(bindings.findings)
+    for module in project.modules:
+        class_of: dict[int, str] = {}
+        for cls in module.tree.body:
+            if isinstance(cls, ast.ClassDef):
+                for sub in ast.walk(cls):
+                    class_of[id(sub)] = cls.name
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            _check_function(
+                module, class_of.get(id(fn)), fn, bindings, findings
+            )
+    return findings
+
+
+def _check_function(
+    module: SourceModule,
+    cls_name: str | None,
+    fn: ast.AST,
+    bindings: _DonatingBindings,
+    findings: list[Finding],
+) -> None:
+    ann = module.annotations
+    # var -> the .batch()/.row() receiver it was bound from.
+    slab_vars: dict[str, str] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            func = node.value.func
+            if isinstance(func, ast.Attribute) and func.attr in (
+                "batch",
+                "row",
+            ):
+                receiver = _dotted(func.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and receiver:
+                        slab_vars[t.id] = receiver
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        positions = bindings.call_positions(module, cls_name, node)
+        if positions:
+            stmt = _stmt_of(fn, node)
+            if stmt is None:
+                continue
+            for k in sorted(positions):
+                if k >= len(node.args) or not isinstance(
+                    node.args[k], ast.Name
+                ):
+                    continue
+                name = node.args[k].id
+                if _stmt_rebinds(stmt, name):
+                    # `state = self._step(state, ...)`: the donating
+                    # statement rebinds the name to the fresh output —
+                    # later reads see the new buffer, not the donated one.
+                    continue
+                for read in _dead_name_reads(fn, stmt, name):
+                    if ann.waived(read.lineno, "donated-read-ok"):
+                        continue
+                    findings.append(
+                        Finding(
+                            "DON001", module.path, read.lineno,
+                            f"{name!r} read after being passed at donated "
+                            f"position {k} of a donating call "
+                            f"(line {node.lineno}): the buffer is deleted "
+                            "or aliased by then",
+                        )
+                    )
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "retire":
+            receiver = _dotted(func.value)
+            stmt = _stmt_of(fn, node)
+            if stmt is None:
+                continue
+            for name, bound_from in slab_vars.items():
+                if receiver is not None and bound_from != receiver:
+                    continue
+                for read in _dead_name_reads(fn, stmt, name):
+                    if ann.waived(read.lineno, "donated-read-ok"):
+                        continue
+                    findings.append(
+                        Finding(
+                            "DON002", module.path, read.lineno,
+                            f"slab batch {name!r} read after "
+                            f"{receiver}.retire() (line {node.lineno}): "
+                            "the slab can be re-leased and overwritten",
+                        )
+                    )
+
+    if os.path.basename(module.path) != "staging.py":
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Name)
+                and node.value.id in slab_vars
+            ):
+                continue
+            for t in node.targets:
+                dotted = _dotted(t)
+                if dotted and dotted.startswith("self."):
+                    if ann.waived(node.lineno, "donated-read-ok"):
+                        continue
+                    findings.append(
+                        Finding(
+                            "DON003", module.path, node.lineno,
+                            f"slab view {node.value.id!r} stored to "
+                            f"{dotted}: a row view must not escape its "
+                            "lease scope",
+                        )
+                    )

@@ -1,0 +1,205 @@
+"""Lock-discipline pass (LOCK0xx).
+
+Enforces every ``# guarded-by:`` declaration:
+
+- A guarded ``self.<attr>`` may only be touched inside a
+  ``with self.<lock>:`` block, inside a method annotated
+  ``# holds: <lock>``, or inside ``__init__``/``__del__`` (construction
+  and teardown happen before/after sharing).
+- For a dotted guard ``Owner.<lock>`` (state owned by a satellite object
+  but coordinated by Owner's lock — e.g. ``_Slab`` row ledgers under
+  ``StagingRing._cond``), any access spelled ``<expr>.<attr>`` from
+  *within Owner's methods* must hold ``self.<lock>`` the same way.
+  Accesses from other classes are out of the lock pass's scope (the
+  ownership pass accounts for them).
+- ``# lint: unguarded-ok(<reason>)`` waives a single deliberate lock-free
+  access (e.g. a seqlock-style racy read whose authoritative check is
+  elsewhere).
+
+A nested ``def`` resets the held-lock context (a closure defined inside
+a ``with`` block generally outlives it); a lambda inherits it (the
+dominant pattern is a ``Condition.wait_for`` predicate, evaluated with
+the lock held).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from asyncrl_tpu.analysis.core import ClassInfo, Finding, Project
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    """Lock attr names of ``with self.<lock>:`` items."""
+    locks: set[str] = set()
+    for item in node.items:
+        ctx = item.context_expr
+        if (
+            isinstance(ctx, ast.Attribute)
+            and isinstance(ctx.value, ast.Name)
+            and ctx.value.id == "self"
+        ):
+            locks.add(ctx.attr)
+    return locks
+
+
+class _MethodChecker(ast.NodeVisitor):
+    def __init__(
+        self,
+        info: ClassInfo,
+        method: str,
+        self_guards: dict[str, str],
+        owner_guards: dict[str, str],
+        findings: list[Finding],
+    ):
+        self.info = info
+        self.method = method
+        self.self_guards = self_guards  # attr -> required self lock
+        self.owner_guards = owner_guards  # foreign attr -> required self lock
+        self.findings = findings
+        self.held: list[str] = []
+        ann = info.module.annotations
+        held_lock = ann.holds.get((info.name, method))
+        if held_lock is not None:
+            self.held.append(held_lock)
+
+    def visit_With(self, node: ast.With) -> None:
+        locks = _with_locks(node)
+        self.held.extend(locks)
+        self.generic_visit(node)
+        for _ in locks:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # Lambdas INHERIT the held set: the dominant pattern is a
+        # Condition.wait_for predicate, which the condition evaluates with
+        # the lock held. (Nested defs still reset — they outlive blocks.)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = node.attr
+        is_self = isinstance(node.value, ast.Name) and node.value.id == "self"
+        lock = self.self_guards.get(attr) if is_self else None
+        if lock is None and not is_self:
+            lock = self.owner_guards.get(attr)
+        if lock is not None and lock not in self.held:
+            ann = self.info.module.annotations
+            if not ann.waived(node.lineno, "unguarded-ok"):
+                where = f"self.{attr}" if is_self else f"<...>.{attr}"
+                self.findings.append(
+                    Finding(
+                        "LOCK001",
+                        self.info.module.path,
+                        node.lineno,
+                        f"{where} accessed in "
+                        f"{self.info.name}.{self.method} without holding "
+                        f"self.{lock} (declared '# guarded-by')",
+                    )
+                )
+        self.generic_visit(node)
+
+
+class _GlobalChecker(ast.NodeVisitor):
+    """Enforce module-level ``# guarded-by:`` declarations: guarded
+    globals may only be touched inside ``with <lock>:`` within functions
+    (module top-level code runs import-time, single-threaded — the
+    construction analog of ``__init__``)."""
+
+    def __init__(self, module, guards: dict[str, str], findings):
+        self.module = module
+        self.guards = guards  # global name -> module-level lock name
+        self.findings = findings
+        self.held: list[str] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        locks = {
+            item.context_expr.id
+            for item in node.items
+            if isinstance(item.context_expr, ast.Name)
+        }
+        self.held.extend(locks)
+        self.generic_visit(node)
+        for _ in locks:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        # Nested defs are checked as their own roots (fresh held set) by
+        # _check_module_globals's walk; don't double-visit them here.
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Name(self, node: ast.Name) -> None:
+        lock = self.guards.get(node.id)
+        if lock is not None and lock not in self.held:
+            ann = self.module.annotations
+            if not ann.waived(node.lineno, "unguarded-ok"):
+                self.findings.append(
+                    Finding(
+                        "LOCK002",
+                        self.module.path,
+                        node.lineno,
+                        f"module global {node.id!r} accessed without "
+                        f"holding {lock} (declared '# guarded-by')",
+                    )
+                )
+
+
+def _check_module_globals(module, findings: list[Finding]) -> None:
+    guards = {
+        attr: g.lock
+        for (cls, attr), g in module.annotations.guards.items()
+        if cls is None and g.simple
+    }
+    if not guards:
+        return
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            checker = _GlobalChecker(module, guards, findings)
+            for stmt in node.body:
+                checker.visit(stmt)
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for module in project.modules:
+        _check_module_globals(module, findings)
+    for info in project.class_list:
+        ann = info.module.annotations
+        # self.<attr> guards declared by this class (single-identifier).
+        self_guards = {
+            attr: g.lock
+            for (cls, attr), g in ann.guards.items()
+            if cls == info.name and g.simple
+        }
+        # Dotted guards naming THIS class as the lock owner: foreign-attr
+        # accesses inside this class's methods must hold self.<lock>.
+        owner_guards: dict[str, str] = {}
+        for module in project.modules:
+            for (_, attr), g in module.annotations.guards.items():
+                if not g.simple and g.owner == info.name:
+                    owner_guards[attr] = g.lock_attr
+        if not self_guards and not owner_guards:
+            continue
+        for mname, method in info.methods.items():
+            if mname in ("__init__", "__del__"):
+                continue
+            checker = _MethodChecker(
+                info, mname, self_guards, owner_guards, findings
+            )
+            # Visit the body, not the def node: visit_FunctionDef resets
+            # the held-lock stack for NESTED defs only.
+            for stmt in method.body:
+                checker.visit(stmt)
+    return findings

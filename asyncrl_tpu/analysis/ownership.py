@@ -1,0 +1,591 @@
+"""Thread-ownership audit (OWN0xx) + broad-except swallows (EXC0xx).
+
+Builds the framework's **thread-entry map** from ``# thread-entry:``
+annotations (actor loop, inference-server loop, trainer drain, watchdog,
+checkpoint writer — see ``python -m asyncrl_tpu.analysis --entries``),
+computes which functions each entry reaches, and flags mutable module or
+instance state touched from two or more OS-thread *groups* with no
+declared discipline — no ``# guarded-by:`` and no
+``# lint: thread-shared-ok(...)`` waiver. This is the static complement
+of ``ASYNCRL_DEBUG_SYNC``: the runtime checks catch a broken discipline
+on the interleavings a test happens to hit; this pass catches state that
+has *no* discipline at all, on every line.
+
+Reachability is a deliberately conservative name-based call graph:
+
+- ``self.m()`` resolves through the class and its analyzed bases;
+- ``ClassName(...)`` resolves to ``__init__``;
+- ``<recv>.m()`` resolves when the receiver's type is known (a
+  ``self.x = ClassName(...)`` binding or a local ``v = ClassName(...)``)
+  or when ``m`` is defined by exactly one analyzed class;
+- module-level calls resolve through imports.
+
+Closure- or queue-mediated dispatch (an actor invoking the inference
+server's client callable) is invisible to this graph — that is what a
+``# thread-entry:`` annotation on the receiving method is for.
+
+Touch accounting: writes in the *declaring* class's ``__init__`` never
+count (construction precedes publication; ``Thread.start`` is the
+happens-before edge). A write is an attribute store, an augmented
+assignment, a subscript store through the attribute, or a call to a known
+container mutator (``append``/``pop``/``update``/…) on it.
+
+EXC001 flags ``except:``/``except Exception``/``except BaseException``
+handlers in entry-reachable code: a broad handler on a worker thread
+swallows the very failures the supervisor exists to see. Supervisor-
+boundary handlers (error-sink delivery, best-effort teardown) carry a
+``# lint: broad-except-ok(<reason>)`` waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from asyncrl_tpu.analysis.core import (
+    ClassInfo,
+    Finding,
+    Project,
+    SourceModule,
+    _dotted,
+)
+
+# Method names builtin containers, strings, arrays, events, and queues
+# answer to: excluded from unique-name call resolution (see callees()).
+_BUILTIN_METHOD_NAMES = {
+    "append", "appendleft", "extend", "insert", "remove", "discard",
+    "clear", "pop", "popleft", "popitem", "update", "add", "setdefault",
+    "get", "put", "get_nowait", "put_nowait", "items", "keys", "values",
+    "copy", "count", "index", "sort", "reverse", "join", "start", "set",
+    "is_set", "wait", "notify", "notify_all", "acquire", "release",
+    "close", "open", "read", "write", "flush", "reset", "split", "strip",
+    "encode", "decode", "format", "mean", "sum", "min", "max", "item",
+    "astype", "reshape", "tolist", "any", "all",
+}
+
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "extend",
+    "insert",
+    "remove",
+    "discard",
+    "clear",
+    "pop",
+    "popleft",
+    "popitem",
+    "update",
+    "add",
+    "setdefault",
+    "put",
+    "put_nowait",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class _Node:
+    """One function in the call graph."""
+
+    module: SourceModule
+    cls: ClassInfo | None
+    name: str
+    fn: ast.AST
+
+    @property
+    def qualname(self) -> str:
+        prefix = f"{self.cls.name}." if self.cls else ""
+        return f"{self.module.name}:{prefix}{self.name}"
+
+
+class _Graph:
+    def __init__(self, project: Project):
+        self.project = project
+        self.nodes: dict[int, _Node] = {}
+        self.top_level: dict[SourceModule, dict[str, _Node]] = {}
+        self.methods: dict[tuple[str, str], _Node] = {}
+        for module in project.modules:
+            tl: dict[str, _Node] = {}
+            for stmt in module.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    node = _Node(module, None, stmt.name, stmt)
+                    tl[stmt.name] = node
+                    self.nodes[id(stmt)] = node
+            self.top_level[module] = tl
+        for info in project.class_list:
+            for mname, fn in info.methods.items():
+                node = _Node(info.module, info, mname, fn)
+                self.methods[(info.name, mname)] = node
+                self.nodes[id(fn)] = node
+
+    # ------------------------------------------------------------ resolve
+
+    def _method_on(self, class_name: str, mname: str) -> _Node | None:
+        """Method lookup through the class and its analyzed bases."""
+        seen: set[str] = set()
+        queue = [class_name]
+        while queue:
+            cname = queue.pop(0)
+            if cname in seen:
+                continue
+            seen.add(cname)
+            hit = self.methods.get((cname, mname))
+            if hit is not None:
+                return hit
+            for info in self.project.classes.get(cname, []):
+                queue.extend(b.rsplit(".", 1)[-1] for b in info.bases)
+        return None
+
+    def _local_types(self, fn: ast.AST, cls: ClassInfo | None) -> dict:
+        """var -> ClassName for ``v = ClassName(...)`` / ``v = self.x``
+        (typed attr) bindings inside ``fn``."""
+        types: dict[str, str] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call):
+                callee = _dotted(value.func)
+                if callee:
+                    tail = callee.rsplit(".", 1)[-1]
+                    if tail in self.project.classes:
+                        types[target.id] = tail
+            elif (
+                isinstance(value, ast.Attribute)
+                and isinstance(value.value, ast.Name)
+                and value.value.id == "self"
+                and cls is not None
+            ):
+                typed = cls.attr_types.get(value.attr)
+                if typed in self.project.classes:
+                    types[target.id] = typed
+        return types
+
+    def callees(self, node: _Node) -> list[_Node]:
+        out: list[_Node] = []
+        cls = node.cls
+        local_types = self._local_types(node.fn, cls)
+        for sub in ast.walk(node.fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if isinstance(func, ast.Name):
+                # Bare call: constructor, local function, or import.
+                hit = self._resolve_bare(node.module, func.id)
+                if hit is not None:
+                    out.append(hit)
+                continue
+            if not isinstance(func, ast.Attribute):
+                continue
+            mname = func.attr
+            recv = func.value
+            # super().m()
+            if (
+                isinstance(recv, ast.Call)
+                and isinstance(recv.func, ast.Name)
+                and recv.func.id == "super"
+                and cls is not None
+            ):
+                for base in cls.bases:
+                    hit = self._method_on(base.rsplit(".", 1)[-1], mname)
+                    if hit is not None:
+                        out.append(hit)
+                continue
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                if cls is not None:
+                    hit = self._method_on(cls.name, mname)
+                    if hit is not None:
+                        out.append(hit)
+                    # No fallback for self-calls: a miss means a CALLABLE
+                    # ATTRIBUTE (a jitted fn, a handle) — resolving it by
+                    # name against other classes' methods fabricates
+                    # cross-subsystem edges (JaxHostPool's jitted _init
+                    # is not SebulbaTrainer._init).
+                    continue
+            # Typed receiver: self.<typed attr>.m() or <typed var>.m().
+            type_name = None
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and cls is not None
+            ):
+                type_name = cls.attr_types.get(recv.attr)
+            elif isinstance(recv, ast.Name):
+                type_name = local_types.get(recv.id)
+            if type_name is not None and type_name in self.project.classes:
+                hit = self._method_on(type_name, mname)
+                if hit is not None:
+                    out.append(hit)
+                    continue
+            # Module-function call through an alias (faults.site(...)).
+            resolved = node.module.resolve(func)
+            if resolved is not None and "." in resolved:
+                mod_path, fname = resolved.rsplit(".", 1)
+                for module, tl in self.top_level.items():
+                    if fname in tl and mod_path.endswith(module.name):
+                        out.append(tl[fname])
+                        break
+                else:
+                    # Unique-name method resolution (last resort) — but
+                    # never for names every builtin container/primitive
+                    # also answers to: `history.append(...)` must not edge
+                    # into RolloutBuffer.append just because it is the
+                    # only analyzed class with an `append`.
+                    if mname in _BUILTIN_METHOD_NAMES:
+                        continue
+                    candidates = self.project.methods_by_name.get(mname, [])
+                    if len(candidates) == 1:
+                        hit = self.methods.get((candidates[0].name, mname))
+                        if hit is not None:
+                            out.append(hit)
+        return out
+
+    def _resolve_bare(self, module: SourceModule, name: str) -> _Node | None:
+        if name in self.project.classes:
+            infos = self.project.classes[name]
+            if len(infos) == 1:
+                return self._method_on(name, "__init__")
+        tl = self.top_level.get(module, {})
+        if name in tl:
+            return tl[name]
+        resolved = module.aliases.get(name)
+        if resolved and "." in resolved:
+            mod_path, fname = resolved.rsplit(".", 1)
+            if fname in self.project.classes:
+                return self._method_on(fname, "__init__")
+            for other, funcs in self.top_level.items():
+                if fname in funcs and mod_path.endswith(other.name):
+                    return funcs[fname]
+        return None
+
+
+def _entry_roots(project: Project, graph: _Graph):
+    """(entry, node) pairs from the thread-entry annotations."""
+    roots = []
+    for module in project.modules:
+        for entry in module.annotations.entries:
+            if entry.method is not None:
+                if entry.class_name is not None:
+                    node = graph.methods.get((entry.class_name, entry.method))
+                else:
+                    node = graph.top_level.get(module, {}).get(entry.method)
+                if node is not None:
+                    roots.append((entry, node))
+            elif entry.class_name is not None:
+                for (cname, mname), node in graph.methods.items():
+                    if cname == entry.class_name and mname != "__init__":
+                        roots.append((entry, node))
+    return roots
+
+
+def entry_map(project: Project) -> dict[str, list[str]]:
+    """entry-name@group -> reachable function qualnames (the audit's
+    thread-entry map, printed by ``--entries``)."""
+    graph = _Graph(project)
+    out: dict[str, list[str]] = {}
+    for entry, root in _entry_roots(project, graph):
+        reached = _reach(graph, [root])
+        key = f"{entry.name}@{entry.group}"
+        names = sorted(n.qualname for n in reached)
+        out.setdefault(key, [])
+        out[key] = sorted(set(out[key]) | set(names))
+    return out
+
+
+def _reach(graph: _Graph, roots: list[_Node]) -> set[_Node]:
+    seen: set[int] = set()
+    out: set[_Node] = set()
+    work = list(roots)
+    while work:
+        node = work.pop()
+        if id(node.fn) in seen:
+            continue
+        seen.add(id(node.fn))
+        out.add(node)
+        work.extend(graph.callees(node))
+    return out
+
+
+# ------------------------------------------------------------------ touches
+
+
+@dataclasses.dataclass
+class _Touch:
+    node: _Node
+    line: int
+    write: bool
+    group: str
+    entry: str
+
+
+def _subscript_write_targets(fn: ast.AST) -> set[int]:
+    """ids of Attribute nodes written through a subscript
+    (``self._pending[i] = x``, ``slab.row_gen[r] = g``)."""
+    out: set[int] = set()
+    for node in ast.walk(fn):
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            while isinstance(t, ast.Subscript):
+                t = t.value
+            if isinstance(t, ast.Attribute):
+                out.add(id(t))
+    return out
+
+
+def _attr_touches(node: _Node, group: str, entry: str, project: Project):
+    """Yield (ClassInfo, attr, _Touch) for every attribute touch in
+    ``node``'s body that can be attributed to an analyzed class."""
+    fn = node.fn
+    cls = node.cls
+    sub_writes = _subscript_write_targets(fn)
+    mutated: set[int] = set()
+    for sub in ast.walk(fn):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in _MUTATORS
+            and isinstance(sub.func.value, ast.Attribute)
+        ):
+            mutated.add(id(sub.func.value))
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Attribute):
+            continue
+        write = (
+            isinstance(sub.ctx, (ast.Store, ast.Del))
+            or id(sub) in sub_writes
+            or id(sub) in mutated
+        )
+        is_self = (
+            isinstance(sub.value, ast.Name) and sub.value.id == "self"
+        )
+        owners: list[ClassInfo] = []
+        if is_self and cls is not None:
+            owner = _declaring_class(project, cls, sub.attr)
+            if owner is not None:
+                owners = [owner]
+        elif not is_self:
+            candidates = project.attrs_by_name.get(sub.attr, [])
+            typed = _receiver_class(project, node, sub.value)
+            if typed is not None:
+                owners = [
+                    c for c in candidates if c.name == typed
+                ] or []
+            elif (
+                len(candidates) == 1
+                and sub.attr not in project.dataclass_fields
+            ):
+                owners = candidates
+        for owner in owners:
+            yield owner, sub.attr, _Touch(node, sub.lineno, write, group, entry)
+
+
+def _declaring_class(
+    project: Project, cls: ClassInfo, attr: str
+) -> ClassInfo | None:
+    seen: set[str] = set()
+    queue = [cls.name]
+    while queue:
+        cname = queue.pop(0)
+        if cname in seen:
+            continue
+        seen.add(cname)
+        for info in project.classes.get(cname, []):
+            if attr in info.attrs:
+                return info
+            queue.extend(b.rsplit(".", 1)[-1] for b in info.bases)
+    return None
+
+
+def _receiver_class(
+    project: Project, node: _Node, recv: ast.AST
+) -> str | None:
+    if (
+        isinstance(recv, ast.Attribute)
+        and isinstance(recv.value, ast.Name)
+        and recv.value.id == "self"
+        and node.cls is not None
+    ):
+        return node.cls.attr_types.get(recv.attr)
+    return None
+
+
+# ------------------------------------------------------------------- run
+
+
+def run(project: Project) -> list[Finding]:
+    graph = _Graph(project)
+    roots = _entry_roots(project, graph)
+    if not roots:
+        return []
+    findings: list[Finding] = []
+
+    # Function -> set of (entry, group) reaching it.
+    reach_of: dict[int, set[tuple[str, str]]] = {}
+    node_of: dict[int, _Node] = {}
+    for entry, root in roots:
+        for node in _reach(graph, [root]):
+            reach_of.setdefault(id(node.fn), set()).add(
+                (entry.name, entry.group)
+            )
+            node_of[id(node.fn)] = node
+
+    # ---- broad-except swallows in entry-reachable code.
+    for fid, node in node_of.items():
+        ann = node.module.annotations
+        for sub in ast.walk(node.fn):
+            if not isinstance(sub, ast.ExceptHandler):
+                continue
+            if not _is_broad(sub.type):
+                continue
+            if ann.waived(sub.lineno, "broad-except-ok"):
+                continue
+            findings.append(
+                Finding(
+                    "EXC001", node.module.path, sub.lineno,
+                    f"broad except in thread-reachable {node.qualname}: "
+                    "swallows the worker failures the supervisor exists "
+                    "to see (narrow it, or waive a supervisor boundary "
+                    "with '# lint: broad-except-ok(<reason>)')",
+                )
+            )
+
+    # ---- cross-thread state audit.
+    touches: dict[tuple[int, str], list[_Touch]] = {}
+    owner_of: dict[int, ClassInfo] = {}
+    for fid, node in node_of.items():
+        for (ename, group) in reach_of[fid]:
+            for owner, attr, touch in _attr_touches(
+                node, group, ename, project
+            ):
+                # Construction precedes publication: the declaring class's
+                # own __init__ touches never count.
+                if node.cls is owner and node.name == "__init__":
+                    continue
+                if owner.module.annotations.waived(
+                    touch.line, "thread-shared-ok"
+                ) or node.module.annotations.waived(
+                    touch.line, "thread-shared-ok"
+                ):
+                    continue
+                touches.setdefault((id(owner), attr), []).append(touch)
+                owner_of[id(owner)] = owner
+
+    for (oid, attr), tlist in sorted(
+        touches.items(), key=lambda kv: (owner_of[kv[0][0]].name, kv[0][1])
+    ):
+        owner = owner_of[oid]
+        groups = {t.group for t in tlist}
+        if len(groups) < 2:
+            continue
+        if not any(t.write for t in tlist):
+            continue
+        ann = owner.module.annotations
+        if ann.guard_for(owner.name, attr) is not None:
+            continue  # lock pass enforces the declared discipline
+        decl_line = owner.attrs.get(attr, 0)
+        if ann.waived(decl_line, "thread-shared-ok"):
+            continue
+        entries = sorted({f"{t.entry}@{t.group}" for t in tlist})
+        first_write = min(t.line for t in tlist if t.write)
+        findings.append(
+            Finding(
+                "OWN001", owner.module.path, decl_line or first_write,
+                f"{owner.name}.{attr} is touched from multiple thread "
+                f"entries ({', '.join(entries)}) with no declared "
+                "discipline: add '# guarded-by: <lock>' or "
+                "'# lint: thread-shared-ok(<reason>)' at its declaration",
+            )
+        )
+
+    # ---- module-global audit.
+    findings.extend(_global_audit(project, graph, reach_of, node_of))
+    return findings
+
+
+def _is_broad(type_node: ast.AST | None) -> bool:
+    if type_node is None:
+        return True
+    names = []
+    if isinstance(type_node, ast.Tuple):
+        names = [_dotted(e) for e in type_node.elts]
+    else:
+        names = [_dotted(type_node)]
+    return any(n in ("Exception", "BaseException") for n in names if n)
+
+
+def _global_audit(project, graph, reach_of, node_of) -> list[Finding]:
+    findings: list[Finding] = []
+    # module -> {global name -> declaration line} (top-level assigns).
+    decls: dict[SourceModule, dict[str, int]] = {}
+    for module in project.modules:
+        d: dict[str, int] = {}
+        for stmt in module.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    d.setdefault(t.id, stmt.lineno)
+        decls[module] = d
+
+    hits: dict[tuple[int, str], dict] = {}
+    for fid, node in node_of.items():
+        declared = decls.get(node.module, {})
+        if not declared:
+            continue
+        global_names: set[str] = set()
+        for sub in ast.walk(node.fn):
+            if isinstance(sub, ast.Global):
+                global_names.update(sub.names)
+        for sub in ast.walk(node.fn):
+            if not isinstance(sub, ast.Name) or sub.id not in declared:
+                continue
+            write = (
+                isinstance(sub.ctx, ast.Store) and sub.id in global_names
+            )
+            read = isinstance(sub.ctx, ast.Load)
+            if not (write or read):
+                continue
+            key = (id(node.module), sub.id)
+            rec = hits.setdefault(
+                key,
+                {
+                    "module": node.module,
+                    "groups": set(),
+                    "writes": False,
+                    "entries": set(),
+                    "line": declared[sub.id],
+                },
+            )
+            for ename, group in reach_of[fid]:
+                rec["groups"].add(group)
+                rec["entries"].add(f"{ename}@{group}")
+            rec["writes"] = rec["writes"] or write
+    for (_, name), rec in sorted(hits.items(), key=lambda kv: kv[0][1]):
+        module = rec["module"]
+        if len(rec["groups"]) < 2 or not rec["writes"]:
+            continue
+        ann = module.annotations
+        if ann.guard_for(None, name) is not None:
+            continue
+        if ann.waived(rec["line"], "thread-shared-ok"):
+            continue
+        findings.append(
+            Finding(
+                "OWN002", module.path, rec["line"],
+                f"module global {name!r} is touched from multiple thread "
+                f"entries ({', '.join(sorted(rec['entries']))}) with no "
+                "declared discipline: add '# guarded-by: <lock>' or "
+                "'# lint: thread-shared-ok(<reason>)' at its declaration",
+            )
+        )
+    return findings

@@ -1,0 +1,306 @@
+"""JAX purity pass (PURE0xx).
+
+Functions traced by JAX — reachable from ``jax.jit`` / ``pmap`` /
+``shard_map`` / ``vmap`` / ``grad`` / ``lax.scan``-family bodies — must be
+functionally pure: no host effects, no mutation of Python state that
+outlives the trace. A host effect inside a traced function runs once at
+trace time and then silently never again (the classic "my print/metric/
+RNG only happened on the first step" bug); mutated nonlocal state bakes
+trace-time values into the compiled program.
+
+Roots are found three ways:
+
+- decorators: ``@jax.jit``, ``@jit``, ``@functools.partial(jax.jit, ...)``,
+  ``@jax.pmap``, ``@shard_map`` …
+- wrapper calls: ``jax.jit(f)``, ``shard_map(body, ...)``,
+  ``jax.lax.scan(step, ...)``, ``vmap(f)`` … where the callable argument
+  is a local function name or a lambda;
+- transitively: calls from a traced function to another function defined
+  in the analyzed file set (resolved by name through imports).
+
+Flagged inside traced code:
+
+- PURE001 — host-effect calls: ``print``/``open``/``input``, ``time.*``,
+  ``np.random.*`` / stdlib ``random.*``, ``os.*``/``sys.*``,
+  ``queue.*``/``threading.*``, ``logging.*``, metric-sink writes, and the
+  fault-injection layer (``faults.*``). ``jax.debug.print`` and
+  ``jax.debug.callback`` are sanctioned (JAX-managed effects) and not
+  flagged.
+- PURE002 — mutation of nonlocal Python state: assignment through
+  ``global``/``nonlocal``, or attribute stores whose base is not a local
+  created inside the traced function (``self.x = ...``, captured-object
+  fields).
+
+``# lint: impure-ok(<reason>)`` waives one finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
+
+# Wrapper callables whose function-valued arguments are traced. Matched on
+# the LAST path segment after alias resolution, so ``jax.jit``, ``jit``,
+# and ``asyncrl_tpu.parallel.mesh.shard_map`` all match.
+TRACE_WRAPPERS = {
+    "jit",
+    "pmap",
+    "vmap",
+    "grad",
+    "value_and_grad",
+    "shard_map",
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "remat",
+    "associative_scan",
+    "custom_vjp",
+    "custom_jvp",
+}
+
+# Dotted-prefix deny list (after alias resolution).
+_EFFECT_PREFIXES = (
+    "time.",
+    "numpy.random",
+    "random.",
+    "os.",
+    "sys.",
+    "io.",
+    "queue.",
+    "threading.",
+    "subprocess.",
+    "logging.",
+    "builtins.print",
+    "builtins.open",
+    "asyncrl_tpu.utils.faults",
+    "asyncrl_tpu.utils.metrics",
+)
+
+_EFFECT_BARE = {"print", "open", "input", "breakpoint", "exec", "eval"}
+
+_SANCTIONED_PREFIXES = ("jax.debug.",)
+
+
+def _is_effect_call(module: SourceModule, node: ast.Call) -> str | None:
+    resolved = module.resolve(node.func)
+    if resolved is None:
+        return None
+    if resolved in _EFFECT_BARE:
+        return resolved
+    if any(resolved.startswith(p) for p in _SANCTIONED_PREFIXES):
+        return None
+    for prefix in _EFFECT_PREFIXES:
+        if resolved == prefix.rstrip(".") or resolved.startswith(prefix):
+            return resolved
+    return None
+
+
+class _FunctionIndex:
+    """Functions (top-level and nested) per module, keyed by name, plus a
+    global view keyed by ``<module-resolved dotted name>``."""
+
+    def __init__(self, project: Project):
+        self.per_module: dict[SourceModule, dict[str, ast.FunctionDef]] = {}
+        for module in project.modules:
+            funcs: dict[str, ast.FunctionDef] = {}
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # Last definition wins on name collision — good enough
+                    # for intra-module resolution of helper names.
+                    funcs[node.name] = node
+            self.per_module[module] = funcs
+
+    def resolve_callable(
+        self, module: SourceModule, node: ast.AST
+    ) -> tuple[SourceModule, ast.FunctionDef] | None:
+        """A Name/Attribute callable → its FunctionDef, same module first,
+        then by import (``from asyncrl_tpu.x import f``)."""
+        if isinstance(node, ast.Name):
+            fn = self.per_module[module].get(node.id)
+            if fn is not None:
+                return module, fn
+        resolved = module.resolve(node)
+        if resolved is None:
+            return None
+        name = resolved.rsplit(".", 1)[-1]
+        mod_path = resolved.rsplit(".", 1)[0] if "." in resolved else ""
+        for other, funcs in self.per_module.items():
+            if name in funcs and mod_path.endswith(other.name):
+                return other, funcs[name]
+        # An imported bare name (`from mod import f` makes resolve() yield
+        # "mod.f"): accept a same-module def as the fallback for Names
+        # only — attribute calls on unresolvable receivers (self.x.m())
+        # must not leak into the traced set by method-name accident.
+        if isinstance(node, ast.Name):
+            fn = self.per_module[module].get(name)
+            if fn is not None:
+                return module, fn
+        return None
+
+
+def _decorator_is_traced(module: SourceModule, dec: ast.AST) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    resolved = module.resolve(target)
+    if resolved and resolved.rsplit(".", 1)[-1] in TRACE_WRAPPERS:
+        return True
+    # functools.partial(jax.jit, ...) decorator form.
+    if isinstance(dec, ast.Call):
+        resolved = module.resolve(dec.func)
+        if resolved and resolved.rsplit(".", 1)[-1] == "partial" and dec.args:
+            inner = module.resolve(dec.args[0])
+            if inner and inner.rsplit(".", 1)[-1] in TRACE_WRAPPERS:
+                return True
+    return False
+
+
+def _collect_roots(
+    module: SourceModule, index: _FunctionIndex
+) -> list[tuple[SourceModule, ast.AST]]:
+    """(module, function-or-lambda) roots in ``module``."""
+    roots: list[tuple[SourceModule, ast.AST]] = []
+    # Enclosing-class map, for jax.jit(self._apply)-style method roots.
+    class_methods: dict[int, dict[str, ast.FunctionDef]] = {}
+    for cls in ast.walk(module.tree):
+        if isinstance(cls, ast.ClassDef):
+            methods = {
+                n.name: n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for sub in ast.walk(cls):
+                class_methods[id(sub)] = methods
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(
+                _decorator_is_traced(module, d) for d in node.decorator_list
+            ):
+                roots.append((module, node))
+        elif isinstance(node, ast.Call):
+            resolved = module.resolve(node.func)
+            if (
+                resolved is None
+                or resolved.rsplit(".", 1)[-1] not in TRACE_WRAPPERS
+            ):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    roots.append((module, arg))
+                elif (
+                    isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"
+                    and arg.attr in class_methods.get(id(node), {})
+                ):
+                    roots.append(
+                        (module, class_methods[id(node)][arg.attr])
+                    )
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    hit = index.resolve_callable(module, arg)
+                    if hit is not None:
+                        roots.append(hit)
+    return roots
+
+
+def _local_names(fn: ast.AST) -> set[str]:
+    """Parameter and locally-assigned names of a function/lambda body."""
+    names: set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            names.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+    return names
+
+
+def run(project: Project) -> list[Finding]:
+    index = _FunctionIndex(project)
+    findings: list[Finding] = []
+    # Reachable set, by object identity of the def/lambda node.
+    seen: set[int] = set()
+    work: list[tuple[SourceModule, ast.AST]] = []
+    for module in project.modules:
+        work.extend(_collect_roots(module, index))
+    while work:
+        module, fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        _check_traced(module, fn, findings)
+        # Transitive closure: follow calls (and bare function references,
+        # which cover callbacks) to functions in the analyzed set.
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                hit = index.resolve_callable(module, node.func)
+                if hit is not None and id(hit[1]) not in seen:
+                    work.append(hit)
+    return findings
+
+
+def _check_traced(
+    module: SourceModule, fn: ast.AST, findings: list[Finding]
+) -> None:
+    ann = module.annotations
+    name = getattr(fn, "name", "<lambda>")
+    locals_ = _local_names(fn)
+    declared_external: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            declared_external.update(node.names)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            effect = _is_effect_call(module, node)
+            if effect is not None and not ann.waived(
+                node.lineno, "impure-ok"
+            ):
+                findings.append(
+                    Finding(
+                        "PURE001", module.path, node.lineno,
+                        f"host-effect call {effect}() inside jit-traced "
+                        f"{name}: runs at trace time only, then never "
+                        "again",
+                    )
+                )
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            if node.id in declared_external and not ann.waived(
+                node.lineno, "impure-ok"
+            ):
+                findings.append(
+                    Finding(
+                        "PURE002", module.path, node.lineno,
+                        f"traced {name} mutates nonlocal/global "
+                        f"{node.id!r}: the write happens at trace time, "
+                        "not per step",
+                    )
+                )
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            base = node.value
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            if (
+                isinstance(base, ast.Name)
+                # `self` is a parameter, but the instance outlives the
+                # trace — a self.<attr> store is still state mutation.
+                and (base.id == "self" or base.id not in locals_)
+                and not ann.waived(node.lineno, "impure-ok")
+            ):
+                findings.append(
+                    Finding(
+                        "PURE002", module.path, node.lineno,
+                        f"traced {name} stores to captured object "
+                        f"attribute {base.id}.{node.attr}: Python-state "
+                        "mutation under trace",
+                    )
+                )

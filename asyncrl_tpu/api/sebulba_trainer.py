@@ -350,7 +350,7 @@ class SebulbaTrainer:
         )
         self._server.start()
 
-    def _supervise(self) -> None:
+    def _supervise(self) -> None:  # thread-entry: watchdog@learner
         """The reap loop: rebuild a dead/hung inference server, restart
         dead actors (SURVEY.md §5.3 — fresh env pool each time), retire and
         replace HUNG actors via the heartbeat watchdog, and re-raise only
@@ -586,7 +586,7 @@ class SebulbaTrainer:
 
     # ---------------------------------------------------------------- train
 
-    def train(
+    def train(  # thread-entry: learner-drain@learner
         self,
         total_env_steps: int | None = None,
         callback: Callable[[dict[str, Any]], None] | None = None,
@@ -941,6 +941,7 @@ class SebulbaTrainer:
             if return_episodes:
                 return final_return.astype(np.float32)
             return float(final_return.mean())
+        # lint: broad-except-ok(not a swallow: evicts the broken eval pool from the cache, then re-raises the original failure)
         except BaseException:
             # A broken pool must not be reused; drop it from the cache.
             self._eval_pools.pop(pool_key, None)
@@ -966,5 +967,6 @@ def _close(pool) -> None:
     if close is not None:
         try:
             close()
+        # lint: broad-except-ok(best-effort pool teardown at a supervisor boundary; a failing close must not mask the path that led here)
         except Exception:
             pass

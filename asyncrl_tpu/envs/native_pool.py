@@ -147,7 +147,7 @@ class NativeEnvPool:
         self._fault_step = faults.site("pool.step")
         self.fault_stop = None
 
-    def reset(self) -> np.ndarray:
+    def reset(self) -> np.ndarray:  # thread-entry: env-pool@actor
         """Re-seed (to the construction seed) and reset every env:
         ``reset()`` is deterministic no matter how far a reused pool's RNGs
         have advanced — evaluation pools cached across calls depend on
@@ -156,7 +156,7 @@ class NativeEnvPool:
         self._lib.envpool_reset(self._handle, self._obs.ctypes.data)
         return self._obs.copy()
 
-    def step(
+    def step(  # thread-entry: env-pool@actor
         self, actions: np.ndarray
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Step all envs; returns fresh arrays safe to retain across calls

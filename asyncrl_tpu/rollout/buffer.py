@@ -177,6 +177,13 @@ class RolloutBuffer:
                 f"fragment incomplete: {self._t}/{self.unroll_len} steps"
             )
         if self._bootstrap is not None:
+            # Slab mode: emit WRITES the row (bootstrap_obs), so it must
+            # re-validate the lease like every append — a zombie actor
+            # voided mid-emit would otherwise scribble a full [B, obs]
+            # array over the replacement's committed row (static-analysis
+            # era review finding; append/write_init_core already guard).
+            if self._guard is not None:
+                self._guard()
             np.copyto(self._bootstrap, np.asarray(bootstrap_obs))
             rollout = Rollout(
                 obs=self.obs,

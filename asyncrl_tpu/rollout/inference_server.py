@@ -131,8 +131,13 @@ class InferenceServer(threading.Thread):
         self._device = device
         self._key = jax.random.PRNGKey(seed ^ 0x5E21EA)
         self._cond = threading.Condition()
-        self._pending: list[Any] = [None] * num_clients
+        self._pending: list[Any] = [None] * num_clients  # guarded-by: _cond
+        # Result/error slots are event-handshake-owned, not lock-guarded:
+        # the server owns slot i from collect to event.set(), the client
+        # owns it from its wait() returning to the consuming swap.
+        # lint: thread-shared-ok(event handshake: Event.set/wait is the ownership hand-off; §5.2b debug mode asserts the discipline)
         self._results: list[Any] = [None] * num_clients
+        # lint: thread-shared-ok(event handshake, same protocol as _results)
         self._errors: list[BaseException | None] = [None] * num_clients
         self._events = [threading.Event() for _ in range(num_clients)]
         from asyncrl_tpu.utils.debug import sync_debug_enabled
@@ -145,9 +150,11 @@ class InferenceServer(threading.Thread):
         # clients re-raise the REAL cause from _submit instead of a bland
         # ServerClosed, and the trainer's supervisor reads it to decide
         # abort (InvariantViolation) vs rebuild (anything else).
+        # lint: thread-shared-ok(single-writer latch: only the dying server thread writes; readers re-read after is_alive() turns false)
         self._fatal: BaseException | None = None
         # Progress stamp for the trainer's heartbeat watchdog (refreshed
         # every collect/serve loop iteration).
+        # lint: thread-shared-ok(GIL-atomic float stamp; the watchdog reads staleness only)
         self.heartbeat = time.monotonic()
         self._fault_serve = faults.site("server.serve")
         # Preallocated host batch slabs, one per flattened request-leaf
@@ -156,8 +163,8 @@ class InferenceServer(threading.Thread):
         # Coalescing counters for the infer_coalesce_batch metric: total
         # served rounds and total request rows (plain ints under the GIL;
         # the trainer only reads them).
-        self.coalesce_rounds = 0
-        self.coalesce_rows = 0
+        self.coalesce_rounds = 0  # lint: thread-shared-ok(GIL-atomic int; single-writer, metrics-only reader)
+        self.coalesce_rows = 0  # lint: thread-shared-ok(GIL-atomic int; single-writer, metrics-only reader)
 
     # ------------------------------------------------------------- client
 
@@ -181,7 +188,7 @@ class InferenceServer(threading.Thread):
 
         return call
 
-    def _submit(self, index: int, args):
+    def _submit(self, index: int, args):  # thread-entry: infer-client@actor
         event = self._events[index]
         event.clear()
         with self._cond:
@@ -211,14 +218,15 @@ class InferenceServer(threading.Thread):
 
     # ------------------------------------------------------------- server
 
-    def run(self) -> None:  # noqa: D102 — thread entry
+    def run(self) -> None:  # thread-entry: infer-server@server
         try:
             if self._device is not None:
                 with jax.default_device(self._device):
                     self._run()
             else:
                 self._run()
-        except BaseException as e:  # noqa: BLE001 — see below
+        # lint: broad-except-ok(thread boundary: the cause is latched in _fatal and re-raised into every client; see below)
+        except BaseException as e:
             # Fatal: remember why the server died so every subsequent
             # client call re-raises the REAL cause (not a bland
             # ServerClosed) — an InvariantViolation aborts the run, any
@@ -366,7 +374,8 @@ class InferenceServer(threading.Thread):
                         actions[a:b], logp[a:b], _slice(core, a, b)
                     )
                 self._events[i].set()
-        except BaseException as e:  # deliver, keep serving
+        # lint: broad-except-ok(per-request boundary: the failure is delivered to every waiting client, then the server keeps serving)
+        except BaseException as e:
             for i in indices:
                 self._errors[i] = e
                 self._events[i].set()

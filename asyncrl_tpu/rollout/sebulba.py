@@ -51,20 +51,20 @@ class ParamStore:
         self, params: Any, env_steps: int = 0, debug: bool | None = None
     ):
         self._lock = threading.Lock()
-        self._params = params
-        self._version = 0
+        self._params = params  # guarded-by: _lock
+        self._version = 0  # guarded-by: _lock
         # Authoritative global frame counter, published by the trainer loop
         # alongside params. Epsilon/anneal schedules read THIS rather than
         # extrapolating from a single thread's frame count (which drifts
         # when threads progress unevenly or after an actor restart).
-        self._env_steps = int(env_steps)
+        self._env_steps = int(env_steps)  # guarded-by: _lock
         # §5.2b debug mode: seqlock-style write stamp around every mutation
         # (odd = publish in flight). With the lock held this is invisible;
         # if the lock discipline is ever broken, a concurrent get() observes
         # an odd or changed stamp and raises instead of serving a torn
         # params/version pair. Kept unconditionally cheap (two int adds);
         # the read-side verification only arms under ASYNCRL_DEBUG_SYNC=1.
-        self._seq = 0
+        self._seq = 0  # guarded-by: _lock
         if debug is None:
             from asyncrl_tpu.utils.debug import sync_debug_enabled
 
@@ -84,7 +84,7 @@ class ParamStore:
             self._seq += 1
             return self._version
 
-    def _torn(self, s1: int, s2: int) -> bool:
+    def _torn(self, s1: int, s2: int) -> bool:  # holds: _lock
         return s1 != s2 or s1 % 2 == 1
 
     def get(self) -> tuple[Any, int]:
@@ -129,6 +129,7 @@ class Fragment:
     def __init__(self, rollout: Rollout, return_sum: float, length_sum: float,
                  count: float, version: int, actor: int = 0, gen: int = 0,
                  seq: int = 0, lease=None):
+        # lint: thread-shared-ok(queue hand-off: Queue.put/get is the happens-before edge; the producer only rebinds rollout before the put)
         self.rollout = rollout
         self.return_sum = return_sum
         self.length_sum = length_sum
@@ -205,7 +206,7 @@ class JaxHostPool:
         self._fault_step = faults.site("pool.step")
         self.fault_stop = None
 
-    def reset(self) -> np.ndarray:
+    def reset(self) -> np.ndarray:  # thread-entry: env-pool@actor
         """Deterministic: restart the key stream from the construction
         seed, so a pool reused across evaluations replays the same initial
         states (matching the gymnasium adapter's reset(seed=...))."""
@@ -216,7 +217,7 @@ class JaxHostPool:
             self._state, obs = self._init(keys)
         return np.asarray(obs)
 
-    def step(self, actions: np.ndarray):
+    def step(self, actions: np.ndarray):  # thread-entry: env-pool@actor
         with jax.default_device(self._cpu):
             self._key, sub = jax.random.split(self._key)
             self._state, ts = self._step(self._state, jnp.asarray(actions), sub)
@@ -292,6 +293,7 @@ def make_host_pool(config, num_envs: int, seed: int):
         if env_id in native_pool.NATIVE_ENV_IDS:
             try:
                 return native_pool.NativeEnvPool(env_id, num_envs, seed=seed)
+            # lint: broad-except-ok(auto mode falls through to the next pool backend; an explicit native choice re-raises)
             except Exception:
                 if kind == "native":
                     raise
@@ -491,17 +493,19 @@ class ActorThread(threading.Thread):
         # Progress stamp for the trainer's heartbeat watchdog: refreshed
         # every iteration of the production loop (including the bounded-
         # queue retry loop — a backpressured actor is alive, not hung).
+        # lint: thread-shared-ok(GIL-atomic float stamp; the watchdog reads staleness only and refreshes after server outages)
         self.heartbeat = time.monotonic()
         # queue.Full retries observed on the fragment handoff (exported via
         # the metrics window as ``queue_backpressure``): how often actors
         # out-ran the learner+queue. Plain int under the GIL; the trainer
         # only ever reads it.
-        self.backpressure = 0
+        self.backpressure = 0  # lint: thread-shared-ok(GIL-atomic int; single-writer, metrics-only reader)
         # Zero-copy staging ring (rollout/staging.py); None = legacy
         # copy-on-emit path. The actor leases one slab row per fragment
         # and writes transitions straight into it; ``_open_lease`` is the
         # not-yet-queued lease the supervisor voids if this thread dies.
         self.staging = staging
+        # lint: thread-shared-ok(supervisor reads it only after this thread is dead or abandoned; StagingRing.void re-checks generations under its lock)
         self._open_lease = None
         # Chaos layer handles (None when unarmed — hot loop pays one
         # identity check per iteration; utils/faults.py).
@@ -511,20 +515,22 @@ class ActorThread(threading.Thread):
         # stopped/abandoned (a chaos stall has to stay abandonable, like
         # the wedged engine it models); harmless no-op on pools without an
         # armed site.
+        # lint: thread-shared-ok(written before Thread.start: publication happens-before the run loop)
         self.pool.fault_stop = self._stopped
 
     def _stopped(self) -> bool:
         """Cohort shutdown OR individual watchdog retirement."""
         return self.stop_event.is_set() or self.abandon.is_set()
 
-    def run(self) -> None:  # noqa: D102 — thread entry
+    def run(self) -> None:  # thread-entry: actor
         try:
             if self.device is not None:
                 with jax.default_device(self.device):
                     self._run()
             else:
                 self._run()
-        except BaseException as e:  # report, don't die silently (§5.3)
+        # lint: broad-except-ok(thread boundary: the failure is delivered to the supervisor's error sink, never swallowed — §5.3)
+        except BaseException as e:
             # ...unless the run is shutting down (or the watchdog already
             # retired this thread): an inference call (or server client)
             # interrupted by stop()/abandonment is not a failure. The
@@ -538,6 +544,7 @@ class ActorThread(threading.Thread):
             if close is not None:
                 try:
                     close()
+                # lint: broad-except-ok(best-effort teardown on a dying thread; the primary failure is already reported above)
                 except Exception:
                     pass
 

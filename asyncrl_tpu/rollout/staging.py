@@ -51,6 +51,18 @@ import numpy as np
 from asyncrl_tpu.rollout.buffer import Rollout, RolloutBuffer
 
 
+def _handle_ready(handle) -> bool:
+    """Has the readiness handle's device work executed? A deleted
+    (donated/consumed) or handle-less array can only mean the update
+    already ran: ready. ONE home for this rule — the reclamation paths in
+    ``retire`` and ``_await_release`` must never diverge on which
+    exceptions mean "deleted"."""
+    try:
+        return bool(handle.is_ready())
+    except (RuntimeError, ValueError, AttributeError):
+        return True
+
+
 class StaleLeaseError(RuntimeError):
     """A voided/superseded lease was used to write or commit: the owning
     actor was retired by the supervisor and its slab row re-leased. The
@@ -96,24 +108,28 @@ def fragment_template(config, spec, model, num_envs: int) -> Rollout:
 class _Slab:
     """One preallocated ``[K, T, B, ...]`` numpy pytree + its row ledger."""
 
-    __slots__ = ("arrays", "row_gen", "committed", "state")
+    __slots__ = ("arrays", "row_gen", "committed", "phase")
 
     def __init__(self, template: Rollout, rows: int):
         self.arrays = jax.tree.map(
             lambda sds: np.empty((rows, *sds.shape), np.dtype(sds.dtype)),
             template,
         )
-        self.row_gen = [-1] * rows
-        self.committed = [False] * rows
-        self.state = "free"  # "free" | "filling" | "inflight"
+        self.row_gen = [-1] * rows  # guarded-by: StagingRing._cond
+        self.committed = [False] * rows  # guarded-by: StagingRing._cond
+        # "free" | "filling" | "inflight"
+        self.phase = "free"  # guarded-by: StagingRing._cond
 
     def row(self, k: int) -> Rollout:
         """Row ``k`` as a pytree of VIEWS (numpy basic slicing)."""
         return jax.tree.map(lambda a: a[k], self.arrays)
 
 
-class SlabLease:
-    """One actor's write permit for one slab row, generation-stamped."""
+class SlabLease:  # thread-entry: slab-lease@actor
+    """One actor's write permit for one slab row, generation-stamped.
+    Methods run on the owning actor thread (closure-dispatched through
+    the buffer guard, hence the explicit thread-entry declaration);
+    ``StagingRing.void`` is the supervisor's cross-thread path."""
 
     __slots__ = ("ring", "slab", "row", "gen", "_buffer")
 
@@ -128,6 +144,7 @@ class SlabLease:
         """Still the row's current lease? Lock-free read (a list-element
         load is atomic under the GIL; staleness here only delays, never
         corrupts — the locked commit is the authoritative check)."""
+        # lint: unguarded-ok(GIL-atomic list-element load; the locked commit is the authoritative check)
         return self.ring._slabs[self.slab].row_gen[self.row] == self.gen
 
     def _check(self) -> None:
@@ -190,13 +207,13 @@ class StagingRing:
         # Rows open for leasing: the current fill slab's rows in order,
         # plus voided rows of older incomplete slabs (prepended, so old
         # slabs complete before new ones open — the anti-starvation rule).
-        self._avail: "deque[tuple[int, int]]" = deque()
+        self._avail: "deque[tuple[int, int]]" = deque()  # guarded-by: _cond
         # Retired slabs awaiting device readiness: (slab_index, handle).
-        self._inflight: "deque[tuple[int, Any]]" = deque()
-        self._gen = 0
+        self._inflight: "deque[tuple[int, Any]]" = deque()  # guarded-by: _cond
+        self._gen = 0  # guarded-by: _cond
         # Times an acquire had to wait on an in-flight slab's readiness
         # (the ring was too shallow for the moment's pipeline depth).
-        self.reuse_waits = 0
+        self.reuse_waits = 0  # guarded-by: _cond
         self.slab_nbytes = int(
             sum(leaf.nbytes for leaf in jax.tree.leaves(self._slabs[0].arrays))
         )
@@ -229,8 +246,8 @@ class StagingRing:
                     return None
                 if not self._avail:
                     for i, slab in enumerate(self._slabs):
-                        if slab.state == "free":
-                            slab.state = "filling"
+                        if slab.phase == "free":
+                            slab.phase = "filling"
                             self._avail.extend(
                                 (i, r) for r in range(self._K)
                             )
@@ -261,13 +278,7 @@ class StagingRing:
         responsive even under a slow device."""
         s, handle = head
         while True:
-            try:
-                ready = bool(handle.is_ready())
-            except Exception:
-                # A deleted (donated/consumed) or handle-less array can
-                # only mean the update already ran: ready.
-                ready = True
-            if ready:
+            if _handle_ready(handle):
                 break
             if stop is not None and stop():
                 return
@@ -289,7 +300,7 @@ class StagingRing:
                 return
             slab.row_gen[lease.row] = -1
             slab.committed[lease.row] = False
-            if slab.state == "filling":
+            if slab.phase == "filling":
                 self._avail.appendleft((lease.slab, lease.row))
             self._cond.notify_all()
 
@@ -308,12 +319,18 @@ class StagingRing:
     def batch(self, slab_id: int) -> Rollout:
         """The consumable batch for a fully-committed slab: the raw
         ``[K, T, B, ...]`` pytree (K > 1), or row 0's plain ``[T, B, ...]``
-        views (K == 1 — the unfused learner layout). Zero copies."""
+        views (K == 1 — the unfused learner layout). Zero copies.
+
+        The committed-ledger check runs under the ring lock (a static-
+        analysis finding: the queue hand-off makes the drain's view of the
+        K commits it consumed consistent, but a CONCURRENT void/commit on
+        another row of the same slab could tear the unguarded list read)."""
         slab = self._slabs[slab_id]
-        if not all(slab.committed):
+        with self._cond:
+            uncommitted = [i for i, c in enumerate(slab.committed) if not c]
+        if uncommitted:
             raise RuntimeError(
-                f"slab {slab_id} batched with uncommitted rows "
-                f"{[i for i, c in enumerate(slab.committed) if not c]}"
+                f"slab {slab_id} batched with uncommitted rows {uncommitted}"
             )
         if self._K == 1:
             return slab.row(0)
@@ -326,23 +343,20 @@ class StagingRing:
         update has executed, so no device-side reader — including a
         zero-copy CPU alias — can still see the slab's memory."""
         with self._cond:
-            self._slabs[slab_id].state = "inflight"
+            self._slabs[slab_id].phase = "inflight"
             self._inflight.append((slab_id, ready))
             # Opportunistic reclamation: anything already ready frees now,
             # so steady state never routes through the blocking path.
             while self._inflight:
                 s, handle = self._inflight[0]
-                try:
-                    if not handle.is_ready():
-                        break
-                except Exception:
-                    pass
+                if not _handle_ready(handle):
+                    break
                 self._inflight.popleft()
                 self._release_locked(s)
 
-    def _release_locked(self, slab_id: int) -> None:
+    def _release_locked(self, slab_id: int) -> None:  # holds: _cond
         slab = self._slabs[slab_id]
-        slab.state = "free"
+        slab.phase = "free"
         slab.row_gen = [-1] * self._K
         slab.committed = [False] * self._K
         self._cond.notify_all()

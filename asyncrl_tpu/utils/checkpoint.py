@@ -82,7 +82,9 @@ class Checkpointer:
 
     # ------------------------------------------------------------------ save
 
-    def save(self, step: int, state: Any, env_steps: int = 0) -> None:
+    def save(  # thread-entry: checkpoint-writer@learner
+        self, step: int, state: Any, env_steps: int = 0
+    ) -> None:
         """Async-save ``state`` + metadata under ``step``.
 
         Idempotent within a run: re-saving the step this Checkpointer just
@@ -127,6 +129,7 @@ class Checkpointer:
                     fault.fire()
                 self._do_save(step, state, env_steps)
                 return
+            # lint: broad-except-ok(supervisor boundary: bounded-backoff retry over transient filesystem failures; exhausted retries re-raise)
             except Exception as e:
                 if attempt == self.SAVE_RETRIES - 1:
                     raise
@@ -225,6 +228,7 @@ class Checkpointer:
         for i, candidate in enumerate(steps):
             try:
                 return self._restore_step(state_like, candidate)
+            # lint: broad-except-ok(supervisor boundary: latest-step restore falls back through older retained steps; the last failure re-raises)
             except Exception as e:
                 if i == len(steps) - 1:
                     raise
@@ -454,6 +458,7 @@ class TrainerCheckpointing:
                 # The crash contract covers the best slot too: an in-flight
                 # async best save must be durable before the process dies.
                 self._best.wait()
+        # lint: broad-except-ok(crash-path boundary: the original propagating exception must survive a failing final save)
         except Exception:
             if not in_flight:
                 raise

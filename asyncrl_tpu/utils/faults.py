@@ -110,20 +110,24 @@ class FaultSite:
         self.stall_s = stall_s
         # zlib.crc32, not hash(): str hashing is salted per process and
         # would silently break cross-run determinism.
-        self._rng = random.Random(seed ^ zlib.crc32(name.encode()))
+        self._rng = random.Random(seed ^ zlib.crc32(name.encode()))  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.fires = 0
-        self.calls = 0
+        self.fires = 0  # guarded-by: _lock
+        self.calls = 0  # guarded-by: _lock
 
-    def _should_fire(self) -> bool:
+    def _should_fire(self) -> int:
+        """0 = don't fire; otherwise the 1-based fire ordinal. Returning
+        the ordinal (instead of a bool) keeps every ``fires`` read under
+        the lock — ``fire`` must not re-read the counter lock-free just to
+        format its message (a static-analysis finding)."""
         with self._lock:
             self.calls += 1
             if self.max_fires is not None and self.fires >= self.max_fires:
-                return False
+                return 0
             if self._rng.random() >= self.prob:
-                return False
+                return 0
             self.fires += 1
-            return True
+            return self.fires
 
     def fire(
         self,
@@ -141,12 +145,14 @@ class FaultSite:
           float arrays, bit-flip for ints/bools); payload-less sites
           degrade corrupt to a no-op (nothing to damage).
         """
-        if not self._should_fire():
+        ordinal = self._should_fire()
+        if not ordinal:
             return payload
         if self.kind == "crash":
             raise InjectedFault(
-                f"injected crash at fault site {self.name!r} "
-                f"(fire {self.fires}/{self.max_fires or 'inf'})"
+                f"injected crash at fault site {self.name!r} in thread "
+                f"{threading.current_thread().name!r} "
+                f"(fire {ordinal}/{self.max_fires or 'inf'})"
             )
         if self.kind == "stall":
             deadline = time.monotonic() + self.stall_s

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Lint gate for asyncrl-tpu: ruff (curated rule set in pyproject.toml)
+# plus the framework-aware static passes (python -m asyncrl_tpu.analysis:
+# lock discipline, JAX purity, donation safety, thread ownership).
+#
+#   scripts/lint.sh            # lint the package (CI gate)
+#   scripts/lint.sh path.py    # lint specific files (fixtures exit nonzero)
+#
+# Exits nonzero on ANY finding from either tool, so it can gate PRs.
+# ruff is optional at runtime (not vendored in the training image); the
+# analysis passes always run and always gate.
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+if command -v ruff >/dev/null 2>&1; then
+    ruff check asyncrl_tpu tests scripts bench.py || rc=1
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check asyncrl_tpu tests scripts bench.py || rc=1
+else
+    echo "lint.sh: ruff not installed; skipping ruff (analysis passes still gate)" >&2
+fi
+
+python -m asyncrl_tpu.analysis "$@" || rc=1
+exit $rc

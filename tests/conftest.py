@@ -117,7 +117,14 @@ QUICK: dict[str, object] = {
         "test_native_pool_close_is_idempotent",
         "test_native_pool_close_safe_after_failed_init",
         "test_recovery_counters_flow_through_sinks",
+        "test_threads_are_named_and_fault_messages_identify_threads",  # 2s
     },
+    # Static checker (asyncrl_tpu/analysis/): pure-AST, no training; the
+    # whole file (package-lints-clean + fixture corpus + lock-deletion
+    # detection + annotation-grammar hardness) measures ~7s, CLI
+    # subprocess test included. Tier-1 by the ISSUE 3 acceptance
+    # contract: the package must lint clean on every PR.
+    "test_analysis.py": "all",  # 7s
     # Zero-copy staging pipeline (rollout/staging.py): ring/lease units
     # are sub-second; the bit-identity A/B is ~25s (two tiny trainings).
     # The two training smokes (chaos crash recovery, recurrent slabs)

@@ -1,0 +1,30 @@
+"""Known-bad fixture: malformed annotations and unknown waiver tags —
+hard ANN errors, never silent no-ops."""
+
+import threading
+
+
+class SloppyStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # ANN001: malformed lockspec (empty).
+        self._a = 0  # guarded-by:
+        # ANN003: lock name that is not an attribute of this class.
+        self._b = 0  # guarded-by: _mutex
+        self._c = 0
+
+    # ANN002: annotation not bound to an attribute assignment.
+    def compute(self):  # guarded-by: _lock
+        return self._c
+
+    # ANN005: unknown waiver tag.
+    def risky(self):
+        return self._c  # lint: race-is-fine(trust me)
+
+    # ANN004: waiver with no reason.
+    def sloppy(self):
+        return self._c  # lint: unguarded-ok()
+
+    # ANN006: malformed holds (dotted lock).
+    def helper(self):  # holds: Other._lock
+        return self._c

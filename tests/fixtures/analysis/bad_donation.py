@@ -1,0 +1,42 @@
+"""Known-bad fixture: donated-buffer and slab-lease misuse the DON pass
+must flag."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(state, rollout):
+    return state + rollout.sum(), rollout.mean()
+
+
+class BadLearner:
+    def __init__(self, donate):
+        self._step = jax.jit(
+            _step, donate_argnums=(1,) if donate else ()
+        )
+
+    def update(self, state, rollout):
+        return self._step(state, rollout)
+
+
+class BadTrainer:
+    def __init__(self):
+        self.learner = BadLearner(True)
+        self.stash = None
+
+    def train_step(self, state, rollout):
+        state, loss = self.learner.update(state, rollout)
+        scale = rollout.mean()  # BAD: rollout was donated by update()
+        return state, loss * scale
+
+    def train_loop(self, ring, state):
+        while True:
+            batch = ring.batch(0)
+            state, _ = self.learner.update(state, batch)
+            ring.retire(0, state)
+            checksum = batch.sum()  # BAD: slab read after retire
+            del checksum
+
+    def leak_row(self, ring):
+        view = ring.batch(0)
+        self.stash = view  # BAD: slab view escapes the lease scope

@@ -1,0 +1,50 @@
+"""Known-bad fixture: lock-discipline violations the LOCK pass must flag.
+
+Mirrors the ParamStore/StagingRing shapes: guarded attributes touched
+outside their declared lock.
+"""
+
+import threading
+
+
+class BadStore:
+    def __init__(self, params):
+        self._lock = threading.Lock()
+        self._params = params  # guarded-by: _lock
+        self._version = 0  # guarded-by: _lock
+
+    def publish(self, params):
+        with self._lock:
+            self._params = params
+            self._version += 1
+        return self._version  # BAD: read after the lock released
+
+    def peek(self):
+        return self._params  # BAD: unguarded read
+
+    def _bump_locked(self):  # holds: _lock
+        self._version += 1  # OK: caller holds the lock by contract
+
+    def sanctioned_racy_read(self):
+        # OK: deliberate lock-free read, waived with a reason.
+        return self._version  # lint: unguarded-ok(progress hint only; authoritative read is publish)
+
+
+class BadLedger:
+    """Cross-object guard: _Row state coordinated by BadLedger's lock."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.rows = [_Row() for _ in range(4)]
+
+    def retire(self, k):
+        self.rows[k].phase_tag = "retired"  # BAD: Owner must hold _cond
+
+    def retire_locked(self, k):
+        with self._cond:
+            self.rows[k].phase_tag = "retired"  # OK
+
+
+class _Row:
+    def __init__(self):
+        self.phase_tag = "free"  # guarded-by: BadLedger._cond

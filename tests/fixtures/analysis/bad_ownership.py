@@ -1,0 +1,35 @@
+"""Known-bad fixture: cross-thread shared state with no declared
+discipline, and a broad except swallowing worker failures — the OWN/EXC
+checks must flag both."""
+
+import threading
+
+_completed = 0
+
+
+class BadWorker(threading.Thread):
+    def __init__(self, out):
+        super().__init__(name="bad-worker")
+        self.out = out
+        self.progress = 0
+
+    def run(self):  # thread-entry: worker
+        global _completed
+        while True:
+            try:
+                self.progress += 1  # BAD: also read by the driver
+                _completed += 1  # BAD: module global, two entries
+                self.out.append(self.progress)
+            except Exception:  # BAD: swallows the failure silently
+                continue
+
+
+class BadDriver:
+    def __init__(self):
+        self.results = []
+        self.worker = BadWorker(self.results)
+
+    def poll(self):  # thread-entry: driver
+        global _completed
+        _completed += 1
+        return self.worker.progress

@@ -1,0 +1,50 @@
+"""Known-bad fixture: host effects / state mutation inside jit-traced
+code the PURE pass must flag."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_calls = 0
+
+
+@jax.jit
+def noisy_update(params, grads):
+    print("updating")  # BAD: trace-time-only host effect
+    return jax.tree.map(lambda p, g: p - 0.01 * g, params, grads)
+
+
+def _helper(x):
+    time.sleep(0.001)  # BAD: reachable from the jitted root below
+    return x * np.random.rand()  # BAD: host RNG under trace
+
+
+def scan_body(carry, x):
+    global _calls
+    _calls += 1  # BAD: mutates module state at trace time only
+    return carry + _helper(x), None
+
+
+def rollout(xs):
+    total, _ = jax.lax.scan(scan_body, jnp.zeros(()), xs)
+    return total
+
+
+class Recorder:
+    def __init__(self):
+        self.last = None
+        self._fn = jax.jit(self._apply)
+
+    def _apply(self, x):
+        self.last = x  # BAD: stores to captured object attribute
+        return x * 2
+
+    def sanctioned(self, x):
+        @jax.jit
+        def inner(y):
+            jax.debug.print("y={}", y)  # OK: JAX-managed effect
+            return y + 1
+
+        return inner(x)

@@ -1,0 +1,482 @@
+"""The framework-aware static checker (asyncrl_tpu/analysis/).
+
+Tier-1 contract, mirroring tests/test_race_debug.py's runtime contract:
+
+- the real package lints CLEAN (every declared discipline holds on every
+  line), and the known-bad fixture corpus does NOT — each pass is proven
+  against code it must flag;
+- the passes detect what they guard: deleting a ``with self._cond:`` from
+  rollout/staging.py (in memory — the file itself is untouched) makes the
+  lock-discipline pass fail, exactly as deleting the lock at runtime
+  makes test_race_debug.py fail under ASYNCRL_DEBUG_SYNC;
+- malformed annotations and unknown waiver tags are hard errors, never
+  silent no-ops.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import asyncrl_tpu
+from asyncrl_tpu import analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.dirname(os.path.abspath(asyncrl_tpu.__file__))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+# ----------------------------------------------------------- the package
+
+
+def test_package_lints_clean():
+    """Every guarded-by/holds/thread-entry/waiver annotation in the real
+    package holds; any new finding means either a real concurrency bug or
+    an undeclared discipline — both belong in the diff that caused them."""
+    findings = analysis.check_paths([PACKAGE])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_entry_map_names_the_five_thread_entries():
+    """The ownership audit's thread-entry map covers the actor loop, the
+    inference-server loop, the trainer drain, the watchdog, and the
+    checkpoint writer (ISSUE: the roles that share mutable state)."""
+    from asyncrl_tpu.analysis import ownership
+
+    entries = ownership.entry_map(analysis.load_paths([PACKAGE]))
+    assert {
+        "actor@actor",
+        "infer-server@server",
+        "learner-drain@learner",
+        "watchdog@learner",
+        "checkpoint-writer@learner",
+    } <= set(entries)
+    # The map is real: the actor entry reaches the production loop.
+    assert any(
+        name.endswith("ActorThread._run") for name in entries["actor@actor"]
+    )
+
+
+# ------------------------------------------------------- fixture corpus
+
+
+@pytest.mark.parametrize(
+    "fixture, expected",
+    [
+        ("bad_lock.py", {"LOCK001"}),
+        ("bad_purity.py", {"PURE001", "PURE002"}),
+        ("bad_donation.py", {"DON001", "DON002", "DON003"}),
+        ("bad_ownership.py", {"OWN001", "OWN002", "EXC001"}),
+        (
+            "bad_annotation.py",
+            {"ANN001", "ANN002", "ANN003", "ANN004", "ANN005", "ANN006"},
+        ),
+    ],
+)
+def test_fixture_corpus_is_flagged(fixture, expected):
+    findings = analysis.check_paths([os.path.join(FIXTURES, fixture)])
+    assert expected <= codes(findings), (
+        f"{fixture} must trip {sorted(expected)}; got "
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_fixture_waivers_are_honored():
+    """bad_lock.py's waived/holds-annotated accesses are NOT flagged —
+    the grammar suppresses exactly the declared lines, nothing else."""
+    findings = analysis.check_paths([os.path.join(FIXTURES, "bad_lock.py")])
+    flagged_lines = {f.line for f in findings}
+    src = open(os.path.join(FIXTURES, "bad_lock.py")).read().splitlines()
+    for i, line in enumerate(src, 1):
+        if "lint: unguarded-ok" in line or "OK: caller holds" in line:
+            assert i not in flagged_lines
+
+
+# ------------------------------------- detection proof (lock deletion)
+
+
+def _delete_with_block(source: str, method: str) -> str:
+    """Textually remove the first ``with self._cond:`` inside ``method``,
+    dedenting its body — the exact edit a careless refactor would make."""
+    lines = source.split("\n")
+    out, i, in_method, deleted = [], 0, False, False
+    while i < len(lines):
+        line = lines[i]
+        if f"def {method}(" in line:
+            in_method = True
+        if in_method and not deleted and line.strip() == "with self._cond:":
+            indent = len(line) - len(line.lstrip())
+            i += 1
+            while i < len(lines) and (
+                not lines[i].strip()
+                or len(lines[i]) - len(lines[i].lstrip()) > indent
+            ):
+                body = lines[i]
+                out.append(
+                    body[4:] if body.startswith(" " * (indent + 4)) else body
+                )
+                i += 1
+            deleted = True
+            continue
+        out.append(line)
+        i += 1
+    assert deleted, f"no `with self._cond:` found in {method}"
+    return "\n".join(out)
+
+
+@pytest.mark.parametrize("method", ["retire", "void", "reset"])
+def test_deleting_a_lock_in_staging_is_detected(method):
+    """The acceptance contract: deleting one ``with self._cond:`` from
+    rollout/staging.py makes the lock-discipline pass fail. (Done on an
+    in-memory copy; the real file stays untouched.)"""
+    path = os.path.join(PACKAGE, "rollout", "staging.py")
+    mutated = _delete_with_block(open(path).read(), method)
+    findings = analysis.check_source(
+        mutated, path="staging.py", passes=("locks",)
+    )
+    assert any(f.code == "LOCK001" for f in findings), (
+        f"deleting {method}'s lock must trip LOCK001"
+    )
+    # And the pristine source passes the same pass.
+    assert not analysis.check_source(
+        open(path).read(), path="staging.py", passes=("locks",)
+    )
+
+
+def test_removing_a_waiver_resurfaces_the_ownership_finding():
+    """Annotations are load-bearing: stripping one thread-shared-ok
+    waiver from the inference server re-surfaces OWN001 for that slot."""
+    from asyncrl_tpu.analysis import core
+
+    paths = [
+        os.path.join(PACKAGE, "rollout", p)
+        for p in ("sebulba.py", "inference_server.py", "staging.py",
+                  "buffer.py")
+    ] + [os.path.join(PACKAGE, "api", "sebulba_trainer.py")]
+    modules = []
+    for p in paths:
+        src = open(p).read()
+        if p.endswith("inference_server.py"):
+            src, n = _strip_waiver(src, "_results")
+            assert n == 1
+        modules.append(core.SourceModule(p, src))
+    findings = analysis.run_passes(core.Project(modules), ("ownership",))
+    assert any(
+        f.code == "OWN001" and "_results" in f.message for f in findings
+    )
+
+
+def _strip_waiver(src: str, attr: str):
+    out, n = [], 0
+    for line in src.split("\n"):
+        if "lint: thread-shared-ok" in line and "Event.set/wait" in line:
+            n += 1
+            continue
+        out.append(line)
+    return "\n".join(out), n
+
+
+# ------------------------------------------- annotation grammar hardness
+
+
+def _lint(src: str, passes=analysis.PASSES):
+    return analysis.check_source(textwrap.dedent(src), passes=passes)
+
+
+def test_malformed_guarded_by_is_a_hard_error():
+    findings = _lint(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0  # guarded-by _lock
+        """
+    )
+    assert "ANN001" in codes(findings)
+
+
+def test_guarded_by_must_bind_an_assignment():
+    findings = _lint(
+        """
+        class S:
+            def f(self):  # guarded-by: _lock
+                return 1
+        """
+    )
+    assert "ANN002" in codes(findings)
+
+
+def test_guarded_by_unknown_lock_is_a_hard_error():
+    findings = _lint(
+        """
+        class S:
+            def __init__(self):
+                self.x = 0  # guarded-by: _mutex
+        """
+    )
+    assert "ANN003" in codes(findings)
+
+
+def test_unknown_waiver_tag_is_a_hard_error_not_a_silent_noop():
+    findings = _lint(
+        """
+        def f():
+            return 1  # lint: totally-fine(reason)
+        """
+    )
+    assert "ANN005" in codes(findings)
+
+
+def test_waiver_without_reason_is_a_hard_error():
+    findings = _lint(
+        """
+        def f():
+            return 1  # lint: impure-ok()
+        """
+    )
+    assert "ANN004" in codes(findings)
+
+
+def test_waiver_with_reason_on_known_tag_parses_clean():
+    findings = _lint(
+        """
+        def f():
+            return 1  # lint: impure-ok(why not)
+        """
+    )
+    assert not findings
+
+
+def test_malformed_thread_entry_is_a_hard_error():
+    findings = _lint(
+        """
+        class W:
+            def run(self):  # thread-entry: two words
+                pass
+        """
+    )
+    assert "ANN009" in codes(findings)
+
+
+def test_holds_on_non_def_line_is_a_hard_error():
+    findings = _lint(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.y = 0  # holds: _lock
+        """
+    )
+    assert "ANN007" in codes(findings)
+
+
+def test_annotation_errors_cannot_be_waived():
+    """An ANN error on a line carrying a (valid) waiver still fails: the
+    waiver grammar never silences the grammar checker itself."""
+    findings = _lint(
+        """
+        # lint: unguarded-ok(shield attempt)
+        x = 1  # guarded-by:
+        """
+    )
+    assert "ANN001" in codes(findings)
+
+
+def test_trailing_waiver_does_not_cover_the_next_line():
+    """A waiver trailing code scopes to its own line only; the unguarded
+    access on the NEXT line must still be flagged (a trailing waiver must
+    never silently suppress a neighbor)."""
+    findings = _lint(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0  # guarded-by: _lock
+                self.y = 0  # guarded-by: _lock
+
+            def f(self):
+                a = self.x  # lint: unguarded-ok(deliberate snapshot)
+                b = self.y
+                return a, b
+        """,
+        passes=("locks",),
+    )
+    assert codes(findings) == {"LOCK001"}
+    assert len(findings) == 1 and "self.y" in findings[0].message
+
+
+def test_standalone_waiver_covers_the_line_below():
+    findings = _lint(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0  # guarded-by: _lock
+
+            def f(self):
+                # lint: unguarded-ok(deliberate snapshot)
+                return self.x
+        """,
+        passes=("locks",),
+    )
+    assert not findings
+
+
+def test_donate_and_rebind_idiom_is_not_flagged():
+    """`state = self._step(state, ...)` — the canonical JAX donation
+    idiom rebinds in the donating statement; later reads see the fresh
+    output, not the donated buffer."""
+    findings = _lint(
+        """
+        import jax
+
+        def _step(state, rollout):
+            return state + rollout.sum(), rollout.mean()
+
+        class L:
+            def __init__(self):
+                self._step = jax.jit(_step, donate_argnums=(0,))
+
+            def loop(self, state, rollouts):
+                for r in rollouts:
+                    state, loss = self._step(state, r)
+                return state
+        """,
+        passes=("donation",),
+    )
+    assert not findings
+
+
+def test_waiver_reason_may_mention_annotation_names():
+    """A waiver whose reason quotes 'guarded-by' (e.g. this tool's own
+    remediation text) parses as a waiver, not as a malformed guard."""
+    findings = _lint(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0  # guarded-by: _lock
+
+            def f(self):
+                return self.x  # lint: unguarded-ok(no guarded-by lock needed: snapshot)
+        """
+    )
+    assert not findings
+
+
+def test_module_global_guard_is_enforced():
+    """A '# guarded-by:' on a module global is not decorative: unguarded
+    function-scope accesses trip LOCK002, with-lock accesses pass, and a
+    lock name that doesn't exist at module level is a hard error."""
+    src = """
+    import threading
+
+    _REG_LOCK = threading.Lock()
+    _registry = {}  # guarded-by: _REG_LOCK
+
+
+    def good(k, v):
+        with _REG_LOCK:
+            _registry[k] = v
+
+
+    def bad(k):
+        return _registry.get(k)
+    """
+    findings = _lint(src, passes=("locks",))
+    assert [f.code for f in findings] == ["LOCK002"]
+    assert "bad" not in findings[0].message  # message names the global
+    missing = _lint(
+        """
+        _registry = {}  # guarded-by: _NO_SUCH_LOCK
+        """
+    )
+    assert "ANN003" in codes(missing)
+
+
+def test_plain_dotted_import_does_not_poison_resolution():
+    """`import numpy.random` must not make `numpy.asarray` resolve as
+    numpy.random.* (false PURE001)."""
+    findings = _lint(
+        """
+        import jax
+        import numpy.random
+
+        @jax.jit
+        def f(x):
+            return numpy.asarray(x)
+        """,
+        passes=("purity",),
+    )
+    assert not findings
+
+
+def test_donate_argnames_resolves_or_reports():
+    """donate_argnames on a local callee maps to positions (read-after-
+    donate still caught); on an unresolvable callee it is reported as
+    unchecked (DON004), never silently skipped."""
+    caught = _lint(
+        """
+        import jax
+
+        def _step(state, rollout):
+            return state + rollout.sum()
+
+        class L:
+            def __init__(self):
+                self._step = jax.jit(_step, donate_argnames=("rollout",))
+
+            def update(self, state, rollout):
+                out = self._step(state, rollout)
+                return out + rollout.mean()
+        """,
+        passes=("donation",),
+    )
+    assert "DON001" in codes(caught)
+    unchecked = _lint(
+        """
+        import jax
+        from somewhere import opaque_fn
+
+        g = jax.jit(opaque_fn, donate_argnames=("rollout",))
+        """,
+        passes=("donation",),
+    )
+    assert "DON004" in codes(unchecked)
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_exit_codes_gate_findings():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    clean = subprocess.run(
+        [sys.executable, "-m", "asyncrl_tpu.analysis", PACKAGE],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = subprocess.run(
+        [
+            sys.executable, "-m", "asyncrl_tpu.analysis",
+            os.path.join(FIXTURES, "bad_lock.py"),
+        ],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert dirty.returncode == 1
+    assert "LOCK001" in dirty.stdout

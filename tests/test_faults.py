@@ -421,3 +421,39 @@ def test_recovery_counters_flow_through_sinks(tmp_path):
     assert "queue_backpressure=7" in line
     assert "fault_actor.step=1" in line
     assert "server_restarts" not in line  # zero counters stay quiet
+
+
+# ------------------------------------------------------ thread identity
+
+
+def test_threads_are_named_and_fault_messages_identify_threads():
+    """Every spawned worker thread carries a stable name (actor-<i>,
+    inference-server), and an injected fault's message names the thread
+    that hit it — so watchdog logs, linter reports (the analysis pass's
+    thread-entry map), and chaos logs all identify threads consistently."""
+    import threading
+
+    cfg = _chaos_config(inference_server=True)
+    agent = make_agent(cfg)
+    try:
+        agent._start_actors()
+        names = sorted(t.name for t in agent._actors)
+        assert names == [f"actor-{i}" for i in range(cfg.actor_threads)]
+        assert agent._server.name == "inference-server"
+    finally:
+        agent.close()
+
+    site = faults.FaultRegistry("actor.step:crash:1.0:0").site("actor.step")
+    captured = []
+
+    def hit():
+        try:
+            site.fire()
+        except faults.InjectedFault as e:
+            captured.append(str(e))
+
+    t = threading.Thread(target=hit, name="actor-7", daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    assert captured, "the armed site must fire in the worker thread"
+    assert "'actor-7'" in captured[0] and "actor.step" in captured[0]

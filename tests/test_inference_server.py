@@ -44,7 +44,12 @@ def test_slicing_round_trip_two_clients():
             eps = np.full((3,), 0.1 * (i + 1), np.float32)
             out[i] = c(None, obs, jax.random.PRNGKey(0), eps)
 
-        threads = [threading.Thread(target=work, args=(i,)) for i in range(2)]
+        threads = [
+            threading.Thread(
+                target=work, args=(i,), name=f"infer-client-{i}"
+            )
+            for i in range(2)
+        ]
         for t in threads:
             t.start()
         for t in threads:

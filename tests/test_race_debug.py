@@ -76,9 +76,15 @@ def _hammer(store: ParamStore, seconds: float, errors: list, stop: threading.Eve
     # Two writers must not interleave with EACH OTHER for the payload
     # invariant to be meaningful; the race under test is writer-vs-reader.
     write_lock = threading.Lock()
-    threads = [threading.Thread(target=reader) for _ in range(4)]
-    threads += [threading.Thread(target=writer) for _ in range(2)]
-    threads += [threading.Thread(target=steps_reader)]
+    threads = [
+        threading.Thread(target=reader, name=f"race-reader-{i}")
+        for i in range(4)
+    ]
+    threads += [
+        threading.Thread(target=writer, name=f"race-writer-{i}")
+        for i in range(2)
+    ]
+    threads += [threading.Thread(target=steps_reader, name="race-steps-reader")]
     old_interval = sys.getswitchinterval()
     sys.setswitchinterval(1e-6)  # force frequent preemption mid-section
     try:
@@ -175,7 +181,8 @@ def test_fragment_transport_stress_clean():
             q.put(_fragment(actor, 0, seq, version=seq // 7))
 
     threads = [
-        threading.Thread(target=produce, args=(i,)) for i in range(n_producers)
+        threading.Thread(target=produce, args=(i,), name=f"race-producer-{i}")
+        for i in range(n_producers)
     ]
     old_interval = sys.getswitchinterval()
     sys.setswitchinterval(1e-6)

@@ -103,7 +103,9 @@ def test_no_reuse_before_transfer_complete():
     def acquire_blocked():
         got.append(ring.acquire())
 
-    t = threading.Thread(target=acquire_blocked, daemon=True)
+    t = threading.Thread(
+        target=acquire_blocked, name="staging-acquirer", daemon=True
+    )
     t.start()
     time.sleep(0.15)
     assert not got, "slab re-leased while its transfer was still in flight"
@@ -120,7 +122,7 @@ def test_retire_reclaims_ready_slabs_without_blocking():
     _fill_and_commit(lease)
     ring.retire(lease.slab, FakeReady(ready=True))
     # Ready at retire time -> reclaimed opportunistically: both slabs free.
-    assert all(s.state == "free" for s in ring._slabs)
+    assert all(s.phase == "free" for s in ring._slabs)
     assert ring.reuse_waits == 0
 
 
@@ -147,6 +149,14 @@ def test_generation_stamp_fences_restarted_actor():
         )
     with pytest.raises(StaleLeaseError):
         zombie.commit()
+    # emit() also WRITES the row (bootstrap_obs) and must re-validate:
+    # a zombie descheduled after its last append, voided, then resuming
+    # into emit would otherwise overwrite the replacement's bootstrap.
+    with pytest.raises(StaleLeaseError):
+        full = zombie.buffer
+        while not full.full:
+            full._t += 1  # the appends already raised; force "full"
+        full.emit(bootstrap_obs=np.zeros((3, 4), np.float32))
     # The replacement gets the SAME row back under a newer generation
     # (voided rows are re-served first so old slabs complete).
     replacement = ring.acquire()
@@ -166,7 +176,7 @@ def test_reset_invalidates_all_leases():
     assert not lease.valid()
     with pytest.raises(StaleLeaseError):
         lease.commit()
-    assert all(s.state == "free" for s in ring._slabs)
+    assert all(s.phase == "free" for s in ring._slabs)
 
 
 def test_auto_num_slabs_covers_pipeline_depth():
