@@ -1,21 +1,34 @@
 """Framework-aware static checker for the async pipeline.
 
-``python -m asyncrl_tpu.analysis [paths...]`` runs four passes over the
+``python -m asyncrl_tpu.analysis [paths...]`` runs seven passes over the
 package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
 :mod:`asyncrl_tpu.analysis.annotations` for the annotation grammar):
 
-- ``locks``     — ``guarded-by`` lock discipline (LOCK*)
-- ``purity``    — host effects / state mutation inside jit (PURE*)
-- ``donation``  — donated-buffer and slab-lease aliasing safety (DON*)
-- ``ownership`` — cross-thread state audit + broad excepts (OWN*/EXC*)
+- ``locks``       — ``guarded-by`` lock discipline (LOCK*)
+- ``purity``      — host effects / state mutation inside jit (PURE*)
+- ``donation``    — donated-buffer and slab-lease aliasing safety (DON*)
+- ``ownership``   — cross-thread state audit + broad excepts (OWN*/EXC*)
+- ``deadlock``    — interprocedural lock-order graph: cycles, waits under
+  foreign locks, blocking calls in lock regions (DEAD*)
+- ``collectives`` — device contracts: collective axis binding, scan-carry
+  structure, host threading under trace (COL*)
+- ``configflow``  — config-field contracts + ASYNCRL_* env discipline
+  (CFG*)
 
-Annotation-grammar errors (ANN*) are produced by every run and cannot be
-waived. ``scripts/lint.sh`` wires this into CI next to ruff;
-``tests/test_analysis.py`` pins "the package lints clean" as a tier-1
-invariant.
+Annotation-grammar errors and unloadable files (ANN*) are produced by
+every run and can be neither waived nor baselined. The analyzer core
+shares ONE parse + symbol/call-graph index per run, keeps an incremental
+on-disk cache (``--cache-dir``, :mod:`asyncrl_tpu.analysis.cache`), emits
+machine-readable JSON with stable finding IDs
+(:mod:`asyncrl_tpu.analysis.report`), and gates against the checked-in
+``analysis/baseline.json``. ``scripts/lint.sh`` wires this into CI next
+to ruff; ``tests/test_analysis.py`` pins "the package lints clean modulo
+the baseline" as a tier-1 invariant.
 """
 
 from __future__ import annotations
+
+import time
 
 from asyncrl_tpu.analysis.core import (  # noqa: F401  (public API)
     Finding,
@@ -24,27 +37,67 @@ from asyncrl_tpu.analysis.core import (  # noqa: F401  (public API)
     load_source,
 )
 
-PASSES = ("locks", "purity", "donation", "ownership")
+PASSES = (
+    "locks",
+    "purity",
+    "donation",
+    "ownership",
+    "deadlock",
+    "collectives",
+    "configflow",
+)
+
+# Finding-code prefix -> owning pass (for per-pass stats; ANN* belongs to
+# the grammar/loader, not a pass).
+CODE_FAMILIES = {
+    "LOCK": "locks",
+    "PURE": "purity",
+    "DON": "donation",
+    "OWN": "ownership",
+    "EXC": "ownership",
+    "DEAD": "deadlock",
+    "COL": "collectives",
+    "CFG": "configflow",
+    "ANN": "annotations",
+}
 
 
-def run_passes(
-    project: Project, passes: tuple[str, ...] | list[str] = PASSES
-) -> list[Finding]:
-    """Annotation errors + every requested pass's findings, stably ordered
-    by (path, line, code)."""
-    from asyncrl_tpu.analysis import donation, locks, ownership, purity
+def _impl():
+    from asyncrl_tpu.analysis import (
+        collectives,
+        configflow,
+        deadlock,
+        donation,
+        locks,
+        ownership,
+        purity,
+    )
 
-    impl = {
+    return {
         "locks": locks.run,
         "purity": purity.run,
         "donation": donation.run,
         "ownership": ownership.run,
+        "deadlock": deadlock.run,
+        "collectives": collectives.run,
+        "configflow": configflow.run,
     }
+
+
+def run_passes(
+    project: Project,
+    passes: tuple[str, ...] | list[str] = PASSES,
+    targets: set[str] | None = None,
+) -> list[Finding]:
+    """Annotation errors + every requested pass's findings, stably ordered
+    by (path, line, code). ``targets`` scopes per-file findings for the
+    incremental cache (global passes ignore it — see analysis/cache.py)."""
+    impl = _impl()
     findings = list(project.annotation_errors())
     for name in passes:
         if name not in impl:
             raise ValueError(f"unknown pass {name!r}; have {PASSES}")
-        findings.extend(impl[name](project))
+        findings.extend(impl[name](project, targets))
     return sorted(findings, key=lambda f: (f.path, f.line, f.code))
 
 
@@ -61,3 +114,80 @@ def check_source(
 ) -> list[Finding]:
     """Lint a source string (tests; the lock-deletion detection proof)."""
     return run_passes(load_source(source, path), passes)
+
+
+class AnalysisResult:
+    """One analyzer run: findings + the stats the CLI/tests consume."""
+
+    def __init__(self, findings: list[Finding], stats: dict):
+        self.findings = findings
+        self.stats = stats
+
+
+def run_analysis(
+    paths: list[str],
+    passes: tuple[str, ...] | list[str] = PASSES,
+    cache_dir: str | None = None,
+) -> AnalysisResult:
+    """The full pipeline behind the CLI: discover -> (cache check) ->
+    parse -> passes -> (cache store), with wall-time and per-pass stats.
+
+    Cache modes reported in ``stats["cache"]``: ``"off"`` (no cache dir),
+    ``"cold"`` (no reusable manifest), ``"partial"`` (some files served
+    from cache — ``files_analyzed`` counts the re-analyzed ones), and
+    ``"warm"`` (everything replayed from the manifest, zero parses)."""
+    from asyncrl_tpu.analysis import cache as _cache
+    from asyncrl_tpu.analysis import core as _core
+
+    t0 = time.perf_counter()
+    passes = tuple(passes)
+    files = _core.discover_files(paths)
+
+    def finish(findings, mode, analyzed):
+        per_pass: dict[str, int] = {}
+        for f in findings:
+            family = next(
+                (p for prefix, p in CODE_FAMILIES.items()
+                 if f.code.startswith(prefix)),
+                "other",
+            )
+            per_pass[family] = per_pass.get(family, 0) + 1
+        return AnalysisResult(
+            findings,
+            {
+                "wall_s": time.perf_counter() - t0,
+                "files_total": len(files),
+                "files_analyzed": analyzed,
+                "cache": mode,
+                "passes": list(passes),
+                "findings_per_pass": dict(sorted(per_pass.items())),
+                "findings_total": len(findings),
+            },
+        )
+
+    if cache_dir is None:
+        project = load_paths(paths)
+        return finish(run_passes(project, passes), "off", len(files))
+
+    hashes = {f: _cache.file_sha(f) for f in files}
+    cache_plan, manifest = _cache.plan(cache_dir, files, hashes, passes)
+    if cache_plan.mode == "warm":
+        return finish(cache_plan.warm_findings, "warm", 0)
+
+    project = load_paths(paths)
+    env_hash = _cache.project_env_hash(project)
+    cache_plan = _cache.refine(
+        cache_plan, manifest, project, files, hashes, env_hash
+    )
+    if cache_plan.mode == "partial":
+        fresh = run_passes(project, passes, targets=cache_plan.targets)
+        findings = sorted(
+            fresh + cache_plan.reused,
+            key=lambda f: (f.path, f.line, f.code),
+        )
+        analyzed = len(cache_plan.targets)
+    else:
+        findings = run_passes(project, passes)
+        analyzed = len(files)
+    _cache.store(cache_dir, files, hashes, passes, env_hash, findings)
+    return finish(findings, cache_plan.mode, analyzed)

@@ -1,29 +1,43 @@
 """CLI: ``python -m asyncrl_tpu.analysis [paths...]``.
 
-Exit status 0 when every pass is clean, 1 when any finding (or annotation
-error) is reported, 2 on usage errors. With no paths, lints the installed
-``asyncrl_tpu`` package — the form ``scripts/lint.sh`` runs in CI.
+Exit status 0 when every finding is baselined (or there are none), 1 on
+any non-baselined finding (annotation/load errors always gate), 2 on
+usage errors. With no paths, lints the installed ``asyncrl_tpu`` package
+— the form ``scripts/lint.sh`` runs in CI.
 
-``--entries`` prints the thread-entry map (which functions each declared
-thread entry reaches) instead of linting — the audit's view of who runs
-where.
+- ``--format json`` prints the machine-readable document (findings with
+  stable IDs, run stats, baseline effect) to stdout; human-readable
+  findings go to stderr so the JSON stays parseable.
+- ``--cache-dir DIR`` arms the incremental cache: a second consecutive
+  run with no edits replays the manifest without parsing a single file.
+- ``--baseline PATH`` overrides the checked-in
+  ``asyncrl_tpu/analysis/baseline.json``; ``--no-baseline`` disables
+  grandfathering entirely. ``--write-baseline`` snapshots the current
+  findings as the new baseline (the explicit grandfathering act).
+- ``--stats`` appends per-pass finding counts, cache mode, and analysis
+  wall time.
+- ``--entries`` prints the thread-entry map (which functions each
+  declared thread entry reaches) instead of linting.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 import asyncrl_tpu
 from asyncrl_tpu import analysis
+from asyncrl_tpu.analysis import report
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m asyncrl_tpu.analysis",
         description="framework-aware static checker (lock discipline, "
-        "JAX purity, donation safety, thread ownership)",
+        "JAX purity, donation safety, thread ownership, deadlock/"
+        "lock-order, device contracts, config contracts)",
     )
     parser.add_argument(
         "paths",
@@ -38,6 +52,39 @@ def main(argv: list[str] | None = None) -> int:
         help="run only the named pass(es); repeatable",
     )
     parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (json: stable-ID findings + stats on stdout)",
+    )
+    parser.add_argument(
+        "--cache-dir",
+        default=None,
+        help="incremental cache directory (content-hash keyed; a clean "
+        "re-run skips analysis entirely)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=report.DEFAULT_BASELINE,
+        help="baseline file of grandfathered finding IDs "
+        "(default: the checked-in analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline: every finding gates",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="snapshot current findings into --baseline and exit 0",
+    )
+    parser.add_argument(
+        "--stats",
+        action="store_true",
+        help="print per-pass finding counts and analysis wall time",
+    )
+    parser.add_argument(
         "--entries",
         action="store_true",
         help="print the thread-entry map and exit",
@@ -45,23 +92,77 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     paths = args.paths or [os.path.dirname(asyncrl_tpu.__file__)]
-    project = analysis.load_paths(paths)
 
     if args.entries:
         from asyncrl_tpu.analysis import ownership
 
+        project = analysis.load_paths(paths)
         for entry, reached in sorted(ownership.entry_map(project).items()):
             print(f"{entry}:")
             for name in reached:
                 print(f"  {name}")
         return 0
 
-    findings = analysis.run_passes(project, args.passes or analysis.PASSES)
-    for finding in findings:
-        print(finding.render())
-    if findings:
+    result = analysis.run_analysis(
+        paths, args.passes or analysis.PASSES, cache_dir=args.cache_dir
+    )
+    findings = result.findings
+
+    if args.write_baseline:
+        report.write_baseline(args.baseline, findings)
         print(
-            f"asyncrl_tpu.analysis: {len(findings)} finding(s)",
+            f"wrote {len(findings)} finding(s) to {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else report.load_baseline(
+        args.baseline
+    )
+    gating, baseline_info = report.apply_baseline(findings, baseline)
+    baseline_info["applied"] = (
+        None if args.no_baseline else args.baseline
+    )
+
+    ids = report.finding_ids(findings)
+    suppressed = set(baseline_info.get("suppressed_ids", ()))
+    text_out = sys.stderr if args.format == "json" else sys.stdout
+    for finding, fid in zip(findings, ids):
+        mark = "  [baselined]" if fid in suppressed else ""
+        print(f"{finding.render()}  [{fid}]{mark}", file=text_out)
+
+    if args.format == "json":
+        doc = report.to_json(findings, result.stats, baseline_info)
+        doc["gating"] = len(gating)
+        print(json.dumps(doc, indent=2))
+
+    if args.stats:
+        stats = result.stats
+        print("analysis stats:", file=text_out)
+        print(
+            f"  wall_s={stats['wall_s']:.3f}  cache={stats['cache']}  "
+            f"files={stats['files_analyzed']}/{stats['files_total']} "
+            "analyzed",
+            file=text_out,
+        )
+        for name, count in stats["findings_per_pass"].items():
+            print(f"  {name}: {count} finding(s)", file=text_out)
+
+    if baseline_info.get("stale_entries"):
+        print(
+            f"asyncrl_tpu.analysis: {len(baseline_info['stale_entries'])} "
+            "stale baseline entr(y/ies) — the findings are fixed; delete "
+            "their IDs from the baseline",
+            file=sys.stderr,
+        )
+    if gating:
+        print(
+            f"asyncrl_tpu.analysis: {len(gating)} gating finding(s)"
+            + (
+                f" ({baseline_info['suppressed']} baselined)"
+                if baseline_info.get("suppressed")
+                else ""
+            ),
             file=sys.stderr,
         )
         return 1

@@ -33,8 +33,14 @@ enforce. The full grammar (also documented in docs/ARCHITECTURE.md):
     ``impure-ok`` (sanctioned host effect in jit-reachable code),
     ``donated-read-ok`` (read after donation that is provably safe),
     ``thread-shared-ok`` (cross-thread state with a non-lock discipline —
-    GIL-atomic stamp, single-writer latch, handshake ownership). The
-    reason is mandatory.
+    GIL-atomic stamp, single-writer latch, handshake ownership),
+    ``lock-order-ok`` (a lock-order edge that cannot participate in a
+    real cycle — e.g. the inner lock is private to one thread),
+    ``blocking-under-lock-ok`` (a deliberate blocking call or Condition
+    hand-off while a lock is held — e.g. serializing a one-time build),
+    ``config-unused-ok`` (a declared config field with no static reader —
+    e.g. consumed through dynamic ``getattr`` machinery). The reason is
+    mandatory.
 
 Malformed annotations and unknown waiver tags are **hard lint errors**
 (ANN0xx findings) — a misspelled annotation must never silently enforce
@@ -55,6 +61,9 @@ WAIVER_TAGS = (
     "impure-ok",
     "donated-read-ok",
     "thread-shared-ok",
+    "lock-order-ok",
+    "blocking-under-lock-ok",
+    "config-unused-ok",
 )
 
 _GUARDED_RE = re.compile(r"^guarded-by:\s*(\S+)\s*$")

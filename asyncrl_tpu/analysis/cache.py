@@ -1,0 +1,277 @@
+"""Incremental on-disk cache for the analyzer (``--cache-dir``).
+
+Soundness contract: **a stale cache must never hide a finding.** The
+design is therefore hash-everything, reuse-only-on-proof:
+
+- Every run fingerprints every file (sha256 of raw bytes). If the file
+  set and every hash match the manifest, the whole cached finding list is
+  replayed — zero parses, zero passes. This is the warm path
+  ``scripts/lint.sh`` hits on the second consecutive run (the >= 3x
+  speedup the tier-1 test asserts).
+- Otherwise the project is re-parsed and an **environment hash** is
+  computed: per file, the docstring-free ``ast.dump`` of its tree plus
+  its annotation declarations (guards/holds/entries, line-independent).
+  The env hash captures everything a pass may consult ACROSS files —
+  classes, call sites, jit bindings, axis bindings, config fields. A
+  file's cached findings are reused only when its own content hash AND
+  the project env hash both match; so a comment-only edit re-analyzes
+  just the edited file, while any code change anywhere invalidates
+  every cross-file-dependent result. Conservative, and sound.
+- Findings from the **global passes** (ownership, deadlock, CFG002 — the
+  codes in :data:`GLOBAL_CODES`) fold state from the whole project, so
+  they are recomputed on every non-warm run and never served per-file.
+  ANN findings (annotation grammar, unparseable files) likewise.
+
+The cache keys on :data:`ANALYZER_VERSION`; bump it whenever a pass's
+behavior changes so stale manifests self-invalidate.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+
+from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
+
+ANALYZER_VERSION = "2"
+_MANIFEST = "manifest.json"
+
+# Code prefixes whose findings fold whole-project state: recomputed every
+# run, never cached per-file.
+GLOBAL_CODES = ("OWN", "EXC", "DEAD", "ANN")
+_GLOBAL_EXACT = ("CFG002",)
+
+
+def is_global_code(code: str) -> bool:
+    return code.startswith(GLOBAL_CODES) or code in _GLOBAL_EXACT
+
+
+def file_sha(path: str) -> str | None:
+    try:
+        with open(path, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def _strip_docstrings(tree: ast.Module) -> None:
+    """Drop leading docstring Exprs in place (on a throwaway re-parse):
+    a docstring edit must not invalidate the whole project's env."""
+    for node in ast.walk(tree):
+        body = getattr(node, "body", None)
+        if (
+            isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                              ast.AsyncFunctionDef))
+            and body
+            and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and isinstance(body[0].value.value, str)
+        ):
+            node.body = body[1:]
+
+
+def _module_env(module: SourceModule) -> str:
+    """The cross-file-visible summary of one module: its code shape (AST
+    sans docstrings and positions) + its annotation declarations (line
+    numbers excluded — a shifted line is not a changed declaration)."""
+    tree = ast.parse(module.source)
+    _strip_docstrings(tree)
+    ann = module.annotations
+    decls = {
+        "guards": sorted(
+            (cls or "", attr, g.lock)
+            for (cls, attr), g in ann.guards.items()
+        ),
+        "holds": sorted(
+            (cls, method, lock)
+            for (cls, method), lock in ann.holds.items()
+        ),
+        "entries": sorted(
+            (e.name, e.group, e.class_name or "", e.method or "")
+            for e in ann.entries
+        ),
+    }
+    payload = ast.dump(tree, include_attributes=False) + json.dumps(
+        decls, sort_keys=True
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def project_env_hash(project: Project) -> str:
+    digest = hashlib.sha256()
+    for module in sorted(project.modules, key=lambda m: m.path):
+        digest.update(module.path.encode())
+        digest.update(_module_env(module).encode())
+    # A file that failed to load is part of the environment too (its
+    # disciplines are unchecked either way, but its identity matters).
+    for f in sorted(project.load_errors, key=lambda f: f.path):
+        digest.update(f"{f.code}:{f.path}".encode())
+    return digest.hexdigest()
+
+
+def _encode(findings: list[Finding]) -> list[list]:
+    return [[f.code, f.path, f.line, f.message] for f in findings]
+
+
+def _decode(rows: list[list]) -> list[Finding]:
+    return [Finding(code, path, line, msg) for code, path, line, msg in rows]
+
+
+class Manifest:
+    def __init__(self, doc: dict | None = None):
+        doc = doc or {}
+        self.version = doc.get("version")
+        self.passes = tuple(doc.get("passes", ()))
+        self.env_hash = doc.get("env_hash")
+        # path -> {"sha256": ..., "findings": [...] (non-global codes)}
+        self.files: dict[str, dict] = doc.get("files", {})
+        self.all_findings: list[list] = doc.get("all_findings", [])
+
+    @classmethod
+    def load(cls, cache_dir: str) -> "Manifest | None":
+        path = os.path.join(cache_dir, _MANIFEST)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                return cls(json.load(fh))
+        except (OSError, ValueError):
+            return None
+
+    def save(self, cache_dir: str) -> None:
+        os.makedirs(cache_dir, exist_ok=True)
+        doc = {
+            "version": self.version,
+            "passes": list(self.passes),
+            "env_hash": self.env_hash,
+            "files": self.files,
+            "all_findings": self.all_findings,
+        }
+        tmp = os.path.join(cache_dir, _MANIFEST + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, os.path.join(cache_dir, _MANIFEST))
+
+
+class CachePlan:
+    """What one run decided about the cache: the warm-path verdict, the
+    target set to re-analyze, and the reusable per-file findings."""
+
+    def __init__(
+        self,
+        mode: str,  # "warm" | "partial" | "cold"
+        targets: set[str] | None,
+        reused: list[Finding],
+        warm_findings: list[Finding] | None = None,
+    ):
+        self.mode = mode
+        self.targets = targets
+        self.reused = reused
+        self.warm_findings = warm_findings
+
+
+def plan(
+    cache_dir: str,
+    files: list[str],
+    hashes: dict[str, str | None],
+    passes: tuple[str, ...],
+) -> tuple[CachePlan, "Manifest | None"]:
+    """Decide warm/partial/cold from the manifest and current hashes.
+    The partial decision is finalized by :func:`refine` once the project
+    is parsed (the env hash needs the ASTs)."""
+    manifest = Manifest.load(cache_dir)
+    if (
+        manifest is None
+        or manifest.version != ANALYZER_VERSION
+        or manifest.passes != tuple(passes)
+    ):
+        return CachePlan("cold", None, []), manifest
+    cached_files = manifest.files
+    if set(cached_files) == set(files) and all(
+        hashes[f] is not None and cached_files[f].get("sha256") == hashes[f]
+        for f in files
+    ):
+        return (
+            CachePlan(
+                "warm", set(), [],
+                warm_findings=_decode(manifest.all_findings),
+            ),
+            manifest,
+        )
+    return CachePlan("partial", None, []), manifest
+
+
+def refine(
+    cache_plan: CachePlan,
+    manifest: "Manifest | None",
+    project: Project,
+    files: list[str],
+    hashes: dict[str, str | None],
+    env_hash: str,
+) -> CachePlan:
+    """Turn a partial plan into (targets, reused findings): a file's
+    cached findings are valid iff its content hash matches AND the stored
+    env hash equals this run's. Everything else re-analyzes."""
+    if cache_plan.mode != "partial" or manifest is None:
+        return CachePlan("cold", None, [])
+    if manifest.env_hash != env_hash:
+        # Cross-file-visible code changed somewhere: nothing per-file is
+        # provably reusable.
+        return CachePlan("cold", None, [])
+    targets: set[str] = set()
+    reused: list[Finding] = []
+    for module in project.modules:
+        entry = manifest.files.get(module.path)
+        if (
+            entry is not None
+            and hashes.get(module.path) == entry.get("sha256")
+        ):
+            reused.extend(_decode(entry.get("findings", [])))
+        else:
+            targets.add(module.path)
+    # Files that failed to load this run are "analyzed" by definition
+    # (their ANN findings are global-coded and recomputed).
+    for f in project.load_errors:
+        targets.add(f.path)
+    return CachePlan("partial", targets, reused)
+
+
+def store(
+    cache_dir: str,
+    files: list[str],
+    hashes: dict[str, str | None],
+    passes: tuple[str, ...],
+    env_hash: str,
+    findings: list[Finding],
+) -> None:
+    """Persist the run: per-file non-global findings + the full list for
+    the warm path."""
+    manifest = Manifest()
+    manifest.version = ANALYZER_VERSION
+    manifest.passes = tuple(passes)
+    manifest.env_hash = env_hash
+    per_file: dict[str, list] = {f: [] for f in files}
+    for f in findings:
+        if not is_global_code(f.code) and f.path in per_file:
+            per_file[f.path].append([f.code, f.path, f.line, f.message])
+    manifest.files = {
+        path: {"sha256": hashes.get(path), "findings": per_file[path]}
+        for path in files
+        if hashes.get(path) is not None
+    }
+    manifest.all_findings = _encode(findings)
+    manifest.save(cache_dir)
+
+
+__all__ = [
+    "ANALYZER_VERSION",
+    "CachePlan",
+    "GLOBAL_CODES",
+    "Manifest",
+    "file_sha",
+    "is_global_code",
+    "plan",
+    "project_env_hash",
+    "refine",
+    "store",
+]

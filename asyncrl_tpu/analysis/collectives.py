@@ -1,0 +1,372 @@
+"""Device-contract pass (COL0xx): the device half's collective/scan rules.
+
+The host passes guard Python concurrency; this pass guards the contracts
+the DEVICE half must honor — the ones that otherwise only fail at trace
+time on a real pod (or worse, silently compute garbage on one):
+
+- COL001 — a collective (``psum``/``pmean``/``pmax``/``pmin``/
+  ``all_gather``/``ppermute``/``axis_index``/``axis_size``) whose
+  *statically-known* axis name is bound by NO ``pmap``/``vmap``/
+  ``shard_map`` axis anywhere in the analyzed project. Axis names resolve
+  through module constants (``DP_AXIS = "dp"``), and bindings are
+  collected from ``pmap(..., axis_name=...)``/``vmap`` axis kwargs,
+  ``Mesh``/``make_mesh`` axis-name tuples, ``*_AXIS`` string constants,
+  and ``mesh_axes`` dataclass defaults. Calls whose axis argument is a
+  runtime value (the dominant idiom here — ``axes`` parameters) are out
+  of static reach and skipped; when the project binds no axes at all the
+  check disarms rather than guessing.
+- COL002 — a ``lax.scan`` body whose returned carry structure provably
+  differs from the carry it receives (tuple-arity mismatch against the
+  body's carry unpacking or the ``init`` literal, or a non-pair return),
+  where statically decidable. JAX reports these as opaque pytree errors
+  deep inside a trace; here they fail at lint time with the body named.
+- COL003 — host-threading primitives (``threading.*``, ``queue.*``,
+  ``concurrent.*``, ``multiprocessing.*``, ``socket.*``) reachable from
+  device-traced roots (the shared traced closure — ``ops/``, ``learn/``,
+  ``parallel/``, ``rollout/anakin.py`` live almost entirely inside it).
+  A lock or queue op under trace runs ONCE at trace time and never
+  again; per-step synchronization it claims to do is fiction. Sanctioned
+  cases carry ``# lint: impure-ok(<reason>)`` (the same waiver the purity
+  pass honors — one sanction, two lenses).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
+
+# resolved last path segment -> positional index of the axis-name arg.
+_COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "ppermute": 1,
+    "pswapaxes": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+_AXIS_BINDERS = {"pmap", "vmap", "shard_map", "xmap"}
+
+_THREADING_PREFIXES = (
+    "threading.",
+    "queue.",
+    "concurrent.",
+    "multiprocessing.",
+    "socket.",
+)
+
+
+def _const_strs(module: SourceModule, node: ast.AST) -> set[str] | None:
+    """Statically-known axis-name strings of an expression: a string
+    constant, a tuple/list of them, or a Name resolving to a module-level
+    string/tuple constant (``DP_AXIS``). None = not statically known."""
+    if isinstance(node, ast.Constant):
+        return {node.value} if isinstance(node.value, str) else None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: set[str] = set()
+        for elt in node.elts:
+            sub = _const_strs(module, elt)
+            if sub is None:
+                return None
+            out |= sub
+        return out
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        resolved = module.resolve(node)
+        if resolved is None:
+            return None
+        const = _module_constant(module, resolved)
+        if const is None:
+            return None
+        return _const_strs(module, const)
+    return None
+
+
+def _top_constants(module: SourceModule) -> dict[str, ast.AST]:
+    consts = getattr(module, "_top_constants", None)
+    if consts is None:
+        consts = {}
+        for stmt in module.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        consts[t.id] = stmt.value
+        module._top_constants = consts  # cached on the module itself
+    return consts
+
+
+def _module_constant(module: SourceModule, resolved: str) -> ast.AST | None:
+    """The value expression of a module-level ``NAME = <literal>`` that
+    ``resolved`` points at — same module, or an analyzed module the
+    dotted path suffixes (``asyncrl_tpu.parallel.mesh.DP_AXIS``)."""
+    name = resolved.rsplit(".", 1)[-1]
+    mod_path = resolved.rsplit(".", 1)[0] if "." in resolved else ""
+    candidates = [module]
+    project = getattr(module, "_project", None)
+    if project is not None and mod_path:
+        candidates += [
+            m for m in project.modules if mod_path.endswith(m.name)
+        ]
+    for m in candidates:
+        consts = _top_constants(m)
+        if name in consts:
+            return consts[name]
+    return None
+
+
+def _bound_axes(project: Project) -> set[str]:
+    """Every axis name the project binds anywhere (see COL001 docs)."""
+    bound: set[str] = set()
+    for module in project.modules:
+        module._project = project  # for cross-module constant resolution
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                # *_AXIS = "dp" module constants: declared axis names.
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Name)
+                        and t.id.endswith("_AXIS")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)
+                    ):
+                        bound.add(node.value.value)
+            elif isinstance(node, ast.AnnAssign):
+                # Config-style defaults: mesh_axes: tuple = ("dp",)
+                if (
+                    isinstance(node.target, ast.Name)
+                    and node.target.id in ("mesh_axes", "axis_names")
+                    and node.value is not None
+                ):
+                    strs = _const_strs(module, node.value)
+                    if strs:
+                        bound |= strs
+            elif isinstance(node, ast.Call):
+                resolved = module.resolve(node.func)
+                tail = (
+                    resolved.rsplit(".", 1)[-1] if resolved else None
+                )
+                if tail in _AXIS_BINDERS:
+                    for kw in node.keywords:
+                        if kw.arg == "axis_name":
+                            strs = _const_strs(module, kw.value)
+                            if strs:
+                                bound |= strs
+                elif tail in ("Mesh", "make_mesh"):
+                    exprs = [kw.value for kw in node.keywords
+                             if kw.arg in ("axis_names", "mesh_axes")]
+                    if tail == "Mesh" and len(node.args) >= 2:
+                        exprs.append(node.args[1])
+                    if tail == "make_mesh" and len(node.args) >= 2:
+                        exprs.append(node.args[1])
+                    for expr in exprs:
+                        strs = _const_strs(module, expr)
+                        if strs:
+                            bound |= strs
+    return bound
+
+
+def _axis_arg(call: ast.Call, pos: int) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            return kw.value
+    if pos < len(call.args):
+        return call.args[pos]
+    return None
+
+
+def _check_axes(
+    project: Project, targets: set[str] | None, findings: list[Finding]
+) -> None:
+    bound = _bound_axes(project)
+    if not bound:
+        # No binding site in the analyzed set: nothing to check against
+        # (a lone ops file legitimately names axes its caller binds).
+        return
+    for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve(node.func)
+            if resolved is None:
+                continue
+            tail = resolved.rsplit(".", 1)[-1]
+            if tail not in _COLLECTIVES:
+                continue
+            if not (resolved.startswith("jax.") or "lax." in resolved):
+                continue  # a local helper that happens to share the name
+            axis_expr = _axis_arg(node, _COLLECTIVES[tail])
+            if axis_expr is None:
+                continue
+            strs = _const_strs(module, axis_expr)
+            if strs is None:
+                continue  # runtime axis value: out of static reach
+            unbound = sorted(strs - bound)
+            if unbound:
+                findings.append(
+                    Finding(
+                        "COL001", module.path, node.lineno,
+                        f"collective {tail}() names axis "
+                        f"{', '.join(map(repr, unbound))} which no "
+                        "pmap/vmap/shard_map/Mesh in the analyzed project "
+                        f"binds (bound axes: {sorted(bound)}): this fails "
+                        "at trace time on the pod",
+                    )
+                )
+
+
+# --------------------------------------------------------------- COL002
+
+
+def _scan_body_fn(
+    project: Project, module: SourceModule, call: ast.Call
+) -> tuple[SourceModule, ast.AST] | None:
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Lambda):
+        return module, target
+    if isinstance(target, (ast.Name, ast.Attribute)):
+        return project.function_index.resolve_callable(module, target)
+    return None
+
+
+def _own_returns(fn: ast.AST) -> list[ast.Return]:
+    """Return statements of ``fn`` itself (nested defs excluded)."""
+    out: list[ast.Return] = []
+    work = list(getattr(fn, "body", []) or [])
+    while work:
+        node = work.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Return):
+            out.append(node)
+        work.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _carry_arity(fn: ast.AST, init: ast.AST | None) -> int | None:
+    """Statically-known carry tuple arity: from ``a, b = <carry>`` unpacks
+    of the body's first parameter, or from a literal ``init`` tuple."""
+    args = getattr(fn, "args", None)
+    carry_param = args.args[0].arg if args and args.args else None
+    if carry_param is not None:
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], (ast.Tuple, ast.List))
+                and isinstance(node.value, ast.Name)
+                and node.value.id == carry_param
+            ):
+                return len(node.targets[0].elts)
+    if isinstance(init, (ast.Tuple, ast.List)):
+        return len(init.elts)
+    return None
+
+
+def _check_scans(
+    project: Project, targets: set[str] | None, findings: list[Finding]
+) -> None:
+    for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve(node.func)
+            if resolved is None or not resolved.endswith("lax.scan"):
+                continue
+            hit = _scan_body_fn(project, module, node)
+            if hit is None:
+                continue
+            _, body = hit
+            init = node.args[1] if len(node.args) > 1 else None
+            arity = _carry_arity(body, init)
+            if isinstance(body, ast.Lambda):
+                returns = [body.body]
+            else:
+                returns = [
+                    r.value for r in _own_returns(body)
+                    if r.value is not None
+                ]
+            name = getattr(body, "name", "<lambda>")
+            for value in returns:
+                if not isinstance(value, ast.Tuple):
+                    continue  # a Name may well be a pair: undecidable
+                if len(value.elts) != 2:
+                    findings.append(
+                        Finding(
+                            "COL002", module.path, value.lineno,
+                            f"scan body {name} returns a "
+                            f"{len(value.elts)}-tuple; lax.scan bodies "
+                            "must return (carry, ys)",
+                        )
+                    )
+                    continue
+                head = value.elts[0]
+                if (
+                    arity is not None
+                    and isinstance(head, (ast.Tuple, ast.List))
+                    and len(head.elts) != arity
+                ):
+                    findings.append(
+                        Finding(
+                            "COL002", module.path, value.lineno,
+                            f"scan body {name} receives a {arity}-element "
+                            f"carry but returns a {len(head.elts)}-element "
+                            "one: the carry pytree structure must be "
+                            "preserved across iterations",
+                        )
+                    )
+
+
+# --------------------------------------------------------------- COL003
+
+
+def _check_traced_threading(
+    project: Project, targets: set[str] | None, findings: list[Finding]
+) -> None:
+    for module, fn in project.traced_functions():
+        if targets is not None and module.path not in targets:
+            continue
+        ann = module.annotations
+        name = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve(node.func)
+            if resolved is None:
+                continue
+            if not any(
+                resolved.startswith(p) for p in _THREADING_PREFIXES
+            ):
+                continue
+            if ann.waived(node.lineno, "impure-ok"):
+                continue
+            findings.append(
+                Finding(
+                    "COL003", module.path, node.lineno,
+                    f"host-threading call {resolved}() in device-traced "
+                    f"{name}: it executes once at trace time — the "
+                    "synchronization it promises does not exist per step",
+                )
+            )
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """``targets`` (incremental cache): when given, only emit findings for
+    those module paths; axis bindings and the traced closure are still
+    computed over the whole project."""
+    findings: list[Finding] = []
+    _check_axes(project, targets, findings)
+    _check_scans(project, targets, findings)
+    _check_traced_threading(project, targets, findings)
+    return findings

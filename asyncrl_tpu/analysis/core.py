@@ -3,21 +3,31 @@
 The analysis subsystem (``python -m asyncrl_tpu.analysis``) enforces, at
 lint time and on every line, the concurrency and JAX disciplines the
 runtime checks (``ASYNCRL_DEBUG_SYNC``, ``tests/test_race_debug.py``) can
-only probe on the interleavings a stress test happens to hit. Four passes
-run over the package's ASTs (stdlib ``ast``/``tokenize`` only — no
-third-party linter dependency):
+only probe on the interleavings a stress test happens to hit. Seven
+passes run over the package's ASTs (stdlib ``ast``/``tokenize`` only —
+no third-party linter dependency):
 
-- :mod:`asyncrl_tpu.analysis.locks`      — ``guarded-by`` lock discipline
-- :mod:`asyncrl_tpu.analysis.purity`     — host effects inside jit/scan
-- :mod:`asyncrl_tpu.analysis.donation`   — donated/retired buffer reads
-- :mod:`asyncrl_tpu.analysis.ownership`  — cross-thread state audit +
+- :mod:`asyncrl_tpu.analysis.locks`       — ``guarded-by`` lock discipline
+- :mod:`asyncrl_tpu.analysis.purity`      — host effects inside jit/scan
+- :mod:`asyncrl_tpu.analysis.donation`    — donated/retired buffer reads
+- :mod:`asyncrl_tpu.analysis.ownership`   — cross-thread state audit +
   broad-except swallows
+- :mod:`asyncrl_tpu.analysis.deadlock`    — interprocedural lock-order
+  graph: cycles, waits under foreign locks, blocking under locks
+- :mod:`asyncrl_tpu.analysis.collectives` — device-contract checks: axis
+  binding, scan-carry structure, host threading in traced code
+- :mod:`asyncrl_tpu.analysis.configflow`  — config-field contracts and
+  ``ASYNCRL_*`` env-var discipline
 
 This module holds what every pass shares: source loading, comment
 extraction, import/alias resolution, class/attribute indexing, a light
-``self.<attr> = ClassName(...)`` type map, and the :class:`Finding`
-record. The annotation grammar itself lives in
-:mod:`asyncrl_tpu.analysis.annotations`.
+``self.<attr> = ClassName(...)`` type map, the :class:`Finding` record,
+and the ONE-per-run interprocedural indexes (:class:`FunctionIndex`, the
+name-based :class:`CallGraph`, and the jit-traced reachable set) that the
+passes used to rebuild independently. The annotation grammar itself lives
+in :mod:`asyncrl_tpu.analysis.annotations`; incremental caching in
+:mod:`asyncrl_tpu.analysis.cache`; finding IDs / JSON / the baseline in
+:mod:`asyncrl_tpu.analysis.report`.
 
 The checker is deliberately approximate — a linter, not a verifier: it
 resolves calls by name (unique-name or typed-receiver only), it does not
@@ -39,8 +49,9 @@ import tokenize
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One lint finding. ``code`` identifies the rule (LOCK/PURE/DON/OWN/
-    EXC/ANN families); annotation-grammar errors (ANN*) are hard errors
-    that no waiver can silence."""
+    EXC/DEAD/COL/CFG/ANN families); annotation-grammar and file-load
+    errors (ANN*) are hard errors that no waiver or baseline can
+    silence."""
 
     code: str
     path: str
@@ -197,15 +208,32 @@ class ClassInfo:
 
 class Project:
     """A set of modules under analysis + the cross-module indexes every
-    pass shares."""
+    pass shares.
 
-    def __init__(self, modules: list[SourceModule]):
+    ``load_errors`` carries hard findings for files that could not even be
+    loaded (non-UTF-8 bytes, syntax errors): the file is excluded from the
+    module set but the run keeps analyzing everything else — a broken file
+    must fail the gate, not crash the analyzer.
+    """
+
+    def __init__(
+        self,
+        modules: list[SourceModule],
+        load_errors: list[Finding] | None = None,
+    ):
         # Not `from asyncrl_tpu.analysis import annotations`: the package
         # __init__'s `from __future__ import annotations` shadows the
         # submodule as a package attribute.
         import asyncrl_tpu.analysis.annotations as annotations
 
         self.modules = modules
+        self.load_errors: list[Finding] = list(load_errors or [])
+        # Lazily-built shared indexes (one parse + one symbol/call-graph
+        # walk per RUN, not per pass): see function_index / call_graph /
+        # traced_functions below.
+        self._function_index: FunctionIndex | None = None
+        self._call_graph = None
+        self._traced: list[tuple[SourceModule, ast.AST]] | None = None
         self.classes: dict[str, list[ClassInfo]] = {}
         self.class_list: list[ClassInfo] = []
         for module in modules:
@@ -241,15 +269,225 @@ class Project:
                     self.dataclass_fields.add(stmt.target.id)
 
     def annotation_errors(self) -> list[Finding]:
-        out: list[Finding] = []
+        out: list[Finding] = list(self.load_errors)
         for module in self.modules:
             out.extend(module.annotations.errors)
         return out
 
+    # ------------------------------------------------- shared indexes
 
-def load_paths(paths: list[str]) -> Project:
-    """Build a Project from files and/or directories (``.py`` under a
-    directory, recursively, skipping hidden and build directories)."""
+    @property
+    def function_index(self) -> "FunctionIndex":
+        """Every function def in the project, by module and by resolved
+        dotted name — built once per run and shared by the purity,
+        collectives, and deadlock passes."""
+        if self._function_index is None:
+            self._function_index = FunctionIndex(self)
+        return self._function_index
+
+    @property
+    def call_graph(self):
+        """The conservative name-based call graph (see
+        :class:`asyncrl_tpu.analysis.ownership` for the resolution rules)
+        — built once per run, shared by the ownership and deadlock
+        passes."""
+        if self._call_graph is None:
+            from asyncrl_tpu.analysis.ownership import CallGraph
+
+            self._call_graph = CallGraph(self)
+        return self._call_graph
+
+    def traced_functions(self) -> list[tuple[SourceModule, ast.AST]]:
+        """The transitive closure of functions reachable from JAX trace
+        roots (jit/pmap/shard_map/vmap/scan decorators and wrapper calls)
+        — computed once per run, shared by purity and collectives."""
+        if self._traced is None:
+            index = self.function_index
+            seen: set[int] = set()
+            order: list[tuple[SourceModule, ast.AST]] = []
+            work: list[tuple[SourceModule, ast.AST]] = []
+            for module in self.modules:
+                work.extend(collect_trace_roots(module, index))
+            while work:
+                module, fn = work.pop()
+                if id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                order.append((module, fn))
+                # Follow calls (and bare function references, which cover
+                # callbacks) to functions in the analyzed set.
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        hit = index.resolve_callable(module, node.func)
+                        if hit is not None and id(hit[1]) not in seen:
+                            work.append(hit)
+            self._traced = order
+        return self._traced
+
+
+# Wrapper callables whose function-valued arguments are traced. Matched on
+# the LAST path segment after alias resolution, so ``jax.jit``, ``jit``,
+# and ``asyncrl_tpu.parallel.mesh.shard_map`` all match.
+TRACE_WRAPPERS = {
+    "jit",
+    "pmap",
+    "vmap",
+    "grad",
+    "value_and_grad",
+    "shard_map",
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "remat",
+    "associative_scan",
+    "custom_vjp",
+    "custom_jvp",
+}
+
+
+class FunctionIndex:
+    """Functions (top-level and nested) per module, keyed by name, plus a
+    global view keyed by ``<module-resolved dotted name>``."""
+
+    def __init__(self, project: Project):
+        self.per_module: dict[SourceModule, dict[str, ast.FunctionDef]] = {}
+        for module in project.modules:
+            funcs: dict[str, ast.FunctionDef] = {}
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # Last definition wins on name collision — good enough
+                    # for intra-module resolution of helper names.
+                    funcs[node.name] = node
+            self.per_module[module] = funcs
+
+    def resolve_callable(
+        self, module: SourceModule, node: ast.AST
+    ) -> tuple[SourceModule, ast.FunctionDef] | None:
+        """A Name/Attribute callable → its FunctionDef, same module first,
+        then by import (``from asyncrl_tpu.x import f``)."""
+        if isinstance(node, ast.Name):
+            fn = self.per_module[module].get(node.id)
+            if fn is not None:
+                return module, fn
+        resolved = module.resolve(node)
+        if resolved is None:
+            return None
+        name = resolved.rsplit(".", 1)[-1]
+        mod_path = resolved.rsplit(".", 1)[0] if "." in resolved else ""
+        for other, funcs in self.per_module.items():
+            if name in funcs and mod_path.endswith(other.name):
+                return other, funcs[name]
+        # An imported bare name (`from mod import f` makes resolve() yield
+        # "mod.f"): accept a same-module def as the fallback for Names
+        # only — attribute calls on unresolvable receivers (self.x.m())
+        # must not leak into the traced set by method-name accident.
+        if isinstance(node, ast.Name):
+            fn = self.per_module[module].get(name)
+            if fn is not None:
+                return module, fn
+        return None
+
+
+def decorator_is_traced(module: SourceModule, dec: ast.AST) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    resolved = module.resolve(target)
+    if resolved and resolved.rsplit(".", 1)[-1] in TRACE_WRAPPERS:
+        return True
+    # functools.partial(jax.jit, ...) decorator form.
+    if isinstance(dec, ast.Call):
+        resolved = module.resolve(dec.func)
+        if resolved and resolved.rsplit(".", 1)[-1] == "partial" and dec.args:
+            inner = module.resolve(dec.args[0])
+            if inner and inner.rsplit(".", 1)[-1] in TRACE_WRAPPERS:
+                return True
+    return False
+
+
+def collect_trace_roots(
+    module: SourceModule, index: FunctionIndex
+) -> list[tuple[SourceModule, ast.AST]]:
+    """(module, function-or-lambda) JAX trace roots in ``module``."""
+    roots: list[tuple[SourceModule, ast.AST]] = []
+    # Enclosing-class map, for jax.jit(self._apply)-style method roots.
+    class_methods: dict[int, dict[str, ast.FunctionDef]] = {}
+    for cls in ast.walk(module.tree):
+        if isinstance(cls, ast.ClassDef):
+            methods = {
+                n.name: n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for sub in ast.walk(cls):
+                class_methods[id(sub)] = methods
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(
+                decorator_is_traced(module, d) for d in node.decorator_list
+            ):
+                roots.append((module, node))
+        elif isinstance(node, ast.Call):
+            resolved = module.resolve(node.func)
+            if (
+                resolved is None
+                or resolved.rsplit(".", 1)[-1] not in TRACE_WRAPPERS
+            ):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    roots.append((module, arg))
+                elif (
+                    isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"
+                    and arg.attr in class_methods.get(id(node), {})
+                ):
+                    roots.append(
+                        (module, class_methods[id(node)][arg.attr])
+                    )
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    hit = index.resolve_callable(module, arg)
+                    if hit is not None:
+                        roots.append(hit)
+    return roots
+
+
+def load_file(path: str) -> tuple[SourceModule | None, Finding | None]:
+    """Load and parse one source file. Returns ``(module, None)`` on
+    success, ``(None, finding)`` when the file is unreadable or not
+    decodable UTF-8 (ANN011) or not parseable Python (ANN012) — hard
+    findings that gate the run but never crash it."""
+    try:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except OSError as e:
+        return None, Finding(
+            "ANN011", path, 1,
+            f"file could not be read ({e.__class__.__name__}: {e}); "
+            "excluded from analysis — every discipline in it is UNCHECKED",
+        )
+    try:
+        source = raw.decode("utf-8")
+    except UnicodeDecodeError as e:
+        return None, Finding(
+            "ANN011", path, 1,
+            f"file is not valid UTF-8 ({e.reason} at byte {e.start}); "
+            "excluded from analysis — every discipline in it is UNCHECKED",
+        )
+    try:
+        return SourceModule(path, source), None
+    except SyntaxError as e:
+        return None, Finding(
+            "ANN012", path, e.lineno or 1,
+            f"file does not parse ({e.msg}); excluded from analysis — "
+            "every discipline in it is UNCHECKED",
+        )
+
+
+def discover_files(paths: list[str]) -> list[str]:
+    """Expand files and/or directories into the ``.py`` file list
+    (recursive under directories, skipping hidden and build dirs)."""
     files: list[str] = []
     for path in paths:
         if os.path.isdir(path):
@@ -266,11 +504,21 @@ def load_paths(paths: list[str]) -> Project:
                 )
         else:
             files.append(path)
-    modules = []
-    for f in files:
-        with open(f, encoding="utf-8") as fh:
-            modules.append(SourceModule(f, fh.read()))
-    return Project(modules)
+    return files
+
+
+def load_paths(paths: list[str]) -> Project:
+    """Build a Project from files and/or directories. Unreadable files
+    become load-error findings, not crashes."""
+    modules: list[SourceModule] = []
+    errors: list[Finding] = []
+    for f in discover_files(paths):
+        module, err = load_file(f)
+        if module is not None:
+            modules.append(module)
+        if err is not None:
+            errors.append(err)
+    return Project(modules, load_errors=errors)
 
 
 def load_source(source: str, path: str = "<string>") -> Project:

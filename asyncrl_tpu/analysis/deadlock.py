@@ -1,0 +1,662 @@
+"""Interprocedural deadlock pass (DEAD0xx).
+
+PR 3's lock pass checks that *declared* guards are held; nothing checked
+how locks compose ACROSS functions — exactly the bug class that only
+surfaces under load on a real pod: two threads acquiring the same two
+locks in opposite orders, a ``Condition.wait`` that sleeps while holding
+an unrelated lock, a ``queue.put`` that blocks forever inside a critical
+section. This pass builds a whole-program **lock-acquisition graph** and
+reports:
+
+- DEAD001 — a cycle in the lock-order graph. Nodes are lock identities
+  (``Class.attr`` for ``with self.<lock>:`` where the attribute is bound
+  to a ``threading`` primitive or carries a lock-ish name;
+  ``module:NAME`` for module-level locks; one typed hop —
+  ``with self.ring._cond:`` resolves through the
+  ``self.ring = StagingRing(...)`` binding). An edge ``A -> B`` is
+  recorded when B is acquired while A is held — lexically via ``with``
+  nesting, via a ``# holds:`` method entry, or interprocedurally: a call
+  made while holding A edges into every lock the callee may
+  (transitively) acquire. Re-acquiring a lock already in the held set is
+  REENTRANT (no edge — the framework's Conditions use RLocks), which is
+  also what makes the check precise: deleting the outer ``with`` that
+  made an inner acquisition reentrant turns it into a real opposite-order
+  edge and trips the cycle. One finding per strongly-connected component;
+  ``# lint: lock-order-ok(<reason>)`` on an edge's line removes that edge
+  from the graph.
+- DEAD002 — ``Condition.wait``/``wait_for`` while holding a *different*
+  lock (directly or through a call chain): the wait releases only its own
+  condition, so the foreign lock is held for the whole sleep — every
+  other thread needing it stalls behind a sleeper. Waivable with
+  ``# lint: blocking-under-lock-ok(<reason>)``.
+- DEAD003 — a blocking call inside a lock region (directly or through a
+  call chain): ``queue.put/get`` without a timeout, ``jax.device_get`` /
+  ``block_until_ready``, ``Thread.join``, ``subprocess.*``, file ``open``,
+  ``time.sleep``, ``Event.wait`` without timeout. Waivable with
+  ``# lint: blocking-under-lock-ok(<reason>)`` where the hold is the
+  point (serializing a one-time native build; a Condition hand-off).
+
+Like every pass here, this is a linter, not a verifier: lock identity is
+name/type-based, call resolution is the shared :class:`CallGraph`'s, and
+dynamic dispatch is invisible. What it guarantees is that every lock
+order the code *spells out* is acyclic, every run.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from asyncrl_tpu.analysis.core import ClassInfo, Finding, Project
+
+LOCK_TYPES = {
+    "Lock",
+    "RLock",
+    "Condition",
+    "Semaphore",
+    "BoundedSemaphore",
+}
+_COND_TYPES = {"Condition"}
+_LOCKY_NAME = re.compile(r"lock|cond|mutex|semaphore", re.IGNORECASE)
+
+# Blocking-call deny list for DEAD003, by resolved dotted prefix.
+_BLOCKING_PREFIXES = (
+    "subprocess.",
+    "time.sleep",
+    "jax.device_get",
+    "jax.block_until_ready",
+)
+_BLOCKING_BARE = {"open", "input"}
+_QUEUE_TYPES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+
+@dataclasses.dataclass(frozen=True)
+class _LockRef:
+    """A resolved lock identity + whether it is a Condition."""
+
+    key: str
+    is_cond: bool
+
+
+class _Index:
+    """Project-level lock-identity resolution shared by every function
+    visit: class attr -> primitive type, module-level lock names."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        # Module-level `NAME = threading.Lock()` style declarations.
+        self.module_locks: dict[int, dict[str, _LockRef]] = {}
+        for module in project.modules:
+            locks: dict[str, _LockRef] = {}
+            for stmt in module.tree.body:
+                if not (
+                    isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                ):
+                    continue
+                resolved = module.resolve(stmt.value.func)
+                if resolved is None:
+                    continue
+                tail = resolved.rsplit(".", 1)[-1]
+                if tail not in LOCK_TYPES:
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        locks[t.id] = _LockRef(
+                            f"{module.name}:{t.id}", tail in _COND_TYPES
+                        )
+            self.module_locks[id(module)] = locks
+
+    def _class_lock(self, info: ClassInfo, attr: str) -> _LockRef | None:
+        bound = info.attr_types.get(attr)
+        if bound in LOCK_TYPES:
+            return _LockRef(f"{info.name}.{attr}", bound in _COND_TYPES)
+        if bound is None and _LOCKY_NAME.search(attr):
+            # Unbound but lock-named (the lock arrives via a parameter):
+            # trust the name; "cond" names count as conditions.
+            return _LockRef(
+                f"{info.name}.{attr}", "cond" in attr.lower()
+            )
+        return None
+
+    def resolve(self, node, expr: ast.AST) -> _LockRef | None:
+        """Lock identity of an acquisition/wait receiver expression inside
+        call-graph node ``node`` (module + optional class context)."""
+        cls = node.cls
+        if isinstance(expr, ast.Name):
+            return self.module_locks[id(node.module)].get(expr.id)
+        if not isinstance(expr, ast.Attribute):
+            return None
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if cls is not None:
+                return self._class_lock(cls, expr.attr)
+            return None
+        # One typed hop: self.<x>.<lock> through `self.x = ClassName(...)`.
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and cls is not None
+        ):
+            type_name = cls.attr_types.get(recv.attr)
+            infos = self.project.classes.get(type_name or "", [])
+            if len(infos) == 1:
+                return self._class_lock(infos[0], expr.attr)
+        return None
+
+
+def _has_timeout(
+    call: ast.Call,
+    timeout_pos: int | None = None,
+    block_pos: int | None = None,
+) -> bool:
+    """Does the call bound its blocking — a ``timeout=`` keyword, the
+    method's positional timeout slot (``get(True, 0.5)``, ``wait(0.05)``,
+    ``join(2.0)``), or non-blocking mode (``block=False`` by keyword or
+    in its positional slot)?"""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return True
+        if (
+            kw.arg in ("block", "blocking")
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+        ):
+            return True
+    if timeout_pos is not None and len(call.args) > timeout_pos:
+        return True
+    if block_pos is not None and len(call.args) > block_pos:
+        arg = call.args[block_pos]
+        if isinstance(arg, ast.Constant) and arg.value is False:
+            return True
+    return False
+
+
+def _thread_like(project: Project, type_name: str | None) -> bool:
+    if type_name is None:
+        return False
+    if type_name == "Thread":
+        return True
+    seen: set[str] = set()
+    queue = [type_name]
+    while queue:
+        name = queue.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for info in project.classes.get(name, []):
+            for base in info.bases:
+                tail = base.rsplit(".", 1)[-1]
+                if tail == "Thread":
+                    return True
+                queue.append(tail)
+    return False
+
+
+@dataclasses.dataclass
+class _Summary:
+    """Per-function transitive facts: locks it may acquire, waits it may
+    perform, blocking ops it may execute (each with one witness site)."""
+
+    acquires: dict[str, tuple[str, int]] = dataclasses.field(
+        default_factory=dict
+    )  # lock key -> (path, line) witness
+    waits: dict[str, tuple[str, int]] = dataclasses.field(
+        default_factory=dict
+    )  # condition key -> witness
+    blocks: dict[str, tuple[str, int]] = dataclasses.field(
+        default_factory=dict
+    )  # description -> witness
+
+
+class _FnVisitor(ast.NodeVisitor):
+    """One function body: tracks the held-lock stack through ``with``
+    nesting and records local edges / waits / blocking ops / call sites
+    with their held sets."""
+
+    def __init__(self, pass_, node):
+        self.p = pass_
+        self.node = node
+        self.held: list[_LockRef] = []
+        ann = node.module.annotations
+        held_lock = ann.holds.get((node.cls.name, node.name)) if (
+            node.cls is not None
+        ) else None
+        if held_lock is not None:
+            ref = self.p.index._class_lock(node.cls, held_lock)
+            if ref is not None:
+                self.held.append(ref)
+        self.local = _Summary()
+        # (callee CallNode, held keys tuple, line) at each resolvable call.
+        self.calls: list[tuple[object, tuple[_LockRef, ...], int]] = []
+        self._local_types = None
+
+    # ------------------------------------------------------------- helpers
+
+    def _held_keys(self) -> set[str]:
+        return {r.key for r in self.held}
+
+    def _acquire(self, ref: _LockRef, line: int) -> bool:
+        """Record an acquisition event; returns True when it is a NEW
+        (non-reentrant) hold that the caller should push/pop."""
+        if ref.key in self._held_keys():
+            return False  # reentrant: no ordering edge, nothing to track
+        waived = self.node.module.annotations.waived(line, "lock-order-ok")
+        for holder in self.held:
+            self.p.add_edge(
+                holder.key, ref.key, self.node, line, waived=waived
+            )
+        self.local.acquires.setdefault(
+            ref.key, (self.node.module.path, line)
+        )
+        return True
+
+    # --------------------------------------------------------------- withs
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            ref = self.p.index.resolve(self.node, item.context_expr)
+            if ref is None:
+                continue
+            if self._acquire(ref, item.context_expr.lineno):
+                self.held.append(ref)
+                pushed += 1
+        self.generic_visit(node)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        # A nested def outlives the block: fresh held context. _Pass.run
+        # synthesizes a node for every nested def (CallGraph itself only
+        # indexes top-level functions and methods), so its lock activity
+        # — a thread-target closure's edges, waits, blocking ops — still
+        # feeds the graph, analyzed with an empty entry held set.
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # Lambdas inherit the held set (Condition.wait_for predicates run
+        # with the lock held) — same rule as the lock-discipline pass.
+        self.generic_visit(node)
+
+    # --------------------------------------------------------------- calls
+
+    def visit_Call(self, call: ast.Call) -> None:
+        func = call.func
+        line = call.lineno
+        if isinstance(func, ast.Attribute):
+            if func.attr in ("wait", "wait_for"):
+                self._check_wait(call, func, line)
+            elif func.attr == "acquire":
+                ref = self.p.index.resolve(self.node, func.value)
+                if ref is not None and not _has_timeout(
+                    call, timeout_pos=1, block_pos=0
+                ):
+                    # An explicit .acquire() is an acquisition event for
+                    # edge purposes (held state afterwards is not modeled).
+                    self._acquire(ref, line)
+            else:
+                self._check_blocking_attr(call, func, line)
+        desc = self._blocking_resolved(call)
+        if desc is not None:
+            self._record_block(desc, line)
+        # Interprocedural: remember resolvable call sites with held sets.
+        graph = self.p.graph
+        if self._local_types is None:
+            self._local_types = graph._local_types(
+                self.node.fn, self.node.cls
+            )
+        for callee in graph.resolve_call(self.node, call, self._local_types):
+            self.calls.append((callee, tuple(self.held), line))
+        self.generic_visit(call)
+
+    def _check_wait(self, call: ast.Call, func: ast.Attribute, line) -> None:
+        ref = self.p.index.resolve(self.node, func.value)
+        if ref is not None and ref.is_cond:
+            others = self._held_keys() - {ref.key}
+            self.local.waits.setdefault(
+                ref.key, (self.node.module.path, line)
+            )
+            if others:
+                self.p.dead002(
+                    self.node, line, ref.key, sorted(others), direct=True
+                )
+            return
+        # Event.wait (or an unknown waitable) without a timeout blocks
+        # indefinitely: a DEAD003-class op, not a condition hand-off.
+        if func.attr == "wait" and not _has_timeout(call, timeout_pos=0):
+            type_name = None
+            if (
+                isinstance(func.value, ast.Attribute)
+                and isinstance(func.value.value, ast.Name)
+                and func.value.value.id == "self"
+                and self.node.cls is not None
+            ):
+                type_name = self.node.cls.attr_types.get(func.value.attr)
+            if type_name == "Event":
+                self._record_block("Event.wait() without timeout", line)
+
+    def _check_blocking_attr(self, call, func: ast.Attribute, line) -> None:
+        mname = func.attr
+        if mname in ("put", "get"):
+            # Queue.put(item, block, timeout) / Queue.get(block, timeout):
+            # the stdlib-documented positional forms are bounded too.
+            if mname == "put":
+                bounded = _has_timeout(call, timeout_pos=2, block_pos=1)
+            else:
+                bounded = _has_timeout(call, timeout_pos=1, block_pos=0)
+            if bounded:
+                return
+            recv = func.value
+            type_name = None
+            recv_name = None
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and self.node.cls is not None
+            ):
+                type_name = self.node.cls.attr_types.get(recv.attr)
+                recv_name = recv.attr
+            elif isinstance(recv, ast.Name):
+                recv_name = recv.id
+            is_queue = type_name in _QUEUE_TYPES or (
+                type_name is None
+                and recv_name is not None
+                and "queue" in recv_name.lower()
+            )
+            if is_queue:
+                self._record_block(
+                    f"queue .{mname}() without timeout", line
+                )
+        elif mname == "join" and not _has_timeout(call, timeout_pos=0):
+            recv = func.value
+            type_name = None
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and self.node.cls is not None
+            ):
+                type_name = self.node.cls.attr_types.get(recv.attr)
+            if _thread_like(self.p.project, type_name):
+                self._record_block("Thread.join() without timeout", line)
+
+    def _blocking_resolved(self, call: ast.Call) -> str | None:
+        resolved = self.node.module.resolve(call.func)
+        if resolved is None:
+            return None
+        if resolved in _BLOCKING_BARE:
+            return f"{resolved}() (file I/O)"
+        for prefix in _BLOCKING_PREFIXES:
+            if resolved == prefix.rstrip(".") or resolved.startswith(prefix):
+                return f"{resolved}()"
+        return None
+
+    def _record_block(self, desc: str, line: int) -> None:
+        self.local.blocks.setdefault(desc, (self.node.module.path, line))
+        if self.held:
+            self.p.dead003(
+                self.node, line, desc, sorted(self._held_keys()),
+                direct=True,
+            )
+
+
+class _Pass:
+    def __init__(self, project: Project):
+        self.project = project
+        self.graph = project.call_graph
+        self.index = _Index(project)
+        self.findings: list[Finding] = []
+        # (from, to) -> list of (node, line) witnesses; waived edges are
+        # dropped before cycle detection.
+        self.edges: dict[tuple[str, str], list[tuple[object, int]]] = {}
+        self.locals: dict[int, _Summary] = {}
+        self.visitors: dict[int, _FnVisitor] = {}
+
+    # --------------------------------------------------------- findings
+
+    def add_edge(self, a: str, b: str, node, line: int, waived=False):
+        if a == b or waived:
+            return
+        self.edges.setdefault((a, b), []).append((node, line))
+
+    def dead002(self, node, line, cond, others, direct, via=None):
+        ann = node.module.annotations
+        if ann.waived(line, "blocking-under-lock-ok"):
+            return
+        how = "" if direct else f" (via call to {via})"
+        self.findings.append(
+            Finding(
+                "DEAD002", node.module.path, line,
+                f"{node.qualname} waits on {cond}{how} while holding "
+                f"{', '.join(others)}: the wait releases only its own "
+                "condition — the other lock is held for the whole sleep",
+            )
+        )
+
+    def dead003(self, node, line, desc, held, direct, via=None):
+        ann = node.module.annotations
+        if ann.waived(line, "blocking-under-lock-ok"):
+            return
+        how = "" if direct else f" (via call to {via})"
+        self.findings.append(
+            Finding(
+                "DEAD003", node.module.path, line,
+                f"{node.qualname} performs blocking {desc}{how} while "
+                f"holding {', '.join(held)}: every thread needing the "
+                "lock stalls behind this call",
+            )
+        )
+
+    # -------------------------------------------------------------- run
+
+    def run(self) -> list[Finding]:
+        nodes = list(self.graph.nodes.values())
+        nodes.extend(self._nested_nodes({id(n.fn) for n in nodes}))
+        for node in nodes:
+            visitor = _FnVisitor(self, node)
+            for stmt in getattr(node.fn, "body", []) or []:
+                visitor.visit(stmt)
+            self.locals[id(node.fn)] = visitor.local
+            self.visitors[id(node.fn)] = visitor
+
+        summaries = self._transitive_summaries(nodes)
+        self._interprocedural(nodes, summaries)
+        self._cycles()
+        return self.findings
+
+    def _nested_nodes(self, known: set[int]):
+        """Synthetic nodes for nested defs (thread-target closures and
+        local helpers): CallGraph indexes only top-level functions and
+        methods, but a closure's ``with`` nesting still orders locks —
+        its edges must reach the graph. Class context comes from the
+        lexically enclosing class, so ``self.<lock>`` resolves."""
+        from asyncrl_tpu.analysis.ownership import CallNode
+
+        out = []
+        for module in self.project.modules:
+            class_of: dict[int, object] = {}
+            for info in self.project.class_list:
+                if info.module is module:
+                    for sub in ast.walk(info.node):
+                        class_of[id(sub)] = info
+            for fn in ast.walk(module.tree):
+                if (
+                    isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and id(fn) not in known
+                ):
+                    out.append(
+                        CallNode(module, class_of.get(id(fn)), fn.name, fn)
+                    )
+        return out
+
+    def _transitive_summaries(self, nodes) -> dict[int, _Summary]:
+        """Fixpoint of summary[f] = local[f] ∪ ⋃ summary[callees(f)].
+        Callee sets come from the visitors' already-resolved call sites
+        (re-resolving via graph.callees would repeat identical work)."""
+        summaries = {
+            id(n.fn): _Summary(
+                dict(self.locals[id(n.fn)].acquires),
+                dict(self.locals[id(n.fn)].waits),
+                dict(self.locals[id(n.fn)].blocks),
+            )
+            for n in nodes
+        }
+        callee_ids = {
+            id(n.fn): [
+                id(callee.fn)
+                for callee, _, _ in self.visitors[id(n.fn)].calls
+                if id(callee.fn) in summaries
+            ]
+            for n in nodes
+        }
+        changed = True
+        while changed:
+            changed = False
+            for n in nodes:
+                s = summaries[id(n.fn)]
+                for cid in callee_ids[id(n.fn)]:
+                    c = summaries[cid]
+                    for src, dst in (
+                        (c.acquires, s.acquires),
+                        (c.waits, s.waits),
+                        (c.blocks, s.blocks),
+                    ):
+                        for key, where in src.items():
+                            if key not in dst:
+                                dst[key] = where
+                                changed = True
+        return summaries
+
+    def _interprocedural(self, nodes, summaries) -> None:
+        for node in nodes:
+            visitor = self.visitors[id(node.fn)]
+            for callee, held, line in visitor.calls:
+                if not held:
+                    continue
+                summary = summaries.get(id(callee.fn))
+                if summary is None:
+                    continue
+                held_keys = {r.key for r in held}
+                waived = node.module.annotations.waived(
+                    line, "lock-order-ok"
+                )
+                for lock in summary.acquires:
+                    if lock in held_keys:
+                        continue  # reentrant through the call: no edge
+                    for holder in held:
+                        self.add_edge(
+                            holder.key, lock, node, line, waived=waived
+                        )
+                for cond in summary.waits:
+                    others = held_keys - {cond}
+                    if others:
+                        self.dead002(
+                            node, line, cond, sorted(others),
+                            direct=False, via=callee.qualname,
+                        )
+                for desc, (bpath, bline) in summary.blocks.items():
+                    self.dead003(
+                        node, line,
+                        f"{desc} [{bpath}:{bline}]",
+                        sorted(held_keys),
+                        direct=False, via=callee.qualname,
+                    )
+
+    def _cycles(self) -> None:
+        """Tarjan SCCs over the lock-order graph; every SCC of >= 2 locks
+        is a deadlock-capable cycle, reported once."""
+        adj: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            # Iterative Tarjan (explicit stack) — lock graphs are tiny,
+            # but recursion depth must not depend on input shape.
+            work = [(v, iter(sorted(adj[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(adj[w]))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    sccs.append(scc)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            members = sorted(scc)
+            sites = []
+            for (a, b), witnesses in sorted(self.edges.items()):
+                if a in scc and b in scc:
+                    node, line = witnesses[0]
+                    sites.append(f"{a}->{b} at {node.module.path}:{line}")
+            first = min(
+                (w for (a, b), ws in self.edges.items()
+                 if a in scc and b in scc for w in ws),
+                key=lambda w: (w[0].module.path, w[1]),
+            )
+            self.findings.append(
+                Finding(
+                    "DEAD001", first[0].module.path, first[1],
+                    "lock-order cycle among "
+                    f"{', '.join(members)}: two threads taking these in "
+                    "opposite orders deadlock. Edges: "
+                    + "; ".join(sites),
+                )
+            )
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    # ``targets`` is accepted for pass-protocol uniformity but ignored:
+    # the lock-order graph and the call-chain DEAD002/003 findings fold
+    # edges from the whole project, so the pass recomputes in full every
+    # run (its codes are global for the incremental cache).
+    del targets
+    return _Pass(project).run()

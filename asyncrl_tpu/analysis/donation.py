@@ -386,10 +386,21 @@ def _stmt_of(fn: ast.AST, node: ast.AST) -> ast.stmt | None:
     return best
 
 
-def run(project: Project) -> list[Finding]:
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """``targets`` (incremental cache): when given, only emit findings for
+    those module paths; donating bindings and forwarders are still indexed
+    from the whole project."""
     bindings = _DonatingBindings(project)
-    findings: list[Finding] = list(bindings.findings)
+    findings: list[Finding] = [
+        f
+        for f in bindings.findings
+        if targets is None or f.path in targets
+    ]
     for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
         class_of: dict[int, str] = {}
         for cls in module.tree.body:
             if isinstance(cls, ast.ClassDef):

@@ -171,11 +171,20 @@ def _check_module_globals(module, findings: list[Finding]) -> None:
                 checker.visit(stmt)
 
 
-def run(project: Project) -> list[Finding]:
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """``targets`` (incremental cache): when given, only emit findings for
+    those module paths; guard declarations are still indexed from the
+    whole project (dotted ``Owner.lock`` guards cross files)."""
     findings: list[Finding] = []
     for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
         _check_module_globals(module, findings)
     for info in project.class_list:
+        if targets is not None and info.module.path not in targets:
+            continue
         ann = info.module.annotations
         # self.<attr> guards declared by this class (single-identifier).
         self_guards = {

@@ -83,7 +83,7 @@ _MUTATORS = {
 
 
 @dataclasses.dataclass(frozen=True)
-class _Node:
+class CallNode:
     """One function in the call graph."""
 
     module: SourceModule
@@ -97,29 +97,29 @@ class _Node:
         return f"{self.module.name}:{prefix}{self.name}"
 
 
-class _Graph:
+class CallGraph:
     def __init__(self, project: Project):
         self.project = project
-        self.nodes: dict[int, _Node] = {}
-        self.top_level: dict[SourceModule, dict[str, _Node]] = {}
-        self.methods: dict[tuple[str, str], _Node] = {}
+        self.nodes: dict[int, CallNode] = {}
+        self.top_level: dict[SourceModule, dict[str, CallNode]] = {}
+        self.methods: dict[tuple[str, str], CallNode] = {}
         for module in project.modules:
-            tl: dict[str, _Node] = {}
+            tl: dict[str, CallNode] = {}
             for stmt in module.tree.body:
                 if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    node = _Node(module, None, stmt.name, stmt)
+                    node = CallNode(module, None, stmt.name, stmt)
                     tl[stmt.name] = node
                     self.nodes[id(stmt)] = node
             self.top_level[module] = tl
         for info in project.class_list:
             for mname, fn in info.methods.items():
-                node = _Node(info.module, info, mname, fn)
+                node = CallNode(info.module, info, mname, fn)
                 self.methods[(info.name, mname)] = node
                 self.nodes[id(fn)] = node
 
     # ------------------------------------------------------------ resolve
 
-    def _method_on(self, class_name: str, mname: str) -> _Node | None:
+    def _method_on(self, class_name: str, mname: str) -> CallNode | None:
         """Method lookup through the class and its analyzed bases."""
         seen: set[str] = set()
         queue = [class_name]
@@ -163,87 +163,95 @@ class _Graph:
                     types[target.id] = typed
         return types
 
-    def callees(self, node: _Node) -> list[_Node]:
-        out: list[_Node] = []
-        cls = node.cls
-        local_types = self._local_types(node.fn, cls)
+    def callees(self, node: CallNode) -> list[CallNode]:
+        out: list[CallNode] = []
+        local_types = self._local_types(node.fn, node.cls)
         for sub in ast.walk(node.fn):
-            if not isinstance(sub, ast.Call):
-                continue
-            func = sub.func
-            if isinstance(func, ast.Name):
-                # Bare call: constructor, local function, or import.
-                hit = self._resolve_bare(node.module, func.id)
-                if hit is not None:
-                    out.append(hit)
-                continue
-            if not isinstance(func, ast.Attribute):
-                continue
-            mname = func.attr
-            recv = func.value
-            # super().m()
-            if (
-                isinstance(recv, ast.Call)
-                and isinstance(recv.func, ast.Name)
-                and recv.func.id == "super"
-                and cls is not None
-            ):
-                for base in cls.bases:
-                    hit = self._method_on(base.rsplit(".", 1)[-1], mname)
-                    if hit is not None:
-                        out.append(hit)
-                continue
-            if isinstance(recv, ast.Name) and recv.id == "self":
-                if cls is not None:
-                    hit = self._method_on(cls.name, mname)
-                    if hit is not None:
-                        out.append(hit)
-                    # No fallback for self-calls: a miss means a CALLABLE
-                    # ATTRIBUTE (a jitted fn, a handle) — resolving it by
-                    # name against other classes' methods fabricates
-                    # cross-subsystem edges (JaxHostPool's jitted _init
-                    # is not SebulbaTrainer._init).
-                    continue
-            # Typed receiver: self.<typed attr>.m() or <typed var>.m().
-            type_name = None
-            if (
-                isinstance(recv, ast.Attribute)
-                and isinstance(recv.value, ast.Name)
-                and recv.value.id == "self"
-                and cls is not None
-            ):
-                type_name = cls.attr_types.get(recv.attr)
-            elif isinstance(recv, ast.Name):
-                type_name = local_types.get(recv.id)
-            if type_name is not None and type_name in self.project.classes:
-                hit = self._method_on(type_name, mname)
-                if hit is not None:
-                    out.append(hit)
-                    continue
-            # Module-function call through an alias (faults.site(...)).
-            resolved = node.module.resolve(func)
-            if resolved is not None and "." in resolved:
-                mod_path, fname = resolved.rsplit(".", 1)
-                for module, tl in self.top_level.items():
-                    if fname in tl and mod_path.endswith(module.name):
-                        out.append(tl[fname])
-                        break
-                else:
-                    # Unique-name method resolution (last resort) — but
-                    # never for names every builtin container/primitive
-                    # also answers to: `history.append(...)` must not edge
-                    # into RolloutBuffer.append just because it is the
-                    # only analyzed class with an `append`.
-                    if mname in _BUILTIN_METHOD_NAMES:
-                        continue
-                    candidates = self.project.methods_by_name.get(mname, [])
-                    if len(candidates) == 1:
-                        hit = self.methods.get((candidates[0].name, mname))
-                        if hit is not None:
-                            out.append(hit)
+            if isinstance(sub, ast.Call):
+                out.extend(self.resolve_call(node, sub, local_types))
         return out
 
-    def _resolve_bare(self, module: SourceModule, name: str) -> _Node | None:
+    def resolve_call(
+        self,
+        node: CallNode,
+        sub: ast.Call,
+        local_types: dict | None = None,
+    ) -> list[CallNode]:
+        """Resolve ONE call site inside ``node`` to its callee node(s) —
+        the per-site form of :meth:`callees`, shared with the deadlock
+        pass (which needs the held-lock set AT the call site, so it walks
+        call sites itself)."""
+        cls = node.cls
+        if local_types is None:
+            local_types = self._local_types(node.fn, cls)
+        func = sub.func
+        if isinstance(func, ast.Name):
+            # Bare call: constructor, local function, or import.
+            hit = self._resolve_bare(node.module, func.id)
+            return [hit] if hit is not None else []
+        if not isinstance(func, ast.Attribute):
+            return []
+        mname = func.attr
+        recv = func.value
+        # super().m()
+        if (
+            isinstance(recv, ast.Call)
+            and isinstance(recv.func, ast.Name)
+            and recv.func.id == "super"
+            and cls is not None
+        ):
+            out = []
+            for base in cls.bases:
+                hit = self._method_on(base.rsplit(".", 1)[-1], mname)
+                if hit is not None:
+                    out.append(hit)
+            return out
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if cls is not None:
+                hit = self._method_on(cls.name, mname)
+                # No fallback for self-calls: a miss means a CALLABLE
+                # ATTRIBUTE (a jitted fn, a handle) — resolving it by
+                # name against other classes' methods fabricates
+                # cross-subsystem edges (JaxHostPool's jitted _init
+                # is not SebulbaTrainer._init).
+                return [hit] if hit is not None else []
+        # Typed receiver: self.<typed attr>.m() or <typed var>.m().
+        type_name = None
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and cls is not None
+        ):
+            type_name = cls.attr_types.get(recv.attr)
+        elif isinstance(recv, ast.Name):
+            type_name = local_types.get(recv.id)
+        if type_name is not None and type_name in self.project.classes:
+            hit = self._method_on(type_name, mname)
+            if hit is not None:
+                return [hit]
+        # Module-function call through an alias (faults.site(...)).
+        resolved = node.module.resolve(func)
+        if resolved is not None and "." in resolved:
+            mod_path, fname = resolved.rsplit(".", 1)
+            for module, tl in self.top_level.items():
+                if fname in tl and mod_path.endswith(module.name):
+                    return [tl[fname]]
+            # Unique-name method resolution (last resort) — but
+            # never for names every builtin container/primitive
+            # also answers to: `history.append(...)` must not edge
+            # into RolloutBuffer.append just because it is the
+            # only analyzed class with an `append`.
+            if mname in _BUILTIN_METHOD_NAMES:
+                return []
+            candidates = self.project.methods_by_name.get(mname, [])
+            if len(candidates) == 1:
+                hit = self.methods.get((candidates[0].name, mname))
+                if hit is not None:
+                    return [hit]
+        return []
+
+    def _resolve_bare(self, module: SourceModule, name: str) -> CallNode | None:
         if name in self.project.classes:
             infos = self.project.classes[name]
             if len(infos) == 1:
@@ -262,7 +270,7 @@ class _Graph:
         return None
 
 
-def _entry_roots(project: Project, graph: _Graph):
+def _entry_roots(project: Project, graph: CallGraph):
     """(entry, node) pairs from the thread-entry annotations."""
     roots = []
     for module in project.modules:
@@ -284,7 +292,7 @@ def _entry_roots(project: Project, graph: _Graph):
 def entry_map(project: Project) -> dict[str, list[str]]:
     """entry-name@group -> reachable function qualnames (the audit's
     thread-entry map, printed by ``--entries``)."""
-    graph = _Graph(project)
+    graph = project.call_graph
     out: dict[str, list[str]] = {}
     for entry, root in _entry_roots(project, graph):
         reached = _reach(graph, [root])
@@ -295,9 +303,9 @@ def entry_map(project: Project) -> dict[str, list[str]]:
     return out
 
 
-def _reach(graph: _Graph, roots: list[_Node]) -> set[_Node]:
+def _reach(graph: CallGraph, roots: list[CallNode]) -> set[CallNode]:
     seen: set[int] = set()
-    out: set[_Node] = set()
+    out: set[CallNode] = set()
     work = list(roots)
     while work:
         node = work.pop()
@@ -314,7 +322,7 @@ def _reach(graph: _Graph, roots: list[_Node]) -> set[_Node]:
 
 @dataclasses.dataclass
 class _Touch:
-    node: _Node
+    node: CallNode
     line: int
     write: bool
     group: str
@@ -339,7 +347,7 @@ def _subscript_write_targets(fn: ast.AST) -> set[int]:
     return out
 
 
-def _attr_touches(node: _Node, group: str, entry: str, project: Project):
+def _attr_touches(node: CallNode, group: str, entry: str, project: Project):
     """Yield (ClassInfo, attr, _Touch) for every attribute touch in
     ``node``'s body that can be attributed to an analyzed class."""
     fn = node.fn
@@ -404,7 +412,7 @@ def _declaring_class(
 
 
 def _receiver_class(
-    project: Project, node: _Node, recv: ast.AST
+    project: Project, node: CallNode, recv: ast.AST
 ) -> str | None:
     if (
         isinstance(recv, ast.Attribute)
@@ -419,8 +427,15 @@ def _receiver_class(
 # ------------------------------------------------------------------- run
 
 
-def run(project: Project) -> list[Finding]:
-    graph = _Graph(project)
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    # ``targets`` is accepted for pass-protocol uniformity but ignored:
+    # every OWN/EXC finding folds touches from the whole project, so the
+    # ownership audit is recomputed in full on every run (the incremental
+    # cache treats its codes as global — see cache.GLOBAL_CODES).
+    del targets
+    graph = project.call_graph
     roots = _entry_roots(project, graph)
     if not roots:
         return []
@@ -428,7 +443,7 @@ def run(project: Project) -> list[Finding]:
 
     # Function -> set of (entry, group) reaching it.
     reach_of: dict[int, set[tuple[str, str]]] = {}
-    node_of: dict[int, _Node] = {}
+    node_of: dict[int, CallNode] = {}
     for entry, root in roots:
         for node in _reach(graph, [root]):
             reach_of.setdefault(id(node.fn), set()).add(

@@ -38,28 +38,13 @@ from __future__ import annotations
 
 import ast
 
-from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
-
-# Wrapper callables whose function-valued arguments are traced. Matched on
-# the LAST path segment after alias resolution, so ``jax.jit``, ``jit``,
-# and ``asyncrl_tpu.parallel.mesh.shard_map`` all match.
-TRACE_WRAPPERS = {
-    "jit",
-    "pmap",
-    "vmap",
-    "grad",
-    "value_and_grad",
-    "shard_map",
-    "scan",
-    "while_loop",
-    "fori_loop",
-    "cond",
-    "switch",
-    "remat",
-    "associative_scan",
-    "custom_vjp",
-    "custom_jvp",
-}
+from asyncrl_tpu.analysis.core import (
+    TRACE_WRAPPERS,  # noqa: F401  (re-exported: the canonical home moved
+    # to core so every pass shares one wrapper list)
+    Finding,
+    Project,
+    SourceModule,
+)
 
 # Dotted-prefix deny list (after alias resolution).
 _EFFECT_PREFIXES = (
@@ -98,112 +83,6 @@ def _is_effect_call(module: SourceModule, node: ast.Call) -> str | None:
     return None
 
 
-class _FunctionIndex:
-    """Functions (top-level and nested) per module, keyed by name, plus a
-    global view keyed by ``<module-resolved dotted name>``."""
-
-    def __init__(self, project: Project):
-        self.per_module: dict[SourceModule, dict[str, ast.FunctionDef]] = {}
-        for module in project.modules:
-            funcs: dict[str, ast.FunctionDef] = {}
-            for node in ast.walk(module.tree):
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    # Last definition wins on name collision — good enough
-                    # for intra-module resolution of helper names.
-                    funcs[node.name] = node
-            self.per_module[module] = funcs
-
-    def resolve_callable(
-        self, module: SourceModule, node: ast.AST
-    ) -> tuple[SourceModule, ast.FunctionDef] | None:
-        """A Name/Attribute callable → its FunctionDef, same module first,
-        then by import (``from asyncrl_tpu.x import f``)."""
-        if isinstance(node, ast.Name):
-            fn = self.per_module[module].get(node.id)
-            if fn is not None:
-                return module, fn
-        resolved = module.resolve(node)
-        if resolved is None:
-            return None
-        name = resolved.rsplit(".", 1)[-1]
-        mod_path = resolved.rsplit(".", 1)[0] if "." in resolved else ""
-        for other, funcs in self.per_module.items():
-            if name in funcs and mod_path.endswith(other.name):
-                return other, funcs[name]
-        # An imported bare name (`from mod import f` makes resolve() yield
-        # "mod.f"): accept a same-module def as the fallback for Names
-        # only — attribute calls on unresolvable receivers (self.x.m())
-        # must not leak into the traced set by method-name accident.
-        if isinstance(node, ast.Name):
-            fn = self.per_module[module].get(name)
-            if fn is not None:
-                return module, fn
-        return None
-
-
-def _decorator_is_traced(module: SourceModule, dec: ast.AST) -> bool:
-    target = dec.func if isinstance(dec, ast.Call) else dec
-    resolved = module.resolve(target)
-    if resolved and resolved.rsplit(".", 1)[-1] in TRACE_WRAPPERS:
-        return True
-    # functools.partial(jax.jit, ...) decorator form.
-    if isinstance(dec, ast.Call):
-        resolved = module.resolve(dec.func)
-        if resolved and resolved.rsplit(".", 1)[-1] == "partial" and dec.args:
-            inner = module.resolve(dec.args[0])
-            if inner and inner.rsplit(".", 1)[-1] in TRACE_WRAPPERS:
-                return True
-    return False
-
-
-def _collect_roots(
-    module: SourceModule, index: _FunctionIndex
-) -> list[tuple[SourceModule, ast.AST]]:
-    """(module, function-or-lambda) roots in ``module``."""
-    roots: list[tuple[SourceModule, ast.AST]] = []
-    # Enclosing-class map, for jax.jit(self._apply)-style method roots.
-    class_methods: dict[int, dict[str, ast.FunctionDef]] = {}
-    for cls in ast.walk(module.tree):
-        if isinstance(cls, ast.ClassDef):
-            methods = {
-                n.name: n
-                for n in cls.body
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-            }
-            for sub in ast.walk(cls):
-                class_methods[id(sub)] = methods
-    for node in ast.walk(module.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if any(
-                _decorator_is_traced(module, d) for d in node.decorator_list
-            ):
-                roots.append((module, node))
-        elif isinstance(node, ast.Call):
-            resolved = module.resolve(node.func)
-            if (
-                resolved is None
-                or resolved.rsplit(".", 1)[-1] not in TRACE_WRAPPERS
-            ):
-                continue
-            for arg in node.args:
-                if isinstance(arg, ast.Lambda):
-                    roots.append((module, arg))
-                elif (
-                    isinstance(arg, ast.Attribute)
-                    and isinstance(arg.value, ast.Name)
-                    and arg.value.id == "self"
-                    and arg.attr in class_methods.get(id(node), {})
-                ):
-                    roots.append(
-                        (module, class_methods[id(node)][arg.attr])
-                    )
-                elif isinstance(arg, (ast.Name, ast.Attribute)):
-                    hit = index.resolve_callable(module, arg)
-                    if hit is not None:
-                        roots.append(hit)
-    return roots
-
-
 def _local_names(fn: ast.AST) -> set[str]:
     """Parameter and locally-assigned names of a function/lambda body."""
     names: set[str] = set()
@@ -223,27 +102,17 @@ def _local_names(fn: ast.AST) -> set[str]:
     return names
 
 
-def run(project: Project) -> list[Finding]:
-    index = _FunctionIndex(project)
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """``targets`` (incremental cache): when given, only emit findings for
+    those module paths — the traced-reachable closure is still computed
+    over the WHOLE project (reachability crosses files)."""
     findings: list[Finding] = []
-    # Reachable set, by object identity of the def/lambda node.
-    seen: set[int] = set()
-    work: list[tuple[SourceModule, ast.AST]] = []
-    for module in project.modules:
-        work.extend(_collect_roots(module, index))
-    while work:
-        module, fn = work.pop()
-        if id(fn) in seen:
+    for module, fn in project.traced_functions():
+        if targets is not None and module.path not in targets:
             continue
-        seen.add(id(fn))
         _check_traced(module, fn, findings)
-        # Transitive closure: follow calls (and bare function references,
-        # which cover callbacks) to functions in the analyzed set.
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call):
-                hit = index.resolve_callable(module, node.func)
-                if hit is not None and id(hit[1]) not in seen:
-                    work.append(hit)
     return findings
 
 

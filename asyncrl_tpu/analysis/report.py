@@ -1,0 +1,170 @@
+"""Machine-readable output: stable finding IDs, JSON, and the baseline.
+
+**Stable IDs.** A finding's ID must survive the edits that don't concern
+it — lines shifting under an unrelated hunk, a renumbered neighbor — or
+the checked-in baseline would churn on every diff. The ID therefore
+hashes the finding's *content coordinates*, not its line: the code, the
+package-relative path, and the message with volatile numerics (line
+references, counts) normalized out. Identical findings in one file (two
+unguarded reads of the same attribute producing byte-identical messages)
+disambiguate by rank in line order, so the Nth instance keeps the Nth ID.
+
+**Baseline.** ``asyncrl_tpu/analysis/baseline.json`` is the checked-in
+grandfather list: finding IDs that predate the rule that catches them.
+The gate (``scripts/lint.sh``, ``python -m asyncrl_tpu.analysis``) fails
+on any finding NOT in the baseline — new debt never lands — while
+baselined findings are reported as suppressed and burn down explicitly:
+fix one, delete its ID, the stale-entry report keeps the file honest.
+The baseline intentionally holds IDs only plus human-facing context; it
+never silences ANN (grammar/load) errors.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+
+from asyncrl_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+# ANN findings (grammar errors, unparseable files) can never be baselined:
+# a broken declaration must fail the gate today, not burn down someday.
+_UNBASELINEABLE_PREFIX = "ANN"
+
+_NUMERIC = re.compile(r"\d+")
+
+
+def norm_path(path: str) -> str:
+    """Repo-stable form of a finding path: the subpath from the last
+    ``asyncrl_tpu``/``tests``/``scripts`` component when present (the CLI
+    may be invoked with absolute or relative paths — both must produce
+    the same IDs), else the basename."""
+    parts = path.replace(os.sep, "/").split("/")
+    for anchor in ("asyncrl_tpu", "tests", "scripts"):
+        if anchor in parts:
+            # LAST occurrence: a checkout under /home/ci/asyncrl_tpu/
+            # must not anchor on the ancestor directory, or IDs would be
+            # machine-specific and the shared baseline would break.
+            idx = len(parts) - 1 - parts[::-1].index(anchor)
+            return "/".join(parts[idx:])
+    return parts[-1]
+
+
+def _content_key(finding: Finding) -> str:
+    # Normalize numerics out of the message: "line 42", "slot(s) [3]",
+    # and the like shift under unrelated edits; the words identify the
+    # finding, the rank (below) disambiguates true duplicates.
+    msg = _NUMERIC.sub("#", finding.message)
+    return f"{finding.code}|{norm_path(finding.path)}|{msg}"
+
+
+def finding_ids(findings: list[Finding]) -> list[str]:
+    """One stable 12-hex ID per finding, aligned with the input list.
+    Duplicate content keys rank by line order (stable across runs as long
+    as the instances keep their relative order)."""
+    by_key: dict[str, list[int]] = {}
+    for i, f in enumerate(findings):
+        by_key.setdefault(_content_key(f), []).append(i)
+    ids = [""] * len(findings)
+    for key, indices in by_key.items():
+        indices.sort(key=lambda i: (findings[i].line, i))
+        for rank, i in enumerate(indices):
+            digest = hashlib.sha256(
+                f"{key}|{rank}".encode()
+            ).hexdigest()[:12]
+            ids[i] = digest
+    return ids
+
+
+def to_json(
+    findings: list[Finding],
+    stats: dict | None = None,
+    baseline_info: dict | None = None,
+) -> dict:
+    """The ``--format json`` document: findings with IDs, run stats, and
+    what the baseline did. Round-trips through ``json.loads`` by
+    construction (plain dict/list/str/int/float only)."""
+    ids = finding_ids(findings)
+    baselined = set((baseline_info or {}).get("suppressed_ids", ()))
+    return {
+        "schema": 1,
+        "findings": [
+            {
+                "id": fid,
+                "code": f.code,
+                "path": norm_path(f.path),
+                "line": f.line,
+                "message": f.message,
+                "baselined": fid in baselined,
+            }
+            for f, fid in zip(findings, ids)
+        ],
+        "stats": stats or {},
+        "baseline": {
+            k: v
+            for k, v in (baseline_info or {}).items()
+            if k != "suppressed_ids"
+        },
+    }
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def load_baseline(path: str) -> dict[str, dict]:
+    """ID -> context map from a baseline file; {} for a missing file (an
+    absent baseline means "nothing is grandfathered")."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return dict(doc.get("findings", {}))
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    """Snapshot ``findings`` as the new baseline (``--write-baseline``:
+    the explicit grandfathering act; ANN findings are refused)."""
+    ids = finding_ids(findings)
+    entries = {
+        fid: {
+            "code": f.code,
+            "path": norm_path(f.path),
+            "message": f.message,
+        }
+        for f, fid in zip(findings, ids)
+        if not f.code.startswith(_UNBASELINEABLE_PREFIX)
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(
+            {"schema": 1, "findings": entries}, fh, indent=2, sort_keys=True
+        )
+        fh.write("\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[str, dict]
+) -> tuple[list[Finding], dict]:
+    """Split findings against the baseline. Returns ``(gating, info)``:
+    ``gating`` are the findings that must fail the run (not baselined, or
+    un-baselineable ANN errors); ``info`` reports suppressed counts, the
+    suppressed IDs, and stale baseline entries (fixed findings whose IDs
+    should now be deleted from the file — the burn-down signal)."""
+    ids = finding_ids(findings)
+    gating: list[Finding] = []
+    suppressed_ids: list[str] = []
+    for f, fid in zip(findings, ids):
+        if fid in baseline and not f.code.startswith(
+            _UNBASELINEABLE_PREFIX
+        ):
+            suppressed_ids.append(fid)
+        else:
+            gating.append(f)
+    stale = sorted(set(baseline) - set(ids))
+    return gating, {
+        "suppressed": len(suppressed_ids),
+        "suppressed_ids": suppressed_ids,
+        "stale_entries": stale,
+    }

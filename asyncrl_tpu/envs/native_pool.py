@@ -52,6 +52,7 @@ def load_library() -> ctypes.CDLL:
         if not os.path.exists(_LIB_PATH) or (
             os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
         ):
+            # lint: blocking-under-lock-ok(serializing the one-time compiler run IS this lock's job: concurrent first callers must block until the .so exists)
             _build()
         lib = ctypes.CDLL(_LIB_PATH)
         lib.envpool_create.restype = ctypes.c_void_p

@@ -1,0 +1,37 @@
+"""Known-bad fixture: device-contract violations (COL001/002/003).
+
+Mirrors the device half's shapes: a pmap body whose collective names an
+axis nothing binds, a scan body that grows its carry, and traced code
+that reaches for host threading.
+"""
+
+import threading
+
+import jax
+
+
+def grads_body(x):
+    # COL001: axis "model" is bound by no pmap/vmap/shard_map/Mesh here
+    # (the pmap below binds "batch").
+    return jax.lax.psum(x, "model")
+
+
+pmapped = jax.pmap(grads_body, axis_name="batch")
+
+
+def unroll(init, xs):
+    def body(carry, x):
+        state, count = carry
+        # COL002: receives a 2-element carry, returns a 3-element one.
+        return (state, count, x), state
+
+    return jax.lax.scan(body, init, xs)
+
+
+@jax.jit
+def locked_step(x):
+    # COL003 (and PURE001 — two lenses on the same sin): a lock created
+    # under trace exists once, at trace time, then never again.
+    guard = threading.Lock()
+    with guard:
+        return x + 1

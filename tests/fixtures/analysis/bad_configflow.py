@@ -1,0 +1,50 @@
+"""Known-bad fixture: config-contract violations (CFG001/002/003).
+
+A miniature config layer with a typo'd read, a preset keyword naming no
+field, a dead field, and an unregistered ASYNCRL_* env knob.
+"""
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    num_envs: int = 64
+    unroll_len: int = 32
+    # CFG002: declared, never read by anything below.
+    vestigial_knob: float = 0.0
+    # OK: waived with a documented reason.
+    # lint: config-unused-ok(consumed only by the dynamic override parser in this fixture's story)
+    dynamic_only: int = 0
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+def batch_steps(config):
+    # CFG001: typo'd field read (num_env vs num_envs).
+    return config.num_env * config.unroll_len
+
+
+def real_batch_steps(config):
+    # OK: declared-field reads (and what keeps num_envs out of CFG002 —
+    # constructor keywords are writes, not reads).
+    return config.num_envs * config.unroll_len
+
+
+# CFG001: preset keyword naming no declared field.
+preset = Config(num_envs=128, unroll_length=16)
+
+# OK: a declared-field preset.
+small = preset.replace(num_envs=8)
+
+
+def debug_enabled() -> bool:
+    # CFG003: unregistered ASYNCRL_* env var (typo of ASYNCRL_DEBUG_SYNC).
+    return bool(os.environ.get("ASYNCRL_DEBUG_SYNK"))
+
+
+def sanctioned() -> str:
+    # OK: registered knob.
+    return os.environ.get("ASYNCRL_FAULTS", "")

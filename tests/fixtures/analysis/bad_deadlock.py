@@ -1,0 +1,68 @@
+"""Known-bad fixture: deadlock-pass violations (DEAD001/002/003).
+
+Mirrors the host pipeline's shapes: a drain and a supervisor taking the
+same two locks in opposite orders, a condition wait that sleeps on a
+foreign lock, and queue/device blocking inside critical sections.
+"""
+
+import queue
+import subprocess
+import threading
+
+import jax
+
+
+class BadPipeline:
+    def __init__(self):
+        self._sched = threading.Lock()
+        self._ledger = threading.Lock()
+        self._cond = threading.Condition()
+        self._queue = queue.Queue(maxsize=4)
+
+    def drain(self):
+        # DEAD001 half: sched -> ledger.
+        with self._sched:
+            with self._ledger:
+                pass
+
+    def supervise(self):
+        # DEAD001 other half: ledger -> sched (opposite order = cycle).
+        with self._ledger:
+            with self._sched:
+                pass
+
+    def handoff(self):
+        # DEAD002: wait_for sleeps holding _sched; the wait releases only
+        # _cond, so _sched is pinned for the whole sleep.
+        with self._sched:
+            with self._cond:
+                self._cond.wait_for(lambda: True)
+
+    def publish(self, item):
+        # DEAD003: queue.put with no timeout inside a lock region.
+        with self._sched:
+            self._queue.put(item)
+
+    def snapshot(self, arr):
+        # DEAD003: a device sync inside a lock region.
+        with self._ledger:
+            return jax.device_get(arr)
+
+    def rebuild(self):
+        # DEAD003 (interprocedural): the callee blocks in subprocess.
+        with self._sched:
+            self._compile()
+
+    def _compile(self):
+        subprocess.run(["true"])
+
+    def bounded_put(self, item):
+        # OK: bounded wait — backpressure, not deadlock.
+        with self._sched:
+            self._queue.put(item, timeout=0.1)
+
+    def sanctioned(self, item):
+        # OK: waived with a reason (the Condition hand-off idiom).
+        with self._sched:
+            # lint: blocking-under-lock-ok(hand-off fixture: the producer owns the queue slot until the consumer acks)
+            self._queue.put(item)

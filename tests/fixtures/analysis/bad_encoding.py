@@ -1,0 +1,3 @@
+"""Fixture: non-UTF-8 bytes (ANN011)."""
+# café = "café"
+X = 1

@@ -1,0 +1,5 @@
+"""Known-bad fixture: does not parse (ANN012) — the analyzer must report
+it and keep analyzing the rest of the run."""
+
+def broken(:
+    return 1

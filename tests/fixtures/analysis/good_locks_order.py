@@ -1,0 +1,31 @@
+"""Known-GOOD fixture: two locks under a consistent a-before-b order.
+
+The deadlock-pass detection proof (tests/test_analysis.py) deletes one
+``with self._a:`` nesting edge from a method below — the exact edit a
+careless refactor would make — which turns ``_reenter_a``'s reentrant
+re-acquisition into a real b-before-a edge and must trip DEAD001. The
+pristine file must stay clean: every acquisition respects the order, and
+the re-acquisition is reentrant (RLock) on every call path.
+"""
+
+import threading
+
+
+class OrderedPair:
+    def __init__(self):
+        self._a = threading.RLock()
+        self._b = threading.RLock()
+
+    def drain(self):
+        with self._a:
+            with self._b:
+                self._reenter_a()
+
+    def supervise(self):
+        with self._a:
+            with self._b:
+                self._reenter_a()
+
+    def _reenter_a(self):
+        with self._a:
+            pass
