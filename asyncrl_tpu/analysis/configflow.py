@@ -48,6 +48,10 @@ KNOWN_ENV_VARS = {
     "ASYNCRL_SMOKE_UPDATES",  # scripts/perf_smoke harness sizing
     "ASYNCRL_SMOKE_TOLERANCE",  # scripts/perf_smoke pass threshold
     "ASYNCRL_CHAOS_STEPS",    # scripts/chaos_smoke.sh harness sizing
+    "ASYNCRL_TRACE",          # obs/trace.py — arm pipeline tracing
+    "ASYNCRL_TRACE_RING",     # obs/trace.py — per-thread ring capacity
+    "ASYNCRL_RUN_DIR",        # obs/__init__.py — observability output dir
+    "ASYNCRL_TRACE_TOLERANCE",  # scripts/trace_smoke.sh overhead threshold
 }
 
 _CONFIG_NAMES = {"config", "cfg"}

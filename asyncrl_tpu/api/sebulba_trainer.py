@@ -31,6 +31,11 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from asyncrl_tpu import obs
+from asyncrl_tpu.obs import flightrec
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
 from asyncrl_tpu.learn.learner import (
     validate_ppo_geometry,
     validate_train_target,
@@ -183,6 +188,11 @@ class SebulbaTrainer:
                     or staging.auto_num_slabs(cap, config.actor_threads, K)
                 ),
             )
+        # Observability (asyncrl_tpu/obs/): arms span tracing + the
+        # flight recorder per config.trace (ASYNCRL_TRACE wins), resets
+        # the counters/histograms registry; the window aggregation and
+        # close() drive the returned handle.
+        self._obs = obs.setup(config)
         # §5.2b debug mode: transport invariants on drained fragments.
         from asyncrl_tpu.utils.debug import sync_debug_enabled
 
@@ -367,7 +377,13 @@ class SebulbaTrainer:
                 if isinstance(err, InvariantViolation):
                     # §5.2b failures are integrity bugs, not transient actor
                     # faults: abort NOW instead of churning restarts (even
-                    # when reported by an already-replaced generation).
+                    # when reported by an already-replaced generation). The
+                    # one abort class that means a REAL pipeline bug gets
+                    # forensics like every other failure path.
+                    flightrec.record(
+                        "supervisor.invariant_abort",
+                        detail=f"actor {index} gen {gen}: {err!r}",
+                    )
                     self.stop()
                     raise err
                 if gen != self._actor_gens[index]:
@@ -393,6 +409,13 @@ class SebulbaTrainer:
         stamps.append(now)
         stamps[:] = [t for t in stamps if now - t < self._RESTART_WINDOW_S]
         if len(stamps) > threshold:
+            # Last forensics before the abort: the flight recorder gets
+            # the final seconds of every thread's spans (no-op unarmed).
+            flightrec.record(
+                "supervisor.storm_abort",
+                detail=f"{what}: {len(stamps)} restarts in "
+                f"{self._RESTART_WINDOW_S}s (cause: {cause!r})",
+            )
             self.stop()
             raise RuntimeError(
                 f"{what} failed repeatedly ({len(stamps)} restarts in "
@@ -402,6 +425,13 @@ class SebulbaTrainer:
     def _restart_actor(self, index: int, err: BaseException | None) -> None:
         """Retire actor ``index`` (already dead or abandoned) and spawn its
         replacement, aborting on a restart storm."""
+        # Forensics FIRST, replacement second: the dump captures every
+        # thread's spans as they were when the failure was detected
+        # (crash or watchdog retirement alike). No-op when unarmed.
+        flightrec.record(
+            "supervisor.actor_restart",
+            detail=f"actor {index} gen {self._actor_gens[index]}: {err!r}",
+        )
         self._actor_restarts += 1
         self._storm_guard(
             self._recent_restarts, 3 * self.config.actor_threads,
@@ -468,6 +498,9 @@ class SebulbaTrainer:
 
         fatal = server._fatal
         if isinstance(fatal, InvariantViolation):
+            flightrec.record(
+                "supervisor.invariant_abort", detail=f"server: {fatal!r}"
+            )
             self.stop()
             raise fatal
         hung = (
@@ -485,8 +518,15 @@ class SebulbaTrainer:
         # rebuild instead of the abort the policy promises.
         fatal = server._fatal or fatal
         if isinstance(fatal, InvariantViolation):
+            flightrec.record(
+                "supervisor.invariant_abort", detail=f"server: {fatal!r}"
+            )
             self.stop()
             raise fatal
+        flightrec.record(
+            "supervisor.server_restart",
+            detail=f"hung={hung}: {fatal!r}",
+        )
         self._server_restarts += 1
         # The actor storm rule at one instance: > 3 in the window aborts.
         self._storm_guard(
@@ -602,6 +642,9 @@ class SebulbaTrainer:
         steps_per_fragment = self._envs_per_actor * cfg.unroll_len
         history: list[dict[str, Any]] = []
 
+        # The drain usually runs on MainThread — tag its span ring with
+        # the pipeline-stage group so reports/flight dumps say "learner".
+        trace.tag_thread("learner")
         self._start_actors()
         pending: list[dict[str, jax.Array]] = []
         ret_sum = len_sum = count = lag_sum = 0.0
@@ -631,7 +674,8 @@ class SebulbaTrainer:
                 self._supervise()
                 t_wait = time.perf_counter()
                 try:
-                    fragment = self._queue.get(timeout=1.0)
+                    with trace.span(span_names.LEARNER_QUEUE_WAIT):
+                        fragment = self._queue.get(timeout=1.0)
                 except queue.Empty:
                     stall_s += time.perf_counter() - t_wait
                     continue
@@ -690,15 +734,24 @@ class SebulbaTrainer:
                         ),
                     )
                 t_put = time.perf_counter()
-                rollout_d = self.learner.put_rollout(rollout)
-                if ring is not None:
-                    # Transfer barrier: wait for slab i+1's H2D to finish
-                    # BEFORE dispatching its update — this wait runs while
-                    # the PREVIOUS update still computes on device, so
-                    # transfer time hides behind compute and h2d_wait_s
-                    # records only the part that didn't fit under it.
-                    jax.block_until_ready(rollout_d)
-                h2d_wait_s += time.perf_counter() - t_put
+                with trace.span(span_names.LEARNER_H2D_WAIT):
+                    rollout_d = self.learner.put_rollout(rollout)
+                    if ring is not None:
+                        # Transfer barrier: wait for slab i+1's H2D to
+                        # finish BEFORE dispatching its update — this wait
+                        # runs while the PREVIOUS update still computes on
+                        # device, so transfer time hides behind compute
+                        # and h2d_wait_s records only the part that didn't
+                        # fit under it.
+                        jax.block_until_ready(rollout_d)
+                h2d_wait = time.perf_counter() - t_put
+                h2d_wait_s += h2d_wait
+                # Registry histogram (obs/registry.py): the per-update
+                # unhidden-transfer distribution — p50/p95/max surface in
+                # the window next to the legacy h2d_wait_s sum.
+                obs_registry.histogram("h2d_wait_ms").observe(
+                    1e3 * h2d_wait
+                )
                 # Slab batches are constant-sized (precomputed); only the
                 # legacy stack path needs the per-update leaf walk.
                 h2d_bytes += (
@@ -758,7 +811,8 @@ class SebulbaTrainer:
                 self._ckpt.after_update(self.state, self.env_steps)
 
                 if len(pending) >= cfg.log_every or self.env_steps >= target:
-                    drained = jax.device_get(pending)
+                    with trace.span(span_names.LEARNER_METRICS):
+                        drained = jax.device_get(pending)
                     pending = []
                     elapsed = time.perf_counter() - window_start
                     window_start = time.perf_counter()
@@ -796,6 +850,11 @@ class SebulbaTrainer:
                         agg["slab_reuse_waits"] = ring.reuse_waits
                     agg.update(self._infer_coalesce_window())
                     agg.update(faults.counters())
+                    # Counters/histograms registry + trace stats
+                    # (obs/__init__.py): every instrument any subsystem
+                    # registered drains here — new metrics need no
+                    # bespoke trainer plumbing.
+                    agg.update(self._obs.window())
                     ret_sum = len_sum = count = lag_sum = 0.0
                     window_steps = 0
                     stall_s = h2d_wait_s = 0.0
@@ -815,9 +874,10 @@ class SebulbaTrainer:
                         >= cfg.eval_every * K
                     ):
                         updates_at_eval = self._updates
-                        agg["eval_return"] = self.evaluate(
-                            num_episodes=cfg.eval_episodes
-                        )
+                        with trace.span(span_names.LEARNER_EVAL):
+                            agg["eval_return"] = self.evaluate(
+                                num_episodes=cfg.eval_episodes
+                            )
                         self._ckpt.maybe_save_best(
                             self.state, self.env_steps, agg["eval_return"]
                         )
@@ -829,6 +889,11 @@ class SebulbaTrainer:
             # A crash (including the §5.3 actor crash-loop abort) must not
             # lose progress: save final state and flush async writes.
             self._ckpt.finalize(self.state, self.env_steps)
+            # Flush any flight dumps still queued on the writer thread.
+            # (The Perfetto export happens ONCE, in close(): exporting
+            # per train() call would tax the measured hot path, and
+            # crash-time forensics are the flight recorder's job.)
+            self._obs.close()
         return history
 
     def save_checkpoint(self) -> None:
@@ -842,6 +907,10 @@ class SebulbaTrainer:
             _close(pool)
         self._eval_pools = {}
         self._ckpt.close()
+        # Perfetto export of everything the rings still hold (the whole
+        # run's tail, all threads), then flush the flight recorder.
+        self._obs.export_trace()
+        self._obs.close()
 
     # ----------------------------------------------------------------- eval
 

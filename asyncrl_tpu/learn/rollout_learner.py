@@ -36,6 +36,8 @@ from asyncrl_tpu.learn.learner import (
     validate_recurrent_config,
 )
 from asyncrl_tpu.models.networks import is_recurrent
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.ops.losses import (
     a3c_loss,
@@ -494,9 +496,17 @@ class RolloutLearner:
     # --------------------------------------------------------------- update
 
     def put_rollout(self, rollout: Rollout) -> Rollout:
-        """Transfer a host (numpy) fragment to the mesh, batch-sharded."""
-        return jax.device_put(rollout, self._rollout_sharding)
+        """Transfer a host (numpy) fragment to the mesh, batch-sharded.
+
+        The span is the DISPATCH cost only (device_put is async); the
+        unhidden transfer time shows up in the trainer's
+        ``learner.h2d_wait`` span around its explicit barrier."""
+        with trace.span(span_names.LEARNER_H2D):
+            return jax.device_put(rollout, self._rollout_sharding)
 
     def update(self, state: LearnerState, rollout: Rollout):
-        """One gradient step on a device-resident fragment."""
-        return self._step(state, rollout)
+        """One gradient step on a device-resident fragment. The span
+        covers the jitted dispatch (plus, on the CPU backend where
+        dispatch is effectively synchronous, the compute itself)."""
+        with trace.span(span_names.LEARNER_UPDATE):
+            return self._step(state, rollout)

@@ -1,0 +1,135 @@
+"""``asyncrl_tpu.obs``: pipeline tracing, metrics registry, flight recorder.
+
+The observability subsystem for the async host path (ISSUE 5):
+
+- :mod:`asyncrl_tpu.obs.trace` — per-thread lock-free span rings behind
+  ``trace.span("actor.env_step")`` context managers (near-zero cost when
+  disabled).
+- :mod:`asyncrl_tpu.obs.spans` — the span taxonomy + wait/compute
+  classification + stall causes.
+- :mod:`asyncrl_tpu.obs.registry` — the counters/histograms registry the
+  metric window sinks drain from.
+- :mod:`asyncrl_tpu.obs.export` — Chrome/Perfetto ``trace_event`` JSON
+  export and its schema validator.
+- :mod:`asyncrl_tpu.obs.report` — per-stage time shares, wait-vs-compute
+  breakdown, stall attribution (the ``python -m asyncrl_tpu.obs report``
+  CLI).
+- :mod:`asyncrl_tpu.obs.flightrec` — crash-time span/counter dumps to
+  ``runs/<run>/flightrec-*.json``.
+
+:func:`setup` is the trainer-facing entry point: it arms tracing and the
+flight recorder per ``config.trace`` (``ASYNCRL_TRACE`` wins when set,
+mirroring ``utils.faults``) and returns the handle the trainer's window
+aggregation and teardown drive.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+
+from asyncrl_tpu.obs import export, flightrec, registry, trace
+
+# Process-wide export sequence: two agents sharing a run_dir (A/B
+# harnesses) must never overwrite each other's same-second export.
+# lint: thread-shared-ok(itertools.count.__next__ is GIL-atomic)
+_EXPORT_SEQ = itertools.count(1)
+
+__all__ = [
+    "PipelineObs", "setup", "export", "flightrec", "registry", "trace",
+]
+
+
+def _default_run_dir(config) -> str:
+    slug = "".join(
+        ch if ch.isalnum() else "-" for ch in str(config.env_id)
+    ).strip("-").lower()
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    return os.path.join(
+        "runs", f"{slug}-{config.algo}-s{config.seed}-{stamp}-{os.getpid()}"
+    )
+
+
+class PipelineObs:
+    """One trainer's observability handle (always constructed; inert when
+    tracing is disabled — ``window()`` still drains the registry, which is
+    the one metrics path that runs unconditionally). The handle holds THE
+    tracer/recorder its setup armed: a later trainer re-arming the globals
+    must never redirect this trainer's export or stats to its own rings."""
+
+    def __init__(self, enabled: bool, run_dir: str | None, recorder,
+                 tracer=None):
+        self.enabled = enabled
+        self.run_dir = run_dir
+        self._recorder = recorder
+        self._tracer = tracer
+
+    def window(self) -> dict[str, float]:
+        """Counters/histograms + this trainer's trace stats for one
+        metrics window."""
+        out = registry.window()
+        if self._tracer is not None:
+            out.update(self._tracer.stats())
+        return out
+
+    def export_trace(self) -> str | None:
+        """Write THIS trainer's rings as a Perfetto export into the run
+        dir (None when tracing is off); called from close()."""
+        if not self.enabled or self.run_dir is None or self._tracer is None:
+            return None
+        seq = next(_EXPORT_SEQ)
+        # stamp + pid + per-process seq: unique across agents in one
+        # process AND across processes sharing a run_dir.
+        path = os.path.join(
+            self.run_dir,
+            f"trace-{time.strftime('%Y%m%d-%H%M%S')}"
+            f"-{os.getpid()}-{seq:03d}.json",
+        )
+        doc = export.to_trace_events(
+            self._tracer.snapshots(),
+            self._tracer.anchor_perf,
+            self._tracer.anchor_unix,
+        )
+        return export.write_document(doc, path)
+
+    def close(self) -> None:
+        """Flush this trainer's flight recorder (only if it is still the
+        armed one — a newer trainer's recorder is not ours to close)."""
+        if self._recorder is not None and flightrec.active() is self._recorder:
+            self._recorder.drain()
+
+
+def setup(config) -> PipelineObs:
+    """Arm tracing + flight recorder for a trainer, per config/env.
+
+    ``ASYNCRL_TRACE`` (when present) wins over ``config.trace`` — the
+    no-code-change knob, exactly the ``ASYNCRL_FAULTS`` precedence. The
+    registry resets so a fresh agent never reports a predecessor's
+    counters (same semantics as re-arming faults).
+    """
+    registry.registry().reset()
+    env = trace.env_requests()
+    enabled = bool(config.trace) if env is None else env
+    # Always RE-ARM (even under env arming): a fresh agent gets fresh
+    # rings — its export/dumps/stats must never include a predecessor's
+    # spans. Env arming keeps the env's ring capacity; config arming
+    # uses config.trace_ring.
+    tracer = trace.configure(
+        enabled, capacity=config.trace_ring if env is None else None
+    )
+    if not enabled:
+        # Disarm any predecessor's flight recorder too: a trace=False
+        # agent must never dump forensics into an OLD agent's run_dir
+        # with the old agent's config embedded (faults.arm("") precedent).
+        flightrec.disarm()
+        return PipelineObs(False, None, None)
+    run_dir = (
+        os.environ.get("ASYNCRL_RUN_DIR")
+        or config.run_dir
+        or _default_run_dir(config)
+    )
+    recorder = flightrec.arm(
+        run_dir, window_s=config.trace_window_s, config=config
+    )
+    return PipelineObs(True, run_dir, recorder, tracer=tracer)

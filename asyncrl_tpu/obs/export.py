@@ -1,0 +1,149 @@
+"""Chrome/Perfetto ``trace_event`` JSON export of the span rings.
+
+The output is the JSON Object Format the Trace Event spec defines (and
+Perfetto's UI at https://ui.perfetto.dev opens directly): complete-span
+``"X"`` events with microsecond ``ts``/``dur``, one ``"M"``
+``thread_name`` metadata event per ring, span stage as ``cat``. The same
+schema is what :func:`validate_trace` checks — ``scripts/trace_smoke.sh``
+gates on it, so the exporter and the validator live side by side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
+
+SCHEMA = "asyncrl-trace-v1"
+
+
+def to_trace_events(
+    snapshots: list[dict[str, Any]],
+    anchor_perf: float,
+    anchor_unix: float,
+) -> dict[str, Any]:
+    """Snapshot list -> the Perfetto-loadable trace document."""
+    pid = os.getpid()
+    events: list[dict[str, Any]] = []
+    for tid, snap in enumerate(snapshots, start=1):
+        events.append({
+            "ph": "M",
+            "name": "thread_name",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": snap["thread"], "group": snap["group"]},
+        })
+        for name, start, end in snap["spans"]:
+            events.append({
+                "ph": "X",
+                "name": name,
+                "cat": span_names.stage_of(name),
+                "pid": pid,
+                "tid": tid,
+                "ts": max(0.0, (start - anchor_perf) * 1e6),
+                "dur": max(0.0, (end - start) * 1e6),
+            })
+    return {
+        "schema": SCHEMA,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "anchor_unix": anchor_unix,
+            "threads": [
+                {
+                    "thread": s["thread"],
+                    "group": s["group"],
+                    "recorded": s["recorded"],
+                    "dropped": s["dropped"],
+                }
+                for s in snapshots
+            ],
+        },
+        "traceEvents": events,
+    }
+
+
+def export_document() -> dict[str, Any] | None:
+    """The armed tracer's current trace document (None when disabled)."""
+    tracer = trace.active()
+    if tracer is None:
+        return None
+    return to_trace_events(
+        tracer.snapshots(), tracer.anchor_perf, tracer.anchor_unix
+    )
+
+
+def write_document(doc: dict[str, Any], path: str) -> str:
+    """Serialize a trace document to ``path`` (created dirs included)."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def write_trace(path: str) -> str | None:
+    """Export the armed tracer to ``path``; returns the path, or None
+    when tracing is disabled."""
+    doc = export_document()
+    if doc is None:
+        return None
+    return write_document(doc, path)
+
+
+def validate_trace(
+    doc: dict[str, Any], require_spans: bool = True
+) -> list[str]:
+    """Schema check for an exported trace document; returns the list of
+    violations (empty = valid). One shared home: the exporter above and
+    ``scripts/trace_smoke.sh``'s gate can never drift.
+
+    ``require_spans=False`` accepts a span-less document: a flight dump
+    whose lookback window was quiet (the pipeline wedged outside any
+    instrumented stage) is correctly recorded, not malformed — only a
+    full run export with zero spans indicates broken instrumentation."""
+    errors: list[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if doc.get("schema") != SCHEMA:
+        errors.append(f"schema is {doc.get('schema')!r}, expected {SCHEMA!r}")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or (not events and require_spans):
+        return errors + ["traceEvents missing or empty"]
+    thread_meta = 0
+    span_events = 0
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph == "M":
+            thread_meta += 1
+            if ev.get("name") != "thread_name" or "name" not in ev.get(
+                "args", {}
+            ):
+                errors.append(f"{where}: malformed thread_name metadata")
+            continue
+        if ph != "X":
+            errors.append(f"{where}: ph={ph!r}, expected 'X' or 'M'")
+            continue
+        span_events += 1
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errors.append(f"{where}: missing span name")
+        for field in ("ts", "dur"):
+            value = ev.get(field)
+            if not isinstance(value, (int, float)) or value < 0:
+                errors.append(f"{where}: {field}={value!r} not a number >= 0")
+        for field in ("pid", "tid"):
+            if not isinstance(ev.get(field), int):
+                errors.append(f"{where}: {field} missing or not an int")
+    if require_spans:
+        if thread_meta == 0:
+            errors.append("no thread_name metadata events")
+        if span_events == 0:
+            errors.append("no span ('X') events")
+    return errors

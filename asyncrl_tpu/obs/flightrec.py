@@ -1,0 +1,247 @@
+"""Flight recorder: crash-time forensics for the async host path.
+
+On any fault, watchdog retirement, or supervisor restart, the pipeline's
+last seconds — every thread's recent spans, the counters registry, the
+fault counters, and the run config — dump to
+``<run_dir>/flightrec-<seq>-<reason>.json``. The snapshot is taken on the
+*reporting* thread at the moment of the event (so it is the state AT the
+fault); serialization and disk I/O happen on a dedicated daemon writer
+thread (``flightrec-writer``), so a dump never adds latency to the
+supervisor's recovery path.
+
+Debounce: at most one dump per reason per ``min_interval_s`` — a crash
+storm produces a bounded number of files plus a ``flightrec_suppressed``
+counter, never a disk flood. The dump's ``trace`` section is a regular
+``obs.export`` trace document (filtered to the last ``window_s``), so
+``python -m asyncrl_tpu.obs report flightrec-*.json`` and Perfetto both
+open it.
+
+Arming is explicit (``obs.setup`` arms it alongside tracing); the module
+-level :func:`record` is a cheap no-op when unarmed, which is what the
+``utils.faults`` and supervisor call sites rely on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import re
+import threading
+import time
+from typing import Any
+
+from asyncrl_tpu.obs import export, registry, trace
+
+SCHEMA = "asyncrl-flightrec-v1"
+
+_STOP = object()
+_SAFE_REASON = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+class FlightRecorder:
+    """One run's dump sink. Thread-safe: any thread may ``record``."""
+
+    def __init__(
+        self,
+        out_dir: str,
+        window_s: float = 10.0,
+        min_interval_s: float = 2.0,
+        config: Any = None,
+    ):
+        self.out_dir = out_dir
+        self.window_s = window_s
+        self.min_interval_s = min_interval_s
+        self._config = _config_dict(config)
+        self._lock = threading.Lock()
+        self._last: dict[str, float] = {}  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._pending = 0  # guarded-by: _lock
+        self._thread: threading.Thread | None = None  # guarded-by: _lock
+        # lint: thread-shared-ok(queue.Queue is internally synchronized; the reference itself is never rebound)
+        self._q: "queue.Queue[Any]" = queue.Queue()
+        self.paths: list[str] = []  # guarded-by: _lock
+
+    def record(
+        self, reason: str, detail: str = "", extra: dict | None = None
+    ) -> bool:
+        """Snapshot now, enqueue the dump. Returns False when debounced."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._last.get(reason)
+            if last is not None and now - last < self.min_interval_s:
+                suppressed = True
+            else:
+                suppressed = False
+                self._last[reason] = now
+                self._seq += 1
+                seq = self._seq
+                self._pending += 1
+        if suppressed:
+            registry.counter("flightrec_suppressed").inc()
+            return False
+        registry.counter("flightrec_dumps").inc()
+        tracer = trace.active()
+        doc = {
+            "schema": SCHEMA,
+            "reason": reason,
+            "detail": detail,
+            "ts_unix": time.time(),
+            "pid": os.getpid(),
+            "window_s": self.window_s,
+            "thread": threading.current_thread().name,
+            "config": self._config,
+            "counters": _all_counters(),
+            "extra": extra or {},
+        }
+        if tracer is not None:
+            cutoff = time.perf_counter() - self.window_s
+            snaps = tracer.snapshots()
+            for snap in snaps:
+                snap["spans"] = [
+                    s for s in snap["spans"] if s[2] >= cutoff
+                ]
+            doc["thread_groups"] = sorted(
+                {s["group"] for s in snaps if s["spans"]}
+            )
+            doc["trace"] = export.to_trace_events(
+                snaps, tracer.anchor_perf, tracer.anchor_unix
+            )
+        else:
+            doc["thread_groups"] = []
+            doc["trace"] = None
+        self._ensure_writer()
+        self._q.put_nowait((seq, reason, doc))
+        return True
+
+    def _ensure_writer(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._writer, name="flightrec-writer", daemon=True
+            )
+            self._thread.start()
+
+    def _writer(self) -> None:  # thread-entry: flightrec-writer@flightrec
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            seq, reason, doc = item
+            try:
+                path = self._write(seq, reason, doc)
+                with self._lock:
+                    self.paths.append(path)
+            # lint: broad-except-ok(best-effort forensics: a full disk or unwritable run dir must never take down the writer, let alone the pipeline)
+            except Exception as e:
+                registry.counter("flightrec_write_errors").inc()
+                print(f"flightrec: dump failed: {e}", flush=True)
+            finally:
+                with self._lock:
+                    self._pending -= 1
+
+    def _write(self, seq: int, reason: str, doc: dict) -> str:
+        os.makedirs(self.out_dir, exist_ok=True)
+        slug = _SAFE_REASON.sub("-", reason)[:64] or "event"
+        # pid in the name: two processes sharing a run_dir both start
+        # their seq at 1 — forensics must never overwrite each other.
+        path = os.path.join(
+            self.out_dir,
+            f"flightrec-{os.getpid()}-{seq:03d}-{slug}.json",
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Block until every enqueued dump is on disk (tests; shutdown)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._pending == 0:
+                    return True
+            time.sleep(0.01)
+        with self._lock:
+            return self._pending == 0
+
+    def close(self) -> None:
+        """Flush pending dumps and stop the writer thread."""
+        self.drain()
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+        if thread is not None and thread.is_alive():
+            self._q.put_nowait(_STOP)
+            thread.join(timeout=2.0)
+
+
+def _config_dict(config: Any) -> dict | None:
+    """A JSON-dumpable view of the run config (json serializes tuples as
+    arrays on its own, so plain ``asdict`` suffices)."""
+    if config is None:
+        return None
+    if isinstance(config, dict):
+        return config
+    import dataclasses
+
+    if dataclasses.is_dataclass(config):
+        return dataclasses.asdict(config)
+    return {"repr": repr(config)}
+
+
+def _all_counters() -> dict[str, float]:
+    """Registry + fault + trace counters, one flat dict (the same keys
+    the metrics window carries, so forensics and JSONL line up)."""
+    from asyncrl_tpu.utils import faults
+
+    out: dict[str, float] = {}
+    out.update(registry.window())
+    out.update(faults.counters())
+    out.update(trace.stats())
+    return out
+
+
+_ARM_LOCK = threading.Lock()
+# lint: thread-shared-ok(single reference swap under _ARM_LOCK; lock-free readers see None or a fully-constructed recorder)
+_RECORDER: FlightRecorder | None = None
+
+
+def arm(
+    out_dir: str,
+    window_s: float = 10.0,
+    min_interval_s: float = 2.0,
+    config: Any = None,
+) -> FlightRecorder:
+    """Arm the process-wide recorder (replacing any previous one)."""
+    global _RECORDER
+    with _ARM_LOCK:
+        old, _RECORDER = _RECORDER, FlightRecorder(
+            out_dir, window_s=window_s, min_interval_s=min_interval_s,
+            config=config,
+        )
+    if old is not None:
+        old.close()
+    return _RECORDER
+
+
+def disarm() -> None:
+    global _RECORDER
+    with _ARM_LOCK:
+        old, _RECORDER = _RECORDER, None
+    if old is not None:
+        old.close()
+
+
+def active() -> FlightRecorder | None:
+    return _RECORDER
+
+
+def record(reason: str, detail: str = "", extra: dict | None = None) -> bool:
+    """The call-site entry point (faults, supervisor): no-op when unarmed."""
+    recorder = _RECORDER
+    if recorder is None:
+        return False
+    return recorder.record(reason, detail=detail, extra=extra)

@@ -1,0 +1,167 @@
+"""Counters/histograms registry the metric window sinks drain from.
+
+Before this module every pipeline metric was hand-plumbed: an attribute on
+the owning object, a snapshot field on the trainer, and a bespoke line in
+the window-aggregation block (``h2d_wait_s``, ``slab_reuse_waits``,
+``infer_coalesce_batch``, ``faults.counters()`` — each with its own
+delta/cumulative convention). This registry is the common sink for new
+instrumentation: any thread increments named counters or observes into
+named histograms; the trainer's window close calls :func:`window` once and
+merges the result next to the legacy keys; the flight recorder dumps
+:func:`dump` wholesale. Legacy metrics keep their existing keys (nothing
+breaks downstream greps) — they are not migrated, new ones simply stop
+needing trainer plumbing.
+
+Counters are cumulative (like ``actor_restarts``); histograms export
+``<name>_p50`` / ``<name>_p95`` / ``<name>_max`` / ``<name>_count``
+summaries over everything observed so far. Thread-safety: one registry
+lock around the name->instrument map; each instrument carries its own
+lock (observations are per-update/per-event, not per-env-frame — never a
+hot-path cost).
+"""
+
+from __future__ import annotations
+
+import threading
+
+# Log-spaced default bucket upper bounds (milliseconds-friendly: spans
+# from 10µs to ~2 minutes when observations are in seconds ×1e3).
+_DEFAULT_BUCKETS = tuple(
+    round(base * 10.0 ** exp, 6)
+    for exp in range(-2, 6)
+    for base in (1.0, 2.5, 5.0)
+)
+
+
+class Counter:
+    """A named cumulative counter (monotone under normal use)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0  # guarded-by: _lock
+
+    def inc(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._value += delta
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with quantile summaries.
+
+    Quantiles come from bucket upper bounds (the Prometheus estimate):
+    exact enough for stall diagnosis, allocation-free in steady state.
+    """
+
+    def __init__(self, name: str, buckets: tuple[float, ...] = _DEFAULT_BUCKETS):
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError(f"histogram buckets must be sorted: {buckets!r}")
+        self.name = name
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(buckets) + 1)  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._max = 0.0  # guarded-by: _lock
+
+    def observe(self, value: float) -> None:
+        i = 0
+        for i, bound in enumerate(self.buckets):  # noqa: B007
+            if value <= bound:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += value
+            if value > self._max:
+                self._max = value
+
+    def _quantile_locked(self, q: float) -> float:  # holds: _lock
+        if self._count == 0:
+            return 0.0
+        rank = q * self._count
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank:
+                return (
+                    self.buckets[i] if i < len(self.buckets) else self._max
+                )
+        return self._max
+
+    def summary(self) -> dict[str, float]:
+        with self._lock:
+            return {
+                f"{self.name}_count": float(self._count),
+                f"{self.name}_p50": self._quantile_locked(0.50),
+                f"{self.name}_p95": self._quantile_locked(0.95),
+                f"{self.name}_max": self._max,
+            }
+
+
+class Registry:
+    """Name -> instrument map. One process-wide instance (module level)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}  # guarded-by: _lock
+        self._histograms: dict[str, Histogram] = {}  # guarded-by: _lock
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    def window(self) -> dict[str, float]:
+        """The metrics-window view: every counter value and histogram
+        summary, flat-keyed — what the trainer merges into each window."""
+        with self._lock:
+            counters = list(self._counters.values())
+            histograms = list(self._histograms.values())
+        out: dict[str, float] = {}
+        for c in counters:
+            out[c.name] = c.value()
+        for h in histograms:
+            out.update(h.summary())
+        return out
+
+    def reset(self) -> None:
+        """Drop every instrument (tests; a fresh trainer's obs setup)."""
+        with self._lock:
+            self._counters.clear()
+            self._histograms.clear()
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    """The process-wide registry."""
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _REGISTRY.histogram(name)
+
+
+def window() -> dict[str, float]:
+    return _REGISTRY.window()

@@ -1,0 +1,205 @@
+"""Trace analysis: per-stage time shares, wait-vs-compute, stall attribution.
+
+Consumes the ``trace_event`` document ``obs.export`` writes (or the
+``trace`` section of a flight-recorder dump) and computes, per thread
+group:
+
+- **self time** per span name (nested spans subtract their children, so
+  ``actor.lease_wait`` and the ``staging.reuse_wait`` inside it never
+  double-count a second);
+- the **wait vs compute** split (``obs.spans.is_wait``);
+- a **stall-attribution table**: what fraction of each group's wall time
+  each wait span owns, with the taxonomy's causal reading
+  (``obs.spans.WAIT_CAUSES``) — the "learner idle 34% waiting on staging
+  slab reuse" line the ISSUE asks for.
+
+Spans within one thread are properly nested (context managers unwind
+LIFO), so a single stack pass per thread attributes self time exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from asyncrl_tpu.obs import spans as span_names
+
+_EPS_US = 1e-3  # float slack when testing span containment (µs)
+
+
+@dataclasses.dataclass
+class StageStat:
+    name: str
+    group: str
+    count: int = 0
+    total_us: float = 0.0  # full durations (mean span cost)
+    self_us: float = 0.0   # minus child spans (time-share accounting)
+
+    @property
+    def is_wait(self) -> bool:
+        return span_names.is_wait(self.name)
+
+
+@dataclasses.dataclass
+class GroupStat:
+    group: str
+    threads: int = 0
+    wall_us: float = 0.0
+    busy_us: float = 0.0
+
+    @property
+    def idle_us(self) -> float:
+        return max(0.0, self.wall_us - self.busy_us)
+
+
+def _thread_events(doc: dict[str, Any]):
+    """tid -> (thread_name, group, [(ts, dur, name), ...])."""
+    threads: dict[int, tuple[str, str]] = {}
+    events: dict[int, list[tuple[float, float, str]]] = {}
+    for ev in doc.get("traceEvents", []):
+        tid = ev.get("tid", 0)
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            args = ev.get("args", {})
+            threads[tid] = (
+                args.get("name", f"tid-{tid}"),
+                args.get("group", f"tid-{tid}"),
+            )
+        elif ev.get("ph") == "X":
+            # Tolerate truncated/hand-edited documents: an event missing
+            # its fields is skipped, not a raw KeyError traceback (the
+            # validate subcommand names the exact violation).
+            ts, dur, name = ev.get("ts"), ev.get("dur"), ev.get("name")
+            if ts is None or dur is None or not name:
+                continue
+            events.setdefault(tid, []).append((float(ts), float(dur), name))
+    out = {}
+    for tid, evs in events.items():
+        name, group = threads.get(tid, (f"tid-{tid}", f"tid-{tid}"))
+        out[tid] = (name, group, sorted(evs, key=lambda e: (e[0], -e[1])))
+    return out
+
+
+def _self_times(evs: list[tuple[float, float, str]]):
+    """Stack pass over one thread's sorted events: per-span self time.
+
+    Yields (name, dur_us, self_us)."""
+    stack: list[list] = []  # [ts, end, name, child_us]
+    for ts, dur, name in evs:
+        end = ts + dur
+        while stack and stack[-1][1] <= ts + _EPS_US:
+            yield _pop(stack)
+        if stack and ts >= stack[-1][0] - _EPS_US and end <= stack[-1][1] + _EPS_US:
+            stack[-1][3] += dur
+        elif stack:
+            # Overlap without containment (snapshot edge tear): close out.
+            while stack:
+                yield _pop(stack)
+        stack.append([ts, end, name, 0.0])
+    while stack:
+        yield _pop(stack)
+
+
+def _pop(stack):
+    ts, end, name, child = stack.pop()
+    dur = end - ts
+    return name, dur, max(0.0, dur - child)
+
+
+def analyze(doc: dict[str, Any]) -> dict[str, Any]:
+    """Structured analysis of a trace document (see module docstring)."""
+    stages: dict[tuple[str, str], StageStat] = {}
+    groups: dict[str, GroupStat] = {}
+    total_spans = 0
+    t_min, t_max = float("inf"), 0.0
+    for _tid, (_tname, group, evs) in sorted(_thread_events(doc).items()):
+        if not evs:
+            continue
+        g = groups.setdefault(group, GroupStat(group))
+        g.threads += 1
+        start = evs[0][0]
+        end = max(ts + dur for ts, dur, _ in evs)
+        g.wall_us += end - start
+        t_min, t_max = min(t_min, start), max(t_max, end)
+        for name, dur, self_us in _self_times(evs):
+            total_spans += 1
+            st = stages.setdefault(
+                (group, name), StageStat(name=name, group=group)
+            )
+            st.count += 1
+            st.total_us += dur
+            st.self_us += self_us
+            g.busy_us += self_us
+    waits = []
+    for (group, name), st in stages.items():
+        if st.is_wait and groups[group].wall_us > 0:
+            waits.append(
+                (st.self_us / groups[group].wall_us, group, name, st)
+            )
+    waits.sort(reverse=True, key=lambda w: w[0])
+    return {
+        "stages": sorted(
+            stages.values(), key=lambda s: (s.group, -s.self_us)
+        ),
+        "groups": sorted(groups.values(), key=lambda g: g.group),
+        "waits": waits,
+        "total_spans": total_spans,
+        "window_s": max(0.0, (t_max - t_min)) / 1e6 if total_spans else 0.0,
+    }
+
+
+def render(analysis: dict[str, Any]) -> str:
+    """The human-readable report (the ``obs report`` CLI's output)."""
+    lines: list[str] = []
+    groups: list[GroupStat] = analysis["groups"]
+    lines.append(
+        f"pipeline report: {sum(g.threads for g in groups)} thread(s) in "
+        f"{len(groups)} group(s), {analysis['total_spans']} spans, "
+        f"window {analysis['window_s']:.2f}s"
+    )
+    lines.append("")
+    lines.append("== per-stage time shares (self time) ==")
+    header = (
+        f"{'stage':<24} {'group':<10} {'count':>7} {'total_s':>9} "
+        f"{'mean_ms':>9} {'share%':>7}  kind"
+    )
+    lines.append(header)
+    for st in analysis["stages"]:
+        wall = next(g.wall_us for g in groups if g.group == st.group)
+        share = 100.0 * st.self_us / wall if wall else 0.0
+        mean_ms = st.total_us / st.count / 1e3 if st.count else 0.0
+        lines.append(
+            f"{st.name:<24} {st.group:<10} {st.count:>7} "
+            f"{st.self_us / 1e6:>9.3f} {mean_ms:>9.3f} {share:>7.1f}  "
+            f"{'wait' if st.is_wait else 'compute'}"
+        )
+    lines.append("")
+    lines.append("== wait vs compute ==")
+    for g in groups:
+        stage_list = [s for s in analysis["stages"] if s.group == g.group]
+        wait_us = sum(s.self_us for s in stage_list if s.is_wait)
+        compute_us = sum(s.self_us for s in stage_list if not s.is_wait)
+        wall = g.wall_us or 1.0
+        lines.append(
+            f"{g.group}: busy {100.0 * compute_us / wall:5.1f}% | "
+            f"waiting {100.0 * wait_us / wall:5.1f}% | "
+            f"untraced {100.0 * g.idle_us / wall:5.1f}%   "
+            f"(wall {g.wall_us / 1e6:.2f}s across {g.threads} thread(s))"
+        )
+    lines.append("")
+    lines.append("== stall attribution ==")
+    if not analysis["waits"]:
+        lines.append("no wait spans recorded")
+    for share, group, name, _st in analysis["waits"]:
+        cause = span_names.WAIT_CAUSES.get(name, "")
+        lines.append(
+            f"{group} idle {100.0 * share:5.1f}% in {name}"
+            + (f" — {cause}" if cause else "")
+        )
+    if analysis["waits"]:
+        share, group, name, _ = analysis["waits"][0]
+        lines.append("")
+        lines.append(
+            f"dominant stall: {name} ({100.0 * share:.1f}% of {group} "
+            "wall time)"
+        )
+    return "\n".join(lines)

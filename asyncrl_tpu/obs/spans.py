@@ -1,0 +1,106 @@
+"""The span taxonomy: every instrumented stage of the async host path.
+
+One module owns the vocabulary so the instrumentation sites, the report's
+stall attribution, and the flight recorder can never drift on what a span
+name means. Names are ``<stage>.<what>``; the stage prefix groups spans in
+the Perfetto export (``cat``) and the report tables.
+
+Wait vs compute: a span is a WAIT span when the thread is blocked on
+another pipeline stage (queue empty/full, slab reuse, device readiness) —
+the report attributes thread idleness to these by name. Everything else is
+compute. The classification is by exact name first, then by the
+``*_wait`` suffix convention, so a new wait span is classified correctly
+even before it is added to the cause table.
+"""
+
+from __future__ import annotations
+
+# Actor threads (rollout/sebulba.py ActorThread._run).
+ACTOR_INFERENCE = "actor.inference"      # batched action selection + sync
+ACTOR_ENV_STEP = "actor.env_step"        # host env pool step
+ACTOR_LEASE_WAIT = "actor.lease_wait"    # staging-slab row acquisition
+ACTOR_QUEUE_PUT = "actor.queue_put"      # fragment hand-off (incl. backpressure)
+
+# Staging ring internals (rollout/staging.py).
+STAGING_REUSE_WAIT = "staging.reuse_wait"  # blocked on in-flight slab readiness
+
+# Shared inference server (rollout/inference_server.py).
+SERVER_COLLECT_WAIT = "server.collect_wait"  # waiting for client requests
+SERVER_SERVE = "server.serve"                # coalesce + batched device call
+
+# Learner drain (api/sebulba_trainer.py train loop + learn/rollout_learner.py).
+LEARNER_QUEUE_WAIT = "learner.queue_wait"    # fragment queue empty (starved)
+LEARNER_H2D = "learner.h2d"                  # device_put dispatch
+LEARNER_H2D_WAIT = "learner.h2d_wait"        # unhidden transfer barrier
+LEARNER_UPDATE = "learner.update"            # jitted update dispatch
+LEARNER_METRICS = "learner.metrics_drain"    # device_get of pending metrics
+LEARNER_EVAL = "learner.eval"                # in-training greedy evaluation
+
+# Spans where the thread is blocked on ANOTHER stage of the pipeline.
+WAIT_SPANS = frozenset({
+    ACTOR_LEASE_WAIT,
+    ACTOR_QUEUE_PUT,
+    STAGING_REUSE_WAIT,
+    SERVER_COLLECT_WAIT,
+    LEARNER_QUEUE_WAIT,
+    LEARNER_H2D_WAIT,
+})
+
+# What a high share in each wait span MEANS — the stall-attribution table's
+# causal reading, kept next to the names so instrumentation and diagnosis
+# cannot drift apart.
+WAIT_CAUSES = {
+    LEARNER_QUEUE_WAIT: (
+        "learner starved for fragments: actors (env stepping / inference) "
+        "are the bottleneck"
+    ),
+    LEARNER_H2D_WAIT: (
+        "host->device transfer time not hidden behind the previous "
+        "update's compute"
+    ),
+    ACTOR_LEASE_WAIT: (
+        "no free staging slab row: waiting on slab reuse — the learner/"
+        "device side is the bottleneck or the ring is too shallow"
+    ),
+    STAGING_REUSE_WAIT: (
+        "waiting on an in-flight slab's device readiness (slab reuse): "
+        "deepen staging_slabs or speed up the consuming update"
+    ),
+    ACTOR_QUEUE_PUT: (
+        "fragment queue full (backpressure): the learner drain is the "
+        "bottleneck"
+    ),
+    SERVER_COLLECT_WAIT: (
+        "inference server idle between requests: actors are busy stepping "
+        "envs (healthy) or dead/restarting (check supervisor counters)"
+    ),
+}
+
+
+def is_wait(name: str) -> bool:
+    """WAIT span? Exact taxonomy membership, else the suffix convention."""
+    return name in WAIT_SPANS or name.endswith("_wait")
+
+
+def stage_of(name: str) -> str:
+    """The stage prefix (``actor``/``server``/``learner``/``staging``)."""
+    return name.split(".", 1)[0]
+
+
+# Thread-name -> thread-group mapping (the flight recorder's "distinct
+# thread groups" and the report's per-group rollup). Threads the framework
+# names map to their subsystem; anything else groups as its own name, and
+# a thread can override explicitly via ``trace.tag_thread``.
+_GROUP_PREFIXES = (
+    ("actor-", "actor"),
+    ("inference-server", "server"),
+    ("flightrec-", "flightrec"),
+    ("checkpoint", "checkpoint"),
+)
+
+
+def thread_group(thread_name: str) -> str:
+    for prefix, group in _GROUP_PREFIXES:
+        if thread_name.startswith(prefix):
+            return group
+    return thread_name

@@ -54,6 +54,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
 from asyncrl_tpu.utils import faults
 
 
@@ -250,14 +252,16 @@ class InferenceServer(threading.Thread):
     def _run(self) -> None:
         while not self._stop_event.is_set():
             self.heartbeat = time.monotonic()
-            batch = self._collect()
+            with trace.span(span_names.SERVER_COLLECT_WAIT):
+                batch = self._collect()
             if batch:
                 if self._fault_serve is not None:
                     # Outside _serve's per-request try: an injected crash
                     # kills the SERVER (recorded in _fatal, recovered by
                     # the trainer's rebuild), not just one batch.
                     self._fault_serve.fire(stop=self._stop_event.is_set)
-                self._serve(batch)
+                with trace.span(span_names.SERVER_SERVE):
+                    self._serve(batch)
 
     def _collect(self):
         """Wait for requests; return [(client_index, args), ...] in index

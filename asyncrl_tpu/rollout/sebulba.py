@@ -34,6 +34,8 @@ from asyncrl_tpu.envs.core import Environment, EnvSpec
 from asyncrl_tpu.models.networks import is_recurrent, reset_core
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.ops.normalize import normalize
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
 from asyncrl_tpu.rollout.buffer import Rollout, RolloutBuffer
 from asyncrl_tpu.utils import faults
 
@@ -578,9 +580,10 @@ class ActorThread(threading.Thread):
                 # Lease one slab row for this fragment. A blocked acquire
                 # (ring under pressure) refreshes the heartbeat: a back-
                 # pressured actor is alive, not hung.
-                lease = ring.acquire(
-                    stop=self._stopped, on_wait=self._heartbeat
-                )
+                with trace.span(span_names.ACTOR_LEASE_WAIT):
+                    lease = ring.acquire(
+                        stop=self._stopped, on_wait=self._heartbeat
+                    )
                 if lease is None:
                     break  # stopped/abandoned while waiting
                 self._open_lease = lease
@@ -609,27 +612,31 @@ class ActorThread(threading.Thread):
                 self.heartbeat = time.monotonic()
                 if self._fault_step is not None:
                     self._fault_step.fire(stop=self._stopped)
-                if core is not None and eps is not None:
-                    actions_d, logp_d, key, core = self.inference_fn(
-                        params, obs, key, core, done_prev, eps
-                    )
-                elif core is not None:
-                    actions_d, logp_d, key, core = self.inference_fn(
-                        params, obs, key, core, done_prev
-                    )
-                elif eps is not None:
-                    actions_d, logp_d, key = self.inference_fn(
-                        params, obs, key, eps
-                    )
-                else:
-                    actions_d, logp_d, key = self.inference_fn(params, obs, key)
-                # ONE batched device→host sync for both leaves (two
-                # np.asarray calls were two round trips on a high-latency
-                # link); numpy passes through untouched (server clients
-                # already hand back host arrays).
-                actions, logp = jax.device_get((actions_d, logp_d))
+                with trace.span(span_names.ACTOR_INFERENCE):
+                    if core is not None and eps is not None:
+                        actions_d, logp_d, key, core = self.inference_fn(
+                            params, obs, key, core, done_prev, eps
+                        )
+                    elif core is not None:
+                        actions_d, logp_d, key, core = self.inference_fn(
+                            params, obs, key, core, done_prev
+                        )
+                    elif eps is not None:
+                        actions_d, logp_d, key = self.inference_fn(
+                            params, obs, key, eps
+                        )
+                    else:
+                        actions_d, logp_d, key = self.inference_fn(
+                            params, obs, key
+                        )
+                    # ONE batched device→host sync for both leaves (two
+                    # np.asarray calls were two round trips on a high-
+                    # latency link); numpy passes through untouched (server
+                    # clients already hand back host arrays).
+                    actions, logp = jax.device_get((actions_d, logp_d))
                 prev_obs = obs
-                obs, rew, term, trunc = pool.step(actions)
+                with trace.span(span_names.ACTOR_ENV_STEP):
+                    obs, rew, term, trunc = pool.step(actions)
                 if track_returns:
                     disc_g = self.return_discount * disc_g + rew
                     buffer.append(
@@ -690,13 +697,16 @@ class ActorThread(threading.Thread):
                 # caught by run()'s stopped-thread swallow.
                 lease.commit()
             # Bounded put that stays responsive to shutdown (and to the
-            # watchdog retiring this thread mid-backpressure).
-            while not self._stopped():
-                try:
-                    self.out_queue.put(fragment, timeout=0.1)
-                    self._open_lease = None
-                    break
-                except queue.Full:
-                    self.backpressure += 1
-                    self.heartbeat = time.monotonic()
-                    continue
+            # watchdog retiring this thread mid-backpressure). The span
+            # covers the retry loop: its duration IS the backpressure
+            # wait (a free queue slot makes it ~one put's epsilon).
+            with trace.span(span_names.ACTOR_QUEUE_PUT):
+                while not self._stopped():
+                    try:
+                        self.out_queue.put(fragment, timeout=0.1)
+                        self._open_lease = None
+                        break
+                    except queue.Full:
+                        self.backpressure += 1
+                        self.heartbeat = time.monotonic()
+                        continue

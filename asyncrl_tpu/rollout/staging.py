@@ -48,6 +48,8 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
 from asyncrl_tpu.rollout.buffer import Rollout, RolloutBuffer
 
 
@@ -277,14 +279,15 @@ class StagingRing:
         ``block_until_ready``) so a stopping run and the heartbeat stay
         responsive even under a slow device."""
         s, handle = head
-        while True:
-            if _handle_ready(handle):
-                break
-            if stop is not None and stop():
-                return
-            if on_wait is not None:
-                on_wait()
-            time.sleep(0.002)
+        with trace.span(span_names.STAGING_REUSE_WAIT):
+            while True:
+                if _handle_ready(handle):
+                    break
+                if stop is not None and stop():
+                    return
+                if on_wait is not None:
+                    on_wait()
+                time.sleep(0.002)
         with self._cond:
             if self._inflight and self._inflight[0] is head:
                 self._inflight.popleft()

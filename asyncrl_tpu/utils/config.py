@@ -211,6 +211,24 @@ class Config:
     # The env var takes precedence when both are set.
     fault_spec: str = ""
 
+    # --- observability (asyncrl_tpu/obs/; host backends) ---
+    # Pipeline tracing: per-thread span ring buffers across the actor/
+    # server/staging/learner stages, Perfetto-exportable, with the flight
+    # recorder armed alongside (crash-time span dumps into run_dir).
+    # ASYNCRL_TRACE (when set) wins over this flag, like ASYNCRL_FAULTS.
+    # Off = the no-op fast path (one None check per span site).
+    trace: bool = False
+    # Per-thread span ring capacity (drop-oldest on overflow; overflow is
+    # counted in the trace_dropped_spans window metric).
+    trace_ring: int = 4096
+    # Flight recorder lookback: seconds of spans dumped on a fault,
+    # watchdog retirement, or supervisor restart.
+    trace_window_s: float = 10.0
+    # Observability output directory (trace exports, flightrec-*.json).
+    # Empty = runs/<env>-<algo>-s<seed>-<stamp>-<pid> when tracing is on;
+    # ASYNCRL_RUN_DIR overrides.
+    run_dir: str = ""
+
     # --- runtime ---
     seed: int = 0
     # Anakin backend: learner updates fused into ONE jitted call via

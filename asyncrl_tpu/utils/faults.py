@@ -148,6 +148,19 @@ class FaultSite:
         ordinal = self._should_fire()
         if not ordinal:
             return payload
+        # Flight recorder (obs/flightrec.py): every fire dumps the last
+        # seconds of spans from all threads — the forensics record of
+        # what the pipeline was doing when the fault hit. Imported
+        # lazily: obs depends on faults for counters, and an unarmed
+        # recorder makes this a no-op anyway.
+        from asyncrl_tpu.obs import flightrec
+
+        flightrec.record(
+            f"fault.{self.name}",
+            detail=f"kind={self.kind} fire {ordinal}/"
+            f"{self.max_fires or 'inf'} in thread "
+            f"{threading.current_thread().name!r}",
+        )
         if self.kind == "crash":
             raise InjectedFault(
                 f"injected crash at fault site {self.name!r} in thread "

@@ -48,11 +48,16 @@ class StdoutSink(MetricsSink):
         if self.as_json:
             print(json.dumps(dict(window)), file=self.stream)
         else:
-            parts = [
-                f"steps={int(window.get('env_steps', 0)):>10}",
-                f"fps={window.get('fps', 0.0):>12,.0f}",
-                f"ep_return={window.get('episode_return', 0.0):8.2f}",
-            ]
+            # Absent keys are OMITTED, never defaulted: a window early in
+            # a run (or from a backend that doesn't produce a key) must
+            # not print a misleading steps=0 / fps=0 / ep_return=0.00.
+            parts = []
+            if "env_steps" in window:
+                parts.append(f"steps={int(window['env_steps']):>10}")
+            if "fps" in window:
+                parts.append(f"fps={window['fps']:>12,.0f}")
+            if "episode_return" in window:
+                parts.append(f"ep_return={window['episode_return']:8.2f}")
             for k in ("loss", "entropy", "param_lag"):
                 if k in window:
                     parts.append(f"{k}={window[k]:8.4f}")

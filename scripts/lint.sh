@@ -2,7 +2,10 @@
 # Lint gate for asyncrl-tpu: ruff (curated rule set in pyproject.toml)
 # plus the framework-aware static passes (python -m asyncrl_tpu.analysis:
 # lock discipline, JAX purity, donation safety, thread ownership,
-# deadlock/lock-order, device contracts, config contracts).
+# deadlock/lock-order, device contracts, config contracts). The default
+# package run covers EVERY subpackage — asyncrl_tpu/obs/ (span rings,
+# flight recorder) included, so its guarded-by/thread-entry annotations
+# gate like the rest of the concurrency substrate.
 #
 #   scripts/lint.sh            # lint the package (CI gate)
 #   scripts/lint.sh path.py    # lint specific files (fixtures exit nonzero)

@@ -119,6 +119,11 @@ QUICK: dict[str, object] = {
         "test_recovery_counters_flow_through_sinks",
         "test_threads_are_named_and_fault_messages_identify_threads",  # 2s
     },
+    # Observability (asyncrl_tpu/obs/, ISSUE 5): ring/export/report/
+    # registry units are sub-second; the two pipeline smokes (the
+    # fault-injected flight-recorder acceptance run and the disabled-mode
+    # window check) are ~10s combined. Whole file ~15s.
+    "test_obs.py": "all",
     # Static checker (asyncrl_tpu/analysis/): pure-AST, no training; the
     # whole file (package-gates-clean + fixture corpus + lock/edge
     # deletion detection + cache correctness/speedup + baseline + JSON +

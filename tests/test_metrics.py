@@ -33,6 +33,24 @@ def test_stdout_sink_text_and_json():
     assert json.loads(buf.getvalue()) == WINDOW
 
 
+def test_stdout_sink_omits_absent_keys():
+    """A window missing env_steps/fps/episode_return must not print
+    misleading zeros for them (early-run windows, partial backends) —
+    absent keys are omitted from the one-liner entirely."""
+    buf = io.StringIO()
+    StdoutSink(stream=buf).write({"loss": 0.25})
+    line = buf.getvalue()
+    assert "loss=" in line
+    assert "steps=" not in line
+    assert "fps=" not in line
+    assert "ep_return=" not in line
+
+    # Present keys still render exactly as before.
+    buf = io.StringIO()
+    StdoutSink(stream=buf).write(WINDOW)
+    assert "steps=" in buf.getvalue()
+
+
 def test_jsonl_sink_appends(tmp_path):
     path = str(tmp_path / "run.jsonl")
     with JsonlSink(path) as sink:
