@@ -403,3 +403,18 @@ class PopulationTrainer:
     def member_params(self, i: int):
         """Extract one member's params (e.g. the best seed, for eval)."""
         return jax.tree.map(lambda x: x[i], self.state.params)
+
+    def publish_policies(self, router, prefix: str = "member") -> list[str]:
+        """Install every member's CURRENT params into a serve-core policy
+        router (asyncrl_tpu/serve/router.py) as ``<prefix>/<i>`` — the
+        whole population served from one :class:`~asyncrl_tpu.serve.ServeCore`.
+        First call registers; later calls are zero-drain generation swaps
+        (in-flight batches finish on the old weights, new dispatches pick
+        up the new ones — no serving pause at publish time). Returns the
+        policy ids, index-aligned with members."""
+        ids = []
+        for i in range(self.pop_size):
+            policy = f"{prefix}/{i}"
+            router.install(policy, self.member_params(i))
+            ids.append(policy)
+        return ids

@@ -338,26 +338,61 @@ class SebulbaTrainer:
             self._spawn_actor(i) for i in range(self.config.actor_threads)
         ]
 
+    def _use_serve_core(self) -> bool:
+        """Serve core (asyncrl_tpu/serve/) vs legacy InferenceServer for
+        the shared server. ``ASYNCRL_SERVE`` wins over ``config.serve``
+        when set — the no-code-change A/B knob, like ASYNCRL_FAULTS."""
+        env = os.environ.get("ASYNCRL_SERVE", "")
+        if env:
+            return env.lower() not in ("0", "false", "no")
+        return self.config.serve
+
     def _spawn_server(self) -> None:
         """(Re)build the shared inference server on a fresh personal stop
         event. Callers re-wire actors separately: existing clients of a
         dead/retired server raise into their actor threads, whose restarts
-        pick up ``self._server``'s new clients."""
-        from asyncrl_tpu.rollout.inference_server import InferenceServer
+        pick up ``self._server``'s new clients. Both cores expose the same
+        supervisor surface (heartbeat, _fatal, client(i), coalesce
+        counters), so everything downstream is core-agnostic."""
         from asyncrl_tpu.rollout.sebulba import inference_mode
 
+        cfg = self.config
         self._server_stop = threading.Event()
-        self._server = InferenceServer(
-            self._inference_fn,
-            self._store,
-            num_clients=self.config.actor_threads,
-            stop_event=self._server_stop,
-            # Decorrelate the restarted server's action-sampling key
-            # stream from its predecessor's.
-            seed=self.config.seed + 1_000_003 * self._server_restarts,
-            mode=inference_mode(self.config, self.model),
-            device=self._actor_device,
-        )
+        # Decorrelate the restarted server's action-sampling key stream
+        # from its predecessor's.
+        seed = cfg.seed + 1_000_003 * self._server_restarts
+        mode = inference_mode(cfg, self.model)
+        if self._use_serve_core():
+            from asyncrl_tpu.serve.scheduler import ServeCore
+            from asyncrl_tpu.serve.slo import SLOGate
+
+            self._server = ServeCore(
+                self._inference_fn,
+                store=self._store,
+                num_clients=cfg.actor_threads,
+                stop_event=self._server_stop,
+                mode=mode,
+                seed=seed,
+                device=self._actor_device,
+                deadline_ms=cfg.serve_deadline_ms,
+                slo=SLOGate(
+                    p95_target_ms=cfg.serve_slo_p95_ms,
+                    max_inflight=cfg.serve_max_inflight,
+                    shed=cfg.serve_shed,
+                ),
+            )
+        else:
+            from asyncrl_tpu.rollout.inference_server import InferenceServer
+
+            self._server = InferenceServer(
+                self._inference_fn,
+                self._store,
+                num_clients=cfg.actor_threads,
+                stop_event=self._server_stop,
+                seed=seed,
+                mode=mode,
+                device=self._actor_device,
+            )
         self._server.start()
 
     def _supervise(self) -> None:  # thread-entry: watchdog@learner

@@ -13,7 +13,8 @@ breaks downstream greps) — they are not migrated, new ones simply stop
 needing trainer plumbing.
 
 Counters are cumulative (like ``actor_restarts``); histograms export
-``<name>_p50`` / ``<name>_p95`` / ``<name>_max`` / ``<name>_count``
+``<name>_p50`` / ``<name>_p95`` / ``<name>_p99`` / ``<name>_max`` /
+``<name>_count``
 summaries over everything observed so far. Thread-safety: one registry
 lock around the name->instrument map; each instrument carries its own
 lock (observations are per-update/per-event, not per-env-frame — never a
@@ -101,6 +102,7 @@ class Histogram:
                 f"{self.name}_count": float(self._count),
                 f"{self.name}_p50": self._quantile_locked(0.50),
                 f"{self.name}_p95": self._quantile_locked(0.95),
+                f"{self.name}_p99": self._quantile_locked(0.99),
                 f"{self.name}_max": self._max,
             }
 

@@ -28,6 +28,12 @@ STAGING_REUSE_WAIT = "staging.reuse_wait"  # blocked on in-flight slab readiness
 SERVER_COLLECT_WAIT = "server.collect_wait"  # waiting for client requests
 SERVER_SERVE = "server.serve"                # coalesce + batched device call
 
+# Serving core (asyncrl_tpu/serve/): continuous batching + zero-drain swaps.
+SERVE_ADMIT_WAIT = "serve.admit_wait"    # client blocked at the admission gate
+SERVE_BATCH_FILL = "serve.batch_fill"    # scheduler holding a partial batch open
+SERVE_DISPATCH = "serve.dispatch"        # coalesce + batched device call
+SERVE_SWAP_DRAIN = "serve.swap_drain"    # waiting for old-generation batches
+
 # Learner drain (api/sebulba_trainer.py train loop + learn/rollout_learner.py).
 LEARNER_QUEUE_WAIT = "learner.queue_wait"    # fragment queue empty (starved)
 LEARNER_H2D = "learner.h2d"                  # device_put dispatch
@@ -42,6 +48,9 @@ WAIT_SPANS = frozenset({
     ACTOR_QUEUE_PUT,
     STAGING_REUSE_WAIT,
     SERVER_COLLECT_WAIT,
+    SERVE_ADMIT_WAIT,
+    SERVE_BATCH_FILL,
+    SERVE_SWAP_DRAIN,
     LEARNER_QUEUE_WAIT,
     LEARNER_H2D_WAIT,
 })
@@ -74,6 +83,22 @@ WAIT_CAUSES = {
         "inference server idle between requests: actors are busy stepping "
         "envs (healthy) or dead/restarting (check supervisor counters)"
     ),
+    SERVE_ADMIT_WAIT: (
+        "clients held at the serve admission gate (SLO backpressure or "
+        "inflight cap): the server is the bottleneck — it cannot keep "
+        "latency inside target at the offered load"
+    ),
+    SERVE_BATCH_FILL: (
+        "scheduler holding partial batches open for more requests: clients "
+        "are slow to submit (healthy under light load); a high share paired "
+        "with mostly deadline-flush dispatches means the deadline budget is "
+        "long relative to client cadence — tighten serve_deadline_ms"
+    ),
+    SERVE_SWAP_DRAIN: (
+        "waiting for in-flight batches pinned to an old param generation "
+        "to retire: dispatches are long relative to the publish cadence "
+        "(teardown/barrier paths only — the swap itself never blocks)"
+    ),
 }
 
 
@@ -94,6 +119,7 @@ def stage_of(name: str) -> str:
 _GROUP_PREFIXES = (
     ("actor-", "actor"),
     ("inference-server", "server"),
+    ("serve-core", "server"),
     ("flightrec-", "flightrec"),
     ("checkpoint", "checkpoint"),
 )

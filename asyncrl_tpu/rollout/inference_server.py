@@ -91,6 +91,53 @@ def _slice(tree, start, stop):
     return jax.tree.map(lambda x: x[start:stop], tree)
 
 
+def pack_rows(slabs: dict, key, parts, total_rows: int) -> np.ndarray:
+    """Copy ``parts`` back-to-back into the slab registered under ``key``
+    in ``slabs``; returns the ``[total_rows, ...]`` view. The slab grows to
+    the largest (rows, tail-shape, dtype) seen and is then reused forever —
+    steady state allocates nothing. Shared by the InferenceServer (keys are
+    leaf positions) and the serve core (keys are (policy, position) pairs,
+    so policies with different request shapes never thrash one slab)."""
+    tail, dtype = parts[0].shape[1:], parts[0].dtype
+    slab = slabs.get(key)
+    if (
+        slab is None
+        or slab.shape[0] < total_rows
+        or slab.shape[1:] != tail
+        or slab.dtype != dtype
+    ):
+        slab = np.empty((total_rows, *tail), dtype)
+        slabs[key] = slab
+    offset = 0
+    for part in parts:
+        n = part.shape[0]
+        np.copyto(slab[offset:offset + n], part)
+        offset += n
+    return slab[:total_rows]
+
+
+def coalesce_args(slabs: dict, key_prefix, args_list, total_rows: int):
+    """Merge per-client request pytrees into one batch pytree.
+
+    Host (numpy) leaves pack into the caller's preallocated slabs — a host
+    memcpy per client, then ONE device transfer of the slab when the jitted
+    call consumes it. Device-resident leaves (the recurrent core on an
+    accelerator) concatenate on device; bouncing them through the host
+    would add a D2H sync per round."""
+    flats = [jax.tree.flatten(args)[0] for args in args_list]
+    treedef = jax.tree.structure(args_list[0])
+    merged = []
+    for pos in range(len(flats[0])):
+        parts = [flat[pos] for flat in flats]
+        if all(isinstance(p, np.ndarray) for p in parts):
+            merged.append(
+                pack_rows(slabs, (key_prefix, pos), parts, total_rows)
+            )
+        else:
+            merged.append(jnp.concatenate(parts, axis=0))
+    return jax.tree.unflatten(treedef, merged)
+
+
 class InferenceServer(threading.Thread):
     """Coalesces actor-thread inference requests into one batched call.
 
@@ -161,7 +208,7 @@ class InferenceServer(threading.Thread):
         self._fault_serve = faults.site("server.serve")
         # Preallocated host batch slabs, one per flattened request-leaf
         # position (grown to the largest batch seen); server-thread-only.
-        self._slabs: dict[int, np.ndarray] = {}
+        self._slabs: dict[Any, np.ndarray] = {}
         # Coalescing counters for the infer_coalesce_batch metric: total
         # served rounds and total request rows (plain ints under the GIL;
         # the trainer only reads them).
@@ -292,45 +339,10 @@ class InferenceServer(threading.Thread):
             return batch
 
     def _coalesce(self, args_list, total_rows: int):
-        """Merge per-client request pytrees into one batch pytree.
-
-        Host (numpy) leaves pack into this server's preallocated slabs —
-        a host memcpy per client, then ONE device transfer of the slab
-        when the jitted call consumes it. Device-resident leaves (the
-        recurrent core on an accelerator) concatenate on device as before;
-        bouncing them through the host would add a D2H sync per round."""
-        flats = [jax.tree.flatten(args)[0] for args in args_list]
-        treedef = jax.tree.structure(args_list[0])
-        merged = []
-        for pos in range(len(flats[0])):
-            parts = [flat[pos] for flat in flats]
-            if all(isinstance(p, np.ndarray) for p in parts):
-                merged.append(self._pack(pos, parts, total_rows))
-            else:
-                merged.append(jnp.concatenate(parts, axis=0))
-        return jax.tree.unflatten(treedef, merged)
-
-    def _pack(self, pos: int, parts, total_rows: int) -> np.ndarray:
-        """Copy ``parts`` back-to-back into the slab for leaf ``pos``;
-        returns the ``[total_rows, ...]`` view. The slab grows to the
-        largest (rows, tail-shape, dtype) seen and is then reused forever
-        — steady state allocates nothing."""
-        tail, dtype = parts[0].shape[1:], parts[0].dtype
-        slab = self._slabs.get(pos)
-        if (
-            slab is None
-            or slab.shape[0] < total_rows
-            or slab.shape[1:] != tail
-            or slab.dtype != dtype
-        ):
-            slab = np.empty((total_rows, *tail), dtype)
-            self._slabs[pos] = slab
-        offset = 0
-        for part in parts:
-            n = part.shape[0]
-            np.copyto(slab[offset:offset + n], part)
-            offset += n
-        return slab[:total_rows]
+        """Merge per-client request pytrees into one batch pytree (the
+        shared :func:`coalesce_args`; this server's slabs are keyed on
+        leaf position alone — one client population, one shape family)."""
+        return coalesce_args(self._slabs, None, args_list, total_rows)
 
     def _serve(self, batch) -> None:
         if self._debug:

@@ -1,0 +1,41 @@
+"""Serving-grade policy serving (ROADMAP item 4; ISSUE 6 tentpole).
+
+The subsystem that turns policy inference from a training convenience
+into a serving core that could face external traffic:
+
+- :mod:`asyncrl_tpu.serve.scheduler` — :class:`ServeCore`, the
+  continuous-batching scheduler (deadline-flush vs slab-full dispatch,
+  partial batches first-class).
+- :mod:`asyncrl_tpu.serve.slo` — :class:`SLOGate`, per-client latency
+  targets with a token-bucket admission gate that sheds or backpressures
+  when p95 breaches target.
+- :mod:`asyncrl_tpu.serve.router` — :class:`PolicyRouter`, multi-policy
+  routing (population/league/self-play from one server).
+- :mod:`asyncrl_tpu.serve.params` — :class:`ParamSlots`,
+  generation-stamped zero-drain weight swaps.
+
+``SebulbaTrainer`` mounts the serve core behind ``config.serve`` (default
+on; ``ASYNCRL_SERVE`` env overrides) wherever ``config.inference_server``
+asks for a shared server — see docs/ARCHITECTURE.md "Policy serving".
+"""
+
+from asyncrl_tpu.serve.params import ParamSlots
+from asyncrl_tpu.serve.router import (
+    DEFAULT_POLICY,
+    PolicyRouter,
+    UnknownPolicyError,
+    selfplay_policies,
+)
+from asyncrl_tpu.serve.scheduler import ServeCore
+from asyncrl_tpu.serve.slo import RequestShed, SLOGate
+
+__all__ = [
+    "DEFAULT_POLICY",
+    "ParamSlots",
+    "PolicyRouter",
+    "RequestShed",
+    "SLOGate",
+    "ServeCore",
+    "UnknownPolicyError",
+    "selfplay_policies",
+]

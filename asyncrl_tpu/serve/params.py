@@ -1,0 +1,140 @@
+"""Generation-stamped parameter slots: zero-drain weight swaps.
+
+The serving core must keep answering requests while the learner publishes
+new weights — Laminar (PAPERS.md, arXiv:2510.12633) makes fully-decoupled
+per-replica weight sync the design that lets serving scale independently
+of training. The legacy path got this *almost* right: a ``ParamStore``
+swap is atomic, but the server re-reads the store every round, so there is
+no way to reason about which batches ran under which weights, no way for a
+second publisher (a population, an external pusher) to coexist with the
+trainer, and no structural guarantee that one batched call never mixes
+weights.
+
+:class:`ParamSlots` is the staging-lease trick (rollout/staging.py)
+applied to parameters instead of rollout rows:
+
+- Every published param pytree occupies a **slot** stamped with a
+  monotonically increasing **generation**.
+- A dispatch **leases** the latest generation for the lifetime of one
+  batched call: every request in that batch is answered under exactly that
+  generation — mixed-generation batches are impossible by construction,
+  not by luck.
+- :meth:`install` publishes generation g+1 **without blocking**: new
+  dispatches pick up g+1 immediately while in-flight batches finish on g.
+  No request is ever dropped or re-run for a swap.
+- A superseded slot is retired (its params reference dropped, memory
+  freed) the moment its lease count hits zero; the latest slot is never
+  retired. Publishers therefore never wait on the serve path and the
+  serve path never waits on publishers — the only waiting anywhere is
+  :meth:`drain` (teardown/barrier paths), which is traced as the
+  ``serve.swap_drain`` span so the obs report can attribute it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
+
+
+class ParamSlots:
+    """Generation-stamped param slots for one policy (see module doc)."""
+
+    def __init__(self, params: Any, generation: int = 0):
+        self._cond = threading.Condition()
+        # Resident slots: generation -> params; refs: generation -> number
+        # of in-flight dispatches leased on it.
+        self._slots: dict[int, Any] = {generation: params}  # guarded-by: _cond
+        self._refs: dict[int, int] = {generation: 0}  # guarded-by: _cond
+        self._latest = generation  # guarded-by: _cond
+        self._installs = 0  # guarded-by: _cond
+
+    def install(self, params: Any) -> int:
+        """Publish ``params`` as the next generation. Never blocks: the
+        serve path keeps dispatching throughout, in-flight batches finish
+        on their leased generation. Returns the new generation."""
+        with self._cond:
+            gen = self._latest + 1
+            self._slots[gen] = params
+            self._refs[gen] = 0
+            self._latest = gen
+            self._installs += 1
+            self._retire_locked()
+            self._cond.notify_all()
+            return gen
+
+    def _retire_locked(self) -> None:  # holds: _cond
+        """Drop every superseded slot with no in-flight lease (frees the
+        old params reference; the latest slot always stays resident)."""
+        for gen in [
+            g for g, r in self._refs.items()
+            if r == 0 and g != self._latest
+        ]:
+            del self._refs[gen]
+            del self._slots[gen]
+
+    def lease(self) -> tuple[Any, int]:
+        """Pin the latest generation for one dispatch; returns
+        ``(params, generation)``. Must be paired with :meth:`release`."""
+        with self._cond:
+            gen = self._latest
+            self._refs[gen] += 1
+            return self._slots[gen], gen
+
+    def release(self, generation: int) -> None:
+        """Drop one lease on ``generation``; retires the slot when it is
+        superseded and this was its last in-flight batch."""
+        with self._cond:
+            refs = self._refs.get(generation)
+            if refs is None or refs <= 0:
+                raise RuntimeError(
+                    f"ParamSlots.release({generation}): no outstanding "
+                    "lease on that generation — release/lease pairing is "
+                    "broken"
+                )
+            self._refs[generation] = refs - 1
+            self._retire_locked()
+            self._cond.notify_all()
+
+    def latest(self) -> int:
+        with self._cond:
+            return self._latest
+
+    def installs(self) -> int:
+        """Total installs since construction (the swap counter)."""
+        with self._cond:
+            return self._installs
+
+    def generations(self) -> list[int]:
+        """Resident generations (the latest plus any still pinned by
+        in-flight batches), ascending."""
+        with self._cond:
+            return sorted(self._slots)
+
+    def _drained_locked(self) -> bool:  # holds: _cond
+        return set(self._slots) == {self._latest} and (
+            self._refs[self._latest] == 0
+        )
+
+    def drain(
+        self,
+        timeout_s: float = 5.0,
+        stop: Callable[[], bool] | None = None,
+    ) -> bool:
+        """Wait until every superseded generation has retired and the
+        latest has no in-flight lease (teardown / test barrier — the serve
+        hot path never calls this). Returns True when fully drained. The
+        wait is traced as ``serve.swap_drain`` so stall attribution sees
+        it; it wakes early when ``stop`` turns true."""
+        deadline = time.monotonic() + timeout_s
+        with trace.span(span_names.SERVE_SWAP_DRAIN):
+            with self._cond:
+                while not self._drained_locked():
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or (stop is not None and stop()):
+                        return self._drained_locked()
+                    self._cond.wait(timeout=min(remaining, 0.05))
+                return True

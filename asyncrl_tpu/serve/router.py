@@ -1,0 +1,132 @@
+"""Multi-policy routing: serve a whole population/league from one server.
+
+AcceRL (PAPERS.md, arXiv:2603.18464) motivates one async substrate serving
+many policy/workload shapes; in-repo, ``api/population.py`` trains K
+policies in one program and self-play carries a live policy plus a frozen
+rival — yet the legacy inference server could serve exactly one
+``ParamStore``. The router closes that gap: requests carry a **policy
+id**, each policy owns its own generation-stamped :class:`ParamSlots`
+(serve/params.py — publishes stay zero-drain per policy), and the serve
+scheduler groups compatible requests (same policy, hence same param
+pytree and model) into one batched dispatch.
+
+Publishing is the ``serve.swap`` fault site: a chaos run can crash or
+stall the swap path and the supervisor must rebuild the serve core
+without dropping the actor fleet (tests/test_faults.py).
+
+First in-repo clients:
+
+- ``PopulationTrainer.publish_policies(router)`` installs every member's
+  params as ``member/<i>`` policies — a league served from one process.
+- :func:`selfplay_policies` maps a self-play ``TrainState`` to its
+  ``live`` + ``opponent`` policy dict for registration.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from asyncrl_tpu.serve.params import ParamSlots
+from asyncrl_tpu.utils import faults
+
+DEFAULT_POLICY = "default"
+
+
+class UnknownPolicyError(KeyError):
+    """A request or publish named a policy the router has never seen."""
+
+
+class PolicyRouter:
+    """policy id -> :class:`ParamSlots` map (see module doc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots: dict[str, ParamSlots] = {}  # guarded-by: _lock
+        # Chaos handle (utils/faults.py): one fetch, None when unarmed —
+        # the publish path pays a single identity check.
+        self._fault_swap = faults.site("serve.swap")
+
+    def register(self, policy: str, params: Any) -> int:
+        """Create ``policy`` with ``params`` as its initial generation.
+        Refuses a duplicate registration — a second registration is almost
+        always a lost :meth:`publish` (use :meth:`install` for the
+        register-or-publish convenience)."""
+        with self._lock:
+            if policy in self._slots:
+                raise ValueError(
+                    f"policy {policy!r} already registered; use publish() "
+                    "or install()"
+                )
+            slots = self._slots[policy] = ParamSlots(params)
+        return slots.latest()
+
+    def publish(self, policy: str, params: Any) -> int:
+        """Zero-drain swap for ``policy``: installs the next generation
+        without blocking the serve path (in-flight batches finish on their
+        leased generation). Returns the new generation."""
+        with self._lock:
+            slots = self._slots.get(policy)
+        if slots is None:
+            raise UnknownPolicyError(policy)
+        return self._publish_slots(slots, params)
+
+    def _publish_slots(self, slots: ParamSlots, params: Any) -> int:
+        if self._fault_swap is not None:
+            # Fires on the PUBLISHER's thread (the serve core's store
+            # sync, a population pusher): an injected crash kills that
+            # path — the supervisor's rebuild recovers the serve core.
+            self._fault_swap.fire()
+        return slots.install(params)
+
+    def install(self, policy: str, params: Any) -> int:
+        """Register-or-publish: the idempotent form callers loop over.
+        The decision and the registration happen under ONE lock hold, so
+        two publishers racing on a not-yet-registered policy both succeed
+        (one registers, the other swaps) instead of the loser crashing on
+        the register() duplicate check."""
+        with self._lock:
+            slots = self._slots.get(policy)
+            if slots is None:
+                slots = self._slots[policy] = ParamSlots(params)
+                return slots.latest()
+        return self._publish_slots(slots, params)
+
+    def slots(self, policy: str) -> ParamSlots:
+        with self._lock:
+            slots = self._slots.get(policy)
+        if slots is None:
+            raise UnknownPolicyError(policy)
+        return slots
+
+    def lease(self, policy: str) -> tuple[Any, int, ParamSlots]:
+        """Pin ``policy``'s latest generation for one dispatch; the caller
+        releases via the returned slots (``slots.release(gen)``)."""
+        slots = self.slots(policy)
+        params, gen = slots.lease()
+        return params, gen, slots
+
+    def policies(self) -> list[str]:
+        with self._lock:
+            return sorted(self._slots)
+
+    def drain(self, timeout_s: float = 5.0, stop=None) -> bool:
+        """Drain every policy's superseded generations (teardown barrier;
+        traced per policy as ``serve.swap_drain``)."""
+        ok = True
+        for policy in self.policies():
+            ok = self.slots(policy).drain(timeout_s, stop=stop) and ok
+        return ok
+
+
+def selfplay_policies(state) -> dict[str, Any]:
+    """The self-play ``TrainState`` as a router policy dict: the live
+    learner params plus the frozen rival — ``router.install`` each to
+    serve a self-play pair from one serve core."""
+    opponent = getattr(state, "opponent_params", None)
+    if opponent is None:
+        raise ValueError(
+            "state has no opponent_params: not a self-play TrainState "
+            "(config.selfplay=True populates it)"
+        )
+    return {"live": state.params, "opponent": opponent}

@@ -1,0 +1,467 @@
+"""Continuous-batching serve scheduler: the serving-grade policy server.
+
+The legacy ``InferenceServer`` is a *training* convenience: it waits for
+every live client each round ("collect rounds"), serves one policy from
+one ``ParamStore``, and has no notion of latency beyond ``max_wait_s``.
+:class:`ServeCore` turns the same coalesce-and-dispatch machinery into a
+serving core shaped like a production inference tier:
+
+- **Continuous batching** (the vLLM/Orca discipline, adapted to
+  fixed-shape RL inference): requests are admitted into the preallocated
+  batch slab as they arrive; a batch dispatches when the slab is **full**
+  (every registered client of the policy has a request in, or the row cap
+  is hit) *or* when the **oldest request's deadline budget is spent** —
+  whichever comes first. Partial batches are first-class: a dead or slow
+  client delays nobody past the deadline. The fill-vs-flush decision is
+  observable: ``serve.batch_fill`` spans cover the holding-open time and
+  the ``serve_dispatch_full`` / ``serve_dispatch_deadline`` counters
+  record which rule fired, so the obs report can say *why* batches were
+  the size they were.
+- **SLOs + admission control** (serve/slo.py): every request passes the
+  gate before it queues; breached p95 targets shed or backpressure
+  clients at the door (``serve.admit_wait``), not after they have already
+  cost a slab slot.
+- **Multi-policy routing** (serve/router.py): requests carry a policy id;
+  one dispatch groups requests of one policy (same params, same model),
+  oldest-request-first across policies, so a league/population serves
+  from one core without head-of-line blocking between policies.
+- **Zero-drain weight swaps** (serve/params.py): each dispatch leases one
+  param generation for the whole batched call — a publish installs g+1
+  concurrently while in-flight batches finish on g; no request is dropped
+  and no batch ever mixes generations.
+
+Drop-in compatibility: ``ServeCore.client(i)`` returns the exact
+``make_inference_fn``-signature callable ``InferenceServer.client(i)``
+returns, and the thread exposes the same supervisor surface (``heartbeat``,
+``_fatal``, ``coalesce_rounds/rows``, personal stop event), so
+``SebulbaTrainer`` swaps cores behind ``config.serve`` with no changes to
+actors, supervision, or metrics plumbing.
+
+Chaos: ``serve.dispatch`` fires on the serve thread per batch (an injected
+crash kills the core; the trainer's supervisor rebuilds it and actors
+re-wire — the actor fleet is never dropped); ``serve.swap`` fires on the
+publish path inside the router.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
+from asyncrl_tpu.rollout.inference_server import (
+    InvariantViolation,
+    ServerClosed,
+    _on_cpu,
+    _slice,
+    coalesce_args,
+)
+from asyncrl_tpu.serve.router import DEFAULT_POLICY, PolicyRouter
+from asyncrl_tpu.serve.slo import SLOGate
+from asyncrl_tpu.utils import faults
+
+DISPATCH_FULL_COUNTER = "serve_dispatch_full"
+DISPATCH_DEADLINE_COUNTER = "serve_dispatch_deadline"
+
+
+class _Request:
+    """One in-flight client request. Ownership protocol: the fields below
+    the event are event-handshake-owned exactly like the InferenceServer's
+    result slots — the scheduler writes result/error/generation before
+    ``event.set()``; the client reads them only after its wait returns."""
+
+    __slots__ = (
+        "client", "policy", "args", "rows", "arrival", "deadline",
+        "event", "result", "error", "generation",
+    )
+
+    def __init__(self, client, policy, args, rows, arrival, deadline):
+        self.client = client
+        self.policy = policy
+        self.args = args
+        self.rows = rows
+        self.arrival = arrival
+        self.deadline = deadline
+        self.event = threading.Event()
+        # lint: thread-shared-ok(event handshake: Event.set/wait is the ownership hand-off, same protocol as InferenceServer result slots)
+        self.result = None
+        # lint: thread-shared-ok(event handshake, same protocol as result)
+        self.error: BaseException | None = None
+        # lint: thread-shared-ok(event handshake, same protocol as result)
+        self.generation = -1
+
+
+class ServeCore(threading.Thread):
+    """Continuous-batching, SLO-gated, multi-policy inference server.
+
+    ``mode`` names the wrapped callable's signature exactly as in
+    ``InferenceServer`` ("ff" | "eps" | "rec" | "rec_eps").
+
+    ``store`` (a ``ParamStore``) backs the ``"default"`` policy: the
+    scheduler syncs the store's latest published version into the router
+    before every dispatch, converting the trainer's publish cadence into
+    generation-stamped zero-drain swaps. Pass ``store=None`` to serve a
+    router-only policy set (population/league serving).
+    """
+
+    MODES = ("ff", "eps", "rec", "rec_eps")
+
+    def __init__(
+        self,
+        inference_fn: Callable,
+        store=None,
+        num_clients: int = 1,
+        stop_event: threading.Event | None = None,
+        mode: str = "ff",
+        seed: int = 0,
+        device=None,
+        deadline_ms: float = 2.0,
+        slo: SLOGate | None = None,
+        router: PolicyRouter | None = None,
+        max_batch_rows: int = 0,
+    ):
+        super().__init__(name="serve-core", daemon=True)
+        if mode not in self.MODES:
+            raise ValueError(f"unknown mode {mode!r}; expected {self.MODES}")
+        if num_clients < 1:
+            raise ValueError(f"num_clients must be >= 1, got {num_clients}")
+        if deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        self._fn = inference_fn
+        self._store = store
+        self._n = num_clients
+        self._stop_event = stop_event if stop_event is not None else threading.Event()
+        self._mode = mode
+        self._deadline_s = deadline_ms / 1e3
+        self._max_rows = max_batch_rows
+        self._slo = slo if slo is not None else SLOGate()
+        self._router = router if router is not None else PolicyRouter()
+        # Thread-local device pin, same constraint as InferenceServer.
+        self._device = device
+        self._key = jax.random.PRNGKey(seed ^ 0x5EC0DE)
+        self._cond = threading.Condition()
+        self._pending: "deque[_Request]" = deque()  # guarded-by: _cond
+        self._client_policy: dict[int, str] = {}  # guarded-by: _cond
+        from asyncrl_tpu.utils.debug import sync_debug_enabled
+
+        self._debug = sync_debug_enabled()
+        # Fatal latch, heartbeat, and coalescing counters: the exact
+        # supervisor/metrics surface InferenceServer exposes, so the
+        # trainer's _supervise_server and _infer_coalesce_window drive
+        # either core unchanged.
+        # lint: thread-shared-ok(single-writer latch: only the dying serve thread writes; readers re-read after is_alive() turns false)
+        self._fatal: BaseException | None = None
+        # lint: thread-shared-ok(GIL-atomic float stamp; the watchdog reads staleness only)
+        self.heartbeat = time.monotonic()
+        self.coalesce_rounds = 0  # lint: thread-shared-ok(GIL-atomic int; single-writer, metrics-only reader)
+        self.coalesce_rows = 0  # lint: thread-shared-ok(GIL-atomic int; single-writer, metrics-only reader)
+        self._fault_dispatch = faults.site("serve.dispatch")
+        # Batch slabs, keyed (policy, leaf position): policies with
+        # different request shapes never thrash one slab. Serve-thread-only.
+        self._slabs: dict[Any, np.ndarray] = {}
+        self._counter_full = obs_registry.counter(DISPATCH_FULL_COUNTER)
+        self._counter_deadline = obs_registry.counter(
+            DISPATCH_DEADLINE_COUNTER
+        )
+        # Store-backed default policy: version -> generation conversion
+        # happens on the serve thread (_sync_store); seeded here so the
+        # router serves requests that arrive before the first dispatch.
+        self._store_version = -1  # serve-thread-only after construction
+        if store is not None:
+            params, version = store.get()
+            self._router.install(DEFAULT_POLICY, params)
+            self._store_version = version
+
+    @property
+    def router(self) -> PolicyRouter:
+        """The policy map — external publishers (population, self-play)
+        install/publish through this."""
+        return self._router
+
+    @property
+    def slo(self) -> SLOGate:
+        return self._slo
+
+    # ------------------------------------------------------------- client
+
+    def client(
+        self,
+        index: int,
+        policy: str = DEFAULT_POLICY,
+        deadline_ms: float | None = None,
+    ) -> Callable:
+        """A drop-in replacement for the jitted inference callable (same
+        signature per ``mode``; params/key arguments are ignored — the
+        server serves ``policy``'s latest generation under its own key
+        stream). ``deadline_ms`` overrides the core's admission deadline
+        for this client — the per-client latency-target knob."""
+        if not 0 <= index < self._n:
+            raise IndexError(f"client index {index} out of range 0..{self._n - 1}")
+        deadline_s = (
+            deadline_ms / 1e3 if deadline_ms is not None else self._deadline_s
+        )
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        with self._cond:
+            self._client_policy[index] = policy
+
+        def call(params, obs, key, *rest):
+            del params  # the router serves the policy's latest generation
+            out = self._submit(
+                index, policy, (np.asarray(obs), *rest), deadline_s
+            )
+            if self._mode in ("rec", "rec_eps"):
+                actions, logp, core = out
+                return actions, logp, key, core
+            actions, logp = out
+            return actions, logp, key
+
+        return call
+
+    def _closed(self) -> bool:
+        return self._stop_event.is_set() or not self.is_alive()
+
+    def _submit(self, index, policy, args, deadline_s):  # thread-entry: serve-client@actor
+        # Admission gate FIRST: a shed/backpressured request never costs a
+        # queue slot. Blocked time traces as serve.admit_wait. A gate wait
+        # interrupted by server death re-raises the REAL latched cause,
+        # never a bland closure (and never a fake shed).
+        try:
+            self._slo.admit(stop=self._closed)
+        except ServerClosed:
+            if self._fatal is not None:
+                raise self._fatal
+            raise
+        try:
+            arrival = time.monotonic()
+            request = _Request(
+                index, policy, args, int(args[0].shape[0]),
+                arrival, arrival + deadline_s,
+            )
+            with self._cond:
+                self._pending.append(request)
+                self._cond.notify_all()
+        # lint: broad-except-ok(not a swallow: un-counts the admitted request in the SLO gate, then re-raises the original failure)
+        except BaseException:
+            self._slo.abandoned()
+            raise
+        while not request.event.wait(timeout=0.2):
+            if self._closed():
+                self._slo.abandoned()
+                if self._fatal is not None:
+                    raise self._fatal
+                raise ServerClosed("serve core stopped")
+        if self._fatal is not None:
+            # Integrity violation: no delivered content can be trusted.
+            self._slo.abandoned()
+            raise self._fatal
+        if request.error is not None:
+            self._slo.abandoned()
+            raise request.error
+        if request.result is None:
+            # Shutdown wakeup raced the wait: neither result nor error.
+            self._slo.abandoned()
+            raise ServerClosed("serve core stopped")
+        # Served: close the SLO accounting with the true client-observed
+        # latency (queue + fill + dispatch + slicing).
+        self._slo.finished(1e3 * (time.monotonic() - request.arrival))
+        return request.result
+
+    # ------------------------------------------------------------- server
+
+    def run(self) -> None:  # thread-entry: serve-core@server
+        try:
+            if self._device is not None:
+                with jax.default_device(self._device):
+                    self._run()
+            else:
+                self._run()
+        # lint: broad-except-ok(thread boundary: the cause is latched in _fatal and re-raised into every client, same contract as InferenceServer.run)
+        except BaseException as e:
+            self._fatal = e
+            import sys
+
+            print(
+                f"ServeCore: fatal {type(e).__name__}: {e}", file=sys.stderr
+            )
+        finally:
+            # Wake every queued client so it observes the closed server;
+            # in-dispatch requests already got results or errors.
+            with self._cond:
+                leftovers = list(self._pending)
+                self._pending.clear()
+            for request in leftovers:
+                request.event.set()
+
+    def _run(self) -> None:
+        while not self._stop_event.is_set():
+            self.heartbeat = time.monotonic()
+            batch, reason = self._admit()
+            if batch:
+                if self._fault_dispatch is not None:
+                    # Outside _dispatch's per-request try: an injected
+                    # crash kills the SERVE CORE (latched in _fatal,
+                    # rebuilt by the trainer's supervisor), not one batch.
+                    self._fault_dispatch.fire(stop=self._stop_event.is_set)
+                self._dispatch(batch, reason)
+        # Clean stop: retire superseded generations (no-op in steady
+        # state; traced as serve.swap_drain when it actually waits).
+        self._router.drain(timeout_s=0.5, stop=None)
+
+    def _policy_clients_locked(self, policy: str) -> int:  # holds: _cond
+        return sum(1 for p in self._client_policy.values() if p == policy)
+
+    def _admit(self) -> tuple[list[_Request], str]:
+        """Continuous-batching admission: pick the oldest request's policy
+        and hold its batch open (``serve.batch_fill``) until the slab is
+        full — every registered client of the policy has a request in, or
+        the row cap is hit — or the oldest deadline expires. Returns the
+        admitted group (removed from the queue, arrival order) and the
+        dispatch reason ("full" | "deadline")."""
+        with self._cond:
+            with trace.span(span_names.SERVER_COLLECT_WAIT):
+                self._cond.wait_for(
+                    lambda: self._stop_event.is_set() or bool(self._pending),
+                    timeout=0.1,
+                )
+            if self._stop_event.is_set() or not self._pending:
+                return [], ""
+            oldest = self._pending[0]
+            policy = oldest.policy
+            reason = "deadline"
+            with trace.span(span_names.SERVE_BATCH_FILL):
+                while not self._stop_event.is_set():
+                    group = [
+                        r for r in self._pending if r.policy == policy
+                    ]
+                    rows = sum(r.rows for r in group)
+                    target = self._policy_clients_locked(policy)
+                    if target and len(group) >= target:
+                        reason = "full"
+                        break
+                    if self._max_rows and rows >= self._max_rows:
+                        reason = "full"
+                        break
+                    remaining = oldest.deadline - time.monotonic()
+                    if remaining <= 0:
+                        reason = "deadline"
+                        break
+                    count = len(self._pending)
+                    self._cond.wait_for(
+                        lambda: self._stop_event.is_set()
+                        or len(self._pending) != count,
+                        timeout=min(remaining, 0.05),
+                    )
+            # Select in arrival order up to the row cap; the remainder
+            # stays queued for the next dispatch (its own deadline clock
+            # is already running).
+            selected: list[_Request] = []
+            rows = 0
+            for request in list(self._pending):
+                if request.policy != policy:
+                    continue
+                if (
+                    selected
+                    and self._max_rows
+                    and rows + request.rows > self._max_rows
+                ):
+                    break
+                selected.append(request)
+                rows += request.rows
+            for request in selected:
+                self._pending.remove(request)
+            return selected, reason
+
+    def _sync_store(self) -> None:
+        """Convert the trainer's ParamStore publishes into router
+        generations: one zero-drain install per NEW store version, on the
+        serve thread, before the dispatch that first serves it."""
+        if self._store is None:
+            return
+        params, version = self._store.get()
+        if version != self._store_version:
+            self._store_version = version
+            self._router.publish(DEFAULT_POLICY, params)
+
+    def _dispatch(self, group: list[_Request], reason: str) -> None:
+        if self._debug:
+            # Checked before any delivery so a violation cannot poison
+            # already-served clients; raised outside the per-request try
+            # so it escalates (fatal), same policy as InferenceServer.
+            occupied = [
+                r.client for r in group
+                if r.result is not None or r.error is not None
+            ]
+            if occupied:
+                raise InvariantViolation(
+                    f"serve-core handshake invariant broken: request(s) "
+                    f"from client(s) {occupied} dispatched while occupied"
+                )
+        (
+            self._counter_full if reason == "full"
+            else self._counter_deadline
+        ).inc()
+        # Outside the per-request try: a failed swap (serve.swap chaos
+        # included) is an infrastructure failure that kills the CORE —
+        # recorded in _fatal, rebuilt by the supervisor — never a
+        # per-request error that would silently serve stale weights.
+        self._sync_store()
+        try:
+            with trace.span(span_names.SERVE_DISPATCH):
+                policy = group[0].policy
+                # Generation lease: THE zero-drain pin. Held across the
+                # whole batched call — a concurrent publish installs g+1
+                # for the NEXT dispatch while this batch finishes on g;
+                # mixed-generation batches are impossible by construction.
+                params, generation, slots = self._router.lease(policy)
+                try:
+                    sizes = [r.rows for r in group]
+                    merged = coalesce_args(
+                        self._slabs, policy,
+                        [r.args for r in group], sum(sizes),
+                    )
+                    out = self._fn(
+                        params, merged[0], self._key, *merged[1:]
+                    )
+                    if self._mode in ("rec", "rec_eps"):
+                        actions, logp, self._key, core = out
+                    else:
+                        actions, logp, self._key = out
+                        core = None
+                    # Blocks until the batched call finishes — the input
+                    # slabs are consumed (safe to repack next round) and
+                    # the generation's device work is complete before the
+                    # lease releases.
+                    actions = np.asarray(actions)
+                    logp = np.asarray(logp)
+                    if core is not None and _on_cpu(core):
+                        # Host-pinned core: hand back numpy VIEWS, not
+                        # per-client device slices (the cpu_async rule,
+                        # same as InferenceServer._serve).
+                        core = jax.tree.map(np.asarray, core)
+                finally:
+                    slots.release(generation)
+            offsets = np.cumsum([0] + sizes)
+            self.coalesce_rounds += 1
+            self.coalesce_rows += int(offsets[-1])
+            for request, a, b in zip(group, offsets[:-1], offsets[1:]):
+                if core is None:
+                    request.result = (actions[a:b], logp[a:b])
+                else:
+                    request.result = (
+                        actions[a:b], logp[a:b], _slice(core, a, b)
+                    )
+                request.generation = generation
+                request.event.set()
+        # lint: broad-except-ok(per-request boundary: the failure is delivered to every admitted client, then the core keeps serving — same contract as InferenceServer._serve)
+        except BaseException as e:
+            for request in group:
+                request.error = e
+                request.event.set()

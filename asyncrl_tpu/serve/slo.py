@@ -1,0 +1,219 @@
+"""SLOs and admission control for the serving core.
+
+A serving system that faces real traffic needs an answer to overload that
+is better than "queues grow until everything is slow": per-client latency
+targets, a measurement of whether they hold (p50/p95/p99 through the obs
+histogram registry), and an admission gate that **sheds or backpressures**
+new traffic the moment the p95 breaches target — so the requests that ARE
+admitted still meet their SLO instead of everyone missing together.
+
+The gate is a token bucket whose refill is **completion-driven during
+breach**: while the rolling p95 is inside target, admission is free
+(subject only to the ``max_inflight`` cap); the moment p95 breaches, each
+admission consumes a token and each request *completion* refills one —
+admission locks step with service rate (one-in-one-out), inflight stops
+growing, and the rolling window recovers. Out of breach the bucket refills
+to its burst instantly. This needs no tuned rate constant: the service
+rate itself is the refill clock, which is the only rate that is always
+correct.
+
+Two overload responses, chosen per gate:
+
+- ``shed=False`` (backpressure, the trainer's mode): admission *blocks*
+  until a token frees. Actor threads slow down instead of erroring — the
+  pipeline's natural flow control. The blocked time is the client-side
+  ``serve.admit_wait`` span, so the obs report attributes it ("clients
+  held at the serve admission gate — the server is the bottleneck").
+- ``shed=True`` (external-traffic mode): admission raises
+  :class:`RequestShed` immediately. The caller (a front-end, a retry
+  layer) owns the retry policy; the serve core stays inside target.
+
+Counters (obs/registry.py, drained into every metrics window):
+``server_overload`` — admissions that found the gate in breach;
+``serve_shed`` — requests refused. Latency observations feed the
+``serve_latency_ms`` histogram (p50/p95/p99/max exported per window).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
+from asyncrl_tpu.rollout.inference_server import ServerClosed
+
+LATENCY_HISTOGRAM = "serve_latency_ms"
+OVERLOAD_COUNTER = "server_overload"
+SHED_COUNTER = "serve_shed"
+
+
+class RequestShed(RuntimeError):
+    """Raised to a client whose request was refused by a shedding
+    admission gate (p95 over target, no tokens). Deliberately a plain
+    RuntimeError subclass: an in-repo client that cannot tolerate sheds
+    (an actor thread) should not enable shed mode, not special-case it."""
+
+
+class SLOGate:
+    """Latency-target admission gate (see module doc).
+
+    ``p95_target_ms=0`` disables breach detection (the gate only enforces
+    ``max_inflight``); ``max_inflight=0`` removes the inflight cap. The
+    default-constructed gate is therefore a no-op on the admit path — the
+    trainer's serve core costs nothing until targets are configured.
+    """
+
+    def __init__(
+        self,
+        p95_target_ms: float = 0.0,
+        max_inflight: int = 0,
+        shed: bool = False,
+        window: int = 512,
+    ):
+        if p95_target_ms < 0:
+            raise ValueError(f"p95_target_ms must be >= 0: {p95_target_ms}")
+        if max_inflight < 0:
+            raise ValueError(f"max_inflight must be >= 0: {max_inflight}")
+        self.p95_target_ms = p95_target_ms
+        self.max_inflight = max_inflight
+        self.shed = shed
+        self._cond = threading.Condition()
+        # Rolling latency window (ms); sorted on demand only when a target
+        # is configured — the disabled gate never pays for it.
+        self._lat: deque[float] = deque(maxlen=window)  # guarded-by: _cond
+        self._inflight = 0  # guarded-by: _cond
+        # Token bucket: burst tokens available outside breach; during
+        # breach each admit consumes one and each finish refills one.
+        self._burst = max(1, max_inflight) if max_inflight else 1
+        self._tokens = float(self._burst)  # guarded-by: _cond
+        # Cached rolling p95 (ms), refreshed only where the window
+        # mutates — the admit path reads it O(1).
+        self._p95_cache = 0.0  # guarded-by: _cond
+        self._counter_overload = obs_registry.counter(OVERLOAD_COUNTER)
+        self._counter_shed = obs_registry.counter(SHED_COUNTER)
+        self._histogram = obs_registry.histogram(LATENCY_HISTOGRAM)
+
+    # ------------------------------------------------------------ metrics
+
+    def _recompute_p95_locked(self) -> None:  # holds: _cond
+        """Refresh the cached p95. Called ONLY where the window mutates
+        (:meth:`finished`) — once per completion, never per admission
+        attempt, so the per-request admit path stays O(1) (the
+        obs/registry discipline: instrumentation must never be a hot-path
+        cost)."""
+        if not self._lat:
+            self._p95_cache = 0.0
+            return
+        ordered = sorted(self._lat)
+        rank = max(0, min(len(ordered) - 1, int(0.95 * len(ordered))))
+        self._p95_cache = ordered[rank]
+
+    def p95_ms(self) -> float:
+        """Rolling-window p95 latency (ms) — the breach signal. With no
+        target configured the cache is not maintained on the hot path, so
+        this diagnostic read recomputes on demand."""
+        with self._cond:
+            if self.p95_target_ms <= 0:
+                self._recompute_p95_locked()
+            return self._p95_cache
+
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+    def _in_breach_locked(self) -> bool:  # holds: _cond
+        return (
+            self.p95_target_ms > 0
+            and self._p95_cache > self.p95_target_ms
+        )
+
+    # ---------------------------------------------------------- admission
+
+    def admit(
+        self,
+        stop: Callable[[], bool] | None = None,
+        timeout_s: float = 30.0,
+    ) -> None:
+        """Admit one request or refuse it.
+
+        Returns when admitted (inflight is counted from here — pair with
+        :meth:`finished`). Raises :class:`RequestShed` in shed mode when
+        the gate is in breach with no tokens, or — in backpressure mode —
+        when ``timeout_s`` elapses without admission (a bounded wait, so a
+        dead server cannot wedge clients in the gate forever). A ``stop``
+        predicate turning true raises :class:`ServerClosed` instead — the
+        server died, which must never masquerade as load shedding (the
+        caller re-raises its real fatal cause). Blocked time is the
+        ``serve.admit_wait`` span."""
+        deadline = time.monotonic() + timeout_s
+        overload_counted = False
+        with trace.span(span_names.SERVE_ADMIT_WAIT):
+            with self._cond:
+                while True:
+                    if stop is not None and stop():
+                        raise ServerClosed(
+                            "serve core stopped while a request waited at "
+                            "the admission gate"
+                        )
+                    capped = (
+                        self.max_inflight > 0
+                        and self._inflight >= self.max_inflight
+                    )
+                    breach = self._in_breach_locked()
+                    if breach and not overload_counted:
+                        # Once per request, not per wait iteration.
+                        overload_counted = True
+                        self._counter_overload.inc()
+                    if not capped and (not breach or self._tokens >= 1.0):
+                        if breach:
+                            self._tokens -= 1.0
+                        self._inflight += 1
+                        return
+                    if self.shed:
+                        self._counter_shed.inc()
+                        raise RequestShed(
+                            "serve admission refused: "
+                            + (
+                                f"p95 {self._p95_cache:.1f}ms over "
+                                f"target {self.p95_target_ms:.1f}ms"
+                                if breach
+                                else f"inflight cap {self.max_inflight} "
+                                "reached"
+                            )
+                        )
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self._counter_shed.inc()
+                        raise RequestShed(
+                            "serve admission timed out under backpressure "
+                            f"({timeout_s:.1f}s)"
+                        )
+                    self._cond.wait(timeout=min(remaining, 0.05))
+
+    def finished(self, latency_ms: float) -> None:
+        """Record one completed request: feeds the latency window and the
+        registry histogram, refills one token during breach, and wakes
+        backpressured admitters."""
+        self._histogram.observe(latency_ms)
+        with self._cond:
+            self._inflight -= 1
+            self._lat.append(latency_ms)
+            if self.p95_target_ms > 0:
+                self._recompute_p95_locked()
+            if self._tokens < self._burst:
+                self._tokens += 1.0
+            self._cond.notify_all()
+
+    def abandoned(self) -> None:
+        """Un-count an admitted request that never reached dispatch (its
+        submit failed between gate and queue). No latency observation —
+        the request was not served."""
+        with self._cond:
+            self._inflight -= 1
+            if self._tokens < self._burst:
+                self._tokens += 1.0
+            self._cond.notify_all()

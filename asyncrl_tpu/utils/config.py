@@ -182,6 +182,34 @@ class Config:
     # env step (the podracer inference-thread design). Pays off with many
     # threads and/or a high-latency device link; off = per-thread dispatch.
     inference_server: bool = False
+    # --- policy serving (asyncrl_tpu/serve/; applies when the shared
+    # server is on) ---
+    # Serve core vs legacy coalescing server: with serve=True the shared
+    # server is the continuous-batching ServeCore (deadline-based
+    # admission, SLO gate, multi-policy router, generation-stamped
+    # zero-drain weight swaps); False keeps the legacy fixed-round
+    # InferenceServer for A/B measurement (scripts/serve_smoke.sh).
+    # ASYNCRL_SERVE (when set) wins over this flag, like ASYNCRL_TRACE.
+    serve: bool = True
+    # Admission deadline budget per request, ms: a batch dispatches when
+    # every registered client of its policy has a request in (slab full)
+    # or when the OLDEST admitted request has waited this long (deadline
+    # flush, partial batch) — whichever comes first.
+    serve_deadline_ms: float = 2.0
+    # SLO target on the rolling p95 serve latency, ms: when breached, the
+    # admission gate sheds (serve_shed=True) or backpressures new
+    # requests until p95 recovers (the server_overload counter records
+    # breaches; serve_latency_ms_p50/p95/p99 export per window). 0 = off.
+    serve_slo_p95_ms: float = 0.0
+    # Hard cap on admitted-but-unfinished requests (the gate blocks — or
+    # sheds, under serve_shed — at the cap). 0 = uncapped.
+    serve_max_inflight: int = 0
+    # Overload response: True = refuse (RequestShed) at the admission
+    # gate; False = backpressure (block the client until capacity frees).
+    # Training keeps the default False — actor threads must slow down,
+    # not crash; shed mode is for external-traffic front-ends that own a
+    # retry policy.
+    serve_shed: bool = False
     # Zero-copy overlapped actor→learner data path (rollout/staging.py):
     # actors write fragments straight into preallocated pinned staging
     # slabs (no per-fragment emit copy, no per-drain np.stack) and the

@@ -9,6 +9,8 @@ registry of *named fault sites* threaded through the hot paths —
 - ``actor.step``        each ActorThread env-step iteration
 - ``actor.queue_put``   the actor->learner fragment handoff
 - ``server.serve``      each InferenceServer batched serve
+- ``serve.dispatch``    each ServeCore batched dispatch (serve/scheduler.py)
+- ``serve.swap``        each PolicyRouter param publish (serve/router.py)
 - ``pool.step``         inside the host env pool's batched step
 - ``checkpoint.save``   each Checkpointer save attempt
 - ``checkpoint.restore``each Checkpointer restore attempt
@@ -59,6 +61,8 @@ SITES = (
     "actor.step",
     "actor.queue_put",
     "server.serve",
+    "serve.dispatch",
+    "serve.swap",
     "pool.step",
     "checkpoint.save",
     "checkpoint.restore",

@@ -1,0 +1,144 @@
+#!/usr/bin/env bash
+# Serve smoke: latency/throughput A/B of the continuous-batching serve
+# core (config.serve=True, asyncrl_tpu/serve/) against the legacy
+# coalescing InferenceServer (serve=False) on a short CPU sebulba run.
+# Gates:
+#   - throughput: the serve core must not be slower than the legacy
+#     server beyond ASYNCRL_SERVE_TOLERANCE (default 1.10 — this 1-core
+#     box's scheduler noise swings identical configs run to run, see
+#     perf_smoke.sh; the strict comparison belongs on quiet hardware),
+#   - latency: the serve core's p95 serve latency must stay within
+#     ASYNCRL_SERVE_P95_MS (default 250 ms — generous for a shared CI
+#     box; tighten on real serving hardware),
+#   - liveness: the serve run must export p50/p95/p99 latency and at
+#     least one dispatch through the metrics window.
+#
+# Same measurement discipline as trace_smoke.sh: discard a process
+# warm-up run, then alternate legacy/serve and take best-of-N per mode.
+#
+# Usage: scripts/serve_smoke.sh                    # CPU, ~1-2 min
+#        ASYNCRL_SMOKE_UPDATES=64 scripts/serve_smoke.sh
+#        ASYNCRL_SERVE_TOLERANCE=1.20 scripts/serve_smoke.sh  # noisy box
+#        ASYNCRL_SMOKE_RECORD=1 scripts/serve_smoke.sh  # append the A/B as
+#          a kind="serving" probe="serve_ab" row to BENCH_HISTORY.json
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+UPDATES="${ASYNCRL_SMOKE_UPDATES:-24}"
+TOLERANCE="${ASYNCRL_SERVE_TOLERANCE:-1.10}"
+P95_BUDGET_MS="${ASYNCRL_SERVE_P95_MS:-250}"
+RECORD="${ASYNCRL_SMOKE_RECORD:-0}"
+
+python - "$UPDATES" "$TOLERANCE" "$P95_BUDGET_MS" "$RECORD" <<'EOF'
+import sys
+import time
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.configs import presets
+
+updates, tolerance = int(sys.argv[1]), float(sys.argv[2])
+p95_budget_ms = float(sys.argv[3])
+record = sys.argv[4] not in ("", "0")
+NUM_ENVS, UNROLL, THREADS = 16, 16, 2
+steps = updates * NUM_ENVS * UNROLL
+
+
+def run(serve: bool):
+    cfg = presets.get("pong_impala").replace(
+        backend="sebulba", host_pool="jax", num_envs=NUM_ENVS,
+        actor_threads=THREADS, unroll_len=UNROLL, precision="f32",
+        log_every=4, seed=3, hidden_sizes=(64, 64),
+        actor_staleness=1_000_000, inference_server=True, serve=serve,
+    )
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=NUM_ENVS * UNROLL)  # jit warm-up
+        t0 = time.perf_counter()
+        history = agent.train(total_env_steps=NUM_ENVS * UNROLL + steps)
+        elapsed = time.perf_counter() - t0
+    finally:
+        agent.close()
+    fps = steps / elapsed
+    last = history[-1]
+    label = "serve-core" if serve else "legacy    "
+    lat = {
+        q: float(last.get(f"serve_latency_ms_{q}", 0.0))
+        for q in ("p50", "p95", "p99")
+    }
+    if serve:
+        print(
+            f"serve_smoke {label}: fps={fps:12,.0f}  "
+            f"p50={lat['p50']:.1f}ms p95={lat['p95']:.1f}ms "
+            f"p99={lat['p99']:.1f}ms  "
+            f"dispatch_full={int(last.get('serve_dispatch_full', 0))} "
+            f"deadline={int(last.get('serve_dispatch_deadline', 0))}"
+        )
+    else:
+        print(f"serve_smoke {label}: fps={fps:12,.0f}")
+    return fps, last, lat
+
+
+# Best-of-N per mode, alternating (the perf_smoke/trace_smoke discipline
+# for this 1-core box's scheduler noise).
+run(True)  # discarded process warm-up
+fps_legacy, _, _ = run(False)
+fps_serve, last_serve, lat = run(True)
+for _ in range(2):
+    f, _, _ = run(False)
+    fps_legacy = max(fps_legacy, f)
+    f, cand_last, cand_lat = run(True)
+    if f > fps_serve:
+        fps_serve, last_serve, lat = f, cand_last, cand_lat
+
+# Liveness gate: the serve run must have exported the latency taxonomy
+# and dispatched through the continuous-batching scheduler.
+for key in ("serve_latency_ms_p50", "serve_latency_ms_p95",
+            "serve_latency_ms_p99"):
+    if key not in last_serve:
+        sys.exit(f"serve_smoke FAILED: {key} missing from metrics window")
+dispatches = last_serve.get("serve_dispatch_full", 0) + last_serve.get(
+    "serve_dispatch_deadline", 0
+)
+if dispatches <= 0:
+    sys.exit("serve_smoke FAILED: serve core recorded no dispatches")
+
+if lat["p95"] > p95_budget_ms:
+    sys.exit(
+        f"serve_smoke FAILED: p95 serve latency {lat['p95']:.1f}ms over "
+        f"budget {p95_budget_ms:.0f}ms"
+    )
+if fps_serve * tolerance < fps_legacy:
+    sys.exit(
+        f"serve_smoke FAILED: serve core slower than legacy beyond budget "
+        f"({fps_serve:,.0f} vs {fps_legacy:,.0f} fps, tolerance "
+        f"{tolerance}x)"
+    )
+print(
+    f"serve_smoke OK: serve {fps_serve:,.0f} fps vs legacy "
+    f"{fps_legacy:,.0f} fps ({fps_serve / fps_legacy:.3f}x, budget "
+    f"{tolerance}x); p95 {lat['p95']:.1f}ms <= {p95_budget_ms:.0f}ms"
+)
+
+if record:
+    from asyncrl_tpu.utils import bench_history
+
+    entry = bench_history.record({
+        "kind": "serving",
+        "probe": "serve_ab",
+        "preset": "pong_impala(sebulba tiny)",
+        **bench_history.device_entry(),
+        "num_envs": NUM_ENVS,
+        "actor_threads": THREADS,
+        "unroll_len": UNROLL,
+        "updates": updates,
+        "fps_serve": round(fps_serve),
+        "fps_legacy": round(fps_legacy),
+        "serve_speedup": round(fps_serve / fps_legacy, 3),
+        "serve_latency_ms_p50": round(lat["p50"], 2),
+        "serve_latency_ms_p95": round(lat["p95"], 2),
+        "serve_latency_ms_p99": round(lat["p99"], 2),
+        "p95_budget_ms": p95_budget_ms,
+    })
+    print("serve_smoke: recorded", entry["ts"])
+EOF

@@ -112,6 +112,7 @@ QUICK: dict[str, object] = {
         "test_single_crash_in_actor_path_is_recovered",  # 3 sites, ~20s
         "test_eval_pools_step_unarmed",  # 3s
         "test_server_crash_is_recovered_and_counted",  # 7s
+        "test_serve_core_crash_is_rebuilt_without_dropping_fleet",  # 2 sites, ~12s
         "test_watchdog_restarts_stalled_actor",  # 8s
         "test_restart_storm_aborts_instead_of_churning",  # 4s
         "test_native_pool_close_is_idempotent",
@@ -119,6 +120,12 @@ QUICK: dict[str, object] = {
         "test_recovery_counters_flow_through_sinks",
         "test_threads_are_named_and_fault_messages_identify_threads",  # 2s
     },
+    # Serving core (asyncrl_tpu/serve/, ISSUE 6): params/router/SLO units
+    # are sub-second; the dispatch/routing/storm tests are a few seconds
+    # each and the two trainer e2e paths ~15s combined. Tier-1 by the
+    # ISSUE 6 acceptance contract (zero-drain swaps proven by test on
+    # every PR). Whole file ~30s.
+    "test_serve.py": "all",
     # Observability (asyncrl_tpu/obs/, ISSUE 5): ring/export/report/
     # registry units are sub-second; the two pipeline smokes (the
     # fault-injected flight-recorder acceptance run and the disabled-mode

@@ -170,10 +170,12 @@ def test_single_crash_in_actor_path_is_recovered(site):
 
 @pytest.mark.chaos
 def test_server_crash_is_recovered_and_counted():
-    """An exception escaping the InferenceServer loop kills the server;
-    the supervisor rebuilds it, actors re-wire, training completes."""
+    """An exception escaping the LEGACY InferenceServer loop kills the
+    server; the supervisor rebuilds it, actors re-wire, training completes
+    (serve=False pins the legacy core — its serve-core twin is
+    test_serve_core_crash_is_rebuilt_without_dropping_fleet)."""
     cfg = _chaos_config(
-        inference_server=True,
+        inference_server=True, serve=False,
         fault_spec="server.serve:crash:1.0:0:max=1",
     )
     agent = make_agent(cfg)
@@ -183,6 +185,45 @@ def test_server_crash_is_recovered_and_counted():
         assert agent._server_restarts >= 1
         assert history[-1]["server_restarts"] >= 1
         assert history[-1]["fault_server.serve"] == 1
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", ["serve.dispatch", "serve.swap"])
+def test_serve_core_crash_is_rebuilt_without_dropping_fleet(site):
+    """A crash injected into the serve core's dispatch or swap path kills
+    the core; the supervisor rebuilds it WITHOUT dropping the actor fleet
+    — every metrics window still sees a full cohort of actor slots, and
+    training reaches its target. (serve.swap fires on the router publish
+    path — the first ParamStore version change the core syncs.)"""
+    cfg = _chaos_config(
+        inference_server=True,
+        fault_spec=f"{site}:crash:1.0:0:max=1",
+    )
+    agent = make_agent(cfg)
+    try:
+        fleet = []
+
+        def watch(window):
+            fleet.append(
+                (
+                    len(agent._actors),
+                    sum(a.is_alive() for a in agent._actors),
+                )
+            )
+
+        history = agent.train(
+            total_env_steps=_train_steps(cfg), callback=watch
+        )
+        assert agent.env_steps >= _train_steps(cfg)
+        assert agent._server_restarts >= 1
+        assert history[-1]["server_restarts"] >= 1
+        assert history[-1][f"fault_{site}"] == 1
+        # The fleet was never dropped: every window saw every actor slot
+        # filled, and the run reached full health (all threads alive).
+        assert fleet and all(n == cfg.actor_threads for n, _ in fleet)
+        assert any(alive == cfg.actor_threads for _, alive in fleet)
     finally:
         agent.close()
 
@@ -428,9 +469,10 @@ def test_recovery_counters_flow_through_sinks(tmp_path):
 
 def test_threads_are_named_and_fault_messages_identify_threads():
     """Every spawned worker thread carries a stable name (actor-<i>,
-    inference-server), and an injected fault's message names the thread
-    that hit it — so watchdog logs, linter reports (the analysis pass's
-    thread-entry map), and chaos logs all identify threads consistently."""
+    serve-core / inference-server), and an injected fault's message names
+    the thread that hit it — so watchdog logs, linter reports (the
+    analysis pass's thread-entry map), and chaos logs all identify
+    threads consistently."""
     import threading
 
     cfg = _chaos_config(inference_server=True)
@@ -439,9 +481,16 @@ def test_threads_are_named_and_fault_messages_identify_threads():
         agent._start_actors()
         names = sorted(t.name for t in agent._actors)
         assert names == [f"actor-{i}" for i in range(cfg.actor_threads)]
-        assert agent._server.name == "inference-server"
+        assert agent._server.name == "serve-core"
     finally:
         agent.close()
+
+    legacy = make_agent(cfg.replace(serve=False))
+    try:
+        legacy._start_actors()
+        assert legacy._server.name == "inference-server"
+    finally:
+        legacy.close()
 
     site = faults.FaultRegistry("actor.step:crash:1.0:0").site("actor.step")
     captured = []

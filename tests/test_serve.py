@@ -1,0 +1,650 @@
+"""Serving core (asyncrl_tpu/serve/): generation-stamped zero-drain weight
+swaps, continuous-batching dispatch (deadline-flush vs slab-full), SLO
+admission control (shed + backpressure), multi-policy routing, and the
+SebulbaTrainer end-to-end path on the serve core."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.rollout.inference_server import InferenceServer
+from asyncrl_tpu.rollout.sebulba import ParamStore
+from asyncrl_tpu.serve import (
+    DEFAULT_POLICY,
+    ParamSlots,
+    PolicyRouter,
+    RequestShed,
+    SLOGate,
+    ServeCore,
+    UnknownPolicyError,
+    selfplay_policies,
+)
+from asyncrl_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Serve counters/histograms are process-wide registry instruments;
+    every test starts from a clean slate (the trainer's obs.setup does
+    the same at construction)."""
+    obs_registry.registry().reset()
+    yield
+    obs_registry.registry().reset()
+
+
+def _mk_core(fn, n, store=None, router=None, mode="ff", deadline_ms=20.0,
+             slo=None, max_batch_rows=0, seed=0):
+    stop = threading.Event()
+    core = ServeCore(
+        fn, store=store, router=router, num_clients=n, stop_event=stop,
+        mode=mode, seed=seed, deadline_ms=deadline_ms, slo=slo,
+        max_batch_rows=max_batch_rows,
+    )
+    core.start()
+    return core, stop
+
+
+def _join(core, stop):
+    stop.set()
+    core.join(timeout=5)
+    assert not core.is_alive()
+
+
+# --------------------------------------------------------- ParamSlots units
+
+
+def test_param_slots_zero_drain_swap_protocol():
+    """install never blocks; a leased generation survives its supersession
+    until released, then retires; the latest slot is never retired."""
+    slots = ParamSlots({"w": 0})
+    params, g0 = slots.lease()
+    assert params == {"w": 0} and g0 == 0
+
+    g1 = slots.install({"w": 1})  # returns immediately, lease still out
+    assert g1 == 1 and slots.latest() == 1
+    assert slots.generations() == [0, 1]  # g0 pinned by the lease
+
+    # New leases pick up the NEW generation while g0 is still in flight.
+    params1, g = slots.lease()
+    assert g == 1 and params1 == {"w": 1}
+    slots.release(g)
+
+    assert not slots.drain(timeout_s=0.05)  # g0 still pinned
+    slots.release(g0)
+    assert slots.generations() == [1]  # superseded slot retired
+    assert slots.drain(timeout_s=0.05)
+    assert slots.installs() == 1
+
+
+def test_param_slots_release_pairing_enforced():
+    slots = ParamSlots({"w": 0})
+    with pytest.raises(RuntimeError, match="release"):
+        slots.release(0)
+    _, g = slots.lease()
+    slots.release(g)
+    with pytest.raises(RuntimeError, match="release"):
+        slots.release(g)
+
+
+# ------------------------------------------------------------- router units
+
+
+def test_router_register_publish_lease_and_unknown():
+    router = PolicyRouter()
+    router.register("a", {"w": 1.0})
+    with pytest.raises(ValueError, match="already registered"):
+        router.register("a", {"w": 2.0})
+    router.install("a", {"w": 2.0})  # install = publish for known policy
+    params, gen, slots = router.lease("a")
+    assert params == {"w": 2.0} and gen == 1
+    slots.release(gen)
+    with pytest.raises(UnknownPolicyError):
+        router.publish("nope", {})
+    with pytest.raises(UnknownPolicyError):
+        router.lease("nope")
+    assert router.policies() == ["a"]
+    assert router.drain(timeout_s=0.05)
+
+
+def test_router_install_race_is_atomic():
+    """Two publishers racing install() on a not-yet-registered policy:
+    both must succeed (one registers, one swaps) — the check-then-act
+    must happen under one lock hold, never crash on the duplicate
+    register guard."""
+    for trial in range(16):
+        router = PolicyRouter()
+        barrier = threading.Barrier(2)
+        errors = []
+
+        def install(i):
+            try:
+                barrier.wait(timeout=5)
+                router.install("raced", {"w": i})
+            # lint: broad-except-ok(test harness: failures are re-raised via the errors list assertion below)
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=install, args=(i,),
+                             name=f"race-installer-{i}")
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, f"trial {trial}: {errors}"
+        assert router.slots("raced").latest() in (0, 1)
+
+
+def test_selfplay_policies_maps_live_and_opponent():
+    class _State:
+        params = {"w": 1}
+        opponent_params = {"w": 2}
+
+    policies = selfplay_policies(_State())
+    assert policies == {"live": {"w": 1}, "opponent": {"w": 2}}
+
+    class _NoRival:
+        params = {"w": 1}
+        opponent_params = None
+
+    with pytest.raises(ValueError, match="opponent_params"):
+        selfplay_policies(_NoRival())
+
+
+# ------------------------------------------------------------ SLO gate units
+
+
+def test_slo_gate_default_is_noop_and_counts_nothing():
+    gate = SLOGate()
+    for _ in range(8):
+        gate.admit()
+    for _ in range(8):
+        gate.finished(1.0)
+    window = obs_registry.window()
+    assert window["server_overload"] == 0
+    assert window["serve_shed"] == 0
+    assert window["serve_latency_ms_count"] == 8.0
+    assert "serve_latency_ms_p99" in window
+
+
+def test_slo_gate_sheds_on_p95_breach_and_counts_overload():
+    gate = SLOGate(p95_target_ms=10.0, shed=True)
+    gate.admit()
+    gate.finished(100.0)  # p95 now 100ms, way over the 10ms target
+    assert gate.p95_ms() > 10.0
+    gate.admit()  # breach admission consumes the single burst token...
+    with pytest.raises(RequestShed, match="over target"):
+        gate.admit()  # ...so a second concurrent request sheds
+    window = obs_registry.window()
+    assert window["server_overload"] >= 2
+    assert window["serve_shed"] >= 1
+
+
+def test_slo_gate_backpressure_unblocks_on_completion():
+    """In backpressure mode a breached gate admits in lock-step with
+    completions (the completion-driven token refill)."""
+    gate = SLOGate(p95_target_ms=1.0, max_inflight=2, shed=False)
+    # Drive into breach: two served requests at 50ms each.
+    for _ in range(2):
+        gate.admit()
+        gate.finished(50.0)
+    assert gate.p95_ms() > 1.0
+    # Breach admission: the bucket's burst tokens (max_inflight=2) admit
+    # two, then the third BLOCKS until a completion refills a token.
+    gate.admit()
+    gate.admit()
+    released = []
+
+    def admit_third():
+        gate.admit(timeout_s=10.0)
+        released.append(time.monotonic())
+
+    t = threading.Thread(target=admit_third, name="slo-admitter", daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not released, "third admit must backpressure, not pass"
+    gate.finished(50.0)  # completion refills one token
+    t.join(timeout=5.0)
+    assert released, "completion must unblock the backpressured admit"
+    assert gate.inflight() == 2
+
+
+def test_slo_gate_shed_on_backpressure_timeout():
+    gate = SLOGate(max_inflight=1, shed=False)
+    gate.admit()
+    with pytest.raises(RequestShed, match="timed out"):
+        gate.admit(timeout_s=0.1)
+    assert obs_registry.window()["serve_shed"] == 1
+
+
+def test_slo_gate_stop_raises_closed_not_shed():
+    """A blocked admit whose server dies must report closure (so the
+    caller re-raises the real fatal cause), never a fake shed — and must
+    not inflate the serve_shed counter."""
+    from asyncrl_tpu.rollout.inference_server import ServerClosed
+
+    gate = SLOGate(max_inflight=1, shed=False)
+    gate.admit()
+    with pytest.raises(ServerClosed, match="stopped"):
+        gate.admit(stop=lambda: True, timeout_s=10.0)
+    assert obs_registry.window()["serve_shed"] == 0
+
+
+def test_slo_gate_inflight_cap_sheds_immediately_in_shed_mode():
+    gate = SLOGate(max_inflight=1, shed=True)
+    gate.admit()
+    with pytest.raises(RequestShed, match="inflight cap"):
+        gate.admit()
+    gate.abandoned()  # un-count; the slot frees
+    gate.admit()
+
+
+# ----------------------------------------------- continuous-batching dispatch
+
+
+def _det_fn(params, obs, key):
+    """Deterministic, key-free: actions encode obs identity, logp encodes
+    the param value — batch-size independent, so partial and full batches
+    must agree bit-for-bit."""
+    bias = params["bias"]
+    return obs[:, 0].astype(jnp.int32), obs[:, 0] * 0.0 + bias, key
+
+
+def test_slab_full_dispatch_when_every_client_submits():
+    """Both registered clients submitting promptly -> one full-batch
+    dispatch (counter serve_dispatch_full), coalesced rows conserved."""
+    store = ParamStore({"bias": jnp.asarray(0.5)})
+    core, stop = _mk_core(_det_fn, 2, store=store, deadline_ms=2000.0)
+    try:
+        clients = [core.client(i) for i in range(2)]
+        out = [None, None]
+
+        def work(i):
+            obs = np.full((3, 4), 10 * (i + 1), np.float32)
+            out[i] = clients[i](None, obs, None)
+
+        threads = [
+            threading.Thread(target=work, args=(i,), name=f"serve-cl-{i}")
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        for i in range(2):
+            actions, logp, _ = out[i]
+            np.testing.assert_array_equal(actions, 10 * (i + 1))
+            np.testing.assert_allclose(logp, 0.5, rtol=1e-6)
+        # A 2s deadline cannot have flushed: the dispatch was slab-full.
+        window = obs_registry.window()
+        assert window["serve_dispatch_full"] >= 1
+        assert window["serve_dispatch_deadline"] == 0
+        assert core.coalesce_rows == 6
+    finally:
+        _join(core, stop)
+
+
+def test_deadline_flush_serves_partial_batch():
+    """One live client of two: the oldest request's deadline budget
+    expires and a partial batch dispatches (counter
+    serve_dispatch_deadline) — nobody waits on a dead client."""
+    store = ParamStore({"bias": jnp.asarray(0.0)})
+    core, stop = _mk_core(_det_fn, 2, store=store, deadline_ms=30.0)
+    try:
+        core.client(1)  # registered but never submits (the "dead" client)
+        c0 = core.client(0)
+        t0 = time.monotonic()
+        actions, logp, _ = c0(None, np.full((2, 4), 3.0, np.float32), None)
+        took = time.monotonic() - t0
+        np.testing.assert_array_equal(actions, 3)
+        assert took < 5.0, f"deadline flush took {took:.2f}s"
+        window = obs_registry.window()
+        assert window["serve_dispatch_deadline"] >= 1
+        # Latency histogram fed through the SLO gate on the served path.
+        assert window["serve_latency_ms_count"] >= 1
+    finally:
+        _join(core, stop)
+
+
+def test_partial_batches_bit_identical_to_coalesced_reference():
+    """The serve core's partial-batch results equal the legacy
+    InferenceServer's full-batch results bit-for-bit on the same inputs
+    (the deterministic fn makes batching invisible — any slab packing or
+    slicing bug surfaces as a mismatch)."""
+    inputs = [
+        np.arange(12, dtype=np.float32).reshape(3, 4) + 100 * i
+        for i in range(2)
+    ]
+    store = ParamStore({"bias": jnp.asarray(2.5)})
+
+    # Reference: the legacy coalescing server, both clients in one round.
+    ref_stop = threading.Event()
+    ref = InferenceServer(_det_fn, store, 2, ref_stop, max_wait_s=5.0)
+    ref.start()
+    ref_out = [None, None]
+    try:
+        threads = [
+            threading.Thread(
+                target=lambda i=i: ref_out.__setitem__(
+                    i, ref.client(i)(None, inputs[i], None)
+                ),
+                name=f"ref-cl-{i}",
+            )
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+    finally:
+        ref_stop.set()
+        ref.join(timeout=5)
+
+    # Serve core, FORCED partial: client 1 submits only after client 0's
+    # deadline-flushed dispatch completed (two partial batches).
+    core, stop = _mk_core(_det_fn, 2, store=store, deadline_ms=20.0)
+    try:
+        c0, c1 = core.client(0), core.client(1)
+        out0 = c0(None, inputs[0], None)
+        assert core.coalesce_rounds == 1  # first dispatch already done
+        out1 = c1(None, inputs[1], None)
+        assert core.coalesce_rounds == 2  # second was its own partial batch
+        for got, want in ((out0, ref_out[0]), (out1, ref_out[1])):
+            np.testing.assert_array_equal(
+                np.asarray(got[0]), np.asarray(want[0])
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got[1]), np.asarray(want[1])
+            )
+    finally:
+        _join(core, stop)
+
+
+def test_max_batch_rows_caps_a_dispatch():
+    """The row cap dispatches a full slab and leaves the remainder queued
+    (served by the next dispatch) — no request is dropped."""
+    store = ParamStore({"bias": jnp.asarray(0.0)})
+    core, stop = _mk_core(
+        _det_fn, 3, store=store, deadline_ms=50.0, max_batch_rows=4
+    )
+    try:
+        clients = [core.client(i) for i in range(3)]
+        out = [None] * 3
+
+        def work(i):
+            out[i] = clients[i](
+                None, np.full((2, 4), float(i), np.float32), None
+            )
+
+        threads = [
+            threading.Thread(target=work, args=(i,), name=f"cap-cl-{i}")
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        for i in range(3):
+            np.testing.assert_array_equal(np.asarray(out[i][0]), i)
+        assert core.coalesce_rows == 6
+        assert core.coalesce_rounds >= 2  # 6 rows can't fit one 4-row slab
+    finally:
+        _join(core, stop)
+
+
+# ------------------------------------------------------- zero-drain swaps e2e
+
+
+def test_swap_storm_zero_drops_zero_mixed_generations():
+    """Continuous client load + a publisher storming param publishes:
+    every request is answered (zero drops), every batch ran under exactly
+    one generation (zero mixed-generation batches — the fn asserts it),
+    and each client's observed weight sequence is non-decreasing (a swap
+    never serves OLDER weights)."""
+    N_CLIENTS, N_REQS = 3, 40
+    mixed = []
+
+    def fn(params, obs, key):
+        w = np.asarray(params["w"])
+        if w.ndim != 0:  # a torn/mixed params pytree would not be scalar
+            mixed.append(w)
+        return (
+            jnp.zeros(obs.shape[0], jnp.int32),
+            jnp.zeros(obs.shape[0]) + w,  # logp broadcasts the generation
+            key,
+        )
+
+    store = ParamStore({"w": jnp.asarray(0.0)})
+    core, stop = _mk_core(fn, N_CLIENTS, store=store, deadline_ms=5.0)
+    publisher_stop = threading.Event()
+
+    def publisher():
+        version = 0
+        while not publisher_stop.is_set():
+            version += 1
+            store.publish({"w": jnp.asarray(float(version))})
+            time.sleep(0.001)
+
+    pub = threading.Thread(target=publisher, name="param-publisher",
+                           daemon=True)
+    served = [0] * N_CLIENTS
+    failures = []
+
+    def client_loop(i):
+        c = core.client(i)
+        last = -1.0
+        for _ in range(N_REQS):
+            actions, logp, _ = c(
+                None, np.zeros((2, 4), np.float32), None
+            )
+            logp = np.asarray(logp)
+            if not (logp == logp[0]).all():
+                failures.append(
+                    f"client {i}: mixed weights within one result: {logp}"
+                )
+            if logp[0] < last:
+                failures.append(
+                    f"client {i}: weights went backwards "
+                    f"({last} -> {logp[0]})"
+                )
+            last = float(logp[0])
+            served[i] += 1
+
+    try:
+        pub.start()
+        threads = [
+            threading.Thread(target=client_loop, args=(i,),
+                             name=f"storm-cl-{i}", daemon=True)
+            for i in range(N_CLIENTS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not failures, failures
+        assert served == [N_REQS] * N_CLIENTS, f"dropped requests: {served}"
+        assert not mixed, "a batched call saw a non-scalar (torn) params"
+        # The storm actually exercised swaps while serving.
+        assert core.router.slots(DEFAULT_POLICY).installs() >= 1
+        # Zero-drain invariant at rest: superseded generations all retired.
+        assert core.router.drain(timeout_s=2.0)
+    finally:
+        publisher_stop.set()
+        pub.join(timeout=5)
+        _join(core, stop)
+
+
+# -------------------------------------------------------- multi-policy routing
+
+
+def test_multi_policy_routing_returns_each_client_its_own_policy():
+    """Two policies on one core: each client's actions come from ITS
+    policy's params; dispatches never mix policies (per-dispatch row
+    accounting proves grouping)."""
+    router = PolicyRouter()
+    router.register("league/a", {"bias": jnp.asarray(100.0)})
+    router.register("league/b", {"bias": jnp.asarray(200.0)})
+
+    def fn(params, obs, key):
+        bias = params["bias"]
+        return (obs[:, 0] + bias).astype(jnp.int32), obs[:, 0] * 0.0, key
+
+    core, stop = _mk_core(fn, 4, router=router, deadline_ms=30.0)
+    try:
+        policy_of = {0: "league/a", 1: "league/b", 2: "league/a",
+                     3: "league/b"}
+        clients = {
+            i: core.client(i, policy=p) for i, p in policy_of.items()
+        }
+        out = {}
+
+        def work(i):
+            out[i] = clients[i](
+                None, np.full((2, 4), float(i), np.float32), None
+            )
+
+        threads = [
+            threading.Thread(target=work, args=(i,), name=f"route-cl-{i}")
+            for i in policy_of
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        for i, policy in policy_of.items():
+            want = i + (100 if policy == "league/a" else 200)
+            np.testing.assert_array_equal(np.asarray(out[i][0]), want)
+        assert core.coalesce_rows == 8
+    finally:
+        _join(core, stop)
+
+
+def test_population_publishes_policies_served_per_member():
+    """api/population.py as a serve client: every member's params install
+    as member/<i> policies (distinct weights per member), and a serve
+    dispatch under member i's policy answers with member i's weights."""
+    cfg = Config(
+        env_id="CartPole-v1", algo="a3c", backend="tpu", num_envs=4,
+        unroll_len=4, hidden_sizes=(8,), precision="f32",
+    )
+    from asyncrl_tpu.api.population import PopulationTrainer
+
+    trainer = PopulationTrainer(cfg, pop_size=2)
+    try:
+        router = PolicyRouter()
+        ids = trainer.publish_policies(router)
+        assert ids == ["member/0", "member/1"]
+        assert router.policies() == ids
+
+        # Member params are genuinely distinct (different seeds)...
+        leaves0 = jax.tree.leaves(router.slots("member/0").lease()[0])
+        leaves1 = jax.tree.leaves(router.slots("member/1").lease()[0])
+        assert any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(leaves0, leaves1)
+        )
+
+        # ...and the serve path answers each client under ITS member. The
+        # marker is a whole-tree checksum, so ANY leaf difference shows.
+        def _checksum(params):
+            return sum(
+                jnp.sum(jnp.abs(leaf)) for leaf in jax.tree.leaves(params)
+            )
+
+        def fn(params, obs, key):
+            return (
+                jnp.zeros(obs.shape[0], jnp.int32),
+                jnp.zeros(obs.shape[0]) + _checksum(params),
+                key,
+            )
+
+        core, stop = _mk_core(fn, 2, router=router, deadline_ms=30.0)
+        try:
+            markers = {}
+            for i, policy in enumerate(ids):
+                _, logp, _ = core.client(i, policy=policy)(
+                    None, np.zeros((1, 4), np.float32), None
+                )
+                markers[policy] = float(np.asarray(logp)[0])
+            want0 = float(sum(np.abs(np.asarray(x)).sum() for x in leaves0))
+            want1 = float(sum(np.abs(np.asarray(x)).sum() for x in leaves1))
+            assert markers["member/0"] == pytest.approx(want0, rel=1e-5)
+            assert markers["member/1"] == pytest.approx(want1, rel=1e-5)
+            assert markers["member/0"] != markers["member/1"]
+        finally:
+            _join(core, stop)
+        # A second publish is a zero-drain swap, not a re-register.
+        trainer.publish_policies(router)
+        assert router.slots("member/0").installs() == 1
+    finally:
+        trainer.close()
+
+
+# ----------------------------------------------------------- trainer e2e path
+
+
+def test_trainer_end_to_end_on_serve_core():
+    """SebulbaTrainer behind config.serve (default on): training reaches
+    its target on the serve core with p50/p95/p99 serve latency exported
+    through the metrics window and zero actor errors."""
+    cfg = Config(
+        env_id="CartPole-v1", algo="a3c", backend="sebulba",
+        host_pool="jax", num_envs=16, actor_threads=2, unroll_len=4,
+        precision="f32", log_every=2, inference_server=True,
+    )
+    agent = make_agent(cfg)
+    try:
+        assert agent._use_serve_core()
+        agent._start_actors()
+        assert isinstance(agent._server, ServeCore)
+        assert agent._server.name == "serve-core"
+        steps = (cfg.num_envs // cfg.actor_threads) * cfg.unroll_len * 8
+        history = agent.train(total_env_steps=steps)
+        assert agent.env_steps >= steps
+        last = history[-1]
+        for key in (
+            "serve_latency_ms_p50", "serve_latency_ms_p95",
+            "serve_latency_ms_p99", "server_overload",
+        ):
+            assert key in last, f"missing serve metric {key}"
+        assert last["serve_latency_ms_count"] > 0
+        assert (
+            last["serve_dispatch_full"] + last["serve_dispatch_deadline"]
+            > 0
+        )
+        assert any(h["infer_coalesce_batch"] > 0 for h in history)
+        assert agent._errors.empty()
+    finally:
+        agent.close()
+
+
+def test_trainer_env_override_selects_legacy_core(monkeypatch):
+    """ASYNCRL_SERVE=0 pins the legacy InferenceServer even with
+    config.serve=True (the no-code-change A/B knob)."""
+    cfg = Config(
+        env_id="CartPole-v1", algo="a3c", backend="sebulba",
+        host_pool="jax", num_envs=16, actor_threads=2, unroll_len=4,
+        precision="f32", inference_server=True,
+    )
+    monkeypatch.setenv("ASYNCRL_SERVE", "0")
+    agent = make_agent(cfg)
+    try:
+        assert not agent._use_serve_core()
+        agent._start_actors()
+        assert isinstance(agent._server, InferenceServer)
+    finally:
+        agent.close()
+    monkeypatch.setenv("ASYNCRL_SERVE", "1")
+    agent = make_agent(cfg.replace(serve=False))
+    try:
+        assert agent._use_serve_core()  # env wins over config again
+    finally:
+        agent.close()
