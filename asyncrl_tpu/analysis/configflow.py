@@ -55,6 +55,7 @@ KNOWN_ENV_VARS = {
     "ASYNCRL_SERVE",          # api/sebulba_trainer.py — serve-core toggle
     "ASYNCRL_SERVE_TOLERANCE",  # scripts/serve_smoke.sh throughput budget
     "ASYNCRL_SERVE_P95_MS",   # scripts/serve_smoke.sh p95 latency gate
+    "ASYNCRL_OBS_PORT",       # obs/http.py — exposition endpoint port
 }
 
 _CONFIG_NAMES = {"config", "cfg"}

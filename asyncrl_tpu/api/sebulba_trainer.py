@@ -190,8 +190,10 @@ class SebulbaTrainer:
             )
         # Observability (asyncrl_tpu/obs/): arms span tracing + the
         # flight recorder per config.trace (ASYNCRL_TRACE wins), resets
-        # the counters/histograms registry; the window aggregation and
-        # close() drive the returned handle.
+        # the counters/histograms registry, and mounts the run-health
+        # layer (time-series store + detectors + optional /metrics
+        # endpoint per config.obs_http_port); the window aggregation
+        # (observe_window) and close()/shutdown() drive the handle.
         self._obs = obs.setup(config)
         # §5.2b debug mode: transport invariants on drained fragments.
         from asyncrl_tpu.utils.debug import sync_debug_enabled
@@ -885,11 +887,6 @@ class SebulbaTrainer:
                         agg["slab_reuse_waits"] = ring.reuse_waits
                     agg.update(self._infer_coalesce_window())
                     agg.update(faults.counters())
-                    # Counters/histograms registry + trace stats
-                    # (obs/__init__.py): every instrument any subsystem
-                    # registered drains here — new metrics need no
-                    # bespoke trainer plumbing.
-                    agg.update(self._obs.window())
                     ret_sum = len_sum = count = lag_sum = 0.0
                     window_steps = 0
                     stall_s = h2d_wait_s = 0.0
@@ -916,6 +913,14 @@ class SebulbaTrainer:
                         self._ckpt.maybe_save_best(
                             self.state, self.env_steps, agg["eval_return"]
                         )
+                    # ONE shared window snapshot (obs/__init__.py): the
+                    # registry/trace drain merges in here, the health
+                    # detectors run, and the time-series store records —
+                    # all on THIS dict, so stdout, JSONL, TensorBoard,
+                    # /metrics, and timeseries.jsonl can never disagree
+                    # on what the window contained. Placed after the
+                    # eval so eval_return feeds the regression detector.
+                    self._obs.observe_window(agg)
                     history.append(agg)
                     if callback:
                         callback(agg)
@@ -943,9 +948,10 @@ class SebulbaTrainer:
         self._eval_pools = {}
         self._ckpt.close()
         # Perfetto export of everything the rings still hold (the whole
-        # run's tail, all threads), then flush the flight recorder.
+        # run's tail, all threads), then the final obs teardown: stop the
+        # exposition endpoint, close timeseries.jsonl, flush forensics.
         self._obs.export_trace()
-        self._obs.close()
+        self._obs.shutdown()
 
     # ----------------------------------------------------------------- eval
 

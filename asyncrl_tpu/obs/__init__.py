@@ -1,14 +1,14 @@
-"""``asyncrl_tpu.obs``: pipeline tracing, metrics registry, flight recorder.
+"""``asyncrl_tpu.obs``: tracing, metrics registry, run-health telemetry.
 
-The observability subsystem for the async host path (ISSUE 5):
+The observability subsystem for the async host path (ISSUE 5 + ISSUE 7):
 
 - :mod:`asyncrl_tpu.obs.trace` — per-thread lock-free span rings behind
   ``trace.span("actor.env_step")`` context managers (near-zero cost when
   disabled).
 - :mod:`asyncrl_tpu.obs.spans` — the span taxonomy + wait/compute
   classification + stall causes.
-- :mod:`asyncrl_tpu.obs.registry` — the counters/histograms registry the
-  metric window sinks drain from.
+- :mod:`asyncrl_tpu.obs.registry` — the counters/gauges/histograms
+  registry the metric window sinks drain from.
 - :mod:`asyncrl_tpu.obs.export` — Chrome/Perfetto ``trace_event`` JSON
   export and its schema validator.
 - :mod:`asyncrl_tpu.obs.report` — per-stage time shares, wait-vs-compute
@@ -16,20 +16,37 @@ The observability subsystem for the async host path (ISSUE 5):
   CLI).
 - :mod:`asyncrl_tpu.obs.flightrec` — crash-time span/counter dumps to
   ``runs/<run>/flightrec-*.json``.
+- :mod:`asyncrl_tpu.obs.timeseries` — the bounded per-window sample ring
+  persisted to ``runs/<run>/timeseries.jsonl``.
+- :mod:`asyncrl_tpu.obs.health` — the detector framework evaluated at
+  each window close (NaN loss, stall attribution, fps collapse, SLO
+  breach persistence, restart storms, eval regression), each firing a
+  flight-recorder dump with ``reason=health.<detector>``.
+- :mod:`asyncrl_tpu.obs.http` — the ``/metrics`` / ``/healthz`` /
+  ``/timeseries`` exposition endpoint (``config.obs_http_port`` /
+  ``ASYNCRL_OBS_PORT``; off by default — zero threads when off).
+- :mod:`asyncrl_tpu.obs.doctor` — offline run diagnosis
+  (``python -m asyncrl_tpu.obs doctor <run_dir>``).
 
 :func:`setup` is the trainer-facing entry point: it arms tracing and the
 flight recorder per ``config.trace`` (``ASYNCRL_TRACE`` wins when set,
-mirroring ``utils.faults``) and returns the handle the trainer's window
-aggregation and teardown drive.
+mirroring ``utils.faults``), mounts the time-series store + health
+monitor (+ the HTTP endpoint when a port is configured), and returns the
+handle the trainer's window aggregation and teardown drive.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import os
+import sys
 import time
 
 from asyncrl_tpu.obs import export, flightrec, registry, trace
+from asyncrl_tpu.obs import health as health_mod
+from asyncrl_tpu.obs import http as http_mod
+from asyncrl_tpu.obs import timeseries as timeseries_mod
 
 # Process-wide export sequence: two agents sharing a run_dir (A/B
 # harnesses) must never overwrite each other's same-second export.
@@ -51,27 +68,58 @@ def _default_run_dir(config) -> str:
     )
 
 
+def _platform() -> str | None:
+    """The JAX backend platform for the timeseries meta (doctor matches
+    BENCH_HISTORY rows on it). Lazy + failure-tolerant: obs must stay
+    importable (and setup must succeed) without a working jax install."""
+    # lint: broad-except-ok(metadata enrichment only; a broken jax backend must not break observability setup)
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return None
+
+
 class PipelineObs:
     """One trainer's observability handle (always constructed; inert when
-    tracing is disabled — ``window()`` still drains the registry, which is
-    the one metrics path that runs unconditionally). The handle holds THE
-    tracer/recorder its setup armed: a later trainer re-arming the globals
-    must never redirect this trainer's export or stats to its own rings."""
+    everything is disabled — ``window()`` still drains the registry, which
+    is the one metrics path that runs unconditionally). The handle holds
+    THE tracer/recorder/store its setup mounted: a later trainer re-arming
+    the globals must never redirect this trainer's export, stats, or
+    health telemetry to its own rings."""
 
     def __init__(self, enabled: bool, run_dir: str | None, recorder,
-                 tracer=None):
+                 tracer=None, store=None, monitor=None, http=None):
         self.enabled = enabled
         self.run_dir = run_dir
         self._recorder = recorder
         self._tracer = tracer
+        self.store = store
+        self.monitor = monitor
+        self.http = http
 
     def window(self) -> dict[str, float]:
-        """Counters/histograms + this trainer's trace stats for one
+        """Counters/gauges/histograms + this trainer's trace stats for one
         metrics window."""
         out = registry.window()
         if self._tracer is not None:
             out.update(self._tracer.stats())
         return out
+
+    def observe_window(self, agg: dict) -> dict:
+        """THE per-window drain: merges :meth:`window` (ONE registry
+        snapshot) into ``agg``, then runs the health detectors and records
+        the sample into the time-series store. Every downstream consumer —
+        stdout, JSONL, TensorBoard, the timeseries, ``/metrics`` — sees
+        this identical dict: no sink can drift on which keys a window
+        carries. Returns ``agg`` (mutated in place)."""
+        agg.update(self.window())
+        if self.monitor is not None:
+            # The monitor owns the store.append (sample + annotations in
+            # order); setup() never mounts a store without a monitor.
+            self.monitor.on_window(agg)
+        return agg
 
     def export_trace(self) -> str | None:
         """Write THIS trainer's rings as a Perfetto export into the run
@@ -95,18 +143,38 @@ class PipelineObs:
 
     def close(self) -> None:
         """Flush this trainer's flight recorder (only if it is still the
-        armed one — a newer trainer's recorder is not ours to close)."""
+        armed one — a newer trainer's recorder is not ours to close).
+        Non-destructive and re-callable: ``train()`` calls it at the end
+        of EVERY call, and the agent may train again."""
         if self._recorder is not None and flightrec.active() is self._recorder:
             self._recorder.drain()
 
+    def shutdown(self) -> None:
+        """Final teardown (the agent's ``close()``): stop the exposition
+        endpoint, close the time-series JSONL, flush forensics.
+        Idempotent."""
+        if self.http is not None:
+            self.http.stop()
+            self.http = None
+        if self.store is not None:
+            self.store.close()
+        self.close()
+
 
 def setup(config) -> PipelineObs:
-    """Arm tracing + flight recorder for a trainer, per config/env.
+    """Arm tracing + flight recorder + run-health telemetry per config/env.
 
-    ``ASYNCRL_TRACE`` (when present) wins over ``config.trace`` — the
-    no-code-change knob, exactly the ``ASYNCRL_FAULTS`` precedence. The
+    ``ASYNCRL_TRACE`` (when present) wins over ``config.trace``, and
+    ``ASYNCRL_OBS_PORT`` over ``config.obs_http_port`` — the
+    no-code-change knobs, exactly the ``ASYNCRL_FAULTS`` precedence. The
     registry resets so a fresh agent never reports a predecessor's
     counters (same semantics as re-arming faults).
+
+    The health layer (store + detectors) mounts when tracing is on OR an
+    exposition port is configured; with both off the handle is inert and
+    the per-window cost is exactly one registry snapshot. The HTTP server
+    thread exists only when a port is configured (endpoint off ⇒ zero
+    threads).
     """
     registry.registry().reset()
     env = trace.env_requests()
@@ -118,18 +186,68 @@ def setup(config) -> PipelineObs:
     tracer = trace.configure(
         enabled, capacity=config.trace_ring if env is None else None
     )
-    if not enabled:
+    port = http_mod.env_port(config.obs_http_port)
+    if not enabled and port == 0:
         # Disarm any predecessor's flight recorder too: a trace=False
         # agent must never dump forensics into an OLD agent's run_dir
         # with the old agent's config embedded (faults.arm("") precedent).
         flightrec.disarm()
         return PipelineObs(False, None, None)
-    run_dir = (
-        os.environ.get("ASYNCRL_RUN_DIR")
-        or config.run_dir
-        or _default_run_dir(config)
+    if enabled:
+        run_dir = (
+            os.environ.get("ASYNCRL_RUN_DIR")
+            or config.run_dir
+            or _default_run_dir(config)
+        )
+        recorder = flightrec.arm(
+            run_dir, window_s=config.trace_window_s, config=config
+        )
+    else:
+        # Endpoint without tracing: live exposition only. No flight
+        # recorder (nothing armed to dump spans), and the timeseries
+        # persists only if the operator named a run_dir explicitly.
+        flightrec.disarm()
+        recorder = None
+        run_dir = os.environ.get("ASYNCRL_RUN_DIR") or config.run_dir or None
+    thresholds = health_mod.Thresholds.from_config(config)
+    store = timeseries_mod.TimeSeriesStore(
+        capacity=config.obs_timeseries_cap,
+        persist_path=(
+            os.path.join(run_dir, timeseries_mod.FILENAME) if run_dir else None
+        ),
+        meta={
+            "env_id": config.env_id,
+            "algo": config.algo,
+            "backend": config.backend,
+            "seed": config.seed,
+            "num_envs": config.num_envs,
+            "unroll_len": config.unroll_len,
+            "platform": _platform(),
+            "thresholds": dataclasses.asdict(thresholds),
+        },
     )
-    recorder = flightrec.arm(
-        run_dir, window_s=config.trace_window_s, config=config
+    # The monitor binds THE recorder this setup armed (None when tracing
+    # is off): a later trainer re-arming the global flight recorder must
+    # never receive — or redirect — this trainer's health forensics.
+    monitor = health_mod.HealthMonitor(
+        thresholds=thresholds, store=store, tracer=tracer,
+        recorder=recorder,
     )
-    return PipelineObs(True, run_dir, recorder, tracer=tracer)
+    server = None
+    if port != 0:
+        try:
+            server = http_mod.ObsHTTPServer(
+                port=port, store=store, monitor=monitor
+            ).start()
+        except OSError as e:
+            # A taken/forbidden port must not kill training — the run is
+            # the product, the endpoint is the window onto it.
+            print(
+                f"asyncrl_tpu.obs: could not bind exposition endpoint on "
+                f"port {port}: {e} (continuing without /metrics)",
+                file=sys.stderr,
+            )
+    return PipelineObs(
+        enabled, run_dir, recorder, tracer=tracer,
+        store=store, monitor=monitor, http=server,
+    )

@@ -1,11 +1,14 @@
-"""CLI: ``python -m asyncrl_tpu.obs <report|validate> FILE``.
+"""CLI: ``python -m asyncrl_tpu.obs <report|validate|doctor> ...``.
 
 ``report`` prints the per-stage time shares, wait-vs-compute breakdown,
 and stall-attribution table for an exported trace (``trace-*.json``) or a
 flight-recorder dump (``flightrec-*.json`` — its embedded ``trace``
 section is analyzed). ``validate`` checks the trace_event schema
 (``obs.export.validate_trace``) and exits 1 on any violation — the gate
-``scripts/trace_smoke.sh`` runs.
+``scripts/trace_smoke.sh`` runs. ``doctor`` replays a recorded run_dir's
+timeseries + forensics into a health report (detector timeline,
+bottleneck attribution, BENCH_HISTORY regression verdict) and exits 1 on
+a throughput regression — the gate ``scripts/health_smoke.sh`` runs.
 """
 
 from __future__ import annotations
@@ -14,6 +17,7 @@ import argparse
 import json
 import sys
 
+from asyncrl_tpu.obs import doctor as doctor_mod
 from asyncrl_tpu.obs import export as export_mod
 from asyncrl_tpu.obs import flightrec, report
 
@@ -54,7 +58,42 @@ def main(argv: list[str] | None = None) -> int:
         "validate", help="validate a trace export against the schema"
     )
     p_validate.add_argument("file", help="trace-*.json or flightrec-*.json")
+    p_doctor = sub.add_parser(
+        "doctor",
+        help="offline run-health report for a recorded run_dir "
+        "(detector timeline + bottleneck attribution + BENCH_HISTORY "
+        "regression verdict; exits 1 on regression)",
+    )
+    p_doctor.add_argument(
+        "run_dir", help="run directory holding timeseries.jsonl"
+    )
+    p_doctor.add_argument(
+        "--preset", default=None,
+        help="BENCH_HISTORY preset to compare against (default: inferred "
+        "from the run's env_id/algo)",
+    )
+    p_doctor.add_argument(
+        "--fps-tolerance", type=float,
+        default=doctor_mod.DEFAULT_FPS_TOLERANCE,
+        help="regression bar: run best fps must reach this fraction of "
+        "the baseline row (default %(default)s)",
+    )
+    p_doctor.add_argument(
+        "--bench-history", default=None,
+        help="ledger path (default: BENCH_HISTORY.json, or "
+        "ASYNCRL_BENCH_HISTORY when set)",
+    )
     args = parser.parse_args(argv)
+
+    if args.cmd == "doctor":
+        text, code = doctor_mod.diagnose(
+            args.run_dir,
+            preset=args.preset,
+            tolerance=args.fps_tolerance,
+            history_path=args.bench_history,
+        )
+        print(text, file=sys.stderr if code == 2 else sys.stdout)
+        return code
 
     doc, from_flightrec = _load_trace_doc(args.file)
     if args.cmd == "validate":

@@ -1,0 +1,260 @@
+"""``obs doctor``: offline run-health diagnosis for a recorded run_dir.
+
+Replays everything a run left behind — ``timeseries.jsonl`` (the metric
+history), ``flightrec-*.json`` (crash forensics), ``trace-*.json`` (the
+span export) — into one report:
+
+1. **Detector timeline**: the health events recorded live, merged with an
+   offline :func:`~asyncrl_tpu.obs.health.replay` of the same detector
+   set over the samples (same thresholds, read back from the run's meta
+   line) — so runs recorded before a detector existed still get judged
+   by it, and a live monitor that died mid-run loses nothing.
+2. **Bottleneck attribution**: the stall-attribution table from the run's
+   newest trace export (falling back to the newest flight dump's embedded
+   trace) — the ``obs report`` analysis inlined.
+3. **Regression verdict**: the run's best window throughput against the
+   matching BENCH_HISTORY.json rows (preset- and platform-matched,
+   newest row wins) with a tolerance fraction — "did this PR regress
+   perf" as a command, not archaeology.
+
+Exit code: 0 clean (or no baseline to compare against — absence of
+evidence is reported, never treated as regression), 1 when the regression
+verdict fires, 2 when the run_dir has no readable timeseries.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any
+
+from asyncrl_tpu.obs import health, report, timeseries
+
+# A run "regresses" when its best window fps falls below this fraction of
+# the baseline row. Generous by default: shared/noisy hosts swing real
+# throughput run to run (see perf_smoke.sh); tighten on quiet hardware.
+DEFAULT_FPS_TOLERANCE = 0.5
+
+
+def load_run(run_dir: str) -> dict[str, Any]:
+    """{"meta", "samples", "events"} from ``<run_dir>/timeseries.jsonl``.
+    Raises FileNotFoundError when the run recorded no timeseries."""
+    path = os.path.join(run_dir, timeseries.FILENAME)
+    return timeseries.read_jsonl(path)
+
+
+def _infer_preset(meta: dict[str, Any]) -> str | None:
+    """The preset whose (env_id, algo) matches the run's — how doctor
+    joins a run_dir to BENCH_HISTORY rows without the run knowing its
+    preset name. First declaration order wins on ties."""
+    env_id, algo = meta.get("env_id"), meta.get("algo")
+    if not env_id or not algo:
+        return None
+    from asyncrl_tpu.configs import presets
+
+    for name, cfg in presets.PRESETS.items():
+        if cfg.env_id == env_id and cfg.algo == algo:
+            return name
+    return None
+
+
+def best_fps(samples: list[dict[str, Any]]) -> float:
+    """The run's best window throughput — best-of-N, the same discipline
+    every smoke harness uses against scheduler noise."""
+    values = timeseries.series_of(samples, "fps")
+    return max(values) if values else 0.0
+
+
+def regression_verdict(
+    meta: dict[str, Any],
+    samples: list[dict[str, Any]],
+    preset: str | None = None,
+    tolerance: float = DEFAULT_FPS_TOLERANCE,
+    history_path: str | None = None,
+) -> dict[str, Any]:
+    """Compare the run against its matching BENCH_HISTORY rows.
+
+    verdict: "ok" | "regressed" | "no-baseline" (no matching row, or the
+    run recorded no fps — reported, never conflated with regression).
+    """
+    from asyncrl_tpu.utils import bench_history
+
+    preset = preset or _infer_preset(meta)
+    run_fps = best_fps(samples)
+    out: dict[str, Any] = {
+        "verdict": "no-baseline",
+        "preset": preset,
+        "platform": meta.get("platform"),
+        "run_fps": round(run_fps),
+        "tolerance": tolerance,
+        "baseline_fps": None,
+        "baseline_ts": None,
+    }
+    if preset is None or run_fps <= 0:
+        return out
+    rows = [
+        row for row in bench_history.load(history_path)
+        if row.get("kind") == "throughput"
+        and row.get("preset") == preset
+        and (
+            meta.get("platform") is None
+            or row.get("platform") == meta.get("platform")
+        )
+        and isinstance(row.get("frames_per_sec"), (int, float))
+    ]
+    if not rows:
+        return out
+    baseline = rows[-1]  # newest matching row: the last known good
+    out["baseline_fps"] = baseline["frames_per_sec"]
+    out["baseline_ts"] = baseline.get("ts")
+    out["verdict"] = (
+        "ok" if run_fps >= tolerance * float(baseline["frames_per_sec"])
+        else "regressed"
+    )
+    return out
+
+
+def _latest_trace_doc(run_dir: str) -> tuple[dict[str, Any] | None, str | None]:
+    """The newest analyzable trace document in the run_dir: a full
+    ``trace-*.json`` export preferred, else the newest flight dump's
+    embedded trace section."""
+    traces = sorted(glob.glob(os.path.join(run_dir, "trace-*.json")))
+    for path in reversed(traces):
+        try:
+            with open(path) as f:
+                return json.load(f), path
+        except (OSError, json.JSONDecodeError):
+            continue
+    dumps = sorted(glob.glob(os.path.join(run_dir, "flightrec-*.json")))
+    for path in reversed(dumps):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if doc.get("trace"):
+            return doc["trace"], path
+    return None, None
+
+
+def _timeline(
+    recorded: list[dict[str, Any]], replayed: list[health.HealthEvent]
+) -> list[dict[str, Any]]:
+    """Recorded + replayed events, deduplicated on (detector, window) —
+    a live event and its offline re-derivation are the same fact."""
+    out: list[dict[str, Any]] = []
+    seen: set[tuple[str, int]] = set()
+    for event in recorded:
+        key = (event.get("detector", "?"), int(event.get("window_idx", -1)))
+        if key not in seen:
+            seen.add(key)
+            out.append(dict(event, source="recorded"))
+    for event in replayed:
+        key = (event.detector, event.window_idx)
+        if key not in seen:
+            seen.add(key)
+            out.append(dict(event.to_dict(), source="replayed"))
+    out.sort(key=lambda e: (e.get("window_idx", 0), e.get("detector", "")))
+    return out
+
+
+def diagnose(
+    run_dir: str,
+    preset: str | None = None,
+    tolerance: float = DEFAULT_FPS_TOLERANCE,
+    history_path: str | None = None,
+) -> tuple[str, int]:
+    """(report text, exit code) for a recorded run_dir."""
+    try:
+        run = load_run(run_dir)
+    except OSError as e:
+        return f"obs doctor: {run_dir}: no readable timeseries — {e}", 2
+    meta, samples, recorded = run["meta"], run["samples"], run["events"]
+    if not samples:
+        return (
+            f"obs doctor: {run_dir}: timeseries holds no window samples "
+            "(the run died before its first window closed)",
+            2,
+        )
+    thresholds = health.Thresholds.from_meta(meta)
+    replayed = health.replay(samples, thresholds=thresholds)
+    timeline = _timeline(recorded, replayed)
+
+    lines: list[str] = []
+    steps = timeseries.series_of(samples, "env_steps")
+    lines.append(
+        f"obs doctor: {run_dir}"
+    )
+    lines.append(
+        f"run: env_id={meta.get('env_id')} algo={meta.get('algo')} "
+        f"backend={meta.get('backend')} platform={meta.get('platform')} "
+        f"windows={len(samples)} env_steps={int(steps[-1]) if steps else 0}"
+    )
+    lines.append("")
+    lines.append(f"== detector timeline ({len(timeline)} event(s)) ==")
+    if not timeline:
+        lines.append("no health events: every detector stayed quiet")
+    for event in timeline:
+        lines.append(
+            f"[window {event.get('window_idx', '?'):>4} | "
+            f"steps {int(event.get('env_steps', 0) or 0):>10}] "
+            f"{event.get('severity', '?'):<8} {event.get('detector', '?'):<20} "
+            f"({event.get('component', '?')}, {event.get('source')}): "
+            f"{event.get('message', '')}"
+        )
+
+    lines.append("")
+    lines.append("== bottleneck attribution ==")
+    doc, trace_path = _latest_trace_doc(run_dir)
+    if doc is None:
+        lines.append(
+            "no trace export or flight dump with a trace section in the "
+            "run_dir (tracing was off, or the run never exported)"
+        )
+    else:
+        analysis = report.analyze(doc)
+        if analysis["waits"]:
+            share, group, name, _ = analysis["waits"][0]
+            from asyncrl_tpu.obs import spans as span_names
+
+            cause = span_names.WAIT_CAUSES.get(name, "")
+            lines.append(f"from {trace_path}:")
+            lines.append(
+                f"dominant stall: {name} ({100.0 * share:.1f}% of {group} "
+                f"wall time)" + (f" — {cause}" if cause else "")
+            )
+        else:
+            lines.append(
+                f"from {trace_path}: no wait spans recorded — nothing "
+                "in the pipeline blocked long enough to attribute"
+            )
+
+    lines.append("")
+    lines.append("== regression verdict (vs BENCH_HISTORY) ==")
+    verdict = regression_verdict(
+        meta, samples, preset=preset, tolerance=tolerance,
+        history_path=history_path,
+    )
+    if verdict["verdict"] == "no-baseline":
+        lines.append(
+            f"no baseline: preset={verdict['preset']} "
+            f"platform={verdict['platform']} matched no throughput row "
+            f"(run best fps {verdict['run_fps']:,})"
+        )
+    else:
+        lines.append(
+            f"preset={verdict['preset']} platform={verdict['platform']}: "
+            f"run best fps {verdict['run_fps']:,} vs baseline "
+            f"{verdict['baseline_fps']:,} ({verdict['baseline_ts']}), "
+            f"tolerance {verdict['tolerance']:g}x -> {verdict['verdict'].upper()}"
+        )
+
+    code = 1 if verdict["verdict"] == "regressed" else 0
+    lines.append("")
+    lines.append(
+        f"verdict: {'REGRESSED' if code else 'CLEAN'} "
+        f"({len(timeline)} health event(s), "
+        f"throughput {verdict['verdict']})"
+    )
+    return "\n".join(lines), code

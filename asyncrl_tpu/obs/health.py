@@ -1,0 +1,464 @@
+"""Run-health detectors: the machine that watches a run from the outside.
+
+Everything upstream of this module explains a run after the fact (trace
+export, flight recorder, stall report); nothing *watched* it. The
+:class:`HealthMonitor` closes that gap: evaluated once per metrics window
+(on the trainer's window-close thread — no detector thread exists), each
+:class:`Detector` inspects the window sample plus a little trailing state
+and fires a structured :class:`HealthEvent` when its condition holds.
+
+Every firing event:
+
+- increments the ``health_events_total`` and ``health_<detector>``
+  registry counters (so the NEXT window's sample records the anomaly),
+- annotates the time-series store (so ``obs doctor`` and the
+  ``/timeseries`` endpoint see the anomaly inline with the metrics), and
+- triggers the flight recorder with ``reason=health.<detector>`` — every
+  anomaly gets an automatic forensic dump of the pipeline's last seconds.
+
+Detector taxonomy (thresholds from :class:`Thresholds`, i.e. the
+``health_*`` config fields):
+
+===================== ========== ========= =================================
+detector              component  severity  fires when
+===================== ========== ========= =================================
+nonfinite_loss        learner    critical  loss / grad_norm is NaN or inf
+grad_explosion        learner    warn      grad_norm > health_grad_norm_max
+learner_stall         (blamed)   warn      learner_stall_frac >
+                                           health_stall_frac — the event
+                                           names the bottleneck stage via
+                                           the WAIT_SPANS attribution
+admission_saturation  serve-core warn      serve gate overloads/sheds grew
+                                           this window
+fps_collapse          pipeline   warn      fps < health_fps_collapse x the
+                                           run's own trailing median
+slo_breach            serve-core warn      rolling p95 over SLO target for
+                                           2+ consecutive windows
+restart_storm         actors/    critical  >= 2 supervised restarts in ONE
+                      server               window (storm proximity)
+eval_regression       learner    warn      eval_return fell more than
+                                           health_eval_drop below the
+                                           run's best (0 = off)
+===================== ========== ========= =================================
+
+The ``learner_stall`` verdict reuses the span taxonomy's causal table
+(:data:`asyncrl_tpu.obs.spans.WAIT_CAUSES`): when tracing is armed the
+detector sums the last window's wait spans across all rings and blames
+the component the dominant wait points at (``learner.queue_wait`` means
+the ACTORS are the bottleneck, not the learner) — the same attribution
+the offline report computes, inlined into the live verdict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from collections import deque
+from typing import Any, Callable
+
+from asyncrl_tpu.obs import flightrec, registry
+from asyncrl_tpu.obs import spans as span_names
+
+COMPONENTS = ("actors", "server", "learner", "serve-core", "pipeline")
+_STATUS_RANK = {"ok": 0, "degraded": 1, "critical": 2}
+
+# Which component a dominant WAIT span indicts (the causal reading of
+# spans.WAIT_CAUSES, folded to the /healthz component vocabulary): the
+# learner starving on its queue blames the ACTORS that feed it, actors
+# blocked on the queue/slab blame the LEARNER that drains it.
+_BLAME = {
+    span_names.LEARNER_QUEUE_WAIT: "actors",
+    span_names.LEARNER_H2D_WAIT: "learner",
+    span_names.ACTOR_QUEUE_PUT: "learner",
+    span_names.ACTOR_LEASE_WAIT: "learner",
+    span_names.STAGING_REUSE_WAIT: "learner",
+    span_names.SERVER_COLLECT_WAIT: "actors",
+    span_names.SERVE_ADMIT_WAIT: "serve-core",
+    span_names.SERVE_BATCH_FILL: "actors",
+    span_names.SERVE_SWAP_DRAIN: "serve-core",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Thresholds:
+    """Detector thresholds — one frozen bundle so the live monitor and the
+    offline doctor replay the SAME conditions (the doctor reads these back
+    from the timeseries meta line)."""
+
+    stall_frac: float = 0.9
+    fps_collapse: float = 0.5
+    grad_norm_max: float = 0.0   # 0 = detector off
+    eval_drop: float = 0.0       # 0 = detector off
+    window_ttl: int = 3          # windows an event degrades the verdict
+
+    @classmethod
+    def from_config(cls, config: Any) -> "Thresholds":
+        return cls(
+            stall_frac=config.health_stall_frac,
+            fps_collapse=config.health_fps_collapse,
+            grad_norm_max=config.health_grad_norm_max,
+            eval_drop=config.health_eval_drop,
+            window_ttl=config.health_window_ttl,
+        )
+
+    @classmethod
+    def from_meta(cls, meta: dict[str, Any]) -> "Thresholds":
+        raw = meta.get("thresholds") or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+
+@dataclasses.dataclass
+class HealthEvent:
+    """One detector firing for one window (JSONL- and UI-facing)."""
+
+    detector: str
+    component: str
+    severity: str  # "warn" | "critical"
+    message: str
+    window_idx: int
+    env_steps: float
+    t_unix: float
+    data: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Detector:
+    """One named condition: ``fn(monitor, sample)`` returns None (quiet)
+    or ``(message, data)``; ``data`` may carry a ``component`` override
+    (the stall detector blames the attributed stage, not itself)."""
+
+    name: str
+    component: str
+    severity: str
+    fn: Callable[["HealthMonitor", dict[str, Any]], Any]
+
+
+def _nonfinite(monitor: "HealthMonitor", sample: dict[str, Any]):
+    for key in ("loss", "grad_norm"):
+        value = sample.get(key)
+        if isinstance(value, float) and not math.isfinite(value):
+            return f"{key} is {value!r}: the update diverged", {"key": key}
+    return None
+
+
+def _grad_explosion(monitor: "HealthMonitor", sample: dict[str, Any]):
+    limit = monitor.thresholds.grad_norm_max
+    value = sample.get("grad_norm")
+    if limit > 0 and isinstance(value, float) and math.isfinite(value):
+        if value > limit:
+            return (
+                f"grad_norm {value:.3g} exceeds health_grad_norm_max "
+                f"{limit:.3g}",
+                {"grad_norm": value},
+            )
+    return None
+
+
+def _learner_stall(monitor: "HealthMonitor", sample: dict[str, Any]):
+    frac = sample.get("learner_stall_frac")
+    if not isinstance(frac, float) or frac <= monitor.thresholds.stall_frac:
+        return None
+    stage, cause = monitor.bottleneck()
+    message = (
+        f"learner stalled {100.0 * frac:.0f}% of the window"
+        + (f"; dominant wait {stage}: {cause}" if stage else "")
+    )
+    data = {"learner_stall_frac": frac}
+    if stage:
+        data["stage"] = stage
+        data["component"] = _BLAME.get(stage, "learner")
+    return message, data
+
+
+def _admission_saturation(monitor: "HealthMonitor", sample: dict[str, Any]):
+    overloads = monitor.delta(sample, "server_overload")
+    sheds = monitor.delta(sample, "serve_shed")
+    if overloads + sheds <= 0:
+        return None
+    return (
+        f"serve admission gate saturated this window "
+        f"({overloads:.0f} overloaded admissions, {sheds:.0f} shed)",
+        {"overloads": overloads, "sheds": sheds},
+    )
+
+
+def _fps_collapse(monitor: "HealthMonitor", sample: dict[str, Any]):
+    fps = sample.get("fps")
+    hist = monitor.fps_history
+    if not isinstance(fps, float) or len(hist) < 4:
+        return None
+    ordered = sorted(hist)
+    median = ordered[len(ordered) // 2]
+    floor = monitor.thresholds.fps_collapse * median
+    if median <= 0 or fps >= floor:
+        return None
+    return (
+        f"fps collapsed to {fps:,.0f} — below {floor:,.0f} "
+        f"({monitor.thresholds.fps_collapse:.0%} of the run's trailing "
+        f"median {median:,.0f})",
+        {"fps": fps, "trailing_median": median},
+    )
+
+
+def _slo_breach(monitor: "HealthMonitor", sample: dict[str, Any]):
+    breached = sample.get("serve_slo_breached")
+    if not breached:
+        monitor.slo_breach_run = 0
+        return None
+    monitor.slo_breach_run += 1
+    if monitor.slo_breach_run < 2:
+        return None  # one breached window is noise; persistence is signal
+    p95 = sample.get("serve_p95_rolling_ms", 0.0)
+    return (
+        f"serve p95 over SLO target for {monitor.slo_breach_run} "
+        f"consecutive windows (rolling p95 {p95:.1f}ms)",
+        {"windows": monitor.slo_breach_run, "p95_rolling_ms": p95},
+    )
+
+
+def _restart_storm(monitor: "HealthMonitor", sample: dict[str, Any]):
+    actors = monitor.delta(sample, "actor_restarts")
+    servers = monitor.delta(sample, "server_restarts")
+    if actors + servers < 2:
+        return None
+    return (
+        f"{actors + servers:.0f} supervised restarts in one window "
+        f"({actors:.0f} actor, {servers:.0f} server): restart-storm "
+        "proximity (the supervisor aborts past its storm threshold)",
+        {
+            "actor_restarts": actors,
+            "server_restarts": servers,
+            "component": "actors" if actors >= servers else "server",
+        },
+    )
+
+
+def _eval_regression(monitor: "HealthMonitor", sample: dict[str, Any]):
+    drop = monitor.thresholds.eval_drop
+    value = sample.get("eval_return")
+    if drop <= 0 or not isinstance(value, float):
+        return None
+    best = monitor.eval_best
+    monitor.eval_best = value if best is None else max(best, value)
+    if best is None or value >= best - drop:
+        return None
+    return (
+        f"eval_return {value:.2f} fell {best - value:.2f} below the "
+        f"run's best {best:.2f} (health_eval_drop={drop:g})",
+        {"eval_return": value, "best": best},
+    )
+
+
+def default_detectors() -> list[Detector]:
+    return [
+        Detector("nonfinite_loss", "learner", "critical", _nonfinite),
+        Detector("grad_explosion", "learner", "warn", _grad_explosion),
+        Detector("learner_stall", "learner", "warn", _learner_stall),
+        Detector(
+            "admission_saturation", "serve-core", "warn",
+            _admission_saturation,
+        ),
+        Detector("fps_collapse", "pipeline", "warn", _fps_collapse),
+        Detector("slo_breach", "serve-core", "warn", _slo_breach),
+        Detector("restart_storm", "actors", "critical", _restart_storm),
+        Detector("eval_regression", "learner", "warn", _eval_regression),
+    ]
+
+
+class HealthMonitor:
+    """Evaluates the detector set at each window close and keeps the
+    trailing state the verdict needs. Runs entirely on the trainer's
+    window-close thread (no thread of its own); the HTTP endpoint reads
+    :meth:`verdict` cross-thread, which only touches append-only /
+    GIL-atomic state (the events deque and the window counter)."""
+
+    def __init__(
+        self,
+        thresholds: Thresholds | None = None,
+        store=None,
+        tracer=None,
+        detectors: list[Detector] | None = None,
+        emit: bool = True,
+        recorder: Any = flightrec,
+    ):
+        self.thresholds = thresholds or Thresholds()
+        self.store = store
+        self.tracer = tracer
+        self.detectors = (
+            detectors if detectors is not None else default_detectors()
+        )
+        # emit=False (the doctor's offline replay): pure evaluation — no
+        # registry counters, no flight-recorder dumps.
+        self.emit = emit
+        # THE recorder this monitor's setup armed (the PipelineObs
+        # isolation contract): a later trainer re-arming the process
+        # globals must never redirect THIS trainer's health forensics
+        # into its run_dir — nor resurrect dumps its setup disarmed.
+        # Default is the module (process-global) for standalone use;
+        # obs.setup always binds explicitly (its recorder, or None for
+        # never-dump when it armed none).
+        self.recorder = recorder
+        # Detector trailing state (window-close thread only).
+        self.fps_history: deque[float] = deque(maxlen=32)
+        self.slo_breach_run = 0
+        self.eval_best: float | None = None
+        self._prev: dict[str, Any] | None = None
+        self._prev_t = 0.0
+        # lint: thread-shared-ok(GIL-atomic int; single-writer window counter, verdict() readers see the latest or previous window — both coherent)
+        self.window_idx = 0
+        # lint: thread-shared-ok(deque appends are GIL-atomic and verdict() iterates a list() copy; events are frozen after construction)
+        self._events: deque[HealthEvent] = deque(maxlen=256)
+
+    # ---------------------------------------------------- detector helpers
+
+    def delta(self, sample: dict[str, Any], key: str) -> float:
+        """This window's increase of a CUMULATIVE counter key."""
+        now = sample.get(key, 0.0)
+        if not isinstance(now, (int, float)) or isinstance(now, bool):
+            return 0.0
+        prev = (self._prev or {}).get(key, 0.0)
+        if not isinstance(prev, (int, float)) or isinstance(prev, bool):
+            prev = 0.0
+        return float(now) - float(prev)
+
+    def bottleneck(self) -> tuple[str | None, str | None]:
+        """(dominant wait-span name, causal reading) over roughly the last
+        window's spans, from the armed tracer's rings — (None, None) when
+        tracing is off or nothing waited. Computed only when a detector is
+        about to fire, never per window."""
+        if self.tracer is None:
+            return None, None
+        elapsed = max(1.0, time.time() - self._prev_t) if self._prev_t else 60.0
+        cutoff = time.perf_counter() - elapsed
+        totals: dict[str, float] = {}
+        for snap in self.tracer.snapshots():
+            for name, start, end in snap["spans"]:
+                if end >= cutoff and span_names.is_wait(name):
+                    totals[name] = totals.get(name, 0.0) + (end - start)
+        if not totals:
+            return None, None
+        stage = max(totals, key=totals.get)
+        return stage, span_names.WAIT_CAUSES.get(stage, "")
+
+    # ----------------------------------------------------------- evaluate
+
+    def on_window(self, sample: dict[str, Any]) -> list[HealthEvent]:
+        """Evaluate every detector against one window sample. Mutates the
+        sample with ``health_events`` / ``health_status`` (so every sink
+        and the store see the verdict inline), records the sample + any
+        events into the store, and fires the flight recorder per event."""
+        self.window_idx += 1
+        env_steps = float(sample.get("env_steps", 0) or 0)
+        now = time.time()
+        events: list[HealthEvent] = []
+        for det in self.detectors:
+            try:
+                result = det.fn(self, sample)
+            # lint: broad-except-ok(a buggy detector must degrade to a counter, never take down the training loop it watches)
+            except Exception:
+                if self.emit:
+                    registry.counter("health_detector_errors").inc()
+                continue
+            if not result:
+                continue
+            message, data = result
+            events.append(
+                HealthEvent(
+                    detector=det.name,
+                    component=data.pop("component", det.component),
+                    severity=det.severity,
+                    message=message,
+                    window_idx=self.window_idx,
+                    env_steps=env_steps,
+                    t_unix=now,
+                    data=data,
+                )
+            )
+        fps = sample.get("fps")
+        if isinstance(fps, float) and math.isfinite(fps):
+            self.fps_history.append(fps)
+        for event in events:
+            self._events.append(event)
+        sample["health_events"] = float(len(events))
+        sample["health_status"] = self.status()
+        if self.store is not None:
+            self.store.append(sample)
+            for event in events:
+                self.store.annotate(event.to_dict())
+        if self.emit:
+            for event in events:
+                registry.counter("health_events_total").inc()
+                registry.counter(f"health_{event.detector}").inc()
+                if self.recorder is not None:
+                    self.recorder.record(
+                        f"health.{event.detector}",
+                        detail=event.message,
+                        extra={"health_event": event.to_dict()},
+                    )
+        self._prev = sample
+        self._prev_t = now
+        return events
+
+    # ------------------------------------------------------------ verdict
+
+    def recent_events(self) -> list[HealthEvent]:
+        """Events still inside the verdict TTL (any thread)."""
+        horizon = self.window_idx - self.thresholds.window_ttl
+        return [e for e in list(self._events) if e.window_idx > horizon]
+
+    def status(self) -> str:
+        worst = "ok"
+        for event in self.recent_events():
+            status = "critical" if event.severity == "critical" else "degraded"
+            if _STATUS_RANK[status] > _STATUS_RANK[worst]:
+                worst = status
+        return worst
+
+    def verdict(self) -> dict[str, Any]:
+        """The ``/healthz`` document: overall status + per-component
+        status + the events that caused it (any thread)."""
+        components = {c: "ok" for c in COMPONENTS}
+        recent = self.recent_events()
+        for event in recent:
+            status = "critical" if event.severity == "critical" else "degraded"
+            current = components.get(event.component, "ok")
+            if _STATUS_RANK[status] > _STATUS_RANK[current]:
+                components[event.component] = status
+        worst = "ok"
+        for status in components.values():
+            if _STATUS_RANK[status] > _STATUS_RANK[worst]:
+                worst = status
+        latest = self.store.latest() if self.store is not None else None
+        return {
+            "status": worst,
+            "window": self.window_idx,
+            "env_steps": (latest or {}).get("env_steps", 0),
+            "components": components,
+            "recent_events": [e.to_dict() for e in recent],
+            "detectors": [d.name for d in self.detectors],
+            "ttl_windows": self.thresholds.window_ttl,
+        }
+
+
+def replay(
+    samples: list[dict[str, Any]],
+    thresholds: Thresholds | None = None,
+    detectors: list[Detector] | None = None,
+) -> list[HealthEvent]:
+    """Offline re-evaluation of the detector set over recorded samples
+    (the doctor's path): the same conditions the live monitor ran, minus
+    the tracer attribution and the flight-recorder side effects."""
+    monitor = HealthMonitor(
+        thresholds=thresholds, detectors=detectors, emit=False
+    )
+    events: list[HealthEvent] = []
+    for sample in samples:
+        # Copy: on_window mutates its sample, and replay must not scribble
+        # health keys onto the caller's recorded history.
+        events.extend(monitor.on_window(dict(sample)))
+    return events

@@ -1,0 +1,240 @@
+"""Exposition endpoint: ``/metrics``, ``/healthz``, ``/timeseries``.
+
+A stdlib-only background HTTP server — the per-host surface a multi-host
+launcher, an autoscaler, or a plain ``curl`` scrapes while a run is live:
+
+- ``GET /metrics`` — Prometheus text exposition rendered from the
+  counters/histograms registry merged over the latest window sample
+  (every key the metric sinks see, as ``asyncrl_<key>`` gauges).
+- ``GET /healthz`` — the :class:`~asyncrl_tpu.obs.health.HealthMonitor`
+  verdict as JSON: overall status, per-component status for
+  actors/server/learner/serve-core, and the events behind it. HTTP 200
+  while ``ok``, 503 once degraded/critical — load balancers and
+  autoscalers key off the code without parsing the body.
+- ``GET /timeseries?key=fps&n=240`` — recent ``[t, value]`` points for
+  one metric key (dashboards); ``GET /timeseries`` lists available keys.
+
+Off by default: the server exists only when ``config.obs_http_port`` (or
+``ASYNCRL_OBS_PORT``, which wins) asks for it — endpoint off means zero
+threads and zero per-request surface. Port semantics: ``0`` = off,
+``-1`` = bind an OS-assigned ephemeral port (tests, smoke scripts; read
+it back from :attr:`ObsHTTPServer.port`), positive = bind exactly there.
+Binds 127.0.0.1 by default — exposing beyond the host is a deliberate
+operator decision (bind_host="0.0.0.0"), not a default.
+
+The serving thread is named ``obs-http`` (one more named thread for the
+watchdog/analysis thread-identity discipline); per-request handlers run
+on ThreadingHTTPServer's daemon threads and only ever READ snapshot-
+consistent state (registry window, store snapshots, monitor verdict) —
+the handler never mutates pipeline state, so no lock discipline crosses
+this boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Mapping
+from urllib.parse import parse_qs, urlparse
+
+from asyncrl_tpu.obs import registry
+
+ENV_PORT = "ASYNCRL_OBS_PORT"
+_METRIC_NAME = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def env_port(config_port: int) -> int:
+    """The effective port: ``ASYNCRL_OBS_PORT`` (when set and non-empty)
+    wins over ``config.obs_http_port`` — the no-code-change knob, the
+    ASYNCRL_TRACE precedence."""
+    raw = os.environ.get(ENV_PORT, "").strip()
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{ENV_PORT}={raw!r} is not an integer port "
+                "(0=off, -1=ephemeral)"
+            )
+    return config_port
+
+
+def render_prometheus(values: Mapping[str, Any]) -> str:
+    """Prometheus text exposition (gauge-typed) for a flat metrics dict.
+    Keys sanitize to ``asyncrl_<name>`` metric names; non-numeric values
+    (e.g. the ``health_status`` string) are skipped — ``/healthz`` owns
+    the categorical story."""
+    lines: list[str] = []
+    for key in sorted(values):
+        value = values[key]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        name = "asyncrl_" + _METRIC_NAME.sub("_", str(key))
+        value = float(value)
+        if math.isfinite(value):
+            rendered = f"{value:g}"
+        else:
+            # The exposition format's canonical non-finite spellings (a
+            # diverging run's loss=NaN must scrape, not corrupt).
+            rendered = "NaN" if math.isnan(value) else (
+                "+Inf" if value > 0 else "-Inf"
+            )
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {rendered}")
+    return "\n".join(lines) + "\n"
+
+
+class ObsHTTPServer:
+    """The background exposition server (see module docstring).
+
+    Construction BINDS the socket (so a taken port fails loudly at setup,
+    where the operator reads it); :meth:`start` spawns the ``obs-http``
+    serving thread; :meth:`stop` shuts it down and closes the socket.
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        store=None,
+        monitor=None,
+        bind_host: str = "127.0.0.1",
+    ):
+        self.store = store
+        self.monitor = monitor
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # Per-request daemon threads: READ-ONLY consumers of snapshot-
+            # consistent state (see module docstring).
+            def log_message(self, fmt, *args):  # silence stderr chatter
+                pass
+
+            def do_GET(self):  # noqa: N802 (stdlib handler contract)
+                try:
+                    outer._route(self)
+                # lint: broad-except-ok(exposition must never take down the run it observes; a failed render answers 500 and the next scrape retries)
+                except Exception as e:
+                    try:
+                        outer._send(self, 500, "text/plain",
+                                    f"obs-http error: {e}\n".encode())
+                    except OSError:
+                        pass  # client hung up mid-error — nothing to do
+
+        # port -1 => 0 at the socket layer (OS-assigned ephemeral).
+        self._httpd = ThreadingHTTPServer(
+            (bind_host, max(0, port)), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port: int = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- routes
+
+    @staticmethod
+    def _send(handler, code: int, ctype: str, body: bytes) -> None:
+        handler.send_response(code)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _send_json(self, handler, code: int, doc: Any) -> None:
+        # Strict JSON on the wire: json.dumps' bare NaN/Infinity literals
+        # are a Python dialect every RFC-compliant consumer (JS dashboards,
+        # jq, Go autoscalers) rejects — and a NaN loss in a health event is
+        # exactly when this surface matters. Encode them as strings (the
+        # timeseries.jsonl spelling).
+        from asyncrl_tpu.obs.timeseries import encode_tree
+
+        self._send(
+            handler, code, "application/json",
+            (json.dumps(encode_tree(doc), default=str,
+                        allow_nan=False) + "\n").encode(),
+        )
+
+    def _route(self, handler) -> None:
+        url = urlparse(handler.path)
+        if url.path == "/metrics":
+            values: dict[str, Any] = {}
+            latest = self.store.latest() if self.store is not None else None
+            if latest:
+                values.update(latest)
+            # Registry second: its counters/histograms are fresher than
+            # the window-close snapshot of the same keys.
+            values.update(registry.window())
+            self._send(
+                handler, 200,
+                "text/plain; version=0.0.4; charset=utf-8",
+                render_prometheus(values).encode(),
+            )
+        elif url.path == "/healthz":
+            if self.monitor is None:
+                self._send_json(
+                    handler, 200,
+                    {"status": "unknown", "detail": "no health monitor"},
+                )
+                return
+            verdict = self.monitor.verdict()
+            self._send_json(
+                handler, 200 if verdict["status"] == "ok" else 503, verdict
+            )
+        elif url.path == "/timeseries":
+            if self.store is None:
+                self._send_json(
+                    handler, 404, {"error": "no timeseries store mounted"}
+                )
+                return
+            query = parse_qs(url.query)
+            key = (query.get("key") or [""])[0]
+            if not key:
+                self._send_json(
+                    handler, 200,
+                    {"keys": self.store.keys(),
+                     "samples": self.store.idx,
+                     "dropped": self.store.dropped},
+                )
+                return
+            try:
+                n = int((query.get("n") or ["240"])[0])
+            except ValueError:
+                self._send_json(
+                    handler, 400, {"error": "n must be an integer"}
+                )
+                return
+            self._send_json(
+                handler, 200,
+                {"key": key, "points": self.store.series(key, last_n=n)},
+            )
+        elif url.path == "/":
+            self._send_json(
+                handler, 200,
+                {"endpoints": ["/metrics", "/healthz",
+                               "/timeseries?key=<metric>&n=<count>"]},
+            )
+        else:
+            self._send_json(handler, 404, {"error": f"no route {url.path}"})
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "ObsHTTPServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._serve, name="obs-http", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _serve(self) -> None:  # thread-entry: obs-http@obs
+        self._httpd.serve_forever(poll_interval=0.2)
+
+    def stop(self) -> None:
+        """Shut down the serving loop and close the socket (idempotent)."""
+        thread, self._thread = self._thread, None
+        if thread is not None and thread.is_alive():
+            self._httpd.shutdown()
+            thread.join(timeout=2.0)
+        self._httpd.server_close()

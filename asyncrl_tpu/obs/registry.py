@@ -12,7 +12,8 @@ merges the result next to the legacy keys; the flight recorder dumps
 breaks downstream greps) — they are not migrated, new ones simply stop
 needing trainer plumbing.
 
-Counters are cumulative (like ``actor_restarts``); histograms export
+Counters are cumulative (like ``actor_restarts``); gauges are last-value
+levels (like the serve gate's rolling p95); histograms export
 ``<name>_p50`` / ``<name>_p95`` / ``<name>_p99`` / ``<name>_max`` /
 ``<name>_count``
 summaries over everything observed so far. Thread-safety: one registry
@@ -45,6 +46,26 @@ class Counter:
     def inc(self, delta: float = 1.0) -> None:
         with self._lock:
             self._value += delta
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """A named last-value instrument (set, not accumulated): the shape
+    for state that is a LEVEL, not a count — the serve gate's rolling p95
+    and its in-breach flag (serve/slo.py), queue depths. Exported in the
+    window under its bare name, like a counter."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0  # guarded-by: _lock
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
 
     def value(self) -> float:
         with self._lock:
@@ -113,6 +134,7 @@ class Registry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}  # guarded-by: _lock
+        self._gauges: dict[str, Gauge] = {}  # guarded-by: _lock
         self._histograms: dict[str, Histogram] = {}  # guarded-by: _lock
 
     def counter(self, name: str) -> Counter:
@@ -121,6 +143,13 @@ class Registry:
             if c is None:
                 c = self._counters[name] = Counter(name)
             return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
 
     def histogram(self, name: str) -> Histogram:
         with self._lock:
@@ -134,10 +163,13 @@ class Registry:
         summary, flat-keyed — what the trainer merges into each window."""
         with self._lock:
             counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
             histograms = list(self._histograms.values())
         out: dict[str, float] = {}
         for c in counters:
             out[c.name] = c.value()
+        for g in gauges:
+            out[g.name] = g.value()
         for h in histograms:
             out.update(h.summary())
         return out
@@ -146,6 +178,7 @@ class Registry:
         """Drop every instrument (tests; a fresh trainer's obs setup)."""
         with self._lock:
             self._counters.clear()
+            self._gauges.clear()
             self._histograms.clear()
 
 
@@ -159,6 +192,10 @@ def registry() -> Registry:
 
 def counter(name: str) -> Counter:
     return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
 
 
 def histogram(name: str) -> Histogram:

@@ -121,6 +121,7 @@ _GROUP_PREFIXES = (
     ("inference-server", "server"),
     ("serve-core", "server"),
     ("flightrec-", "flightrec"),
+    ("obs-http", "obs"),
     ("checkpoint", "checkpoint"),
 )
 

@@ -1,0 +1,312 @@
+"""Per-window metric time-series: the run's metric history, queryable.
+
+Before this module a window's metrics existed exactly once — in the dict
+handed to the sinks — and vanished when the window closed. Post-mortems
+leaned on grepping JSONL (if a JsonlSink happened to be wired) and live
+questions ("is fps collapsing *right now*?") had no machine-readable
+answer at all. The store keeps the answer in two places:
+
+- **In memory**: a bounded, preallocated ring of per-window sample dicts,
+  single-writer (the trainer's window close), snapshot-consistent for
+  cross-thread readers (the HTTP endpoint, tests) in the style of
+  ``trace.py``'s span rings — readers copy the slot list and discard the
+  bounded window of slots a concurrent writer may have been overwriting,
+  so no returned sample is torn.
+- **On disk**: every sample (and every health event) appends one JSON
+  line to ``<run_dir>/timeseries.jsonl``, so the run's full metric
+  history survives the process — ``python -m asyncrl_tpu.obs doctor``
+  replays it offline.
+
+The JSONL grammar (one object per line, ``kind`` discriminated):
+
+    {"kind": "meta",   "schema": "asyncrl-timeseries-v1", "t": ..,
+     "run": {env_id, algo, backend, seed, platform, thresholds, ...}}
+    {"kind": "sample", "t": .., "window": {env_steps, fps, loss, ...}}
+    {"kind": "event",  "t": .., "event": {detector, component, ...}}
+
+A reused run_dir appends (never truncates); each run opens with its own
+meta line, and :func:`read_jsonl` returns the LAST such segment — the
+doctor always judges the most recent run by that run's own thresholds.
+Non-finite floats are encoded as "NaN"/"Infinity"/"-Infinity" strings on
+disk (strict JSON for external tooling) and decoded back on read.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Iterable
+
+SCHEMA = "asyncrl-timeseries-v1"
+DEFAULT_CAPACITY = 4096
+FILENAME = "timeseries.jsonl"
+# In-memory bound on health-event annotations (the JSONL keeps them all).
+EVENTS_CAPACITY = 256
+
+
+# Non-finite float <-> strict-JSON spelling. json.dumps would emit bare
+# NaN/Infinity literals (its Python dialect), which RFC-compliant readers
+# (jq, JS, Go — exactly the tooling a .jsonl exists for) reject; encode
+# them as these strings on write and decode on read, so a diverging run's
+# loss=NaN survives the round-trip AND the file stays valid JSON.
+_NONFINITE = {"NaN": math.nan, "Infinity": math.inf, "-Infinity": -math.inf}
+
+
+def _encode(value: Any) -> Any:
+    if isinstance(value, float) and not math.isfinite(value):
+        if math.isnan(value):
+            return "NaN"
+        return "Infinity" if value > 0 else "-Infinity"
+    return value
+
+
+def _decode(value: Any) -> Any:
+    if isinstance(value, str) and value in _NONFINITE:
+        return _NONFINITE[value]
+    return value
+
+
+def encode_tree(obj: Any) -> Any:
+    """:func:`_encode` applied through nested dicts/lists (event ``data``
+    payloads carry the offending values, e.g. grad_norm=inf)."""
+    if isinstance(obj, dict):
+        return {k: encode_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode_tree(v) for v in obj]
+    return _encode(obj)
+
+
+def decode_tree(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {k: decode_tree(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decode_tree(v) for v in obj]
+    return _decode(obj)
+
+
+def _jsonable(value: Any) -> Any:
+    """A JSON-serializable scalar for ``value``, or None to drop it.
+    Window dicts occasionally carry numpy scalars (an aggregation that
+    skipped the float() coercion) — ``.item()`` unwraps them; anything
+    non-scalar is dropped rather than poisoning the whole line."""
+    if isinstance(value, (bool, int, float, str)) or value is None:
+        return value
+    item = getattr(value, "item", None)
+    if item is not None:
+        try:
+            return item()
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+class TimeSeriesStore:
+    """One run's per-window sample ring + incremental JSONL persistence.
+
+    Single-writer by contract: only the trainer's window-close thread
+    calls :meth:`append`/:meth:`annotate`. Cross-thread readers (the obs
+    HTTP server) use :meth:`snapshot`/:meth:`series`/:meth:`latest`,
+    which tolerate the bounded copy-window tear exactly like
+    ``trace.SpanRing.snapshot`` — the declared non-lock discipline.
+    """
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        persist_path: str | None = None,
+        meta: dict[str, Any] | None = None,
+    ):
+        if capacity < 2:
+            raise ValueError(
+                f"timeseries capacity must be >= 2, got {capacity}"
+            )
+        self.capacity = capacity
+        self.persist_path = persist_path
+        self.meta = dict(meta or {})
+        # lint: thread-shared-ok(single-writer ring slots; snapshot discards the copy-window slots a concurrent append may touch)
+        self._slots: list[dict[str, Any] | None] = [None] * capacity
+        # lint: thread-shared-ok(GIL-atomic int; single-writer monotone counter, snapshot reads it before/after the copy)
+        self.idx = 0
+        # lint: thread-shared-ok(single-writer bounded list; readers take a slice under the GIL — events are append-only dicts, never mutated)
+        self._events: list[dict[str, Any]] = []
+        self._f = None
+        if persist_path:
+            parent = os.path.dirname(persist_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            # Line-buffered append: each window's sample is on disk the
+            # moment it is written — a crash loses at most the line in
+            # flight, never the run's history.
+            self._f = open(persist_path, "a", buffering=1)
+            self._write_line(
+                {"kind": "meta", "schema": SCHEMA, "t": time.time(),
+                 "pid": os.getpid(), "run": self.meta}
+            )
+
+    # ------------------------------------------------------------- writer
+
+    def _write_line(self, row: dict[str, Any]) -> None:
+        if self._f is None:
+            return
+        try:
+            line = json.dumps(
+                encode_tree(row), default=str, allow_nan=False
+            )
+        except (TypeError, ValueError) as e:
+            # One unserializable row is dropped; the file stays alive.
+            print(f"timeseries: row not serializable: {e}", file=sys.stderr)
+            return
+        try:
+            self._f.write(line + "\n")
+        except (OSError, ValueError) as e:
+            # Best-effort persistence: a full disk (or a close() racing a
+            # final append) must never take down the training loop; the
+            # in-memory ring keeps serving the endpoint either way.
+            print(f"timeseries: persist failed: {e}", file=sys.stderr)
+            self._f = None
+
+    def append(self, window: dict[str, Any]) -> dict[str, Any]:
+        """Record one window sample (writer thread only). The stored dict
+        is a sanitized copy stamped with ``t`` (unix) — the caller's dict
+        is NOT retained, so later caller-side mutation cannot tear a
+        reader's view."""
+        sample = {"t": time.time()}
+        for key, value in window.items():
+            coerced = _jsonable(value)
+            if coerced is not None:
+                sample[key] = coerced
+        self._slots[self.idx % self.capacity] = sample
+        self.idx += 1
+        self._write_line({"kind": "sample", "t": sample["t"],
+                          "window": sample})
+        return sample
+
+    def annotate(self, event: dict[str, Any]) -> None:
+        """Record one health-event annotation (writer thread only):
+        bounded in memory, unbounded on disk."""
+        row = dict(event)
+        row.setdefault("t", time.time())
+        self._events.append(row)
+        del self._events[:-EVENTS_CAPACITY]
+        self._write_line({"kind": "event", "t": row["t"], "event": row})
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self.idx - self.capacity)
+
+    # ------------------------------------------------------------ readers
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        """Oldest-to-newest copy of the retained samples, from ANY thread
+        (the SpanRing discipline: the copy-window slot a concurrent
+        append may be mid-store on is excluded, so no sample is torn)."""
+        i0 = self.idx
+        slots = list(self._slots)
+        i1 = self.idx
+        lo = max(0, i1 - self.capacity + 1)
+        out = []
+        for j in range(lo, i0):
+            sample = slots[j % self.capacity]
+            if sample is not None:
+                out.append(sample)
+        return out
+
+    def latest(self) -> dict[str, Any] | None:
+        """The newest sample (None before the first window closes)."""
+        i = self.idx
+        if i == 0:
+            return None
+        return self._slots[(i - 1) % self.capacity]
+
+    def series(self, key: str, last_n: int = 240) -> list[list[float]]:
+        """Recent ``[t, value]`` points for one metric key (samples that
+        lack the key — or hold a non-finite value no chart can plot and
+        no strict-JSON reader can parse — are skipped) — the
+        ``/timeseries`` endpoint's shape."""
+        points = []
+        for sample in self.snapshot():
+            value = sample.get(key)
+            if (
+                isinstance(value, (int, float))
+                and not isinstance(value, bool)
+                and math.isfinite(value)
+            ):
+                points.append([sample["t"], float(value)])
+        return points[-last_n:]
+
+    def events(self, last_n: int = 64) -> list[dict[str, Any]]:
+        return list(self._events[-last_n:])
+
+    def keys(self) -> list[str]:
+        """Every metric key any retained sample carries (dashboards)."""
+        out: set[str] = set()
+        for sample in self.snapshot():
+            out.update(sample)
+        return sorted(out)
+
+    def close(self) -> None:
+        f, self._f = self._f, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------- reading
+
+
+def read_jsonl(path: str) -> dict[str, Any]:
+    """Parse a persisted ``timeseries.jsonl`` into
+    ``{"meta": .., "samples": [..], "events": [..]}`` (the doctor's
+    input). Tolerates torn final lines (a crashed writer) and unknown
+    kinds (forward compatibility). A reused run_dir appends one meta
+    line per run SEGMENT; the returned view is the LAST segment — the
+    run the doctor is being asked about — so an earlier run's samples
+    are never replayed under a later run's thresholds (and recorded
+    events always align with the samples' window indices)."""
+    meta: dict[str, Any] = {}
+    samples: list[dict[str, Any]] = []
+    events: list[dict[str, Any]] = []
+    started = False  # a meta AFTER data starts a new segment
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail of a crashed run — keep what parsed
+            kind = row.get("kind")
+            if kind == "meta":
+                if started:
+                    samples, events = [], []
+                    started = False
+                meta = row.get("run") or {}
+            elif kind == "sample":
+                window = row.get("window")
+                if isinstance(window, dict):
+                    started = True
+                    samples.append(decode_tree(window))
+            elif kind == "event":
+                event = row.get("event")
+                if isinstance(event, dict):
+                    started = True
+                    events.append(decode_tree(event))
+    return {"meta": meta, "samples": samples, "events": events}
+
+
+def series_of(
+    samples: Iterable[dict[str, Any]], key: str
+) -> list[float]:
+    """The numeric values of ``key`` across ``samples`` (missing skipped)."""
+    out = []
+    for sample in samples:
+        value = sample.get(key)
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            out.append(float(value))
+    return out

@@ -32,6 +32,14 @@ Counters (obs/registry.py, drained into every metrics window):
 ``server_overload`` — admissions that found the gate in breach;
 ``serve_shed`` — requests refused. Latency observations feed the
 ``serve_latency_ms`` histogram (p50/p95/p99/max exported per window).
+
+Breach state also feeds the health detectors (obs/health.py) through two
+gauges maintained wherever the rolling window recomputes:
+``serve_p95_rolling_ms`` (the breach signal itself — the histogram's p95
+is lifetime-cumulative, the gauge is the rolling window) and
+``serve_slo_breached`` (0/1). The ``slo_breach`` detector fires on
+breach *persistence* (2+ consecutive windows), and ``/healthz`` degrades
+the ``serve-core`` component.
 """
 
 from __future__ import annotations
@@ -49,6 +57,8 @@ from asyncrl_tpu.rollout.inference_server import ServerClosed
 LATENCY_HISTOGRAM = "serve_latency_ms"
 OVERLOAD_COUNTER = "server_overload"
 SHED_COUNTER = "serve_shed"
+P95_GAUGE = "serve_p95_rolling_ms"
+BREACH_GAUGE = "serve_slo_breached"
 
 
 class RequestShed(RuntimeError):
@@ -96,6 +106,10 @@ class SLOGate:
         self._counter_overload = obs_registry.counter(OVERLOAD_COUNTER)
         self._counter_shed = obs_registry.counter(SHED_COUNTER)
         self._histogram = obs_registry.histogram(LATENCY_HISTOGRAM)
+        # Health-detector feed (module docstring): rolling p95 + breach
+        # flag as gauges, refreshed where the rolling window recomputes.
+        self._gauge_p95 = obs_registry.gauge(P95_GAUGE)
+        self._gauge_breach = obs_registry.gauge(BREACH_GAUGE)
 
     # ------------------------------------------------------------ metrics
 
@@ -204,6 +218,16 @@ class SLOGate:
             self._lat.append(latency_ms)
             if self.p95_target_ms > 0:
                 self._recompute_p95_locked()
+                # Gauge writes UNDER _cond, deliberately: two client
+                # threads completing concurrently must publish their
+                # breach states in recompute order — a stale breached=1
+                # applied after a recovery would hold /healthz degraded
+                # until the next completion. The nesting is acyclic (the
+                # gauge's lock is only ever taken alone) and non-blocking.
+                self._gauge_p95.set(self._p95_cache)
+                self._gauge_breach.set(
+                    1.0 if self._in_breach_locked() else 0.0
+                )
             if self._tokens < self._burst:
                 self._tokens += 1.0
             self._cond.notify_all()

@@ -252,10 +252,38 @@ class Config:
     # Flight recorder lookback: seconds of spans dumped on a fault,
     # watchdog retirement, or supervisor restart.
     trace_window_s: float = 10.0
-    # Observability output directory (trace exports, flightrec-*.json).
-    # Empty = runs/<env>-<algo>-s<seed>-<stamp>-<pid> when tracing is on;
-    # ASYNCRL_RUN_DIR overrides.
+    # Observability output directory (trace exports, flightrec-*.json,
+    # timeseries.jsonl). Empty = runs/<env>-<algo>-s<seed>-<stamp>-<pid>
+    # when tracing is on; ASYNCRL_RUN_DIR overrides.
     run_dir: str = ""
+    # --- run-health telemetry (obs/timeseries.py, obs/health.py,
+    # obs/http.py) ---
+    # Exposition endpoint port (/metrics, /healthz, /timeseries): 0 = off
+    # (the default — zero threads, zero per-window cost beyond the one
+    # shared registry snapshot), -1 = bind an OS-assigned ephemeral port
+    # (tests/smoke harnesses read it back from the handle), positive =
+    # bind exactly there (127.0.0.1). ASYNCRL_OBS_PORT wins when set.
+    obs_http_port: int = 0
+    # Per-window samples retained in the in-memory time-series ring
+    # (drop-oldest; the timeseries.jsonl persistence is unbounded).
+    obs_timeseries_cap: int = 4096
+    # Detector thresholds (obs/health.py; the doctor replays the same
+    # values from the run's recorded meta):
+    # learner_stall fires when learner_stall_frac exceeds this.
+    health_stall_frac: float = 0.9
+    # fps_collapse fires when a window's fps drops below this fraction of
+    # the run's own trailing median (>= 4 windows of history required).
+    health_fps_collapse: float = 0.5
+    # grad_explosion fires when grad_norm exceeds this; 0 disables (the
+    # default: a healthy clipped run's grad_norm scale is workload-
+    # specific, so an absolute bar is an operator choice).
+    health_grad_norm_max: float = 0.0
+    # eval_regression fires when eval_return falls this far below the
+    # run's best; 0 disables (return scales are workload-specific).
+    health_eval_drop: float = 0.0
+    # Windows a fired event keeps the /healthz verdict degraded (the
+    # recovery horizon: no new events for this many windows => ok again).
+    health_window_ttl: int = 3
 
     # --- runtime ---
     seed: int = 0

@@ -4,7 +4,16 @@ The hot loop never blocks on host sync for metrics — trainers drain
 device-resident metric pytrees every ``log_every`` updates (see
 ``Trainer.train``) and hand each aggregated window dict to a sink. Sinks are
 composable; the CLI wires them from flags (``--json``, ``--jsonl FILE``,
-``--logdir DIR``). The reference family at most printed episode rewards to
+``--logdir DIR``).
+
+One-snapshot contract: every sink in a window receives the SAME dict
+object — the trainer merges the obs registry drain, runs the health
+detectors, and records the time-series sample on that one dict
+(``PipelineObs.observe_window``) BEFORE fanning out, so stdout, JSONL,
+TensorBoard, ``/metrics``, and ``timeseries.jsonl`` can never disagree on
+which keys a window carried. Sinks must therefore tolerate the health
+keys (``health_status`` is a string; everything else numeric) and never
+mutate the window they are handed. The reference family at most printed episode rewards to
 stdout (SURVEY.md §5.5a); TensorBoard here uses ``tf.summary`` (tensorflow
 ships in this image) imported lazily so the common path never pays the TF
 import.
@@ -87,6 +96,13 @@ class StdoutSink(MetricsSink):
                          ) or k.startswith("fault_"):
                     if value:
                         parts.append(f"{k}={int(value)}")
+            # Health verdict (obs/health.py), shown only once an event
+            # fired this window — a healthy run's one-liner is unchanged.
+            if window.get("health_events"):
+                parts.append(
+                    f"health={window.get('health_status', 'degraded')}"
+                    f"({int(window['health_events'])} event(s))"
+                )
             print("  ".join(parts), file=self.stream)
         self.stream.flush()
 

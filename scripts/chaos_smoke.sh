@@ -65,7 +65,13 @@ run_one "baseline (no faults)" ""
 run_one "actor step crash"      "actor.step:crash:1.0:0:max=1"
 run_one "fragment handoff crash" "actor.queue_put:crash:1.0:0:max=1"
 run_one "env pool crash"        "pool.step:crash:1.0:0:max=1"
-run_one "inference server crash" "server.serve:crash:1.0:0:max=1" "inference_server=True"
+# Both shared-server cores, each through ITS fault site: serve=True (the
+# default since the serve core landed) routes inference through
+# serve.dispatch — arming server.serve there never fires (the legacy
+# site), which silently made this case vacuous until the health-smoke
+# round caught it.
+run_one "inference server crash (legacy)" "server.serve:crash:1.0:0:max=1" "inference_server=True,serve=False"
+run_one "serve-core dispatch crash" "serve.dispatch:crash:1.0:0:max=1" "inference_server=True"
 
 # A hung actor, recovered by the heartbeat watchdog.
 run_one "actor stall + watchdog" "actor.step:stall:1.0:0:max=1,stall_s=60" "stall_timeout_s=1.0"

@@ -492,6 +492,21 @@ def test_threads_are_named_and_fault_messages_identify_threads():
     finally:
         legacy.close()
 
+    # The obs exposition server (obs/http.py, PR 7) follows the same
+    # discipline: its serving thread is named obs-http (a declared
+    # thread-entry root, grouped "obs" in the span taxonomy) and is gone
+    # once stopped.
+    from asyncrl_tpu.obs.http import ObsHTTPServer
+    from asyncrl_tpu.obs.spans import thread_group
+
+    server = ObsHTTPServer(port=0).start()
+    try:
+        assert "obs-http" in [t.name for t in threading.enumerate()]
+        assert thread_group("obs-http") == "obs"
+    finally:
+        server.stop()
+    assert "obs-http" not in [t.name for t in threading.enumerate()]
+
     site = faults.FaultRegistry("actor.step:crash:1.0:0").site("actor.step")
     captured = []
 

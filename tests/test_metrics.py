@@ -51,6 +51,22 @@ def test_stdout_sink_omits_absent_keys():
     assert "steps=" in buf.getvalue()
 
 
+def test_stdout_sink_shows_health_only_when_events_fired():
+    """The health verdict (obs/health.py keys riding the shared window
+    snapshot) prints only once an event fired this window — a healthy
+    run's one-liner stays unchanged, and the string-valued
+    health_status key never breaks the numeric formatting."""
+    healthy = dict(WINDOW, health_events=0.0, health_status="ok")
+    buf = io.StringIO()
+    StdoutSink(stream=buf).write(healthy)
+    assert "health=" not in buf.getvalue()
+
+    sick = dict(WINDOW, health_events=2.0, health_status="critical")
+    buf = io.StringIO()
+    StdoutSink(stream=buf).write(sick)
+    assert "health=critical(2 event(s))" in buf.getvalue()
+
+
 def test_jsonl_sink_appends(tmp_path):
     path = str(tmp_path / "run.jsonl")
     with JsonlSink(path) as sink:
