@@ -56,6 +56,8 @@ KNOWN_ENV_VARS = {
     "ASYNCRL_SERVE_TOLERANCE",  # scripts/serve_smoke.sh throughput budget
     "ASYNCRL_SERVE_P95_MS",   # scripts/serve_smoke.sh p95 latency gate
     "ASYNCRL_OBS_PORT",       # obs/http.py — exposition endpoint port
+    "ASYNCRL_INTROSPECT",     # obs/introspect.py — training introspection
+    "ASYNCRL_INTROSPECT_TOLERANCE",  # scripts/introspect_smoke.sh budget
 }
 
 _CONFIG_NAMES = {"config", "cfg"}

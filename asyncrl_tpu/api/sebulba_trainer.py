@@ -32,7 +32,7 @@ import jax
 import numpy as np
 
 from asyncrl_tpu import obs
-from asyncrl_tpu.obs import flightrec
+from asyncrl_tpu.obs import flightrec, introspect
 from asyncrl_tpu.obs import registry as obs_registry
 from asyncrl_tpu.obs import spans as span_names
 from asyncrl_tpu.obs import trace
@@ -95,6 +95,14 @@ class SebulbaTrainer:
             raise
 
     def _init(self, config, spec, model, mesh, restore):
+        # Resolve the ASYNCRL_INTROSPECT override ONCE (env wins over
+        # config.introspect, the ASYNCRL_TRACE precedence) so every
+        # downstream consumer — the jitted loss aux, the learner's compile
+        # instrumentation, the staleness tracker — reads the same resolved
+        # flag instead of re-consulting the environment.
+        if introspect.enabled(config) != config.introspect:
+            config = config.replace(introspect=introspect.enabled(config))
+            self.config = config
         if config.num_envs % config.actor_threads:
             raise ValueError(
                 f"num_envs={config.num_envs} not divisible by "
@@ -161,6 +169,25 @@ class SebulbaTrainer:
         self.checkpointer = self._ckpt.checkpointer
 
         self._inference_fn = make_inference_fn(self.model, self.spec, config)
+        if config.introspect:
+            # Compile accounting on the inference entry point
+            # (obs/introspect.py): wrapped ONCE here — not per server —
+            # because the jit cache lives in this function object and
+            # survives supervised server rebuilds; the counter must match
+            # its lifetime. ``infer_recompile`` makes the shared server's
+            # partial-batch recompiles (deadline flushes change the batch
+            # shape) measurable next to ``infer_coalesce_batch``. The
+            # params argument's shapes never change and is skipped.
+            self._inference_fn = introspect.instrument(
+                self._inference_fn, "infer",
+                counters=("compiles", "infer_recompile"),
+                ignore_argnums=(0,),
+            )
+        # Per-window off-policy staleness aggregation (obs/introspect.py):
+        # fed one lag per consumed fragment, drained at window close.
+        self._staleness = (
+            introspect.StalenessWindow() if config.introspect else None
+        )
         self._initial_core = (
             self.model.initial_core if is_recurrent(self.model) else None
         )
@@ -822,9 +849,12 @@ class SebulbaTrainer:
                     # the server evaluates under the latest published
                     # params, so later steps of a fragment can be fresher
                     # than its fragment-start version implies.
-                    lag_sum += (self._updates + i) - self._published_updates.get(
+                    lag = (self._updates + i) - self._published_updates.get(
                         f.version, self._updates
                     )
+                    lag_sum += lag
+                    if self._staleness is not None:
+                        self._staleness.observe(lag)
 
                 before = self._updates
                 self._updates += K
@@ -885,6 +915,15 @@ class SebulbaTrainer:
                     )
                     if ring is not None:
                         agg["slab_reuse_waits"] = ring.reuse_waits
+                    # Off-policy staleness distribution for the window
+                    # (staleness_p50/p95/max/mean, in learner updates) —
+                    # the per-fragment lags behind the param_lag mean.
+                    # The compile counters (compiles / infer_recompile /
+                    # learner_recompile) ride the shared registry drain
+                    # in observe_window below, landing next to
+                    # infer_coalesce_batch in this same dict.
+                    if self._staleness is not None:
+                        agg.update(self._staleness.drain())
                     agg.update(self._infer_coalesce_window())
                     agg.update(faults.counters())
                     ret_sum = len_sum = count = lag_sum = 0.0

@@ -94,6 +94,13 @@ class Trainer:
     def __init__(
         self, config: Config, env=None, model=None, mesh=None, restore=None
     ):
+        # Resolve the ASYNCRL_INTROSPECT override once (env wins over
+        # config.introspect, the ASYNCRL_TRACE precedence): the jitted
+        # loss aux reads the RESOLVED flag at trace time, never the env.
+        from asyncrl_tpu.obs import introspect
+
+        if introspect.enabled(config) != config.introspect:
+            config = config.replace(introspect=introspect.enabled(config))
         self.config = config
         self.env = (
             env if env is not None else registry.make(config.env_id, config)

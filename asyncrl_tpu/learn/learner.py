@@ -368,6 +368,7 @@ def _algo_loss(
             jax.lax.stop_gradient(bootstrap_value),
             value_coef=config.value_coef, entropy_coef=entropy_coef,
             dist=dist, scan_impl=config.scan_impl,
+            diagnostics=config.introspect,
         )
     if config.algo == "impala":
         return impala_loss(
@@ -376,6 +377,7 @@ def _algo_loss(
             value_coef=config.value_coef, entropy_coef=entropy_coef,
             rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
             dist=dist, scan_impl=config.scan_impl,
+            diagnostics=config.introspect,
         )
     if config.algo == "ppo":
         # Single-pass PPO over the fresh fragment (used when
@@ -391,7 +393,7 @@ def _algo_loss(
             adv.advantages, adv.returns,
             clip_eps=config.ppo_clip_eps, value_coef=config.value_coef,
             entropy_coef=entropy_coef, axis_name=axis_name,
-            dist=dist,
+            dist=dist, diagnostics=config.introspect,
         )
     raise ValueError(f"unknown algo {config.algo!r}")
 
@@ -501,6 +503,7 @@ def _ppo_multipass(
                     entropy_coef=entropy_coef_at(config, update_step),
                     axis_name=axes or None,
                     dist=dist,
+                    diagnostics=config.introspect,
                 )
                 metrics = dict(metrics, loss=loss)
                 return loss / _axis_size(axes), metrics

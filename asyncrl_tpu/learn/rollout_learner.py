@@ -36,6 +36,7 @@ from asyncrl_tpu.learn.learner import (
     validate_recurrent_config,
 )
 from asyncrl_tpu.models.networks import is_recurrent
+from asyncrl_tpu.obs import introspect
 from asyncrl_tpu.obs import spans as span_names
 from asyncrl_tpu.obs import trace
 from asyncrl_tpu.ops import distributions
@@ -201,6 +202,7 @@ def _algo_loss_timesharded(
             logits_t, values_t, rollout.actions, rollout.rewards, discounts,
             bootstrap_value, value_coef=config.value_coef,
             entropy_coef=entropy_coef, dist=dist, returns=returns,
+            diagnostics=config.introspect,
         )
     if config.algo == "impala":
         target_logp = dist.logp(logits_t, rollout.actions)
@@ -209,13 +211,16 @@ def _algo_loss_timesharded(
             jax.lax.stop_gradient(values_t), bootstrap_value,
             rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
         )
-        # rho_clip_frac comes back already pmean'd over the time axis
-        # (sp-invariant); re-mark it sp-varying so the caller's uniform
+        # The clip fractions come back already pmean'd over the time axis
+        # (sp-invariant); re-mark them sp-varying so the caller's uniform
         # pmean over (dp axes + sp) is legal under vma tracking.
         vt = vt._replace(
             rho_clip_frac=jax.lax.pcast(
                 vt.rho_clip_frac, TIME_AXIS, to="varying"
-            )
+            ),
+            c_clip_frac=jax.lax.pcast(
+                vt.c_clip_frac, TIME_AXIS, to="varying"
+            ),
         )
         return impala_loss(
             logits_t, values_t, rollout.actions, rollout.behaviour_logp,
@@ -223,6 +228,7 @@ def _algo_loss_timesharded(
             value_coef=config.value_coef, entropy_coef=entropy_coef,
             rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
             dist=dist, vtrace_out=vt,
+            diagnostics=config.introspect,
         )
     if config.algo == "ppo":
         adv = gae_timesharded(
@@ -234,6 +240,7 @@ def _algo_loss_timesharded(
             adv.advantages, adv.returns, clip_eps=config.ppo_clip_eps,
             value_coef=config.value_coef, entropy_coef=entropy_coef,
             axis_name=reduce_axes, dist=dist,
+            diagnostics=config.introspect,
         )
     raise ValueError(f"unknown algo {config.algo!r} for time sharding")
 
@@ -447,6 +454,19 @@ class RolloutLearner:
             ),
             donate_argnums=(1,) if config.donate_buffers else (),
         )
+        if config.introspect:
+            # Compile accounting (obs/introspect.py): the learner's entry
+            # point compiles once per fragment geometry — any further
+            # compile is a silent recompile the bench numbers would
+            # otherwise hide. The state argument's shapes are fixed, so
+            # only the rollout argument is signature-walked. Reads the
+            # RESOLVED flag (the trainers fold ASYNCRL_INTROSPECT in at
+            # construction) — never re-consults the environment.
+            self._step = introspect.instrument(
+                self._step, "learner.update",
+                counters=("compiles", "learner_recompile"),
+                ignore_argnums=(0,),
+            )
         # Fragment structure is fixed for this trainer (ff vs recurrent), so
         # the device_put sharding pytree is built once, not per update.
         template = Rollout(

@@ -25,6 +25,11 @@ The observability subsystem for the async host path (ISSUE 5 + ISSUE 7):
 - :mod:`asyncrl_tpu.obs.http` — the ``/metrics`` / ``/healthz`` /
   ``/timeseries`` exposition endpoint (``config.obs_http_port`` /
   ``ASYNCRL_OBS_PORT``; off by default — zero threads when off).
+- :mod:`asyncrl_tpu.obs.introspect` — training introspection (ISSUE 8):
+  off-policy staleness aggregation, compile/recompile accounting with
+  static-shape blame on the learner/inference entry points, and memory
+  watermarks (``config.introspect`` / ``ASYNCRL_INTROSPECT``; on by
+  default).
 - :mod:`asyncrl_tpu.obs.doctor` — offline run diagnosis
   (``python -m asyncrl_tpu.obs doctor <run_dir>``).
 
@@ -43,7 +48,7 @@ import os
 import sys
 import time
 
-from asyncrl_tpu.obs import export, flightrec, registry, trace
+from asyncrl_tpu.obs import export, flightrec, introspect, registry, trace
 from asyncrl_tpu.obs import health as health_mod
 from asyncrl_tpu.obs import http as http_mod
 from asyncrl_tpu.obs import timeseries as timeseries_mod
@@ -54,7 +59,8 @@ from asyncrl_tpu.obs import timeseries as timeseries_mod
 _EXPORT_SEQ = itertools.count(1)
 
 __all__ = [
-    "PipelineObs", "setup", "export", "flightrec", "registry", "trace",
+    "PipelineObs", "setup", "export", "flightrec", "introspect",
+    "registry", "trace",
 ]
 
 
@@ -90,7 +96,8 @@ class PipelineObs:
     health telemetry to its own rings."""
 
     def __init__(self, enabled: bool, run_dir: str | None, recorder,
-                 tracer=None, store=None, monitor=None, http=None):
+                 tracer=None, store=None, monitor=None, http=None,
+                 introspect_on: bool = False):
         self.enabled = enabled
         self.run_dir = run_dir
         self._recorder = recorder
@@ -98,6 +105,10 @@ class PipelineObs:
         self.store = store
         self.monitor = monitor
         self.http = http
+        # Training introspection (obs/introspect.py): when on, the window
+        # drain samples the memory watermarks (registry gauges) and
+        # persists pending compile events into the time-series store.
+        self.introspect_on = introspect_on
 
     def window(self) -> dict[str, float]:
         """Counters/gauges/histograms + this trainer's trace stats for one
@@ -114,11 +125,21 @@ class PipelineObs:
         stdout, JSONL, TensorBoard, the timeseries, ``/metrics`` — sees
         this identical dict: no sink can drift on which keys a window
         carries. Returns ``agg`` (mutated in place)."""
+        if self.introspect_on:
+            # Memory watermarks FIRST (they publish as registry gauges),
+            # so the one registry snapshot below already carries them.
+            introspect.sample_memory()
         agg.update(self.window())
         if self.monitor is not None:
             # The monitor owns the store.append (sample + annotations in
             # order); setup() never mounts a store without a monitor.
             self.monitor.on_window(agg)
+        if self.store is not None:
+            # Compile events recorded since the last window (any thread)
+            # persist as kind=event annotations AFTER the sample, on this
+            # (the writer) thread — the store's single-writer contract.
+            for event in introspect.drain_compile_events():
+                self.store.annotate(event)
         return agg
 
     def export_trace(self) -> str | None:
@@ -177,6 +198,10 @@ def setup(config) -> PipelineObs:
     threads).
     """
     registry.registry().reset()
+    # A fresh agent must never persist a predecessor's compile events
+    # into its own run_dir (the registry-reset semantics).
+    introspect.reset()
+    intro = introspect.enabled(config)
     env = trace.env_requests()
     enabled = bool(config.trace) if env is None else env
     # Always RE-ARM (even under env arming): a fresh agent gets fresh
@@ -192,7 +217,7 @@ def setup(config) -> PipelineObs:
         # agent must never dump forensics into an OLD agent's run_dir
         # with the old agent's config embedded (faults.arm("") precedent).
         flightrec.disarm()
-        return PipelineObs(False, None, None)
+        return PipelineObs(False, None, None, introspect_on=intro)
     if enabled:
         run_dir = (
             os.environ.get("ASYNCRL_RUN_DIR")
@@ -249,5 +274,5 @@ def setup(config) -> PipelineObs:
             )
     return PipelineObs(
         enabled, run_dir, recorder, tracer=tracer,
-        store=store, monitor=monitor, http=server,
+        store=store, monitor=monitor, http=server, introspect_on=intro,
     )

@@ -9,10 +9,16 @@ span export) — into one report:
    set over the samples (same thresholds, read back from the run's meta
    line) — so runs recorded before a detector existed still get judged
    by it, and a live monitor that died mid-run loses nothing.
-2. **Bottleneck attribution**: the stall-attribution table from the run's
+2. **Learning timeline** (ISSUE 8): the learning-health trajectory —
+   entropy, behaviour-vs-learner KL, V-trace clip saturation, value
+   explained-variance, off-policy staleness percentiles, compile counts,
+   memory watermarks — first/last/min/max per metric, plus every
+   recorded compile event with its static-shape blame. The offline
+   replay of what the introspection layer measured live.
+3. **Bottleneck attribution**: the stall-attribution table from the run's
    newest trace export (falling back to the newest flight dump's embedded
    trace) — the ``obs report`` analysis inlined.
-3. **Regression verdict**: the run's best window throughput against the
+4. **Regression verdict**: the run's best window throughput against the
    matching BENCH_HISTORY.json rows (preset- and platform-matched,
    newest row wins) with a tolerance fraction — "did this PR regress
    perf" as a command, not archaeology.
@@ -138,6 +144,50 @@ def _latest_trace_doc(run_dir: str) -> tuple[dict[str, Any] | None, str | None]:
     return None, None
 
 
+# Learning-health keys the learning-timeline section summarizes, in
+# display order (only keys the run actually recorded are shown).
+LEARNING_KEYS = (
+    "loss", "entropy", "kl", "rho_clip_frac", "c_clip_frac",
+    "explained_variance", "staleness_p50", "staleness_p95",
+    "staleness_max", "compiles", "infer_recompile", "learner_recompile",
+    "mem_device_bytes_in_use", "mem_device_peak_bytes",
+    "mem_host_rss_bytes", "mem_host_rss_peak_bytes",
+)
+
+
+def learning_timeline(
+    samples: list[dict[str, Any]], events: list[dict[str, Any]]
+) -> list[str]:
+    """The learning-timeline section lines: metric trajectories
+    (first/last/min/max over the run) + recorded compile events with
+    their static-shape blame."""
+    lines: list[str] = []
+    for key in LEARNING_KEYS:
+        values = timeseries.series_of(samples, key)
+        if not values:
+            continue
+        lines.append(
+            f"{key:<26} first {values[0]:>12.5g}  last {values[-1]:>12.5g}"
+            f"  min {min(values):>12.5g}  max {max(values):>12.5g}"
+        )
+    if not lines:
+        lines.append(
+            "no learning-health metrics recorded (introspection was off, "
+            "or the run predates it)"
+        )
+    compiles = [e for e in events if e.get("type") == "compile"]
+    if compiles:
+        lines.append(f"-- {len(compiles)} recorded compile event(s) --")
+    for event in compiles:
+        dt = event.get("compile_s")
+        lines.append(
+            f"compile #{event.get('seq', '?')} at {event.get('site', '?')}"
+            + (f" ({1e3 * dt:.0f}ms)" if isinstance(dt, (int, float)) else "")
+            + f": {event.get('blame', '?')}"
+        )
+    return lines
+
+
 def _timeline(
     recorded: list[dict[str, Any]], replayed: list[health.HealthEvent]
 ) -> list[dict[str, Any]]:
@@ -179,7 +229,11 @@ def diagnose(
         )
     thresholds = health.Thresholds.from_meta(meta)
     replayed = health.replay(samples, thresholds=thresholds)
-    timeline = _timeline(recorded, replayed)
+    # The event stream mixes detector firings and compile annotations
+    # (both are kind=event lines): the detector timeline reads the
+    # former, the learning timeline the latter.
+    health_events = [e for e in recorded if "detector" in e]
+    timeline = _timeline(health_events, replayed)
 
     lines: list[str] = []
     steps = timeseries.series_of(samples, "env_steps")
@@ -203,6 +257,10 @@ def diagnose(
             f"({event.get('component', '?')}, {event.get('source')}): "
             f"{event.get('message', '')}"
         )
+
+    lines.append("")
+    lines.append("== learning timeline ==")
+    lines.extend(learning_timeline(samples, recorded))
 
     lines.append("")
     lines.append("== bottleneck attribution ==")

@@ -39,7 +39,24 @@ restart_storm         actors/    critical  >= 2 supervised restarts in ONE
 eval_regression       learner    warn      eval_return fell more than
                                            health_eval_drop below the
                                            run's best (0 = off)
+entropy_collapse      learner    warn      policy entropy below
+                                           health_entropy_floor (0 = off)
+staleness_runaway     pipeline   warn      staleness_max (behaviour-params
+                                           lag, learner updates) above
+                                           health_staleness_max (0 = off)
+rho_clip_saturation   learner    warn      rho_clip_frac above
+                                           health_rho_clip_frac (0 = off)
+recompile_storm       pipeline   warn      `compiles` grew >=
+                                           health_recompile_storm in one
+                                           window (0 = off)
+memory_growth         pipeline   warn      memory watermark grew more than
+                                           health_mem_growth x the run's
+                                           first watermark (0 = off)
 ===================== ========== ========= =================================
+
+The last five (ISSUE 8) watch the *learning* and the *device* — fed by
+``obs/introspect.py`` and the loss-aux diagnostics — where everything
+above watches the system.
 
 The ``learner_stall`` verdict reuses the span taxonomy's causal table
 (:data:`asyncrl_tpu.obs.spans.WAIT_CAUSES`): when tracing is armed the
@@ -91,6 +108,12 @@ class Thresholds:
     grad_norm_max: float = 0.0   # 0 = detector off
     eval_drop: float = 0.0       # 0 = detector off
     window_ttl: int = 3          # windows an event degrades the verdict
+    # Learning-health / device-behavior detectors (ISSUE 8; 0 = off):
+    entropy_floor: float = 0.0
+    staleness_max: float = 0.0
+    rho_clip_frac: float = 0.0
+    recompile_storm: int = 0
+    mem_growth: float = 0.0
 
     @classmethod
     def from_config(cls, config: Any) -> "Thresholds":
@@ -100,6 +123,11 @@ class Thresholds:
             grad_norm_max=config.health_grad_norm_max,
             eval_drop=config.health_eval_drop,
             window_ttl=config.health_window_ttl,
+            entropy_floor=config.health_entropy_floor,
+            staleness_max=config.health_staleness_max,
+            rho_clip_frac=config.health_rho_clip_frac,
+            recompile_storm=config.health_recompile_storm,
+            mem_growth=config.health_mem_growth,
         )
 
     @classmethod
@@ -254,6 +282,104 @@ def _eval_regression(monitor: "HealthMonitor", sample: dict[str, Any]):
     )
 
 
+def _finite_number(value: Any) -> bool:
+    return (
+        isinstance(value, (int, float))
+        and not isinstance(value, bool)
+        and math.isfinite(value)
+    )
+
+
+def _entropy_collapse(monitor: "HealthMonitor", sample: dict[str, Any]):
+    floor = monitor.thresholds.entropy_floor
+    value = sample.get("entropy")
+    if floor <= 0 or not _finite_number(value) or value >= floor:
+        return None
+    return (
+        f"policy entropy {value:.4g} below health_entropy_floor "
+        f"{floor:g}: exploration collapsed (the policy went deterministic)",
+        {"entropy": float(value)},
+    )
+
+
+def _staleness_runaway(monitor: "HealthMonitor", sample: dict[str, Any]):
+    limit = monitor.thresholds.staleness_max
+    value = sample.get("staleness_max")
+    if limit <= 0 or not _finite_number(value) or value <= limit:
+        return None
+    p95 = sample.get("staleness_p95")
+    return (
+        f"behaviour-params staleness ran away: max lag {value:.0f} learner "
+        f"updates (p95 {p95 if p95 is not None else '?'}) exceeds "
+        f"health_staleness_max {limit:g} — actors are consuming weights "
+        "far behind the learner",
+        {"staleness_max": float(value), "staleness_p95": p95},
+    )
+
+
+def _rho_clip_saturation(monitor: "HealthMonitor", sample: dict[str, Any]):
+    limit = monitor.thresholds.rho_clip_frac
+    value = sample.get("rho_clip_frac")
+    if limit <= 0 or not _finite_number(value) or value <= limit:
+        return None
+    return (
+        f"V-trace rho-clip saturated: {100.0 * value:.0f}% of importance "
+        f"weights pinned at the cap (> health_rho_clip_frac "
+        f"{limit:g}) — the learner has drifted too far off-policy for "
+        "the correction to be meaningful",
+        {"rho_clip_frac": float(value)},
+    )
+
+
+def _recompile_storm(monitor: "HealthMonitor", sample: dict[str, Any]):
+    limit = monitor.thresholds.recompile_storm
+    if limit <= 0:
+        return None
+    if monitor._prev is None:
+        # First window: delta() would return the whole cumulative counter,
+        # which always includes the EXPECTED cold-start compilations
+        # (learner step + first inference batches) — not a storm.
+        return None
+    grew = monitor.delta(sample, "compiles")
+    if grew < limit:
+        return None
+    infer = monitor.delta(sample, "infer_recompile")
+    learner = monitor.delta(sample, "learner_recompile")
+    return (
+        f"{grew:.0f} compilation(s) in one window (>= "
+        f"health_recompile_storm {limit}): recompiles are taxing the hot "
+        f"path ({infer:.0f} inference, {learner:.0f} learner — unstable "
+        "batch shapes?)",
+        {"compiles": grew, "infer_recompile": infer,
+         "learner_recompile": learner},
+    )
+
+
+def _memory_growth(monitor: "HealthMonitor", sample: dict[str, Any]):
+    limit = monitor.thresholds.mem_growth
+    if limit <= 0:
+        return None
+    value = sample.get("mem_device_bytes_in_use")
+    key = "mem_device_bytes_in_use"
+    if not _finite_number(value):
+        value, key = sample.get("mem_host_rss_bytes"), "mem_host_rss_bytes"
+    if not _finite_number(value) or value <= 0:
+        return None
+    baseline = monitor.mem_baseline
+    if baseline is None or baseline <= 0:
+        monitor.mem_baseline = float(value)
+        return None
+    if value <= baseline * (1.0 + limit):
+        return None
+    return (
+        f"{key} grew to {value:,.0f} bytes — "
+        f"{value / baseline - 1.0:+.0%} over the run's first watermark "
+        f"{baseline:,.0f} (health_mem_growth {limit:g}): possible leak or "
+        "unbounded cache",
+        {"key": key, "bytes": float(value), "baseline": baseline},
+    )
+
+
 def default_detectors() -> list[Detector]:
     return [
         Detector("nonfinite_loss", "learner", "critical", _nonfinite),
@@ -267,6 +393,17 @@ def default_detectors() -> list[Detector]:
         Detector("slo_breach", "serve-core", "warn", _slo_breach),
         Detector("restart_storm", "actors", "critical", _restart_storm),
         Detector("eval_regression", "learner", "warn", _eval_regression),
+        # Learning-health / device-behavior detectors (ISSUE 8), fed by
+        # the loss-aux diagnostics and obs/introspect.py:
+        Detector("entropy_collapse", "learner", "warn", _entropy_collapse),
+        Detector(
+            "staleness_runaway", "pipeline", "warn", _staleness_runaway
+        ),
+        Detector(
+            "rho_clip_saturation", "learner", "warn", _rho_clip_saturation
+        ),
+        Detector("recompile_storm", "pipeline", "warn", _recompile_storm),
+        Detector("memory_growth", "pipeline", "warn", _memory_growth),
     ]
 
 
@@ -307,6 +444,8 @@ class HealthMonitor:
         self.fps_history: deque[float] = deque(maxlen=32)
         self.slo_breach_run = 0
         self.eval_best: float | None = None
+        # memory_growth's reference: the run's first recorded watermark.
+        self.mem_baseline: float | None = None
         self._prev: dict[str, Any] | None = None
         self._prev_t = 0.0
         # lint: thread-shared-ok(GIL-atomic int; single-writer window counter, verdict() readers see the latest or previous window — both coherent)

@@ -29,6 +29,27 @@ def categorical_entropy(logits: jax.Array) -> jax.Array:
     return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
 
 
+def explained_variance(targets: jax.Array, predictions: jax.Array) -> jax.Array:
+    """Value-head learning health: 1 - Var(target - V) / Var(target).
+
+    1 = the critic explains the value targets perfectly, 0 = no better
+    than predicting the mean, negative = worse than the mean (a diverging
+    or unlearned value head). Stop-gradient on both sides — this is a
+    diagnostic, never a training signal. Degenerate windows with (near-)
+    constant targets report 0 rather than an unbounded ratio.
+
+    Sharded note: inside shard_map this is the LOCAL explained variance;
+    the caller's pmean over the data axes yields the mean of per-shard
+    EVs — a diagnostic-grade aggregate (exact only when shard means
+    agree), unlike the mean-based metrics which pmean exactly.
+    """
+    targets = jax.lax.stop_gradient(targets)
+    predictions = jax.lax.stop_gradient(predictions)
+    var_t = jnp.var(targets)
+    ev = 1.0 - jnp.var(targets - predictions) / jnp.maximum(var_t, 1e-8)
+    return jnp.where(var_t < 1e-8, 0.0, ev)
+
+
 def a3c_loss(
     logits: jax.Array,
     values: jax.Array,
@@ -41,6 +62,7 @@ def a3c_loss(
     dist=None,
     scan_impl: str = "associative",
     returns=None,
+    diagnostics: bool = False,
 ):
     """n-step-return actor-critic loss (A3C, PAPERS.md:8).
 
@@ -66,6 +88,8 @@ def a3c_loss(
         "entropy": entropy,
         "mean_value": jnp.mean(values),
     }
+    if diagnostics:
+        metrics["explained_variance"] = explained_variance(returns, values)
     return loss, metrics
 
 
@@ -84,11 +108,18 @@ def impala_loss(
     dist=None,
     scan_impl: str = "associative",
     vtrace_out=None,
+    diagnostics: bool = False,
 ):
     """IMPALA: V-trace corrected policy gradient + value + entropy
     (BASELINE.json:5 'V-trace correction + policy-gradient/value loss').
     ``vtrace_out`` may be passed precomputed (the time-sharded learner
-    builds it with ``parallel.timeshard.vtrace_timesharded``)."""
+    builds it with ``parallel.timeshard.vtrace_timesharded``).
+
+    ``diagnostics`` (ISSUE 8, ``config.introspect``) folds off-policy
+    learning-health scalars into the metrics aux — behaviour-vs-learner
+    KL, the c-clip saturation fraction, and the value head's explained
+    variance against the V-trace targets — all device reductions riding
+    the existing metrics path, no extra host sync."""
     target_logp = dist.logp(logits, actions) if dist else categorical_logp(logits, actions)
     vt = vtrace_out if vtrace_out is not None else vtrace(
         behaviour_logp=behaviour_logp,
@@ -112,6 +143,15 @@ def impala_loss(
         "rho_clip_frac": vt.rho_clip_frac,
         "mean_value": jnp.mean(values),
     }
+    if diagnostics:
+        # E_mu[log mu - log pi]: the sampled forward KL(mu || pi) of the
+        # behaviour policy from the learner at the taken actions — the
+        # direct measure of how off-policy the consumed fragment was.
+        metrics["kl"] = jnp.mean(
+            jax.lax.stop_gradient(behaviour_logp - target_logp)
+        )
+        metrics["c_clip_frac"] = vt.c_clip_frac
+        metrics["explained_variance"] = explained_variance(vt.vs, values)
     return loss, metrics
 
 
@@ -179,6 +219,7 @@ def ppo_loss(
     normalize_advantages: bool = True,
     axis_name: str | None = None,
     dist=None,
+    diagnostics: bool = False,
 ):
     """PPO clipped surrogate over precomputed GAE advantages
     (BASELINE.json:10 'PPO + GAE'). Flat or [T, B] batch shapes both work.
@@ -213,6 +254,8 @@ def ppo_loss(
         ),
         "approx_kl": jnp.mean(behaviour_logp - logp),
     }
+    if diagnostics:
+        metrics["explained_variance"] = explained_variance(returns, values)
     return loss, metrics
 
 
@@ -226,4 +269,5 @@ __all__ = [
     "vtrace",
     "categorical_logp",
     "categorical_entropy",
+    "explained_variance",
 ]

@@ -33,7 +33,8 @@ from asyncrl_tpu.ops.scan import reverse_linear_scan
 class VTraceOutput(NamedTuple):
     vs: jax.Array  # [T, B] corrected value targets
     pg_advantages: jax.Array  # [T, B] importance-weighted PG advantages
-    rho_clip_frac: jax.Array  # scalar: fraction of rho's hitting the clip
+    rho_clip_frac: jax.Array  # scalar: fraction of rho's hitting rho_bar
+    c_clip_frac: jax.Array  # scalar: fraction of c's hitting c_bar
 
 
 def vtrace(
@@ -85,9 +86,16 @@ def vtrace(
     vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
     pg_advantages = clipped_rhos * (rewards + discounts * vs_tp1 - values)
 
+    # Clip saturation fractions (ISSUE 8 off-policy diagnostics): how often
+    # the importance weights hit their caps. Near-1.0 rho saturation means
+    # the learner barely corrects for the behaviour gap anymore — the
+    # observed condition under which staleness-tolerant replay stops being
+    # safe (IMPACT, PAPERS.md). Two scalar reductions, no host sync.
     rho_clip_frac = jnp.mean((rhos > rho_clip).astype(jnp.float32))
+    c_clip_frac = jnp.mean((rhos > c_clip).astype(jnp.float32))
     return VTraceOutput(
         vs=jax.lax.stop_gradient(vs),
         pg_advantages=jax.lax.stop_gradient(pg_advantages),
         rho_clip_frac=rho_clip_frac,
+        c_clip_frac=c_clip_frac,
     )

@@ -129,14 +129,18 @@ def vtrace_timesharded(
     vs_tp1 = shift_from_next_shard(vs, bootstrap_value, axis_name)
     pg_advantages = clipped_rhos * (rewards + discounts * vs_tp1 - values)
 
-    # Global clip fraction: equal-sized shards -> pmean of local means.
+    # Global clip fractions: equal-sized shards -> pmean of local means.
     rho_clip_frac = jax.lax.pmean(
         jnp.mean((rhos > rho_clip).astype(jnp.float32)), axis_name
+    )
+    c_clip_frac = jax.lax.pmean(
+        jnp.mean((rhos > c_clip).astype(jnp.float32)), axis_name
     )
     return VTraceOutput(
         vs=jax.lax.stop_gradient(vs),
         pg_advantages=jax.lax.stop_gradient(pg_advantages),
         rho_clip_frac=rho_clip_frac,
+        c_clip_frac=c_clip_frac,
     )
 
 

@@ -16,7 +16,12 @@ after ``max_wait_s`` — whichever comes first. In steady state all actors
 block on inference every step, so full batches are the norm; the timeout
 only covers clients that are mid-fragment-emit, dead, or restarting.
 Partial batches change the call's batch size and recompile once per
-distinct size (jit cache keyed on shape) — rare by construction.
+distinct size (jit cache keyed on shape) — rare by construction, and
+since ISSUE 8 *measured* rather than assumed: the trainer wraps the
+shared inference callable in ``obs.introspect.instrument``, so every
+distinct batch shape lands in the ``infer_recompile`` counter (exported
+next to ``infer_coalesce_batch``) and a ``kind=event`` compile
+annotation with static-shape blame in ``timeseries.jsonl``.
 
 Semantics note vs per-thread inference: the server always evaluates under
 the LATEST published params, so behaviour params can refresh mid-fragment

@@ -170,6 +170,12 @@ class ServeCore(threading.Thread):
         self._counter_deadline = obs_registry.counter(
             DISPATCH_DEADLINE_COUNTER
         )
+        # Per-dispatch batch-row distribution (serve_batch_rows_p50/p95/
+        # max in the window): the shape story behind the recompile
+        # counters — every DISTINCT partial-batch size a deadline flush
+        # produces is a potential ``infer_recompile``, so the row
+        # distribution says how unstable the dispatch shapes really are.
+        self._hist_rows = obs_registry.histogram("serve_batch_rows")
         # Store-backed default policy: version -> generation conversion
         # happens on the serve thread (_sync_store); seeded here so the
         # router serves requests that arrive before the first dispatch.
@@ -451,6 +457,7 @@ class ServeCore(threading.Thread):
             offsets = np.cumsum([0] + sizes)
             self.coalesce_rounds += 1
             self.coalesce_rows += int(offsets[-1])
+            self._hist_rows.observe(float(offsets[-1]))
             for request, a, b in zip(group, offsets[:-1], offsets[1:]):
                 if core is None:
                     request.result = (actions[a:b], logp[a:b])

@@ -284,6 +284,41 @@ class Config:
     # Windows a fired event keeps the /healthz verdict degraded (the
     # recovery horizon: no new events for this many windows => ok again).
     health_window_ttl: int = 3
+    # --- training introspection (obs/introspect.py) ---
+    # Learning-health + device-behavior telemetry: off-policy staleness
+    # percentiles per window, loss-aux diagnostics (behaviour-vs-learner
+    # KL, V-trace rho/c clip saturation, value explained-variance),
+    # compile/recompile accounting with static-shape blame on the
+    # learner/inference entry points, and per-window memory watermarks.
+    # On by default (the device side is a handful of scalar reductions
+    # folded into the existing metrics aux — no extra host sync;
+    # scripts/introspect_smoke.sh is the on/off A/B gate).
+    # ASYNCRL_INTROSPECT (when set) wins, like ASYNCRL_TRACE.
+    introspect: bool = True
+    # Detector thresholds for the learning-health detectors (obs/health.py;
+    # all default 0 = off — the scales are workload-specific, so arming an
+    # absolute bar is an operator choice, the health_grad_norm_max rule):
+    # entropy_collapse fires when the window's policy entropy falls below
+    # this floor (nats; exploration is dead / the policy went deterministic
+    # early).
+    health_entropy_floor: float = 0.0
+    # staleness_runaway fires when the window's max behaviour-params lag
+    # (in learner updates, staleness_max) exceeds this.
+    health_staleness_max: float = 0.0
+    # rho_clip_saturation fires when the V-trace rho-clip fraction exceeds
+    # this (near 1.0 = importance weights pinned at the cap: the learner
+    # has drifted too far from the behaviour policy for the correction to
+    # mean much).
+    health_rho_clip_frac: float = 0.0
+    # recompile_storm fires when `compiles` grows by at least this many in
+    # ONE window (a recompile storm — e.g. unstable batch shapes — silently
+    # taxes every number a bench reports). The first window is exempt:
+    # cold-start compilation is expected, not a storm.
+    health_recompile_storm: int = 0
+    # memory_growth fires when the memory watermark (device bytes-in-use
+    # where available, else host RSS) exceeds the run's first recorded
+    # watermark by more than this fraction (0.5 = +50%): the leak detector.
+    health_mem_growth: float = 0.0
 
     # --- runtime ---
     seed: int = 0

@@ -1,0 +1,143 @@
+#!/usr/bin/env bash
+# Introspect smoke: A/B the training-introspection layer (ISSUE 8;
+# obs/introspect.py + the loss-aux diagnostics) on/off on a tiny
+# pong_impala-shaped sebulba run:
+#
+#   1. IDENTITY — losses must be bit-identical on a fixed seed with
+#      introspection on vs off (the diagnostics are aux-only device
+#      reductions; they must never perturb the update).
+#   2. FUNCTION — the ON run's windows must carry the introspection keys
+#      (staleness percentiles, kl, explained_variance, compiles) and the
+#      OFF run's must not (off = the pre-ISSUE-8 surface).
+#   3. OVERHEAD — the ON run must not be more than
+#      ASYNCRL_INTROSPECT_TOLERANCE (default 1.15, the perf_smoke noise
+#      budget for this shared 1-core box — identical configs swing ±25%
+#      run to run; tighten on quiet hardware) slower, best-of-N
+#      alternating per the perf_smoke measurement discipline.
+#
+# Usage: scripts/introspect_smoke.sh                  # CPU, ~1-2 min
+#        ASYNCRL_SMOKE_UPDATES=64 scripts/introspect_smoke.sh
+#        ASYNCRL_INTROSPECT_TOLERANCE=1.10 scripts/introspect_smoke.sh
+#        ASYNCRL_SMOKE_RECORD=1 scripts/introspect_smoke.sh  # append the
+#          A/B as a kind="observability" probe="introspect_ab" row to
+#          BENCH_HISTORY.json
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+UPDATES="${ASYNCRL_SMOKE_UPDATES:-24}"
+TOLERANCE="${ASYNCRL_INTROSPECT_TOLERANCE:-1.15}"
+RECORD="${ASYNCRL_SMOKE_RECORD:-0}"
+
+python - "$UPDATES" "$TOLERANCE" "$RECORD" <<'EOF'
+import sys
+import time
+
+import numpy as np
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.configs import presets
+
+updates, tolerance = int(sys.argv[1]), float(sys.argv[2])
+record = sys.argv[3] not in ("", "0")
+NUM_ENVS, UNROLL = 16, 16
+steps = updates * NUM_ENVS * UNROLL
+
+INTROSPECT_KEYS = (
+    "staleness_p50", "staleness_p95", "staleness_max",
+    "kl", "explained_variance", "compiles", "mem_host_rss_bytes",
+)
+
+
+def run(introspect: bool):
+    cfg = presets.get("pong_impala").replace(
+        backend="sebulba", host_pool="jax", num_envs=NUM_ENVS,
+        actor_threads=1, unroll_len=UNROLL, precision="f32", log_every=4,
+        seed=3, hidden_sizes=(64, 64),
+        # Frozen behaviour params: losses must be seed-deterministic for
+        # the identity assertion (no publish-timing race).
+        actor_staleness=1_000_000,
+        introspect=introspect,
+    )
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=NUM_ENVS * UNROLL)  # jit warm-up
+        t0 = time.perf_counter()
+        history = agent.train(total_env_steps=NUM_ENVS * UNROLL + steps)
+        elapsed = time.perf_counter() - t0
+    finally:
+        agent.close()
+    fps = steps / elapsed
+    losses = [h["loss"] for h in history]
+    last = history[-1]
+    label = "introspect=on " if introspect else "introspect=off"
+    print(
+        f"introspect_smoke {label}: fps={fps:12,.0f}  "
+        f"compiles={int(last.get('compiles', 0))}  "
+        f"staleness_p95={last.get('staleness_p95', '-')}  "
+        f"kl={last.get('kl', '-')}"
+    )
+    return fps, losses, last
+
+
+# Best-of-three per mode, alternating (the perf_smoke discipline: the
+# first training run in a process is systematically slow, and this
+# 1-core box's scheduler noise swings identical configs run to run).
+run(True)  # discarded process warm-up
+fps_off, losses_off, last_off = run(False)
+fps_on, losses_on, last_on = run(True)
+for _ in range(2):
+    f, _, _ = run(False)
+    fps_off = max(fps_off, f)
+    f, _, _ = run(True)
+    fps_on = max(fps_on, f)
+
+if not np.array_equal(np.asarray(losses_on), np.asarray(losses_off)):
+    sys.exit(
+        "introspect_smoke FAILED: introspect on/off losses diverged on a "
+        "fixed seed — the diagnostics aux perturbed the update"
+    )
+print(f"introspect_smoke: losses identical across {len(losses_on)} windows")
+
+missing = [k for k in INTROSPECT_KEYS if k not in last_on]
+if missing:
+    sys.exit(
+        f"introspect_smoke FAILED: ON run's window is missing {missing}"
+    )
+leaked = [k for k in INTROSPECT_KEYS if k in last_off]
+if leaked:
+    sys.exit(
+        f"introspect_smoke FAILED: OFF run's window leaked {leaked}"
+    )
+print("introspect_smoke: ON windows carry the introspection keys, "
+      "OFF windows do not")
+
+if fps_on * tolerance < fps_off:
+    sys.exit(
+        f"introspect_smoke FAILED: introspection overhead above budget "
+        f"({fps_on:,.0f} vs {fps_off:,.0f} fps, tolerance {tolerance}x)"
+    )
+print(
+    f"introspect_smoke OK: introspected {fps_on:,.0f} fps vs plain "
+    f"{fps_off:,.0f} fps ({fps_on / fps_off:.3f}x, budget {tolerance}x)"
+)
+
+if record:
+    from asyncrl_tpu.utils import bench_history
+
+    entry = bench_history.record({
+        "kind": "observability",
+        "probe": "introspect_ab",
+        "preset": "pong_impala(sebulba tiny)",
+        **bench_history.device_entry(),
+        "num_envs": NUM_ENVS,
+        "actor_threads": 1,
+        "unroll_len": UNROLL,
+        "updates": updates,
+        "fps_introspected": round(fps_on),
+        "fps_plain": round(fps_off),
+        "introspect_overhead": round(fps_off / fps_on, 3),
+        "compiles": int(last_on.get("compiles", 0)),
+    })
+    print("introspect_smoke: recorded", entry["ts"])
+EOF

@@ -131,6 +131,12 @@ QUICK: dict[str, object] = {
     # fault-injected flight-recorder acceptance run and the disabled-mode
     # window check) are ~10s combined. Whole file ~15s.
     "test_obs.py": "all",
+    # Training introspection (obs/introspect.py, ISSUE 8): staleness/
+    # compile/memory units are sub-second; the live acceptance run
+    # (metrics + /healthz flip + forensics) and the introspect-off A/B
+    # are ~15s combined. Tier-1 by the ISSUE 8 acceptance contract
+    # (detectors proven to flip /healthz on every PR). Whole file ~20s.
+    "test_introspect.py": "all",
     # Static checker (asyncrl_tpu/analysis/): pure-AST, no training; the
     # whole file (package-gates-clean + fixture corpus + lock/edge
     # deletion detection + cache correctness/speedup + baseline + JSON +

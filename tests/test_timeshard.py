@@ -128,7 +128,8 @@ def test_vtrace_timesharded_matches_single_device(devices):
             mesh=mesh,
             in_specs=(P("sp"),) * 5,
             out_specs=VTraceOutput(
-                vs=P("sp"), pg_advantages=P("sp"), rho_clip_frac=P()
+                vs=P("sp"), pg_advantages=P("sp"), rho_clip_frac=P(),
+                c_clip_frac=P(),
             ),
         )
     )(behaviour_logp, target_logp, rewards, discounts, values)
@@ -144,6 +145,9 @@ def test_vtrace_timesharded_matches_single_device(devices):
     )
     np.testing.assert_allclose(
         float(sharded.rho_clip_frac), float(want.rho_clip_frac), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(sharded.c_clip_frac), float(want.c_clip_frac), rtol=1e-6
     )
 
 

@@ -87,6 +87,26 @@ def test_rho_clip_frac():
         jnp.zeros((B,)),
     )
     assert np.isclose(float(out.rho_clip_frac), 1 / 8)
+    # c_bar == rho_bar == 1.0 here, so the c fraction matches rho's.
+    assert np.isclose(float(out.c_clip_frac), 1 / 8)
+
+
+def test_c_clip_frac_with_lower_c_bar():
+    """c_bar < rho_bar (the paper's allowed asymmetry): the c fraction
+    counts every rho above c_bar, a superset of the rho-clip hits."""
+    T, B = 2, 2
+    behaviour = np.zeros((T, B), np.float32)
+    target = np.log(np.array(
+        [[0.3, 0.7], [1.5, 0.9]], np.float32
+    ))  # rhos: 0.3, 0.7, 1.5, 0.9
+    out = vtrace(
+        jnp.asarray(behaviour), jnp.asarray(target),
+        jnp.zeros((T, B)), jnp.full((T, B), 0.9), jnp.zeros((T, B)),
+        jnp.zeros((B,)),
+        rho_clip=1.0, c_clip=0.5,
+    )
+    assert np.isclose(float(out.rho_clip_frac), 1 / 4)  # only 1.5
+    assert np.isclose(float(out.c_clip_frac), 3 / 4)  # 0.7, 1.5, 0.9
 
 
 def test_terminal_cut():
