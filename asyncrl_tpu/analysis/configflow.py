@@ -58,6 +58,7 @@ KNOWN_ENV_VARS = {
     "ASYNCRL_OBS_PORT",       # obs/http.py — exposition endpoint port
     "ASYNCRL_INTROSPECT",     # obs/introspect.py — training introspection
     "ASYNCRL_INTROSPECT_TOLERANCE",  # scripts/introspect_smoke.sh budget
+    "ASYNCRL_ELASTIC",        # api/sebulba_trainer.py — elastic-runtime toggle
 }
 
 _CONFIG_NAMES = {"config", "cfg"}

@@ -194,26 +194,73 @@ class SebulbaTrainer:
         self._store = ParamStore(self._published(self.state), self.env_steps)
         cap = config.queue_capacity or 2 * config.actor_threads
         self._queue: "queue.Queue[Fragment]" = queue.Queue(maxsize=cap)
+        # Elastic runtime (asyncrl_tpu/runtime/elastic.py): resolved ONCE
+        # (ASYNCRL_ELASTIC wins over config.elastic, the ASYNCRL_SERVE
+        # precedence) and validated eagerly — the in-flight ring swap does
+        # not compose with fused multi-fragment slabs, and the legacy
+        # InferenceServer's client set is fixed-shape.
+        self._elastic_on = self._use_elastic()
+        if self._elastic_on:
+            if config.updates_per_call > 1:
+                raise ValueError(
+                    "elastic=True requires updates_per_call=1: a fused "
+                    "[K>1] slab interrupted by a ring swap would strand "
+                    "its partial batch"
+                )
+            if config.inference_server and not self._use_serve_core():
+                raise ValueError(
+                    "elastic=True requires the serve core for the shared "
+                    "server (serve=True / ASYNCRL_SERVE=1): the legacy "
+                    "InferenceServer's client set is fixed-shape"
+                )
+            emax = config.elastic_max_actors or 2 * config.actor_threads
+            if not (
+                config.elastic_min_actors
+                <= config.actor_threads
+                <= emax
+            ):
+                raise ValueError(
+                    f"actor_threads={config.actor_threads} outside the "
+                    f"elastic bounds [{config.elastic_min_actors}, {emax}]"
+                )
+        else:
+            registry = faults.active()
+            if registry is not None and registry.has_kind("scale"):
+                raise ValueError(
+                    "fault spec arms a 'scale' site but the elastic "
+                    "runtime is off (elastic=True / ASYNCRL_ELASTIC=1): "
+                    "scripted scale requests would accumulate with no "
+                    "controller to drain them"
+                )
         # Zero-copy staging ring (rollout/staging.py): actors write
         # fragments straight into preallocated [K, T, B, ...] slabs and
         # the drain transfers whole slabs, double-buffered against the
         # learner's compute. config.overlap_h2d=False keeps the legacy
         # copy-and-stack path (A/B-compared by scripts/perf_smoke.sh).
+        # Under elasticity the ring sits behind a RingSwapHolder so a
+        # fleet-scale event can install a right-sized ring while in-flight
+        # leases finish on the old one.
         self._staging = None
+        self._staging_template = None
+        self._staging_rows = max(config.updates_per_call, 1)
         if config.overlap_h2d:
             from asyncrl_tpu.rollout import staging
 
             template = staging.fragment_template(
                 config, self.spec, self.model, self._envs_per_actor
             )
-            K = max(config.updates_per_call, 1)
-            self._staging = staging.StagingRing(
+            self._staging_template = template
+            K = self._staging_rows
+            ring = staging.StagingRing(
                 template,
                 rows_per_slab=K,
                 num_slabs=(
                     config.staging_slabs
                     or staging.auto_num_slabs(cap, config.actor_threads, K)
                 ),
+            )
+            self._staging = (
+                staging.RingSwapHolder(ring) if self._elastic_on else ring
             )
         # Observability (asyncrl_tpu/obs/): arms span tracing + the
         # flight recorder per config.trace (ASYNCRL_TRACE wins), resets
@@ -222,6 +269,41 @@ class SebulbaTrainer:
         # endpoint per config.obs_http_port); the window aggregation
         # (observe_window) and close()/shutdown() drive the handle.
         self._obs = obs.setup(config)
+        # The elastic controller itself (policy) + the save → reconfigure
+        # → restore barrier (safety). Both None when elasticity is off —
+        # the off path constructs NOTHING elastic, the bit-identity
+        # contract of scripts/elastic_smoke.sh.
+        self._elastic = None
+        self._elastic_barrier = None
+        if self._elastic_on:
+            from asyncrl_tpu.obs import health as health_mod
+            from asyncrl_tpu.runtime import elastic as elastic_mod
+
+            monitor = self._obs.monitor
+            blame_fn = None
+            if monitor is not None:
+
+                def blame_fn():
+                    # Runs AFTER observe_window advanced the monitor's
+                    # close timestamp — pass the closed window's duration
+                    # or the span horizon collapses to the 1s clamp.
+                    stage, _ = monitor.bottleneck(
+                        elapsed=monitor.last_window_s
+                    )
+                    return health_mod.blame_component(stage)
+
+            self._elastic = elastic_mod.ElasticController(
+                min_actors=config.elastic_min_actors,
+                max_actors=(
+                    config.elastic_max_actors or 2 * config.actor_threads
+                ),
+                cooldown_windows=config.elastic_cooldown_windows,
+                up_stall_frac=config.elastic_up_stall_frac,
+                down_backpressure=config.elastic_down_backpressure,
+                down_admission=config.elastic_down_admission,
+                blame_fn=blame_fn,
+            )
+            self._elastic_barrier = elastic_mod.ReconfigureBarrier(self._ckpt)
         # §5.2b debug mode: transport invariants on drained fragments.
         from asyncrl_tpu.utils.debug import sync_debug_enabled
 
@@ -243,7 +325,12 @@ class SebulbaTrainer:
         # derived). Version 0 is the constructor-published initial params.
         self._published_updates: dict[int, int] = {0: 0}
         self._actor_restarts = 0
+        # Crash-storm window: CRASH-caused restarts only. Watchdog
+        # retirements keep their own window below, and deliberate elastic
+        # scale-downs enter NEITHER — a run must never abort for being
+        # scaled (or stall-churned) the way it aborts for crash-looping.
         self._recent_restarts: list[float] = []
+        self._recent_watchdog: list[float] = []
         self._RESTART_WINDOW_S = 300.0
         # Supervised inference-server restarts (same storm window; the
         # threshold is the actor rule at one instance: > 3 in the window).
@@ -376,6 +463,14 @@ class SebulbaTrainer:
             return env.lower() not in ("0", "false", "no")
         return self.config.serve
 
+    def _use_elastic(self) -> bool:
+        """Elastic runtime on? ``ASYNCRL_ELASTIC`` wins over
+        ``config.elastic`` when set — same precedence as ASYNCRL_SERVE."""
+        env = os.environ.get("ASYNCRL_ELASTIC", "")
+        if env:
+            return env.lower() not in ("0", "false", "no")
+        return self.config.elastic
+
     def _spawn_server(self) -> None:
         """(Re)build the shared inference server on a fresh personal stop
         event. Callers re-wire actors separately: existing clients of a
@@ -398,7 +493,11 @@ class SebulbaTrainer:
             self._server = ServeCore(
                 self._inference_fn,
                 store=self._store,
-                num_clients=cfg.actor_threads,
+                # The LIVE fleet size, not the configured one: a
+                # supervised rebuild after an elastic scale-up must cover
+                # every live client slot (fresh construction sees an
+                # empty fleet and falls back to the config).
+                num_clients=max(cfg.actor_threads, len(self._actors)),
                 stop_event=self._server_stop,
                 mode=mode,
                 seed=seed,
@@ -450,11 +549,13 @@ class SebulbaTrainer:
                     )
                     self.stop()
                     raise err
-                if gen != self._actor_gens[index]:
+                if index >= len(self._actors) or gen != self._actor_gens[index]:
                     # A thread the supervisor already retired (watchdog
-                    # abandonment racing the thread's own death report):
-                    # ONE failure must not restart the slot twice — the
-                    # second restart would orphan the live replacement.
+                    # abandonment racing the thread's own death report) or
+                    # a slot a deliberate scale-down removed (its gen was
+                    # bumped at retirement): ONE failure must not restart
+                    # the slot twice — the second restart would orphan the
+                    # live replacement (or resurrect a retired slot).
                     continue
                 self._restart_actor(index, err)
         except queue.Empty:
@@ -486,20 +587,40 @@ class SebulbaTrainer:
                 f"{self._RESTART_WINDOW_S}s)"
             ) from cause
 
-    def _restart_actor(self, index: int, err: BaseException | None) -> None:
+    def _restart_actor(
+        self, index: int, err: BaseException | None, reason: str = "crash"
+    ) -> None:
         """Retire actor ``index`` (already dead or abandoned) and spawn its
-        replacement, aborting on a restart storm."""
+        replacement, aborting on a restart storm. ``reason`` classifies
+        the retirement cause for the storm accounting: ``"crash"`` feeds
+        the crash-storm window, ``"watchdog"`` its own window — a
+        stall-churning fleet and a crash-looping one are different
+        failures and must not pool toward one abort threshold (and a
+        deliberate elastic scale-down goes through
+        :meth:`_scale_down_actor` instead, entering neither)."""
         # Forensics FIRST, replacement second: the dump captures every
         # thread's spans as they were when the failure was detected
         # (crash or watchdog retirement alike). No-op when unarmed.
         flightrec.record(
             "supervisor.actor_restart",
-            detail=f"actor {index} gen {self._actor_gens[index]}: {err!r}",
+            detail=(
+                f"actor {index} gen {self._actor_gens[index]} "
+                f"reason={reason}: {err!r}"
+            ),
         )
         self._actor_restarts += 1
+        stamps = (
+            self._recent_watchdog
+            if reason == "watchdog"
+            else self._recent_restarts
+        )
+        # The bar follows the LIVE fleet (3 per actor), not the configured
+        # actor_threads: an elastically grown fleet earns proportionally
+        # more tolerated restarts, a shrunken one keeps the tight bar a
+        # small fleet had before elasticity existed.
         self._storm_guard(
-            self._recent_restarts, 3 * self.config.actor_threads,
-            f"actor {index}", err,
+            stamps, 3 * max(1, len(self._actors)),
+            f"actor {index} ({reason})", err,
         )
         self._actor_gens[index] += 1
         self._backpressure_base += self._actors[index].backpressure
@@ -545,6 +666,7 @@ class SebulbaTrainer:
                     f"actor {index} made no progress for more than "
                     f"{timeout_s}s (heartbeat watchdog)"
                 ),
+                reason="watchdog",
             )
 
     def _supervise_server(self) -> None:
@@ -613,6 +735,150 @@ class SebulbaTrainer:
         refreshed = time.monotonic()
         for actor in self._actors:
             actor.heartbeat = refreshed
+
+    # -------------------------------------------------------------- elastic
+
+    def _scale_up_actor(self) -> None:
+        """Grow the fleet by one slot (window-close thread). The serve
+        core's client slot registers FIRST (``client(index)`` must not
+        bounds-fail), the thread spawns LAST — mutate-last, so a failing
+        env-pool build observed by the reconfigure barrier leaves the
+        fleet exactly as it was."""
+        index = len(self._actors)
+        while len(self._actor_gens) <= index:
+            self._actor_gens.append(0)
+        if self._server is not None:
+            self._server.ensure_client(index)
+        try:
+            self._actors.append(self._spawn_actor(index))
+        # lint: broad-except-ok(not a swallow: cleanup-and-reraise — the serve-client registration unwinds and the original failure propagates to the reconfigure barrier)
+        except BaseException:
+            # _spawn_actor registers the serve-client slot (client(index))
+            # BEFORE the thread exists; if the build fails after that
+            # point, a ghost registration would hold every future
+            # dispatch's slab-full target one client high — each batch
+            # waiting out its full deadline on a client that can never
+            # submit. remove_client is idempotent, so this is safe even
+            # when the failure preceded the registration.
+            if self._server is not None:
+                self._server.remove_client(index)
+            raise
+
+    def _scale_down_actor(self) -> None:
+        """Retire the highest slot (window-close thread) through the
+        existing per-thread retirement path — the abandon event, the join
+        window, the lease void — so shrink is drain-clean by the same
+        argument as a watchdog retirement: the thread can only exit, and
+        its voided OPEN lease raises ``StaleLeaseError`` on any late
+        write. Fragments it already committed and queued keep valid
+        leases and drain into the learner normally — real on-policy data
+        is consumed, not discarded (the "zero dropped leases" chaos
+        assertion counts on exactly this). The slot's
+        generation bumps so a zombie's late error report (and a future
+        regrow of the same index) can never be confused with the retired
+        stream. Deliberate: enters NO storm window."""
+        index = len(self._actors) - 1
+        actor = self._actors[index]
+        actor.abandon.set()
+        actor.join(timeout=5.0)
+        if actor.is_alive():
+            print(
+                f"asyncrl_tpu: scaled-down actor {index} did not join "
+                "within 5s; abandoning thread (it exits at its next "
+                "abandon-event check)",
+                file=sys.stderr,
+            )
+        self._actors.pop()
+        self._actor_gens[index] += 1
+        self._backpressure_base += actor.backpressure
+        if self._staging is not None:
+            lease = actor._open_lease
+            if lease is not None:
+                self._staging.void(lease)
+        if self._server is not None:
+            # AFTER the join: the actor can no longer submit, so removing
+            # its registration cannot strand a pending request — and the
+            # removal wakes the batch-fill wait so the slab-full condition
+            # re-targets the shrunken client set.
+            self._server.remove_client(index)
+
+    def _build_staging_ring(self, actor_count: int):
+        """Allocate — NOT install — a staging ring sized for
+        ``actor_count`` (auto sizing only; an explicit ``staging_slabs``
+        is an operator's fixed choice). None = no resize needed. The
+        fallible slab allocation lives here so the reconfigure closure
+        can run it BEFORE any fleet mutation; installing is the separate
+        ``self._staging.swap`` (the RingSwapHolder generation protocol,
+        rollout/staging.py: in-flight leases finish on the old ring)."""
+        if self._staging_template is None or self.config.staging_slabs:
+            return None
+        from asyncrl_tpu.rollout import staging
+
+        depth = staging.auto_num_slabs(
+            self._queue.maxsize, actor_count, self._staging_rows
+        )
+        if depth == self._staging.num_slabs:
+            return None
+        return staging.StagingRing(
+            self._staging_template,
+            rows_per_slab=self._staging_rows,
+            num_slabs=depth,
+        )
+
+    def _elastic_step(self, window: dict[str, Any]) -> None:
+        """One controller evaluation at window close (window-close thread,
+        next to the health monitor). A decision executes inside the
+        save → reconfigure → restore barrier and is recorded as a
+        structured event everywhere a crash would be: flight recorder,
+        registry counters, time-series annotation."""
+        decision = self._elastic.decide(window, len(self._actors))
+        if decision is None:
+            return
+        before = len(self._actors)
+        flightrec.record(
+            f"elastic.scale_{decision.direction}",
+            detail=f"{decision.reason}: {decision.detail} "
+            f"(fleet {before} {decision.delta:+d})",
+        )
+
+        def reconfigure():
+            # Exactly ONE slot per decision (the controller's delta
+            # contract: delta is always ±1) — and mutate-last across the
+            # COMPOSED action: the ring resize's fallible slab allocation
+            # runs before the fleet changes, and the swap installs it
+            # only after the slot operation succeeded. A failure anywhere
+            # leaves both the fleet and the data path on the pre-scale
+            # shape the barrier's restore message describes; an unused
+            # pre-built ring is just garbage-collected.
+            new_ring = self._build_staging_ring(before + decision.delta)
+            if decision.delta > 0:
+                self._scale_up_actor()
+            else:
+                self._scale_down_actor()
+            if new_ring is not None:
+                self._staging.swap(new_ring)
+
+        with trace.span(span_names.ELASTIC_RECONFIGURE):
+            self.state, self.env_steps, ok = self._elastic_barrier.run(
+                self.state, self.env_steps, reconfigure
+            )
+        if not ok:
+            # A rolled-back scale is NOT a scale: only
+            # elastic_reconfigure_failed records the attempt, so the
+            # scale counters/annotations never report a fleet change
+            # that did not happen.
+            obs_registry.counter("elastic_reconfigure_failed").inc()
+            flightrec.record(
+                "elastic.reconfigure_failed",
+                detail=f"restored checkpoint barrier; fleet stays at "
+                f"{len(self._actors)}",
+            )
+            return
+        obs_registry.counter(f"elastic_scale_{decision.direction}").inc()
+        if self._obs.store is not None:
+            self._obs.store.annotate(
+                decision.event(before, len(self._actors))
+            )
 
     def _infer_coalesce_window(self) -> dict[str, float]:
         """Mean coalesced inference-batch rows per served round since the
@@ -731,7 +997,10 @@ class SebulbaTrainer:
         fragments: list[Fragment] = []
         # Staging mode: fragments grouped by slab until a slab has all K
         # rows in hand (completion order, like the legacy arrival order).
-        slab_groups: dict[int, list[Fragment]] = {}
+        # Keyed by (minting ring, slab): under an elastic ring swap the
+        # old ring's in-flight fragments and the new ring's never share a
+        # group — a batch is one ring's slab, always.
+        slab_groups: dict[tuple[Any, int], list[Fragment]] = {}
         ring = self._staging
         try:
             while self.env_steps < target:
@@ -754,7 +1023,9 @@ class SebulbaTrainer:
                         # now belongs to the replacement. (The checker
                         # above already advanced the old stream.)
                         continue
-                    group = slab_groups.setdefault(lease.slab, [])
+                    batch_ring = lease.ring
+                    group_key = (batch_ring, lease.slab)
+                    group = slab_groups.setdefault(group_key, [])
                     group.append(fragment)
                     if len(group) >= K:
                         # Re-validate at the boundary: a lease can go
@@ -765,11 +1036,11 @@ class SebulbaTrainer:
                     if len(group) < K:
                         continue
                     batch = sorted(
-                        slab_groups.pop(lease.slab),
+                        slab_groups.pop(group_key),
                         key=lambda f: f.lease.row,
                     )
                     slab_id = lease.slab
-                    rollout = ring.batch(slab_id)
+                    rollout = batch_ring.batch(slab_id)
                 else:
                     fragments.append(fragment)
                     if len(fragments) < K:
@@ -779,6 +1050,7 @@ class SebulbaTrainer:
                         continue
                     batch, fragments = fragments, []
                     slab_id = None
+                    batch_ring = None
                     rollout = _stack_fragments([f.rollout for f in batch])
                 if cfg.reward_scale != 1.0 or cfg.step_cost != 0.0:
                     # Learner's reward view (living cost, then scale). Host
@@ -819,8 +1091,8 @@ class SebulbaTrainer:
                 # Slab batches are constant-sized (precomputed); only the
                 # legacy stack path needs the per-update leaf walk.
                 h2d_bytes += (
-                    ring.slab_nbytes
-                    if ring is not None
+                    batch_ring.slab_nbytes
+                    if batch_ring is not None
                     else int(
                         sum(leaf.nbytes for leaf in jax.tree.leaves(rollout))
                     )
@@ -828,11 +1100,13 @@ class SebulbaTrainer:
                 self.state, metrics = self.learner.update(
                     self.state, rollout_d
                 )
-                if ring is not None:
+                if batch_ring is not None:
                     # The slab frees only once this update's OUTPUT is
                     # ready — the gate that makes reuse safe even where
                     # the device buffer aliases host memory (CPU client).
-                    ring.retire(slab_id, self.state.update_step)
+                    # Retired on the MINTING ring: after an elastic ring
+                    # swap an old-ring slab must free on the old ring.
+                    batch_ring.retire(slab_id, self.state.update_step)
                 self.env_steps += steps_per_fragment * K
                 window_steps += steps_per_fragment * K
                 pending.append(metrics)
@@ -952,6 +1226,22 @@ class SebulbaTrainer:
                         self._ckpt.maybe_save_best(
                             self.state, self.env_steps, agg["eval_return"]
                         )
+                    # Fleet-shape gauges (registry → window snapshot →
+                    # /metrics + timeseries), exported EVEN when
+                    # elasticity is off: without them a retired-and-not-
+                    # replaced actor is indistinguishable from a quiet
+                    # one in the recorded history (the obs-doctor gap).
+                    obs_registry.gauge("actors_live").set(
+                        float(sum(a.is_alive() for a in self._actors))
+                    )
+                    obs_registry.gauge("servers_live").set(
+                        1.0
+                        if self._server is not None and self._server.is_alive()
+                        else 0.0
+                    )
+                    obs_registry.gauge("staging_slabs_live").set(
+                        float(ring.num_slabs) if ring is not None else 0.0
+                    )
                     # ONE shared window snapshot (obs/__init__.py): the
                     # registry/trace drain merges in here, the health
                     # detectors run, and the time-series store records —
@@ -960,6 +1250,11 @@ class SebulbaTrainer:
                     # on what the window contained. Placed after the
                     # eval so eval_return feeds the regression detector.
                     self._obs.observe_window(agg)
+                    # Elastic runtime: the controller reads the SAME
+                    # merged window the sinks saw; a decision reconfigures
+                    # the fleet here, between updates, on this thread.
+                    if self._elastic is not None:
+                        self._elastic_step(agg)
                     history.append(agg)
                     if callback:
                         callback(agg)

@@ -97,6 +97,17 @@ _BLAME = {
 }
 
 
+def blame_component(stage: str | None) -> str | None:
+    """The /healthz component a dominant wait span indicts (the public
+    view of the blame table): what the ``learner_stall`` detector reports
+    and what the elastic controller refines its scale-up verdict with —
+    a stall the spans blame on H2D or the serve core is not fixed by
+    growing the actor fleet."""
+    if stage is None:
+        return None
+    return _BLAME.get(stage)
+
+
 @dataclasses.dataclass(frozen=True)
 class Thresholds:
     """Detector thresholds — one frozen bundle so the live monitor and the
@@ -448,6 +459,11 @@ class HealthMonitor:
         self.mem_baseline: float | None = None
         self._prev: dict[str, Any] | None = None
         self._prev_t = 0.0
+        # Duration of the last CLOSED window — the span horizon for
+        # post-close bottleneck() callers (the elastic blame veto runs
+        # right after on_window has advanced _prev_t to now, so
+        # time.time() - _prev_t would clamp to ~1s there).
+        self.last_window_s = 60.0
         # lint: thread-shared-ok(GIL-atomic int; single-writer window counter, verdict() readers see the latest or previous window — both coherent)
         self.window_idx = 0
         # lint: thread-shared-ok(deque appends are GIL-atomic and verdict() iterates a list() copy; events are frozen after construction)
@@ -465,14 +481,25 @@ class HealthMonitor:
             prev = 0.0
         return float(now) - float(prev)
 
-    def bottleneck(self) -> tuple[str | None, str | None]:
+    def bottleneck(
+        self, elapsed: float | None = None
+    ) -> tuple[str | None, str | None]:
         """(dominant wait-span name, causal reading) over roughly the last
         window's spans, from the armed tracer's rings — (None, None) when
         tracing is off or nothing waited. Computed only when a detector is
-        about to fire, never per window."""
+        about to fire, never per window. The default horizon (time since
+        the last window close) is right for detectors firing DURING
+        ``on_window``; a caller running after the close (the elastic
+        blame veto) must pass ``elapsed=monitor.last_window_s`` or the
+        horizon collapses to the 1s clamp."""
         if self.tracer is None:
             return None, None
-        elapsed = max(1.0, time.time() - self._prev_t) if self._prev_t else 60.0
+        if elapsed is None:
+            elapsed = (
+                max(1.0, time.time() - self._prev_t)
+                if self._prev_t
+                else 60.0
+            )
         cutoff = time.perf_counter() - elapsed
         totals: dict[str, float] = {}
         for snap in self.tracer.snapshots():
@@ -540,6 +567,8 @@ class HealthMonitor:
                         extra={"health_event": event.to_dict()},
                     )
         self._prev = sample
+        if self._prev_t:
+            self.last_window_s = max(1.0, now - self._prev_t)
         self._prev_t = now
         return events
 

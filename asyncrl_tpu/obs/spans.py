@@ -34,6 +34,12 @@ SERVE_BATCH_FILL = "serve.batch_fill"    # scheduler holding a partial batch ope
 SERVE_DISPATCH = "serve.dispatch"        # coalesce + batched device call
 SERVE_SWAP_DRAIN = "serve.swap_drain"    # waiting for old-generation batches
 
+# Elastic runtime (asyncrl_tpu/runtime/elastic.py): the save → reconfigure
+# → restore barrier around a fleet-scale action. Runs on the learner
+# (window-close) thread; a COMPUTE span — its cost is the price of a scale
+# event, not a wait on another stage.
+ELASTIC_RECONFIGURE = "elastic.reconfigure"
+
 # Learner drain (api/sebulba_trainer.py train loop + learn/rollout_learner.py).
 LEARNER_QUEUE_WAIT = "learner.queue_wait"    # fragment queue empty (starved)
 LEARNER_H2D = "learner.h2d"                  # device_put dispatch

@@ -489,8 +489,11 @@ class ActorThread(threading.Thread):
         self.device = device
         # Per-thread retirement signal: the watchdog abandons a HUNG thread
         # through this (the cohort stop event would take every healthy
-        # sibling down with it). An abandoned thread exits at its next
-        # check and its late error/fragment output is discarded.
+        # sibling down with it), and a deliberate elastic scale-down
+        # (runtime/elastic.py) retires the highest slot through the SAME
+        # event — one drain-clean exit path, two callers. An abandoned
+        # thread exits at its next check and its late error/fragment
+        # output is discarded.
         self.abandon = threading.Event()
         # Progress stamp for the trainer's heartbeat watchdog: refreshed
         # every iteration of the production loop (including the bounded-
@@ -506,6 +509,9 @@ class ActorThread(threading.Thread):
         # copy-on-emit path. The actor leases one slab row per fragment
         # and writes transitions straight into it; ``_open_lease`` is the
         # not-yet-queued lease the supervisor voids if this thread dies.
+        # Under the elastic runtime this is a RingSwapHolder, not a bare
+        # StagingRing — same acquire contract, but a mid-wait ring swap
+        # wakes the acquire and retries on the new ring.
         self.staging = staging
         # lint: thread-shared-ok(supervisor reads it only after this thread is dead or abandoned; StagingRing.void re-checks generations under its lock)
         self._open_lease = None

@@ -36,6 +36,13 @@ restarted actor therefore can never scribble on a slab row it no longer
 owns (modulo the single-store race inherent to abandoning a live thread,
 which the watchdog design already accepts; the guard shrinks the window
 from a whole fragment to one array store).
+
+Ring resize (elastic runtime)
+-----------------------------
+:class:`RingSwapHolder` makes the ring itself replaceable at runtime: a
+fleet-scale event installs a fresh ring sized for the new fleet while
+in-flight leases finish on the old one (every lease pins its minting ring
+via ``lease.ring``). See the class docstring for the swap protocol.
 """
 
 from __future__ import annotations
@@ -364,6 +371,25 @@ class StagingRing:
         slab.committed = [False] * self._K
         self._cond.notify_all()
 
+    def busy(self) -> bool:
+        """Any open lease or committed-but-undrained row? The elastic
+        :class:`RingSwapHolder`'s safe-to-reset test for a retired ring:
+        ``False`` means resetting cannot invalidate a lease an actor or
+        the drain still holds. Only ``"filling"`` slabs can carry such
+        state — ``"inflight"`` slabs were fully consumed (batched +
+        retired) and ``"free"`` ones hold nothing. Conservative for
+        never-re-leased rows still carrying an old generation (K > 1
+        slabs); exact in the elastic configuration, which requires
+        ``updates_per_call=1`` (K=1)."""
+        with self._cond:
+            for slab in self._slabs:
+                if slab.phase != "filling":
+                    continue
+                for r in range(self._K):
+                    if slab.committed[r] or slab.row_gen[r] > 0:
+                        return True
+            return False
+
     def reset(self) -> None:
         """Invalidate every lease and free every slab (trainer ``stop()``:
         actors are joined/abandoned, queued fragments discarded — any
@@ -374,6 +400,148 @@ class StagingRing:
             self._inflight.clear()
             for i in range(len(self._slabs)):
                 self._release_locked(i)
+
+
+class RingSwapHolder:
+    """A swappable staging-ring façade for the elastic runtime.
+
+    Actors acquire through the holder; every :class:`SlabLease` carries a
+    hard reference to the :class:`StagingRing` it was minted from
+    (``lease.ring``), so an in-flight lease keeps committing — and the
+    drain keeps batching/retiring — on the OLD ring while new acquires
+    land on the new one. This is the ParamSlots generation trick
+    (serve/params.py) applied to whole rings: a resize installs ring g+1
+    concurrently while ring g's leases finish; no lease is ever dropped
+    and no batch ever mixes rows from two rings (the drain keys slab
+    groups by ring identity).
+
+    :meth:`swap` also *interrupts* acquires blocked on the outgoing ring:
+    the holder threads a swapped-out predicate into ``StagingRing.acquire``'s
+    stop hook, so a back-pressured actor wakes and retries on the new ring
+    instead of leasing a row no drain will ever complete.
+
+    Retired rings are swept on every swap: a ring that has fully drained
+    (``StagingRing.busy()`` false — no open lease, no committed row the
+    drain still owes) is reset, turning any stale lease object still
+    referencing it into :class:`StaleLeaseError` on every write path; a
+    ring that is NOT drained (an actor mid-write across the swap, a
+    fragment still queued) is retained untouched — a live lease is never
+    invalidated by a deliberate scale, no matter how closely two scale
+    events follow each other. Retention is bounded at
+    ``MAX_RETIRED_RINGS``: beyond it the oldest ring is force-reset — its
+    straggler (a thread wedged across that many scale windows, which the
+    heartbeat watchdog would have retired anyway) is fenced to
+    ``StaleLeaseError`` and the supervisor treats the fallout as a crash,
+    the pre-elastic semantics.
+    """
+
+    # Slabs are large (whole [K, T, B, ...] rollouts); a handful of
+    # retained retired rings is memory-bounded churn, unbounded retention
+    # is a leak.
+    MAX_RETIRED_RINGS = 4
+
+    def __init__(self, ring: StagingRing):
+        self._lock = threading.Lock()
+        self._ring = ring  # guarded-by: _lock
+        self._retired: list[StagingRing] = []  # guarded-by: _lock
+        self._reuse_base = 0  # guarded-by: _lock
+
+    # ------------------------------------------------------------- facade
+
+    def current(self) -> StagingRing:
+        with self._lock:
+            return self._ring
+
+    @property
+    def rows_per_slab(self) -> int:
+        return self.current().rows_per_slab
+
+    @property
+    def num_slabs(self) -> int:
+        return self.current().num_slabs
+
+    @property
+    def slab_nbytes(self) -> int:
+        # Slab GEOMETRY ([K, T, B, ...]) is invariant across swaps — only
+        # the slab count changes — so the current ring's nbytes is exact
+        # for old-ring batches too.
+        return self.current().slab_nbytes
+
+    @property
+    def reuse_waits(self) -> int:
+        with self._lock:
+            return self._reuse_base + self._ring.reuse_waits
+
+    # ------------------------------------------------------------- actors
+
+    def acquire(
+        self,
+        stop: Callable[[], bool] | None = None,
+        on_wait: Callable[[], None] | None = None,
+    ) -> SlabLease | None:
+        """Lease a row from the CURRENT ring; a swap arriving mid-wait
+        wakes the acquire and retries on the new ring. Same contract as
+        ``StagingRing.acquire`` (None = stopped/abandoned)."""
+        while True:
+            ring = self.current()
+
+            def stop_or_swapped(ring=ring):
+                if stop is not None and stop():
+                    return True
+                # Deliberately UNLOCKED read (GIL-atomic attribute load):
+                # this predicate runs inside StagingRing.acquire UNDER
+                # ring._cond, and taking the holder lock here would invert
+                # swap()'s holder->ring nesting (its busy() sweep) into an
+                # ABBA deadlock between an actor and the window-close
+                # thread. A stale read only costs one extra 50ms wait tick.
+                # lint: unguarded-ok(GIL-atomic reference read; locking here would invert the holder->ring nesting into an ABBA deadlock; staleness bounded by the acquire wait timeout)
+                return self._ring is not ring
+
+            lease = ring.acquire(stop=stop_or_swapped, on_wait=on_wait)
+            if lease is not None:
+                return lease
+            if stop is not None and stop():
+                return None
+            # Swapped out from under the wait: retry on the new ring.
+
+    def void(self, lease: SlabLease) -> None:
+        """Supervisor path: void on whatever ring minted the lease."""
+        lease.ring.void(lease)
+
+    # ------------------------------------------------------------ control
+
+    def swap(self, new_ring: StagingRing) -> None:
+        """Install ``new_ring`` for all future acquires. The outgoing ring
+        keeps serving its in-flight leases; previously retired rings are
+        swept — drained ones reset (fencing stale lease objects), busy
+        ones retained (a live lease is never invalidated), the oldest
+        force-reset beyond ``MAX_RETIRED_RINGS`` (see class docstring)."""
+        with self._lock:
+            self._retired.append(self._ring)
+            self._reuse_base += self._ring.reuse_waits
+            self._ring = new_ring
+            # busy() takes the ring lock nested inside the holder lock:
+            # holder->ring is the one permitted nesting order, which is
+            # why acquire's swapped-out predicate (which runs under the
+            # ring lock) reads the holder WITHOUT its lock.
+            drained, keep = [], []
+            for ring in self._retired:
+                (keep if ring.busy() else drained).append(ring)
+            while len(keep) > self.MAX_RETIRED_RINGS:
+                drained.append(keep.pop(0))
+            self._retired = keep
+        for ring in drained:
+            ring.reset()
+
+    def reset(self) -> None:
+        """Trainer ``stop()``: every lease on every live ring goes stale
+        and every slab frees (the ``StagingRing.reset`` contract, applied
+        to the current AND every retained retired ring)."""
+        with self._lock:
+            rings = [*self._retired, self._ring]
+            self._retired = []
+        for ring in rings:
+            ring.reset()
 
 
 def auto_num_slabs(queue_capacity: int, actor_threads: int, rows: int) -> int:

@@ -1,0 +1,16 @@
+"""``asyncrl_tpu.runtime``: runtime reconfiguration of a live training
+fleet.
+
+The supervision stack (PR 2) *reacts* — it rebuilds what crashed. This
+package *decides*: :mod:`asyncrl_tpu.runtime.elastic` turns the same
+retirement/rebuild machinery into deliberate elasticity — signal-driven
+fleet scaling with checkpoint-consistent reconfiguration (ROADMAP item 5).
+"""
+
+from asyncrl_tpu.runtime.elastic import (
+    ElasticController,
+    ReconfigureBarrier,
+    ScaleDecision,
+)
+
+__all__ = ["ElasticController", "ReconfigureBarrier", "ScaleDecision"]

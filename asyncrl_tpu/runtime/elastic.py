@@ -1,0 +1,397 @@
+"""Elastic runtime: signal-driven fleet scaling for the async host path.
+
+The supervisor (api/sebulba_trainer.py) already retires and rebuilds
+crashed/hung actors and servers — but the fleet SHAPE was frozen at
+construction, so the only answer to "the actors are the bottleneck" was a
+restart of the whole run. This module generalizes supervised *recovery*
+into deliberate *elasticity* (ROADMAP item 5): grow/shrink the actor fleet
+at runtime from the signals the obs stack already exports, with
+checkpoint-consistent reconfiguration. Laminar (arXiv:2510.12633)
+decouples per-replica lifecycles for exactly this reason; IMPACT
+(arXiv:1912.00167) motivates keeping the learner fed when actor
+throughput swings.
+
+Three pieces, one per concern:
+
+- :class:`ElasticController` — the POLICY. Evaluated once per metrics
+  window on the trainer's window-close thread (next to the
+  ``HealthMonitor``; no thread of its own), it consumes signals that
+  already exist — ``learner_stall_frac`` (+ the WAIT_SPANS blame when
+  tracing is armed), ``queue_backpressure`` deltas, the serve gate's
+  overload/shed counters, ``staleness_p95`` — behind hysteresis windows,
+  a post-action cooldown, and hard min/max fleet bounds. Scripted scale
+  requests from the chaos layer (``utils/faults.py`` ``scale`` kind)
+  bypass hysteresis and cooldown but never the bounds, and at most ONE
+  action is returned per window (extra scripted requests queue for the
+  next windows — the rule that keeps ring swaps a full window apart).
+- :class:`ReconfigureBarrier` — the SAFETY. A scale action that touches
+  shared data-path state (the staging-ring swap, a learner-facing
+  reshape) runs inside a save → reconfigure → restore barrier built on
+  ``Checkpointer``'s fallback-restore: the learner state is made durable
+  before the action, and a failed action restores it (falling back
+  through older retained steps if the newest save is damaged) so the run
+  continues on the pre-scale fleet instead of dying mid-reconfigure.
+- The MECHANISM lives where the fleet lives: ``SebulbaTrainer`` owns the
+  slot-addressed grow/shrink executors (reusing the per-thread
+  stop-event + lease-void retirement path, so shrink is provably
+  drain-clean) and ``rollout.staging.RingSwapHolder`` owns the
+  generation-stamped ring swap.
+
+Every decision is a structured event: a flight-recorder entry
+(``elastic.scale_up`` / ``elastic.scale_down``), the
+``elastic_scale_up``/``elastic_scale_down`` registry counters, and a
+``kind=event`` annotation in the time-series store — next to the
+``actors_live``/``servers_live``/``staging_slabs_live`` gauges the
+trainer exports every window regardless of whether elasticity is armed.
+A deliberate scale event is stamped distinctly from a crash: it never
+enters the supervisor's restart-storm windows, so a run can never abort
+for scaling on purpose.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable
+
+from asyncrl_tpu.utils import faults
+
+# Controller defaults (constructor-overridable; deliberately NOT config
+# fields — the four public knobs are the bounds and cadence, the signal
+# thresholds are policy internals the tests pin):
+# scale UP actors when the learner starved at least this fraction of a
+# window (and the span blame, when available, points at the actors) —
+# 1.0 disables the organic up signal (the stall fraction caps at exactly
+# 1.0, never exceeding it) …
+UP_STALL_FRAC = 0.5
+# … for this many CONSECUTIVE windows (hysteresis: one noisy window is
+# not a trend).
+HYSTERESIS_WINDOWS = 2
+# scale DOWN actors when the fragment queue's backpressure counter grew
+# by at least this much in a window (actors out-ran the learner; 0
+# disables) …
+DOWN_BACKPRESSURE = 1.0
+# … or the serve gate's overload+shed counters grew by at least this much
+# (actors out-ran the server; 0 disables — every organic signal has a
+# disable knob so identity A/B runs can pin the controller armed-but-
+# quiet).
+DOWN_ADMISSION = 1.0
+# Cap on queued scripted requests the controller carries across windows
+# (one applies per window; a degenerate no-max script must not grow the
+# queue without bound — extras drop, FIFO prefix preserved).
+MAX_PENDING_SCRIPTED = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleDecision:
+    """One controller verdict: scale the actor fleet by ``delta`` slots.
+
+    ``scripted`` marks chaos-driven events (``faults`` ``scale`` kind) —
+    applied without hysteresis/cooldown but inside the bounds, and stamped
+    as such in the structured event so a forensic reader can tell a test's
+    script from the controller's own judgement."""
+
+    direction: str  # "up" | "down"
+    delta: int      # signed fleet-size change: always exactly +1 or -1
+    #                 (bound-clamped; multi-slot scripted requests apply
+    #                 one slot per window, re-queueing the remainder — a
+    #                 single mutate-last slot op is what the reconfigure
+    #                 barrier's restore contract covers exactly)
+    reason: str     # "stall" | "backpressure" | "admission" | "staleness" | "scripted"
+    detail: str
+    scripted: bool = False
+    signals: dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def event(self, before: int, after: int) -> dict[str, Any]:
+        """The ``kind=event`` time-series annotation for this decision
+        (the elastic twin of a HealthEvent dict)."""
+        return {
+            "event_type": "elastic_scale",
+            "action": f"scale_{self.direction}",
+            "reason": self.reason,
+            "detail": self.detail,
+            "scripted": self.scripted,
+            "actors_before": before,
+            "actors_after": after,
+            "signals": dict(self.signals),
+            "t": time.time(),
+        }
+
+
+class ElasticController:
+    """The per-window scale policy (see module docstring).
+
+    Window-close-thread only (the trainer's drain thread): no internal
+    locking, matching ``HealthMonitor``. ``blame_fn`` is an optional
+    ``() -> str | None`` returning the component the dominant wait span
+    indicts (``obs.health.blame_component`` over ``monitor.bottleneck``)
+    — when it names anything other than the actors, a high stall fraction
+    does NOT trigger a scale-up (growing the fleet cannot fix an H2D- or
+    serve-bound stall).
+    """
+
+    def __init__(
+        self,
+        min_actors: int,
+        max_actors: int,
+        cooldown_windows: int = 2,
+        hysteresis: int = HYSTERESIS_WINDOWS,
+        up_stall_frac: float = UP_STALL_FRAC,
+        down_backpressure: float = DOWN_BACKPRESSURE,
+        down_admission: float = DOWN_ADMISSION,
+        down_staleness_p95: float = 0.0,
+        blame_fn: Callable[[], str | None] | None = None,
+    ):
+        if min_actors < 1:
+            raise ValueError(f"elastic_min_actors must be >= 1: {min_actors}")
+        if max_actors < min_actors:
+            raise ValueError(
+                f"elastic_max_actors {max_actors} < elastic_min_actors "
+                f"{min_actors}"
+            )
+        if cooldown_windows < 0:
+            raise ValueError(
+                f"elastic_cooldown_windows must be >= 0: {cooldown_windows}"
+            )
+        self.min_actors = min_actors
+        self.max_actors = max_actors
+        self.cooldown_windows = cooldown_windows
+        self.hysteresis = max(1, hysteresis)
+        self.up_stall_frac = up_stall_frac
+        self.down_backpressure = down_backpressure
+        self.down_admission = down_admission
+        self.down_staleness_p95 = down_staleness_p95
+        self.blame_fn = blame_fn
+        self._prev: dict[str, float] = {}
+        self._up_run = 0
+        self._down_run = 0
+        self._cooldown = 0
+        self._pending_scripted: deque[int] = deque()
+
+    # ---------------------------------------------------------- internals
+
+    def _delta(self, window: dict[str, Any], key: str) -> float:
+        """This window's increase of a cumulative counter key (the
+        HealthMonitor.delta convention)."""
+        now = window.get(key, 0.0)
+        if not isinstance(now, (int, float)) or isinstance(now, bool):
+            now = 0.0
+        return float(now) - self._prev.get(key, 0.0)
+
+    def _clamp(self, live: int, delta: int) -> int:
+        return max(self.min_actors, min(self.max_actors, live + delta)) - live
+
+    # ------------------------------------------------------------- decide
+
+    def decide(self, window: dict[str, Any], live: int) -> ScaleDecision | None:
+        """At most one scale decision for this window (or None).
+
+        Scripted requests (the chaos layer's ``scale`` kind) are drained
+        FIFO, one per window, bypassing hysteresis and cooldown but
+        clamped to the bounds; a request the bounds fully absorb is
+        dropped (never retried — the script asked for a state the
+        operator forbade)."""
+        for delta in faults.drain_scale_requests():
+            if len(self._pending_scripted) < MAX_PENDING_SCRIPTED:
+                self._pending_scripted.append(delta)
+
+        # Signal bookkeeping runs EVERY window (scripted or not), so the
+        # cumulative-counter deltas never span multiple windows.
+        bp_delta = self._delta(window, "queue_backpressure")
+        admit_delta = self._delta(window, "server_overload") + self._delta(
+            window, "serve_shed"
+        )
+        self._prev = {
+            key: float(window[key])
+            for key in ("queue_backpressure", "server_overload", "serve_shed")
+            if isinstance(window.get(key), (int, float))
+            and not isinstance(window.get(key), bool)
+        }
+
+        if self._pending_scripted:
+            request = self._pending_scripted.popleft()
+            delta = self._clamp(live, request)
+            if delta != 0:
+                # ONE slot per window, like every organic decision: the
+                # reconfigure barrier's restore contract ("continues on
+                # the pre-scale fleet") is only exact for a single
+                # mutate-last slot operation. A multi-slot script
+                # re-queues its remainder at the FRONT and applies it
+                # over the following windows.
+                step = 1 if delta > 0 else -1
+                remainder = request - step
+                if remainder != 0 and (remainder > 0) == (request > 0):
+                    self._pending_scripted.appendleft(remainder)
+                direction = "up" if step > 0 else "down"
+                # A scripted fleet change invalidates any organic trend
+                # measured over the old shape and needs the same
+                # re-equilibration an organic action gets: reset both
+                # trends and arm the cooldown (scripted requests
+                # themselves bypass it, so a queued script still drains
+                # one slot per window).
+                self._up_run = self._down_run = 0
+                self._cooldown = self.cooldown_windows
+                return ScaleDecision(
+                    direction=direction,
+                    delta=step,
+                    reason="scripted",
+                    detail=f"scripted scale event ({step:+d} actor slots)",
+                    scripted=True,
+                )
+            # A request the bounds fully absorbed is dropped (never
+            # retried — the script asked for a state the operator
+            # forbade); the window still gets its organic evaluation
+            # below, so a scripted no-op can never freeze the hysteresis
+            # trends or stretch the cooldown.
+
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+
+        stall = window.get("learner_stall_frac")
+        stall = float(stall) if isinstance(stall, (int, float)) else 0.0
+        up_signal = stall > self.up_stall_frac
+        if up_signal and self.blame_fn is not None:
+            blamed = self.blame_fn()
+            if blamed is not None and blamed != "actors":
+                # The stall is real but growing the fleet cannot fix it
+                # (H2D-bound, serve-bound, ...): not an up signal.
+                up_signal = False
+
+        staleness = window.get("staleness_p95")
+        staleness = (
+            float(staleness) if isinstance(staleness, (int, float)) else 0.0
+        )
+        bp_hit = (
+            self.down_backpressure > 0 and bp_delta >= self.down_backpressure
+        )
+        admit_hit = (
+            self.down_admission > 0 and admit_delta >= self.down_admission
+        )
+        down_signal = (
+            bp_hit
+            or admit_hit
+            or (
+                self.down_staleness_p95 > 0
+                and staleness > self.down_staleness_p95
+            )
+        )
+
+        if up_signal and down_signal:
+            # Contradictory window (starved AND backpressured — e.g. a
+            # transient hiccup): trust neither, restart both trends.
+            self._up_run = self._down_run = 0
+            return None
+        self._up_run = self._up_run + 1 if up_signal else 0
+        self._down_run = self._down_run + 1 if down_signal else 0
+
+        if self._up_run >= self.hysteresis:
+            delta = self._clamp(live, 1)
+            self._up_run = 0
+            if delta <= 0:
+                return None  # already at max_actors
+            self._cooldown = self.cooldown_windows
+            return ScaleDecision(
+                direction="up",
+                delta=delta,
+                reason="stall",
+                detail=(
+                    f"learner starved {100.0 * stall:.0f}% of the window "
+                    f"for {self.hysteresis} consecutive windows"
+                ),
+                signals={"learner_stall_frac": stall},
+            )
+        if self._down_run >= self.hysteresis:
+            delta = self._clamp(live, -1)
+            self._down_run = 0
+            if delta >= 0:
+                return None  # already at min_actors
+            self._cooldown = self.cooldown_windows
+            # Blame only a signal that actually fired THIS window (a
+            # disabled signal's threshold must never be "met" at 0 >= 0).
+            reason = (
+                "backpressure"
+                if bp_hit
+                else ("admission" if admit_hit else "staleness")
+            )
+            return ScaleDecision(
+                direction="down",
+                delta=delta,
+                reason=reason,
+                detail=(
+                    f"actors out-ran the pipeline for {self.hysteresis} "
+                    f"consecutive windows (queue_backpressure {bp_delta:+.0f}"
+                    f"/window, admission pressure {admit_delta:+.0f}, "
+                    f"staleness_p95 {staleness:.0f})"
+                ),
+                signals={
+                    "queue_backpressure_delta": bp_delta,
+                    "admission_delta": admit_delta,
+                    "staleness_p95": staleness,
+                },
+            )
+        return None
+
+
+class ReconfigureBarrier:
+    """The save → reconfigure → restore barrier for scale actions.
+
+    ``ckpt`` is the trainer's ``TrainerCheckpointing`` hook. With a
+    checkpointer configured, :meth:`run` makes the learner state durable
+    BEFORE the action (save + wait — the barrier is worthless if the save
+    is still in flight when the action fails), then runs the action; a
+    failing action restores the state through ``Checkpointer.restore``'s
+    fallback-through-older-steps path and reports the failure WITHOUT
+    killing the run — the fleet keeps training on the pre-scale shape.
+    Without a checkpointer there is nothing to restore from, so a failed
+    action propagates to the train loop's abort path (which snapshots and
+    flight-dumps like any other fatal).
+
+    Actions must be written mutate-last: do the fallible work (allocate
+    the new ring, spawn the thread) before installing anything, so a
+    failure observed here means the data path is still the old one.
+    """
+
+    def __init__(self, ckpt: Any):
+        self._ckpt = ckpt
+
+    def run(
+        self, state: Any, env_steps: int, action: Callable[[], None]
+    ) -> tuple[Any, int, bool]:
+        """Returns ``(state, env_steps, ok)`` — unchanged inputs on
+        success; the RESTORED state on a failed-but-recovered action
+        (``ok=False``). Raises only when the action failed AND no
+        checkpoint barrier existed (or the restore itself failed)."""
+        checkpointer = getattr(self._ckpt, "checkpointer", None)
+        if checkpointer is not None:
+            self._ckpt.save_now(state, env_steps)
+            checkpointer.wait()
+        try:
+            action()
+            return state, env_steps, True
+        # lint: broad-except-ok(barrier boundary: a failed deliberate scale restores the checkpointed state and the run continues on the old fleet; only an un-restorable failure propagates)
+        except Exception as action_err:
+            if checkpointer is None:
+                raise
+            try:
+                state, env_steps = checkpointer.restore(state)
+            # lint: broad-except-ok(not a swallow: a restore failure chains and re-raises the original action failure)
+            except Exception as restore_err:
+                raise RuntimeError(
+                    "elastic reconfigure failed AND the checkpoint barrier "
+                    f"could not restore ({type(restore_err).__name__}: "
+                    f"{restore_err}); original failure follows"
+                ) from action_err
+            import sys
+            import traceback
+
+            traceback.print_exc()
+            print(
+                "asyncrl_tpu: elastic reconfigure failed "
+                f"({type(action_err).__name__}: {action_err}); restored "
+                "the checkpoint barrier — continuing on the pre-scale "
+                "fleet (traceback above)",
+                file=sys.stderr,
+            )
+            return state, env_steps, False

@@ -37,6 +37,14 @@ returns, and the thread exposes the same supervisor surface (``heartbeat``,
 ``SebulbaTrainer`` swaps cores behind ``config.serve`` with no changes to
 actors, supervision, or metrics plumbing.
 
+**Elastic client registry** (asyncrl_tpu/runtime/elastic.py): the
+registered-client set is mutable at runtime — ``ensure_client`` grows the
+slot bound before a fleet scale-up spawns its actor, ``remove_client``
+deregisters a retired slot after its actor joined. The slab-full dispatch
+condition counts registered clients LIVE (per fill-wait iteration), so a
+shrinking fleet re-targets the batch instead of deadline-spinning on a
+client that no longer exists.
+
 Chaos: ``serve.dispatch`` fires on the serve thread per batch (an injected
 crash kills the core; the trainer's supervisor rebuilds it and actors
 re-wire — the actor fleet is never dropped); ``serve.swap`` fires on the
@@ -196,6 +204,28 @@ class ServeCore(threading.Thread):
         return self._slo
 
     # ------------------------------------------------------------- client
+
+    def ensure_client(self, index: int) -> None:
+        """Grow the client-slot bound to cover ``index`` (elastic runtime:
+        a fleet scale-up registers its new actor slot BEFORE spawning the
+        actor, so ``client(index)`` cannot bounds-fail)."""
+        if index < 0:
+            raise IndexError(f"client index {index} must be >= 0")
+        with self._cond:
+            if index >= self._n:
+                self._n = index + 1
+
+    def remove_client(self, index: int) -> None:
+        """Deregister a retired client slot (elastic runtime: called AFTER
+        the actor joined, so no request of its can still be pending). The
+        slab-full dispatch condition counts REGISTERED clients per policy,
+        so removal shrinks the fill target — and the notify wakes a
+        batch-fill wait that was holding a batch open for the departed
+        client, re-evaluating the target instead of spinning out its
+        deadline. Idempotent."""
+        with self._cond:
+            self._client_policy.pop(index, None)
+            self._cond.notify_all()
 
     def client(
         self,

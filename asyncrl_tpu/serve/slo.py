@@ -32,6 +32,9 @@ Counters (obs/registry.py, drained into every metrics window):
 ``server_overload`` — admissions that found the gate in breach;
 ``serve_shed`` — requests refused. Latency observations feed the
 ``serve_latency_ms`` histogram (p50/p95/p99/max exported per window).
+The elastic runtime (asyncrl_tpu/runtime/elastic.py) reads the two
+counters' per-window deltas as a scale-DOWN signal: actors overrunning
+the admission gate means fewer actors, not a bigger gate.
 
 Breach state also feeds the health detectors (obs/health.py) through two
 gauges maintained wherever the rolling window recomputes:

@@ -225,6 +225,47 @@ class Config:
     # slab_reuse_waits metric either way).
     staging_slabs: int = 0
 
+    # --- elastic runtime (asyncrl_tpu/runtime/elastic.py; host backends) ---
+    # Signal-driven fleet scaling: an ElasticController evaluated at each
+    # window close grows/shrinks the actor fleet (and resizes the staging
+    # ring through a checkpoint-consistent swap) from the signals the obs
+    # stack already exports — learner_stall_frac + span blame for
+    # scale-up, queue-backpressure/admission/staleness pressure for
+    # scale-down — behind hysteresis and a post-action cooldown. Off by
+    # default; ASYNCRL_ELASTIC (when set) wins over this flag, like
+    # ASYNCRL_SERVE. Requires updates_per_call=1 (the in-flight ring swap
+    # does not compose with fused multi-fragment slabs yet) and, when a
+    # shared server is on, the serve core (the legacy InferenceServer's
+    # client set is fixed-shape). elastic=False is bit-identical on
+    # losses and leaks zero elastic keys into the window snapshot
+    # (pinned by scripts/elastic_smoke.sh and tests/test_elastic.py).
+    elastic: bool = False
+    # Fleet bounds: the controller (and any scripted chaos scale event)
+    # never moves the live actor count outside [min, max].
+    elastic_min_actors: int = 1
+    # 0 = auto: 2x the configured actor_threads.
+    elastic_max_actors: int = 0
+    # Windows the controller stays quiet after each of its own scale
+    # actions (scripted chaos events bypass the cooldown; bounds always
+    # apply). Lets the pipeline re-equilibrate before the next verdict.
+    elastic_cooldown_windows: int = 2
+    # Scale-up trigger: learner_stall_frac must exceed this for the
+    # hysteresis run (and the span blame, when tracing is armed, must
+    # point at the actors). 1.0 disables the organic up signal — the
+    # stall fraction is capped at exactly 1.0 — leaving only scripted
+    # chaos events (how the smoke/tests pin deterministic fleets).
+    elastic_up_stall_frac: float = 0.5
+    # Scale-down trigger: the queue_backpressure counter must grow by at
+    # least this much in a window (actors out-ran the learner). 0
+    # disables the organic backpressure signal.
+    elastic_down_backpressure: float = 1.0
+    # Scale-down trigger #2: the serve admission gate's overload+shed
+    # counters must grow by at least this much in a window (actors
+    # out-ran the server). 0 disables — every organic signal has a
+    # disable knob so identity A/B runs can pin the controller
+    # armed-but-quiet (the elastic_smoke.sh discipline).
+    elastic_down_admission: float = 1.0
+
     # --- fault tolerance (host backends; utils/faults.py) ---
     # Heartbeat watchdog: an actor thread or the inference server whose
     # progress stamp is older than this many seconds is declared hung and

@@ -16,11 +16,15 @@ registry of *named fault sites* threaded through the hot paths —
 - ``checkpoint.restore``each Checkpointer restore attempt
 
 each able to inject a **crash** (raise ``InjectedFault``), a configurable
-**stall** (sleep, interruptible by the caller's stop predicate), or
+**stall** (sleep, interruptible by the caller's stop predicate),
 **payload corruption** (NaN-poison / bit-flip a value flowing through the
-site). Whether a given call fires is decided by a per-site
-``random.Random(seed)`` stream against ``prob`` — fully deterministic for
-a fixed call sequence, independent of wall clock and of other sites.
+site), or a scripted **scale** event (enqueue a fleet grow/shrink request
+the elastic runtime drains at the next window close — the chaos grammar
+driving deliberate elasticity instead of a death; see
+``asyncrl_tpu/runtime/elastic.py``). Whether a given call fires is decided
+by a per-site ``random.Random(seed)`` stream against ``prob`` — fully
+deterministic for a fixed call sequence, independent of wall clock and of
+other sites.
 
 Arming
 ------
@@ -30,8 +34,13 @@ Via config (``config.fault_spec``) or environment::
 
 e.g. ``actor.step:crash:1.0:0:max=1`` (crash the first actor step, then
 never again), ``pool.step:stall:0.05:7:stall_s=3`` (5% of pool steps stall
-3s), ``checkpoint.save:crash:1:0:max=2``. Options: ``max`` (cap on fires;
-default unlimited), ``stall_s`` (stall duration, default 1.0).
+3s), ``checkpoint.save:crash:1:0:max=2``,
+``actor.step:scale:1.0:0:delta=1,max=1`` (request one fleet grow at the
+first actor step). Options: ``max`` (cap on fires; default unlimited),
+``stall_s`` (stall duration, default 1.0), ``after`` (skip the site's
+first N calls before the probability stream starts drawing — stages
+multi-site chaos scripts), ``delta`` (scale kind only: signed fleet-size
+change per fire, default +1).
 
 Unarmed cost
 ------------
@@ -68,9 +77,41 @@ SITES = (
     "checkpoint.restore",
 )
 
-KINDS = ("crash", "stall", "corrupt")
+KINDS = ("crash", "stall", "corrupt", "scale")
 
 ENV_VAR = "ASYNCRL_FAULTS"
+
+# Scripted fleet-scale requests (the ``scale`` kind): sites enqueue signed
+# deltas here from whatever thread they fire on; the elastic runtime's
+# controller drains them on the trainer's window-close thread. Cleared on
+# every arm/disarm — a fresh agent must never apply a predecessor's
+# pending scale script.
+_SCALE_LOCK = threading.Lock()
+_SCALE_REQUESTS: list[int] = []  # guarded-by: _SCALE_LOCK
+# Bound on pending requests: a no-``max=`` scale spec firing every actor
+# step enqueues thousands of requests per window while the controller
+# applies at most one — beyond the cap new requests drop (the script is
+# already degenerate; FIFO order of the retained prefix is preserved).
+_SCALE_PENDING_CAP = 64
+
+
+def request_scale(delta: int) -> None:
+    """Enqueue one scripted fleet-scale request (any thread). Dropped
+    once ``_SCALE_PENDING_CAP`` requests are already pending."""
+    with _SCALE_LOCK:
+        if len(_SCALE_REQUESTS) < _SCALE_PENDING_CAP:
+            _SCALE_REQUESTS.append(int(delta))
+
+
+def drain_scale_requests() -> list[int]:
+    """All pending scripted scale deltas, FIFO; clears the queue (the
+    elastic controller applies at most one per window and re-queues the
+    rest itself, so two rapid-fire scripted events never force two ring
+    swaps inside one window close)."""
+    with _SCALE_LOCK:
+        out = list(_SCALE_REQUESTS)
+        _SCALE_REQUESTS.clear()
+        return out
 
 
 class InjectedFault(RuntimeError):
@@ -96,6 +137,8 @@ class FaultSite:
         seed: int,
         max_fires: int | None = None,
         stall_s: float = 1.0,
+        after: int = 0,
+        delta: int = 1,
     ):
         if name not in SITES:
             raise FaultSpecError(
@@ -107,11 +150,17 @@ class FaultSite:
             )
         if not 0.0 <= prob <= 1.0:
             raise FaultSpecError(f"fault prob must be in [0, 1], got {prob}")
+        if after < 0:
+            raise FaultSpecError(f"fault 'after' must be >= 0, got {after}")
+        if delta == 0:
+            raise FaultSpecError("fault 'delta' must be nonzero")
         self.name = name
         self.kind = kind
         self.prob = prob
         self.max_fires = max_fires
         self.stall_s = stall_s
+        self.after = after
+        self.delta = delta
         # zlib.crc32, not hash(): str hashing is salted per process and
         # would silently break cross-run determinism.
         self._rng = random.Random(seed ^ zlib.crc32(name.encode()))  # guarded-by: _lock
@@ -126,6 +175,12 @@ class FaultSite:
         format its message (a static-analysis finding)."""
         with self._lock:
             self.calls += 1
+            if self.calls <= self.after:
+                # Staged script: the site is dormant for its first
+                # ``after`` calls (no RNG draw — the armed stream starts
+                # when the stage does, keeping it deterministic under a
+                # changed ``after``).
+                return 0
             if self.max_fires is not None and self.fires >= self.max_fires:
                 return 0
             if self._rng.random() >= self.prob:
@@ -148,6 +203,9 @@ class FaultSite:
         - corrupt: returns a damaged copy of ``payload`` (NaN-poison for
           float arrays, bit-flip for ints/bools); payload-less sites
           degrade corrupt to a no-op (nothing to damage).
+        - scale: enqueues one scripted fleet-scale request of ``delta``
+          (drained by the elastic controller at the next window close);
+          the site itself never perturbs the firing thread.
         """
         ordinal = self._should_fire()
         if not ordinal:
@@ -177,6 +235,9 @@ class FaultSite:
                 if stop is not None and stop():
                     break
                 time.sleep(min(0.05, max(deadline - time.monotonic(), 0.0)))
+            return payload
+        if self.kind == "scale":
+            request_scale(self.delta)
             return payload
         # corrupt
         return _corrupt(payload)
@@ -236,6 +297,8 @@ def parse_spec(spec: str) -> list[FaultSite]:
             ) from None
         max_fires: int | None = None
         stall_s = 1.0
+        after = 0
+        delta: int | None = None
         for extra in fields[4:]:
             for kv in extra.split(","):
                 kv = kv.strip()
@@ -247,23 +310,33 @@ def parse_spec(spec: str) -> list[FaultSite]:
                     )
                 k, v = kv.split("=", 1)
                 k = k.strip()
-                if k not in ("max", "stall_s"):
+                if k not in ("max", "stall_s", "after", "delta"):
                     raise FaultSpecError(
                         f"fault spec {chunk!r}: unknown option {k!r} "
-                        "(have max, stall_s)"
+                        "(have max, stall_s, after, delta)"
                     )
                 try:
                     if k == "max":
                         max_fires = int(v)
-                    else:
+                    elif k == "stall_s":
                         stall_s = float(v)
+                    elif k == "after":
+                        after = int(v)
+                    else:
+                        delta = int(v)
                 except ValueError as e:
                     raise FaultSpecError(
                         f"fault spec {chunk!r}: bad value for {k!r} — {e}"
                     ) from None
+        if delta is not None and kind != "scale":
+            raise FaultSpecError(
+                f"fault spec {chunk!r}: option 'delta' only applies to "
+                "the scale kind"
+            )
         sites.append(
             FaultSite(name, kind, prob, seed, max_fires=max_fires,
-                      stall_s=stall_s)
+                      stall_s=stall_s, after=after,
+                      delta=1 if delta is None else delta)
         )
     return sites
 
@@ -296,6 +369,12 @@ class FaultRegistry:
             for name, site in self._sites.items()
         }
 
+    def has_kind(self, kind: str) -> bool:
+        """Any armed site of ``kind``? (The trainer refuses scale-kind
+        sites when the elastic runtime is off: their requests would
+        accumulate with no controller to drain them.)"""
+        return any(site.kind == kind for site in self._sites.values())
+
     def __bool__(self) -> bool:
         return bool(self._sites)
 
@@ -311,6 +390,13 @@ def arm(spec: str) -> FaultRegistry:
     with _ARM_LOCK:
         _ACTIVE = FaultRegistry(spec) if spec else None
         _ENV_CHECKED = True
+        # A fresh agent must never apply a predecessor's pending scripted
+        # scale requests (the registry-reset semantics). _SCALE_LOCK nests
+        # INSIDE _ARM_LOCK (acyclic: request/drain take it alone), keeping
+        # arm atomic — the returned registry is the one THIS call
+        # installed, never a concurrent arm/disarm's.
+        with _SCALE_LOCK:
+            _SCALE_REQUESTS.clear()
         return _ACTIVE if _ACTIVE is not None else FaultRegistry("")
 
 
@@ -320,6 +406,8 @@ def disarm() -> None:
     with _ARM_LOCK:
         _ACTIVE = None
         _ENV_CHECKED = True
+        with _SCALE_LOCK:
+            _SCALE_REQUESTS.clear()
 
 
 def active() -> FaultRegistry | None:

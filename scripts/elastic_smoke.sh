@@ -1,0 +1,230 @@
+#!/usr/bin/env bash
+# Elastic smoke: the operator-facing gate for the elastic runtime
+# (asyncrl_tpu/runtime/elastic.py), in two acts:
+#
+#   1. IDENTITY — a quiet elastic=True run must be BIT-IDENTICAL on
+#      losses to a static-fleet elastic=False control on a fixed seed,
+#      and neither run's windows may carry any elastic_* key (the
+#      introspect=False discipline: off — or armed-but-quiet — changes
+#      nothing).
+#   2. FUNCTION — a live run is forced through a scale-up and then a
+#      scale-down via ASYNCRL_FAULTS scale events (the chaos grammar's
+#      `scale` kind, driven through the public env-var surface the way a
+#      cluster chaos run would drive it), gating on: both transitions
+#      recorded (elastic_scale_up/down counters), the fleet back at its
+#      configured size, zero supervised restarts (a scale is not a
+#      crash), and /healthz — read over HTTP from the live exposition
+#      endpoint — reporting ok after the transitions.
+#
+# ASYNCRL_SMOKE_RECORD=1 appends a kind="robustness" probe="elastic_ab"
+# row to BENCH_HISTORY.json with the static-vs-elastic fps and the
+# transition counts.
+#
+# Usage: scripts/elastic_smoke.sh                  # CPU, ~2 min
+#        ASYNCRL_SMOKE_UPDATES=48 scripts/elastic_smoke.sh
+#        ASYNCRL_SMOKE_RECORD=1 scripts/elastic_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+UPDATES="${ASYNCRL_SMOKE_UPDATES:-24}"
+RECORD="${ASYNCRL_SMOKE_RECORD:-0}"
+OUT_DIR="$(mktemp -d)"
+trap 'rm -rf "$OUT_DIR"' EXIT
+
+# ---------------------------------------------------------------- act 1
+# Identity: elastic=True (quiet) vs elastic=False, fixed seed.
+python - "$UPDATES" "$OUT_DIR" <<'EOF'
+import json
+import sys
+import time
+
+import numpy as np
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.utils.config import Config
+
+updates, out_dir = int(sys.argv[1]), sys.argv[2]
+NUM_ENVS, UNROLL = 16, 8
+steps = updates * NUM_ENVS * UNROLL
+
+
+def run(elastic: bool):
+    cfg = Config(
+        env_id="CartPole-v1", algo="impala", backend="sebulba",
+        host_pool="jax", num_envs=NUM_ENVS, actor_threads=1,
+        unroll_len=UNROLL, precision="f32", log_every=4, seed=3,
+        # Frozen behaviour params: losses must be seed-deterministic for
+        # the identity assertion (no publish-timing race).
+        actor_staleness=1_000_000,
+        elastic=elastic, elastic_max_actors=4,
+        # Armed-but-quiet (the test_elastic bit-identity discipline): the
+        # 1-actor fleet genuinely starves the learner on this box, so the
+        # organic up signal would fire — real, but nondeterministic, and
+        # this act is about elastic=True changing NOTHING when no scale
+        # event happens.
+        elastic_up_stall_frac=1.0, elastic_down_backpressure=0.0,
+        elastic_down_admission=0.0,
+    )
+    agent = make_agent(cfg)
+    try:
+        t0 = time.perf_counter()
+        history = agent.train(total_env_steps=steps)
+        elapsed = time.perf_counter() - t0
+    finally:
+        agent.close()
+    return steps / elapsed, history
+
+
+# Discarded in-process warm-up (the introspect_smoke/perf_smoke
+# methodology): without it the first arm pays the JIT compile cost and
+# the second runs on the warm cache, writing a phantom fps gap into the
+# recorded ledger row for an identical workload.
+run(False)
+fps_static, hist_static = run(False)
+fps_elastic, hist_elastic = run(True)
+
+losses_a = [h["loss"] for h in hist_static]
+losses_b = [h["loss"] for h in hist_elastic]
+if not np.array_equal(np.asarray(losses_a), np.asarray(losses_b)):
+    sys.exit(
+        "elastic_smoke FAILED: quiet elastic=True losses diverged from the "
+        "static-fleet control on a fixed seed"
+    )
+print(f"elastic_smoke: losses identical across {len(losses_a)} windows")
+
+for label, hist in (("static", hist_static), ("elastic", hist_elastic)):
+    leaked = sorted(
+        {k for h in hist for k in h if k.startswith("elastic_")}
+    )
+    if leaked:
+        sys.exit(
+            f"elastic_smoke FAILED: quiet {label} run leaked {leaked} "
+            "into the window snapshot"
+        )
+    if "actors_live" not in hist[-1]:
+        sys.exit(
+            f"elastic_smoke FAILED: {label} run's windows are missing the "
+            "fleet gauges (actors_live)"
+        )
+print("elastic_smoke: zero elastic keys leaked; fleet gauges present")
+
+with open(f"{out_dir}/identity.json", "w") as f:
+    json.dump({"fps_static": fps_static, "fps_elastic_quiet": fps_elastic},
+              f)
+EOF
+
+# ---------------------------------------------------------------- act 2
+# Function: forced scale-up then scale-down via ASYNCRL_FAULTS, gated on
+# /healthz over the live HTTP endpoint.
+export ASYNCRL_FAULTS="actor.step:scale:1.0:0:delta=1,max=1;actor.queue_put:scale:1.0:0:delta=-1,max=1,after=8"
+python - "$UPDATES" "$OUT_DIR" <<'EOF'
+import json
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.utils.config import Config
+
+updates, out_dir = int(sys.argv[1]), sys.argv[2]
+NUM_ENVS, UNROLL = 16, 8
+steps = updates * NUM_ENVS * UNROLL
+
+cfg = Config(
+    env_id="CartPole-v1", algo="impala", backend="sebulba",
+    host_pool="jax", num_envs=NUM_ENVS, actor_threads=2,
+    unroll_len=UNROLL, precision="f32", log_every=4, seed=3,
+    elastic=True, elastic_max_actors=4,
+    # Organic signals pinned off (the test_elastic e2e discipline): this
+    # act asserts EXACT fleet shapes, and on a loaded 1-core box the
+    # controller's own stall verdict is genuine but nondeterministic —
+    # only the scripted ASYNCRL_FAULTS events may move the fleet here.
+    elastic_up_stall_frac=1.0, elastic_down_backpressure=0.0,
+    elastic_down_admission=0.0,
+    obs_http_port=-1,  # ephemeral /metrics + /healthz endpoint
+    # This 1-core box's scheduler noise must not hold /healthz degraded
+    # past the end of the run (the gate is about the SCALE transitions).
+    health_stall_frac=1.0, health_fps_collapse=0.0,
+)
+agent = make_agent(cfg)
+try:
+    t0 = time.perf_counter()
+    history = agent.train(total_env_steps=steps)
+    elapsed = time.perf_counter() - t0
+    last = history[-1]
+    if last.get("elastic_scale_up", 0) < 1:
+        sys.exit("elastic_smoke FAILED: forced scale-up never applied")
+    if last.get("elastic_scale_down", 0) < 1:
+        sys.exit("elastic_smoke FAILED: forced scale-down never applied")
+    if last.get("actors_live") != float(cfg.actor_threads):
+        sys.exit(
+            "elastic_smoke FAILED: fleet did not return to its configured "
+            f"size (actors_live={last.get('actors_live')})"
+        )
+    if last.get("actor_restarts", 0) != 0:
+        sys.exit(
+            "elastic_smoke FAILED: a deliberate scale event was counted "
+            "as a supervised restart"
+        )
+    if not np.isfinite(last["loss"]):
+        sys.exit("elastic_smoke FAILED: loss went non-finite under scaling")
+    if agent._obs.http is None:
+        sys.exit("elastic_smoke FAILED: exposition endpoint did not mount")
+    url = f"http://127.0.0.1:{agent._obs.http.port}/healthz"
+    verdict = json.load(urllib.request.urlopen(url, timeout=5))
+    if verdict["status"] != "ok":
+        sys.exit(
+            f"elastic_smoke FAILED: /healthz did not recover to ok after "
+            f"the scale transitions: {verdict}"
+        )
+    print(
+        f"elastic_smoke: scale-up + scale-down applied, fleet restored, "
+        f"/healthz ok (window {verdict['window']})"
+    )
+finally:
+    agent.close()
+
+with open(f"{out_dir}/elastic.json", "w") as f:
+    json.dump({
+        "fps_elastic_scaled": steps / elapsed,
+        "scale_up": int(last["elastic_scale_up"]),
+        "scale_down": int(last["elastic_scale_down"]),
+    }, f)
+EOF
+unset ASYNCRL_FAULTS
+
+# --------------------------------------------------------------- ledger
+python - "$UPDATES" "$OUT_DIR" "$RECORD" <<'EOF'
+import json
+import sys
+
+updates, out_dir, record = sys.argv[1], sys.argv[2], sys.argv[3]
+identity = json.load(open(f"{out_dir}/identity.json"))
+scaled = json.load(open(f"{out_dir}/elastic.json"))
+print(
+    f"elastic_smoke OK: static {identity['fps_static']:,.0f} fps, quiet "
+    f"elastic {identity['fps_elastic_quiet']:,.0f} fps, scaled run "
+    f"{scaled['fps_elastic_scaled']:,.0f} fps "
+    f"({scaled['scale_up']} up / {scaled['scale_down']} down)"
+)
+if record not in ("", "0"):
+    from asyncrl_tpu.utils import bench_history
+
+    entry = bench_history.record({
+        "kind": "robustness",
+        "probe": "elastic_ab",
+        "preset": "cartpole_impala(sebulba tiny)",
+        **bench_history.device_entry(),
+        "updates": int(updates),
+        "fps_static": round(identity["fps_static"]),
+        "fps_elastic_quiet": round(identity["fps_elastic_quiet"]),
+        "fps_elastic_scaled": round(scaled["fps_elastic_scaled"]),
+        "scale_up": scaled["scale_up"],
+        "scale_down": scaled["scale_down"],
+        "healthz": "ok",
+    })
+    print("elastic_smoke: recorded", entry["ts"])
+EOF

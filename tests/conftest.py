@@ -158,6 +158,52 @@ QUICK: dict[str, object] = {
         "test_reset_invalidates_all_leases",
         "test_auto_num_slabs_covers_pipeline_depth",
         "test_slab_path_bit_identical_to_stack_path",
+        # Elastic ring-swap semantics (RingSwapHolder): sub-second units.
+        "test_ring_swap_inflight_lease_finishes_on_old_ring",
+        "test_ring_swap_zombie_on_drained_ring_raises",
+        "test_ring_swap_never_invalidates_a_live_lease",
+        "test_ring_swap_wakes_blocked_acquirer_onto_new_ring",
+        "test_ring_swap_holder_reset_fences_every_live_ring",
+        "test_ring_swap_holder_accumulates_reuse_waits",
+    },
+    # Elastic runtime (asyncrl_tpu/runtime/elastic.py, ISSUE 9):
+    # controller/grammar/registry units are sub-second; the storm-
+    # classification unit and serve-registry test are a few seconds; the
+    # two scripted-scale e2e runs, the chaos matrix, and the elastic-off
+    # bit-identity A/B are ~60s combined. Tier-1 by the ISSUE 9
+    # acceptance contract (zero dropped leases + /healthz recovery on
+    # every PR). The checkpoint-barrier restore test stays in the full
+    # tier (orbax round trips).
+    "test_elastic.py": {
+        "test_controller_up_needs_hysteresis_then_cools_down",
+        "test_controller_respects_bounds",
+        "test_controller_down_on_backpressure_delta_not_level",
+        "test_controller_down_reason_never_blames_a_disabled_signal",
+        "test_controller_admission_signal_has_disable_knob",
+        "test_controller_blame_veto_blocks_misattributed_scale_up",
+        "test_blame_horizon_covers_the_closed_window_not_the_1s_clamp",
+        "test_scripted_requests_bypass_hysteresis_one_per_window",
+        "test_scripted_multislot_applies_one_slot_per_window",
+        "test_scripted_fire_resets_trends_and_arms_cooldown",
+        "test_scripted_noop_does_not_freeze_organic_trends",
+        "test_scripted_down_clamps_to_min",
+        "test_decision_event_payload_is_structured",
+        "test_scale_kind_fires_requests_and_counts",
+        "test_scale_after_option_stages_the_script",
+        "test_delta_refused_on_non_scale_kinds",
+        "test_arm_clears_pending_scale_requests",
+        "test_pending_scale_requests_are_bounded",
+        "test_scale_spec_requires_elastic_runtime",
+        "test_watchdog_retirements_excluded_from_crash_storm",
+        "test_serve_core_elastic_client_registry",
+        "test_reconfigure_barrier_without_checkpointer_raises",
+        "test_scripted_scale_up_grows_fleet_without_storm",
+        "test_scripted_scale_down_is_drain_clean",
+        "test_organic_stall_signal_scales_up",
+        "test_chaos_matrix_interleaved_scale_and_crash",
+        "test_elastic_off_is_bit_identical_and_leaks_no_keys",
+        "test_elastic_validation_refuses_bad_compositions",
+        "test_asyncrl_elastic_env_wins",
     },
     # overlap_h2d on/off A/B: identical losses + not-slower (~25s).
     "test_perf_smoke.py": "all",

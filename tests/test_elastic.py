@@ -1,0 +1,755 @@
+"""Elastic runtime (asyncrl_tpu/runtime/elastic.py): controller policy
+units (hysteresis, cooldown, bounds, scripted bypass, blame veto), the
+``scale`` chaos kind, reason-classified storm accounting, the serve core's
+elastic client registry, the checkpoint reconfigure barrier, and the
+end-to-end scale paths — including the chaos matrix interleaving scripted
+scale events with crash faults under the §5.2b transport checker."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.rollout.sebulba import ParamStore
+from asyncrl_tpu.runtime.elastic import (
+    ElasticController,
+    ReconfigureBarrier,
+    ScaleDecision,
+)
+from asyncrl_tpu.serve.scheduler import ServeCore
+from asyncrl_tpu.utils import faults
+from asyncrl_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """No test's armed registry (or pending scripted scale requests) may
+    leak into the next."""
+    yield
+    faults.disarm()
+
+
+# -------------------------------------------------------- controller units
+
+
+def _window(**kw):
+    base = {
+        "learner_stall_frac": 0.0,
+        "queue_backpressure": 0.0,
+        "server_overload": 0.0,
+        "serve_shed": 0.0,
+        "staleness_p95": 0.0,
+    }
+    base.update(kw)
+    return base
+
+
+def test_controller_up_needs_hysteresis_then_cools_down():
+    c = ElasticController(min_actors=1, max_actors=4, cooldown_windows=2,
+                          hysteresis=2)
+    assert c.decide(_window(learner_stall_frac=0.9), 2) is None  # 1st window
+    d = c.decide(_window(learner_stall_frac=0.9), 2)  # 2nd: trend confirmed
+    assert d is not None and d.direction == "up" and d.delta == 1
+    assert not d.scripted and d.reason == "stall"
+    # Cooldown: the same signal stays quiet for cooldown_windows windows,
+    # then needs a fresh hysteresis run.
+    assert c.decide(_window(learner_stall_frac=0.9), 3) is None
+    assert c.decide(_window(learner_stall_frac=0.9), 3) is None
+    assert c.decide(_window(learner_stall_frac=0.9), 3) is None
+    d2 = c.decide(_window(learner_stall_frac=0.9), 3)
+    assert d2 is not None and d2.direction == "up"
+
+
+def test_controller_respects_bounds():
+    c = ElasticController(min_actors=2, max_actors=2, cooldown_windows=0,
+                          hysteresis=1)
+    assert c.decide(_window(learner_stall_frac=0.99), 2) is None  # at max
+    # Backpressure growth wants a scale-down, but the fleet is at min.
+    c2 = ElasticController(min_actors=2, max_actors=4, cooldown_windows=0,
+                           hysteresis=1)
+    c2.decide(_window(queue_backpressure=0.0), 2)
+    assert c2.decide(_window(queue_backpressure=50.0), 2) is None  # at min
+
+
+def test_controller_down_on_backpressure_delta_not_level():
+    c = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                          hysteresis=1)
+    c.decide(_window(queue_backpressure=100.0), 3)  # establishes the base
+    # Flat cumulative counter = no NEW backpressure: not a down signal.
+    assert c.decide(_window(queue_backpressure=100.0), 3) is None
+    d = c.decide(_window(queue_backpressure=105.0), 3)
+    assert d is not None and d.direction == "down" and d.delta == -1
+    assert d.reason == "backpressure"
+
+
+def test_controller_down_reason_never_blames_a_disabled_signal():
+    """Code-review pin: with the backpressure signal DISABLED (0), an
+    admission-triggered scale-down must be classified "admission" — the
+    old `bp_delta >= 0.0` comparison blamed a signal the operator turned
+    off."""
+    c = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                          hysteresis=1, down_backpressure=0.0,
+                          down_admission=1.0)
+    c.decide(_window(), 3)  # establish counter baselines
+    d = c.decide(_window(server_overload=2.0), 3)
+    assert d is not None and d.direction == "down"
+    assert d.reason == "admission"
+
+
+def test_controller_admission_signal_has_disable_knob():
+    """Code-review pin: down_admission=0 disables the admission signal —
+    a pinned-quiet identity run must not scale on a stray overload/shed
+    increment."""
+    c = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                          hysteresis=1, down_backpressure=0.0,
+                          down_admission=0.0)
+    c.decide(_window(), 3)
+    assert c.decide(_window(server_overload=5.0, serve_shed=5.0), 3) is None
+
+
+def test_controller_blame_veto_blocks_misattributed_scale_up():
+    """A stall the spans blame on the learner (H2D-bound) must not grow
+    the actor fleet — more actors cannot fix it."""
+    c = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                          hysteresis=1, blame_fn=lambda: "learner")
+    assert c.decide(_window(learner_stall_frac=0.99), 2) is None
+    c2 = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                           hysteresis=1, blame_fn=lambda: "actors")
+    assert c2.decide(_window(learner_stall_frac=0.99), 2) is not None
+
+
+def test_blame_horizon_covers_the_closed_window_not_the_1s_clamp():
+    """Code-review pin: the elastic blame veto runs AFTER observe_window
+    advanced the monitor's close timestamp, so a default ``bottleneck()``
+    call there sees only the ~1s clamp of spans — a window dominated by
+    learner.queue_wait (actors genuinely the bottleneck) would read as
+    no-wait and the veto would misjudge. The veto passes
+    ``elapsed=monitor.last_window_s`` to judge the whole closed window."""
+    import time
+
+    from asyncrl_tpu.obs import health as health_mod
+
+    now = time.perf_counter()
+
+    class _StubTracer:
+        def snapshots(self):
+            # One dominant wait span in the MIDDLE of the closed window —
+            # outside the 1s clamp, inside the window horizon.
+            return [{"spans": [("learner.queue_wait", now - 8.0, now - 5.0)]}]
+
+    m = health_mod.HealthMonitor(
+        tracer=_StubTracer(), emit=False, recorder=None
+    )
+    m._prev_t = time.time()  # a window JUST closed (the veto's call site)
+    m.last_window_s = 10.0
+    assert m.bottleneck() == (None, None)  # the 1s clamp misses the wait
+    stage, cause = m.bottleneck(elapsed=m.last_window_s)
+    assert stage == "learner.queue_wait" and cause
+
+
+def test_scripted_requests_bypass_hysteresis_one_per_window():
+    c = ElasticController(min_actors=1, max_actors=3, cooldown_windows=5,
+                          hysteresis=3)
+    faults.request_scale(1)
+    faults.request_scale(1)
+    faults.request_scale(1)  # clamped away at max_actors=3 later
+    d1 = c.decide(_window(), 2)
+    assert d1 is not None and d1.scripted and d1.delta == 1
+    d2 = c.decide(_window(), 3)  # queued request, next window
+    assert d2 is None  # fleet already at max: clamped to nothing
+    assert c.decide(_window(), 3) is None  # third request also clamped
+
+
+def test_scripted_down_clamps_to_min():
+    c = ElasticController(min_actors=1, max_actors=4)
+    faults.request_scale(-5)
+    d = c.decide(_window(), 2)
+    assert d is not None and d.direction == "down" and d.delta == -1
+
+
+def test_scripted_multislot_applies_one_slot_per_window():
+    """Code-review pin: a delta=3 script is applied one slot per window
+    (remainder re-queued at the front) — the reconfigure barrier's
+    restore contract is only exact for a single mutate-last slot op, and
+    every decision's |delta| is exactly 1."""
+    c = ElasticController(min_actors=1, max_actors=5)
+    faults.request_scale(3)
+    for live in (1, 2, 3):
+        d = c.decide(_window(), live)
+        assert d is not None and d.delta == 1 and d.scripted
+    assert c.decide(_window(), 4) is None  # script fully applied
+
+
+def test_scripted_fire_resets_trends_and_arms_cooldown():
+    """Code-review pin: a scripted fire changes the fleet shape, so a
+    half-built organic trend measured over the old shape is stale — it
+    resets, and the cooldown arms. An organic scale-up can never fire
+    off non-consecutive stall windows bridged by a scripted event."""
+    c = ElasticController(min_actors=1, max_actors=8, cooldown_windows=2,
+                          hysteresis=2)
+    assert c.decide(_window(learner_stall_frac=0.9), 2) is None  # _up_run=1
+    faults.request_scale(1)
+    d = c.decide(_window(learner_stall_frac=0.9), 2)
+    assert d is not None and d.scripted
+    # Two cooldown windows, then a FRESH 2-window hysteresis run: the
+    # pre-script stall window must not count toward the new trend.
+    assert c.decide(_window(learner_stall_frac=0.9), 3) is None
+    assert c.decide(_window(learner_stall_frac=0.9), 3) is None
+    assert c.decide(_window(learner_stall_frac=0.9), 3) is None
+    d2 = c.decide(_window(learner_stall_frac=0.9), 3)
+    assert d2 is not None and d2.reason == "stall"
+
+
+def test_scripted_noop_does_not_freeze_organic_trends():
+    """Code-review pin: a scripted request the bounds fully absorb is
+    dropped and that window still evaluates organically — the stall
+    trend stays consecutive across the no-op instead of silently
+    pausing (the old early return froze trends and cooldown alike)."""
+    c = ElasticController(min_actors=2, max_actors=4, cooldown_windows=0,
+                          hysteresis=2)
+    assert c.decide(_window(learner_stall_frac=0.9), 2) is None  # _up_run=1
+    faults.request_scale(-1)  # live == min_actors: fully absorbed, dropped
+    d = c.decide(_window(learner_stall_frac=0.9), 2)
+    assert d is not None and d.reason == "stall" and d.direction == "up"
+
+
+def test_decision_event_payload_is_structured():
+    d = ScaleDecision(direction="up", delta=1, reason="stall", detail="x",
+                      signals={"learner_stall_frac": 0.9})
+    event = d.event(2, 3)
+    assert event["event_type"] == "elastic_scale"
+    assert event["action"] == "scale_up"
+    assert event["actors_before"] == 2 and event["actors_after"] == 3
+    assert event["signals"]["learner_stall_frac"] == 0.9
+
+
+# ---------------------------------------------------------- scale chaos kind
+
+
+def test_scale_kind_fires_requests_and_counts():
+    site = faults.FaultRegistry(
+        "actor.step:scale:1.0:0:delta=-1,max=2"
+    ).site("actor.step")
+    for _ in range(3):
+        site.fire()
+    assert site.fires == 2  # max honored
+    assert faults.drain_scale_requests() == [-1, -1]
+    assert faults.drain_scale_requests() == []  # drained
+
+
+def test_scale_after_option_stages_the_script():
+    site = faults.FaultRegistry(
+        "pool.step:scale:1.0:0:delta=1,max=1,after=3"
+    ).site("pool.step")
+    for _ in range(3):
+        site.fire()
+    assert faults.drain_scale_requests() == []  # dormant stage
+    site.fire()
+    assert faults.drain_scale_requests() == [1]
+
+
+def test_delta_refused_on_non_scale_kinds():
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec("actor.step:crash:1.0:0:delta=1")
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec("actor.step:scale:1.0:0:delta=0")
+
+
+def test_arm_clears_pending_scale_requests():
+    faults.request_scale(7)
+    faults.arm("")
+    assert faults.drain_scale_requests() == []
+
+
+def test_pending_scale_requests_are_bounded():
+    """Code-review pin: a degenerate no-max scale script cannot grow the
+    pending queue without bound — beyond the cap, requests drop (FIFO
+    prefix kept)."""
+    for _ in range(faults._SCALE_PENDING_CAP + 50):
+        faults.request_scale(1)
+    assert len(faults.drain_scale_requests()) == faults._SCALE_PENDING_CAP
+
+
+def test_scale_spec_requires_elastic_runtime():
+    """Code-review pin: arming a scale-kind site on an elastic=False
+    trainer is refused eagerly — its requests would accumulate with no
+    controller to drain them (and the script would silently do nothing)."""
+    with pytest.raises(ValueError, match="elastic"):
+        make_agent(_elastic_config(
+            elastic=False,
+            fault_spec="actor.step:scale:1.0:0:delta=1,max=1",
+        ))
+
+
+# ------------------------------------------------- storm-reason accounting
+
+
+class _DummyActor:
+    index = 0
+    backpressure = 0
+    _open_lease = None
+
+    def join(self, timeout=None):
+        pass
+
+    def is_alive(self):
+        return False
+
+
+def test_watchdog_retirements_excluded_from_crash_storm():
+    """Satellite: watchdog retirements and crash restarts keep SEPARATE
+    storm windows — 5 of each stays under a threshold of 6 where the old
+    pooled accounting would have aborted at 10."""
+    cfg = Config(
+        env_id="CartPole-v1", algo="a3c", backend="sebulba",
+        host_pool="jax", num_envs=16, actor_threads=2, unroll_len=4,
+        precision="f32",
+    )
+    agent = make_agent(cfg)
+    try:
+        # Two-actor dummy fleet: the storm bar is 3 x the LIVE fleet
+        # (code-review pin — a scaled fleet must be judged by its own
+        # size, not config.actor_threads), so the threshold here is 6.
+        agent._actors = [_DummyActor(), _DummyActor()]
+        agent._actor_gens = [0, 0]
+        agent._spawn_actor = lambda i: _DummyActor()
+        err = RuntimeError("injected")
+        for _ in range(5):
+            agent._restart_actor(0, err, reason="watchdog")
+        for _ in range(5):
+            agent._restart_actor(0, err, reason="crash")
+        assert len(agent._recent_watchdog) == 5
+        assert len(agent._recent_restarts) == 5
+        assert agent._actor_restarts == 10
+        # ... but each window still aborts on ITS OWN storm.
+        with pytest.raises(RuntimeError, match="failed repeatedly"):
+            for _ in range(3):
+                agent._restart_actor(0, err, reason="crash")
+    finally:
+        agent._actors = []
+        agent.close()
+
+
+# ------------------------------------------------ serve-core client registry
+
+
+def test_serve_core_elastic_client_registry():
+    """ensure_client grows the slot bound, remove_client shrinks the
+    slab-full target so dispatch never waits out its deadline on a
+    retired client."""
+
+    def fn(params, obs, key):
+        n = obs.shape[0]
+        return np.zeros(n, np.int32), np.zeros(n, np.float32), key
+
+    obs_registry.registry().reset()
+    store = ParamStore({"w": np.zeros(1)})
+    stop = threading.Event()
+    core = ServeCore(fn, store=store, num_clients=1, stop_event=stop,
+                     mode="ff", deadline_ms=200.0)
+    core.start()
+    try:
+        with pytest.raises(IndexError):
+            core.client(1)
+        core.ensure_client(1)
+        c0, c1 = core.client(0), core.client(1)
+        obs_batch = np.zeros((3, 4), np.float32)
+        results = {}
+
+        def call(tag, client):
+            results[tag] = client(None, obs_batch, None)
+
+        threads = [
+            threading.Thread(target=call, args=("a", c0), daemon=True),
+            threading.Thread(target=call, args=("b", c1), daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert results["a"][0].shape == (3,) and results["b"][0].shape == (3,)
+
+        # Retire client 0: the fill target shrinks to ONE registered
+        # client, so a lone request from client 1 dispatches as a FULL
+        # batch (not a 200ms deadline flush).
+        core.remove_client(0)
+        full_before = obs_registry.counter(
+            "serve_dispatch_full"
+        ).value()
+        import time
+
+        t0 = time.monotonic()
+        out = c1(None, obs_batch, None)
+        took = time.monotonic() - t0
+        assert out[0].shape == (3,)
+        assert took < 0.15, f"dispatch waited out the deadline: {took:.3f}s"
+        assert obs_registry.counter("serve_dispatch_full").value() \
+            == full_before + 1
+    finally:
+        stop.set()
+        core.join(timeout=5)
+        obs_registry.registry().reset()
+
+
+# --------------------------------------------------- reconfigure barrier
+
+
+def test_reconfigure_barrier_restores_on_failed_action(tmp_path):
+    """The save → reconfigure → restore contract: a failing action comes
+    back with the checkpointed state (Checkpointer fallback-restore) and
+    ok=False; the run continues instead of dying."""
+    cfg = Config(
+        env_id="CartPole-v1", algo="a3c", backend="sebulba",
+        host_pool="jax", num_envs=16, actor_threads=2, unroll_len=4,
+        precision="f32", log_every=2, checkpoint_dir=str(tmp_path / "ck"),
+    )
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=16 * 4 * 2)
+        step_before = int(np.asarray(agent.state.update_step))
+        barrier = ReconfigureBarrier(agent._ckpt)
+
+        def boom():
+            raise RuntimeError("injected reconfigure failure")
+
+        state, env_steps, ok = barrier.run(
+            agent.state, agent.env_steps, boom
+        )
+        assert not ok
+        assert int(np.asarray(state.update_step)) == step_before
+        assert env_steps == agent.env_steps
+
+        # Success path: inputs pass through untouched.
+        state2, steps2, ok2 = barrier.run(
+            agent.state, agent.env_steps, lambda: None
+        )
+        assert ok2 and state2 is agent.state and steps2 == agent.env_steps
+    finally:
+        agent.close()
+
+
+def test_failed_reconfigure_is_not_counted_as_a_scale(tmp_path):
+    """Code-review pin: a reconfigure the barrier rolled back must NOT
+    increment elastic_scale_up (nor annotate a fleet change) — only
+    elastic_reconfigure_failed records the attempt. Otherwise a run where
+    every scale failed reads as a successfully scaled run on /metrics."""
+    cfg = _elastic_config(checkpoint_dir=str(tmp_path / "ck"))
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=_steps(cfg, updates=2))
+        fleet_before = len(agent._actors)
+
+        def boom():
+            raise RuntimeError("injected scale failure")
+
+        agent._scale_up_actor = boom
+        faults.request_scale(1)
+        agent._elastic_step(
+            {"learner_stall_frac": 0.0, "queue_backpressure": 0.0}
+        )
+        assert len(agent._actors) == fleet_before
+        assert obs_registry.counter("elastic_reconfigure_failed").value() \
+            == 1
+        assert obs_registry.counter("elastic_scale_up").value() == 0
+    finally:
+        agent.close()
+
+
+def test_failed_ring_build_leaves_fleet_and_ring_untouched(tmp_path):
+    """Code-review pin: the composed reconfigure action is mutate-last —
+    the new ring (the fallible slab allocation) is built BEFORE the fleet
+    changes and installed only after the slot operation succeeded, so a
+    MemoryError in the build rolls back to a fleet AND data path both
+    still on the pre-scale shape (actors_live next window can never
+    contradict the barrier's "fleet stays at N" restore message)."""
+    cfg = _elastic_config(checkpoint_dir=str(tmp_path / "ck"))
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=_steps(cfg, updates=2))
+        fleet_before = len(agent._actors)
+        ring_before = agent._staging.current()
+
+        def boom(actor_count):
+            raise MemoryError("injected slab-allocation failure")
+
+        agent._build_staging_ring = boom
+        faults.request_scale(1)
+        agent._elastic_step(
+            {"learner_stall_frac": 0.0, "queue_backpressure": 0.0}
+        )
+        assert len(agent._actors) == fleet_before
+        assert agent._staging.current() is ring_before
+        assert obs_registry.counter("elastic_reconfigure_failed").value() \
+            == 1
+        assert obs_registry.counter("elastic_scale_up").value() == 0
+    finally:
+        agent.close()
+
+
+def test_failed_scale_up_leaves_no_ghost_serve_client(tmp_path):
+    """Code-review pin: _spawn_actor registers its serve-client slot
+    (``client(index)``) before the actor thread exists; a build failure
+    after that point must unwind the registration — a ghost client holds
+    every future dispatch's slab-full target one client high, so each
+    batch waits out its full deadline on a client that can never
+    submit."""
+    cfg = _elastic_config(
+        inference_server=True, checkpoint_dir=str(tmp_path / "ck")
+    )
+    agent = make_agent(cfg)
+    seen = {}
+
+    def inject(window):
+        # Window-close thread — the thread _elastic_step really runs on.
+        if seen or agent._server is None:
+            return
+        seen["fleet_before"] = len(agent._actors)
+        seen["registered_before"] = dict(agent._server._client_policy)
+        real_spawn = agent._spawn_actor
+
+        def spawn_and_die(index):
+            agent._server.client(index)  # the registration side effect
+            raise RuntimeError("injected actor-build failure")
+
+        agent._spawn_actor = spawn_and_die
+        try:
+            faults.request_scale(1)
+            agent._elastic_step(
+                {"learner_stall_frac": 0.0, "queue_backpressure": 0.0}
+            )
+        finally:
+            agent._spawn_actor = real_spawn
+        seen["fleet_after"] = len(agent._actors)
+        seen["registered_after"] = dict(agent._server._client_policy)
+
+    try:
+        agent.train(total_env_steps=_steps(cfg, updates=4), callback=inject)
+        assert seen, "callback never saw a live server"
+        assert seen["fleet_after"] == seen["fleet_before"]
+        assert seen["registered_after"] == seen["registered_before"]
+    finally:
+        agent.close()
+
+
+def test_reconfigure_barrier_without_checkpointer_raises():
+    from asyncrl_tpu.utils.checkpoint import TrainerCheckpointing
+
+    barrier = ReconfigureBarrier(TrainerCheckpointing(None, 0))
+
+    def boom():
+        raise RuntimeError("no barrier to restore from")
+
+    with pytest.raises(RuntimeError, match="no barrier"):
+        barrier.run(object(), 0, boom)
+
+
+# ------------------------------------------------------------ e2e scaling
+
+
+def _elastic_config(**kw):
+    base = dict(
+        env_id="CartPole-v1", algo="a3c", backend="sebulba",
+        host_pool="jax", num_envs=16, actor_threads=2, unroll_len=4,
+        precision="f32", log_every=2, elastic=True,
+        # Organic signals OFF: these e2e runs pin exact fleet shapes and
+        # scale counts, and on a loaded 1-core box the controller's real
+        # stall/backpressure verdicts are genuine but nondeterministic —
+        # only the scripted chaos events may move the fleet here.
+        elastic_up_stall_frac=1.0, elastic_down_backpressure=0.0,
+        elastic_down_admission=0.0,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _steps(cfg, updates=8):
+    return (cfg.num_envs // cfg.actor_threads) * cfg.unroll_len * updates
+
+
+@pytest.mark.chaos
+def test_scripted_scale_up_grows_fleet_without_storm(monkeypatch):
+    """A scripted scale event grows the fleet mid-run: training reaches
+    its target, the gauges record the new shape, the scale is counted as
+    elastic (NOT as a supervised restart), and the §5.2b transport
+    checker stays silent across the transition."""
+    monkeypatch.setenv("ASYNCRL_DEBUG_SYNC", "1")
+    cfg = _elastic_config(
+        elastic_max_actors=4,
+        fault_spec="actor.step:scale:1.0:0:delta=1,max=1",
+    )
+    agent = make_agent(cfg)
+    fleets = []
+    try:
+        history = agent.train(
+            total_env_steps=_steps(cfg, updates=10),
+            callback=lambda w: fleets.append(len(agent._actors)),
+        )
+        assert agent.env_steps >= _steps(cfg, updates=10)
+        last = history[-1]
+        assert last["elastic_scale_up"] == 1
+        assert "elastic_scale_down" not in last
+        assert last["actors_live"] == 3.0
+        assert last["actor_restarts"] == 0  # a scale is not a restart
+        assert max(fleets) == 3
+        assert np.isfinite(last["loss"])
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+def test_scripted_scale_down_is_drain_clean(monkeypatch):
+    """Shrink reuses the per-thread retirement path: the retired slot's
+    lease voids, its queued fragments drop at the validity check, the
+    serve registry deregisters, and training completes gapless under the
+    transport checker."""
+    monkeypatch.setenv("ASYNCRL_DEBUG_SYNC", "1")
+    cfg = _elastic_config(
+        inference_server=True,
+        fault_spec="actor.step:scale:1.0:0:delta=-1,max=1",
+    )
+    agent = make_agent(cfg)
+    try:
+        history = agent.train(total_env_steps=_steps(cfg, updates=10))
+        assert agent.env_steps >= _steps(cfg, updates=10)
+        last = history[-1]
+        assert last["elastic_scale_down"] == 1
+        assert last["actors_live"] == 1.0
+        assert last["actor_restarts"] == 0
+        assert np.isfinite(last["loss"])
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+def test_chaos_matrix_interleaved_scale_and_crash(monkeypatch):
+    """The acceptance matrix: scripted scale events interleaved with a
+    crash fault. Zero dropped leases / mixed-generation batches (§5.2b
+    checker + the ring's own uncommitted-row guard would abort on
+    either), the crash is recovered and counted as a restart, the scale
+    is counted as elastic, NO storm abort fires, and /healthz recovers
+    to ok after the transitions."""
+    monkeypatch.setenv("ASYNCRL_DEBUG_SYNC", "1")
+    cfg = _elastic_config(
+        elastic_max_actors=4,
+        obs_http_port=-1,  # mounts the health monitor + /healthz endpoint
+        # The run-shape detectors would see this 1-core box's scheduler
+        # noise, not the chaos under test: a transient stall/fps dip must
+        # not hold /healthz degraded past the run's end. (The verdict
+        # assertion below is about the SCALE transitions recovering.)
+        health_stall_frac=1.0,
+        health_fps_collapse=0.0,
+        fault_spec=(
+            "actor.step:scale:1.0:0:delta=1,max=1;"
+            "pool.step:crash:1.0:3:max=1,after=40;"
+            "actor.queue_put:scale:1.0:5:delta=-1,max=1,after=12"
+        ),
+    )
+    agent = make_agent(cfg)
+    try:
+        history = agent.train(total_env_steps=_steps(cfg, updates=16))
+        assert agent.env_steps >= _steps(cfg, updates=16)
+        last = history[-1]
+        assert last["elastic_scale_up"] == 1
+        assert last["elastic_scale_down"] == 1
+        assert last["actor_restarts"] >= 1  # the crash, supervised
+        assert last["fault_pool.step"] == 1
+        # The run lived: no storm abort reached us, losses stayed finite.
+        assert np.isfinite(last["loss"])
+        # /healthz recovered: the crash-window events aged out of the TTL.
+        verdict = agent._obs.monitor.verdict()
+        assert verdict["status"] == "ok", verdict
+        assert verdict["components"]["actors"] == "ok"
+        # Drain-clean: once stopped, every slab on the live ring is free
+        # and no lease survived (the reset contract).
+        agent.stop()
+        ring = agent._staging.current()
+        assert all(s.phase == "free" for s in ring._slabs)
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+def test_organic_stall_signal_scales_up():
+    """The signal-driven path proper: no script, no faults — a starved
+    learner (1 actor feeding it, stall threshold set low enough that the
+    genuine starvation on this box clears it) must make the controller
+    grow the fleet to its max bound through hysteresis."""
+    cfg = _elastic_config(
+        actor_threads=1, num_envs=8,
+        elastic_max_actors=2, elastic_cooldown_windows=0,
+        elastic_up_stall_frac=0.01,  # any real starvation clears this
+    )
+    agent = make_agent(cfg)
+    try:
+        history = agent.train(total_env_steps=_steps(cfg, updates=12))
+        last = history[-1]
+        assert last["elastic_scale_up"] >= 1
+        assert last["actors_live"] == 2.0
+        assert last["actor_restarts"] == 0
+    finally:
+        agent.close()
+
+
+def test_elastic_off_is_bit_identical_and_leaks_no_keys():
+    """Satellite pin (the introspect=False A/B discipline): elastic=False
+    must change NOTHING — bit-identical losses on a fixed seed, zero
+    elastic_* keys in either run's windows (the gauges are part of the
+    base obs surface and appear in both)."""
+
+    def run(elastic: bool):
+        cfg = Config(
+            env_id="CartPole-v1", algo="impala", backend="sebulba",
+            host_pool="jax", num_envs=8, actor_threads=1, unroll_len=8,
+            precision="f32", log_every=2, seed=11,
+            actor_staleness=1_000_000,  # frozen publishes: seed-determined
+            elastic=elastic,
+            # Armed-but-quiet: organic signals pinned off so a genuinely
+            # starved 1-actor fleet on a loaded box cannot trigger a real
+            # (and nondeterministic) scale mid-comparison.
+            elastic_up_stall_frac=1.0, elastic_down_backpressure=0.0,
+            elastic_down_admission=0.0,
+        )
+        agent = make_agent(cfg)
+        try:
+            history = agent.train(total_env_steps=8 * 8 * 4)
+        finally:
+            agent.close()
+        return history
+
+    on, off = run(True), run(False)
+    assert [h["loss"] for h in on] == [h["loss"] for h in off]
+    for history in (on, off):
+        for window in history:
+            assert not any(k.startswith("elastic_") for k in window), (
+                "quiet elastic run leaked elastic keys: "
+                f"{sorted(k for k in window if k.startswith('elastic_'))}"
+            )
+            assert "actors_live" in window
+            assert "servers_live" in window
+            assert "staging_slabs_live" in window
+
+
+def test_elastic_validation_refuses_bad_compositions():
+    with pytest.raises(ValueError, match="updates_per_call"):
+        make_agent(_elastic_config(updates_per_call=2))
+    with pytest.raises(ValueError, match="serve core"):
+        make_agent(_elastic_config(inference_server=True, serve=False))
+    with pytest.raises(ValueError, match="elastic bounds"):
+        make_agent(_elastic_config(elastic_min_actors=3))
+
+
+def test_asyncrl_elastic_env_wins(monkeypatch):
+    monkeypatch.setenv("ASYNCRL_ELASTIC", "1")
+    agent = make_agent(_elastic_config(elastic=False))
+    try:
+        assert agent._elastic is not None
+    finally:
+        agent.close()
+    monkeypatch.setenv("ASYNCRL_ELASTIC", "0")
+    agent = make_agent(_elastic_config(elastic=True))
+    try:
+        assert agent._elastic is None
+    finally:
+        agent.close()

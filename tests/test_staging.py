@@ -14,6 +14,7 @@ from asyncrl_tpu.api.sebulba_trainer import _stack_fragments
 from asyncrl_tpu.envs.cartpole import CartPole
 from asyncrl_tpu.models.networks import build_model
 from asyncrl_tpu.rollout.staging import (
+    RingSwapHolder,
     SlabLease,
     StagingRing,
     StaleLeaseError,
@@ -167,6 +168,123 @@ def test_generation_stamp_fences_restarted_actor():
     # Voiding the superseded lease again is a no-op for the new owner.
     ring.void(zombie)
     assert replacement.valid()
+
+
+def test_ring_swap_inflight_lease_finishes_on_old_ring():
+    """Resize semantics (elastic runtime): a lease minted before the swap
+    commits on the OLD ring and its slab batches/retires there; acquires
+    after the swap land on the NEW ring."""
+    old = StagingRing(_template(), rows_per_slab=1, num_slabs=2)
+    holder = RingSwapHolder(old)
+    assert holder.current() is old
+    inflight = holder.acquire()
+    new = StagingRing(_template(), rows_per_slab=1, num_slabs=3)
+    holder.swap(new)
+    assert holder.current() is new and holder.num_slabs == 3
+    # The in-flight lease still belongs to (and completes on) the old ring.
+    assert inflight.ring is old
+    rollout = _fill_and_commit(inflight)
+    assert inflight.valid()
+    batch = old.batch(inflight.slab)
+    np.testing.assert_array_equal(batch.obs, rollout.obs)
+    old.retire(inflight.slab, FakeReady(ready=True))
+    # Post-swap acquisition is the new ring's business.
+    post = holder.acquire()
+    assert post.ring is new
+    _fill_and_commit(post)
+
+
+def test_ring_swap_zombie_on_drained_ring_raises():
+    """Once a retired ring has DRAINED (its lease committed, batched,
+    retired), the next swap's sweep resets it: a stale lease object still
+    referencing it raises StaleLeaseError on every write path, exactly
+    like a voided lease."""
+    ring0 = StagingRing(_template(), rows_per_slab=1, num_slabs=2)
+    holder = RingSwapHolder(ring0)
+    lease = holder.acquire()
+    _fill_and_commit(lease)
+    holder.swap(StagingRing(_template(), rows_per_slab=1, num_slabs=2))
+    assert lease.valid()  # committed row still awaiting the drain
+    ring0.batch(lease.slab)
+    ring0.retire(lease.slab, FakeReady(ready=True))
+    holder.swap(StagingRing(_template(), rows_per_slab=1, num_slabs=2))
+    assert not lease.valid()  # drained ring swept: ring0 was reset
+    with pytest.raises(StaleLeaseError):
+        lease.commit()
+
+
+def test_ring_swap_never_invalidates_a_live_lease():
+    """Code-review pin: back-to-back swaps (two scripted scale events in
+    consecutive windows) must NOT reset a retired ring whose lease is
+    still open — the mid-write actor would crash with StaleLeaseError on
+    a deliberate scale. The busy ring is retained; its lease commits and
+    drains normally, and only then does a later sweep reset the ring."""
+    ring0 = StagingRing(_template(), rows_per_slab=1, num_slabs=2)
+    holder = RingSwapHolder(ring0)
+    inflight = holder.acquire()
+    holder.swap(StagingRing(_template(), rows_per_slab=1, num_slabs=2))
+    holder.swap(StagingRing(_template(), rows_per_slab=1, num_slabs=2))
+    holder.swap(StagingRing(_template(), rows_per_slab=1, num_slabs=2))
+    assert inflight.valid(), "live lease invalidated by a deliberate scale"
+    rollout = _fill_and_commit(inflight)  # the write path still works
+    batch = ring0.batch(inflight.slab)
+    np.testing.assert_array_equal(batch.obs, rollout.obs)
+    ring0.retire(inflight.slab, FakeReady(ready=True))
+    assert not ring0.busy()
+    holder.swap(StagingRing(_template(), rows_per_slab=1, num_slabs=2))
+    assert not inflight.valid()  # drained at last: swept and fenced
+
+
+def test_ring_swap_wakes_blocked_acquirer_onto_new_ring():
+    """An acquire blocked on the exhausted old ring must not lease a row
+    no drain will ever complete: the swap interrupts the wait and the
+    acquirer retries on the new ring."""
+    old = StagingRing(_template(), rows_per_slab=1, num_slabs=2)
+    holder = RingSwapHolder(old)
+    for _ in range(2):  # exhaust: both slabs retired but NOT ready
+        lease = holder.acquire()
+        _fill_and_commit(lease)
+        old.retire(lease.slab, FakeReady(ready=False))
+    got = []
+
+    def blocked():
+        got.append(holder.acquire())
+
+    t = threading.Thread(target=blocked, name="swap-acquirer", daemon=True)
+    t.start()
+    time.sleep(0.15)
+    assert not got, "acquire should be blocked on the exhausted old ring"
+    new = StagingRing(_template(), rows_per_slab=1, num_slabs=2)
+    holder.swap(new)
+    t.join(timeout=5)
+    assert got and got[0] is not None and got[0].ring is new
+
+
+def test_ring_swap_holder_reset_fences_every_live_ring():
+    """Trainer stop(): reset reaches the current AND the retired ring, so
+    no straggler lease on either survives into the next cohort."""
+    ring0 = StagingRing(_template(), rows_per_slab=1, num_slabs=2)
+    holder = RingSwapHolder(ring0)
+    old_lease = holder.acquire()
+    holder.swap(StagingRing(_template(), rows_per_slab=1, num_slabs=2))
+    new_lease = holder.acquire()
+    holder.reset()
+    assert not old_lease.valid() and not new_lease.valid()
+    with pytest.raises(StaleLeaseError):
+        old_lease.commit()
+    with pytest.raises(StaleLeaseError):
+        new_lease.commit()
+    assert all(s.phase == "free" for s in holder.current()._slabs)
+
+
+def test_ring_swap_holder_accumulates_reuse_waits():
+    old = StagingRing(_template(), rows_per_slab=1, num_slabs=2)
+    holder = RingSwapHolder(old)
+    old.reuse_waits = 3
+    holder.swap(StagingRing(_template(), rows_per_slab=1, num_slabs=2))
+    holder.current().reuse_waits = 2
+    assert holder.reuse_waits == 5
+    assert holder.slab_nbytes == old.slab_nbytes
 
 
 def test_reset_invalidates_all_leases():
