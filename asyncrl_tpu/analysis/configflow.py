@@ -59,6 +59,8 @@ KNOWN_ENV_VARS = {
     "ASYNCRL_INTROSPECT",     # obs/introspect.py — training introspection
     "ASYNCRL_INTROSPECT_TOLERANCE",  # scripts/introspect_smoke.sh budget
     "ASYNCRL_ELASTIC",        # api/sebulba_trainer.py — elastic-runtime toggle
+    "ASYNCRL_RESUME",         # runtime/durability.py — crash-consistent resume
+    "ASYNCRL_DRAIN_GRACE_S",  # runtime/durability.py — preemption drain budget
 }
 
 _CONFIG_NAMES = {"config", "cfg"}

@@ -53,6 +53,7 @@ from asyncrl_tpu.rollout.sebulba import (
     make_host_pool,
     make_inference_fn,
 )
+from asyncrl_tpu.runtime import durability
 from asyncrl_tpu.utils import faults
 from asyncrl_tpu.utils.config import Config, default_eval_max_steps
 
@@ -213,15 +214,11 @@ class SebulbaTrainer:
                     "server (serve=True / ASYNCRL_SERVE=1): the legacy "
                     "InferenceServer's client set is fixed-shape"
                 )
-            emax = config.elastic_max_actors or 2 * config.actor_threads
-            if not (
-                config.elastic_min_actors
-                <= config.actor_threads
-                <= emax
-            ):
+            emin, emax = self._elastic_bounds()
+            if not emin <= config.actor_threads <= emax:
                 raise ValueError(
                     f"actor_threads={config.actor_threads} outside the "
-                    f"elastic bounds [{config.elastic_min_actors}, {emax}]"
+                    f"elastic bounds [{emin}, {emax}]"
                 )
         else:
             registry = faults.active()
@@ -293,10 +290,8 @@ class SebulbaTrainer:
                     return health_mod.blame_component(stage)
 
             self._elastic = elastic_mod.ElasticController(
-                min_actors=config.elastic_min_actors,
-                max_actors=(
-                    config.elastic_max_actors or 2 * config.actor_threads
-                ),
+                min_actors=self._elastic_bounds()[0],
+                max_actors=self._elastic_bounds()[1],
                 cooldown_windows=config.elastic_cooldown_windows,
                 up_stall_frac=config.elastic_up_stall_frac,
                 down_backpressure=config.elastic_down_backpressure,
@@ -304,6 +299,43 @@ class SebulbaTrainer:
                 blame_fn=blame_fn,
             )
             self._elastic_barrier = elastic_mod.ReconfigureBarrier(self._ckpt)
+        # Durable runs (asyncrl_tpu/runtime/durability.py): the drain
+        # grace and resume flag resolve ONCE (env wins — the ASYNCRL_SERVE
+        # precedence), and a preempt-kind fault spec is refused when the
+        # drain is disabled: its scripted SIGTERM would hit a process with
+        # no handler and kill it undrained — the one outcome the spec
+        # exists to test against.
+        self._drain_grace = durability.drain_grace(config)
+        self._resume_on = durability.resume_enabled(config)
+        registry = faults.active()
+        if (
+            registry is not None
+            and registry.has_kind("preempt")
+            and self._drain_grace <= 0
+        ):
+            raise ValueError(
+                "fault spec arms a 'preempt' site but the preemption "
+                "drain is disabled (drain_grace_s=0 / "
+                "ASYNCRL_DRAIN_GRACE_S=0): the scripted SIGTERM would "
+                "kill the run undrained instead of testing the drain"
+            )
+        # Automatic divergence rollback (RollbackPolicy): armed by
+        # rollback_bad_windows > 0, which also arms the learner's
+        # device-side NaN-guard. Needs a checkpoint_dir — without retained
+        # steps there is nothing to roll back to.
+        self._rollback = None
+        if config.rollback_bad_windows > 0:
+            if not config.checkpoint_dir:
+                raise ValueError(
+                    "rollback_bad_windows > 0 requires checkpoint_dir: "
+                    "divergence rollback restores the last-good retained "
+                    "checkpoint"
+                )
+            self._rollback = durability.RollbackPolicy(
+                config.rollback_bad_windows, config.rollback_max_attempts
+            )
+        # Cumulative NaN-guard skip count (window key nonfinite_skips).
+        self._nonfinite_skips = 0.0
         # §5.2b debug mode: transport invariants on drained fragments.
         from asyncrl_tpu.utils.debug import sync_debug_enabled
 
@@ -358,6 +390,85 @@ class SebulbaTrainer:
         # only there for recurrent cores).
         self._eval_pools = {}
         self._greedy_fn = None
+        # Crash-consistent resume (runtime/durability.py): checkpoint.setup
+        # above already restored the LEARNER state (the pre-existing
+        # auto-resume); with resume armed the checkpoint's run_state
+        # metadata restores the rest of the run — host counters, the
+        # actor-PRNG cursor, the health monitor's window cursor, the
+        # elastic fleet size (applied when the fleet starts), and the
+        # rollback attempt budget — so every counter is monotone across
+        # the process boundary and timeseries.jsonl continues as a new
+        # segment marked with a resume event.
+        self._resume_fleet: int | None = None
+        run_state = (self._ckpt.restore_meta or {}).get("run_state")
+        if self._resume_on and run_state:
+            self._updates = int(run_state.get("updates", 0))
+            # Staleness-ledger rebase: the restored params ARE version 0
+            # of this process, published at the restored update count —
+            # without this, every resumed fragment would report a lag of
+            # the full pre-preemption update count.
+            self._published_updates = {0: self._updates}
+            self._next_actor_seed = int(
+                run_state.get("next_actor_seed", self._next_actor_seed)
+            )
+            self._actor_restarts = int(run_state.get("actor_restarts", 0))
+            self._server_restarts = int(run_state.get("server_restarts", 0))
+            if self._rollback is not None:
+                self._rollback.attempts = int(
+                    run_state.get("rollback_attempts", 0)
+                )
+            fleet = int(run_state.get("actors_live", config.actor_threads))
+            if self._elastic_on and fleet != config.actor_threads:
+                emin, emax = self._elastic_bounds()
+                self._resume_fleet = max(emin, min(emax, fleet))
+            monitor = self._obs.monitor
+            if monitor is not None:
+                monitor.window_idx = int(run_state.get("window_idx", 0))
+            if self._obs.store is not None:
+                self._obs.store.annotate({
+                    "event_type": "resume",
+                    "restored_update": self._updates,
+                    "env_steps": float(self.env_steps),
+                    "actors": fleet,
+                })
+        # The fleet size the last STOPPED fleet ran at: stop() clears
+        # self._actors before the drain's final save_now (and before the
+        # crash-path finalize), so without this snapshot an elastically
+        # scaled fleet would checkpoint as the CONFIGURED size and resume
+        # at the wrong shape.
+        self._last_live_fleet = self._resume_fleet or config.actor_threads
+        # Every save from here on carries the full run state in its
+        # metadata (TrainerCheckpointing.meta_fn), so ANY retained step —
+        # periodic, elastic-barrier, or the drain's final save — can
+        # resume the whole run.
+        self._ckpt.meta_fn = self._run_state
+
+    def _elastic_bounds(self) -> tuple[int, int]:
+        """The elastic fleet bounds ``[min_actors, max_actors]``
+        (``elastic_max_actors=0`` defaults the max to 2x the configured
+        fleet) — ONE definition shared by the construct-time validation,
+        the live controller, and the resume clamp."""
+        cfg = self.config
+        return (
+            cfg.elastic_min_actors,
+            cfg.elastic_max_actors or 2 * cfg.actor_threads,
+        )
+
+    def _run_state(self) -> dict[str, Any]:
+        """The resume inventory carried by every checkpoint's metadata
+        (see docs/ARCHITECTURE.md "Durable runs & divergence rollback")."""
+        monitor = self._obs.monitor
+        return {
+            "actors_live": len(self._actors) or self._last_live_fleet,
+            "next_actor_seed": self._next_actor_seed,
+            "updates": self._updates,
+            "window_idx": monitor.window_idx if monitor is not None else 0,
+            "rollback_attempts": (
+                self._rollback.attempts if self._rollback is not None else 0
+            ),
+            "actor_restarts": self._actor_restarts,
+            "server_restarts": self._server_restarts,
+        }
 
     def _published(self, state):
         """What actors act under: the params, bundled with the obs-
@@ -942,6 +1053,8 @@ class SebulbaTrainer:
         self._drain_queue()
         for actor in self._actors:
             self._backpressure_base += actor.backpressure
+        if self._actors:
+            self._last_live_fleet = len(self._actors)
         self._actors = []
         if self._server is not None:
             self._server_stop.set()
@@ -953,6 +1066,212 @@ class SebulbaTrainer:
             # a clean ring, and a zombie's late commit raises instead of
             # landing in a recycled row.
             self._staging.reset()
+
+    # ----------------------------------------------------- durable runs
+
+    def _restore_fleet(self) -> None:
+        """Resume path: grow/shrink the just-started fleet to the
+        checkpointed size (one slot at a time through the SAME executors
+        a live scale uses, ring resize included), so a run preempted at
+        an elastically-scaled shape resumes at that shape instead of the
+        configured one."""
+        target = self._resume_fleet
+        if target is None:
+            return
+        self._resume_fleet = None
+        before = len(self._actors)
+        while len(self._actors) != target:
+            step = 1 if len(self._actors) < target else -1
+            new_ring = self._build_staging_ring(len(self._actors) + step)
+            if step > 0:
+                self._scale_up_actor()
+            else:
+                self._scale_down_actor()
+            if new_ring is not None:
+                self._staging.swap(new_ring)
+        flightrec.record(
+            "durability.fleet_restored",
+            detail=f"resume rebuilt the fleet at {target} actors "
+            f"(configured {before})",
+        )
+
+    def _preempt_drain(self, drain) -> None:
+        """The preemption-safe drain (SIGTERM/SIGINT under a grace
+        budget): stop serve admissions, retire the fleet through the
+        existing void/commit path, flush the partial obs window + flight
+        recorder (reason=preempt), make ONE final full-run-state
+        checkpoint durable, then leave with the distinct EXIT_DRAINED
+        code. Runs on the train (window-close) thread; the coordinator's
+        deadline watchdog hard-kills past the grace."""
+        flightrec.record(
+            "supervisor.preempt",
+            detail=f"signal {drain.signum}: draining within "
+            f"{drain.grace_s:.0f}s, then exiting {durability.EXIT_DRAINED}",
+        )
+        server = self._server
+        if server is not None:
+            gate = getattr(server, "slo", None)
+            if gate is not None:
+                # New admissions refuse FIRST, so the actor joins below
+                # never race fresh requests into the dispatch queue.
+                gate.close()
+        # stop() is the existing drain-clean retirement: queued fragments
+        # discard through the §5.2b checker, actors join (or abandon),
+        # every staging lease goes stale, every slab frees.
+        self.stop()
+        # Flush the partial metrics window so the timeseries' final
+        # sample records where the run actually stopped (counters are
+        # cumulative, so a short window is honest, never misleading).
+        agg: dict[str, Any] = {
+            "env_steps": self.env_steps,
+            "drain_preempt": 1.0,
+            "actor_restarts": self._actor_restarts,
+            "server_restarts": self._server_restarts,
+        }
+        agg.update(faults.counters())
+        self._obs.observe_window(agg)
+        if self._ckpt.checkpointer is not None:
+            # The final checkpoint carries the full run state via meta_fn
+            # and must be DURABLE before the exit code promises it.
+            self._ckpt.save_now(self.state, self.env_steps)
+            self._ckpt.checkpointer.wait()
+        self._obs.close()  # flight-recorder queue flushed to disk
+        drain.finish()
+        raise durability.PreemptedExit(drain.signum)
+
+    def _quarantine_poisoned(self, slab_groups, fragments) -> int:
+        """Divergence quarantine: fragments produced under (or poisoned
+        by) a diverging policy must never reach the learner. Queued
+        fragments discard through the §5.2b checker with their slab
+        leases voided (rows re-open under fresh generations — the
+        supervisor-retirement mechanics applied to data instead of
+        threads); partial slab groups and legacy-path stacks clear the
+        same way. Returns the quarantined fragment count."""
+        count = 0
+        try:
+            while True:
+                fragment = self._queue.get_nowait()
+                if self._seq_checker is not None:
+                    self._seq_checker.check(fragment)
+                if fragment.lease is not None and self._staging is not None:
+                    self._staging.void(fragment.lease)
+                count += 1
+        except queue.Empty:
+            pass
+        for group in slab_groups.values():
+            for fragment in group:
+                if fragment.lease is not None and self._staging is not None:
+                    self._staging.void(fragment.lease)
+                count += 1
+        slab_groups.clear()
+        count += len(fragments)
+        fragments.clear()
+        if count:
+            obs_registry.counter("rollback_quarantined").inc(count)
+        return count
+
+    def _execute_rollback(self, action) -> None:
+        """Restore the last-good checkpoint (window-close thread). The
+        tainted steps saved AFTER the last clean window are evicted
+        first, so the fallback restore cannot land on a checkpoint
+        written while the run was already diverging; the actor-PRNG
+        cursor folds so the replayed stretch decorrelates from the
+        trajectory that diverged; the restored params republish
+        immediately so actors stop acting under the poisoned weights."""
+        ckpt = self._ckpt.checkpointer
+        ckpt.wait()
+        steps = sorted(ckpt.all_steps())
+        if not steps:
+            # Rollback fired before the first save landed: there is
+            # nothing to restore, but the NaN-guard already held the
+            # params through every poisoned update, so the run continues
+            # on the held state — record the degraded action instead of
+            # dying on a restore that cannot exist.
+            flightrec.record(
+                "rollback.no_checkpoint",
+                detail="rollback fired with no retained steps; "
+                "continuing on NaN-guard-held params",
+            )
+            return
+        last_good = self._rollback.last_good_step
+        target = None
+        if last_good is not None:
+            good = [s for s in steps if s <= last_good]
+            if good:
+                target = good[-1]
+        if target is None:
+            # The banked last-good step was rotated out by max_to_keep
+            # retention (or no clean window has banked one yet): the
+            # OLDEST retained step is the closest surviving
+            # approximation. Never evict the whole directory hunting for
+            # a step that no longer exists.
+            target = steps[0]
+        for step in steps:
+            if step > target:
+                ckpt.delete_step(step)
+        self.state, self.env_steps = ckpt.restore(self.state)
+        # The run RE-TRAINS from here with fresh data: when it reaches
+        # the restored step number again the save must REPLACE, not
+        # no-op on the idempotent-save rule.
+        ckpt.invalidate_restored()
+        self._next_actor_seed += 104729 * 997  # fresh PRNG fold
+        version = self._store.publish(
+            self._published(self.state), self.env_steps
+        )
+        self._published_updates[version] = self._updates
+
+    def _rollback_step(self, agg, slab_groups, fragments) -> bool:
+        """One RollbackPolicy evaluation at window close (next to the
+        health monitor and the elastic controller, same thread). Returns
+        True when an action fired — the elastic controller skips a
+        window whose signals a divergence just poisoned."""
+        monitor = self._obs.monitor
+        if monitor is not None:
+            events = [
+                e for e in monitor.recent_events()
+                if e.window_idx == monitor.window_idx
+            ]
+        else:
+            # No health layer mounted (trace off, no exposition port):
+            # the policy still sees the one divergence signal the window
+            # dict itself carries — a non-finite loss/grad_norm.
+            events = []
+            for key in ("loss", "grad_norm"):
+                value = agg.get(key)
+                if isinstance(value, float) and not np.isfinite(value):
+                    events.append(
+                        type("E", (), {"detector": "nonfinite_loss"})()
+                    )
+                    break
+        ckpt = self._ckpt.checkpointer
+        latest = ckpt.latest_step() if ckpt is not None else None
+        action = self._rollback.on_window(events, latest)
+        if action is None:
+            return False
+        counter = {
+            "quarantine": "rollback_quarantine",
+            "rollback": "rollback_restores",
+            "abort": "rollback_abort",
+        }[action.kind]
+        obs_registry.counter(counter).inc()
+        flightrec.record(f"rollback.{action.kind}", detail=action.detail)
+        if self._obs.store is not None:
+            self._obs.store.annotate(action.event())
+        if action.kind == "abort":
+            self.stop()
+            raise RuntimeError(
+                f"divergence rollback attempts exhausted: {action.detail}"
+            )
+        quarantined = self._quarantine_poisoned(slab_groups, fragments)
+        print(
+            f"asyncrl_tpu: rollback policy: {action.kind} — "
+            f"{action.detail} ({quarantined} in-flight fragment(s) "
+            "quarantined)",
+            file=sys.stderr,
+        )
+        if action.kind == "rollback":
+            self._execute_rollback(action)
+        return True
 
     # ---------------------------------------------------------------- train
 
@@ -975,7 +1294,32 @@ class SebulbaTrainer:
         # The drain usually runs on MainThread — tag its span ring with
         # the pipeline-stage group so reports/flight dumps say "learner".
         trace.tag_thread("learner")
-        self._start_actors()
+        # Preemption-safe drain (runtime/durability.py): with a grace
+        # budget, SIGTERM/SIGINT route through the coordinator (handlers
+        # install on the main thread only; the scripted `preempt` fault
+        # kind reaches the same coordinator either way) and the loop
+        # polls one Event per iteration — the unarmed cost discipline.
+        drain = None
+        if self._drain_grace > 0:
+            drain = durability.DrainCoordinator(self._drain_grace)
+            drain.install()
+            durability.set_active(drain)
+        try:
+            self._start_actors()
+            self._restore_fleet()
+        # lint: broad-except-ok(cleanup-and-reraise: the drain handlers uninstall, then the startup failure propagates unchanged)
+        except BaseException:
+            # Startup died before the main try/finally below could own
+            # the teardown: the process signal handlers (and the
+            # scripted-preempt registration) must not outlive the train
+            # call that installed them — a later Ctrl-C would request a
+            # drain nothing polls, and the orphaned watchdog would
+            # os._exit the host process 30s later.
+            if drain is not None:
+                drain.finish()
+                drain.uninstall()
+                durability.clear_active(drain)
+            raise
         pending: list[dict[str, jax.Array]] = []
         ret_sum = len_sum = count = lag_sum = 0.0
         window_start = time.perf_counter()
@@ -1004,6 +1348,8 @@ class SebulbaTrainer:
         ring = self._staging
         try:
             while self.env_steps < target:
+                if drain is not None and drain.requested:
+                    self._preempt_drain(drain)  # raises PreemptedExit
                 self._supervise()
                 t_wait = time.perf_counter()
                 try:
@@ -1198,6 +1544,19 @@ class SebulbaTrainer:
                     # infer_coalesce_batch in this same dict.
                     if self._staleness is not None:
                         agg.update(self._staleness.drain())
+                    if "nonfinite_skip" in agg:
+                        # NaN-guard accounting (rollback armed): the
+                        # per-update skip flags fold into ONE cumulative
+                        # counter key; the per-update mean the generic
+                        # aggregation produced would under-read as a
+                        # fraction.
+                        self._nonfinite_skips += float(
+                            sum(
+                                np.sum(m["nonfinite_skip"]) for m in drained
+                            )
+                        )
+                        del agg["nonfinite_skip"]
+                        agg["nonfinite_skips"] = self._nonfinite_skips
                     agg.update(self._infer_coalesce_window())
                     agg.update(faults.counters())
                     ret_sum = len_sum = count = lag_sum = 0.0
@@ -1250,10 +1609,18 @@ class SebulbaTrainer:
                     # on what the window contained. Placed after the
                     # eval so eval_return feeds the regression detector.
                     self._obs.observe_window(agg)
+                    # Divergence rollback: evaluated FIRST at window
+                    # close — a window the divergence poisoned must not
+                    # also drive a fleet-scale decision.
+                    remediated = False
+                    if self._rollback is not None:
+                        remediated = self._rollback_step(
+                            agg, slab_groups, fragments
+                        )
                     # Elastic runtime: the controller reads the SAME
                     # merged window the sinks saw; a decision reconfigures
                     # the fleet here, between updates, on this thread.
-                    if self._elastic is not None:
+                    if self._elastic is not None and not remediated:
                         self._elastic_step(agg)
                     history.append(agg)
                     if callback:
@@ -1262,12 +1629,23 @@ class SebulbaTrainer:
             self.stop()
             # A crash (including the §5.3 actor crash-loop abort) must not
             # lose progress: save final state and flush async writes.
+            # (After a completed preemption drain this re-save no-ops on
+            # the idempotent same-step rule — the drain already made the
+            # final checkpoint durable.)
             self._ckpt.finalize(self.state, self.env_steps)
             # Flush any flight dumps still queued on the writer thread.
             # (The Perfetto export happens ONCE, in close(): exporting
             # per train() call would tax the measured hot path, and
             # crash-time forensics are the flight recorder's job.)
             self._obs.close()
+            if drain is not None:
+                # Disarm the deadline watchdog on EVERY exit path (a
+                # crash racing a signal must not be hard-killed mid-
+                # forensics), restore the previous handlers, and drop the
+                # scripted-preempt registration.
+                drain.finish()
+                drain.uninstall()
+                durability.clear_active(drain)
         return history
 
     def save_checkpoint(self) -> None:

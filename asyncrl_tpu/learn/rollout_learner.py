@@ -313,6 +313,17 @@ class RolloutLearner:
         # sharded over: batch axes always, plus the time axis when the
         # fragment's T dim is sequence-parallel.
         reduce_axes = axes + ((TIME_AXIS,) if time_sharded else ())
+        # Divergence NaN-guard (runtime/durability.py rollback policy):
+        # armed with the policy, a non-finite loss/grad_norm HOLDS the
+        # entire state — params, opt state, target net, normalization
+        # stats, and the update counter — via a device-side select, so a
+        # poisoned update never lands and the guard costs no host sync.
+        # The metrics still report the bad loss (the nonfinite_loss
+        # detector must fire) plus a ``nonfinite_skip`` flag the trainer
+        # accumulates into the cumulative ``nonfinite_skips`` counter.
+        # Off (the default) the select never traces: bit-identical
+        # program to the pre-rollback learner.
+        nan_guard = config.rollback_bad_windows > 0
 
         def update_body(state: LearnerState, rollout: Rollout):
             # Observation normalization (ops/normalize.py): this step's
@@ -411,6 +422,15 @@ class RolloutLearner:
                 obs_stats=obs_stats,
                 ret_stats=ret_stats,
             )
+            if nan_guard:
+                finite = jnp.isfinite(metrics["loss"]) & jnp.isfinite(
+                    metrics["grad_norm"]
+                )
+                new_state = jax.tree.map(
+                    lambda new, old: jnp.where(finite, new, old),
+                    new_state, state,
+                )
+                metrics["nonfinite_skip"] = 1.0 - finite.astype(jnp.float32)
             return new_state, metrics
 
         K = config.updates_per_call

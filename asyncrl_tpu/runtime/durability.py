@@ -1,0 +1,463 @@
+"""Durable runs: preemption-safe drain, crash-consistent resume, rollback.
+
+Everything the runtime learned so far survives faults *inside* the
+process — crashed actors restart, hung servers rebuild, scale events run
+behind a checkpoint barrier — but the process itself was still a single
+point of failure, which is exactly the wrong property on preemptible TPU
+capacity where the platform's SIGTERM is a routine event, not a disaster
+(Laminar, arXiv:2510.12633, treats long-running decoupled fleets as the
+operating regime). And the health layer could *detect* a diverging run
+(nonfinite_loss, grad_explosion, entropy_collapse) but only degrade
+``/healthz`` and dump forensics; IMPACT (arXiv:1912.00167) argues
+off-policy divergence should be contained and recovered, not observed.
+This module closes both loops with three cooperating pieces, wired
+through ``SebulbaTrainer``:
+
+- :class:`DrainCoordinator` — **preemption-safe drain**. A SIGTERM/SIGINT
+  handler (installed around ``train()`` on the main thread; restored on
+  exit) that converts the platform's kill into a graceful shutdown: the
+  train loop stops admitting serve traffic (``SLOGate.close``), drains
+  open staging leases through the existing void/commit path, flushes the
+  partial metrics window and the flight recorder (``reason=preempt``),
+  writes one final checkpoint carrying the FULL run state (params/opt
+  state, env_steps, actor-PRNG cursor, staleness ledger, elastic fleet
+  size, window cursor), and exits with the distinct
+  :data:`EXIT_DRAINED` code — all within ``config.drain_grace_s``. A
+  deadline watchdog hard-kills (:data:`EXIT_DEADLINE`) past the grace,
+  and a second signal hard-kills immediately: the platform's patience is
+  never assumed.
+- **Crash-consistent resume** (``config.resume`` / ``ASYNCRL_RESUME``,
+  env wins): the trainer restores that run state end-to-end — fleet
+  rebuilt at the checkpointed size, the staleness ledger rebased onto
+  the restored update count, the health monitor's window cursor
+  continued (so ``timeseries.jsonl`` appends a new segment whose window
+  indices stay monotone, marked with a ``kind=event`` resume
+  annotation), counters monotone across the boundary. Torn final saves
+  are detected by the checkpoint manifest checksum
+  (``utils/checkpoint.py``) and fall back through older retained steps.
+- :class:`RollbackPolicy` — **automatic divergence rollback**. Evaluated
+  on the window-close thread next to ``HealthMonitor`` and
+  ``ElasticController``, it watches the critical learning-health
+  detectors (:data:`TRIGGER_DETECTORS`). While divergence is live, the
+  learner's device-side NaN-guard (``learn/rollout_learner.py``, armed
+  with the policy) skips every poisoned update, and the policy
+  quarantines the in-flight slab generation (queued fragments void back
+  to the ring — poisoned data never reaches the learner again). After
+  ``config.rollback_bad_windows`` consecutive bad windows it rolls back
+  to the last-good checkpoint via the fallback-restore path with a
+  fresh PRNG fold and a cooldown; attempts are bounded by
+  ``config.rollback_max_attempts``, beyond which the run aborts with
+  forensics — the same hysteresis/cooldown/mutate-last discipline the
+  elastic controller pins.
+
+The chaos grammar grows a ``preempt`` kind (``utils/faults.py``): a
+scripted fire delivers a real SIGTERM through the installed handler (or
+requests the drain directly when ``train()`` runs off the main thread),
+so SIGTERM-under-load joins the fault matrix next to crash/stall/
+corrupt/scale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import sys
+import threading
+from typing import Any, Callable, Sequence
+
+# Distinct exit codes (documented in docs/ARCHITECTURE.md): a supervisor
+# script can tell a completed graceful drain (safe to resume) from a
+# drain that blew its grace budget (resume still works — the periodic
+# checkpoint cadence covered it — but the final window was lost).
+EXIT_DRAINED = 86
+EXIT_DEADLINE = 87
+
+RESUME_ENV_VAR = "ASYNCRL_RESUME"
+GRACE_ENV_VAR = "ASYNCRL_DRAIN_GRACE_S"
+
+# The learning-health detectors whose firing marks a window "bad" for the
+# rollback policy: divergence signals only — a stalled pipeline or an SLO
+# breach is an efficiency problem, never a reason to rewind the weights.
+TRIGGER_DETECTORS = ("nonfinite_loss", "grad_explosion", "entropy_collapse")
+
+# Windows the policy stays quiet after a rollback (deliberately NOT a
+# config field — the public knobs are the trigger count and the attempt
+# bound; the cooldown is policy internals the tests pin, the
+# ElasticController convention). Poisoned in-flight data still
+# quarantines during cooldown; only the bad-window trend freezes.
+COOLDOWN_WINDOWS = 2
+
+
+class PreemptedExit(SystemExit):
+    """Raised out of ``train()`` after a completed preemption drain: the
+    final checkpoint is durable and the process should exit with
+    :data:`EXIT_DRAINED`. A ``SystemExit`` subclass so an unhandled
+    propagation exits the interpreter with the distinct code (no
+    traceback spew on a ROUTINE platform preemption), while harnesses
+    that want to continue in-process catch it explicitly."""
+
+    def __init__(self, signum: int | None = None):
+        super().__init__(EXIT_DRAINED)
+        self.signum = signum
+
+
+def resume_enabled(config: Any) -> bool:
+    """Resume armed? ``ASYNCRL_RESUME`` wins over ``config.resume`` when
+    set — the no-code-change knob, same precedence as ASYNCRL_SERVE."""
+    env = os.environ.get(RESUME_ENV_VAR, "")
+    if env:
+        return env.lower() not in ("0", "false", "no")
+    return bool(getattr(config, "resume", False))
+
+
+def drain_grace(config: Any) -> float:
+    """The drain grace budget, seconds (0 disables the handler).
+    ``ASYNCRL_DRAIN_GRACE_S`` wins when set; a malformed value raises —
+    an operator's preemption config must never silently disable the
+    drain."""
+    env = os.environ.get(GRACE_ENV_VAR, "")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            raise ValueError(
+                f"{GRACE_ENV_VAR}={env!r} is not a number; the drain "
+                "grace must be explicit (0 disables)"
+            ) from None
+    return float(getattr(config, "drain_grace_s", 0.0))
+
+
+class DrainCoordinator:
+    """One ``train()`` call's preemption-drain state machine.
+
+    Lifecycle: constructed at train entry, :meth:`install` replaces the
+    process SIGTERM/SIGINT handlers (main thread only — off the main
+    thread the coordinator still works through :meth:`request`, which is
+    what the scripted ``preempt`` fault kind uses), the train loop polls
+    :attr:`requested` once per iteration (one Event check — the unarmed
+    cost discipline), and the trainer's drain path calls :meth:`finish`
+    once the final checkpoint is durable, then :meth:`uninstall`.
+
+    The FIRST signal requests the drain and starts the deadline
+    watchdog: a daemon thread that hard-kills the process
+    (:data:`EXIT_DEADLINE`) if the drain has not finished within
+    ``grace_s`` — a wedged join must not outlive the platform's kill
+    escalation. A SECOND signal hard-kills immediately: the operator (or
+    the platform) insisting twice is never made to wait.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(
+        self, grace_s: float, exit_fn: Callable[[int], None] = os._exit
+    ):
+        if grace_s <= 0:
+            raise ValueError(f"drain grace must be > 0 to drain: {grace_s}")
+        self.grace_s = float(grace_s)
+        # Injectable for tests: the REAL watchdog must os._exit (a drain
+        # wedged past its grace cannot be trusted to run Python cleanup),
+        # a test's must not take pytest down with it.
+        self._exit = exit_fn
+        self._requested = threading.Event()
+        self._finished = threading.Event()
+        self._lock = threading.Lock()
+        # lint: thread-shared-ok(written once by the first request() before _requested flips; readers only format it into messages after the flip)
+        self.signum: int | None = None
+        # lint: thread-shared-ok(written only by install/uninstall on the main thread; other threads merely read the boolean to pick the signal-vs-direct request route, and either route drains correctly)
+        self.installed = False
+        self._prev: dict[int, Any] = {}
+        self._watchdog: threading.Thread | None = None  # guarded-by: _lock
+
+    @property
+    def requested(self) -> bool:
+        """Has a drain been requested? (Any thread; one Event check.)"""
+        return self._requested.is_set()
+
+    # ---------------------------------------------------------- signals
+
+    def install(self) -> bool:
+        """Install the SIGTERM/SIGINT handlers. Returns False (no-op)
+        off the main thread — ``signal.signal`` is main-thread-only, and
+        a trainer driven from a worker thread still drains through
+        :meth:`request` / the scripted preempt kind."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        for sig in self.SIGNALS:
+            self._prev[sig] = signal.signal(sig, self._handle)
+        self.installed = True
+        return True
+
+    def uninstall(self) -> None:
+        """Restore the previous handlers (train-exit ``finally``)."""
+        if not self.installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):  # interpreter shutting down
+                pass
+        self._prev = {}
+        self.installed = False
+
+    def _handle(self, signum, frame) -> None:
+        del frame
+        if self._requested.is_set():
+            # Second signal while draining: stop being graceful.
+            print(
+                "asyncrl_tpu: second signal during drain; exiting now "
+                f"({EXIT_DEADLINE})",
+                file=sys.stderr,
+            )
+            self._exit(EXIT_DEADLINE)
+            return  # only reachable with an injected exit_fn
+        self.request(signum)
+
+    def request(self, signum: int = signal.SIGTERM, reason: str = "signal") -> None:
+        """Request the drain (any thread; signal-handler reentrant): sets
+        the event the train loop polls and starts the grace-deadline
+        watchdog. Idempotent.
+
+        The requested flag flips FIRST — before any I/O and without
+        holding the (non-reentrant) lock: this frame runs inside the
+        signal handler on the main thread, and a second signal nested
+        between any two of its bytecodes re-enters :meth:`_handle`, which
+        must observe ``requested`` already set and take the hard-kill
+        path instead of re-entering here and deadlocking on a lock its
+        own thread holds. The worst a non-signal race can produce is a
+        duplicate watchdog, and the watchdogs are idempotent (both wait
+        on the same finish event, both fire the same exit)."""
+        if self._requested.is_set():
+            return
+        self.signum = int(signum)
+        self._requested.set()
+        print(
+            f"asyncrl_tpu: drain requested ({reason}, signal "
+            f"{self.signum}); finishing within {self.grace_s:.0f}s",
+            file=sys.stderr,
+        )
+        watchdog = threading.Thread(
+            target=self._deadline,
+            name="drain-watchdog",
+            daemon=True,
+        )
+        with self._lock:
+            self._watchdog = watchdog
+        watchdog.start()
+
+    def _deadline(self) -> None:  # thread-entry: drain-watchdog@learner
+        if self._finished.wait(timeout=self.grace_s):
+            return
+        print(
+            f"asyncrl_tpu: drain exceeded its {self.grace_s:.0f}s grace; "
+            f"hard-killing ({EXIT_DEADLINE}). The periodic checkpoint "
+            "cadence still covers resume; the final window is lost.",
+            file=sys.stderr,
+        )
+        self._exit(EXIT_DEADLINE)
+
+    def finish(self) -> None:
+        """The drain completed (final checkpoint durable): disarm the
+        deadline watchdog. Idempotent; also safe when never requested."""
+        self._finished.set()
+
+
+# ------------------------------------------------------- scripted preempt
+
+# The coordinator the current train() call exposes to the chaos layer
+# (the `preempt` fault kind). One per process at a time, matching the
+# one-train-loop-per-process reality of the host backends.
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: DrainCoordinator | None = None  # guarded-by: _ACTIVE_LOCK
+
+
+def set_active(coordinator: DrainCoordinator) -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = coordinator
+
+
+def clear_active(coordinator: DrainCoordinator) -> None:
+    """Clear only if ``coordinator`` is still the active one — a nested
+    or racing train() must never clear another call's registration."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is coordinator:
+            _ACTIVE = None
+
+
+def active() -> DrainCoordinator | None:
+    with _ACTIVE_LOCK:
+        return _ACTIVE
+
+
+def scripted_preempt() -> bool:
+    """The ``preempt`` fault kind's payload (utils/faults.py): deliver a
+    SIGTERM-under-load to the active drain coordinator. Goes through the
+    REAL signal machinery when the handler is installed (the scripted
+    event and a platform kill exercise the identical path); falls back
+    to a direct request when train() runs off the main thread (no
+    handler to route through). No-op when no coordinator is active —
+    the site fired outside a drain-armed train loop."""
+    coordinator = active()
+    if coordinator is None:
+        return False
+    if coordinator.installed:
+        signal.raise_signal(signal.SIGTERM)
+    else:
+        coordinator.request(signal.SIGTERM, reason="scripted preempt fault")
+    return True
+
+
+# ------------------------------------------------------- rollback policy
+
+
+@dataclasses.dataclass(frozen=True)
+class RollbackAction:
+    """One policy verdict for one bad window.
+
+    ``kind``:
+
+    - ``"quarantine"`` — void the in-flight slab generation (queued
+      fragments were produced under — or poisoned by — the diverging
+      state; they must never reach the learner). Fired on EVERY bad
+      window, including during cooldown.
+    - ``"rollback"`` — restore the last-good checkpoint (fallback
+      restore), rebase the staleness ledger, fold the actor-PRNG
+      cursor, republish. Fired on the ``bad_windows``-th consecutive
+      bad window, at most ``max_attempts`` times.
+    - ``"abort"`` — attempts exhausted; the trainer dumps forensics and
+      raises.
+    """
+
+    kind: str  # "quarantine" | "rollback" | "abort"
+    detail: str
+    detectors: tuple[str, ...] = ()
+    attempts: int = 0
+
+    def event(self) -> dict[str, Any]:
+        """The ``kind=event`` time-series annotation (the rollback twin
+        of a HealthEvent/ScaleDecision dict)."""
+        return {
+            "event_type": "rollback",
+            "action": self.kind,
+            "detail": self.detail,
+            "detectors": list(self.detectors),
+            "attempts": self.attempts,
+        }
+
+
+class RollbackPolicy:
+    """The per-window divergence-remediation policy (see module doc).
+
+    Window-close-thread only (the trainer's drain thread): no internal
+    locking, matching ``HealthMonitor`` and ``ElasticController``. The
+    caller feeds it the window's fresh :class:`HealthEvent` list and the
+    checkpointer's latest retained step; it tracks the last step saved
+    during a HEALTHY window (``last_good_step``) so a rollback never
+    restores a checkpoint written while the run was already diverging —
+    the trainer evicts the tainted newer steps before the fallback
+    restore.
+    """
+
+    def __init__(
+        self,
+        bad_windows: int,
+        max_attempts: int,
+        cooldown_windows: int = COOLDOWN_WINDOWS,
+        triggers: Sequence[str] = TRIGGER_DETECTORS,
+    ):
+        if bad_windows < 1:
+            raise ValueError(
+                f"rollback_bad_windows must be >= 1 to arm: {bad_windows}"
+            )
+        if max_attempts < 1:
+            raise ValueError(
+                f"rollback_max_attempts must be >= 1: {max_attempts}"
+            )
+        if cooldown_windows < 0:
+            raise ValueError(
+                f"cooldown_windows must be >= 0: {cooldown_windows}"
+            )
+        self.bad_windows = bad_windows
+        self.max_attempts = max_attempts
+        self.cooldown_windows = cooldown_windows
+        self.triggers = frozenset(triggers)
+        self.attempts = 0  # lifetime rollbacks (carried across resume)
+        self.last_good_step: int | None = None
+        self._bad_run = 0
+        self._cooldown = 0
+
+    def on_window(
+        self, events: Sequence[Any], latest_step: int | None = None
+    ) -> RollbackAction | None:
+        """Evaluate one closed window. ``events`` are the HealthEvents
+        fired THIS window (not the TTL-decayed verdict set — a window is
+        judged by what happened in it); ``latest_step`` is the
+        checkpointer's newest retained step, recorded as last-good only
+        on a clean window."""
+        fired = sorted(
+            {
+                e.detector
+                for e in events
+                if getattr(e, "detector", None) in self.triggers
+            }
+        )
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            if fired:
+                # Still diverging mid-cooldown: the trend stays frozen
+                # (the restored run needs its cooldown to produce clean
+                # windows before a re-divergence verdict is meaningful),
+                # but poisoned in-flight data quarantines regardless.
+                return RollbackAction(
+                    kind="quarantine",
+                    detail=(
+                        f"divergence signals during rollback cooldown "
+                        f"({self._cooldown + 1} window(s) left): {fired}"
+                    ),
+                    detectors=tuple(fired),
+                    attempts=self.attempts,
+                )
+            return None
+        if not fired:
+            self._bad_run = 0
+            if latest_step is not None:
+                # Clean window: everything retained up to here is good.
+                self.last_good_step = int(latest_step)
+            return None
+        self._bad_run += 1
+        if self._bad_run < self.bad_windows:
+            return RollbackAction(
+                kind="quarantine",
+                detail=(
+                    f"bad window {self._bad_run}/{self.bad_windows}: "
+                    f"{fired} — NaN-guard holds the params, in-flight "
+                    "fragments quarantine"
+                ),
+                detectors=tuple(fired),
+                attempts=self.attempts,
+            )
+        self._bad_run = 0
+        self.attempts += 1
+        if self.attempts > self.max_attempts:
+            return RollbackAction(
+                kind="abort",
+                detail=(
+                    f"divergence persisted through {self.max_attempts} "
+                    f"rollback(s); aborting with forensics: {fired}"
+                ),
+                detectors=tuple(fired),
+                attempts=self.attempts,
+            )
+        self._cooldown = self.cooldown_windows
+        return RollbackAction(
+            kind="rollback",
+            detail=(
+                f"{self.bad_windows} consecutive bad window(s) ({fired}); "
+                f"rolling back to last-good checkpoint "
+                f"(attempt {self.attempts}/{self.max_attempts})"
+            ),
+            detectors=tuple(fired),
+            attempts=self.attempts,
+        )

@@ -95,6 +95,11 @@ class SLOGate:
         self.max_inflight = max_inflight
         self.shed = shed
         self._cond = threading.Condition()
+        # Preemption drain (runtime/durability.py): a closed gate refuses
+        # every admission with ServerClosed — requests already admitted
+        # finish normally, so close() is the clean "stop taking traffic"
+        # edge of the drain protocol.
+        self._closed = False  # guarded-by: _cond
         # Rolling latency window (ms); sorted on demand only when a target
         # is configured — the disabled gate never pays for it.
         self._lat: deque[float] = deque(maxlen=window)  # guarded-by: _cond
@@ -171,6 +176,11 @@ class SLOGate:
         with trace.span(span_names.SERVE_ADMIT_WAIT):
             with self._cond:
                 while True:
+                    if self._closed:
+                        raise ServerClosed(
+                            "serve admission gate closed (preemption "
+                            "drain); no new requests are admitted"
+                        )
                     if stop is not None and stop():
                         raise ServerClosed(
                             "serve core stopped while a request waited at "
@@ -234,6 +244,20 @@ class SLOGate:
             if self._tokens < self._burst:
                 self._tokens += 1.0
             self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop admitting (preemption drain, runtime/durability.py):
+        every waiting and future :meth:`admit` raises ``ServerClosed``;
+        in-flight requests complete and :meth:`finished` normally. One-way
+        — a closed gate belongs to a run that is exiting."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
 
     def abandoned(self) -> None:
         """Un-count an admitted request that never reached dispatch (its

@@ -16,8 +16,12 @@ actor state) without a host-side gather/scatter round-trip.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import re
 import sys
+import threading
 import time
 import traceback
 from typing import Any
@@ -29,6 +33,35 @@ from asyncrl_tpu.utils import faults
 
 STATE_KEY = "state"
 META_KEY = "meta"
+
+
+class ChecksumMismatch(ValueError):
+    """A restored step's content digest disagrees with its manifest: the
+    save was torn or the data corrupted on disk. The latest-step restore
+    treats it like any other per-step failure and falls back through
+    older retained steps; an explicitly requested step surfaces it."""
+
+
+def content_digest(state: Any) -> str:
+    """sha256 over the state pytree's CONTENT (leaf key paths + dtype +
+    shape + bytes, deterministic order). Computed host-side at save time
+    and re-computed over the restored pytree at restore time, so a save
+    torn anywhere between the manifest and the array files — or flipped
+    bits orbax happily deserializes — is detected instead of restored as
+    garbage. (Digest of the addressable data: exact in the single-process
+    host backends this module serves; a multi-host restore would need a
+    per-shard digest.)"""
+    import jax.tree_util as jtu
+    import numpy as np
+
+    h = hashlib.sha256()
+    for path, leaf in jtu.tree_flatten_with_path(state)[0]:
+        arr = np.asarray(leaf)
+        h.update(jtu.keystr(path).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
 
 
 def _abstract_like(tree: Any) -> Any:
@@ -71,6 +104,21 @@ class Checkpointer:
         self._last_saved: int | None = None
         self._restored_step: int | None = None
         self._extra_meta: dict = {}
+        # Metadata of the step the LAST successful restore returned (the
+        # durable-run resume path reads run_state out of it); {} before
+        # any restore.
+        self.last_restore_meta: dict = {}
+        # Manifest writes run on short-lived daemon threads: the content
+        # digest D2H-copies and sha256s every state leaf, which must not
+        # stall the train thread the async-save cadence exists to keep
+        # hot (jax arrays are immutable, so the background read is as
+        # safe as orbax's own async write). wait()/close() join them
+        # before reporting durability, so the drain's final save is
+        # still manifest-covered; a crash that outruns a manifest leaves
+        # a step with no sidecar, which restores unchecked — the
+        # pre-manifest rule, not a failure.
+        self._manifest_lock = threading.Lock()
+        self._manifest_threads: list = []  # guarded-by: _manifest_lock
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -115,6 +163,29 @@ class Checkpointer:
         else:
             self._save_with_retry(step, state, env_steps)
         self._last_saved = step
+        self._prune_manifests(keep=step)
+
+    def _prune_manifests(self, keep: int) -> None:
+        """Drop manifest sidecars whose step is no longer retained.
+        ``delete_step`` removes its own, but orbax's max_to_keep
+        retention GC does not go through it — without this sweep a long
+        run accumulates one stale JSON per checkpoint ever written.
+        Runs on the save thread (the only thread that talks to the
+        manager); a step GC'd between this save and the next stays
+        behind exactly one cadence."""
+        retained = set(self._mngr.all_steps())
+        retained.add(int(keep))
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            match = re.fullmatch(r"manifest-(\d+)\.json", name)
+            if match and int(match.group(1)) not in retained:
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass  # racing writer/prune; the next sweep retries
 
     def _save_with_retry(self, step: int, state: Any, env_steps: int) -> None:
         """Bounded retry with exponential backoff around one save. The
@@ -145,6 +216,22 @@ class Checkpointer:
     def _do_save(self, step: int, state: Any, env_steps: int) -> None:
         meta = {"env_steps": int(env_steps)}
         meta.update(self._extra_meta)
+        # Manifest of the state as handed to orbax, so whatever lands on
+        # disk must hash back to it — a torn save fails the checksum at
+        # restore. Digested on a background thread (see __init__), joined
+        # by wait() before durability is claimed.
+        thread = threading.Thread(
+            target=self._write_manifest,
+            args=(step, state, env_steps),
+            name="manifest-writer",
+            daemon=True,
+        )
+        with self._manifest_lock:
+            self._manifest_threads = [
+                t for t in self._manifest_threads if t.is_alive()
+            ]
+            self._manifest_threads.append(thread)
+        thread.start()
         self._mngr.save(
             int(step),
             args=ocp.args.Composite(
@@ -154,6 +241,38 @@ class Checkpointer:
                 }
             ),
         )
+
+    # ------------------------------------------------------------ manifest
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"manifest-{int(step)}.json")
+
+    def _write_manifest(  # thread-entry: manifest-writer@learner
+        self, step: int, state: Any, env_steps: int
+    ) -> None:
+        """Atomic sidecar write (tmp + rename): a manifest is either the
+        full document or absent, never torn itself."""
+        doc = {
+            "step": int(step),
+            "sha256": content_digest(state),
+            "env_steps": int(env_steps),
+            "t": time.time(),
+        }
+        path = self._manifest_path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    def read_manifest(self, step: int) -> dict | None:
+        """The step's manifest document, or None for a pre-manifest
+        checkpoint (written before checksums existed — accepted as-is,
+        the forward-compat rule)."""
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
 
     def set_extra_meta(self, **kv) -> None:
         """Additional JSON-able metadata carried by subsequent saves (e.g.
@@ -185,14 +304,28 @@ class Checkpointer:
 
     def delete_step(self, step: int) -> None:
         """Remove one retained step (used to evict stale higher-numbered
-        saves that orbax's keep-highest retention would otherwise favor).
-        Flushes in-flight async saves first: deleting a step whose write is
+        saves that orbax's keep-highest retention would otherwise favor,
+        and tainted post-divergence saves on a rollback). Flushes
+        in-flight async saves first: deleting a step whose write is
         still landing leaves partial .orbax-checkpoint-tmp debris."""
         step = int(step)
-        self._mngr.wait_until_finished()
+        self.wait()
         self._mngr.delete(step)
+        try:
+            os.remove(self._manifest_path(step))
+        except OSError:
+            pass  # pre-manifest step
         if step == self._last_saved:
             self._last_saved = None
+
+    def invalidate_restored(self) -> None:
+        """Forget the restored-step identity. After a divergence rollback
+        the run RE-TRAINS from the restored step with a fresh PRNG fold,
+        so when it reaches that step number again the state is NOT
+        bit-identical to the retained copy — the idempotent-save rule
+        (``save`` no-ops on ``_restored_step``) must not keep the stale
+        content; the cross-run-collision path replaces it instead."""
+        self._restored_step = None
 
     def restore(self, state_like: Any, step: int | None = None):
         """Restore ``(state, env_steps)``.
@@ -248,6 +381,7 @@ class Checkpointer:
         fault = faults.site("checkpoint.restore")
         if fault is not None:
             fault.fire()
+        grafted = False
         try:
             restored = self._mngr.restore(
                 int(step),
@@ -265,14 +399,35 @@ class Checkpointer:
             if "tree structures do not match" not in str(strict_err):
                 raise
             state = self._restore_by_path(state_like, int(step), strict_err)
+            grafted = True
             restored = self._mngr.restore(
                 int(step),
                 args=ocp.args.Composite(
                     **{META_KEY: ocp.args.JsonRestore()}
                 ),
             )
+        # Checksum gate: the restored content must hash back to the
+        # manifest written at save time — a torn final save (preemption
+        # racing the writer) or bit rot orbax deserializes without
+        # complaint raises here and the latest-step fallback skips to an
+        # older retained step. The graft path is exempt: it deliberately
+        # fills NEW optional fields with init values, so its digest can
+        # never match the old structure's manifest (per-leaf presence was
+        # already validated leaf by leaf). Pre-manifest steps pass.
+        if not grafted:
+            manifest = self.read_manifest(int(step))
+            if manifest is not None:
+                digest = content_digest(state)
+                if digest != manifest.get("sha256"):
+                    raise ChecksumMismatch(
+                        f"checkpoint step {step} failed its manifest "
+                        f"checksum (saved {manifest.get('sha256', '?')[:12]}"
+                        f"..., restored {digest[:12]}...): torn or "
+                        "corrupted save"
+                    )
         meta = restored[META_KEY] or {}
         self._restored_step = int(step)
+        self.last_restore_meta = meta
         return state, int(meta.get("env_steps", 0))
 
     def _restore_by_path(self, state_like: Any, step: int, strict_err):
@@ -333,11 +488,16 @@ class Checkpointer:
     # ------------------------------------------------------------- lifecycle
 
     def wait(self) -> None:
-        """Block until all pending async saves are durable."""
+        """Block until all pending async saves — manifest sidecars
+        included — are durable."""
         self._mngr.wait_until_finished()
+        with self._manifest_lock:
+            pending = list(self._manifest_threads)
+        for thread in pending:
+            thread.join(timeout=60.0)
 
     def close(self) -> None:
-        self._mngr.wait_until_finished()
+        self.wait()
         self._mngr.close()
 
     def __enter__(self):
@@ -387,12 +547,23 @@ class TrainerCheckpointing:
         self._best_dir = best_dir
         self._best: "Checkpointer | None" = None
         self._best_score: float | None = None
+        # Durable-run hooks (runtime/durability.py): ``meta_fn`` — when a
+        # trainer sets it — is called before EVERY save and its dict
+        # rides the checkpoint metadata as ``run_state`` (fleet size,
+        # staleness ledger, PRNG cursor, window cursor), so any retained
+        # step can resume the whole run, not just the learner state.
+        # ``restore_meta`` is the metadata of the step ``setup`` restored
+        # from ({} when training started fresh).
+        self.meta_fn = None
+        self.restore_meta: dict = {}
 
     def save_now(self, state: Any, env_steps: int) -> None:
         if self.checkpointer is None:
             raise RuntimeError(
                 "no checkpoint_dir configured; set config.checkpoint_dir"
             )
+        if self.meta_fn is not None:
+            self.checkpointer.set_extra_meta(run_state=self.meta_fn())
         self.checkpointer.save(_step_of(state), state, env_steps)
 
     def after_update(self, state: Any, env_steps: int) -> None:
@@ -565,15 +736,19 @@ def setup(config, restore: str | None, state):
             "save) and eval_every > 0 (a score to rank by)"
         )
     env_steps = 0
+    restore_meta: dict = {}
     if restore is not None:
         with Checkpointer(restore, create=False) as src:
             if src.latest_step() is None:
                 raise FileNotFoundError(f"no checkpoint under {restore!r}")
             _check_config_compat(src.read_meta().get("config"), config)
             state, env_steps = src.restore(state)
+            restore_meta = src.last_restore_meta
 
     if not config.checkpoint_dir:
-        return TrainerCheckpointing(None, 0), state, env_steps
+        hook = TrainerCheckpointing(None, 0)
+        hook.restore_meta = restore_meta
+        return hook, state, env_steps
 
     ckpt = Checkpointer(config.checkpoint_dir)
     # Every save from this run carries the full config snapshot, so the
@@ -582,6 +757,7 @@ def setup(config, restore: str | None, state):
     if restore is None and ckpt.latest_step() is not None:
         _check_config_compat(ckpt.read_meta().get("config"), config)
         state, env_steps = ckpt.restore(state)
+        restore_meta = ckpt.last_restore_meta
     elif restore is not None and ckpt.latest_step() is not None:
         # Explicit restore into a dir that already has history: refuse if
         # that history runs AHEAD of the restored state — otherwise a later
@@ -620,8 +796,6 @@ def setup(config, restore: str | None, state):
             "otherwise gate this run's best saves.",
             file=sys.stderr,
         )
-    return (
-        TrainerCheckpointing(ckpt, config.checkpoint_every, best_dir),
-        state,
-        env_steps,
-    )
+    hook = TrainerCheckpointing(ckpt, config.checkpoint_every, best_dir)
+    hook.restore_meta = restore_meta
+    return hook, state, env_steps

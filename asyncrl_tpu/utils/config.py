@@ -266,6 +266,44 @@ class Config:
     # armed-but-quiet (the elastic_smoke.sh discipline).
     elastic_down_admission: float = 1.0
 
+    # --- durable runs (asyncrl_tpu/runtime/durability.py; host backends) ---
+    # Preemption-safe drain grace budget, seconds: with > 0, train()
+    # installs SIGTERM/SIGINT handlers (main thread only; restored on
+    # exit) that convert a platform kill into a graceful drain — serve
+    # admissions close, staging leases drain through the void/commit
+    # path, the partial metrics window and flight recorder flush
+    # (reason=preempt), and ONE final checkpoint carrying the full run
+    # state lands — then the process exits with the distinct
+    # EXIT_DRAINED code. A deadline watchdog hard-kills past the grace
+    # (EXIT_DEADLINE); a second signal hard-kills immediately. 0
+    # disables the handler (the legacy KeyboardInterrupt path).
+    # ASYNCRL_DRAIN_GRACE_S wins when set.
+    drain_grace_s: float = 30.0
+    # Crash-consistent resume: restore the FULL run state recorded in the
+    # checkpoint metadata (elastic fleet size, staleness ledger rebased
+    # onto the restored update count, actor-PRNG cursor, health-monitor
+    # window cursor) on top of the learner-state auto-resume that
+    # checkpoint_dir already provides — counters stay monotone across
+    # the boundary and timeseries.jsonl appends a new marked segment.
+    # ASYNCRL_RESUME wins when set.
+    resume: bool = False
+    # Automatic divergence rollback: with > 0, a RollbackPolicy evaluated
+    # at each window close (next to the health detectors) reacts to the
+    # critical learning-health events (nonfinite_loss, grad_explosion,
+    # entropy_collapse): the learner's device-side NaN-guard skips every
+    # poisoned update (params/opt state/stats hold; the nonfinite_skips
+    # metric counts), in-flight fragments quarantine back to the staging
+    # ring, and after this many CONSECUTIVE bad windows the run rolls
+    # back to the last-good checkpoint (fallback restore, fresh PRNG
+    # fold, cooldown). 0 disables (the default — bit-identical to the
+    # pre-rollback program). Requires checkpoint_dir (something to roll
+    # back to).
+    rollback_bad_windows: int = 0
+    # Bound on rollbacks per run: one more bad streak past this many
+    # restores aborts with forensics instead of looping forever on a
+    # run that re-diverges deterministically.
+    rollback_max_attempts: int = 2
+
     # --- fault tolerance (host backends; utils/faults.py) ---
     # Heartbeat watchdog: an actor thread or the inference server whose
     # progress stamp is older than this many seconds is declared hung and

@@ -77,7 +77,7 @@ SITES = (
     "checkpoint.restore",
 )
 
-KINDS = ("crash", "stall", "corrupt", "scale")
+KINDS = ("crash", "stall", "corrupt", "scale", "preempt")
 
 ENV_VAR = "ASYNCRL_FAULTS"
 
@@ -238,6 +238,19 @@ class FaultSite:
             return payload
         if self.kind == "scale":
             request_scale(self.delta)
+            return payload
+        if self.kind == "preempt":
+            # Scripted SIGTERM-under-load: delivered through the REAL
+            # signal machinery when train()'s drain handler is installed
+            # (so the scripted event and a platform kill exercise the
+            # identical path); a no-op when no drain coordinator is
+            # active — the trainer refuses preempt-kind specs when the
+            # drain is disabled, so silence here can only mean the site
+            # fired outside a train loop. Lazy import: durability sits
+            # above faults in the layering.
+            from asyncrl_tpu.runtime import durability
+
+            durability.scripted_preempt()
             return payload
         # corrupt
         return _corrupt(payload)

@@ -205,6 +205,41 @@ QUICK: dict[str, object] = {
         "test_elastic_validation_refuses_bad_compositions",
         "test_asyncrl_elastic_env_wins",
     },
+    # Durable runs (asyncrl_tpu/runtime/durability.py, ISSUE 10): the
+    # policy/coordinator/checksum/gate units are seconds combined (the
+    # watchdog tests sleep ~1s total); the scripted-preempt → resume e2e
+    # (~26s) and the quarantine→rollback→recovery e2e (~20s) are the
+    # acceptance contract and stay on the quick signal. The
+    # drain-under-elastic resume and the bounded-attempts abort e2e
+    # (~30s each) stay in the full tier.
+    "test_durability.py": {
+        "test_policy_quarantines_until_threshold_then_rolls_back",
+        "test_policy_clean_window_resets_trend_and_records_last_good",
+        "test_policy_cooldown_freezes_trend_but_still_quarantines",
+        "test_policy_aborts_after_max_attempts",
+        "test_policy_ignores_non_trigger_detectors",
+        "test_policy_validation",
+        "test_drain_deadline_watchdog_hard_kills",
+        "test_drain_finish_disarms_the_watchdog",
+        "test_drain_request_is_idempotent",
+        "test_second_signal_hard_kills_immediately",
+        "test_install_off_main_thread_is_a_noop",
+        "test_scripted_preempt_requires_an_active_coordinator",
+        "test_grace_validation_and_env_precedence",
+        "test_corrupt_latest_checksum_falls_back_to_older_step",
+        "test_corrupt_latest_data_falls_back_to_older_step",
+        "test_pre_manifest_checkpoint_restores_without_checksum",
+        "test_delete_step_removes_the_manifest_sidecar",
+        "test_retention_gc_orphaned_manifests_are_pruned",
+        "test_rollback_with_rotated_out_last_good_keeps_oldest",
+        "test_rollback_with_no_retained_steps_is_a_noop",
+        "test_slo_gate_close_refuses_new_admissions",
+        "test_slo_gate_close_wakes_a_waiting_admitter",
+        "test_preempt_spec_refused_when_drain_disabled",
+        "test_rollback_requires_checkpoint_dir",
+        "test_preempt_drain_then_resume_continues_the_run",
+        "test_divergence_quarantines_then_rolls_back_and_recovers",
+    },
     # overlap_h2d on/off A/B: identical losses + not-slower (~25s).
     "test_perf_smoke.py": "all",
     "test_ppo_multipass.py": {

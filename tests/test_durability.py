@@ -1,0 +1,579 @@
+"""Durable runs (asyncrl_tpu/runtime/durability.py): drain-coordinator
+and rollback-policy units, checkpoint manifest checksums (torn-save
+detection + fallback), the SLOGate close edge, the ``preempt`` chaos
+kind, and the end-to-end paths — preemption drain → crash-consistent
+resume (including under an elastically scaled fleet) and the divergence
+matrix (NaN-guard skip, quarantine, rollback after N windows,
+bounded-attempts abort)."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.runtime import durability
+from asyncrl_tpu.runtime.durability import (
+    EXIT_DEADLINE,
+    DrainCoordinator,
+    PreemptedExit,
+    RollbackPolicy,
+)
+from asyncrl_tpu.serve.slo import SLOGate
+from asyncrl_tpu.rollout.inference_server import ServerClosed
+from asyncrl_tpu.utils import faults
+from asyncrl_tpu.utils.checkpoint import Checkpointer, ChecksumMismatch
+from asyncrl_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """No test's armed fault registry may leak into the next."""
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------- policy units
+
+
+class _Event:
+    def __init__(self, detector):
+        self.detector = detector
+
+
+def _bad(*detectors):
+    return [_Event(d) for d in (detectors or ("nonfinite_loss",))]
+
+
+def test_policy_quarantines_until_threshold_then_rolls_back():
+    p = RollbackPolicy(bad_windows=2, max_attempts=2)
+    a1 = p.on_window(_bad(), latest_step=10)
+    assert a1 is not None and a1.kind == "quarantine"
+    assert "1/2" in a1.detail and a1.detectors == ("nonfinite_loss",)
+    a2 = p.on_window(_bad(), latest_step=12)
+    assert a2 is not None and a2.kind == "rollback" and a2.attempts == 1
+    # A checkpoint retained during a BAD window never becomes last-good.
+    assert p.last_good_step is None
+
+
+def test_policy_clean_window_resets_trend_and_records_last_good():
+    p = RollbackPolicy(bad_windows=2, max_attempts=2)
+    assert p.on_window([], latest_step=4) is None
+    assert p.last_good_step == 4
+    assert p.on_window(_bad(), latest_step=6).kind == "quarantine"
+    assert p.on_window([], latest_step=8) is None  # trend broken
+    assert p.last_good_step == 8
+    # Non-consecutive bad windows never escalate to a rollback.
+    assert p.on_window(_bad(), latest_step=10).kind == "quarantine"
+    assert p.attempts == 0
+
+
+def test_policy_cooldown_freezes_trend_but_still_quarantines():
+    p = RollbackPolicy(bad_windows=1, max_attempts=3, cooldown_windows=2)
+    assert p.on_window(_bad()).kind == "rollback"
+    # Two cooldown windows: still-diverging data quarantines, but the
+    # bad-window trend is frozen — no second rollback inside cooldown.
+    c1 = p.on_window(_bad())
+    assert c1.kind == "quarantine" and "cooldown" in c1.detail
+    c2 = p.on_window(_bad())
+    assert c2.kind == "quarantine"
+    # Cooldown over: the next bad window escalates again.
+    assert p.on_window(_bad()).kind == "rollback"
+    assert p.attempts == 2
+
+
+def test_policy_aborts_after_max_attempts():
+    p = RollbackPolicy(bad_windows=1, max_attempts=1, cooldown_windows=0)
+    assert p.on_window(_bad()).kind == "rollback"
+    a = p.on_window(_bad())
+    assert a.kind == "abort" and "aborting" in a.detail
+    assert a.attempts == 2
+    event = a.event()
+    assert event["event_type"] == "rollback" and event["action"] == "abort"
+
+
+def test_policy_ignores_non_trigger_detectors():
+    p = RollbackPolicy(bad_windows=1, max_attempts=1)
+    stall = [_Event("learner_stall"), _Event("fps_collapse")]
+    assert p.on_window(stall, latest_step=2) is None
+    assert p.last_good_step == 2  # an efficiency-noisy window is CLEAN
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="bad_windows"):
+        RollbackPolicy(bad_windows=0, max_attempts=1)
+    with pytest.raises(ValueError, match="max_attempts"):
+        RollbackPolicy(bad_windows=1, max_attempts=0)
+    with pytest.raises(ValueError, match="cooldown"):
+        RollbackPolicy(bad_windows=1, max_attempts=1, cooldown_windows=-1)
+
+
+# ------------------------------------------------- drain coordinator units
+
+
+class _ExitRecorder:
+    def __init__(self):
+        self.codes = []
+
+    def __call__(self, code):
+        self.codes.append(code)
+
+
+def test_drain_deadline_watchdog_hard_kills():
+    rec = _ExitRecorder()
+    c = DrainCoordinator(grace_s=0.15, exit_fn=rec)
+    c.request(reason="test")
+    time.sleep(0.5)
+    assert rec.codes == [EXIT_DEADLINE]
+
+
+def test_drain_finish_disarms_the_watchdog():
+    rec = _ExitRecorder()
+    c = DrainCoordinator(grace_s=0.15, exit_fn=rec)
+    c.request(reason="test")
+    c.finish()
+    time.sleep(0.4)
+    assert rec.codes == []
+
+
+def test_drain_request_is_idempotent():
+    rec = _ExitRecorder()
+    c = DrainCoordinator(grace_s=30.0, exit_fn=rec)
+    c.request(reason="first")
+    wd = c._watchdog
+    c.request(reason="second")  # no second watchdog
+    assert c._watchdog is wd and c.requested
+    c.finish()
+
+
+def test_second_signal_hard_kills_immediately():
+    rec = _ExitRecorder()
+    c = DrainCoordinator(grace_s=30.0, exit_fn=rec)
+    c._handle(15, None)  # first: requests the drain
+    assert c.requested and rec.codes == []
+    c._handle(15, None)  # second: the operator insisted
+    assert rec.codes == [EXIT_DEADLINE]
+    c.finish()
+
+
+def test_install_off_main_thread_is_a_noop():
+    c = DrainCoordinator(grace_s=1.0, exit_fn=_ExitRecorder())
+    result = {}
+    t = threading.Thread(target=lambda: result.update(r=c.install()))
+    t.start()
+    t.join()
+    assert result["r"] is False and not c.installed
+
+
+def test_scripted_preempt_requires_an_active_coordinator():
+    assert durability.scripted_preempt() is False
+    c = DrainCoordinator(grace_s=5.0, exit_fn=_ExitRecorder())
+    durability.set_active(c)
+    try:
+        # Not installed (no handler): falls back to the direct request.
+        assert durability.scripted_preempt() is True
+        assert c.requested
+        c.finish()
+    finally:
+        durability.clear_active(c)
+    assert durability.active() is None
+
+
+def test_grace_validation_and_env_precedence(monkeypatch):
+    with pytest.raises(ValueError):
+        DrainCoordinator(grace_s=0.0)
+    cfg = Config(env_id="CartPole-v1", algo="impala", num_envs=8,
+                 unroll_len=8, drain_grace_s=7.0, resume=False)
+    assert durability.drain_grace(cfg) == 7.0
+    monkeypatch.setenv("ASYNCRL_DRAIN_GRACE_S", "3.5")
+    assert durability.drain_grace(cfg) == 3.5
+    monkeypatch.setenv("ASYNCRL_DRAIN_GRACE_S", "soon")
+    with pytest.raises(ValueError, match="ASYNCRL_DRAIN_GRACE_S"):
+        durability.drain_grace(cfg)
+    assert durability.resume_enabled(cfg) is False
+    monkeypatch.setenv("ASYNCRL_RESUME", "1")
+    assert durability.resume_enabled(cfg) is True
+    monkeypatch.setenv("ASYNCRL_RESUME", "false")
+    cfg2 = Config(env_id="CartPole-v1", algo="impala", num_envs=8,
+                  unroll_len=8, resume=True)
+    assert durability.resume_enabled(cfg2) is False  # env wins
+
+
+# --------------------------------------------------- manifest checksums
+
+
+def _save_two_steps(tmp_path):
+    d = str(tmp_path / "ck")
+    s1 = {"w": jnp.arange(8, dtype=jnp.float32), "step": jnp.asarray(2)}
+    s2 = {"w": jnp.arange(8, dtype=jnp.float32) * 3, "step": jnp.asarray(4)}
+    with Checkpointer(d) as ck:
+        ck.save(2, s1, 100)
+        ck.wait()
+        ck.save(4, s2, 200)
+        ck.wait()
+    return d
+
+
+def test_corrupt_latest_checksum_falls_back_to_older_step(tmp_path):
+    """The torn-save scenario the manifest exists for: step 4's on-disk
+    content no longer hashes to its manifest (simulated by rewriting the
+    manifest digest — value-level corruption orbax deserializes without
+    complaint). The explicit restore surfaces ChecksumMismatch; the
+    latest-step auto-resume falls back to retained step 2."""
+    d = _save_two_steps(tmp_path)
+    manifest_path = os.path.join(d, "manifest-4.json")
+    with open(manifest_path) as f:
+        doc = json.load(f)
+    doc["sha256"] = "0" * 64
+    with open(manifest_path, "w") as f:
+        json.dump(doc, f)
+
+    template = {"w": jnp.zeros(8, jnp.float32), "step": jnp.asarray(0)}
+    with Checkpointer(d, create=False) as ck:
+        with pytest.raises(ChecksumMismatch, match="step 4"):
+            ck.restore(template, step=4)
+        state, env_steps = ck.restore(template)  # latest: falls back
+    assert env_steps == 100
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.arange(8))
+
+
+def test_corrupt_latest_data_falls_back_to_older_step(tmp_path):
+    """Physically damaged chunk bytes (the pre-manifest truncation
+    fallback, extended): restore skips the unreadable latest step."""
+    d = _save_two_steps(tmp_path)
+    chunks = glob.glob(os.path.join(d, "4", "state", "d", "*"))
+    assert chunks
+    for path in chunks:
+        with open(path, "r+b") as f:
+            data = bytearray(f.read())
+            data[len(data) // 2] ^= 0xFF
+            f.seek(0)
+            f.write(data)
+    template = {"w": jnp.zeros(8, jnp.float32), "step": jnp.asarray(0)}
+    with Checkpointer(d, create=False) as ck:
+        state, env_steps = ck.restore(template)
+    assert env_steps == 100
+
+
+def test_pre_manifest_checkpoint_restores_without_checksum(tmp_path):
+    """Forward-compat: a checkpoint written before manifests existed has
+    no sidecar and restores as-is."""
+    d = _save_two_steps(tmp_path)
+    os.remove(os.path.join(d, "manifest-4.json"))
+    template = {"w": jnp.zeros(8, jnp.float32), "step": jnp.asarray(0)}
+    with Checkpointer(d, create=False) as ck:
+        state, env_steps = ck.restore(template)
+    assert env_steps == 200
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.arange(8) * 3)
+
+
+def test_retention_gc_orphaned_manifests_are_pruned(tmp_path):
+    """Code-review pin: orbax's max_to_keep GC does not go through
+    delete_step, so its evictions leave manifest sidecars behind —
+    save-time pruning sweeps them instead of letting a long run
+    accumulate one stale JSON per checkpoint ever written."""
+    d = str(tmp_path / "ck")
+    with Checkpointer(d, max_to_keep=2) as ck:
+        for step in (1, 2, 3, 4):
+            state = {"w": jnp.full(4, float(step))}
+            ck.save(step, state, step * 10)
+            ck.wait()
+        ck._prune_manifests(keep=4)
+        retained = set(ck.all_steps())
+        on_disk = {
+            int(f.split("-")[1].split(".")[0])
+            for f in os.listdir(d)
+            if f.startswith("manifest-") and f.endswith(".json")
+        }
+    assert len(retained) == 2
+    assert on_disk == retained
+
+
+def test_delete_step_removes_the_manifest_sidecar(tmp_path):
+    d = _save_two_steps(tmp_path)
+    with Checkpointer(d, create=False) as ck:
+        ck.delete_step(4)
+    assert not os.path.exists(os.path.join(d, "manifest-4.json"))
+    assert os.path.exists(os.path.join(d, "manifest-2.json"))
+
+
+# --------------------------------------------------------- SLOGate close
+
+
+def test_slo_gate_close_refuses_new_admissions():
+    gate = SLOGate(max_inflight=2)
+    gate.admit()  # in-flight before the drain
+    gate.close()
+    assert gate.closed
+    with pytest.raises(ServerClosed, match="drain"):
+        gate.admit()
+    gate.finished(1.0)  # the admitted request still completes normally
+
+
+def test_slo_gate_close_wakes_a_waiting_admitter():
+    gate = SLOGate(max_inflight=1)
+    gate.admit()  # fills the cap; the next admit blocks
+    err = {}
+
+    def waiter():
+        try:
+            gate.admit(timeout_s=10.0)
+        except ServerClosed as e:
+            err["e"] = e
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    gate.close()
+    t.join(timeout=5.0)
+    assert not t.is_alive() and "e" in err
+
+
+# ----------------------------------------------------------- validation
+
+
+def _sebulba_cfg(**kw):
+    base = dict(
+        env_id="CartPole-v1", algo="a3c", backend="sebulba",
+        host_pool="jax", num_envs=16, actor_threads=2, unroll_len=4,
+        precision="f32", log_every=2,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _steps(cfg, updates):
+    return cfg.num_envs * cfg.unroll_len * updates
+
+
+def test_preempt_spec_refused_when_drain_disabled():
+    with pytest.raises(ValueError, match="preempt"):
+        make_agent(_sebulba_cfg(
+            drain_grace_s=0.0,
+            fault_spec="actor.step:preempt:1.0:0:max=1",
+        ))
+
+
+def test_rollback_requires_checkpoint_dir():
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        make_agent(_sebulba_cfg(rollback_bad_windows=2))
+
+
+# -------------------------------------------------- e2e: drain + resume
+
+
+@pytest.mark.chaos
+def test_preempt_drain_then_resume_continues_the_run(tmp_path):
+    """The resume-determinism pin: a scripted SIGTERM-under-load drains
+    mid-run (PreemptedExit, final checkpoint carrying run_state), and a
+    resume=True successor restores the counters and finishes the SAME
+    target — update count monotone across the boundary, timeseries
+    window indices continuing (not restarting at 0), a kind=event resume
+    marker in the store, finite losses throughout."""
+    run_dir = str(tmp_path / "run")
+    target = _steps(_sebulba_cfg(), updates=24)
+    cfg = _sebulba_cfg(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        run_dir=run_dir, obs_http_port=-1,
+        health_stall_frac=1.0, health_fps_collapse=0.0,
+        fault_spec="actor.queue_put:preempt:1.0:0:max=1,after=16",
+    )
+    agent = make_agent(cfg)
+    try:
+        with pytest.raises(PreemptedExit):
+            agent.train(total_env_steps=target)
+        updates_at_drain = agent._updates
+        assert updates_at_drain > 0
+        assert agent.env_steps < target  # genuinely interrupted
+    finally:
+        agent.close()
+
+    cfg2 = _sebulba_cfg(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        run_dir=run_dir, obs_http_port=-1,
+        health_stall_frac=1.0, health_fps_collapse=0.0,
+        resume=True,
+    )
+    agent2 = make_agent(cfg2)
+    try:
+        run_state = agent2._ckpt.restore_meta.get("run_state")
+        assert run_state is not None, "final checkpoint carried no run_state"
+        assert agent2._updates == run_state["updates"] > 0
+        history = agent2.train(total_env_steps=target)
+        assert agent2.env_steps >= target
+        assert agent2._updates > updates_at_drain  # monotone across boundary
+        assert all(np.isfinite(h["loss"]) for h in history)
+        assert agent2._obs.monitor.verdict()["status"] == "ok"
+    finally:
+        agent2.close()
+
+    # The timeseries continued as ONE logical series: a second segment
+    # (meta line) appended — never truncated — opening with the resume
+    # marker, env_steps monotone across the boundary, and the drain's
+    # final partial-window flush stamped drain_preempt.
+    metas, resumes, preempt_flushes, env_steps_series = 0, 0, 0, []
+    with open(os.path.join(run_dir, "timeseries.jsonl")) as f:
+        for line in f:
+            doc = json.loads(line)
+            if doc.get("kind") == "meta":
+                metas += 1
+            elif doc.get("kind") == "sample":
+                window = doc["window"]
+                env_steps_series.append(window.get("env_steps", 0.0))
+                if window.get("drain_preempt"):
+                    preempt_flushes += 1
+            elif (doc.get("kind") == "event"
+                    and doc.get("event", {}).get("event_type") == "resume"):
+                resumes += 1
+    assert metas == 2 and resumes == 1 and preempt_flushes == 1
+    assert env_steps_series == sorted(env_steps_series), (
+        "env_steps regressed across the resume boundary"
+    )
+
+
+@pytest.mark.chaos
+def test_drain_under_elastic_resume_restores_scaled_fleet(tmp_path):
+    """A run preempted at an elastically scaled shape resumes AT that
+    shape: scale-up to 3 actors, preempt, resume → the fleet rebuilds at
+    3 (not the configured 2) before training continues."""
+    cfg = _sebulba_cfg(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        elastic=True, elastic_max_actors=4,
+        elastic_up_stall_frac=1.0, elastic_down_backpressure=0.0,
+        elastic_down_admission=0.0,
+        fault_spec=(
+            "actor.step:scale:1.0:0:delta=1,max=1;"
+            "actor.queue_put:preempt:1.0:0:max=1,after=40"
+        ),
+    )
+    target = _steps(cfg, updates=40)
+    agent = make_agent(cfg)
+    try:
+        with pytest.raises(PreemptedExit):
+            agent.train(total_env_steps=target)
+    finally:
+        agent.close()
+
+    cfg2 = _sebulba_cfg(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        elastic=True, elastic_max_actors=4,
+        elastic_up_stall_frac=1.0, elastic_down_backpressure=0.0,
+        elastic_down_admission=0.0,
+        resume=True,
+    )
+    agent2 = make_agent(cfg2)
+    fleets = []
+    try:
+        agent2.train(
+            total_env_steps=target,
+            callback=lambda w: fleets.append(len(agent2._actors)),
+        )
+        assert fleets and fleets[0] == 3, (
+            f"resume did not restore the scaled fleet: {fleets[:4]}"
+        )
+    finally:
+        agent2.close()
+
+
+# ------------------------------------------------- e2e: rollback matrix
+
+
+@pytest.mark.chaos
+def test_divergence_quarantines_then_rolls_back_and_recovers(tmp_path):
+    """The rollback matrix in one live run: clean windows bank a
+    last-good checkpoint, a corrupt burst NaN-poisons the learner (the
+    device-side guard skips those updates — nonfinite_skips counts, the
+    params hold), bad window 1 quarantines, bad window 2 restores the
+    last-good checkpoint, and once the burst passes the run finishes
+    with finite losses and /healthz ok — no human in the loop."""
+    cfg = _sebulba_cfg(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        rollback_bad_windows=2, rollback_max_attempts=3,
+        obs_http_port=-1, health_stall_frac=1.0, health_fps_collapse=0.0,
+        fault_spec="actor.queue_put:corrupt:1.0:0:max=12,after=16",
+    )
+    agent = make_agent(cfg)
+    try:
+        history = agent.train(total_env_steps=_steps(cfg, updates=26))
+        last = history[-1]
+        assert obs_registry.counter("rollback_restores").value() >= 1
+        assert obs_registry.counter("rollback_quarantine").value() >= 1
+        assert last.get("nonfinite_skips", 0) > 0  # the guard fired
+        assert np.isfinite(last["loss"])
+        assert obs_registry.counter("rollback_abort").value() == 0
+        assert agent._obs.monitor.verdict()["status"] == "ok"
+    finally:
+        agent.close()
+
+
+def test_rollback_with_rotated_out_last_good_keeps_oldest(tmp_path):
+    """Code-review pin: when retention GC evicted the banked last-good
+    step (every retained step > last_good), the rollback must fall back
+    to the OLDEST retained step — never evict the entire directory
+    hunting for a step that no longer exists, then die on an empty
+    restore."""
+    cfg = _sebulba_cfg(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        rollback_bad_windows=2,
+    )
+    agent = make_agent(cfg)
+    try:
+        agent.train(total_env_steps=_steps(cfg, updates=6))
+        ckpt = agent._ckpt.checkpointer
+        steps_before = sorted(ckpt.all_steps())
+        assert len(steps_before) >= 2
+        agent._rollback.last_good_step = steps_before[0] - 1  # rotated out
+        agent._execute_rollback(None)
+        remaining = sorted(ckpt.all_steps())
+        assert remaining == [steps_before[0]], (
+            f"expected only the oldest step to survive: {remaining}"
+        )
+        assert int(np.asarray(agent.state.update_step)) == steps_before[0]
+    finally:
+        agent.close()
+
+
+def test_rollback_with_no_retained_steps_is_a_noop(tmp_path):
+    """Code-review pin: a rollback that fires before the first save
+    landed has nothing to restore — the NaN-guard already holds the
+    params, so the action degrades to a no-op instead of raising."""
+    cfg = _sebulba_cfg(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1000,
+        rollback_bad_windows=2,
+    )
+    agent = make_agent(cfg)
+    try:
+        assert agent._ckpt.checkpointer.all_steps() == []
+        step_before = int(np.asarray(agent.state.update_step))
+        agent._execute_rollback(None)  # must not raise
+        assert int(np.asarray(agent.state.update_step)) == step_before
+        assert agent._ckpt.checkpointer.all_steps() == []
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+def test_rollback_attempts_exhausted_aborts_with_forensics(tmp_path):
+    """Unbounded corruption re-diverges the run after every rollback:
+    past rollback_max_attempts the policy aborts the run loudly instead
+    of looping forever."""
+    cfg = _sebulba_cfg(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        rollback_bad_windows=2, rollback_max_attempts=1,
+        obs_http_port=-1, health_stall_frac=1.0, health_fps_collapse=0.0,
+        fault_spec="actor.queue_put:corrupt:1.0:0:after=16",
+    )
+    agent = make_agent(cfg)
+    try:
+        with pytest.raises(RuntimeError, match="rollback attempts exhausted"):
+            agent.train(total_env_steps=_steps(cfg, updates=200))
+        assert obs_registry.counter("rollback_abort").value() == 1
+        assert obs_registry.counter("rollback_restores").value() == 1
+    finally:
+        agent.close()
