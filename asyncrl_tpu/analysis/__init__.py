@@ -1,6 +1,6 @@
 """Framework-aware static checker for the async pipeline.
 
-``python -m asyncrl_tpu.analysis [paths...]`` runs seven passes over the
+``python -m asyncrl_tpu.analysis [paths...]`` runs nine passes over the
 package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
 :mod:`asyncrl_tpu.analysis.annotations` for the annotation grammar):
 
@@ -14,6 +14,12 @@ package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
   structure, host threading under trace (COL*)
 - ``configflow``  — config-field contracts + ASYNCRL_* env discipline
   (CFG*)
+- ``protocols``   — typestate verification of the lease/generation
+  protocols (staging leases, ParamSlots generations, ring swaps, and
+  any ``# protocol:``-declared machine) over per-function CFGs (PROT*)
+- ``signals``     — async-signal-safety of signal-handler-reachable
+  code: lock reentrancy, blocking/buffered calls, registration sites
+  (SIG*)
 
 Annotation-grammar errors and unloadable files (ANN*) are produced by
 every run and can be neither waived nor baselined. The analyzer core
@@ -45,6 +51,8 @@ PASSES = (
     "deadlock",
     "collectives",
     "configflow",
+    "protocols",
+    "signals",
 )
 
 # Finding-code prefix -> owning pass (for per-pass stats; ANN* belongs to
@@ -58,6 +66,8 @@ CODE_FAMILIES = {
     "DEAD": "deadlock",
     "COL": "collectives",
     "CFG": "configflow",
+    "PROT": "protocols",
+    "SIG": "signals",
     "ANN": "annotations",
 }
 
@@ -70,7 +80,9 @@ def _impl():
         donation,
         locks,
         ownership,
+        protocols,
         purity,
+        signals,
     )
 
     return {
@@ -81,6 +93,8 @@ def _impl():
         "deadlock": deadlock.run,
         "collectives": collectives.run,
         "configflow": configflow.run,
+        "protocols": protocols.run,
+        "signals": signals.run,
     }
 
 
@@ -144,7 +158,10 @@ def run_analysis(
     files = _core.discover_files(paths)
 
     def finish(findings, mode, analyzed):
-        per_pass: dict[str, int] = {}
+        # Every requested pass reports, zeros included: lint_report.json
+        # must distinguish "pass ran clean" from "pass never ran" (a
+        # clean run used to emit an empty findings_per_pass).
+        per_pass: dict[str, int] = {p: 0 for p in passes}
         for f in findings:
             family = next(
                 (p for prefix, p in CODE_FAMILIES.items()

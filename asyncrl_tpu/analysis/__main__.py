@@ -37,7 +37,8 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m asyncrl_tpu.analysis",
         description="framework-aware static checker (lock discipline, "
         "JAX purity, donation safety, thread ownership, deadlock/"
-        "lock-order, device contracts, config contracts)",
+        "lock-order, device contracts, config contracts, protocol "
+        "typestate, async-signal safety)",
     )
     parser.add_argument(
         "paths",

@@ -26,6 +26,25 @@ enforce. The full grammar (also documented in docs/ARCHITECTURE.md):
     ``learner``); group defaults to the entry name. On a ``class`` line,
     every method of the class is a root.
 
+``# protocol: <name> <key>=<value> ...``
+    A standalone or trailing comment declaring a typestate protocol for
+    the protocol pass (:mod:`asyncrl_tpu.analysis.protocols`). Keys:
+    ``mint=`` comma-separated minting callables — ``Class.method`` forms
+    resolve through the call graph, bare names match any assigned
+    ``<recv>.<name>(...)`` call; ``attrs=`` attribute names whose
+    assigned read adopts an existing object (``lease = x._open_lease``);
+    ``ops=`` comma-separated ``op:from[|from]-><to>`` transition rules;
+    ``reads=`` attribute reads legal only in the listed states
+    (``buffer:held``); ``open=`` states that must be closed or handed
+    off before function exit; ``terminal=`` states after which any
+    further op is use-after-free; ``initial=`` the post-mint state —
+    optional, defaulting to the first ``open=`` state (the open state IS
+    the post-mint state in every lease discipline) and only then to the
+    first op rule's first from-state, so op-rule ordering alone can
+    never silently un-arm leak detection. A declared name overrides a
+    same-named built-in spec. Malformed declarations are hard ANN013
+    errors.
+
 ``# lint: <tag>(<reason>)``
     A waiver for one finding on the same line (or the line directly
     above). Tags: ``broad-except-ok`` (supervisor-boundary broad except),
@@ -39,8 +58,13 @@ enforce. The full grammar (also documented in docs/ARCHITECTURE.md):
     ``blocking-under-lock-ok`` (a deliberate blocking call or Condition
     hand-off while a lock is held — e.g. serializing a one-time build),
     ``config-unused-ok`` (a declared config field with no static reader —
-    e.g. consumed through dynamic ``getattr`` machinery). The reason is
-    mandatory.
+    e.g. consumed through dynamic ``getattr`` machinery),
+    ``protocol-ok`` (a sanctioned typestate deviation: a declared
+    lease hand-off/escape, or a leak/ordering report the protocol pass
+    cannot see is discharged elsewhere), ``signal-safe-ok`` (a
+    signal-handler-reachable operation whose safety rests on a protocol
+    state the signal pass cannot prove — name that state in the reason).
+    The reason is mandatory.
 
 Malformed annotations and unknown waiver tags are **hard lint errors**
 (ANN0xx findings) — a misspelled annotation must never silently enforce
@@ -64,8 +88,15 @@ WAIVER_TAGS = (
     "lock-order-ok",
     "blocking-under-lock-ok",
     "config-unused-ok",
+    "protocol-ok",
+    "signal-safe-ok",
 )
 
+_PROTOCOL_RE = re.compile(r"^protocol:\s*([\w-]+)\s+(.+)$")
+_STATE_RE = re.compile(r"^[A-Za-z_][\w-]*$")
+_OP_RULE_RE = re.compile(
+    r"^([A-Za-z_]\w*):([\w-]+(?:\|[\w-]+)*)->([\w-]+)$"
+)
 _GUARDED_RE = re.compile(r"^guarded-by:\s*(\S+)\s*$")
 _LOCKSPEC_RE = re.compile(r"^[A-Za-z_]\w*(\.[A-Za-z_]\w*)*$")
 _HOLDS_RE = re.compile(r"^holds:\s*(\S+)\s*$")
@@ -108,6 +139,25 @@ class Entry:
 
 
 @dataclasses.dataclass(frozen=True)
+class ProtocolDecl:
+    """One ``# protocol:`` declaration — the typestate spec a module
+    contributes to the protocol pass. ``raw`` keeps the declaration text
+    so the cache's environment hash sees comment-level spec edits."""
+
+    name: str
+    mint: tuple[str, ...]          # "Class.method" resolved forms
+    mint_names: tuple[str, ...]    # bare method-name fallbacks
+    mint_attrs: tuple[str, ...]    # adopting attribute reads
+    ops: tuple[tuple[str, tuple[str, ...], str], ...]  # (op, froms, to)
+    reads: tuple[tuple[str, tuple[str, ...]], ...]     # (attr, states)
+    open_states: tuple[str, ...]
+    terminal: tuple[str, ...]
+    initial: str | None            # explicit post-mint state, or None
+    line: int
+    raw: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Waiver:
     tag: str
     reason: str
@@ -123,6 +173,7 @@ class ModuleAnnotations:
         self.guards: dict[tuple[str | None, str], Guard] = {}
         self.holds: dict[tuple[str, str], str] = {}  # (class, method) -> lock
         self.entries: list[Entry] = []
+        self.protocols: list[ProtocolDecl] = []
         self.waivers: dict[int, Waiver] = {}
         self.errors: list[Finding] = []
 
@@ -193,7 +244,100 @@ def parse_module(module: SourceModule) -> ModuleAnnotations:
             _parse_holds(module, line, text, out)
         elif text.startswith("thread-entry"):
             _parse_entry(module, line, text, out)
+        elif text.startswith("protocol:"):
+            _parse_protocol(module, line, text, out)
     return out
+
+
+def _parse_protocol(
+    module: SourceModule, line: int, text: str, out: ModuleAnnotations
+) -> None:
+    def err(detail: str) -> None:
+        out.errors.append(
+            Finding(
+                "ANN013", module.path, line,
+                f"malformed protocol declaration {text!r}: {detail}; "
+                "expected '# protocol: <name> mint=... ops=op:from->to,..."
+                " [attrs=...] [reads=attr:state|state,...] [open=...]"
+                " [terminal=...] [initial=<state>]'",
+            )
+        )
+
+    m = _PROTOCOL_RE.match(text)
+    if not m:
+        err("missing name or key=value fields")
+        return
+    name, rest = m.group(1), m.group(2)
+    fields: dict[str, str] = {}
+    for token in rest.split():
+        key, sep, value = token.partition("=")
+        if not sep or key not in (
+            "mint", "attrs", "ops", "reads", "open", "terminal", "initial"
+        ) or not value:
+            err(f"bad field {token!r}")
+            return
+        if key in fields:
+            err(f"duplicate field {key!r}")
+            return
+        fields[key] = value
+    if "mint" not in fields and "attrs" not in fields:
+        err("a protocol needs a mint= or attrs= source")
+        return
+    mint: list[str] = []
+    mint_names: list[str] = []
+    for item in fields.get("mint", "").split(","):
+        if not item:
+            continue
+        (mint if "." in item else mint_names).append(item)
+    mint_attrs = [a for a in fields.get("attrs", "").split(",") if a]
+    ops: list[tuple[str, tuple[str, ...], str]] = []
+    states: set[str] = set()
+    for rule in fields.get("ops", "").split(","):
+        if not rule:
+            continue
+        rm = _OP_RULE_RE.match(rule)
+        if not rm:
+            err(f"bad op rule {rule!r} (want op:from[|from]->to)")
+            return
+        froms = tuple(rm.group(2).split("|"))
+        ops.append((rm.group(1), froms, rm.group(3)))
+        states.update(froms)
+        states.add(rm.group(3))
+    reads: list[tuple[str, tuple[str, ...]]] = []
+    for rule in fields.get("reads", "").split(","):
+        if not rule:
+            continue
+        attr, sep, allowed = rule.partition(":")
+        if not sep or not attr or not allowed:
+            err(f"bad reads rule {rule!r} (want attr:state|state)")
+            return
+        reads.append((attr, tuple(allowed.split("|"))))
+        states.update(allowed.split("|"))
+    open_states = tuple(s for s in fields.get("open", "").split(",") if s)
+    terminal = tuple(s for s in fields.get("terminal", "").split(",") if s)
+    initial = fields.get("initial")
+    for s in (*open_states, *terminal, *((initial,) if initial else ())):
+        if not _STATE_RE.match(s):
+            err(f"bad state name {s!r}")
+            return
+        if states and s not in states:
+            err(f"state {s!r} appears in no op rule")
+            return
+    out.protocols.append(
+        ProtocolDecl(
+            name=name,
+            mint=tuple(mint),
+            mint_names=tuple(mint_names),
+            mint_attrs=tuple(mint_attrs),
+            ops=tuple(ops),
+            reads=tuple(reads),
+            open_states=open_states,
+            terminal=terminal,
+            initial=initial,
+            line=line,
+            raw=text,
+        )
+    )
 
 
 def _parse_waiver(

@@ -35,12 +35,13 @@ import os
 
 from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
 
-ANALYZER_VERSION = "2"
+ANALYZER_VERSION = "3"
 _MANIFEST = "manifest.json"
 
 # Code prefixes whose findings fold whole-project state: recomputed every
-# run, never cached per-file.
-GLOBAL_CODES = ("OWN", "EXC", "DEAD", "ANN")
+# run, never cached per-file. SIG is global because handler reachability
+# folds registrations and call edges from everywhere.
+GLOBAL_CODES = ("OWN", "EXC", "DEAD", "ANN", "SIG")
 _GLOBAL_EXACT = ("CFG002",)
 
 
@@ -92,6 +93,10 @@ def _module_env(module: SourceModule) -> str:
             (e.name, e.group, e.class_name or "", e.method or "")
             for e in ann.entries
         ),
+        # Protocol specs are comment-level declarations other files'
+        # findings depend on: a spec edit must invalidate every
+        # per-file result, exactly like a code-shape change.
+        "protocols": sorted(p.raw for p in ann.protocols),
     }
     payload = ast.dump(tree, include_attributes=False) + json.dumps(
         decls, sort_keys=True

@@ -3,7 +3,7 @@
 The analysis subsystem (``python -m asyncrl_tpu.analysis``) enforces, at
 lint time and on every line, the concurrency and JAX disciplines the
 runtime checks (``ASYNCRL_DEBUG_SYNC``, ``tests/test_race_debug.py``) can
-only probe on the interleavings a stress test happens to hit. Seven
+only probe on the interleavings a stress test happens to hit. Nine
 passes run over the package's ASTs (stdlib ``ast``/``tokenize`` only —
 no third-party linter dependency):
 
@@ -18,10 +18,15 @@ no third-party linter dependency):
   binding, scan-carry structure, host threading in traced code
 - :mod:`asyncrl_tpu.analysis.configflow`  — config-field contracts and
   ``ASYNCRL_*`` env-var discipline
+- :mod:`asyncrl_tpu.analysis.protocols`   — typestate verification of
+  the lease/generation protocols over per-function CFGs
+- :mod:`asyncrl_tpu.analysis.signals`     — async-signal-safety of
+  handler-reachable code
 
 This module holds what every pass shares: source loading, comment
 extraction, import/alias resolution, class/attribute indexing, a light
 ``self.<attr> = ClassName(...)`` type map, the :class:`Finding` record,
+the statement-level :class:`CFG` builder the typestate pass walks,
 and the ONE-per-run interprocedural indexes (:class:`FunctionIndex`, the
 name-based :class:`CallGraph`, and the jit-traced reachable set) that the
 passes used to rebuild independently. The annotation grammar itself lives
@@ -43,15 +48,26 @@ import ast
 import dataclasses
 import io
 import os
+import re
 import tokenize
+
+# Threading primitives that act as locks, plus the name heuristic for
+# lock-ish receivers whose binding the indexer can't see (a lock that
+# arrives via a parameter). ONE definition shared by the deadlock,
+# signal-safety, and protocol passes — divergent copies would let the
+# passes disagree on what counts as a lock.
+LOCK_TYPES = frozenset(
+    {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+)
+LOCKY_NAME = re.compile(r"lock|cond|mutex|semaphore", re.IGNORECASE)
 
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One lint finding. ``code`` identifies the rule (LOCK/PURE/DON/OWN/
-    EXC/DEAD/COL/CFG/ANN families); annotation-grammar and file-load
-    errors (ANN*) are hard errors that no waiver or baseline can
-    silence."""
+    EXC/DEAD/COL/CFG/PROT/SIG/ANN families); annotation-grammar and
+    file-load errors (ANN*) are hard errors that no waiver or baseline
+    can silence."""
 
     code: str
     path: str
@@ -451,6 +467,298 @@ def collect_trace_roots(
                     if hit is not None:
                         roots.append(hit)
     return roots
+
+
+# ------------------------------------------------------------------- CFG
+#
+# Statement-level control-flow graphs for the typestate (protocol) pass.
+# One node per *simple* statement or compound-statement HEADER (an If
+# node's expressions are its test only — bodies are separate nodes), plus
+# three synthetic nodes: entry, exit (normal return paths) and raise_exit
+# (exceptions escaping the function). Edges are labeled:
+#
+# - kind "normal" | "exc" — an exc edge models "this statement raised";
+#   it is added for statements whose header contains a Call (plus Raise
+#   and Assert), targeting the innermost enclosing handler dispatch /
+#   finally, else raise_exit. Attribute errors, KeyboardInterrupt between
+#   arbitrary bytecodes etc. are deliberately NOT modeled — the graph is
+#   for a linter, not a verifier.
+# - narrow (None | ("drop", name)) — branch refinement from
+#   ``X is None`` / ``X is not None`` tests: on the branch where X is
+#   known None, a dataflow client can drop X's binding (how the lease
+#   pass avoids phantom leaks on ``if lease is None: break`` paths).
+#
+# try/finally routes every completion (normal, exceptional, return,
+# break, continue) through the finally subgraph once and then fans out to
+# every continuation that actually flowed in. The fan-out merges paths —
+# a deliberate over-approximation that keeps the graph linear in the
+# source size.
+
+
+class CFG:
+    """Statement-level CFG of one function body (see :func:`build_cfg`)."""
+
+    def __init__(self) -> None:
+        self.stmts: list[ast.stmt | None] = []
+        # node id -> [(target, kind, narrow)]
+        self.succ: list[list[tuple[int, str, tuple | None]]] = []
+        self._incoming: list[int] = []
+        self.entry = self.node(None)
+        self.exit = self.node(None)
+        self.raise_exit = self.node(None)
+
+    def node(self, stmt: ast.stmt | None) -> int:
+        self.stmts.append(stmt)
+        self.succ.append([])
+        self._incoming.append(0)
+        return len(self.stmts) - 1
+
+    def edge(
+        self, a: int, b: int, kind: str = "normal", narrow: tuple | None = None
+    ) -> None:
+        self.succ[a].append((b, kind, narrow))
+        self._incoming[b] += 1
+
+    def used(self, n: int) -> bool:
+        return self._incoming[n] > 0
+
+
+def _test_narrows(test: ast.AST) -> tuple[tuple | None, tuple | None]:
+    """(true_branch_narrow, false_branch_narrow) for ``X is None`` /
+    ``X is not None`` tests on a Name."""
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.left, ast.Name)
+        and len(test.comparators) == 1
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    ):
+        name = test.left.id
+        if isinstance(test.ops[0], ast.Is):
+            return ("drop", name), None
+        if isinstance(test.ops[0], ast.IsNot):
+            return None, ("drop", name)
+    return None, None
+
+
+def _header_exprs(stmt: ast.stmt) -> list[ast.AST]:
+    """The expression ASTs that belong to a statement's OWN node (bodies
+    of compound statements are separate nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return []
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def _can_raise(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    return any(
+        isinstance(sub, ast.Call)
+        for expr in _header_exprs(stmt)
+        for sub in ast.walk(expr)
+    )
+
+
+class _CFGBuilder:
+    def __init__(self, graph: CFG):
+        self.graph = graph
+
+    def seq(
+        self,
+        stmts: list[ast.stmt],
+        preds: list[tuple[int, tuple | None]],
+        exc: int,
+        brk: int | None,
+        cont: int | None,
+        ret: int,
+    ) -> list[tuple[int, tuple | None]]:
+        """Thread ``stmts`` after ``preds``; returns the open normal ends.
+        ``exc``/``brk``/``cont``/``ret`` are the abrupt-completion
+        targets in force."""
+        for stmt in stmts:
+            preds = self._stmt(stmt, preds, exc, brk, cont, ret)
+        return preds
+
+    def _connect(self, preds, n: int) -> None:
+        for p, narrow in preds:
+            self.graph.edge(p, n, "normal", narrow)
+
+    def _stmt(self, stmt, preds, exc, brk, cont, ret):
+        graph = self.graph
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, preds, exc, brk, cont, ret)
+        n = graph.node(stmt)
+        self._connect(preds, n)
+        if _can_raise(stmt):
+            graph.edge(n, exc, "exc")
+        if isinstance(stmt, ast.Return):
+            graph.edge(n, ret)
+            return []
+        if isinstance(stmt, ast.Raise):
+            return []
+        if isinstance(stmt, ast.Break):
+            if brk is not None:
+                graph.edge(n, brk)
+            return []
+        if isinstance(stmt, ast.Continue):
+            if cont is not None:
+                graph.edge(n, cont)
+            return []
+        if isinstance(stmt, ast.If):
+            t_narrow, f_narrow = _test_narrows(stmt.test)
+            then_ends = self.seq(
+                stmt.body, [(n, t_narrow)], exc, brk, cont, ret
+            )
+            if stmt.orelse:
+                else_ends = self.seq(
+                    stmt.orelse, [(n, f_narrow)], exc, brk, cont, ret
+                )
+            else:
+                else_ends = [(n, f_narrow)]
+            return then_ends + else_ends
+        if isinstance(stmt, ast.While):
+            after = graph.node(None)
+            t_narrow, f_narrow = _test_narrows(stmt.test)
+            body_ends = self.seq(
+                stmt.body, [(n, t_narrow)], exc, after, n, ret
+            )
+            for p, narrow in body_ends:
+                graph.edge(p, n, "normal", narrow)
+            infinite = (
+                isinstance(stmt.test, ast.Constant) and bool(stmt.test.value)
+                and not stmt.orelse
+            )
+            if not infinite:
+                ends = self.seq(
+                    stmt.orelse, [(n, f_narrow)], exc, brk, cont, ret
+                ) if stmt.orelse else [(n, f_narrow)]
+                for p, narrow in ends:
+                    graph.edge(p, after, "normal", narrow)
+            return [(after, None)] if graph.used(after) else []
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            after = graph.node(None)
+            body_ends = self.seq(stmt.body, [(n, None)], exc, after, n, ret)
+            for p, narrow in body_ends:
+                graph.edge(p, n, "normal", narrow)
+            ends = self.seq(
+                stmt.orelse, [(n, None)], exc, brk, cont, ret
+            ) if stmt.orelse else [(n, None)]
+            for p, narrow in ends:
+                graph.edge(p, after, "normal", narrow)
+            return [(after, None)] if graph.used(after) else []
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self.seq(stmt.body, [(n, None)], exc, brk, cont, ret)
+        if isinstance(stmt, ast.Match):
+            ends: list[tuple[int, tuple | None]] = [(n, None)]
+            for case in stmt.cases:
+                ends += self.seq(case.body, [(n, None)], exc, brk, cont, ret)
+            return ends
+        return [(n, None)]
+
+    def _try(self, stmt: ast.Try, preds, exc, brk, cont, ret):
+        graph = self.graph
+        has_fin = bool(stmt.finalbody)
+        if has_fin:
+            collectors: dict[int, int] = {}
+
+            def collect(target):
+                if target is None:
+                    return None
+                if target not in collectors:
+                    collectors[target] = graph.node(None)
+                return collectors[target]
+
+            exc2, brk2 = collect(exc), collect(brk)
+            cont2, ret2 = collect(cont), collect(ret)
+        else:
+            exc2, brk2, cont2, ret2 = exc, brk, cont, ret
+        if stmt.handlers:
+            dispatch = graph.node(None)
+            body_ends = self.seq(
+                stmt.body, preds, dispatch, brk2, cont2, ret2
+            )
+            # An exception may match no handler and keep propagating —
+            # unless a catch-all handler (bare ``except:``,
+            # ``except BaseException``, or ``except Exception``)
+            # guarantees a match. Without this carve-out the canonical
+            # lease-cleanup idiom (``except Exception: lease.void();
+            # raise``) would leak a phantom still-open lease along the
+            # no-match edge. ``Exception`` counts as catch-all because
+            # the only escapes it misses (KeyboardInterrupt/SystemExit/
+            # GeneratorExit) are exactly the async-exception class this
+            # graph deliberately does not model.
+            def _catch_all_type(t: ast.AST | None) -> bool:
+                if t is None:
+                    return True
+                if isinstance(t, ast.Name):
+                    return t.id in ("BaseException", "Exception")
+                if isinstance(t, ast.Tuple):
+                    return any(_catch_all_type(e) for e in t.elts)
+                return False
+
+            if not any(_catch_all_type(h.type) for h in stmt.handlers):
+                graph.edge(dispatch, exc2, "exc")
+            handler_ends: list[tuple[int, tuple | None]] = []
+            for handler in stmt.handlers:
+                handler_ends += self.seq(
+                    handler.body, [(dispatch, None)], exc2, brk2, cont2, ret2
+                )
+        else:
+            body_ends = self.seq(stmt.body, preds, exc2, brk2, cont2, ret2)
+            handler_ends = []
+        if stmt.orelse:
+            body_ends = self.seq(
+                stmt.orelse, body_ends, exc2, brk2, cont2, ret2
+            )
+        normal_ends = body_ends + handler_ends
+        if not has_fin:
+            return normal_ends
+        fin_preds = list(normal_ends)
+        used = [
+            (target, node)
+            for target, node in collectors.items()
+            if graph.used(node)
+        ]
+        for _, node in used:
+            fin_preds.append((node, None))
+        if not fin_preds:
+            return []
+        fin_ends = self.seq(stmt.finalbody, fin_preds, exc, brk, cont, ret)
+        for target, _ in used:
+            for p, narrow in fin_ends:
+                graph.edge(p, target, "normal", narrow)
+        # The finally's normal ends continue after the try only when the
+        # body/handlers could complete normally.
+        return fin_ends if normal_ends else []
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """The statement-level CFG of one FunctionDef/AsyncFunctionDef (or
+    Lambda: a single-expression graph)."""
+    graph = CFG()
+    builder = _CFGBuilder(graph)
+    if isinstance(fn, ast.Lambda):
+        n = graph.node(ast.Expr(value=fn.body))
+        graph.edge(graph.entry, n)
+        graph.edge(n, graph.exit)
+        if any(isinstance(s, ast.Call) for s in ast.walk(fn.body)):
+            graph.edge(n, graph.raise_exit, "exc")
+        return graph
+    ends = builder.seq(
+        fn.body, [(graph.entry, None)], graph.raise_exit, None, None, graph.exit
+    )
+    for p, narrow in ends:
+        graph.edge(p, graph.exit, "normal", narrow)
+    return graph
 
 
 def load_file(path: str) -> tuple[SourceModule | None, Finding | None]:

@@ -46,19 +46,16 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-import re
 
-from asyncrl_tpu.analysis.core import ClassInfo, Finding, Project
+from asyncrl_tpu.analysis.core import (
+    LOCK_TYPES,
+    LOCKY_NAME,
+    ClassInfo,
+    Finding,
+    Project,
+)
 
-LOCK_TYPES = {
-    "Lock",
-    "RLock",
-    "Condition",
-    "Semaphore",
-    "BoundedSemaphore",
-}
 _COND_TYPES = {"Condition"}
-_LOCKY_NAME = re.compile(r"lock|cond|mutex|semaphore", re.IGNORECASE)
 
 # Blocking-call deny list for DEAD003, by resolved dotted prefix.
 _BLOCKING_PREFIXES = (
@@ -112,7 +109,7 @@ class _Index:
         bound = info.attr_types.get(attr)
         if bound in LOCK_TYPES:
             return _LockRef(f"{info.name}.{attr}", bound in _COND_TYPES)
-        if bound is None and _LOCKY_NAME.search(attr):
+        if bound is None and LOCKY_NAME.search(attr):
             # Unbound but lock-named (the lock arrives via a parameter):
             # trust the name; "cond" names count as conditions.
             return _LockRef(

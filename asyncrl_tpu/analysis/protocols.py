@@ -1,0 +1,1113 @@
+"""Protocol typestate pass (PROT0xx).
+
+The framework's correctness backbone is a family of lease/generation
+protocols enforced by hand until now: StagingRing slab leases
+(acquire → write → commit | void), ParamSlots generation leases
+(lease → dispatch → release), and RingSwapHolder ring snapshots. The
+review history shows these are exactly where bugs hide — use-after-void
+writes, leaked leases on exception paths, row views escaping their
+scope — so this pass machine-checks them: an **intraprocedural typestate
+walk over the statement-level CFG** (:func:`asyncrl_tpu.analysis.core.
+build_cfg`) with **interprocedural summaries** over the shared call
+graph (mint-wrapper detection, param-op effects).
+
+Objects enter tracking three ways:
+
+- a **mint call** — ``lease = ring.acquire(...)`` — resolved through the
+  call graph to a declared mint method (``StagingRing.acquire``), by
+  bare method name when resolution fails (``acquire`` on an untyped
+  receiver), or through a *mint wrapper* (a function the summary pass
+  proved returns a minted object);
+- an **adopting attribute read** — ``lease = actor._open_lease`` — for
+  attributes a spec declares as lease-carrying (state ``adopted``);
+- a **protocol-op'd parameter** — a function that voids/releases its
+  argument tracks it as ``borrowed`` (no exit obligation: the caller
+  owns it).
+
+Findings:
+
+- **PROT001** — an op or declared attribute read applied in a state the
+  spec forbids: use-after-void, double release, write-after-commit.
+- **PROT002** — a lease leaked on a CFG path: minted/adopted, then a
+  path (normal or exception edge) reaches function exit with the object
+  still in an ``open`` state and never handed off.
+- **PROT003** — a lease/row-view escaping its scope: stored to ``self``,
+  returned from a non-facade function, or captured by a closure handed
+  to a thread target. A *sanctioned* hand-off (the actor parking its
+  open lease for the supervisor) carries ``# lint: protocol-ok(...)`` —
+  the escape then also discharges the PROT002 obligation.
+- **PROT004** — mixed-generation combination: one call receiving
+  protocol objects from two distinct mint sites (a batch/dispatch can
+  never mix generations by construction; a call that would is a bug).
+
+Built-in specs cover the staging leases, the ParamSlots generation
+leases, and RingSwapHolder ring snapshots; new protocols (the coming
+replay ring reuses the lease discipline) declare their own spec with a
+``# protocol:`` comment (grammar in
+:mod:`asyncrl_tpu.analysis.annotations`) instead of relying on reviewer
+memory. A declared spec overrides a same-named built-in.
+
+Approximations, deliberately: aliasing is name-level (tuple-unpacked
+mints alias every target — ``params, gen, slots = router.lease(p)`` is
+ONE lease), attribute-chain receivers are untracked (``fragment.lease``
+is the drain's borrow, not an obligation), escape through an unresolved
+call argument neither discharges nor reports — which also covers a mint
+nested directly in another call's arguments
+(``process(ring.acquire())``; a BARE discarded mint statement does
+report), and a closing op is modeled as succeeded on its own exception
+edge (carrying the pre-op state there would demand a try/except around
+every final ``commit()``/``release()``). The guarantee
+is the linter's: every declared transition is checked on every line,
+and the deletion proofs in tests/test_protocols.py pin that removing a
+real ``void()``/``release()`` trips PROT002.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from asyncrl_tpu.analysis.core import (
+    CFG,
+    LOCK_TYPES,
+    LOCKY_NAME,
+    Finding,
+    Project,
+    SourceModule,
+    build_cfg,
+)
+
+# Pseudo-states every spec understands: "adopted" (attribute-read mints,
+# open — must be closed or handed off), "borrowed" (op'd parameters, no
+# obligation), "escaped" (ownership handed off; rides along the real
+# state in the same set).
+_ADOPTED = "adopted"
+_BORROWED = "borrowed"
+_ESCAPED = "escaped"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSpec:
+    """One typestate protocol (built-in or ``# protocol:``-declared)."""
+
+    name: str
+    mint: frozenset[str]          # resolved "Class.method" mint methods
+    mint_names: frozenset[str]    # bare-name fallback (assigned calls)
+    mint_attrs: frozenset[str]    # adopting attribute reads
+    initial: str
+    ops: dict[str, tuple[frozenset[str], str]]  # op -> (allowed_from, to)
+    reads: dict[str, frozenset[str]]  # attr -> allowed states
+    open_states: frozenset[str]
+    terminal: frozenset[str]
+
+    def facade_names(self) -> frozenset[str]:
+        """Function names sanctioned to RETURN a tracked object (the
+        mint API itself and its wrappers re-export, they don't leak)."""
+        return self.mint_names | frozenset(
+            m.rsplit(".", 1)[-1] for m in self.mint
+        )
+
+
+BUILTIN_SPECS: tuple[ProtocolSpec, ...] = (
+    # StagingRing slab leases: acquire -> write -> commit|void. The
+    # drain-side batch/retire continuation is covered by the donation
+    # pass (read-after-retire); _open_lease adoption is the supervisor's
+    # void path (sebulba_trainer._retire_actor / _scale_down_actor).
+    ProtocolSpec(
+        name="staging-lease",
+        mint=frozenset({"StagingRing.acquire", "RingSwapHolder.acquire"}),
+        mint_names=frozenset({"acquire"}),
+        mint_attrs=frozenset({"_open_lease"}),
+        initial="held",
+        ops={
+            "write_init_core": (frozenset({"held"}), "held"),
+            "commit": (frozenset({"held"}), "committed"),
+            "void": (frozenset({"held", "committed"}), "voided"),
+        },
+        reads={"buffer": frozenset({"held"})},
+        open_states=frozenset({"held", _ADOPTED}),
+        terminal=frozenset({"voided"}),
+    ),
+    # ParamSlots generation leases: lease -> dispatch -> release. The
+    # whole tuple unpacking (params, gen, slots) aliases one lease.
+    ProtocolSpec(
+        name="params-lease",
+        mint=frozenset({"ParamSlots.lease", "PolicyRouter.lease"}),
+        mint_names=frozenset({"lease"}),
+        mint_attrs=frozenset(),
+        initial="leased",
+        ops={"release": (frozenset({"leased"}), "released")},
+        reads={},
+        open_states=frozenset({"leased", _ADOPTED}),
+        terminal=frozenset({"released"}),
+    ),
+    # RingSwapHolder snapshots: a current() ring is a per-iteration
+    # borrow. Pinning one (self-store, non-facade return) would serve a
+    # stale ring across swaps; there is no exit obligation.
+    ProtocolSpec(
+        name="ring-swap",
+        mint=frozenset({"RingSwapHolder.current"}),
+        mint_names=frozenset(),
+        mint_attrs=frozenset(),
+        initial="snapshot",
+        ops={},
+        reads={},
+        open_states=frozenset(),
+        terminal=frozenset(),
+    ),
+)
+
+
+def _spec_from_decl(decl) -> ProtocolSpec:
+    ops = {
+        op: (frozenset(froms), to) for op, froms, to in decl.ops
+    }
+    # Post-mint state: explicit initial=, else the first open= state
+    # (the open state IS the post-mint state in a lease discipline),
+    # else the first op rule's first from-state. Without the open=
+    # preference, reordering op rules could pick an already-closed
+    # initial and silently un-arm PROT002.
+    if decl.initial:
+        initial = decl.initial
+    elif decl.open_states:
+        initial = decl.open_states[0]
+    else:
+        initial = decl.ops[0][1][0] if decl.ops else "held"
+    return ProtocolSpec(
+        name=decl.name,
+        mint=frozenset(decl.mint),
+        mint_names=frozenset(decl.mint_names),
+        mint_attrs=frozenset(decl.mint_attrs),
+        initial=initial,
+        ops=ops,
+        reads={attr: frozenset(states) for attr, states in decl.reads},
+        open_states=frozenset(decl.open_states),
+        terminal=frozenset(decl.terminal),
+    )
+
+
+def collect_specs(project: Project) -> dict[str, ProtocolSpec]:
+    """Built-ins + ``# protocol:`` declarations (declaration wins on a
+    name collision — a module refining a built-in is deliberate)."""
+    specs = {s.name: s for s in BUILTIN_SPECS}
+    for module in project.modules:
+        for decl in module.annotations.protocols:
+            specs[decl.name] = _spec_from_decl(decl)
+    return specs
+
+
+# ----------------------------------------------------------------- indexes
+
+
+class _SpecIndex:
+    """Lookup tables shared by the summary passes and the analyzer."""
+
+    def __init__(self, specs: dict[str, ProtocolSpec]):
+        self.specs = specs
+        self.resolved_mints: dict[str, ProtocolSpec] = {}
+        self.mint_names: dict[str, ProtocolSpec] = {}
+        self.mint_attrs: dict[str, ProtocolSpec] = {}
+        self.op_owner: dict[str, ProtocolSpec] = {}
+        for spec in specs.values():
+            for m in spec.mint:
+                self.resolved_mints[m] = spec
+            for n in spec.mint_names:
+                self.mint_names.setdefault(n, spec)
+            for a in spec.mint_attrs:
+                self.mint_attrs.setdefault(a, spec)
+            for op in spec.ops:
+                self.op_owner.setdefault(op, spec)
+
+
+def _functions(module: SourceModule):
+    """(enclosing ClassInfo-name | None, fn) for every def in ``module``
+    (nested defs included — each is analyzed as its own root)."""
+    class_of: dict[int, str] = {}
+    for cls in module.tree.body:
+        if isinstance(cls, ast.ClassDef):
+            for sub in ast.walk(cls):
+                class_of[id(sub)] = cls.name
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield class_of.get(id(node)), node
+
+
+class _Resolver:
+    """Call resolution in one function's context, through the shared
+    name-based call graph."""
+
+    def __init__(self, project: Project, module: SourceModule,
+                 cls_name: str | None, fn: ast.AST):
+        from asyncrl_tpu.analysis.ownership import CallNode
+
+        self.graph = project.call_graph
+        info = None
+        if cls_name is not None:
+            for candidate in project.classes.get(cls_name, []):
+                if candidate.module is module:
+                    info = candidate
+                    break
+        node = self.graph.nodes.get(id(fn))
+        if node is None:
+            node = CallNode(module, info, getattr(fn, "name", "<lambda>"), fn)
+        self.node = node
+        self.local_types = self.graph._local_types(fn, node.cls)
+
+    def callees(self, call: ast.Call):
+        return self.graph.resolve_call(self.node, call, self.local_types)
+
+
+def _mint_spec_for_call(
+    index: _SpecIndex,
+    resolver: _Resolver,
+    wrappers: dict[int, ProtocolSpec],
+    call: ast.Call,
+) -> ProtocolSpec | None:
+    hits = resolver.callees(call)
+    for hit in hits:
+        qual = f"{hit.cls.name}.{hit.name}" if hit.cls else hit.name
+        spec = index.resolved_mints.get(qual)
+        if spec is not None:
+            return spec
+        spec = wrappers.get(id(hit.fn))
+        if spec is not None:
+            return spec
+    if not hits and isinstance(call.func, ast.Attribute):
+        spec = index.mint_names.get(call.func.attr)
+        if spec is not None and not _lock_receiver(
+            resolver, call.func.value
+        ):
+            return spec
+    return None
+
+
+def _lock_receiver(resolver: _Resolver, recv: ast.AST) -> bool:
+    """True when a bare-name fallback's receiver is recognizably a
+    threading lock — ``got = self._lock.acquire(timeout=0.5)`` shares
+    the ``acquire`` name with the staging mint but must not mint a
+    phantom lease. Typed ``self.<attr>`` receivers use the class's
+    attr-type map (the deadlock pass's rule); untyped receivers fall to
+    the shared lock-ish-name heuristic."""
+    cls = resolver.node.cls
+    if isinstance(recv, ast.Name):
+        return bool(LOCKY_NAME.search(recv.id))
+    if isinstance(recv, ast.Attribute):
+        if (
+            isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and cls is not None
+        ):
+            bound = cls.attr_types.get(recv.attr)
+            if bound is not None:
+                return bound in LOCK_TYPES
+        return bool(LOCKY_NAME.search(recv.attr))
+    return False
+
+
+class _ResolverCache:
+    """One ``_Resolver`` per function for the whole run: the fixpoint
+    passes and the per-function analyzer would otherwise rebuild the
+    local-type walk for every function on every round (~2x cold-run
+    cost, measured)."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._cache: dict[int, _Resolver] = {}
+
+    def get(self, module, cls_name, fn) -> _Resolver:
+        resolver = self._cache.get(id(fn))
+        if resolver is None:
+            resolver = _Resolver(self.project, module, cls_name, fn)
+            self._cache[id(fn)] = resolver
+        return resolver
+
+
+def _mint_wrappers(
+    index: _SpecIndex,
+    resolvers: _ResolverCache,
+    contexts: list,
+) -> dict[int, ProtocolSpec]:
+    """Functions that provably return a minted object (``def grab(r):
+    return r.acquire()``) — calls to them mint, and returning from them
+    is facade-sanctioned. Fixpoint so wrappers-of-wrappers resolve; each
+    function's assign/return nodes are collected ONCE — the rounds only
+    re-resolve, they never re-walk (the walk was the measured cold-run
+    hot spot)."""
+    walks: dict[int, tuple[list, list]] = {}
+    for module, cls_name, fn in contexts:
+        assigns: list[ast.Assign] = []
+        returns: list[ast.Return] = []
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and isinstance(
+                sub.value, ast.Call
+            ):
+                assigns.append(sub)
+            elif isinstance(sub, ast.Return) and sub.value is not None:
+                returns.append(sub)
+        walks[id(fn)] = (assigns, returns)
+    wrappers: dict[int, ProtocolSpec] = {}
+    # Bound = one round per function: each round resolves at least one
+    # more wrapper level, so the longest possible chain converges and
+    # the not-changed break keeps the common case at 2-3 rounds. A fixed
+    # small cap would silently drop deep helper stacks from tracking.
+    for _ in range(max(3, len(contexts))):
+        changed = False
+        for module, cls_name, fn in contexts:
+            if id(fn) in wrappers:
+                continue
+            resolver = resolvers.get(module, cls_name, fn)
+            assigns, returns = walks[id(fn)]
+            minted_names: dict[str, ProtocolSpec] = {}
+            for sub in assigns:
+                spec = _mint_spec_for_call(
+                    index, resolver, wrappers, sub.value
+                )
+                if spec is None:
+                    continue
+                for t in sub.targets:
+                    targets = (
+                        t.elts if isinstance(t, ast.Tuple) else [t]
+                    )
+                    for elt in targets:
+                        if isinstance(elt, ast.Name):
+                            minted_names[elt.id] = spec
+            spec_out = None
+            for sub in returns:
+                values = (
+                    sub.value.elts
+                    if isinstance(sub.value, ast.Tuple)
+                    else [sub.value]
+                )
+                for v in values:
+                    if isinstance(v, ast.Name) and v.id in minted_names:
+                        spec_out = minted_names[v.id]
+                    elif isinstance(v, ast.Call):
+                        spec_out = spec_out or _mint_spec_for_call(
+                            index, resolver, wrappers, v
+                        )
+            if spec_out is not None:
+                wrappers[id(fn)] = spec_out
+                changed = True
+        if not changed:
+            break
+    return wrappers
+
+
+def _direct_param_ops(fn: ast.AST, index: _SpecIndex):
+    """(param_index, spec, op) effects applied to bare parameter names in
+    ``fn``'s own body (receiver or consuming-argument form)."""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return []
+    params = [a.arg for a in args.args]
+    offset = 1 if params and params[0] in ("self", "cls") else 0
+    effects = []
+    for sub in ast.walk(fn):
+        if not (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+        ):
+            continue
+        op = sub.func.attr
+        spec = index.op_owner.get(op)
+        if spec is None:
+            continue
+        # Consuming form (``ring.void(lease)``): the bare-Name ARGS are
+        # the protocol objects and the receiver is the owner applying
+        # the op — seeding the receiver too turned every drain/cleanup
+        # helper taking the ring into a phantom tracked lease. Receiver
+        # form (``lease.commit()``, no Name args): the receiver IS the
+        # object.
+        names = {arg.id for arg in sub.args if isinstance(arg, ast.Name)}
+        if not names and isinstance(sub.func.value, ast.Name):
+            names.add(sub.func.value.id)
+        for i, p in enumerate(params[offset:]):
+            if p in names:
+                effects.append((i, spec, op))
+    return effects
+
+
+def _param_op_summaries(
+    index: _SpecIndex,
+    resolvers: _ResolverCache,
+    contexts: list,
+) -> dict[int, list[tuple[int, ProtocolSpec, str]]]:
+    """fn id -> [(caller-side positional index, spec, op)]: the protocol
+    effects a call to the function applies to its arguments, transitive
+    through the call graph (a helper that calls a helper that voids).
+    Call nodes are collected once per function, outside the rounds."""
+    summaries: dict[int, list] = {}
+    calls: dict[int, list[ast.Call]] = {}
+    for module, cls_name, fn in contexts:
+        direct = _direct_param_ops(fn, index)
+        if direct:
+            summaries[id(fn)] = list(direct)
+        calls[id(fn)] = [
+            sub for sub in ast.walk(fn) if isinstance(sub, ast.Call)
+        ]
+    # Same convergence bound as _mint_wrappers: rounds until no change,
+    # capped at one per function rather than a fixed 3.
+    for _ in range(max(3, len(contexts))):
+        changed = False
+        for module, cls_name, fn in contexts:
+            resolver = resolvers.get(module, cls_name, fn)
+            args = getattr(fn, "args", None)
+            if args is None:
+                continue
+            params = [a.arg for a in args.args]
+            offset = 1 if params and params[0] in ("self", "cls") else 0
+            mine = summaries.get(id(fn), [])
+            known = {(i, s.name, op) for i, s, op in mine}
+            for sub in calls[id(fn)]:
+                for hit in resolver.callees(sub):
+                    for idx, spec, op in summaries.get(id(hit.fn), []):
+                        if idx >= len(sub.args):
+                            continue
+                        arg = sub.args[idx]
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        for i, p in enumerate(params[offset:]):
+                            if p == arg.id and (i, spec.name, op) not in known:
+                                mine.append((i, spec, op))
+                                known.add((i, spec.name, op))
+                                changed = True
+            if mine:
+                summaries[id(fn)] = mine
+        if not changed:
+            break
+    return summaries
+
+
+# ----------------------------------------------------------------- analyzer
+
+# Abstract state: (vars, objs) — vars: name -> frozenset of obj ids;
+# objs: obj id -> frozenset of states ("escaped" rides along). Obj ids
+# are mint-site coordinates, so re-minting in a loop strong-updates the
+# same id.
+_State = tuple[dict, dict]
+
+
+def _join(a: _State | None, b: _State) -> _State:
+    if a is None:
+        return b
+    vars_a, objs_a = a
+    vars_b, objs_b = b
+    vars_out = dict(vars_a)
+    for name, objs in vars_b.items():
+        vars_out[name] = vars_out.get(name, frozenset()) | objs
+    objs_out = dict(objs_a)
+    for oid, states in objs_b.items():
+        objs_out[oid] = objs_out.get(oid, frozenset()) | states
+    return vars_out, objs_out
+
+
+class _FunctionAnalyzer:
+    def __init__(
+        self,
+        module: SourceModule,
+        fn: ast.AST,
+        index: _SpecIndex,
+        wrappers: dict[int, ProtocolSpec],
+        param_ops: dict[int, list],
+        findings: list[Finding],
+        resolver: _Resolver,
+    ):
+        self.module = module
+        self.fn = fn
+        self.index = index
+        self.wrappers = wrappers
+        self.param_ops = param_ops
+        self.findings = findings
+        self.resolver = resolver
+        self.obj_info: dict[tuple, tuple[ProtocolSpec, int]] = {}
+        self.reported: set[tuple] = set()
+        self.fn_name = getattr(fn, "name", "<lambda>")
+
+    # ------------------------------------------------------------ report
+
+    def _report(self, code: str, line: int, key: str, message: str) -> None:
+        if (code, line, key) in self.reported:
+            return
+        if self.module.annotations.waived(line, "protocol-ok"):
+            return
+        self.reported.add((code, line, key))
+        self.findings.append(Finding(code, self.module.path, line, message))
+
+    # ------------------------------------------------------------- state
+
+    def _initial(self) -> _State:
+        vars_out: dict = {}
+        objs: dict = {}
+        args = getattr(self.fn, "args", None)
+        if args is not None:
+            op_params = {
+                a.arg
+                for a in args.args
+                if a.arg not in ("self", "cls")
+            }
+            direct = _direct_param_ops(self.fn, self.index)
+            params = [a.arg for a in args.args]
+            offset = 1 if params and params[0] in ("self", "cls") else 0
+            for idx, spec, _op in direct:
+                name = params[offset + idx]
+                if name not in op_params:
+                    continue
+                oid = ("param", name, spec.name)
+                vars_out[name] = frozenset({oid})
+                objs[oid] = frozenset({_BORROWED})
+                self.obj_info[oid] = (spec, getattr(self.fn, "lineno", 1))
+        return vars_out, objs
+
+    def _mint(self, state: _State, call_or_attr, spec: ProtocolSpec,
+              initial: str) -> tuple[_State, tuple]:
+        oid = (call_or_attr.lineno, call_or_attr.col_offset, spec.name)
+        self.obj_info[oid] = (spec, call_or_attr.lineno)
+        vars_out, objs = state
+        objs = dict(objs)
+        objs[oid] = frozenset({initial})  # strong update at the mint site
+        return (vars_out, objs), oid
+
+    def _apply_op(
+        self, state: _State, oid: tuple, op: str, line: int
+    ) -> _State:
+        spec, mint_line = self.obj_info[oid]
+        allowed, to = spec.ops[op]
+        allowed = allowed | {_ADOPTED, _BORROWED}
+        vars_out, objs = state
+        cur = objs.get(oid, frozenset())
+        bad = cur - allowed - {_ESCAPED}
+        if bad:
+            verb = (
+                "use-after-" + "/".join(sorted(bad & spec.terminal))
+                if bad & spec.terminal
+                else "out-of-order op"
+            )
+            self._report(
+                "PROT001", line, f"{oid}:{op}",
+                f"{op}() on a {spec.name} object (minted line {mint_line}) "
+                f"that can already be {sorted(bad)} on some path — {verb}; "
+                "the protocol allows it only from "
+                f"{sorted(allowed - {_ADOPTED, _BORROWED})}",
+            )
+        objs = dict(objs)
+        # _ESCAPED and _BORROWED ride along across ops: a borrowed
+        # parameter that undergoes a non-closing op (a write helper)
+        # must NOT inherit the caller's close obligation — dropping the
+        # marker here turned every extracted lease-helper into a false
+        # PROT002. Use-after-void on a borrowed object still reports:
+        # the any-bad rule above checks the real states.
+        objs[oid] = frozenset({to}) | (cur & {_ESCAPED, _BORROWED})
+        return vars_out, objs
+
+    def _escape(
+        self, state: _State, oid: tuple, line: int, how: str, flag: bool
+    ) -> _State:
+        spec, mint_line = self.obj_info[oid]
+        if flag:
+            self._report(
+                "PROT003", line, f"{oid}:{how}",
+                f"{spec.name} object (minted line {mint_line}) escapes its "
+                f"acquiring scope ({how}): a lease/row-view outliving its "
+                "scope defeats the generation fence — declare a sanctioned "
+                "hand-off with '# lint: protocol-ok(<reason>)' or keep it "
+                "local",
+            )
+        vars_out, objs = state
+        objs = dict(objs)
+        objs[oid] = objs.get(oid, frozenset()) | {_ESCAPED}
+        return vars_out, objs
+
+    # ------------------------------------------------------------ exprs
+
+    def _tracked(self, state: _State, node: ast.AST) -> frozenset:
+        if isinstance(node, ast.Name):
+            return state[0].get(node.id, frozenset())
+        return frozenset()
+
+    def _scan_expr(self, state: _State, expr: ast.AST) -> _State:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.NamedExpr):
+                state = self._named_expr(state, sub)
+            elif isinstance(sub, ast.Call):
+                state = self._scan_call(state, sub)
+            elif isinstance(sub, ast.Attribute) and isinstance(
+                sub.ctx, ast.Load
+            ):
+                state = self._scan_read(state, sub)
+        return state
+
+    def _named_expr(self, state: _State, node: ast.NamedExpr) -> _State:
+        """``(lease := ring.acquire())`` mints exactly like an
+        assignment — the walrus form must not silently disarm
+        tracking."""
+        oids: frozenset | None = None
+        if isinstance(node.value, ast.Call):
+            spec = _mint_spec_for_call(
+                self.index, self.resolver, self.wrappers, node.value
+            )
+            if spec is not None:
+                state, oid = self._mint(
+                    state, node.value, spec, spec.initial
+                )
+                oids = frozenset({oid})
+        elif isinstance(node.value, ast.Attribute):
+            spec = self.index.mint_attrs.get(node.value.attr)
+            if spec is not None:
+                state, oid = self._mint(state, node.value, spec, _ADOPTED)
+                oids = frozenset({oid})
+        elif isinstance(node.value, ast.Name):
+            oids = self._tracked(state, node.value) or None
+        if isinstance(node.target, ast.Name):
+            state = self._bind(state, node.target.id, oids, node.lineno)
+        return state
+
+    def _scan_read(self, state: _State, attr: ast.Attribute) -> _State:
+        for oid in self._tracked(state, attr.value):
+            spec, mint_line = self.obj_info[oid]
+            allowed = spec.reads.get(attr.attr)
+            if allowed is None:
+                continue
+            cur = state[1].get(oid, frozenset()) - {_ESCAPED}
+            # Any-path rule, mirroring _apply_op: a read that is illegal
+            # on SOME merged path (read-after-void behind a branch) is a
+            # finding — all-paths-bad would only catch straight lines.
+            bad = cur - allowed - {_ADOPTED, _BORROWED}
+            if bad:
+                self._report(
+                    "PROT001", attr.lineno, f"{oid}:read:{attr.attr}",
+                    f".{attr.attr} read on a {spec.name} object (minted "
+                    f"line {mint_line}) that can already be {sorted(bad)} "
+                    f"— legal only in {sorted(allowed)}",
+                )
+        return state
+
+    def _scan_call(self, state: _State, call: ast.Call) -> _State:
+        func = call.func
+        applied: set[tuple] = set()
+        # Receiver form: lease.commit(), slots.release(gen).
+        if isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name
+        ):
+            for oid in self._tracked(state, func.value):
+                if func.attr in self.obj_info[oid][0].ops:
+                    applied.add((oid, func.attr))
+        # Consuming form: ring.void(lease), holder.void(lease).
+        if isinstance(func, ast.Attribute):
+            for arg in call.args:
+                for oid in self._tracked(state, arg):
+                    if func.attr in self.obj_info[oid][0].ops:
+                        applied.add((oid, func.attr))
+        # Summary form: a resolvable callee that op's its parameter.
+        for hit in self.resolver.callees(call):
+            for idx, spec, op in self.param_ops.get(id(hit.fn), []):
+                if idx >= len(call.args):
+                    continue
+                for oid in self._tracked(state, call.args[idx]):
+                    if (
+                        self.obj_info[oid][0].name == spec.name
+                        and op in self.obj_info[oid][0].ops
+                    ):
+                        applied.add((oid, op))
+        for oid, op in sorted(applied, key=str):
+            state = self._apply_op(state, oid, op, call.lineno)
+        # PROT004: one call combining objects from two distinct mint
+        # sites of the same protocol (a batch/dispatch mixing
+        # generations). Per-argument sets, so a merge-induced multi-site
+        # binding of ONE argument never trips it.
+        per_arg: list[tuple[str, frozenset]] = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            # Borrowed parameters are excluded: their "mint sites" are
+            # formal parameters, not acquire sites — a helper taking a
+            # lease plus a payload (both seeded borrowed by the param-op
+            # summary) is not a generation mix. Real mixing is checked
+            # in the caller, where the acquire sites are visible.
+            oids = frozenset(
+                o for o in self._tracked(state, arg)
+                if _BORROWED not in state[1].get(o, frozenset())
+            )
+            for spec_name in {self.obj_info[o][0].name for o in oids}:
+                per_arg.append(
+                    (spec_name,
+                     frozenset(o for o in oids
+                               if self.obj_info[o][0].name == spec_name))
+                )
+        by_spec: dict[str, list[frozenset]] = {}
+        for spec_name, oids in per_arg:
+            by_spec.setdefault(spec_name, []).append(oids)
+        for spec_name, groups in by_spec.items():
+            if len(groups) < 2:
+                continue
+            distinct = set()
+            for g in groups:
+                distinct.add(min(g, key=str))
+            if len(distinct) >= 2:
+                lines = sorted({self.obj_info[o][1] for g in groups
+                                for o in g})
+                self._report(
+                    "PROT004", call.lineno, f"mix:{spec_name}",
+                    f"call combines {spec_name} objects from distinct "
+                    f"mint sites (lines {lines}): a mixed-generation "
+                    "batch/dispatch breaks the generation fence",
+                )
+        return state
+
+    # ------------------------------------------------------------ stmts
+
+    def _bind(
+        self,
+        state: _State,
+        name: str,
+        oids: frozenset | None,
+        line: int | None = None,
+        report: bool = True,
+    ):
+        """Rebind ``name``; objects orphaned by the rebind (no remaining
+        variable references them) leave the abstract state — their fate
+        is decided HERE: an open, un-escaped object dying on a rebind is
+        a leak (PROT002), a narrowed-to-None one never existed on this
+        path (``report=False``). Keeping dead objects out of the state
+        is what makes the per-site strong update at a mint sound across
+        merge points (a path that lost its binding must not poison the
+        fresh lease's state)."""
+        vars_out, objs = state
+        vars_out = dict(vars_out)
+        old = vars_out.get(name, frozenset())
+        if oids:
+            vars_out[name] = oids
+        else:
+            vars_out.pop(name, None)
+        orphans = old - (oids or frozenset())
+        if orphans:
+            still_referenced = frozenset().union(
+                *vars_out.values()
+            ) if vars_out else frozenset()
+            orphans -= still_referenced
+        if orphans:
+            objs = dict(objs)
+            for oid in orphans:
+                st = objs.pop(oid, frozenset())
+                if not report or line is None:
+                    continue
+                if st & {_ESCAPED, _BORROWED}:
+                    continue
+                spec, mint_line = self.obj_info[oid]
+                leaked = st & spec.open_states
+                if leaked and not self.module.annotations.waived(
+                    mint_line, "protocol-ok"
+                ):
+                    self._report(
+                        "PROT002", mint_line, f"{oid}:leak",
+                        f"{spec.name} object minted here is still "
+                        f"{sorted(leaked)} when its last reference is "
+                        f"rebound at line {line}: close it "
+                        f"({', '.join(sorted(spec.ops)) or 'hand it off'})"
+                        " first, or declare the hand-off",
+                    )
+        return vars_out, objs
+
+    def _assign_like(self, state, value, targets, line):
+        """Shared by Assign/AnnAssign: returns (post, exc_state)."""
+        state = self._scan_expr(state, value)
+        exc_state = state  # a raising mint call produced no object
+        oids: frozenset | None = None
+        if isinstance(value, ast.Call):
+            spec = _mint_spec_for_call(
+                self.index, self.resolver, self.wrappers, value
+            )
+            if spec is not None:
+                state, oid = self._mint(state, value, spec, spec.initial)
+                oids = frozenset({oid})
+        elif isinstance(value, ast.Attribute):
+            spec = self.index.mint_attrs.get(value.attr)
+            if spec is not None:
+                state, oid = self._mint(state, value, spec, _ADOPTED)
+                oids = frozenset({oid})
+        elif isinstance(value, ast.Name):
+            oids = self._tracked(state, value) or None
+        for target in targets:
+            elts = target.elts if isinstance(target, ast.Tuple) else [target]
+            for elt in elts:
+                if isinstance(elt, ast.Name):
+                    state = self._bind(state, elt.id, oids, line)
+                elif oids and (
+                    isinstance(elt, ast.Attribute)
+                    and isinstance(elt.value, ast.Name)
+                    and elt.value.id == "self"
+                ):
+                    # A self-store is the one escape-with-discharge: it
+                    # hands the object to the instance's owner (PROT003
+                    # unless the hand-off is declared). Stores into
+                    # other objects/containers are NO-OPS either way —
+                    # they copy a value (request.generation = gen), they
+                    # neither discharge the obligation nor leak.
+                    for oid in oids:
+                        state = self._escape(
+                            state, oid, line,
+                            f"stored to self.{elt.attr}", flag=True,
+                        )
+        return state, exc_state
+
+    def transfer(self, stmt: ast.stmt | None, state: _State):
+        """(normal_out, exc_out) for one CFG node."""
+        if stmt is None:
+            return state, state
+        line = stmt.lineno
+        if isinstance(stmt, ast.Assign):
+            return self._assign_like(state, stmt.value, stmt.targets, line)
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            return self._assign_like(state, stmt.value, [stmt.target], line)
+        if isinstance(stmt, ast.AugAssign):
+            state = self._scan_expr(state, stmt.value)
+            return state, state
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                state = self._scan_expr(state, stmt.value)
+                values = (
+                    stmt.value.elts
+                    if isinstance(stmt.value, ast.Tuple)
+                    else [stmt.value]
+                )
+                for v in values:
+                    for oid in self._tracked(state, v):
+                        spec, _ = self.obj_info[oid]
+                        # A facade (the mint API or a proven wrapper)
+                        # re-exports a FRESH object; returning a used
+                        # lease (written/committed/voided) leaks it past
+                        # the scope its state machine lives in.
+                        pristine = state[1].get(oid, frozenset()) <= {
+                            spec.initial, _BORROWED, _ESCAPED,
+                        }
+                        facade = pristine and (
+                            self.fn_name in spec.facade_names()
+                            or id(self.fn) in self.wrappers
+                        )
+                        state = self._escape(
+                            state, oid, line,
+                            f"returned from {self.fn_name}",
+                            flag=not facade,
+                        )
+            return state, state
+        if isinstance(stmt, (ast.If, ast.While)):
+            state = self._scan_expr(state, stmt.test)
+            return state, state
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            state = self._scan_expr(state, stmt.iter)
+            for elt in ast.walk(stmt.target):
+                if isinstance(elt, ast.Name):
+                    state = self._bind(state, elt.id, None, line)
+            return state, state
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            # ``with ring.acquire() as lease:`` mints exactly like an
+            # assignment — the context-manager form must not silently
+            # disarm tracking. A raising mint produced no object, so the
+            # exc state snapshots before each item's mint.
+            exc_state = state
+            for item in stmt.items:
+                state = self._scan_expr(state, item.context_expr)
+                exc_state = state
+                oids: frozenset | None = None
+                if isinstance(item.context_expr, ast.Call):
+                    spec = _mint_spec_for_call(
+                        self.index, self.resolver, self.wrappers,
+                        item.context_expr,
+                    )
+                    if spec is not None:
+                        state, oid = self._mint(
+                            state, item.context_expr, spec, spec.initial
+                        )
+                        oids = frozenset({oid})
+                if isinstance(item.optional_vars, ast.Name):
+                    state = self._bind(
+                        state, item.optional_vars.id, oids, line
+                    )
+            return state, exc_state
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    state = self._bind(state, t.id, None, line)
+            return state, state
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return self._bind(state, stmt.name, None, line), state
+        if isinstance(stmt, (ast.Expr, ast.Raise, ast.Assert)):
+            for expr in ast.iter_child_nodes(stmt):
+                state = self._scan_expr(state, expr)
+            if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Call
+            ):
+                # A bare mint statement discards the object on the spot:
+                # nothing can ever close it. Report immediately — the
+                # orphan logic only sees rebinds, and there is no name
+                # to rebind.
+                spec = _mint_spec_for_call(
+                    self.index, self.resolver, self.wrappers, stmt.value
+                )
+                if (
+                    spec is not None
+                    and spec.initial in spec.open_states
+                    and not self.module.annotations.waived(
+                        line, "protocol-ok"
+                    )
+                ):
+                    self._report(
+                        "PROT002", line, f"discard:{line}",
+                        f"{spec.name} mint result discarded: the object "
+                        f"is open ({spec.initial!r}) and already "
+                        "unreachable — bind it and close it "
+                        f"({', '.join(sorted(spec.ops)) or 'hand it off'})",
+                    )
+            return state, state
+        return state, state
+
+    # ------------------------------------------------------------- run
+
+    def analyze(self) -> None:
+        flow = build_cfg(self.fn)
+        states: dict[int, _State] = {flow.entry: self._initial()}
+        work = [flow.entry]
+        visits = 0
+        limit = 50 * (len(flow.stmts) + 1)
+        while work and visits < limit:
+            visits += 1
+            n = work.pop()
+            state = states.get(n)
+            if state is None:
+                continue
+            normal, exc = self.transfer(flow.stmts[n], state)
+            for target, kind, narrow in flow.succ[n]:
+                out = exc if kind == "exc" else normal
+                if narrow is not None and narrow[0] == "drop":
+                    out = self._bind(out, narrow[1], None, report=False)
+                merged = _join(states.get(target), out)
+                if merged != states.get(target):
+                    states[target] = merged
+                    work.append(target)
+        self._check_exits(flow, states)
+        self._check_thread_captures()
+
+    def _check_exits(self, flow: CFG, states: dict[int, _State]) -> None:
+        for exit_node, kind in (
+            (flow.exit, "function exit"),
+            (flow.raise_exit, "an exception edge"),
+        ):
+            state = states.get(exit_node)
+            if state is None:
+                continue
+            for oid, st in state[1].items():
+                if _ESCAPED in st or _BORROWED in st:
+                    continue
+                spec, mint_line = self.obj_info[oid]
+                leaked = st & spec.open_states
+                if not leaked:
+                    continue
+                if self.module.annotations.waived(mint_line, "protocol-ok"):
+                    continue
+                self._report(
+                    "PROT002", mint_line, f"{oid}:leak",
+                    f"{spec.name} object minted here can reach {kind} of "
+                    f"{self.fn_name} still {sorted(leaked)}: close it "
+                    f"({', '.join(sorted(spec.ops)) or 'hand it off'}) on "
+                    "every path, including exception edges, or declare the "
+                    "hand-off",
+                )
+
+    def _check_thread_captures(self) -> None:
+        mint_targets: set[str] = set()
+        for sub in ast.walk(self.fn):
+            if isinstance(sub, ast.Assign) and isinstance(
+                sub.value, ast.Call
+            ):
+                spec = _mint_spec_for_call(
+                    self.index, self.resolver, self.wrappers, sub.value
+                )
+                if spec is None:
+                    continue
+                for t in sub.targets:
+                    for elt in (
+                        t.elts if isinstance(t, ast.Tuple) else [t]
+                    ):
+                        if isinstance(elt, ast.Name):
+                            mint_targets.add(elt.id)
+        if not mint_targets:
+            return
+        capturing: dict[str, ast.AST] = {}
+        for sub in ast.walk(self.fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if sub is self.fn:
+                    continue
+                free = {
+                    n.id
+                    for n in ast.walk(sub)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                }
+                if free & mint_targets:
+                    capturing[sub.name] = sub
+        for sub in ast.walk(self.fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            handed = []
+            for kw in sub.keywords:
+                if kw.arg == "target":
+                    if (
+                        isinstance(kw.value, ast.Name)
+                        and kw.value.id in capturing
+                    ):
+                        handed.append(kw.value.id)
+                    elif isinstance(kw.value, ast.Lambda):
+                        free = {
+                            n.id
+                            for n in ast.walk(kw.value)
+                            if isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Load)
+                        }
+                        if free & mint_targets:
+                            handed.append("<lambda>")
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "submit"
+                and sub.args
+                and isinstance(sub.args[0], ast.Name)
+                and sub.args[0].id in capturing
+            ):
+                handed.append(sub.args[0].id)
+            for name in handed:
+                self._report(
+                    "PROT003", sub.lineno, f"thread:{name}",
+                    f"closure {name!r} captures a protocol object and is "
+                    "handed to a thread target: the lease outlives its "
+                    "acquiring frame on another thread — pass the work "
+                    "through the declared hand-off instead",
+                )
+
+
+# ------------------------------------------------------------------- run
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """``targets`` (incremental cache): PROT findings attach to the file
+    containing the flagged statement and are re-derived per file; the
+    cross-file context (specs, wrappers, param-op summaries) is rebuilt
+    from the whole project on every non-warm run, and any cross-file
+    code or declaration change invalidates the env hash."""
+    specs = collect_specs(project)
+    index = _SpecIndex(specs)
+    resolvers = _ResolverCache(project)
+    contexts = [
+        (module, cls_name, fn)
+        for module in project.modules
+        for cls_name, fn in _functions(module)
+    ]
+    wrappers = _mint_wrappers(index, resolvers, contexts)
+    param_ops = _param_op_summaries(index, resolvers, contexts)
+    findings: list[Finding] = []
+    for module, cls_name, fn in contexts:
+        if targets is not None and module.path not in targets:
+            continue
+        _FunctionAnalyzer(
+            module, fn, index, wrappers, param_ops, findings,
+            resolvers.get(module, cls_name, fn),
+        ).analyze()
+    return findings

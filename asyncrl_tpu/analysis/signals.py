@@ -1,0 +1,343 @@
+"""Async-signal-safety pass (SIG0xx).
+
+A Python signal handler runs *between bytecodes on the main thread*,
+inside whatever frame the signal interrupted. That makes two whole bug
+families possible that no lock discipline sees: the handler can re-enter
+a non-reentrant lock the interrupted frame (or a prior nested signal)
+already holds, and it can re-enter stdlib machinery that is not
+reentrancy-safe (buffered I/O raises ``RuntimeError: reentrant call``,
+a blocking queue put can wedge the main thread forever). PR 10's review
+caught exactly such a reentrancy deadlock in the drain coordinator by
+hand; this pass mechanizes that review.
+
+The pass computes the closure of functions reachable from every
+``signal.signal``-registered handler (the handler argument resolved to a
+method/function, reachability through the shared call graph) and flags:
+
+- **SIG001** — acquisition of a threading lock (``with self._lock:``,
+  ``.acquire()``) in handler-reachable code. A plain ``Lock`` deadlocks
+  against the interrupted frame; an ``RLock``/``Condition`` silently
+  re-enters and corrupts the critical section instead. The acquisition
+  is sanctioned when it is *reentrancy-latched* — the PR-10 idiom the
+  pass recognizes structurally: before the acquisition, the function
+  (1) early-returns when an Event latch ``is_set()`` and (2) ``set()``s
+  that latch, so a nested signal observes the latch and never reaches
+  the lock. Anything else needs ``# lint: signal-safe-ok(<reason>)``
+  naming the protocol state that makes it safe.
+- **SIG002** — blocking or buffered-I/O calls in handler-reachable code:
+  ``print``/``open``/``input``, ``time.sleep``, ``json.dump``/
+  ``pickle.dump``, ``logging.*``, timeout-less queue ``put``/``join``/
+  ``flush``, stream ``.write``. ``os.write`` is the sanctioned
+  async-signal-safe escape hatch (unbuffered fd write, no lock).
+- **SIG003** — a ``signal.signal`` registration site outside the
+  documented main-thread path: the registering function must guard with
+  a ``threading.current_thread() is threading.main_thread()`` check
+  (CPython raises otherwise, but only on the code path that executes —
+  a registration buried in a worker-thread branch ships silently), or
+  carry a ``signal-safe-ok`` waiver naming the latch that confines it
+  to the main thread.
+
+Like every pass here, this is a linter, not a verifier: reachability is
+the conservative name-based call graph (callables stored into attributes
+— ``self._exit = os._exit`` — are invisible), and the latch idiom is
+matched structurally, not proved. The deletion proofs in
+tests/test_protocols.py pin the teeth: removing the latch guard from
+``DrainCoordinator.request`` trips SIG001.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from asyncrl_tpu.analysis.core import LOCK_TYPES, Finding, Project, _dotted
+
+
+# Resolved call tails that block or re-enter buffered machinery.
+_BLOCKING_RESOLVED = {
+    "time.sleep",
+    "json.dump",
+    "pickle.dump",
+    "marshal.dump",
+}
+_BLOCKING_BUILTINS = {"print", "open", "input"}
+# Method names that block or flush buffered state on arbitrary
+# receivers (queue hand-offs, thread/queue joins, stream I/O). `.get`
+# is deliberately absent — dict.get would drown the signal. os.write is
+# exempted by resolution before this name check runs.
+_BLOCKING_METHODS = {"put", "put_nowait", "flush", "write", "join"}
+
+
+def _handler_roots(project: Project, graph):
+    """(registration_call, enclosing_fn_node, handler CallNode|None) for
+    every ``signal.signal(sig, handler)`` in the project."""
+    out = []
+    for module in project.modules:
+        enclosing: dict[int, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    enclosing.setdefault(id(sub), node)
+        class_of: dict[int, str] = {}
+        for cls in module.tree.body:
+            if isinstance(cls, ast.ClassDef):
+                for sub in ast.walk(cls):
+                    class_of[id(sub)] = cls.name
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if module.resolve(node.func) != "signal.signal":
+                continue
+            if len(node.args) < 2:
+                continue
+            handler = node.args[1]
+            target = None
+            if (
+                isinstance(handler, ast.Attribute)
+                and isinstance(handler.value, ast.Name)
+                and handler.value.id == "self"
+                and class_of.get(id(node)) is not None
+            ):
+                target = graph.methods.get(
+                    (class_of[id(node)], handler.attr)
+                )
+            elif isinstance(handler, ast.Name):
+                target = graph.top_level.get(module, {}).get(handler.id)
+            out.append((module, node, enclosing.get(id(node)), target))
+    return out
+
+
+def _has_main_thread_guard(fn: ast.AST, module, before_line: int) -> bool:
+    """Does ``fn`` check current_thread against main_thread before
+    ``before_line``? (The documented registration discipline.)"""
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Compare) or sub.lineno > before_line:
+            continue
+        names = {"current_thread", "main_thread"}
+        seen = set()
+        for expr in [sub.left, *sub.comparators]:
+            if isinstance(expr, ast.Call):
+                resolved = module.resolve(expr.func)
+                if resolved:
+                    seen.add(resolved.rsplit(".", 1)[-1])
+        if names <= seen:
+            return True
+    return False
+
+
+def _latch_protected(fn: ast.AST, lock_line: int) -> bool:
+    """The reentrancy-latch idiom: before ``lock_line``, the function
+    (1) early-returns/raises/exits when some ``<latch>.is_set()`` and
+    (2) ``set()``s the same latch. A nested signal then observes the
+    latch and never reaches the lock."""
+    def latch_key(expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute):
+            return _dotted(expr)
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+    guarded: set[str] = set()
+    for stmt in getattr(fn, "body", []):
+        if (
+            isinstance(stmt, ast.If)
+            and isinstance(stmt.test, ast.Call)
+            and isinstance(stmt.test.func, ast.Attribute)
+            and stmt.test.func.attr == "is_set"
+            and stmt.lineno < lock_line
+            and stmt.body
+            and isinstance(stmt.body[-1], (ast.Return, ast.Raise))
+        ):
+            key = latch_key(stmt.test.func.value)
+            if key is not None:
+                guarded.add(key)
+    if not guarded:
+        return False
+    for sub in ast.walk(fn):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "set"
+            and sub.lineno < lock_line
+            and latch_key(sub.func.value) in guarded
+        ):
+            return True
+    return False
+
+
+def _lock_attrs(node) -> dict[str, str]:
+    """attr -> lock type for the node's class (``self._lock =
+    threading.Lock()`` bindings)."""
+    if node.cls is None:
+        return {}
+    return {
+        attr: type_name
+        for attr, type_name in node.cls.attr_types.items()
+        if type_name in LOCK_TYPES
+    }
+
+
+def _module_locks(module) -> set[str]:
+    out = set()
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(
+            stmt.value, ast.Call
+        ):
+            resolved = module.resolve(stmt.value.func)
+            if resolved and resolved.rsplit(".", 1)[-1] in LOCK_TYPES:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    # ``targets`` is accepted for pass-protocol uniformity but ignored:
+    # handler reachability folds registrations and call edges from the
+    # whole project, so SIG findings are recomputed in full on every run
+    # (global codes for the incremental cache — see cache.GLOBAL_CODES).
+    del targets
+    graph = project.call_graph
+    roots = _handler_roots(project, graph)
+    if not roots:
+        return []
+    findings: list[Finding] = []
+
+    # ---- SIG003: registration sites outside the main-thread path.
+    for module, call, enclosing_fn, _handler in roots:
+        ann = module.annotations
+        if ann.waived(call.lineno, "signal-safe-ok"):
+            continue
+        if enclosing_fn is not None and _has_main_thread_guard(
+            enclosing_fn, module, call.lineno
+        ):
+            continue
+        where = (
+            f"in {enclosing_fn.name}" if enclosing_fn is not None
+            else "at module level"
+        )
+        findings.append(
+            Finding(
+                "SIG003", module.path, call.lineno,
+                f"signal.signal registration {where} outside the "
+                "documented main-thread path: guard with a "
+                "threading.current_thread() is threading.main_thread() "
+                "check before registering, or waive with "
+                "'# lint: signal-safe-ok(<reason>)' naming the latch "
+                "that confines this call to the main thread",
+            )
+        )
+
+    # ---- handler-reachable closure.
+    from asyncrl_tpu.analysis.ownership import _reach
+
+    handler_nodes = [h for _, _, _, h in roots if h is not None]
+    if not handler_nodes:
+        return findings
+    reached = _reach(graph, handler_nodes)
+    handler_names = sorted({n.qualname for n in handler_nodes})
+
+    lock_attr_cache: dict[int, dict[str, str]] = {}
+    module_lock_cache: dict[int, set[str]] = {}
+    for node in sorted(reached, key=lambda n: (n.module.path, n.name)):
+        ann = node.module.annotations
+        cls_key = id(node.cls) if node.cls is not None else 0
+        if cls_key not in lock_attr_cache:
+            lock_attr_cache[cls_key] = _lock_attrs(node)
+        lock_attrs = lock_attr_cache[cls_key]
+        if id(node.module) not in module_lock_cache:
+            module_lock_cache[id(node.module)] = _module_locks(node.module)
+        module_locks = module_lock_cache[id(node.module)]
+
+        def lock_name(expr) -> str | None:
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in lock_attrs
+            ):
+                return f"self.{expr.attr} ({lock_attrs[expr.attr]})"
+            if isinstance(expr, ast.Name) and expr.id in module_locks:
+                return expr.id
+            return None
+
+        acquisitions: list[tuple[int, str]] = []
+        for sub in ast.walk(node.fn):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    name = lock_name(item.context_expr)
+                    if name is not None:
+                        acquisitions.append((item.context_expr.lineno, name))
+            elif (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "acquire"
+            ):
+                name = lock_name(sub.func.value)
+                if name is not None:
+                    acquisitions.append((sub.lineno, name))
+        for line, name in acquisitions:
+            if ann.waived(line, "signal-safe-ok"):
+                continue
+            if _latch_protected(node.fn, line):
+                continue
+            findings.append(
+                Finding(
+                    "SIG001", node.module.path, line,
+                    f"{node.qualname} acquires {name} and is reachable "
+                    f"from signal handler(s) {handler_names}: the handler "
+                    "runs between bytecodes of the interrupted frame — a "
+                    "Lock deadlocks against it, an RLock/Condition "
+                    "silently re-enters it. Latch the function "
+                    "(early-return on an Event already set, set it before "
+                    "the lock) or waive with '# lint: "
+                    "signal-safe-ok(<reason>)'",
+                )
+            )
+
+        for sub in ast.walk(node.fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            resolved = node.module.resolve(sub.func)
+            reason = None
+            if resolved in _BLOCKING_RESOLVED:
+                reason = resolved
+            elif resolved == "os.write":
+                continue  # THE sanctioned async-signal-safe write
+            elif resolved is not None and resolved.startswith("logging."):
+                reason = resolved
+            elif (
+                isinstance(sub.func, ast.Name)
+                and sub.func.id in _BLOCKING_BUILTINS
+            ):
+                reason = sub.func.id
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _BLOCKING_METHODS
+                # .join only in the timeout-less zero-arg form: that is
+                # queue.join()/thread.join() (unbounded block), while
+                # sep.join(parts) — one arg — is the ubiquitous string
+                # method and thread.join(timeout) is bounded.
+                and not (
+                    sub.func.attr == "join" and (sub.args or sub.keywords)
+                )
+            ):
+                reason = f".{sub.func.attr}()"
+            if reason is None:
+                continue
+            if ann.waived(sub.lineno, "signal-safe-ok"):
+                continue
+            findings.append(
+                Finding(
+                    "SIG002", node.module.path, sub.lineno,
+                    f"{node.qualname} calls {reason} and is reachable "
+                    f"from signal handler(s) {handler_names}: blocking/"
+                    "buffered machinery re-entered mid-operation wedges "
+                    "or raises (reentrant-call RuntimeError). Use "
+                    "os.write on a raw fd, or defer the work past the "
+                    "handler and waive with '# lint: "
+                    "signal-safe-ok(<reason>)'",
+                )
+            )
+    return findings

@@ -592,6 +592,7 @@ class ActorThread(threading.Thread):
                     )
                 if lease is None:
                     break  # stopped/abandoned while waiting
+                # lint: protocol-ok(sanctioned hand-off: the supervisor voids _open_lease when it retires this thread — the one escape the lease protocol is built around)
                 self._open_lease = lease
                 buffer = lease.buffer
             params, version = self.store.get()

@@ -89,6 +89,19 @@ TRIGGER_DETECTORS = ("nonfinite_loss", "grad_explosion", "entropy_collapse")
 COOLDOWN_WINDOWS = 2
 
 
+def _sigsafe_write(message: str) -> None:
+    """Write one line to stderr WITHOUT the buffered-I/O machinery.
+    This runs inside the signal handler's frame: ``print`` would re-enter
+    ``sys.stderr``'s buffer lock if the interrupted main-thread frame was
+    mid-write (``RuntimeError: reentrant call``), while a raw fd write is
+    the one async-signal-safe way to speak. Best-effort: a closed fd 2
+    must not turn a routine preemption into a crash."""
+    try:
+        os.write(2, (message + "\n").encode())
+    except OSError:
+        pass
+
+
 class PreemptedExit(SystemExit):
     """Raised out of ``train()`` after a completed preemption drain: the
     final checkpoint is durable and the process should exit with
@@ -162,9 +175,9 @@ class DrainCoordinator:
         self._requested = threading.Event()
         self._finished = threading.Event()
         self._lock = threading.Lock()
-        # lint: thread-shared-ok(written once by the first request() before _requested flips; readers only format it into messages after the flip)
+        # lint: thread-shared-ok(reentrancy-latch protocol state: request() writes signum exactly once, strictly before _requested.set() — the SIG001-checked latch — and every reader is gated on requested being True, so the Event publication edge orders the write before any read)
         self.signum: int | None = None
-        # lint: thread-shared-ok(written only by install/uninstall on the main thread; other threads merely read the boolean to pick the signal-vs-direct request route, and either route drains correctly)
+        # lint: thread-shared-ok(installed-latch protocol state: written only by install/uninstall, which the SIG003 main-thread discipline confines to the registering main thread; cross-thread readers like scripted_preempt only pick the signal-vs-direct request route, and either route drains)
         self.installed = False
         self._prev: dict[int, Any] = {}
         self._watchdog: threading.Thread | None = None  # guarded-by: _lock
@@ -194,6 +207,7 @@ class DrainCoordinator:
             return
         for sig, prev in self._prev.items():
             try:
+                # lint: signal-safe-ok(installed-latch protocol: install() sets self.installed only after registering on the main thread, and the guard above returns unless installed — so this restore runs on the same main thread)
                 signal.signal(sig, prev)
             except (ValueError, TypeError):  # interpreter shutting down
                 pass
@@ -204,10 +218,9 @@ class DrainCoordinator:
         del frame
         if self._requested.is_set():
             # Second signal while draining: stop being graceful.
-            print(
+            _sigsafe_write(
                 "asyncrl_tpu: second signal during drain; exiting now "
-                f"({EXIT_DEADLINE})",
-                file=sys.stderr,
+                f"({EXIT_DEADLINE})"
             )
             self._exit(EXIT_DEADLINE)
             return  # only reachable with an injected exit_fn
@@ -231,10 +244,9 @@ class DrainCoordinator:
             return
         self.signum = int(signum)
         self._requested.set()
-        print(
+        _sigsafe_write(
             f"asyncrl_tpu: drain requested ({reason}, signal "
-            f"{self.signum}); finishing within {self.grace_s:.0f}s",
-            file=sys.stderr,
+            f"{self.signum}); finishing within {self.grace_s:.0f}s"
         )
         watchdog = threading.Thread(
             target=self._deadline,

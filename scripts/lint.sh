@@ -2,18 +2,32 @@
 # Lint gate for asyncrl-tpu: ruff (curated rule set in pyproject.toml)
 # plus the framework-aware static passes (python -m asyncrl_tpu.analysis:
 # lock discipline, JAX purity, donation safety, thread ownership,
-# deadlock/lock-order, device contracts, config contracts). The default
-# package run covers EVERY subpackage — asyncrl_tpu/obs/ (span rings,
-# flight recorder) included, so its guarded-by/thread-entry annotations
-# gate like the rest of the concurrency substrate.
+# deadlock/lock-order, device contracts, config contracts, protocol
+# typestate, async-signal safety). The default package run covers EVERY
+# subpackage — asyncrl_tpu/obs/ (span rings, flight recorder) included,
+# so its guarded-by/thread-entry annotations gate like the rest of the
+# concurrency substrate — plus the scripts/*.py entry points under the
+# configflow pass (CFG003: smoke scripts can't invent unregistered
+# ASYNCRL_* env vars).
 #
-#   scripts/lint.sh            # lint the package (CI gate)
+#   scripts/lint.sh            # lint the package + script entries (CI gate)
+#   scripts/lint.sh --fast     # warm-cache mode: a full analyzer cache hit
+#                              # replays the manifest AND skips the ruff
+#                              # re-run — the gate stays sub-second on an
+#                              # unchanged tree (the verify skill's loop).
+#                              # The skip keys on the PACKAGE manifest, so
+#                              # ruff findings in tests/, scripts/, or
+#                              # bench.py edits are deferred to the next
+#                              # full run — CI uses plain lint.sh.
 #   scripts/lint.sh path.py    # lint specific files (fixtures exit nonzero)
 #
 # The package run is incremental (--cache-dir .analysis-cache: a second
 # consecutive run with no edits replays the manifest without re-parsing)
 # and machine-readable (--format json into lint_report.json, stable
-# finding IDs). It exits nonzero on any finding NOT grandfathered in
+# finding IDs). The scripts run caches separately
+# (.analysis-cache-scripts): manifests key on the pass tuple, so sharing
+# one cache dir would invalidate both manifests every run. Both runs exit
+# nonzero on any finding NOT grandfathered in
 # asyncrl_tpu/analysis/baseline.json — new findings gate PRs while
 # baselined ones burn down explicitly. ruff is optional at runtime (not
 # vendored in the training image); the analysis passes always run and
@@ -21,23 +35,55 @@
 set -u
 cd "$(dirname "$0")/.."
 
-rc=0
-if command -v ruff >/dev/null 2>&1; then
-    ruff check asyncrl_tpu tests scripts bench.py || rc=1
-elif python -c "import ruff" >/dev/null 2>&1; then
-    python -m ruff check asyncrl_tpu tests scripts bench.py || rc=1
-else
-    echo "lint.sh: ruff not installed; skipping ruff (analysis passes still gate)" >&2
+fast=0
+if [ "${1:-}" = "--fast" ]; then
+    fast=1
+    shift
 fi
 
+run_ruff() {
+    if command -v ruff >/dev/null 2>&1; then
+        ruff check asyncrl_tpu tests scripts bench.py || rc=1
+    elif python -c "import ruff" >/dev/null 2>&1; then
+        python -m ruff check asyncrl_tpu tests scripts bench.py || rc=1
+    else
+        echo "lint.sh: ruff not installed; skipping ruff (analysis passes still gate)" >&2
+    fi
+}
+
+rc=0
 if [ "$#" -gt 0 ]; then
     # Explicit paths: plain text, no cache (fixture runs must not pollute
     # or consult the package manifest).
+    run_ruff
     python -m asyncrl_tpu.analysis "$@" || rc=1
+    exit $rc
+fi
+
+python -m asyncrl_tpu.analysis \
+    --cache-dir .analysis-cache \
+    --format json --stats \
+    > lint_report.json || rc=1
+
+python -m asyncrl_tpu.analysis \
+    --pass configflow \
+    --cache-dir .analysis-cache-scripts \
+    scripts/*.py || rc=1
+
+if [ "$fast" -eq 1 ] && [ "$rc" -eq 0 ] && python - <<'EOF'
+import json
+import sys
+
+try:
+    with open("lint_report.json") as fh:
+        stats = json.load(fh)["stats"]
+except Exception:
+    sys.exit(1)
+sys.exit(0 if stats.get("cache") == "warm" else 1)
+EOF
+then
+    echo "lint.sh: --fast analyzer cache warm; skipping ruff re-run" >&2
 else
-    python -m asyncrl_tpu.analysis \
-        --cache-dir .analysis-cache \
-        --format json --stats \
-        > lint_report.json || rc=1
+    run_ruff
 fi
 exit $rc

@@ -137,6 +137,12 @@ QUICK: dict[str, object] = {
     # are ~15s combined. Tier-1 by the ISSUE 8 acceptance contract
     # (detectors proven to flip /healthz on every PR). Whole file ~20s.
     "test_introspect.py": "all",
+    # Protocol typestate + signal-safety passes (ISSUE 11): pure-AST;
+    # fixture corpus, live-tree deletion proofs (release/void/latch),
+    # grammar hardness, warm-cache soundness, stats zeros. ~10s, two CLI
+    # subprocess runs included. Tier-1 by the ISSUE 11 acceptance
+    # contract (deletion proofs pass on every PR).
+    "test_protocols.py": "all",  # 10s
     # Static checker (asyncrl_tpu/analysis/): pure-AST, no training; the
     # whole file (package-gates-clean + fixture corpus + lock/edge
     # deletion detection + cache correctness/speedup + baseline + JSON +

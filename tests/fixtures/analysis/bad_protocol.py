@@ -1,0 +1,70 @@
+"""Known-bad fixture: every PROT code the typestate pass must catch.
+
+The classes mirror the real staging API shapes (bare-name mint
+resolution: ``.acquire(...)`` assigned to a local) so the fixture trips
+the BUILT-IN staging-lease spec, not a bespoke one. Each function below
+is one canonical protocol violation; tests/test_protocols.py asserts
+the corpus trips PROT001-PROT004 and nothing here is accidentally
+clean."""
+
+import threading
+
+
+class StagingRing:
+    """Shape-alike of the real ring: acquire mints, void consumes."""
+
+    def acquire(self, stop=None):
+        return object()
+
+    def void(self, lease):
+        del lease
+
+
+def _risky():
+    raise RuntimeError("boom")
+
+
+class Worker:
+    def __init__(self):
+        self.parked = None
+
+    def use_after_void(self, ring):
+        lease = ring.acquire()
+        ring.void(lease)
+        lease.commit()  # PROT001: commit on a voided lease
+
+    def leak_on_exception(self, ring):
+        lease = ring.acquire()
+        _risky()  # PROT002: the exception edge exits with the lease held
+        lease.commit()
+
+    def leak_on_branch(self, ring, flag):
+        lease = ring.acquire()
+        if flag:
+            lease.commit()
+        # PROT002: the else path reaches function exit still held
+
+    def park_forever(self, ring):
+        # PROT003: a lease stored to self outlives its acquiring scope
+        self.parked = ring.acquire()
+
+    def leak_used_row(self, ring):
+        lease = ring.acquire()
+        lease.commit()
+        return lease  # PROT003: a USED lease escaping by return
+
+    def hand_to_thread(self, ring):
+        lease = ring.acquire()
+
+        def finisher():
+            lease.commit()
+
+        # PROT003: the closure carries the lease onto another thread
+        threading.Thread(target=finisher).start()
+
+    def mix_generations(self, ring, combine):
+        a = ring.acquire()
+        b = ring.acquire()
+        combine(a, b)  # PROT004: two mint sites reaching one call
+        a.commit()
+        b.commit()

@@ -1,0 +1,27 @@
+"""Known-bad fixture: every SIG code the async-signal-safety pass must
+catch. A handler registered without the main-thread guard (SIG003),
+taking a plain lock with no reentrancy latch (SIG001), and reaching
+buffered/blocking machinery (SIG002, two hops deep)."""
+
+import json
+import signal
+import threading
+
+
+class BadDaemon:
+    def __init__(self, sink):
+        self._lock = threading.Lock()
+        self._sink = sink
+
+    def start(self):
+        # SIG003: registration with no current_thread/main_thread guard
+        signal.signal(signal.SIGTERM, self._handle)
+
+    def _handle(self, signum, frame):
+        del signum, frame
+        with self._lock:  # SIG001: no reentrancy latch before the lock
+            self._notify()
+
+    def _notify(self):
+        print("terminating")  # SIG002: buffered stderr/stdout re-entry
+        json.dump({"sig": 1}, self._sink)  # SIG002: blocking dump

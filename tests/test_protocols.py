@@ -1,0 +1,706 @@
+"""Protocol typestate + async-signal-safety passes (ISSUE 11).
+
+Tier-1 contract, extending tests/test_analysis.py's pattern to the two
+new pass families:
+
+- the real package gates CLEAN under the protocols/signals passes (the
+  shipped baseline stays empty — every true finding was fixed or
+  reason-waived), while the known-bad fixture corpus trips
+  PROT001-PROT004 and SIG001-SIG003;
+- the passes detect what they guard, ON THE LIVE TREE: neutering the
+  real ``slots.release(generation)`` in serve/scheduler.py or the real
+  ``self._staging.void(lease)`` in api/sebulba_trainer.py (in-memory)
+  trips PROT002; removing DrainCoordinator.request's reentrancy-latch
+  guard, or wrapping the handler body in a plain ``with self._lock``,
+  trips SIG001; re-introducing ``print`` on the handler path trips
+  SIG002 — exactly the bug families PRs 6-10's reviews caught by hand;
+- annotations are load-bearing: stripping the actor's protocol-ok
+  hand-off waiver resurfaces PROT003, and a waiver-stripping
+  comment-only edit resurfaces PROT002 THROUGH the warm/partial cache
+  (the PR-4 stale-cache-soundness discipline); SIG findings are global
+  codes and replay through a warm manifest;
+- the ``# protocol:`` grammar declares new specs (the replay-ring
+  pattern) that the engine enforces like built-ins, and malformed
+  declarations are hard ANN013 errors;
+- ``--stats`` reports per-pass ZEROS on clean runs (a pass that ran
+  clean is distinguishable from a pass that never ran), and the new
+  codes round-trip ``--format json`` with stable IDs through a warm
+  cache.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import asyncrl_tpu
+from asyncrl_tpu import analysis
+from asyncrl_tpu.analysis import core, report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.dirname(os.path.abspath(asyncrl_tpu.__file__))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+SCHEDULER = os.path.join(PACKAGE, "serve", "scheduler.py")
+TRAINER = os.path.join(PACKAGE, "api", "sebulba_trainer.py")
+DURABILITY = os.path.join(PACKAGE, "runtime", "durability.py")
+SEBULBA = os.path.join(PACKAGE, "rollout", "sebulba.py")
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+def _lint(src, passes=("protocols", "signals")):
+    return analysis.check_source(textwrap.dedent(src), passes=passes)
+
+
+def _mutated(path, needle, replacement, count=1):
+    src = open(path).read()
+    assert needle in src, f"needle not found in {path}: {needle!r}"
+    mutated = src.replace(needle, replacement, count)
+    assert mutated != src
+    return mutated
+
+
+def _check_single(path, src, passes):
+    project = core.Project([core.SourceModule(path, src)])
+    return analysis.run_passes(project, passes)
+
+
+# ----------------------------------------------------------- the package
+
+
+def test_package_gates_clean_under_protocol_and_signal_passes():
+    findings = analysis.check_paths(
+        [PACKAGE], passes=("protocols", "signals")
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------- fixture corpus
+
+
+@pytest.mark.parametrize(
+    "fixture, expected",
+    [
+        ("bad_protocol.py", {"PROT001", "PROT002", "PROT003", "PROT004"}),
+        ("bad_signals.py", {"SIG001", "SIG002", "SIG003"}),
+    ],
+)
+def test_fixture_corpus_is_flagged(fixture, expected):
+    findings = analysis.check_paths([os.path.join(FIXTURES, fixture)])
+    assert expected <= codes(findings), (
+        f"{fixture} must trip {sorted(expected)}; got "
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+# ------------------------------------- deletion proofs on the LIVE tree
+
+
+def test_neutering_the_real_release_trips_prot002():
+    """The serve dispatch's generation lease: the real file is clean,
+    and emptying the ``finally: slots.release(generation)`` (in memory)
+    leaks the lease on every exit path — PROT002."""
+    assert not _check_single(
+        SCHEDULER, open(SCHEDULER).read(), ("protocols",)
+    )
+    mutated = _mutated(
+        SCHEDULER,
+        "                    slots.release(generation)",
+        "                    pass",
+    )
+    findings = _check_single(SCHEDULER, mutated, ("protocols",))
+    assert any(
+        f.code == "PROT002" and "params-lease" in f.message
+        for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_neutering_the_real_void_trips_prot002():
+    """The supervisor's lease adoption (``lease = ...._open_lease``)
+    carries a void obligation: dropping the real ``self._staging.void``
+    in _restart_actor (in memory) trips PROT002; the file is clean."""
+    assert not _check_single(TRAINER, open(TRAINER).read(), ("protocols",))
+    mutated = _mutated(
+        TRAINER,
+        "                self._staging.void(lease)",
+        "                pass",
+    )
+    findings = _check_single(TRAINER, mutated, ("protocols",))
+    assert any(
+        f.code == "PROT002" and "staging-lease" in f.message
+        for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_deguarding_request_trips_sig001():
+    """DrainCoordinator.request's lock is sanctioned ONLY by the
+    reentrancy latch (requested flips before the lock; a nested signal
+    early-returns). Removing the guard — the exact bug PR 10's review
+    caught by hand — must trip SIG001; the real file is clean."""
+    assert not _check_single(
+        DURABILITY, open(DURABILITY).read(), ("signals",)
+    )
+    mutated = _mutated(
+        DURABILITY,
+        "        if self._requested.is_set():\n            return",
+        "        if False:\n            return",
+    )
+    findings = _check_single(DURABILITY, mutated, ("signals",))
+    assert any(
+        f.code == "SIG001" and "request" in f.message for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_locking_the_handler_body_trips_sig001():
+    """Wrapping the handler's dispatch in a plain ``with self._lock:``
+    self-deadlocks against request's acquisition — SIG001."""
+    mutated = _mutated(
+        DURABILITY,
+        "        self.request(signum)",
+        "        with self._lock:\n            self.request(signum)",
+    )
+    findings = _check_single(DURABILITY, mutated, ("signals",))
+    assert any(
+        f.code == "SIG001" and "_handle" in f.message for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_reintroducing_print_on_the_handler_path_trips_sig002():
+    """The drain messages go through the os.write-based safe writer;
+    reverting request's call to ``print`` re-enters buffered stderr
+    inside the handler frame — SIG002."""
+    mutated = _mutated(
+        DURABILITY,
+        "        _sigsafe_write(\n            f\"asyncrl_tpu: drain requested",
+        "        print(\n            f\"asyncrl_tpu: drain requested",
+    )
+    findings = _check_single(DURABILITY, mutated, ("signals",))
+    assert any(
+        f.code == "SIG002" and "print" in f.message for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_stripping_the_actor_handoff_waiver_resurfaces_prot003():
+    """The actor parking its open lease on self._open_lease is the ONE
+    sanctioned escape; the waiver carrying that declaration is
+    load-bearing."""
+    assert not _check_single(SEBULBA, open(SEBULBA).read(), ("protocols",))
+    src = "\n".join(
+        line
+        for line in open(SEBULBA).read().split("\n")
+        if "protocol-ok(sanctioned hand-off" not in line
+    )
+    findings = _check_single(SEBULBA, src, ("protocols",))
+    assert any(
+        f.code == "PROT003" and "_open_lease" in f.message
+        for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+# --------------------------------------------------- engine semantics
+
+
+def test_wrapper_facade_mints_and_caller_carries_the_obligation():
+    """A function returning a fresh lease is a facade (no PROT003), its
+    callers mint through it, and THEY carry the close obligation."""
+    findings = _lint(
+        """
+        class StagingRing:
+            def acquire(self):
+                return object()
+
+        def grab(ring):
+            lease = ring.acquire()
+            return lease
+
+        def use(ring):
+            lease = grab(ring)
+            poke()
+            lease.commit()
+        """
+    )
+    assert codes(findings) == {"PROT002"}
+    assert "use" in findings[0].message  # the caller, not the facade
+
+
+def test_param_op_summary_discharges_the_obligation():
+    """A helper that voids its argument closes the caller's lease
+    through the interprocedural summary — no false PROT002."""
+    findings = _lint(
+        """
+        class StagingRing:
+            def acquire(self):
+                return object()
+
+        def discard(ring, lease):
+            ring.void(lease)
+
+        def use(ring):
+            lease = ring.acquire()
+            discard(ring, lease)
+        """
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_double_release_is_prot001():
+    findings = _lint(
+        """
+        class ParamSlots:
+            def lease(self):
+                return object(), 0
+
+        def dispatch(slots):
+            params, gen = slots.lease()
+            slots.release(gen)
+            slots.release(gen)
+        """
+    )
+    assert "PROT001" in codes(findings)
+
+
+def test_try_finally_release_is_clean_and_none_narrowing_works():
+    """The real dispatch shape: mint, try/finally release — clean on
+    both the normal and the exception path; an acquire that can return
+    None is not a leak on the None branch."""
+    findings = _lint(
+        """
+        class ParamSlots:
+            def lease(self):
+                return object(), 0
+
+        class StagingRing:
+            def acquire(self):
+                return None
+
+        def dispatch(slots):
+            params, gen = slots.lease()
+            try:
+                run(params)
+            finally:
+                slots.release(gen)
+
+        def poll(ring):
+            lease = ring.acquire(stop=None)
+            if lease is None:
+                return None
+            lease.commit()
+            return True
+        """
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -------------------------------------------------- # protocol: grammar
+
+
+def test_declared_protocol_is_enforced_like_a_builtin():
+    src = """
+    # protocol: replay-lease mint=lease_row ops=commit:held->done,void:held->voided open=held terminal=voided
+
+    def leak(ring):
+        row = ring.lease_row()
+        poke()
+        row.commit()
+
+    def zombie(ring):
+        row = ring.lease_row()
+        ring.void(row)
+        row.commit()
+
+    def clean(ring):
+        row = ring.lease_row()
+        row.commit()
+    """
+    findings = _lint(src)
+    assert {"PROT001", "PROT002"} <= codes(findings)
+    lines = {f.line for f in findings}
+    clean_start = textwrap.dedent(src).index("def clean")
+    clean_line = textwrap.dedent(src)[:clean_start].count("\n") + 1
+    assert all(line < clean_line for line in lines)
+
+
+def test_catch_all_cleanup_is_clean_but_narrow_handlers_still_leak():
+    """``except BaseException: lease.void(); raise`` closes EVERY
+    escaping path — the no-match propagation edge must not phantom-leak
+    it. A narrower handler really can be bypassed, so that leak stays."""
+    catch_all = """
+    def f(ring):
+        lease = ring.acquire()
+        try:
+            work()
+            lease.commit()
+        except BaseException:
+            lease.void()
+            raise
+    """
+    assert not _lint(catch_all)
+    narrow = catch_all.replace("BaseException", "ValueError")
+    assert "PROT002" in codes(_lint(narrow))
+
+
+def test_with_and_walrus_mints_are_tracked():
+    """``with ring.acquire() as lease:`` and ``(lease := ring.acquire())``
+    mint exactly like an assignment — refactoring an acquire site into
+    either form must not silently disarm the pass."""
+    for src in (
+        """
+        def f(ring):
+            with ring.acquire() as lease:
+                poke(lease)
+        """,
+        """
+        def f(ring):
+            if (lease := ring.acquire()):
+                poke(lease)
+        """,
+    ):
+        assert "PROT002" in codes(_lint(src)), src
+    assert not _lint(
+        """
+        def f(ring):
+            with ring.acquire() as lease:
+                lease.commit()
+        """
+    )
+
+
+def test_borrowed_params_carry_no_close_obligation_through_ops():
+    """A helper that borrows a lease parameter and applies a non-closing
+    op must not inherit the caller's close obligation (extracting a
+    write helper is the canonical refactor), a payload argument seeded
+    by the consuming-form scan must not leak either, and a borrowed
+    lease+payload pair passed onward together is not a generation mix —
+    while use-after-void on a borrowed object still reports."""
+    for src in (
+        """
+        def fill(lease):
+            lease.write_init_core(0, 1)
+        """,
+        """
+        def fill(lease, x):
+            lease.write_init_core(0, x)
+            lease.commit()
+        """,
+        """
+        def fill(lease, x):
+            helper(lease, x)
+            lease.write_init_core(0, x)
+        """,
+    ):
+        assert not _lint(src), src
+    assert "PROT001" in codes(_lint(
+        """
+        def drain(ring, lease):
+            ring.void(lease)
+            lease.commit()
+        """
+    ))
+    # Consuming form seeds the ARGS, not the owner applying the op: a
+    # drain helper taking the ring must not become a phantom lease.
+    assert not _lint(
+        """
+        def drain_all(ring, leases):
+            for lease in leases:
+                ring.void(lease)
+        """
+    )
+
+
+def test_except_exception_cleanup_counts_as_catch_all():
+    """``except Exception: lease.void(); raise`` closes every modeled
+    escape (KeyboardInterrupt-class asynchronous exits are deliberately
+    out of the CFG's model), so no phantom no-match leak."""
+    assert not _lint(
+        """
+        def f(ring):
+            lease = ring.acquire()
+            try:
+                work()
+                lease.commit()
+            except Exception:
+                lease.void()
+                raise
+        """
+    )
+
+
+def test_wrapper_chains_resolve_past_three_levels():
+    """The mint-wrapper fixpoint converges on chain depth, not a fixed
+    round cap: a leak through a 4-level wrapper stack still reports."""
+    findings = _lint(
+        """
+        def grab1(ring):
+            return ring.acquire()
+        def grab2(ring):
+            return grab1(ring)
+        def grab3(ring):
+            return grab2(ring)
+        def grab4(ring):
+            return grab3(ring)
+        def f(ring):
+            lease = grab4(ring)
+            poke(lease)
+        """
+    )
+    assert "PROT002" in codes(findings)
+
+
+def test_bare_discarded_mint_reports_and_documented_blind_spots_hold():
+    """A bare ``ring.acquire()`` statement discards an unclosable lease
+    — reported on the spot. The documented approximations stay pinned:
+    a mint nested in another call's arguments is the unresolved-argument
+    blind spot, and a closing op is modeled as succeeded on its own
+    exception edge (no try/except demanded around every commit)."""
+    assert "PROT002" in codes(_lint(
+        """
+        def f(ring):
+            ring.acquire()
+        """
+    ))
+    assert not _lint(
+        """
+        def f(ring):
+            process(ring.acquire())
+        """
+    )
+    assert not _lint(
+        """
+        def f(ring):
+            lease = ring.acquire()
+            try:
+                lease.commit()
+            finally:
+                log()
+        """
+    )
+
+
+def test_lock_acquire_does_not_mint_a_phantom_lease():
+    """``got = self._lock.acquire(timeout=0.5)`` shares the ``acquire``
+    name with the staging mint; the bare-name fallback must not track a
+    threading-lock acquire as a staging lease (typed attr or lock-ish
+    receiver name), while an untyped ring receiver still mints."""
+    for src in (
+        """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def f(self):
+                got = self._lock.acquire(timeout=0.5)
+                return got
+        """,
+        """
+        def f(lock):
+            got = lock.acquire(timeout=0.5)
+            return got
+        """,
+    ):
+        assert not _lint(src), src
+    assert "PROT002" in codes(_lint(
+        """
+        def f(ring):
+            lease = ring.acquire()
+            poke(lease)
+        """
+    ))
+
+
+def test_conditional_read_after_void_is_prot001():
+    """Declared reads use the same any-path rule as ops: a read that is
+    illegal on SOME merged path (void behind a branch) is a finding."""
+    findings = _lint(
+        """
+        def f(ring):
+            lease = ring.acquire()
+            if c:
+                lease.void()
+            b = lease.buffer
+            lease.commit()
+        """
+    )
+    assert any(
+        f.code == "PROT001" and ".buffer read" in f.message
+        for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_declared_spec_initial_state_survives_op_reordering():
+    """The post-mint state must not depend on op-rule order: without the
+    open=-first default (or an explicit initial=), listing the close rule
+    before the open-state rules would derive an already-closed initial
+    and silently un-arm PROT002 — the exact silent-enforce-nothing
+    failure the ANN013 hard-error design exists to prevent."""
+    def src(decl_fields):
+        return (
+            f"# protocol: replay-lease mint=lease_row {decl_fields}\n"
+            "def leak(ring):\n"
+            "    row = ring.lease_row()\n"
+            "    poke(row)\n"
+        )
+
+    reordered = "ops=drop:sealed->dropped,seal:held->sealed open=held terminal=dropped"
+    assert "PROT002" in codes(_lint(src(reordered)))
+    # held is the explicit initial but NOT open: the mint carries no
+    # exit obligation, so no leak.
+    explicit = "ops=drop:sealed->dropped,seal:held->sealed terminal=dropped open=sealed initial=held"
+    assert "PROT002" not in codes(_lint(src(explicit)))
+    explicit_open = "ops=drop:sealed->dropped,seal:held->sealed terminal=dropped open=held initial=held"
+    assert "PROT002" in codes(_lint(src(explicit_open)))
+
+
+def test_malformed_protocol_declaration_is_ann013():
+    for bad in (
+        "# protocol: broken",
+        "# protocol: broken mint=",
+        "# protocol: broken mint=x ops=commit",
+        "# protocol: broken mint=x ops=commit:a->b open=zzz",
+        "# protocol: broken mint=x ops=commit:a->b initial=zzz",
+        "# protocol: broken mint=x bogus=1",
+    ):
+        findings = _lint(f"{bad}\nX = 1\n", passes=("protocols",))
+        assert "ANN013" in codes(findings), bad
+
+
+# ------------------------------------------------- cache & report seams
+
+
+def _protocol_tree(tmp_path):
+    (tmp_path / "ring.py").write_text(
+        textwrap.dedent(
+            """
+            class StagingRing:
+                def acquire(self):
+                    return object()
+            """
+        )
+    )
+    (tmp_path / "worker.py").write_text(
+        textwrap.dedent(
+            """
+            def fill(ring):
+                # lint: protocol-ok(fixture: the hand-off lives elsewhere)
+                lease = ring.acquire()
+                poke(lease)
+            """
+        )
+    )
+
+
+def test_prot_waiver_strip_resurfaces_through_the_cache(tmp_path):
+    """The PR-4 discipline applied to PROT: a waiver-stripping
+    comment-only edit must resurface the finding on the very next
+    cached (partial) run — a stale cache can never hide it."""
+    tree, cache_dir = tmp_path / "src", tmp_path / "cache"
+    tree.mkdir()
+    _protocol_tree(tree)
+    cold = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert cold.findings == [], [f.render() for f in cold.findings]
+    src = (tree / "worker.py").read_text()
+    (tree / "worker.py").write_text(
+        "\n".join(l for l in src.split("\n") if "protocol-ok" not in l)
+    )
+    after = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert after.stats["cache"] == "partial"
+    assert any(f.code == "PROT002" for f in after.findings)
+
+
+def test_sig_findings_replay_through_a_warm_manifest(tmp_path):
+    tree, cache_dir = tmp_path / "src", tmp_path / "cache"
+    tree.mkdir()
+    (tree / "daemon.py").write_text(
+        open(os.path.join(FIXTURES, "bad_signals.py")).read()
+    )
+    cold = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    warm = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert warm.stats["cache"] == "warm"
+    assert {"SIG001", "SIG002", "SIG003"} <= codes(warm.findings)
+    assert [f.render() for f in warm.findings] == [
+        f.render() for f in cold.findings
+    ]
+
+
+def test_stats_report_per_pass_zeros_on_clean_runs(tmp_path):
+    """lint_report.json must distinguish 'pass ran clean' from 'pass
+    never ran': every requested pass appears with an explicit zero."""
+    (tmp_path / "clean.py").write_text("def f(x):\n    return x\n")
+    result = analysis.run_analysis([str(tmp_path)])
+    assert result.findings == []
+    assert result.stats["findings_per_pass"] == {
+        p: 0 for p in analysis.PASSES
+    }
+    only = analysis.run_analysis([str(tmp_path)], passes=("signals",))
+    assert only.stats["findings_per_pass"] == {"signals": 0}
+
+
+def test_new_codes_round_trip_json_with_stable_ids_through_warm_cache(
+    tmp_path,
+):
+    """The acceptance bound: ``--format json`` round-trips PROT/SIG
+    findings with stable IDs through a warm cache."""
+    fixture = os.path.join(FIXTURES, "bad_protocol.py")
+    cache_dir = str(tmp_path / "cache")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    docs = []
+    for _ in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-m", "asyncrl_tpu.analysis", fixture,
+             "--cache-dir", cache_dir, "--format", "json"],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        assert proc.returncode == 1  # the fixture gates
+        docs.append(json.loads(proc.stdout))
+    cold, warm = docs
+    assert cold["stats"]["cache"] == "cold"
+    assert warm["stats"]["cache"] == "warm"
+    assert warm["findings"] == cold["findings"]
+    found_codes = {f["code"] for f in warm["findings"]}
+    assert {"PROT001", "PROT002", "PROT003", "PROT004"} <= found_codes
+    ids = [f["id"] for f in warm["findings"]]
+    assert len(ids) == len(set(ids))
+    assert warm["stats"]["findings_per_pass"]["protocols"] >= 4
+
+
+def test_prot_ids_are_stable_across_independent_runs():
+    fixture = os.path.join(FIXTURES, "bad_protocol.py")
+    first = analysis.check_paths([fixture], passes=("protocols",))
+    second = analysis.check_paths([fixture], passes=("protocols",))
+    assert report.finding_ids(first) == report.finding_ids(second)
+    assert first, "fixture must produce findings"
+
+
+def test_spec_edit_invalidates_cross_file_results(tmp_path):
+    """A ``# protocol:`` declaration is comment-level but cross-file-
+    visible: editing one must invalidate the env hash (cold re-run), so
+    another file's cached results can't survive a spec change."""
+    tree, cache_dir = tmp_path / "src", tmp_path / "cache"
+    tree.mkdir()
+    (tree / "spec.py").write_text(
+        "# protocol: r-lease mint=lease_row ops=commit:held->done"
+        " open=held\nX = 1\n"
+    )
+    (tree / "user.py").write_text(
+        textwrap.dedent(
+            """
+            def fill(ring):
+                row = ring.lease_row()
+                poke(row)
+            """
+        )
+    )
+    first = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert any(f.code == "PROT002" for f in first.findings)
+    # Relax the spec (comment-only edit): the obligation disappears,
+    # and the cache must NOT replay the stale finding.
+    (tree / "spec.py").write_text(
+        "# protocol: r-lease mint=lease_row ops=commit:held->done\nX = 1\n"
+    )
+    second = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert not any(f.code == "PROT002" for f in second.findings)
