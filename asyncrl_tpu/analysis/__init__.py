@@ -1,6 +1,6 @@
 """Framework-aware static checker for the async pipeline.
 
-``python -m asyncrl_tpu.analysis [paths...]`` runs nine passes over the
+``python -m asyncrl_tpu.analysis [paths...]`` runs twelve passes over the
 package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
 :mod:`asyncrl_tpu.analysis.annotations` for the annotation grammar):
 
@@ -20,6 +20,15 @@ package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
 - ``signals``     — async-signal-safety of signal-handler-reachable
   code: lock reentrancy, blocking/buffered calls, registration sites
   (SIG*)
+- ``sharding``    — SPMD sharding contracts: shard_map spec arity,
+  PartitionSpec/mesh axis congruence, mesh-construction statics,
+  ``check_rep=False`` discipline (SHD*)
+- ``hostsync``    — multi-host collective congruence: collectives or
+  barriers under host-divergent control flow, initialize-before-query
+  ordering (HSY*)
+- ``pallas``      — Pallas kernel discipline: DMA start/wait typestate
+  over the CFG, semaphore pairing, grid/BlockSpec statics, undeclared
+  input aliasing (PAL*)
 
 Annotation-grammar errors and unloadable files (ANN*) are produced by
 every run and can be neither waived nor baselined. The analyzer core
@@ -53,6 +62,9 @@ PASSES = (
     "configflow",
     "protocols",
     "signals",
+    "sharding",
+    "hostsync",
+    "pallas",
 )
 
 # Finding-code prefix -> owning pass (for per-pass stats; ANN* belongs to
@@ -68,6 +80,9 @@ CODE_FAMILIES = {
     "CFG": "configflow",
     "PROT": "protocols",
     "SIG": "signals",
+    "SHD": "sharding",
+    "HSY": "hostsync",
+    "PAL": "pallas",
     "ANN": "annotations",
 }
 
@@ -78,10 +93,13 @@ def _impl():
         configflow,
         deadlock,
         donation,
+        hostsync,
         locks,
         ownership,
+        pallas,
         protocols,
         purity,
+        sharding,
         signals,
     )
 
@@ -95,6 +113,9 @@ def _impl():
         "configflow": configflow.run,
         "protocols": protocols.run,
         "signals": signals.run,
+        "sharding": sharding.run,
+        "hostsync": hostsync.run,
+        "pallas": pallas.run,
     }
 
 

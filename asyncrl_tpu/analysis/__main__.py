@@ -38,7 +38,8 @@ def main(argv: list[str] | None = None) -> int:
         description="framework-aware static checker (lock discipline, "
         "JAX purity, donation safety, thread ownership, deadlock/"
         "lock-order, device contracts, config contracts, protocol "
-        "typestate, async-signal safety)",
+        "typestate, async-signal safety, SPMD sharding contracts, "
+        "multi-host collective congruence, Pallas DMA discipline)",
     )
     parser.add_argument(
         "paths",

@@ -63,8 +63,13 @@ enforce. The full grammar (also documented in docs/ARCHITECTURE.md):
     lease hand-off/escape, or a leak/ordering report the protocol pass
     cannot see is discharged elsewhere), ``signal-safe-ok`` (a
     signal-handler-reachable operation whose safety rests on a protocol
-    state the signal pass cannot prove — name that state in the reason).
-    The reason is mandatory.
+    state the signal pass cannot prove — name that state in the reason),
+    ``sharding-ok`` (a sanctioned SPMD sharding deviation — above all
+    ``check_rep=False``, whose replication argument must live in the
+    reason), ``hostsync-ok`` (a host-divergent collective/barrier whose
+    congruence is argued elsewhere — say where), ``pallas-ok`` (a DMA/
+    semaphore pairing or aliasing deviation the kernel discharges in a
+    way the pass cannot see). The reason is mandatory.
 
 Malformed annotations and unknown waiver tags are **hard lint errors**
 (ANN0xx findings) — a misspelled annotation must never silently enforce
@@ -90,6 +95,9 @@ WAIVER_TAGS = (
     "config-unused-ok",
     "protocol-ok",
     "signal-safe-ok",
+    "sharding-ok",
+    "hostsync-ok",
+    "pallas-ok",
 )
 
 _PROTOCOL_RE = re.compile(r"^protocol:\s*([\w-]+)\s+(.+)$")

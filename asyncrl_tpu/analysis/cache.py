@@ -35,12 +35,19 @@ import os
 
 from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
 
-ANALYZER_VERSION = "3"
+ANALYZER_VERSION = "4"
 _MANIFEST = "manifest.json"
 
 # Code prefixes whose findings fold whole-project state: recomputed every
 # run, never cached per-file. SIG is global because handler reachability
-# folds registrations and call edges from everywhere.
+# folds registrations and call edges from everywhere. The SPMD families
+# (SHD/HSY/PAL) are deliberately NOT here: every finding attaches to the
+# file containing the flagged statement, and the cross-file context they
+# consult (axis-binding sites, the collective-reaching closure, DMA
+# wrapper summaries) is code-shaped — any change to it moves the env
+# hash and cold-invalidates per-file reuse, while a waiver strip changes
+# the flagged file's own hash. tests/test_spmd_analysis.py pins both
+# directions.
 GLOBAL_CODES = ("OWN", "EXC", "DEAD", "ANN", "SIG")
 _GLOBAL_EXACT = ("CFG002",)
 

@@ -34,7 +34,13 @@ from __future__ import annotations
 
 import ast
 
-from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
+from asyncrl_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceModule,
+    bound_axes,
+    const_strs,
+)
 
 # resolved last path segment -> positional index of the axis-name arg.
 _COLLECTIVES = {
@@ -49,8 +55,6 @@ _COLLECTIVES = {
     "axis_size": 0,
 }
 
-_AXIS_BINDERS = {"pmap", "vmap", "shard_map", "xmap"}
-
 _THREADING_PREFIXES = (
     "threading.",
     "queue.",
@@ -60,112 +64,12 @@ _THREADING_PREFIXES = (
 )
 
 
-def _const_strs(module: SourceModule, node: ast.AST) -> set[str] | None:
-    """Statically-known axis-name strings of an expression: a string
-    constant, a tuple/list of them, or a Name resolving to a module-level
-    string/tuple constant (``DP_AXIS``). None = not statically known."""
-    if isinstance(node, ast.Constant):
-        return {node.value} if isinstance(node.value, str) else None
-    if isinstance(node, (ast.Tuple, ast.List)):
-        out: set[str] = set()
-        for elt in node.elts:
-            sub = _const_strs(module, elt)
-            if sub is None:
-                return None
-            out |= sub
-        return out
-    if isinstance(node, (ast.Name, ast.Attribute)):
-        resolved = module.resolve(node)
-        if resolved is None:
-            return None
-        const = _module_constant(module, resolved)
-        if const is None:
-            return None
-        return _const_strs(module, const)
-    return None
-
-
-def _top_constants(module: SourceModule) -> dict[str, ast.AST]:
-    consts = getattr(module, "_top_constants", None)
-    if consts is None:
-        consts = {}
-        for stmt in module.tree.body:
-            if isinstance(stmt, ast.Assign):
-                for t in stmt.targets:
-                    if isinstance(t, ast.Name):
-                        consts[t.id] = stmt.value
-        module._top_constants = consts  # cached on the module itself
-    return consts
-
-
-def _module_constant(module: SourceModule, resolved: str) -> ast.AST | None:
-    """The value expression of a module-level ``NAME = <literal>`` that
-    ``resolved`` points at — same module, or an analyzed module the
-    dotted path suffixes (``asyncrl_tpu.parallel.mesh.DP_AXIS``)."""
-    name = resolved.rsplit(".", 1)[-1]
-    mod_path = resolved.rsplit(".", 1)[0] if "." in resolved else ""
-    candidates = [module]
-    project = getattr(module, "_project", None)
-    if project is not None and mod_path:
-        candidates += [
-            m for m in project.modules if mod_path.endswith(m.name)
-        ]
-    for m in candidates:
-        consts = _top_constants(m)
-        if name in consts:
-            return consts[name]
-    return None
-
-
 def _bound_axes(project: Project) -> set[str]:
-    """Every axis name the project binds anywhere (see COL001 docs)."""
-    bound: set[str] = set()
-    for module in project.modules:
-        module._project = project  # for cross-module constant resolution
-        for node in ast.walk(module.tree):
-            if isinstance(node, ast.Assign):
-                # *_AXIS = "dp" module constants: declared axis names.
-                for t in node.targets:
-                    if (
-                        isinstance(t, ast.Name)
-                        and t.id.endswith("_AXIS")
-                        and isinstance(node.value, ast.Constant)
-                        and isinstance(node.value.value, str)
-                    ):
-                        bound.add(node.value.value)
-            elif isinstance(node, ast.AnnAssign):
-                # Config-style defaults: mesh_axes: tuple = ("dp",)
-                if (
-                    isinstance(node.target, ast.Name)
-                    and node.target.id in ("mesh_axes", "axis_names")
-                    and node.value is not None
-                ):
-                    strs = _const_strs(module, node.value)
-                    if strs:
-                        bound |= strs
-            elif isinstance(node, ast.Call):
-                resolved = module.resolve(node.func)
-                tail = (
-                    resolved.rsplit(".", 1)[-1] if resolved else None
-                )
-                if tail in _AXIS_BINDERS:
-                    for kw in node.keywords:
-                        if kw.arg == "axis_name":
-                            strs = _const_strs(module, kw.value)
-                            if strs:
-                                bound |= strs
-                elif tail in ("Mesh", "make_mesh"):
-                    exprs = [kw.value for kw in node.keywords
-                             if kw.arg in ("axis_names", "mesh_axes")]
-                    if tail == "Mesh" and len(node.args) >= 2:
-                        exprs.append(node.args[1])
-                    if tail == "make_mesh" and len(node.args) >= 2:
-                        exprs.append(node.args[1])
-                    for expr in exprs:
-                        strs = _const_strs(module, expr)
-                        if strs:
-                            bound |= strs
-    return bound
+    """Every axis name the project binds anywhere (see COL001 docs) —
+    the shared :func:`asyncrl_tpu.analysis.core.bound_axes` collector in
+    its permissive reading (``*_AXIS`` constants count as declared
+    bindings; the sharding pass uses the strict reading)."""
+    return bound_axes(project, include_axis_constants=True)
 
 
 def _axis_arg(call: ast.Call, pos: int) -> ast.AST | None:
@@ -202,7 +106,7 @@ def _check_axes(
             axis_expr = _axis_arg(node, _COLLECTIVES[tail])
             if axis_expr is None:
                 continue
-            strs = _const_strs(module, axis_expr)
+            strs = const_strs(module, axis_expr)
             if strs is None:
                 continue  # runtime axis value: out of static reach
             unbound = sorted(strs - bound)

@@ -185,12 +185,12 @@ def _env_key(module: SourceModule, expr: ast.AST) -> str | None:
     if isinstance(expr, ast.Constant):
         return expr.value if isinstance(expr.value, str) else None
     if isinstance(expr, (ast.Name, ast.Attribute)):
-        from asyncrl_tpu.analysis.collectives import _module_constant
+        from asyncrl_tpu.analysis.core import module_constant
 
         resolved = module.resolve(expr)
         if resolved is None:
             return None
-        const = _module_constant(module, resolved)
+        const = module_constant(module, resolved)
         if isinstance(const, ast.Constant) and isinstance(const.value, str):
             return const.value
     return None

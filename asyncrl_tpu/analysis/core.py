@@ -3,7 +3,7 @@
 The analysis subsystem (``python -m asyncrl_tpu.analysis``) enforces, at
 lint time and on every line, the concurrency and JAX disciplines the
 runtime checks (``ASYNCRL_DEBUG_SYNC``, ``tests/test_race_debug.py``) can
-only probe on the interleavings a stress test happens to hit. Nine
+only probe on the interleavings a stress test happens to hit. Twelve
 passes run over the package's ASTs (stdlib ``ast``/``tokenize`` only —
 no third-party linter dependency):
 
@@ -22,6 +22,12 @@ no third-party linter dependency):
   the lease/generation protocols over per-function CFGs
 - :mod:`asyncrl_tpu.analysis.signals`     — async-signal-safety of
   handler-reachable code
+- :mod:`asyncrl_tpu.analysis.sharding`    — mesh/axis/PartitionSpec
+  congruence of the shard_map surface
+- :mod:`asyncrl_tpu.analysis.hostsync`    — multi-host collective
+  congruence (divergent collective programs deadlock a pod)
+- :mod:`asyncrl_tpu.analysis.pallas`      — Pallas kernel DMA typestate,
+  semaphore pairing, and grid/BlockSpec statics
 
 This module holds what every pass shares: source loading, comment
 extraction, import/alias resolution, class/attribute indexing, a light
@@ -832,3 +838,178 @@ def load_paths(paths: list[str]) -> Project:
 def load_source(source: str, path: str = "<string>") -> Project:
     """A single-source Project (tests and the lock-deletion proof)."""
     return Project([SourceModule(path, source)])
+
+
+# ------------------------------------------------ constant/axis resolution
+#
+# Shared by the collectives (COL001) and sharding (SHD*) passes: both must
+# resolve axis-name strings through module constants (``DP_AXIS = "dp"``)
+# and collect the project's mesh-axis binding sites. One definition, two
+# lenses — divergent copies would let the passes disagree on what an axis
+# name statically IS.
+
+
+def top_constants(module: SourceModule) -> dict[str, ast.AST]:
+    """Module-level ``NAME = <expr>`` assignments (cached on the module)."""
+    consts = getattr(module, "_top_constants", None)
+    if consts is None:
+        consts = {}
+        for stmt in module.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        consts[t.id] = stmt.value
+        module._top_constants = consts  # cached on the module itself
+    return consts
+
+
+def module_constant(
+    module: SourceModule, resolved: str
+) -> ast.AST | None:
+    """The value expression of a module-level ``NAME = <literal>`` that
+    ``resolved`` points at — same module, or an analyzed module the
+    dotted path suffixes (``asyncrl_tpu.parallel.mesh.DP_AXIS``).
+    Cross-module resolution requires ``module._project`` (set by
+    :func:`bound_axes` / the passes that need it)."""
+    name = resolved.rsplit(".", 1)[-1]
+    mod_path = resolved.rsplit(".", 1)[0] if "." in resolved else ""
+    candidates = [module]
+    project = getattr(module, "_project", None)
+    if project is not None and mod_path:
+        candidates += [
+            m for m in project.modules if mod_path.endswith(m.name)
+        ]
+    for m in candidates:
+        consts = top_constants(m)
+        if name in consts:
+            return consts[name]
+    return None
+
+
+def const_strs(module: SourceModule, node: ast.AST) -> set[str] | None:
+    """Statically-known axis-name strings of an expression: a string
+    constant, a tuple/list of them, or a Name resolving to a module-level
+    string/tuple constant (``DP_AXIS``). None = not statically known."""
+    if isinstance(node, ast.Constant):
+        return {node.value} if isinstance(node.value, str) else None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: set[str] = set()
+        for elt in node.elts:
+            sub = const_strs(module, elt)
+            if sub is None:
+                return None
+            out |= sub
+        return out
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        resolved = module.resolve(node)
+        if resolved is None:
+            return None
+        const = module_constant(module, resolved)
+        if const is None:
+            return None
+        return const_strs(module, const)
+    return None
+
+
+def call_kwarg(call: ast.Call, name: str) -> ast.AST | None:
+    """The value expression of a call's ``name=`` keyword, else None —
+    shared by the sharding and pallas passes (one definition, so the
+    passes can never disagree on keyword extraction)."""
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+# Wrapper callables that bind a named axis via an ``axis_name`` kwarg.
+AXIS_BINDERS = frozenset({"pmap", "vmap", "shard_map", "xmap"})
+
+# Callables that construct a device mesh — ONE definition shared by the
+# collectives/sharding/hostsync passes (divergent copies would let the
+# passes disagree on what constructs a mesh).
+MESH_MAKER_TAILS = frozenset({"Mesh", "make_mesh", "make_hybrid_mesh"})
+
+
+def mesh_axes_exprs(call: ast.Call, tail: str) -> list[ast.AST]:
+    """The axis-name expressions of one mesh-maker call — keyword forms
+    plus the positional slot of the makers that have one. ONE extraction
+    shared by bound_axes and the sharding pass, so the passes can never
+    disagree on what a call's axis tuple is."""
+    exprs = [kw.value for kw in call.keywords
+             if kw.arg in ("axis_names", "mesh_axes")]
+    if tail in ("Mesh", "make_mesh") and len(call.args) >= 2:
+        exprs.append(call.args[1])
+    return exprs
+
+
+def bound_axes(
+    project: Project, include_axis_constants: bool = True
+) -> set[str]:
+    """Every axis name the project binds anywhere: ``pmap``/``vmap``/
+    ``shard_map`` ``axis_name`` kwargs, ``Mesh``/``make_mesh`` axis-name
+    tuples, ``mesh_axes``/``axis_names`` dataclass defaults AND function
+    parameter defaults. With ``include_axis_constants`` (the COL001
+    reading), bare ``*_AXIS`` string constants count as declared bindings
+    too; without it (the stricter SHD reading) only real mesh/map binding
+    sites count — a PartitionSpec axis is only valid if some mesh can
+    actually carry it."""
+    bound: set[str] = set()
+    for module in project.modules:
+        module._project = project  # for cross-module constant resolution
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                # *_AXIS = "dp" module constants: declared axis names.
+                if include_axis_constants:
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Name)
+                            and t.id.endswith("_AXIS")
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)
+                        ):
+                            bound.add(node.value.value)
+            elif isinstance(node, ast.AnnAssign):
+                # Config-style defaults: mesh_axes: tuple = ("dp",)
+                if (
+                    isinstance(node.target, ast.Name)
+                    and node.target.id in ("mesh_axes", "axis_names")
+                    and node.value is not None
+                ):
+                    strs = const_strs(module, node.value)
+                    if strs:
+                        bound |= strs
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Parameter defaults: def make_mesh(..., mesh_axes=(DP_AXIS,))
+                args = node.args
+                pos = args.posonlyargs + args.args
+                defaults = args.defaults
+                for arg, default in zip(pos[len(pos) - len(defaults):],
+                                        defaults):
+                    if arg.arg in ("mesh_axes", "axis_names"):
+                        strs = const_strs(module, default)
+                        if strs:
+                            bound |= strs
+                for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                    if default is not None and arg.arg in (
+                        "mesh_axes", "axis_names"
+                    ):
+                        strs = const_strs(module, default)
+                        if strs:
+                            bound |= strs
+            elif isinstance(node, ast.Call):
+                resolved = module.resolve(node.func)
+                tail = (
+                    resolved.rsplit(".", 1)[-1] if resolved else None
+                )
+                if tail in AXIS_BINDERS:
+                    for kw in node.keywords:
+                        if kw.arg == "axis_name":
+                            strs = const_strs(module, kw.value)
+                            if strs:
+                                bound |= strs
+                elif tail in MESH_MAKER_TAILS:
+                    for expr in mesh_axes_exprs(node, tail):
+                        strs = const_strs(module, expr)
+                        if strs:
+                            bound |= strs
+    return bound

@@ -1,0 +1,427 @@
+"""Multi-host collective-congruence pass (HSY0xx).
+
+Multi-controller SPMD has one iron rule: **every host must issue the
+same collective program in the same order.** A collective that only some
+hosts reach does not raise — it hangs the pod, with every healthy host
+parked inside an all-reduce waiting for a peer that branched away. The
+pass guards the three shapes of that bug before the multi-host launch
+path (ROADMAP item 1) grows more of them:
+
+- HSY001 — a collective (``psum``/``pmean``/``pmax``/``pmin``/
+  ``all_gather``/``all_to_all``/``ppermute``/``pswapaxes``) reachable
+  under host-divergent control flow: an ``if``/``while`` whose test
+  depends on ``jax.process_index()`` (directly or through a local
+  assigned from it), a ``for`` loop iterating a host-dependent bound,
+  or statements following a host-dependent early return. Reachability
+  is transitive over the shared call graph: calling a function that
+  (transitively) issues a collective counts, so wrapping
+  ``trainer.train()`` in an ``if process_index() == 0:`` block is
+  flagged at the call, not missed behind a layer of indirection.
+- HSY002 — initialization ordering: within one scope, a device query
+  (``jax.devices``/``device_count``/``local_devices``/
+  ``process_count``/``process_index``) or mesh construction
+  (``Mesh``/``make_mesh``/``make_hybrid_mesh``) lexically BEFORE the
+  ``jax.distributed.initialize`` call in that same scope. Before
+  initialize, ``jax.devices()`` sees only local devices and pins the
+  backend — the mesh built from it is silently single-host.
+- HSY003 — a cross-host barrier/coordination point
+  (``sync_global_devices``, ``broadcast_one_to_all``,
+  ``process_allgather``) under the same host-divergent control flow as
+  HSY001. A barrier only some hosts reach is the purest form of the
+  deadlock; checkpoint-coordination helpers are the usual carriers.
+
+Sanctioned divergence (a genuinely host-local effect guarded by rank,
+with the collective congruence argued elsewhere) carries
+``# lint: hostsync-ok(<reason>)``.
+
+The pass deliberately does NOT flag host-guarded *host* effects —
+``if process_index() == 0: print(...)`` is the canonical lead-host
+logging idiom and stays silent; only collective-reaching calls inside
+the divergent region report.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from asyncrl_tpu.analysis.core import (
+    MESH_MAKER_TAILS as _MESH_TAILS,
+    Finding,
+    FunctionIndex,
+    Project,
+    SourceModule,
+)
+
+_WAIVER = "hostsync-ok"
+
+# Collectives every host must issue congruently (jax.lax / jax namespaces).
+_COLLECTIVE_TAILS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pswapaxes",
+})
+
+# Cross-host barrier / coordination points (jax.experimental
+# .multihost_utils and jax.distributed spellings).
+_BARRIER_TAILS = frozenset({
+    "sync_global_devices", "broadcast_one_to_all", "process_allgather",
+})
+
+_QUERY_RESOLVED = frozenset({
+    "devices", "device_count", "local_devices", "local_device_count",
+    "process_count", "process_index",
+})
+
+
+def _all_functions(module: SourceModule):
+    """Every def in the module (nested included) — NOT the name-keyed
+    FunctionIndex.per_module dict, whose last-definition-wins collapse
+    would silently skip any method shadowed by a later same-named def
+    (__init__/run/step recur across classes in every module here)."""
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _is_jaxish(resolved: str) -> bool:
+    return resolved.startswith("jax.") or "lax." in resolved or (
+        "multihost_utils." in resolved
+    )
+
+
+def _call_kind(module: SourceModule, call: ast.Call) -> str | None:
+    """'collective' | 'barrier' | None for one call node."""
+    resolved = module.resolve(call.func)
+    if resolved is None:
+        return None
+    tail = resolved.rsplit(".", 1)[-1]
+    if tail in _BARRIER_TAILS:
+        return "barrier"
+    if tail in _COLLECTIVE_TAILS and _is_jaxish(resolved):
+        return "collective"
+    return None
+
+
+# --------------------------------------------- collective-reaching closure
+
+
+def _reaching(project: Project) -> dict[int, str]:
+    """fn id -> 'collective'|'barrier' for every function that
+    (transitively, through name-resolved calls) issues one. Barrier
+    "wins" over collective for mixed functions only in the sense that
+    the finding code follows the nearest direct call anyway."""
+    index: FunctionIndex = project.function_index
+    direct: dict[int, str] = {}
+    callers: dict[int, list[int]] = {}
+    for module in project.modules:
+        for fn in _all_functions(module):
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                kind = _call_kind(module, sub)
+                if kind is not None and direct.get(id(fn)) != "barrier":
+                    direct[id(fn)] = kind
+                hit = index.resolve_callable(module, sub.func)
+                if hit is not None:
+                    callers.setdefault(id(hit[1]), []).append(id(fn))
+    reach = dict(direct)
+    work = list(direct)
+    while work:
+        fid = work.pop()
+        kind = reach[fid]
+        for caller in callers.get(fid, ()):  # propagate to callers
+            if caller not in reach:
+                reach[caller] = kind
+                work.append(caller)
+    return reach
+
+
+# ------------------------------------------------------- host-divergence
+
+
+def _expr_host_dep(
+    module: SourceModule, expr: ast.AST, tainted: set[str]
+) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            resolved = module.resolve(sub.func)
+            if resolved and resolved.rsplit(".", 1)[-1] == "process_index":
+                return True
+        elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            if sub.id in tainted:
+                return True
+    return False
+
+
+class _FunctionWalk:
+    """One function's HSY001/HSY003 walk: a single in-order pass that
+    tracks host-tainted locals and the host-divergent statement regions
+    they open."""
+
+    def __init__(
+        self,
+        project: Project,
+        module: SourceModule,
+        fn: ast.AST,
+        reach: dict[int, str],
+        findings: list[Finding],
+    ):
+        self.project = project
+        self.module = module
+        self.fn = fn
+        self.reach = reach
+        self.findings = findings
+        self.tainted: set[str] = set()
+
+    def _flag_calls(self, stmts: list[ast.stmt], why: str) -> None:
+        index = self.project.function_index
+        work: list[ast.AST] = list(stmts)
+        while work:
+            sub = work.pop()
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                # Pruned: a function merely DEFINED in a divergent region
+                # only diverges where it is CALLED — and a divergent call
+                # to it is caught through the reaching closure.
+                continue
+            work.extend(ast.iter_child_nodes(sub))
+            if not isinstance(sub, ast.Call):
+                continue
+            kind = _call_kind(self.module, sub)
+            if kind is None:
+                hit = index.resolve_callable(self.module, sub.func)
+                if hit is not None:
+                    kind = self.reach.get(id(hit[1]))
+            if kind is None:
+                continue
+            if self.module.annotations.waived(sub.lineno, _WAIVER):
+                continue
+            code = "HSY003" if kind == "barrier" else "HSY001"
+            what = (
+                "cross-host barrier/coordination point"
+                if kind == "barrier"
+                else "collective"
+            )
+            self.findings.append(
+                Finding(
+                    code, self.module.path, sub.lineno,
+                    f"{what} reachable {why}: hosts that branch away "
+                    "never issue it, and every other host hangs "
+                    "inside it — make the collective program "
+                    "host-uniform, or declare the divergence with "
+                    "'# lint: hostsync-ok(<reason>)'",
+                )
+            )
+
+    def _block(self, stmts: list[ast.stmt]) -> None:
+        divergent_tail: str | None = None
+        for stmt in stmts:
+            if divergent_tail is not None:
+                self._flag_calls([stmt], divergent_tail)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = getattr(stmt, "value", None)
+                if value is not None and _expr_host_dep(
+                    self.module, value, self.tainted
+                ):
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for t in targets:
+                        for elt in ast.walk(t):
+                            # Store-context Names only: the base of
+                            # `self.rank = process_index()` is a LOAD of
+                            # `self` — tainting it would make every later
+                            # `self.<anything>` read as host-dependent.
+                            if isinstance(elt, ast.Name) and isinstance(
+                                elt.ctx, ast.Store
+                            ):
+                                self.tainted.add(elt.id)
+            if isinstance(stmt, ast.If):
+                if _expr_host_dep(self.module, stmt.test, self.tainted):
+                    why = (
+                        "under a process_index/host-id-conditional "
+                        f"branch (line {stmt.lineno})"
+                    )
+                    self._flag_calls(stmt.body, why)
+                    self._flag_calls(stmt.orelse, why)
+                    # A host-dependent early exit diverges EVERYTHING
+                    # after it in this block.
+                    if _terminating(stmt.body) or _terminating(
+                        stmt.orelse
+                    ):
+                        divergent_tail = (
+                            "after a host-dependent early return "
+                            f"(line {stmt.lineno})"
+                        )
+                else:
+                    self._block(stmt.body)
+                    self._block(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                if _expr_host_dep(self.module, stmt.test, self.tainted):
+                    self._flag_calls(
+                        stmt.body,
+                        "inside a loop with a host-dependent bound "
+                        f"(line {stmt.lineno})",
+                    )
+                else:
+                    self._block(stmt.body)
+                self._block(stmt.orelse)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if _expr_host_dep(self.module, stmt.iter, self.tainted):
+                    self._flag_calls(
+                        stmt.body,
+                        "inside a loop with a host-dependent bound "
+                        f"(line {stmt.lineno})",
+                    )
+                else:
+                    self._block(stmt.body)
+                self._block(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._block(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self._block(stmt.body)
+                for handler in stmt.handlers:
+                    self._block(handler.body)
+                self._block(stmt.orelse)
+                self._block(stmt.finalbody)
+            elif isinstance(stmt, ast.Match):
+                if _expr_host_dep(self.module, stmt.subject, self.tainted):
+                    # match process_index(): only the matching host's
+                    # case runs — every case body is divergent.
+                    why = (
+                        "under a process_index/host-id-conditional "
+                        f"match (line {stmt.lineno})"
+                    )
+                    for case in stmt.cases:
+                        self._flag_calls(case.body, why)
+                else:
+                    for case in stmt.cases:
+                        self._block(case.body)
+
+    def walk(self) -> None:
+        self._block(list(getattr(self.fn, "body", []) or []))
+
+
+# ----------------------------------------------------------------- HSY002
+
+
+def _scope_calls(scope: list[ast.stmt]):
+    """Call nodes of one lexical scope, NOT descending into nested
+    defs/classes (each is its own ordering scope)."""
+    work: list[ast.AST] = list(scope)
+    while work:
+        node = work.pop()
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+             ast.ClassDef),
+        ):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        work.extend(ast.iter_child_nodes(node))
+
+
+def _terminating(body: list[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue)
+    )
+
+
+def _fallthrough_calls(scope: list[ast.stmt]):
+    """Call nodes of one scope that can flow PAST their statement to the
+    rest of the scope: nested defs/classes are pruned (own scopes), and
+    an ``if`` arm ending in return/raise is pruned too — a query inside
+    an early-returning branch is mutually exclusive with whatever
+    follows, so it must not read as 'before' it."""
+    work: list[ast.AST] = list(scope)
+    while work:
+        node = work.pop()
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+             ast.ClassDef),
+        ):
+            continue
+        if isinstance(node, ast.If):
+            work.append(node.test)
+            if not _terminating(node.body):
+                work.extend(node.body)
+            if not _terminating(node.orelse):
+                work.extend(node.orelse)
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        work.extend(ast.iter_child_nodes(node))
+
+
+def _check_init_order(
+    module: SourceModule, scope: list[ast.stmt], findings: list[Finding]
+) -> None:
+    """Within one lexical scope: device queries / mesh construction
+    before the scope's ``distributed.initialize`` call."""
+    init_line: int | None = None
+    for sub in _scope_calls(scope):
+        resolved = module.resolve(sub.func)
+        if resolved is None:
+            continue
+        tail = resolved.rsplit(".", 1)[-1]
+        if tail == "initialize" and "distributed" in resolved:
+            if init_line is None or sub.lineno < init_line:
+                init_line = sub.lineno
+    if init_line is None:
+        return  # almost every scope: skip the query walk entirely
+    queries: list[tuple[int, str]] = []
+    for sub in _fallthrough_calls(scope):
+        resolved = module.resolve(sub.func)
+        if resolved is None:
+            continue
+        tail = resolved.rsplit(".", 1)[-1]
+        if (
+            tail in _QUERY_RESOLVED and resolved.startswith("jax.")
+        ) or tail in _MESH_TAILS:
+            queries.append((sub.lineno, tail))
+    for line, tail in queries:
+        if line < init_line and not module.annotations.waived(
+            line, _WAIVER
+        ):
+            findings.append(
+                Finding(
+                    "HSY002", module.path, line,
+                    f"{tail}() runs before jax.distributed.initialize "
+                    f"(line {init_line}): before initialization the "
+                    "runtime sees only local devices and pins the "
+                    "backend — the mesh/query result is silently "
+                    "single-host",
+                )
+            )
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """``targets`` (incremental cache): findings attach to the file
+    containing the flagged call and are re-derived per file; the
+    collective-reaching closure is rebuilt from the whole project on
+    every non-warm run (a cross-file code change invalidates the env
+    hash, so per-file caching stays sound)."""
+    findings: list[Finding] = []
+    reach = _reaching(project)
+    for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
+        for fn in _all_functions(module):
+            _FunctionWalk(project, module, fn, reach, findings).walk()
+            _check_init_order(
+                module, list(getattr(fn, "body", []) or []), findings
+            )
+        # Module scope is a program too: a launch SCRIPT that barriers
+        # only on the lead host at top level hangs the pod exactly like
+        # a function body would (the _block walk ignores nested
+        # def/class statements — each is its own walk root above).
+        _FunctionWalk(project, module, module.tree, reach, findings).walk()
+        _check_init_order(module, module.tree.body, findings)
+    return findings

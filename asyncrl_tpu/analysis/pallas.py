@@ -1,0 +1,438 @@
+"""Pallas kernel-discipline pass (PAL0xx).
+
+The Pallas arc (ROADMAP item 2 — ring-permute collectives, fused scans,
+device-resident queues) lives on explicit DMA: ``make_async_copy``/
+``make_async_remote_copy`` descriptors started against semaphores and
+waited on before the data is touched. A start whose wait is missing on
+one CFG path does not raise — it hangs the chip (the semaphore count
+never drains) or reads torn data, the worst debugging environment there
+is. The pass machine-checks that discipline by REUSING the PR-11
+typestate engine (:mod:`asyncrl_tpu.analysis.protocols`): the DMA
+descriptor is a protocol object whose state machine is
+``created → started → waited``, walked over the same statement-level
+CFGs, exception edges included.
+
+Only modules that import Pallas (``jax.experimental.pallas``) are
+analyzed — the DMA op names (``start``/``wait``) are too generic to
+track project-wide.
+
+- PAL001 — an unpaired DMA: a ``make_async_copy``-style descriptor that
+  can reach function exit (or an exception edge) still ``created`` or
+  ``started`` — its wait is missing on that path; also an unpaired
+  semaphore: a ``semaphore_signal`` with no matching ``semaphore_wait``
+  on the same semaphore in the module (or vice versa).
+- PAL002 — a DMA op from the wrong state: ``wait()`` on an
+  already-waited descriptor (double wait — drains a semaphore count
+  some other DMA owns) or a second ``start()``.
+- PAL003 — grid/BlockSpec statics: a ``pallas_call`` whose literal
+  ``out_specs`` block shape does not divide the literal ``out_shape``
+  dims (padding Pallas will NOT insert for you), where both are
+  statically known. Runtime-computed geometry (the wrapper-sized blocks
+  of ops/pallas_scan.py) is out of static reach and skipped.
+- PAL004 — aliasing misuse: the kernel stores into an INPUT ref (a
+  parameter before the output/scratch block) while the ``pallas_call``
+  declares no ``input_output_aliases`` — an in-place update the
+  compiler is free to make visible or not, i.e. silent data corruption.
+
+Sanctioned deviations (a descriptor handed to a helper that waits, a
+deliberate signal-only semaphore) carry ``# lint: pallas-ok(<reason>)``.
+
+Blind spots, documented: a helper that starts a DMA and returns the
+descriptor re-mints it at the caller in the ``created`` state, so the
+caller's ``wait()`` is accepted from either pre-wait state — start/wait
+pairing is checked within one function, cross-function pairing is the
+caller's obligation via PAL001's leak rule. And the split
+``wait_send``/``wait_recv`` waits are modeled symmetrically (either
+order is legal), which costs the half-waited states their exit
+obligation: a remote copy that waits only one of its two semaphores is
+not reported (no wait at all still is).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from asyncrl_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceModule,
+    _dotted,
+    call_kwarg as _kwarg,
+)
+from asyncrl_tpu.analysis.protocols import (
+    ProtocolSpec,
+    _FunctionAnalyzer,
+    _functions,
+    _mint_wrappers,
+    _param_op_summaries,
+    _ResolverCache,
+    _SpecIndex,
+)
+
+_WAIVER = "pallas-ok"
+
+# The DMA descriptor state machine. ``wait`` accepts ``created`` too: a
+# helper returning a started descriptor re-mints at the caller (see the
+# module docstring's blind-spot note) — rejecting created-state waits
+# would false-positive that hand-off, while double waits still report.
+# The send/recv split waits are SYMMETRIC (the two semaphores are
+# independent, either order is legal in pltpu): each half-wait is
+# allowed from the other's done-state, each rejects its OWN repeat
+# (wait_send twice is PAL002). The cost of symmetry in this spec shape:
+# the half-waited states carry no exit obligation, so a remote copy
+# that waits only ONE of its two semaphores is a documented blind spot
+# (the unpaired-start case — no wait at all — still reports).
+DMA_SPEC = ProtocolSpec(
+    name="pallas-dma",
+    mint=frozenset(),
+    mint_names=frozenset({"make_async_copy", "make_async_remote_copy"}),
+    mint_attrs=frozenset(),
+    initial="created",
+    ops={
+        "start": (frozenset({"created"}), "started"),
+        "wait": (frozenset({"created", "started"}), "waited"),
+        "wait_send": (
+            frozenset({"created", "started", "recv_waited"}),
+            "send_waited",
+        ),
+        "wait_recv": (
+            frozenset({"created", "started", "send_waited"}),
+            "recv_waited",
+        ),
+    },
+    reads={},
+    open_states=frozenset({"created", "started"}),
+    terminal=frozenset({"waited"}),
+    code_op="PAL002",
+    code_leak="PAL001",
+    code_escape="PAL001",
+    code_mix="PAL004",
+    waiver=_WAIVER,
+    flag_escapes=False,  # returning a descriptor is a legit hand-off
+    check_mix=False,     # waiting on several DMAs in one call is normal
+    exc_leaks=False,     # kernels cannot raise at runtime — a Python
+    #                      exception aborts TRACING; only fallthrough
+    #                      paths can reach the chip with a missing wait
+)
+
+
+def _pallas_modules(project: Project) -> list[SourceModule]:
+    """Modules that import jax.experimental.pallas (or a submodule like
+    pallas.tpu) — matched on the RESOLVED import target, not a name
+    substring, so a module that merely imports a pallas-named wrapper
+    (ops.pallas_scan's public functions) does not join the analyzed set
+    and re-arm the generic start/wait tracking this gate exists to
+    contain."""
+    out = []
+    for module in project.modules:
+        if any(
+            target == "jax.experimental.pallas"
+            or target.startswith("jax.experimental.pallas.")
+            for target in module.aliases.values()
+        ):
+            out.append(module)
+    return out
+
+
+# ------------------------------------------------------------ DMA typestate
+
+
+def _check_dma(
+    project: Project,
+    modules: list[SourceModule],
+    targets: set[str] | None,
+    findings: list[Finding],
+) -> None:
+    index = _SpecIndex({DMA_SPEC.name: DMA_SPEC})
+    resolvers = _ResolverCache(project)
+    contexts = [
+        (module, cls_name, fn)
+        for module in modules
+        for cls_name, fn in _functions(module)
+    ]
+    wrappers = _mint_wrappers(index, resolvers, contexts)
+    param_ops = _param_op_summaries(index, resolvers, contexts)
+    for module, cls_name, fn in contexts:
+        if targets is not None and module.path not in targets:
+            continue
+        _FunctionAnalyzer(
+            module, fn, index, wrappers, param_ops, findings,
+            resolvers.get(module, cls_name, fn),
+        ).analyze()
+
+
+# ------------------------------------------------------ semaphore pairing
+
+
+def _sem_base(node: ast.AST) -> str | None:
+    """The semaphore identity of a signal/wait argument: the dotted base
+    with ``.at[...]`` / ``[...]`` subscripts stripped (``sems.at[0]`` and
+    ``sems.at[1]`` are the same allocation)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    dotted = _dotted(node)
+    if dotted is None:
+        return None
+    if dotted.endswith(".at"):
+        dotted = dotted[: -len(".at")]
+    return dotted
+
+
+def _scope_sem_calls(scope: list[ast.AST]):
+    """semaphore_signal/semaphore_wait calls of one function scope,
+    not descending into nested defs (each kernel is its own pairing
+    scope — same-named ``sems`` parameters in unrelated kernels must
+    not pair up across functions and mask a real unpaired site)."""
+    work: list[ast.AST] = list(scope)
+    while work:
+        node = work.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        work.extend(ast.iter_child_nodes(node))
+
+
+def _check_semaphores(
+    modules: list[SourceModule],
+    targets: set[str] | None,
+    findings: list[Finding],
+) -> None:
+    for module in modules:
+        if targets is not None and module.path not in targets:
+            continue
+        scopes: list[list[ast.AST]] = [
+            [s for s in module.tree.body
+             if not isinstance(s, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        ]
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(list(node.body))
+        signals: dict[str, int] = {}
+        waits: dict[str, int] = {}
+        for i, scope in enumerate(scopes):
+            for node in _scope_sem_calls(scope):
+                if not node.args:
+                    continue
+                resolved = module.resolve(node.func)
+                if resolved is None:
+                    continue
+                tail = resolved.rsplit(".", 1)[-1]
+                if tail not in ("semaphore_signal", "semaphore_wait"):
+                    continue
+                base = _sem_base(node.args[0])
+                if base is None:
+                    continue
+                side = signals if tail == "semaphore_signal" else waits
+                side.setdefault(f"{i}:{base}", node.lineno)
+        ann = module.annotations
+        for key, line in signals.items():
+            base = key.split(":", 1)[1]
+            if key not in waits and not ann.waived(line, _WAIVER):
+                findings.append(
+                    Finding(
+                        "PAL001", module.path, line,
+                        f"semaphore {base!r} is signaled but never waited "
+                        "in this function: its count leaks across grid "
+                        "steps and corrupts the next kernel's "
+                        "synchronization",
+                    )
+                )
+        for key, line in waits.items():
+            base = key.split(":", 1)[1]
+            if key not in signals and not ann.waived(line, _WAIVER):
+                findings.append(
+                    Finding(
+                        "PAL001", module.path, line,
+                        f"semaphore {base!r} is waited but never signaled "
+                        "in this function: the wait can never be satisfied "
+                        "— this hangs the kernel",
+                    )
+                )
+
+
+# -------------------------------------------------- pallas_call statics
+
+
+def _literal_int_tuple(node: ast.AST | None) -> list[int] | None:
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: list[int] = []
+    for elt in node.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+            out.append(elt.value)
+        else:
+            return None
+    return out
+
+
+def _out_shape_expr(call: ast.Call) -> ast.AST | None:
+    """The out_shape expression of a pallas_call: keyword form or the
+    second positional argument (jax allows both spellings — missing the
+    positional form misclassified output refs as inputs)."""
+    kw = _kwarg(call, "out_shape")
+    if kw is not None:
+        return kw
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _blockspec_shape(node: ast.AST) -> list[int] | None:
+    """The literal block shape of a ``pl.BlockSpec((bt, bb), ...)``."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    return _literal_int_tuple(node.args[0])
+
+
+def _out_shape_dims(node: ast.AST | None) -> list[list[int]] | None:
+    """Literal dims of each ``ShapeDtypeStruct`` in ``out_shape``."""
+    if node is None:
+        return None
+    structs = (
+        list(node.elts) if isinstance(node, (ast.Tuple, ast.List))
+        else [node]
+    )
+    out: list[list[int]] = []
+    for s in structs:
+        if not (isinstance(s, ast.Call) and s.args):
+            return None
+        dims = _literal_int_tuple(s.args[0])
+        if dims is None:
+            return None
+        out.append(dims)
+    return out
+
+
+def _check_pallas_calls(
+    project: Project,
+    modules: list[SourceModule],
+    targets: set[str] | None,
+    findings: list[Finding],
+) -> None:
+    index = project.function_index
+    for module in modules:
+        if targets is not None and module.path not in targets:
+            continue
+        ann = module.annotations
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve(node.func)
+            if resolved is None or resolved.rsplit(".", 1)[-1] != (
+                "pallas_call"
+            ):
+                continue
+            # PAL003: literal out block shape must divide the literal
+            # out_shape dims.
+            out_shape_expr = _out_shape_expr(node)
+            shapes = _out_shape_dims(out_shape_expr)
+            out_specs = _kwarg(node, "out_specs")
+            specs = (
+                list(out_specs.elts)
+                if isinstance(out_specs, (ast.Tuple, ast.List))
+                else [out_specs] if out_specs is not None else []
+            )
+            if shapes is not None and len(specs) == len(shapes):
+                for spec, dims in zip(specs, shapes):
+                    block = _blockspec_shape(spec)
+                    if block is None or len(block) != len(dims):
+                        continue
+                    bad = [
+                        (b, d)
+                        for b, d in zip(block, dims)
+                        if b > 0 and d % b != 0
+                    ]
+                    if bad and not ann.waived(node.lineno, _WAIVER):
+                        findings.append(
+                            Finding(
+                                "PAL003", module.path, node.lineno,
+                                f"BlockSpec block {tuple(block)} does not "
+                                f"divide out_shape {tuple(dims)} "
+                                f"(offending (block, dim): {bad}): Pallas "
+                                "does not pad for you — the tail tile "
+                                "reads/writes out of bounds",
+                            )
+                        )
+            # PAL004: kernel stores into an input ref without declared
+            # input_output_aliases.
+            if _kwarg(node, "input_output_aliases") is not None:
+                continue
+            fn_expr = node.args[0] if node.args else None
+            if not isinstance(fn_expr, (ast.Name, ast.Attribute)):
+                continue
+            hit = index.resolve_callable(module, fn_expr)
+            if hit is None:
+                continue
+            kernel = hit[1]
+            params = [
+                a.arg
+                for a in kernel.args.posonlyargs + kernel.args.args
+            ]
+            # Output count comes from the out_shape AST STRUCTURE, not
+            # its literal dims: a two-struct tuple with runtime shapes
+            # is still two outputs (counting it as one would push an
+            # output ref into the inputs set and flag a correct store).
+            if isinstance(out_shape_expr, (ast.Tuple, ast.List)):
+                n_outs = len(out_shape_expr.elts)
+            elif out_shape_expr is not None:
+                n_outs = 1
+            else:
+                n_outs = 0
+            scratch = _kwarg(node, "scratch_shapes")
+            if scratch is not None and not isinstance(
+                scratch, (ast.Tuple, ast.List)
+            ):
+                # Non-literal scratch list: the kernel's parameter
+                # layout is unknowable — skip rather than misclassify
+                # output/scratch refs as inputs.
+                continue
+            n_scratch = (
+                len(scratch.elts)
+                if isinstance(scratch, (ast.Tuple, ast.List))
+                else 0
+            )
+            n_inputs = len(params) - n_outs - n_scratch
+            if n_inputs <= 0:
+                continue
+            inputs = set(params[:n_inputs])
+            for sub in ast.walk(kernel):
+                if (
+                    isinstance(sub, ast.Subscript)
+                    and isinstance(sub.ctx, ast.Store)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id in inputs
+                    and not ann.waived(sub.lineno, _WAIVER)
+                ):
+                    findings.append(
+                        Finding(
+                            "PAL004", module.path, sub.lineno,
+                            f"kernel {getattr(kernel, 'name', '?')} "
+                            f"stores into input ref "
+                            f"{sub.value.id!r} but the pallas_call "
+                            "declares no input_output_aliases: an "
+                            "undeclared in-place update is silent "
+                            "data corruption — alias it or write to "
+                            "the output ref",
+                        )
+                    )
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """``targets`` (incremental cache): PAL findings attach to the file
+    containing the flagged statement and are re-derived per file; the
+    wrapper/param-op summaries are rebuilt from the pallas-importing
+    module set on every non-warm run."""
+    findings: list[Finding] = []
+    modules = _pallas_modules(project)
+    if not modules:
+        return findings
+    _check_dma(project, modules, targets, findings)
+    _check_semaphores(modules, targets, findings)
+    _check_pallas_calls(project, modules, targets, findings)
+    return findings

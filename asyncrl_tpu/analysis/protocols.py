@@ -99,6 +99,25 @@ class ProtocolSpec:
     reads: dict[str, frozenset[str]]  # attr -> allowed states
     open_states: frozenset[str]
     terminal: frozenset[str]
+    # Finding codes + waiver tag the spec reports under. The defaults are
+    # the lease-protocol family; the pallas pass reuses this engine with
+    # PAL codes and the pallas-ok waiver (one engine, two code families —
+    # a second CFG walker would drift from this one).
+    code_op: str = "PROT001"
+    code_leak: str = "PROT002"
+    code_escape: str = "PROT003"
+    code_mix: str = "PROT004"
+    waiver: str = "protocol-ok"
+    # Escape/mix checks are lease semantics (a lease outliving its scope
+    # defeats the generation fence); specs whose objects are legitimately
+    # handed around (DMA descriptors) turn them off.
+    flag_escapes: bool = True
+    check_mix: bool = True
+    # Whether an object still open on an EXCEPTION edge leaks. True for
+    # host-side leases (an exception that skips void() wedges the slab);
+    # False for objects living in traced kernel code, where a Python
+    # exception aborts tracing and no runtime path exists to hang.
+    exc_leaks: bool = True
 
     def facade_names(self) -> frozenset[str]:
         """Function names sanctioned to RETURN a tracked object (the
@@ -525,10 +544,12 @@ class _FunctionAnalyzer:
 
     # ------------------------------------------------------------ report
 
-    def _report(self, code: str, line: int, key: str, message: str) -> None:
+    def _report(
+        self, code: str, line: int, key: str, message: str, waiver: str
+    ) -> None:
         if (code, line, key) in self.reported:
             return
-        if self.module.annotations.waived(line, "protocol-ok"):
+        if self.module.annotations.waived(line, waiver):
             return
         self.reported.add((code, line, key))
         self.findings.append(Finding(code, self.module.path, line, message))
@@ -583,11 +604,12 @@ class _FunctionAnalyzer:
                 else "out-of-order op"
             )
             self._report(
-                "PROT001", line, f"{oid}:{op}",
+                spec.code_op, line, f"{oid}:{op}",
                 f"{op}() on a {spec.name} object (minted line {mint_line}) "
                 f"that can already be {sorted(bad)} on some path — {verb}; "
                 "the protocol allows it only from "
                 f"{sorted(allowed - {_ADOPTED, _BORROWED})}",
+                waiver=spec.waiver,
             )
         objs = dict(objs)
         # _ESCAPED and _BORROWED ride along across ops: a borrowed
@@ -603,14 +625,15 @@ class _FunctionAnalyzer:
         self, state: _State, oid: tuple, line: int, how: str, flag: bool
     ) -> _State:
         spec, mint_line = self.obj_info[oid]
-        if flag:
+        if flag and spec.flag_escapes:
             self._report(
-                "PROT003", line, f"{oid}:{how}",
+                spec.code_escape, line, f"{oid}:{how}",
                 f"{spec.name} object (minted line {mint_line}) escapes its "
                 f"acquiring scope ({how}): a lease/row-view outliving its "
                 "scope defeats the generation fence — declare a sanctioned "
-                "hand-off with '# lint: protocol-ok(<reason>)' or keep it "
-                "local",
+                f"hand-off with '# lint: {spec.waiver}(<reason>)' or keep "
+                "it local",
+                waiver=spec.waiver,
             )
         vars_out, objs = state
         objs = dict(objs)
@@ -674,10 +697,11 @@ class _FunctionAnalyzer:
             bad = cur - allowed - {_ADOPTED, _BORROWED}
             if bad:
                 self._report(
-                    "PROT001", attr.lineno, f"{oid}:read:{attr.attr}",
+                    spec.code_op, attr.lineno, f"{oid}:read:{attr.attr}",
                     f".{attr.attr} read on a {spec.name} object (minted "
                     f"line {mint_line}) that can already be {sorted(bad)} "
                     f"— legal only in {sorted(allowed)}",
+                    waiver=spec.waiver,
                 )
         return state
 
@@ -720,10 +744,13 @@ class _FunctionAnalyzer:
             # formal parameters, not acquire sites — a helper taking a
             # lease plus a payload (both seeded borrowed by the param-op
             # summary) is not a generation mix. Real mixing is checked
-            # in the caller, where the acquire sites are visible.
+            # in the caller, where the acquire sites are visible. Specs
+            # that opt out of mix checking (DMA descriptors: waiting on
+            # several in one call is normal) are excluded too.
             oids = frozenset(
                 o for o in self._tracked(state, arg)
                 if _BORROWED not in state[1].get(o, frozenset())
+                and self.obj_info[o][0].check_mix
             )
             for spec_name in {self.obj_info[o][0].name for o in oids}:
                 per_arg.append(
@@ -743,11 +770,13 @@ class _FunctionAnalyzer:
             if len(distinct) >= 2:
                 lines = sorted({self.obj_info[o][1] for g in groups
                                 for o in g})
+                spec = self.index.specs[spec_name]
                 self._report(
-                    "PROT004", call.lineno, f"mix:{spec_name}",
+                    spec.code_mix, call.lineno, f"mix:{spec_name}",
                     f"call combines {spec_name} objects from distinct "
                     f"mint sites (lines {lines}): a mixed-generation "
                     "batch/dispatch breaks the generation fence",
+                    waiver=spec.waiver,
                 )
         return state
 
@@ -793,15 +822,16 @@ class _FunctionAnalyzer:
                 spec, mint_line = self.obj_info[oid]
                 leaked = st & spec.open_states
                 if leaked and not self.module.annotations.waived(
-                    mint_line, "protocol-ok"
+                    mint_line, spec.waiver
                 ):
                     self._report(
-                        "PROT002", mint_line, f"{oid}:leak",
+                        spec.code_leak, mint_line, f"{oid}:leak",
                         f"{spec.name} object minted here is still "
                         f"{sorted(leaked)} when its last reference is "
                         f"rebound at line {line}: close it "
                         f"({', '.join(sorted(spec.ops)) or 'hand it off'})"
                         " first, or declare the hand-off",
+                        waiver=spec.waiver,
                     )
         return vars_out, objs
 
@@ -946,15 +976,16 @@ class _FunctionAnalyzer:
                     spec is not None
                     and spec.initial in spec.open_states
                     and not self.module.annotations.waived(
-                        line, "protocol-ok"
+                        line, spec.waiver
                     )
                 ):
                     self._report(
-                        "PROT002", line, f"discard:{line}",
+                        spec.code_leak, line, f"discard:{line}",
                         f"{spec.name} mint result discarded: the object "
                         f"is open ({spec.initial!r}) and already "
                         "unreachable — bind it and close it "
                         f"({', '.join(sorted(spec.ops)) or 'hand it off'})",
+                        waiver=spec.waiver,
                     )
             return state, state
         return state, state
@@ -997,22 +1028,25 @@ class _FunctionAnalyzer:
                 if _ESCAPED in st or _BORROWED in st:
                     continue
                 spec, mint_line = self.obj_info[oid]
+                if exit_node is flow.raise_exit and not spec.exc_leaks:
+                    continue
                 leaked = st & spec.open_states
                 if not leaked:
                     continue
-                if self.module.annotations.waived(mint_line, "protocol-ok"):
+                if self.module.annotations.waived(mint_line, spec.waiver):
                     continue
                 self._report(
-                    "PROT002", mint_line, f"{oid}:leak",
+                    spec.code_leak, mint_line, f"{oid}:leak",
                     f"{spec.name} object minted here can reach {kind} of "
                     f"{self.fn_name} still {sorted(leaked)}: close it "
                     f"({', '.join(sorted(spec.ops)) or 'hand it off'}) on "
                     "every path, including exception edges, or declare the "
                     "hand-off",
+                    waiver=spec.waiver,
                 )
 
     def _check_thread_captures(self) -> None:
-        mint_targets: set[str] = set()
+        mint_targets: dict[str, ProtocolSpec] = {}
         for sub in ast.walk(self.fn):
             if isinstance(sub, ast.Assign) and isinstance(
                 sub.value, ast.Call
@@ -1020,17 +1054,17 @@ class _FunctionAnalyzer:
                 spec = _mint_spec_for_call(
                     self.index, self.resolver, self.wrappers, sub.value
                 )
-                if spec is None:
+                if spec is None or not spec.flag_escapes:
                     continue
                 for t in sub.targets:
                     for elt in (
                         t.elts if isinstance(t, ast.Tuple) else [t]
                     ):
                         if isinstance(elt, ast.Name):
-                            mint_targets.add(elt.id)
+                            mint_targets[elt.id] = spec
         if not mint_targets:
             return
-        capturing: dict[str, ast.AST] = {}
+        capturing: dict[str, ProtocolSpec] = {}
         for sub in ast.walk(self.fn):
             if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if sub is self.fn:
@@ -1041,19 +1075,22 @@ class _FunctionAnalyzer:
                     if isinstance(n, ast.Name)
                     and isinstance(n.ctx, ast.Load)
                 }
-                if free & mint_targets:
-                    capturing[sub.name] = sub
+                captured = free & set(mint_targets)
+                if captured:
+                    capturing[sub.name] = mint_targets[sorted(captured)[0]]
         for sub in ast.walk(self.fn):
             if not isinstance(sub, ast.Call):
                 continue
-            handed = []
+            handed: list[tuple[str, ProtocolSpec]] = []
             for kw in sub.keywords:
                 if kw.arg == "target":
                     if (
                         isinstance(kw.value, ast.Name)
                         and kw.value.id in capturing
                     ):
-                        handed.append(kw.value.id)
+                        handed.append(
+                            (kw.value.id, capturing[kw.value.id])
+                        )
                     elif isinstance(kw.value, ast.Lambda):
                         free = {
                             n.id
@@ -1061,8 +1098,12 @@ class _FunctionAnalyzer:
                             if isinstance(n, ast.Name)
                             and isinstance(n.ctx, ast.Load)
                         }
-                        if free & mint_targets:
-                            handed.append("<lambda>")
+                        captured = free & set(mint_targets)
+                        if captured:
+                            handed.append((
+                                "<lambda>",
+                                mint_targets[sorted(captured)[0]],
+                            ))
             if (
                 isinstance(sub.func, ast.Attribute)
                 and sub.func.attr == "submit"
@@ -1070,14 +1111,17 @@ class _FunctionAnalyzer:
                 and isinstance(sub.args[0], ast.Name)
                 and sub.args[0].id in capturing
             ):
-                handed.append(sub.args[0].id)
-            for name in handed:
+                handed.append(
+                    (sub.args[0].id, capturing[sub.args[0].id])
+                )
+            for name, spec in handed:
                 self._report(
-                    "PROT003", sub.lineno, f"thread:{name}",
+                    spec.code_escape, sub.lineno, f"thread:{name}",
                     f"closure {name!r} captures a protocol object and is "
                     "handed to a thread target: the lease outlives its "
                     "acquiring frame on another thread — pass the work "
                     "through the declared hand-off instead",
+                    waiver=spec.waiver,
                 )
 
 

@@ -1,0 +1,552 @@
+"""Sharding-contract pass (SHD0xx): the ``shard_map``/mesh surface.
+
+The multi-host arc (ROADMAP item 1) grows exactly the code this pass
+guards: hybrid-mesh construction, ``shard_map`` spec plumbing, and
+PartitionSpec axis naming. The bugs it catches do not raise useful
+errors — a spec-arity mismatch fails deep inside a trace as an opaque
+pytree error, a misnamed PartitionSpec axis fails only when the code
+first runs on a mesh that lacks it, and ``check_rep=False`` silently
+disables the replication checking every learner body relies on.
+
+- SHD001 — a ``shard_map`` call whose literal ``in_specs`` tuple arity
+  differs from the wrapped function's positional signature, or whose
+  literal ``out_specs`` tuple arity differs from the function's literal
+  return tuple. Only statically-decidable sites are checked: the wrapped
+  callable must resolve to a def/lambda (a Name that is also a local
+  assignment target anywhere in the module is skipped — it may be
+  rebound), and specs count only when written as literal tuples/lists
+  (a single ``P(...)`` is a valid pytree prefix of the whole argument
+  tuple and is never flagged).
+- SHD002 — axis-name congruence: (a) a ``PartitionSpec``/``P`` argument
+  whose statically-known axis string (resolved through ``*_AXIS``
+  constants, the collectives pass's machinery) is bound by NO real mesh
+  binding site in the analyzed project — ``Mesh``/``make_mesh`` axis
+  tuples, ``pmap``/``shard_map`` ``axis_name`` kwargs, and
+  ``mesh_axes``/``axis_names`` defaults; unlike COL001, a bare ``*_AXIS``
+  constant does not count (declaring a name is not giving it a mesh
+  dimension) — and (b) an axis ALIAS COLLISION: two distinct ``*_AXIS``
+  constants resolving to the same string, or a static mesh axis tuple
+  with duplicate names. Collisions are the careless-rename bug: with
+  ``TIME_AXIS`` renamed onto ``"dp"``, ``dp_axes()`` silently excludes
+  the data-parallel axis and every gradient all-reduce disappears.
+- SHD003 — mesh-construction statics: a ``make_mesh``/``Mesh`` call (or
+  ``make_mesh`` parameter defaults) whose mesh-shape tuple arity differs
+  from its axis-name tuple arity, more than one inferred (``-1``)
+  dimension, a zero/negative literal dimension, or a fully-literal shape
+  whose product mismatches a literal ``devices=[...]`` list.
+- SHD004 — ``check_rep=False`` on a ``shard_map`` call without a
+  reason-carrying ``# lint: sharding-ok(<reason>)`` waiver. Disabling
+  the replication checker also disables the transpose rewrite that psums
+  gradients of replicated inputs — a silent wrong-gradients switch.
+
+When the project binds no axes at all, SHD002(a) disarms rather than
+guessing (a lone ops file legitimately names axes its caller binds) —
+the same rule COL001 follows.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from asyncrl_tpu.analysis.core import (
+    MESH_MAKER_TAILS,
+    mesh_axes_exprs,
+    Finding,
+    Project,
+    SourceModule,
+    bound_axes,
+    call_kwarg as _kwarg,
+    const_strs,
+    module_constant,
+)
+
+_WAIVER = "sharding-ok"
+
+# Positional (shape, axes) argument indices for the mesh makers whose
+# calls carry STATIC shape/axes expressions SHD003 can check. Membership
+# in the mesh-maker family itself is core.MESH_MAKER_TAILS (shared with
+# collectives/hostsync); make_hybrid_mesh has no shape/axes parameters —
+# its axes are implicit — so it has no entry here, and a future maker
+# with static arguments must add one or its statics go unchecked.
+_MESH_MAKERS = {"make_mesh": (0, 1), "Mesh": (None, 1)}
+
+
+def _const_str_tuple(
+    module: SourceModule, node: ast.AST
+) -> list[str] | None:
+    """Like core.const_strs but ORDER- and DUPLICATE-preserving: the
+    literal axis tuple as a list of strings, or None when any element is
+    not statically known."""
+    if isinstance(node, ast.Constant):
+        return [node.value] if isinstance(node.value, str) else None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in node.elts:
+            sub = _const_str_tuple(module, elt)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        resolved = module.resolve(node)
+        if resolved is None:
+            return None
+        const = module_constant(module, resolved)
+        if const is None:
+            return None
+        return _const_str_tuple(module, const)
+    return None
+
+
+def _tuple_len(node: ast.AST | None) -> int | None:
+    """Arity of a literal tuple/list (elements may be runtime values)."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    return None
+
+
+def _positional_arity(fn: ast.AST) -> tuple[int, int] | None:
+    """(min, max) positional-parameter count of a def/lambda — defaulted
+    parameters are optional, so any spec arity in the range is legal;
+    None when *args/**kw make the arity open-ended. ``self``/``cls`` are
+    excluded."""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    if args.vararg is not None or args.kwarg is not None:
+        return None
+    params = [a.arg for a in args.posonlyargs + args.args]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    n = len(params)
+    return max(0, n - len(args.defaults)), n
+
+
+def _assigned_names(module: SourceModule) -> set[str]:
+    """Every name that is BOUND anywhere in the module other than by a
+    def — assignment targets, function/lambda parameters, for/with
+    targets, comprehension targets. A shard_map callable matching one of
+    these may be a rebound local (``wrapped = fuse_updates(body)``) or a
+    passed-in function (``def build(body): ... shard_map(body, ...)``),
+    not the def the index resolves — skip rather than compare against
+    the wrong signature."""
+    cached = getattr(module, "_shd_assigned", None)
+    if cached is not None:
+        return cached
+    names: set[str] = set()
+    for node in ast.walk(module.tree):
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.NamedExpr):
+            targets = [node.target]
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            targets = [node.target]
+        elif isinstance(node, ast.comprehension):
+            targets = [node.target]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            targets = [
+                i.optional_vars for i in node.items
+                if i.optional_vars is not None
+            ]
+        elif isinstance(node, ast.arg):
+            names.add(node.arg)
+        for t in targets:
+            for elt in ast.walk(t):
+                if isinstance(elt, ast.Name):
+                    names.add(elt.id)
+    module._shd_assigned = names
+    return names
+
+
+def _own_return_tuple_arities(fn: ast.AST) -> list[tuple[int, int]]:
+    """(line, arity) for every literal-tuple return of ``fn`` itself."""
+    out: list[tuple[int, int]] = []
+    if isinstance(fn, ast.Lambda):
+        if isinstance(fn.body, ast.Tuple):
+            out.append((fn.body.lineno, len(fn.body.elts)))
+        return out
+    work = list(getattr(fn, "body", []) or [])
+    while work:
+        node = work.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Return) and isinstance(
+            node.value, ast.Tuple
+        ):
+            out.append((node.lineno, len(node.value.elts)))
+        work.extend(ast.iter_child_nodes(node))
+    return out
+
+
+# ----------------------------------------------------------------- SHD001
+
+
+def _check_spec_arity(
+    project: Project, targets: set[str] | None, findings: list[Finding]
+) -> None:
+    index = project.function_index
+    for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve(node.func)
+            if resolved is None or resolved.rsplit(".", 1)[-1] != "shard_map":
+                continue
+            fn_expr = node.args[0] if node.args else _kwarg(node, "f")
+            if fn_expr is None:
+                continue
+            fn: ast.AST | None = None
+            if isinstance(fn_expr, ast.Lambda):
+                fn = fn_expr
+            elif isinstance(fn_expr, ast.Name):
+                if fn_expr.id in _assigned_names(module):
+                    continue  # possibly a rebound local, not the def
+                hit = index.resolve_callable(module, fn_expr)
+                if hit is not None:
+                    fn = hit[1]
+            if fn is None:
+                continue
+            if module.annotations.waived(node.lineno, _WAIVER):
+                continue
+            arity = _positional_arity(fn)
+            name = getattr(fn, "name", "<lambda>")
+            in_specs = _kwarg(node, "in_specs")
+            n_in = _tuple_len(in_specs)
+            if (
+                arity is not None
+                and n_in is not None
+                and not (arity[0] <= n_in <= arity[1])
+            ):
+                lo, hi = arity
+                takes = str(hi) if lo == hi else f"{lo}..{hi}"
+                findings.append(
+                    Finding(
+                        "SHD001", module.path, node.lineno,
+                        f"shard_map in_specs is a {n_in}-tuple but the "
+                        f"wrapped function {name} takes {takes} positional "
+                        "argument(s): the spec pytree must match the "
+                        "argument tuple — this fails as an opaque pytree "
+                        "error at trace time",
+                    )
+                )
+            out_specs = _kwarg(node, "out_specs")
+            n_out = _tuple_len(out_specs)
+            if n_out is not None:
+                for line, ret_arity in _own_return_tuple_arities(fn):
+                    if ret_arity != n_out:
+                        findings.append(
+                            Finding(
+                                "SHD001", module.path, node.lineno,
+                                f"shard_map out_specs is a {n_out}-tuple "
+                                f"but {name} returns a {ret_arity}-tuple "
+                                f"(line {line}): the out spec structure "
+                                "must match the function's output",
+                            )
+                        )
+
+
+# ----------------------------------------------------------------- SHD002
+
+
+def _axis_constants(
+    project: Project,
+) -> list[tuple[SourceModule, str, str, int]]:
+    """Every ``*_AXIS = "<str>"`` declaration in the project, in
+    deterministic (path, line) order: (module, name, value, line)."""
+    out: list[tuple[SourceModule, str, str, int]] = []
+    for module in sorted(project.modules, key=lambda m: m.path):
+        for stmt in module.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for t in stmt.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id.endswith("_AXIS")
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)
+                ):
+                    out.append((module, t.id, stmt.value.value, stmt.lineno))
+    return out
+
+
+def _check_axis_names(
+    project: Project, targets: set[str] | None, findings: list[Finding]
+) -> None:
+    strict = bound_axes(project, include_axis_constants=False)
+    # (b) alias collisions among *_AXIS constants: PROJECT-wide value
+    # map (a new parallel/ module re-declaring another module's axis
+    # string is exactly the cross-file careless rename). The collision
+    # is reported SYMMETRICALLY at every colliding declaration — which
+    # declaration is "the new one" is unknowable statically (sorted
+    # path order would blame whichever file happens to sort later), and
+    # symmetric reporting keeps per-file cache attribution sound (each
+    # finding lives in its own file; the peer is code the env hash
+    # covers).
+    by_value: dict[str, list[tuple]] = {}
+    for decl in _axis_constants(project):
+        by_value.setdefault(decl[2], []).append(decl)
+    for value, decls in by_value.items():
+        if len({name for _, name, _, _ in decls}) < 2:
+            continue
+        for module, name, _, line in decls:
+            if targets is not None and module.path not in targets:
+                continue
+            if module.annotations.waived(line, _WAIVER):
+                continue
+            others = sorted(
+                {n for _, n, _, _ in decls if n != name}
+            )
+            findings.append(
+                Finding(
+                    "SHD002", module.path, line,
+                    f"axis constant {name} aliases {value!r}, also "
+                    f"declared as {', '.join(others)}: two axis names "
+                    "resolving to one mesh axis breaks every by-name "
+                    "axis selection (dp_axes, reserved-axis exclusion) "
+                    "silently",
+                )
+            )
+    for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
+        ann = module.annotations
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve(node.func)
+            if resolved is None:
+                continue
+            tail = resolved.rsplit(".", 1)[-1]
+            # (b) duplicate names inside one static mesh-axes tuple.
+            if tail in MESH_MAKER_TAILS:
+                for expr in mesh_axes_exprs(node, tail):
+                    axes = _const_str_tuple(module, expr)
+                    if axes is not None and len(axes) != len(set(axes)):
+                        if not ann.waived(node.lineno, _WAIVER):
+                            findings.append(
+                                Finding(
+                                    "SHD002", module.path, node.lineno,
+                                    f"mesh axis tuple {tuple(axes)} "
+                                    "contains a duplicate axis name: "
+                                    "every mesh axis must be unique",
+                                )
+                            )
+            # (a) PartitionSpec axis names vs real binding sites.
+            if tail != "PartitionSpec" or not strict:
+                continue
+            for arg in node.args:
+                strs = const_strs(module, arg)
+                if strs is None:
+                    continue  # runtime axis value: out of static reach
+                unbound = sorted(s for s in strs
+                                 if isinstance(s, str) and s not in strict)
+                if unbound and not ann.waived(node.lineno, _WAIVER):
+                    findings.append(
+                        Finding(
+                            "SHD002", module.path, node.lineno,
+                            f"PartitionSpec names axis "
+                            f"{', '.join(map(repr, unbound))} which no "
+                            "Mesh/make_mesh/pmap/shard_map binding site "
+                            "in the analyzed project provides (bound: "
+                            f"{sorted(strict)}): sharding by it fails "
+                            "the moment this spec meets a real mesh",
+                        )
+                    )
+
+
+# ----------------------------------------------------------------- SHD003
+
+
+def _literal_ints(node: ast.AST | None) -> list[int | None] | None:
+    """Tuple elements as ints where literal, None per element otherwise;
+    None overall when the node is not a literal tuple/list."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: list[int | None] = []
+    for elt in node.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+            out.append(elt.value)
+        elif (
+            isinstance(elt, ast.UnaryOp)
+            and isinstance(elt.op, ast.USub)
+            and isinstance(elt.operand, ast.Constant)
+            and isinstance(elt.operand.value, int)
+        ):
+            out.append(-elt.operand.value)
+        else:
+            out.append(None)
+    return out
+
+
+def _check_mesh_statics(
+    module: SourceModule,
+    shape_expr: ast.AST | None,
+    axes_expr: ast.AST | None,
+    devices_expr: ast.AST | None,
+    line: int,
+    findings: list[Finding],
+) -> None:
+    ann = module.annotations
+    if ann.waived(line, _WAIVER):
+        return
+    shape = _literal_ints(shape_expr)
+    axes = _const_str_tuple(module, axes_expr) if axes_expr is not None \
+        else None
+    n_axes = len(axes) if axes is not None else _tuple_len(axes_expr)
+    n_shape = _tuple_len(shape_expr)
+    if n_shape is not None and n_axes is not None and n_shape != n_axes:
+        findings.append(
+            Finding(
+                "SHD003", module.path, line,
+                f"mesh shape has {n_shape} dimension(s) but "
+                f"{n_axes} axis name(s): every mesh dimension needs "
+                "exactly one name",
+            )
+        )
+    if shape is None:
+        return
+    literals = [s for s in shape if s is not None]
+    if sum(1 for s in literals if s == -1) > 1:
+        findings.append(
+            Finding(
+                "SHD003", module.path, line,
+                "mesh shape infers more than one dimension (-1): at most "
+                "one dimension can be derived from the device count",
+            )
+        )
+    for s in literals:
+        if s == 0 or s < -1:
+            findings.append(
+                Finding(
+                    "SHD003", module.path, line,
+                    f"mesh shape contains invalid dimension {s}: "
+                    "dimensions must be positive (or one -1 to infer)",
+                )
+            )
+    if (
+        devices_expr is not None
+        and isinstance(devices_expr, (ast.Tuple, ast.List))
+        and all(s is not None and s > 0 for s in shape)
+    ):
+        prod = 1
+        for s in shape:
+            prod *= s  # type: ignore[operator]
+        n_dev = len(devices_expr.elts)
+        if prod != n_dev:
+            findings.append(
+                Finding(
+                    "SHD003", module.path, line,
+                    f"mesh shape product {prod} does not divide into the "
+                    f"{n_dev} device(s) listed: the reshape fails at "
+                    "construction on the pod",
+                )
+            )
+
+
+def _check_mesh_construction(
+    project: Project, targets: set[str] | None, findings: list[Finding]
+) -> None:
+    for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                resolved = module.resolve(node.func)
+                tail = resolved.rsplit(".", 1)[-1] if resolved else None
+                if tail not in _MESH_MAKERS:
+                    continue
+                shape_pos, axes_pos = _MESH_MAKERS[tail]
+                shape_expr = _kwarg(node, "mesh_shape")
+                if (
+                    shape_expr is None
+                    and shape_pos is not None
+                    and shape_pos < len(node.args)
+                ):
+                    shape_expr = node.args[shape_pos]
+                axes_expr = _kwarg(node, "mesh_axes") or _kwarg(
+                    node, "axis_names"
+                )
+                if axes_expr is None and axes_pos < len(node.args):
+                    axes_expr = node.args[axes_pos]
+                if tail == "Mesh":
+                    shape_expr = None  # device-array reshape, not a tuple
+                _check_mesh_statics(
+                    module, shape_expr, axes_expr,
+                    _kwarg(node, "devices"), node.lineno, findings,
+                )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # make_mesh-style defaults are call sites too (a call
+                # relying on them uses exactly these values).
+                args = node.args
+                pos = args.posonlyargs + args.args
+                defaults = dict(
+                    zip((a.arg for a in pos[len(pos) - len(args.defaults):]),
+                        args.defaults)
+                )
+                for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                    if d is not None:
+                        defaults.setdefault(a.arg, d)
+                if "mesh_shape" in defaults and (
+                    "mesh_axes" in defaults or "axis_names" in defaults
+                ):
+                    _check_mesh_statics(
+                        module, defaults["mesh_shape"],
+                        defaults.get("mesh_axes")
+                        or defaults.get("axis_names"),
+                        None, node.lineno, findings,
+                    )
+
+
+# ----------------------------------------------------------------- SHD004
+
+
+def _check_check_rep(
+    project: Project, targets: set[str] | None, findings: list[Finding]
+) -> None:
+    for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve(node.func)
+            if resolved is None or resolved.rsplit(".", 1)[-1] != "shard_map":
+                continue
+            for kwarg in ("check_rep", "check_vma"):
+                flag = _kwarg(node, kwarg)
+                if (
+                    isinstance(flag, ast.Constant)
+                    and flag.value is False
+                    and not module.annotations.waived(node.lineno, _WAIVER)
+                ):
+                    findings.append(
+                        Finding(
+                            "SHD004", module.path, node.lineno,
+                            f"{kwarg}=False disables shard_map's "
+                            "replication checking AND the transpose "
+                            "rewrite that psums gradients of replicated "
+                            "inputs — if this is deliberate, say why "
+                            "with '# lint: sharding-ok(<reason>)'",
+                        )
+                    )
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """``targets`` (incremental cache): when given, only emit findings for
+    those module paths; the axis-binding set is still computed over the
+    whole project (any cross-file code change invalidates the env hash,
+    so per-file caching stays sound)."""
+    findings: list[Finding] = []
+    _check_spec_arity(project, targets, findings)
+    _check_axis_names(project, targets, findings)
+    _check_mesh_construction(project, targets, findings)
+    _check_check_rep(project, targets, findings)
+    return findings

@@ -20,6 +20,21 @@ stop_gradient to the scan's INPUTS — their outputs are fixed targets by
 construction — so no custom VJP is defined; differentiating through this
 kernel raises, which is the correct loud failure if a future loss forgets
 the stop (covered by tests/test_pallas_scan.py grad tests).
+
+Two kernels share the math:
+
+- :func:`reverse_linear_scan_pallas` — automatic pipelining: Pallas
+  block-feeds [T, block] tiles into VMEM and double-buffers across grid
+  steps itself.
+- :func:`reverse_linear_scan_pallas_dma` — EXPLICIT DMA: inputs stay in
+  ``pltpu.ANY`` (compiler-placed/HBM) memory space and the kernel issues
+  its own ``pltpu.make_async_copy`` per tile against DMA semaphores
+  (start → compute window → wait). Numerically identical to the
+  automatic kernel; it exists as the beachhead for the ROADMAP item-2
+  kernels (ring all-reduce, device-resident rollout queues) that NEED
+  manual DMA — and as the live-tree surface the PAL static pass guards
+  (delete a ``wait`` and ``python -m asyncrl_tpu.analysis`` fails
+  before the chip can hang).
 """
 
 from __future__ import annotations
@@ -57,6 +72,57 @@ def _round_up(n: int, mult: int) -> int:
     return (n + mult - 1) // mult * mult
 
 
+def _out_struct(shape: tuple[int, ...], *arrays) -> jax.ShapeDtypeStruct:
+    """Output ShapeDtypeStruct, declaring varying-mesh-axes (vma) where
+    this jax tracks them. Under shard_map's vma semantics (jax >= 0.8,
+    ``jax.typeof``) the kernel output must declare which mesh axes it
+    varies over — exactly as its inputs do (the scan is pointwise in the
+    batch/shard axes). Older jax has neither ``jax.typeof`` nor the
+    ``vma=`` kwarg, so the declaration is skipped entirely there."""
+    typeof = getattr(jax, "typeof", None)
+    vma: frozenset = frozenset()
+    if typeof is not None:
+        for x in arrays:
+            vma |= getattr(typeof(x), "vma", frozenset())
+    if not vma:
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+    return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+
+
+def _prep(a: jax.Array, b: jax.Array, block_b: int):
+    """Shared wrapper prologue of BOTH kernels: flatten trailing dims
+    into the batch (lane) axis, pad to the f32 tile grid, and size the
+    batch block. One definition — the DMA twin's bit-identity to the
+    automatic kernel (pinned by test) depends on both choosing the SAME
+    tile geometry, so the sizing must not be able to diverge.
+
+    VMEM budget: three live tiles (a, b, out) plus one tile of headroom
+    for cross-grid-step double buffering (Pallas's own in the automatic
+    kernel, the planned slots in the DMA one) — 6 * T_pad * block * 4B
+    within ~8 MB of the ~16 MB VMEM, shrinking block as T grows instead
+    of overflowing on long fragments.
+
+    Returns (a2, b2, T, B, T_pad, B_pad, block, orig_shape); padded tail
+    rows have a=b=0, which correctly injects the x_T = 0 boundary into
+    the real region.
+    """
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch: {a.shape} vs {b.shape}")
+    orig_shape = a.shape
+    T = a.shape[0]
+    a2 = a.reshape(T, -1).astype(jnp.float32)
+    b2 = b.reshape(T, -1).astype(jnp.float32)
+    B = a2.shape[1]
+    T_pad = _round_up(T, _SUBLANE)
+    budget_elems = (8 * 1024 * 1024) // (6 * 4)
+    fit_b = max(_LANE, (budget_elems // T_pad) // _LANE * _LANE)
+    block = min(block_b, fit_b, _round_up(B, _LANE))
+    B_pad = _round_up(B, block)
+    a2 = jnp.pad(a2, ((0, T_pad - T), (0, B_pad - B)))
+    b2 = jnp.pad(b2, ((0, T_pad - T), (0, B_pad - B)))
+    return a2, b2, T, B, T_pad, B_pad, block, orig_shape
+
+
 @functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
 def reverse_linear_scan_pallas(
     a: jax.Array,
@@ -67,37 +133,12 @@ def reverse_linear_scan_pallas(
     """Solve x_t = b_t + a_t * x_{t+1}, x_T = 0, on the TPU VPU.
 
     ``a``/``b`` are time-major [T, ...]; trailing dims are flattened into
-    the batch (lane) axis and restored. Zero-padding is used to reach the
-    f32 tile grid (padded tail rows have a=b=0, which correctly injects the
-    x_T = 0 boundary into the real region). ``interpret=True`` runs the
-    kernel in the Pallas interpreter (CPU CI — SURVEY.md §4).
+    the batch (lane) axis and restored (see :func:`_prep`).
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU CI
+    — SURVEY.md §4).
     """
-    if a.shape != b.shape:
-        raise ValueError(f"shape mismatch: {a.shape} vs {b.shape}")
-    orig_shape = a.shape
-    T = a.shape[0]
-    a2 = a.reshape(T, -1).astype(jnp.float32)
-    b2 = b.reshape(T, -1).astype(jnp.float32)
-    B = a2.shape[1]
+    a2, b2, T, B, T_pad, B_pad, block, orig_shape = _prep(a, b, block_b)
 
-    T_pad = _round_up(T, _SUBLANE)
-    # VMEM budget: three live tiles (a, b, out) plus Pallas's cross-grid-step
-    # double buffering — size the batch block so 6 * T_pad * block * 4B stays
-    # within ~8 MB of the ~16 MB VMEM, shrinking block as T grows instead of
-    # overflowing on long fragments.
-    budget_elems = (8 * 1024 * 1024) // (6 * 4)
-    fit_b = max(_LANE, (budget_elems // T_pad) // _LANE * _LANE)
-    block = min(block_b, fit_b, _round_up(B, _LANE))
-    B_pad = _round_up(B, block)
-    a2 = jnp.pad(a2, ((0, T_pad - T), (0, B_pad - B)))
-    b2 = jnp.pad(b2, ((0, T_pad - T), (0, B_pad - B)))
-
-    # Under shard_map's vma tracking (jax>=0.8) the kernel output must
-    # declare which mesh axes it varies over — it varies exactly as its
-    # inputs do (the scan is pointwise in the batch/shard axes).
-    vma = getattr(jax.typeof(a2), "vma", frozenset()) | getattr(
-        jax.typeof(b2), "vma", frozenset()
-    )
     out = pl.pallas_call(
         _scan_kernel,
         grid=(B_pad // block,),
@@ -108,7 +149,76 @@ def reverse_linear_scan_pallas(
         out_specs=pl.BlockSpec(
             (T_pad, block), lambda i: (0, i), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((T_pad, B_pad), jnp.float32, vma=vma),
+        out_shape=_out_struct((T_pad, B_pad), a2, b2),
+        interpret=interpret,
+    )(a2, b2)
+
+    return out[:T, :B].reshape(orig_shape).astype(a.dtype)
+
+
+def _scan_kernel_dma(a_hbm, b_hbm, out_hbm, a_vmem, b_vmem, x_vmem, sems):
+    """One grid step of the explicit-DMA variant: pull this step's
+    [T, block] tiles HBM→VMEM with two parallel async copies, run the
+    same sequential reverse walk, push the result back VMEM→HBM. The
+    copies overlap each other (two DMA engines in flight before the
+    first wait); cross-grid-step overlap is the follow-up once the
+    ROADMAP-2 kernels land their double-buffer slots."""
+    j = pl.program_id(0)
+    block = a_vmem.shape[1]
+    cols = pl.ds(j * block, block)
+    copy_a = pltpu.make_async_copy(a_hbm.at[:, cols], a_vmem, sems.at[0])
+    copy_b = pltpu.make_async_copy(b_hbm.at[:, cols], b_vmem, sems.at[1])
+    copy_a.start()
+    copy_b.start()
+    copy_a.wait()
+    copy_b.wait()
+
+    T = a_vmem.shape[0]
+
+    def body(i, carry):
+        t = T - 1 - i
+        x = b_vmem[pl.ds(t, 1), :] + a_vmem[pl.ds(t, 1), :] * carry
+        x_vmem[pl.ds(t, 1), :] = x
+        return x
+
+    zero = a_vmem[pl.ds(0, 1), :] * 0.0
+    jax.lax.fori_loop(0, T, body, zero)
+
+    copy_out = pltpu.make_async_copy(x_vmem, out_hbm.at[:, cols], sems.at[2])
+    copy_out.start()
+    copy_out.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
+def reverse_linear_scan_pallas_dma(
+    a: jax.Array,
+    b: jax.Array,
+    block_b: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """The explicit-DMA twin of :func:`reverse_linear_scan_pallas`: same
+    recurrence, same padding and VMEM sizing, but the kernel owns its
+    HBM↔VMEM transfers (``pltpu.ANY`` inputs, per-tile
+    ``make_async_copy`` + DMA semaphores). Bit-comparable to the
+    automatic kernel on every geometry (tests/test_pallas_scan.py);
+    ``scripts/validate_pallas_tpu.py`` judges both on a live chip."""
+    a2, b2, T, B, T_pad, B_pad, block, orig_shape = _prep(a, b, block_b)
+
+    out = pl.pallas_call(
+        _scan_kernel_dma,
+        grid=(B_pad // block,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=_out_struct((T_pad, B_pad), a2, b2),
+        scratch_shapes=[
+            pltpu.VMEM((T_pad, block), jnp.float32),
+            pltpu.VMEM((T_pad, block), jnp.float32),
+            pltpu.VMEM((T_pad, block), jnp.float32),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
         interpret=interpret,
     )(a2, b2)
 

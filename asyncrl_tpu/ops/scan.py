@@ -42,8 +42,11 @@ def reverse_linear_scan(
       impl: "associative" (default — ``lax.associative_scan``, O(log T)
         depth, portable), "pallas" (TPU VMEM-resident single-pass kernel,
         ``ops/pallas_scan.py`` — minimal HBM traffic, TPU only),
-        "pallas_interpret" (same kernel in the Pallas interpreter, for CPU
-        CI), or "sequential" (O(T) ``lax.scan`` reference).
+        "pallas_dma" (its explicit-DMA twin: kernel-owned HBM↔VMEM async
+        copies, the ROADMAP item-2 beachhead), "pallas_interpret" /
+        "pallas_dma_interpret" (the same kernels in the Pallas
+        interpreter, for CPU CI), or "sequential" (O(T) ``lax.scan``
+        reference).
     Returns:
       x: [T, ...] solutions.
 
@@ -56,6 +59,14 @@ def reverse_linear_scan(
         return reverse_linear_scan_pallas(
             a, b, interpret=impl == "pallas_interpret"
         )
+    if impl == "pallas_dma" or impl == "pallas_dma_interpret":
+        from asyncrl_tpu.ops.pallas_scan import (
+            reverse_linear_scan_pallas_dma,
+        )
+
+        return reverse_linear_scan_pallas_dma(
+            a, b, interpret=impl == "pallas_dma_interpret"
+        )
     if impl == "sequential":
         return reverse_linear_scan_sequential(a, b)
     if impl == "auto":
@@ -66,7 +77,8 @@ def reverse_linear_scan(
     if impl != "associative":
         raise ValueError(
             f"unknown scan impl {impl!r}; expected "
-            "associative|pallas|pallas_interpret|sequential"
+            "associative|pallas|pallas_dma|pallas_interpret|"
+            "pallas_dma_interpret|sequential"
         )
     a_rev = jnp.flip(a, axis=0)
     b_rev = jnp.flip(b, axis=0)

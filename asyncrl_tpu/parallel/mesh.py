@@ -50,8 +50,20 @@ else:
             return jax.lax.pmean(x, axes)
         return jax.lax.pmax(x, axes)
 
-    def shard_map(f, *, mesh, in_specs, out_specs):
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
         import jax.tree_util as jtu
+
+        if check_vma is False:
+            # The caller explicitly opted out of replication/vma checking
+            # (the Pallas interpreter under shard_map cannot infer vma —
+            # tests/test_pallas_scan.py). Forward the same opt-out; the
+            # identity-collective wrapping below exists only to SATISFY
+            # the checker, so it is skipped along with it.
+            # lint: sharding-ok(explicit check_vma=False forward: caller opted out; wrapping exists only to satisfy the checker being disabled)
+            return _experimental_smap(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False,
+            )
 
         axis_names = tuple(mesh.axis_names)
 

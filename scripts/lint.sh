@@ -3,12 +3,17 @@
 # plus the framework-aware static passes (python -m asyncrl_tpu.analysis:
 # lock discipline, JAX purity, donation safety, thread ownership,
 # deadlock/lock-order, device contracts, config contracts, protocol
-# typestate, async-signal safety). The default package run covers EVERY
-# subpackage — asyncrl_tpu/obs/ (span rings, flight recorder) included,
-# so its guarded-by/thread-entry annotations gate like the rest of the
-# concurrency substrate — plus the scripts/*.py entry points under the
-# configflow pass (CFG003: smoke scripts can't invent unregistered
-# ASYNCRL_* env vars).
+# typestate, async-signal safety, SPMD sharding contracts, multi-host
+# collective congruence, Pallas DMA discipline). The default package run
+# covers EVERY subpackage — asyncrl_tpu/obs/ (span rings, flight
+# recorder) included, so its guarded-by/thread-entry annotations gate
+# like the rest of the concurrency substrate — plus ALL the repo entry
+# points (scripts/*.py, bench.py, __graft_entry__.py) under the
+# entry-point pass set: configflow (CFG003: smoke scripts can't invent
+# unregistered ASYNCRL_* env vars) and the three SPMD passes (a launch
+# script that builds its mesh before jax.distributed.initialize, or a
+# validation script with an unpaired DMA, gates here — HSY002/PAL001
+# and friends).
 #
 #   scripts/lint.sh            # lint the package + script entries (CI gate)
 #   scripts/lint.sh --fast     # warm-cache mode: a full analyzer cache hit
@@ -65,10 +70,15 @@ python -m asyncrl_tpu.analysis \
     --format json --stats \
     > lint_report.json || rc=1
 
+# Entry points: configflow + the SPMD contract passes. Own cache
+# manifest (manifests key on the (file set, pass tuple) pair, so sharing
+# the package dir would invalidate both manifests on every run — the
+# PR-11 scripts-manifest pattern, now covering bench.py and
+# __graft_entry__.py too).
 python -m asyncrl_tpu.analysis \
-    --pass configflow \
+    --pass configflow --pass sharding --pass hostsync --pass pallas \
     --cache-dir .analysis-cache-scripts \
-    scripts/*.py || rc=1
+    scripts/*.py bench.py __graft_entry__.py || rc=1
 
 if [ "$fast" -eq 1 ] && [ "$rc" -eq 0 ] && python - <<'EOF'
 import json

@@ -137,6 +137,17 @@ QUICK: dict[str, object] = {
     # are ~15s combined. Tier-1 by the ISSUE 8 acceptance contract
     # (detectors proven to flip /healthz on every PR). Whole file ~20s.
     "test_introspect.py": "all",
+    # SPMD contract passes (ISSUE 13): pure-AST; fixture corpus,
+    # live-tree deletion proofs (axis rename / check_rep flip /
+    # host-guarded all_gather / deleted DMA wait), cache soundness for
+    # the SHD/HSY/PAL families, version-bump invalidation, JSON round
+    # trip. ~10s, two CLI subprocess runs included. Tier-1 by the
+    # ISSUE 13 acceptance contract (deletion proofs pass on every PR).
+    "test_spmd_analysis.py": "all",  # 10s
+    # The explicit-DMA scan kernel must stay bit-identical to the
+    # automatic kernel (the PAL pass guards its start/wait discipline
+    # statically; this guards its numerics). ~8s in the interpreter.
+    "test_pallas_scan.py": {"test_dma_kernel_matches_automatic"},
     # Protocol typestate + signal-safety passes (ISSUE 11): pure-AST;
     # fixture corpus, live-tree deletion proofs (release/void/latch),
     # grammar hardness, warm-cache soundness, stats zeros. ~10s, two CLI

@@ -1,0 +1,62 @@
+"""Known-bad fixture: multi-host collective-congruence violations
+(HSY001-003).
+
+Every shape here hangs a real pod without raising anything: a collective
+only some hosts issue, a mesh built before the distributed runtime
+exists, a checkpoint barrier behind a lead-host guard.
+"""
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def all_reduce(x):
+    # The collective itself is fine — the closure makes callers under a
+    # host-conditional branch HSY001 sites.
+    return jax.lax.psum(x, "dp")
+
+
+def divergent_direct(x):
+    if jax.process_index() == 0:
+        # HSY001: hosts 1..N-1 never issue this pmean; host 0 hangs in it.
+        return jax.lax.pmean(x, "dp")
+    return x
+
+
+def divergent_transitive(x):
+    lead = jax.process_index() == 0
+    if lead:
+        x = all_reduce(x)  # HSY001: reaches psum through the call graph
+    return x
+
+
+def divergent_tail(x):
+    if jax.process_index() != 0:
+        return x
+    # HSY001: everything after the host-dependent early return runs on
+    # host 0 only.
+    return jax.lax.psum(x, "dp")
+
+
+def divergent_loop(xs):
+    for _ in range(jax.process_index()):
+        # HSY001: host k issues k all_gathers — programs disagree.
+        xs = jax.lax.all_gather(xs, "dp")
+    return xs
+
+
+def barrier_behind_guard(step):
+    if jax.process_index() == 0:
+        save(step)
+        # HSY003: a barrier only the lead host reaches IS the deadlock.
+        multihost_utils.sync_global_devices("ckpt")
+
+
+def save(step):
+    del step
+
+
+def launch():
+    devices = jax.devices()  # HSY002: queried before initialize
+    jax.distributed.initialize()
+    return devices

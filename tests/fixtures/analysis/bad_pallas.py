@@ -1,0 +1,64 @@
+"""Known-bad fixture: Pallas kernel-discipline violations (PAL001-004).
+
+Mirrors the ops/pallas_scan.py shapes — explicit ``make_async_copy``
+DMAs against semaphore scratch, ``pallas_call`` grid/BlockSpec plumbing
+— so every PAL code is proven against the idioms the kernel tree uses.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def unpaired_kernel(x_hbm, o_hbm, scratch, sems):
+    copy_in = pltpu.make_async_copy(x_hbm, scratch, sems.at[0])
+    # PAL001: started, but no wait on any path — the semaphore never
+    # drains and the next touch of `scratch` reads torn data.
+    copy_in.start()
+    o_hbm[...] = scratch[...]
+
+
+def branch_leak_kernel(x_hbm, o_hbm, scratch, sems, flag):
+    copy_in = pltpu.make_async_copy(x_hbm, scratch, sems.at[0])
+    copy_in.start()
+    if flag:
+        copy_in.wait()
+    # PAL001: the else path reaches kernel exit with the DMA in flight.
+    o_hbm[...] = scratch[...]
+
+
+def double_wait_kernel(x_hbm, o_hbm, scratch, sems):
+    copy_in = pltpu.make_async_copy(x_hbm, scratch, sems.at[0])
+    copy_in.start()
+    copy_in.wait()
+    copy_in.wait()  # PAL002: drains a count some other DMA owns
+    o_hbm[...] = scratch[...]
+
+
+def signal_only_kernel(o_hbm, sems):
+    # PAL001: signaled but never waited anywhere in the module — the
+    # count leaks into the next grid step.
+    pl.semaphore_signal(sems.at[1])
+    o_hbm[...] = o_hbm[...]
+
+
+def inplace_kernel(x_ref, o_ref):
+    # PAL004: stores into an INPUT ref with no input_output_aliases
+    # declared on the pallas_call below.
+    x_ref[0, 0] = 1.0
+    o_ref[...] = x_ref[...]
+
+
+inplace = pl.pallas_call(
+    inplace_kernel,
+    out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+)
+
+ragged = pl.pallas_call(
+    inplace_kernel,
+    grid=(2,),
+    # PAL003: block 100 does not divide the 256-wide output.
+    out_specs=pl.BlockSpec((8, 100), lambda i: (0, i)),
+    out_shape=jax.ShapeDtypeStruct((8, 256), jnp.float32),
+)

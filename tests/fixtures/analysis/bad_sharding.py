@@ -1,0 +1,56 @@
+"""Known-bad fixture: SPMD sharding-contract violations (SHD001-004).
+
+Mirrors the parallel/mesh.py shapes — ``*_AXIS`` constants, ``make_mesh``
+construction, ``shard_map`` spec plumbing — so every SHD code is proven
+against the idioms the live tree actually uses.
+"""
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from asyncrl_tpu.parallel.mesh import make_mesh, shard_map
+
+DP_AXIS = "dp"
+# SHD002: a second axis constant aliasing "dp" — by-name axis selection
+# (dp_axes-style exclusion lists) now silently collapses two axes.
+MODEL_AXIS = "dp"
+
+# SHD003 (twice): three shape dims vs one axis name, and two inferred
+# (-1) dims.
+mesh = make_mesh((2, -1, -1), (DP_AXIS,))
+
+# SHD003: a fully-literal shape whose product mismatches the literal
+# device list.
+tiny = make_mesh((4,), ("dp",), devices=[0, 1])
+
+
+def body(x, y):
+    return x, y
+
+
+# SHD001: in_specs is a 3-tuple for a 2-argument body; SHD002: axis
+# "model" has no real binding site (the MODEL_AXIS constant alone does
+# not give it a mesh dimension).
+step = shard_map(
+    body,
+    mesh=mesh,
+    in_specs=(P(DP_AXIS), P("model"), P()),
+    out_specs=(P(), P()),
+)
+
+# SHD001: out_specs is a 3-tuple but body returns a 2-tuple.
+wide = shard_map(
+    body,
+    mesh=mesh,
+    in_specs=(P(), P()),
+    out_specs=(P(), P(), P()),
+)
+
+# SHD004: check_rep=False with no reason-carrying sharding-ok waiver.
+unchecked = shard_map(
+    body,
+    mesh=mesh,
+    in_specs=(P(), P()),
+    out_specs=(P(), P()),
+    check_rep=False,
+)

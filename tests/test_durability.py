@@ -126,19 +126,30 @@ class _ExitRecorder:
 
 
 def test_drain_deadline_watchdog_hard_kills():
+    # Deflaked (PR 11 observed a flake under a loaded runner): join the
+    # watchdog thread instead of sleeping a wall-clock guess — the
+    # thread exits exactly once it has decided (fired or disarmed), so
+    # scheduler stalls stretch the join, never the verdict.
     rec = _ExitRecorder()
     c = DrainCoordinator(grace_s=0.15, exit_fn=rec)
     c.request(reason="test")
-    time.sleep(0.5)
+    c._watchdog.join(timeout=30.0)
+    assert not c._watchdog.is_alive(), "watchdog did not decide in 30s"
     assert rec.codes == [EXIT_DEADLINE]
 
 
 def test_drain_finish_disarms_the_watchdog():
+    # Deflaked: with a short grace a loaded runner could stall the main
+    # thread past the deadline BETWEEN request() and finish(), firing a
+    # spurious kill. A generous grace removes that race; finish() then
+    # wakes the watchdog immediately and the join observes the disarm
+    # deterministically instead of sleeping out the old 0.4s guess.
     rec = _ExitRecorder()
-    c = DrainCoordinator(grace_s=0.15, exit_fn=rec)
+    c = DrainCoordinator(grace_s=30.0, exit_fn=rec)
     c.request(reason="test")
     c.finish()
-    time.sleep(0.4)
+    c._watchdog.join(timeout=30.0)
+    assert not c._watchdog.is_alive(), "watchdog did not disarm"
     assert rec.codes == []
 
 
