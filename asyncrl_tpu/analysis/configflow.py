@@ -52,6 +52,7 @@ KNOWN_ENV_VARS = {
     "ASYNCRL_TRACE_RING",     # obs/trace.py — per-thread ring capacity
     "ASYNCRL_RUN_DIR",        # obs/__init__.py — observability output dir
     "ASYNCRL_TRACE_TOLERANCE",  # scripts/trace_smoke.sh overhead threshold
+    "ASYNCRL_REPLAY",         # api/sebulba_trainer.py — replay-ring depth
     "ASYNCRL_SERVE",          # api/sebulba_trainer.py — serve-core toggle
     "ASYNCRL_SERVE_TOLERANCE",  # scripts/serve_smoke.sh throughput budget
     "ASYNCRL_SERVE_P95_MS",   # scripts/serve_smoke.sh p95 latency gate

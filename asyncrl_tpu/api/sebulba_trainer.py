@@ -26,6 +26,7 @@ import queue
 import sys
 import threading
 import time
+from collections import deque
 from typing import Any, Callable
 
 import jax
@@ -40,7 +41,12 @@ from asyncrl_tpu.learn.learner import (
     validate_ppo_geometry,
     validate_train_target,
 )
-from asyncrl_tpu.learn.rollout_learner import LearnerState, RolloutLearner
+from asyncrl_tpu.learn import replay as replay_lib
+from asyncrl_tpu.learn.rollout_learner import (
+    LearnerState,
+    RolloutLearner,
+    rollout_sharding,
+)
 from asyncrl_tpu.models.networks import build_model, is_recurrent, reset_core
 from asyncrl_tpu.ops import distributions
 from asyncrl_tpu.ops.normalize import normalizing_apply
@@ -103,6 +109,15 @@ class SebulbaTrainer:
         # flag instead of re-consulting the environment.
         if introspect.enabled(config) != config.introspect:
             config = config.replace(introspect=introspect.enabled(config))
+            self.config = config
+        # Device replay ring (learn/replay.py): ASYNCRL_REPLAY wins over
+        # config.replay_slabs when set — resolved ONCE here, like
+        # ASYNCRL_INTROSPECT, so the jitted IMPACT update and the ring
+        # construction below read the same resolved depth and never
+        # re-consult the environment.
+        env_replay = os.environ.get("ASYNCRL_REPLAY", "")
+        if env_replay and int(env_replay) != config.replay_slabs:
+            config = config.replace(replay_slabs=int(env_replay))
             self.config = config
         if config.num_envs % config.actor_threads:
             raise ValueError(
@@ -259,6 +274,44 @@ class SebulbaTrainer:
             self._staging = (
                 staging.RingSwapHolder(ring) if self._elastic_on else ring
             )
+        # IMPACT-style device replay (learn/replay.py; ROADMAP item 3):
+        # the last replay_slabs consumed fragments stay resident in
+        # device memory, re-fed to the learner between fresh slabs so
+        # the duty cycle stops tracking actor throughput. replay off
+        # constructs NOTHING (the elastic/introspect off-is-bit-identical
+        # discipline). Fragment geometry is invariant under elastic
+        # scaling (fleet size changes, per-actor env count does not), so
+        # the ring composes with the elastic runtime as-is.
+        self._replay = None
+        self._reuse_window = None
+        self._replay_rng = None
+        self._stall_history = None
+        if config.replay_slabs > 0:
+            from asyncrl_tpu.rollout import staging
+
+            # ONE source of slab geometry: reuse the staging ring's
+            # template when the overlap path already derived it.
+            replay_template = (
+                self._staging_template
+                if self._staging_template is not None
+                else staging.fragment_template(
+                    config, self.spec, self.model, self._envs_per_actor
+                )
+            )
+            self._replay = replay_lib.DeviceReplayRing(
+                replay_template,
+                rollout_sharding(self.mesh, replay_template, stacked=True),
+                rows=config.replay_slabs,
+            )
+            self._reuse_window = replay_lib.ReuseWindow()
+            # Replay-row selection is seed-deterministic (ties among
+            # equally-reused rows break by this stream), decorrelated
+            # from the actor seed ladder.
+            self._replay_rng = np.random.default_rng(config.seed * 9973 + 13)
+            # Trailing stall fractions for the learner_stall_trend key
+            # (this window minus the trailing mean: the operator-facing
+            # "is replay actually closing the duty-cycle gap" signal).
+            self._stall_history = deque(maxlen=8)
         # Observability (asyncrl_tpu/obs/): arms span tracing + the
         # flight recorder per config.trace (ASYNCRL_TRACE wins), resets
         # the counters/histograms registry, and mounts the run-health
@@ -296,6 +349,16 @@ class SebulbaTrainer:
                 up_stall_frac=config.elastic_up_stall_frac,
                 down_backpressure=config.elastic_down_backpressure,
                 down_admission=config.elastic_down_admission,
+                # The replay inversion: high ring fill + low stall means
+                # sample reuse is covering the learner's duty cycle, so
+                # the fleet is oversized — armed only when the ring
+                # exists (0 keeps the signal out of every replay-off
+                # identity A/B, the elastic_smoke discipline).
+                down_replay_fill=(
+                    elastic_mod.DOWN_REPLAY_FILL
+                    if config.replay_slabs > 0
+                    else 0.0
+                ),
                 blame_fn=blame_fn,
             )
             self._elastic_barrier = elastic_mod.ReconfigureBarrier(self._ckpt)
@@ -991,6 +1054,67 @@ class SebulbaTrainer:
                 decision.event(before, len(self._actors))
             )
 
+    def _advance_updates(self, n: int) -> None:
+        """Advance the learner-update counter by ``n`` and publish at
+        every crossed actor_staleness boundary — ONE home for the
+        publish cadence, so the fresh drain and the replay passes can
+        never drift on when actors see new weights. (With n >= the
+        staleness period, every call publishes — the fused-dispatch
+        coarsening trade, unchanged.)"""
+        before = self._updates
+        self._updates += n
+        staleness = max(self.config.actor_staleness, 1)
+        if before // staleness != self._updates // staleness:
+            version = self._store.publish(
+                self._published(self.state), self.env_steps
+            )
+            self._published_updates[version] = self._updates
+            # Bound the map: anything older than the deepest possible
+            # in-flight fragment is unreachable.
+            for old in [
+                v for v in self._published_updates
+                if v < version - 4 * (self._queue.maxsize + 2)
+            ]:
+                del self._published_updates[old]
+
+    def _replay_passes(self, pending: list) -> None:
+        """The IMPACT reuse phase, run after each fresh update: lease up
+        to ``replay_passes - 1`` least-reused ring rows and feed each to
+        the learner as one more SGD pass. Replayed consumptions feed the
+        PR-8 staleness ledger (lag measured against the slab's ORIGINAL
+        behaviour publish — off-policy-ness stays observed, not guessed)
+        and the reuse/target-lag window; env_steps does NOT advance (no
+        new environment data was consumed)."""
+        cfg = self.config
+        # target_lag is phased on the HOST update cursor. Approximation,
+        # documented: under the NaN-guard (a skipped update holds the
+        # device-side update_step while this cursor advances) or after a
+        # rollback restore (device step rewinds, this cursor does not —
+        # the PR-10 rule that only resume rewrites it), the reported
+        # phase can drift from the device refresh schedule. Diagnostic-
+        # grade by design; deriving it from the device step would cost a
+        # host sync per consumed sample.
+        period = max(cfg.target_update_period, 1)
+        for _ in range(cfg.replay_passes - 1):
+            rlease = self._replay.lease_sample(self._replay_rng)
+            if rlease is None:
+                break
+            try:
+                replayed, reuse, behaviour = rlease.consume()
+            except replay_lib.ReplayStaleError:
+                continue
+            self.state, metrics = self.learner.update(self.state, replayed)
+            pending.append(metrics)
+            # Observed BEFORE the counter advances, matching the fresh
+            # path's convention (lag = consuming update's pre-advance
+            # index minus the behaviour publish): the replay pass that
+            # immediately follows a fresh consumption at lag L reports
+            # L+1, not L+2.
+            if self._staleness is not None:
+                self._staleness.observe(self._updates - behaviour)
+            self._reuse_window.observe(reuse, self._updates % period)
+            self._advance_updates(1)
+
     def _infer_coalesce_window(self) -> dict[str, float]:
         """Mean coalesced inference-batch rows per served round since the
         last window close ({} without a shared server). Snapshots per
@@ -1066,6 +1190,15 @@ class SebulbaTrainer:
             # a clean ring, and a zombie's late commit raises instead of
             # landing in a recycled row.
             self._staging.reset()
+        if self._replay is not None:
+            # Same hygiene at the device tier: a new cohort starts on an
+            # empty replay ring — cross-cohort replay would resurrect a
+            # stopped run's off-policy tail — and on fresh telemetry
+            # (the trend baseline and any undrained reuse observations
+            # belong to the stopped cohort's windows).
+            self._replay.quarantine()
+            self._reuse_window.drain()
+            self._stall_history.clear()
 
     # ----------------------------------------------------- durable runs
 
@@ -1166,6 +1299,20 @@ class SebulbaTrainer:
         slab_groups.clear()
         count += len(fragments)
         fragments.clear()
+        if self._replay is not None:
+            # The PR-10 path extended to the replay tier: every
+            # outstanding replay lease voids (a zombie consume raises)
+            # and the ring empties — slabs produced under, or reused
+            # across, the diverging stretch must never feed another
+            # update. The telemetry purges with the data (the stop()
+            # hygiene): the poisoned stretch's reuse/target-lag
+            # observations and its stall baseline must not contaminate
+            # the first post-rollback window's keys.
+            dropped = self._replay.quarantine()
+            self._reuse_window.drain()
+            self._stall_history.clear()
+            if dropped:
+                obs_registry.counter("replay_quarantined").inc(dropped)
         if count:
             obs_registry.counter("rollback_quarantined").inc(count)
         return count
@@ -1322,6 +1469,10 @@ class SebulbaTrainer:
             raise
         pending: list[dict[str, jax.Array]] = []
         ret_sum = len_sum = count = lag_sum = 0.0
+        # Fresh fragments consumed this window: the param_lag mean's
+        # denominator (``pending`` also carries replay-pass metrics when
+        # the ring is armed, so len(drained) would over-count).
+        frag_count = 0
         window_start = time.perf_counter()
         window_steps = 0
         # Pipeline instrumentation (utils/metrics.py window keys):
@@ -1443,6 +1594,23 @@ class SebulbaTrainer:
                         sum(leaf.nbytes for leaf in jax.tree.leaves(rollout))
                     )
                 )
+                if self._replay is not None:
+                    # The fresh slab enters the device ring BEFORE the
+                    # update can donate it (publish is a device-to-device
+                    # install into the leased row, oldest-generation
+                    # eviction); the fresh pass itself counts as the
+                    # row's first consumption.
+                    self._replay.publish(
+                        rollout_d,
+                        behaviour_update=self._published_updates.get(
+                            batch[0].version, self._updates
+                        ),
+                    )
+                    self._reuse_window.observe(
+                        1,
+                        self._updates
+                        % max(cfg.target_update_period, 1),
+                    )
                 self.state, metrics = self.learner.update(
                     self.state, rollout_d
                 )
@@ -1473,28 +1641,17 @@ class SebulbaTrainer:
                         f.version, self._updates
                     )
                     lag_sum += lag
+                    frag_count += 1
                     if self._staleness is not None:
                         self._staleness.observe(lag)
 
-                before = self._updates
-                self._updates += K
-                staleness = max(cfg.actor_staleness, 1)
-                if before // staleness != self._updates // staleness:
-                    # A publish boundary was crossed inside this call (with
-                    # K >= staleness, every call). Publish cadence coarsens
-                    # to one per call — the price of fused dispatch, same
-                    # trade the Anakin backend makes.
-                    version = self._store.publish(
-                        self._published(self.state), self.env_steps
-                    )
-                    self._published_updates[version] = self._updates
-                    # Bound the map: anything older than the deepest
-                    # possible in-flight fragment is unreachable.
-                    for old in [
-                        v for v in self._published_updates
-                        if v < version - 4 * (self._queue.maxsize + 2)
-                    ]:
-                        del self._published_updates[old]
+                self._advance_updates(K)
+                if self._replay is not None:
+                    # IMPACT reuse phase: replay_passes - 1 more SGD
+                    # passes from the device ring, between fresh
+                    # fragments — the learner trains while the actors
+                    # are still producing the next slab.
+                    self._replay_passes(pending)
                 self._ckpt.after_update(self.state, self.env_steps)
 
                 if len(pending) >= cfg.log_every or self.env_steps >= target:
@@ -1512,7 +1669,7 @@ class SebulbaTrainer:
                     agg["episode_count"] = count
                     agg["episode_return"] = ret_sum / max(count, 1.0)
                     agg["episode_length"] = len_sum / max(count, 1.0)
-                    agg["param_lag"] = lag_sum / (len(drained) * K)
+                    agg["param_lag"] = lag_sum / max(frag_count, 1)
                     agg["env_steps"] = self.env_steps
                     agg["fps"] = window_steps / max(elapsed, 1e-9)
                     # Recovery/robustness counters (cumulative), so the
@@ -1557,9 +1714,28 @@ class SebulbaTrainer:
                         )
                         del agg["nonfinite_skip"]
                         agg["nonfinite_skips"] = self._nonfinite_skips
+                    if self._replay is not None:
+                        # Replay telemetry (the ISSUE-14 aux): ring fill,
+                        # per-sample reuse percentiles + target lag, and
+                        # the stall-fraction trend vs the trailing mean
+                        # (negative = replay is closing the duty-cycle
+                        # gap). target_kl rides the learner metrics into
+                        # this same dict. Replay off leaks NONE of these
+                        # keys (the introspect=False discipline).
+                        agg["replay_fill_frac"] = self._replay.fill_frac()
+                        agg.update(self._reuse_window.drain())
+                        hist = self._stall_history
+                        agg["learner_stall_trend"] = (
+                            agg["learner_stall_frac"]
+                            - sum(hist) / len(hist)
+                            if hist
+                            else 0.0
+                        )
+                        hist.append(agg["learner_stall_frac"])
                     agg.update(self._infer_coalesce_window())
                     agg.update(faults.counters())
                     ret_sum = len_sum = count = lag_sum = 0.0
+                    frag_count = 0
                     window_steps = 0
                     stall_s = h2d_wait_s = 0.0
                     h2d_bytes = 0

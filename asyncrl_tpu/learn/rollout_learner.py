@@ -14,6 +14,7 @@ queue-batched fragments (BASELINE.json:5; SURVEY.md §3.2).
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import jax
@@ -35,6 +36,7 @@ from asyncrl_tpu.learn.learner import (
     validate_qlearn_config,
     validate_recurrent_config,
 )
+from asyncrl_tpu.learn.replay import validate_replay_config
 from asyncrl_tpu.models.networks import is_recurrent
 from asyncrl_tpu.obs import introspect
 from asyncrl_tpu.obs import spans as span_names
@@ -255,6 +257,13 @@ class RolloutLearner:
     def __init__(self, config: Config, spec: EnvSpec, model, mesh: Mesh):
         validate_recurrent_config(config, model)
         validate_qlearn_config(config)
+        validate_replay_config(config)
+        # IMPACT mode (learn/replay.py; arXiv:1912.00167): with the
+        # device replay ring armed, every update — fresh or replayed —
+        # runs under the clipped-target-network importance anchor, and
+        # the target net refreshes every target_update_period updates.
+        # Off (the default) traces NONE of it: bit-identical program.
+        replay_mode = config.replay_slabs > 0
         # Host fragments arrive with the FULL env batch on the sharded-in
         # time/batch layout; the per-shard env count the chunker sees is
         # num_envs / (product of dp axes).
@@ -337,6 +346,35 @@ class RolloutLearner:
                     rewards=rollout.rewards
                     * jax.lax.rsqrt(jnp.maximum(ret_var, 1e-8))
                 )
+            target_kl = None
+            if replay_mode:
+                # IMPACT-style ratio anchoring: the slowly-updated
+                # target network's log-probs FLOOR the behaviour
+                # log-prob, so the V-trace importance ratio rho = pi/mu
+                # never exceeds replay_rho_clip * pi/pi_target — a slab
+                # reused across many updates (its mu frozen ever further
+                # in the past) keeps a bounded correction anchored to a
+                # policy at most target_update_period updates old,
+                # instead of an unbounded one anchored to a dead mu.
+                # Constant w.r.t. the differentiated params (target
+                # forward under stop_gradient, applied before the loss).
+                t_logits, _ = napply(state.target_params, rollout.obs)
+                target_logp = jax.lax.stop_gradient(
+                    dist.logp(t_logits, rollout.actions)
+                )
+                # Behaviour-vs-target divergence proxy E_mu[log mu -
+                # log pi_target] (the existing ``kl`` aux's recipe, with
+                # the target net in the learner's seat): it bounds how
+                # much anchoring the clip below is actually doing.
+                target_kl = jnp.mean(
+                    rollout.behaviour_logp - target_logp
+                )
+                rollout = rollout.replace(
+                    behaviour_logp=jnp.maximum(
+                        rollout.behaviour_logp,
+                        target_logp - math.log(config.replay_rho_clip),
+                    )
+                )
             if ppo_multipass:
                 # ``axes=reduce_axes``: on an sp mesh the shuffle keys,
                 # loss scaling, and advantage moments must span the time
@@ -393,11 +431,24 @@ class RolloutLearner:
             metrics = dict(jax.lax.pmean(metrics, reduce_axes))
             metrics["loss"] = jax.lax.pmean(loss, reduce_axes)
             metrics["grad_norm"] = grad_norm
+            if target_kl is not None:
+                metrics["target_kl"] = jax.lax.pmean(
+                    target_kl, reduce_axes
+                )
             step = state.update_step + 1
             if config.algo == "qlearn":
                 # Target-network refresh every actor_staleness updates
                 # (same recipe as the Anakin learner's actor_params).
                 refresh = (step % config.actor_staleness) == 0
+                target_params = jax.tree.map(
+                    lambda new, old: jnp.where(refresh, new, old),
+                    params, state.target_params,
+                )
+            elif replay_mode:
+                # The IMPACT anchor refreshes on its own period — the
+                # qlearn recipe with the replay knob, so the anchor is
+                # never more than target_update_period updates stale.
+                refresh = (step % config.target_update_period) == 0
                 target_params = jax.tree.map(
                     lambda new, old: jnp.where(refresh, new, old),
                     params, state.target_params,
@@ -518,9 +569,15 @@ class RolloutLearner:
             params=params,
             opt_state=jax.device_put(opt_state, rep),
             update_step=jax.device_put(jnp.zeros((), jnp.int32), rep),
-            # qlearn: target net starts equal to the online net (device
-            # arrays are immutable, so sharing the reference is safe).
-            target_params=params if self.config.algo == "qlearn" else None,
+            # qlearn — and the IMPACT replay anchor — start the target
+            # net equal to the online net (device arrays are immutable,
+            # so sharing the reference is safe).
+            target_params=(
+                params
+                if self.config.algo == "qlearn"
+                or self.config.replay_slabs > 0
+                else None
+            ),
             obs_stats=(
                 jax.device_put(init_stats(self.spec.obs_shape), rep)
                 if self.config.normalize_obs

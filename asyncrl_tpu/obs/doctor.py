@@ -147,9 +147,11 @@ def _latest_trace_doc(run_dir: str) -> tuple[dict[str, Any] | None, str | None]:
 # Learning-health keys the learning-timeline section summarizes, in
 # display order (only keys the run actually recorded are shown).
 LEARNING_KEYS = (
-    "loss", "entropy", "kl", "rho_clip_frac", "c_clip_frac",
+    "loss", "entropy", "kl", "target_kl", "rho_clip_frac", "c_clip_frac",
     "explained_variance", "staleness_p50", "staleness_p95",
-    "staleness_max", "compiles", "infer_recompile", "learner_recompile",
+    "staleness_max", "reuse_p50", "reuse_p95", "replay_fill_frac",
+    "learner_stall_frac", "compiles", "infer_recompile",
+    "learner_recompile",
     "mem_device_bytes_in_use", "mem_device_peak_bytes",
     "mem_host_rss_bytes", "mem_host_rss_peak_bytes",
 )

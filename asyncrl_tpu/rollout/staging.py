@@ -37,6 +37,12 @@ owns (modulo the single-store race inherent to abandoning a live thread,
 which the watchdog design already accepts; the guard shrinks the window
 from a whole fragment to one array store).
 
+The same generation/lease discipline is applied at the DEVICE tier by
+the IMPACT replay ring (learn/replay.py): generation-stamped rows,
+oldest-generation eviction, and zombie reads fenced to
+:class:`StaleLeaseError` (its ``ReplayStaleError`` subclass) — one
+error family for "your row was re-leased under you", host or device.
+
 Ring resize (elastic runtime)
 -----------------------------
 :class:`RingSwapHolder` makes the ring itself replaceable at runtime: a

@@ -77,6 +77,17 @@ DOWN_BACKPRESSURE = 1.0
 # disable knob so identity A/B runs can pin the controller armed-but-
 # quiet).
 DOWN_ADMISSION = 1.0
+# scale DOWN actors when the replay ring (learn/replay.py) is at least
+# this full AND the learner is far from starved: sample reuse is
+# covering the duty cycle, so the fleet is oversized for the moment's
+# learner appetite — the INVERSE of the starvation-only up signal. 0
+# disables; the trainer arms it only when the ring exists, so every
+# replay-off identity A/B stays pinned quiet.
+DOWN_REPLAY_FILL = 0.9
+# The "low stall" bar the replay-fill down signal additionally requires:
+# a full ring WITH a starved learner is a throughput problem, not an
+# oversupply — only full-and-fed reads as "fewer actors would do".
+REPLAY_LOW_STALL = 0.1
 # Cap on queued scripted requests the controller carries across windows
 # (one applies per window; a degenerate no-max script must not grow the
 # queue without bound — extras drop, FIFO prefix preserved).
@@ -98,7 +109,7 @@ class ScaleDecision:
     #                 one slot per window, re-queueing the remainder — a
     #                 single mutate-last slot op is what the reconfigure
     #                 barrier's restore contract covers exactly)
-    reason: str     # "stall" | "backpressure" | "admission" | "staleness" | "scripted"
+    reason: str     # "stall" | "backpressure" | "admission" | "staleness" | "replay_fill" | "scripted"
     detail: str
     scripted: bool = False
     signals: dict[str, float] = dataclasses.field(default_factory=dict)
@@ -141,6 +152,7 @@ class ElasticController:
         down_backpressure: float = DOWN_BACKPRESSURE,
         down_admission: float = DOWN_ADMISSION,
         down_staleness_p95: float = 0.0,
+        down_replay_fill: float = 0.0,
         blame_fn: Callable[[], str | None] | None = None,
     ):
         if min_actors < 1:
@@ -162,6 +174,7 @@ class ElasticController:
         self.down_backpressure = down_backpressure
         self.down_admission = down_admission
         self.down_staleness_p95 = down_staleness_p95
+        self.down_replay_fill = down_replay_fill
         self.blame_fn = blame_fn
         self._prev: dict[str, float] = {}
         self._up_run = 0
@@ -263,15 +276,28 @@ class ElasticController:
         staleness = (
             float(staleness) if isinstance(staleness, (int, float)) else 0.0
         )
+        fill = window.get("replay_fill_frac")
+        fill = float(fill) if isinstance(fill, (int, float)) else 0.0
         bp_hit = (
             self.down_backpressure > 0 and bp_delta >= self.down_backpressure
         )
         admit_hit = (
             self.down_admission > 0 and admit_delta >= self.down_admission
         )
+        # The replay inversion (ISSUE 14): a (nearly) full replay ring
+        # with a well-fed learner means sample reuse covers the duty
+        # cycle — fewer actors would do. A full ring with a STARVED
+        # learner stays an up case (replay is masking a real shortfall),
+        # hence the low-stall requirement.
+        replay_hit = (
+            self.down_replay_fill > 0
+            and fill >= self.down_replay_fill
+            and stall <= REPLAY_LOW_STALL
+        )
         down_signal = (
             bp_hit
             or admit_hit
+            or replay_hit
             or (
                 self.down_staleness_p95 > 0
                 and staleness > self.down_staleness_p95
@@ -310,11 +336,14 @@ class ElasticController:
             self._cooldown = self.cooldown_windows
             # Blame only a signal that actually fired THIS window (a
             # disabled signal's threshold must never be "met" at 0 >= 0).
-            reason = (
-                "backpressure"
-                if bp_hit
-                else ("admission" if admit_hit else "staleness")
-            )
+            if bp_hit:
+                reason = "backpressure"
+            elif admit_hit:
+                reason = "admission"
+            elif replay_hit:
+                reason = "replay_fill"
+            else:
+                reason = "staleness"
             return ScaleDecision(
                 direction="down",
                 delta=delta,
@@ -323,12 +352,15 @@ class ElasticController:
                     f"actors out-ran the pipeline for {self.hysteresis} "
                     f"consecutive windows (queue_backpressure {bp_delta:+.0f}"
                     f"/window, admission pressure {admit_delta:+.0f}, "
-                    f"staleness_p95 {staleness:.0f})"
+                    f"staleness_p95 {staleness:.0f}, replay_fill_frac "
+                    f"{fill:.2f} at stall {100.0 * stall:.0f}%)"
                 ),
                 signals={
                     "queue_backpressure_delta": bp_delta,
                     "admission_delta": admit_delta,
                     "staleness_p95": staleness,
+                    "replay_fill_frac": fill,
+                    "learner_stall_frac": stall,
                 },
             )
         return None

@@ -225,6 +225,38 @@ class Config:
     # slab_reuse_waits metric either way).
     staging_slabs: int = 0
 
+    # --- device-resident replay (learn/replay.py; host backends) ---
+    # IMPACT-style sample reuse (arXiv:1912.00167): a circular ring of
+    # the last N consumed slabs kept in DEVICE memory, re-fed to the
+    # learner between fresh fragments so learner FLOPs stop being
+    # rate-limited by actor throughput (learner_stall_frac -> ~0). The
+    # ring reuses the staging-ring generation/lease discipline: rows are
+    # generation-stamped, eviction is oldest-generation, and a zombie
+    # read after eviction/quarantine raises instead of returning a newer
+    # slab's rows. 0 = off — bit-identical to the pre-replay program
+    # (the introspect=False discipline; pinned by tests/test_replay.py
+    # and scripts/replay_smoke.sh). Requires algo="impala" (the
+    # importance-ratio anchoring below is V-trace-specific),
+    # updates_per_call=1, core="ff", and normalize_obs/normalize_returns
+    # off (the jitted step folds every consumed fragment into the
+    # running stats and cannot tell fresh from replayed — reuse would
+    # bias them). ASYNCRL_REPLAY (when set) wins, like ASYNCRL_SERVE.
+    replay_slabs: int = 0
+    # Total SGD passes per drained fragment when replay is on: 1 fresh
+    # pass + (replay_passes - 1) replayed slabs sampled least-reused-
+    # first from the ring. 2x-3x is the IMPACT-recommended regime.
+    replay_passes: int = 2
+    # Learner updates between clipped-target-network refreshes: the
+    # target's log-probs anchor the importance ratio on every replay-
+    # mode update, so a slab reused across many updates keeps a bounded
+    # correction even as its behaviour policy goes stale.
+    target_update_period: int = 100
+    # Cap on the target-anchored importance ratio: the effective
+    # behaviour log-prob is floored at log pi_target - log(clip), so
+    # rho = pi/mu never exceeds clip * pi/pi_target. Must be >= 1
+    # (a cap below 1 would down-weight perfectly on-policy data).
+    replay_rho_clip: float = 2.0
+
     # --- elastic runtime (asyncrl_tpu/runtime/elastic.py; host backends) ---
     # Signal-driven fleet scaling: an ElasticController evaluated at each
     # window close grows/shrinks the actor fleet (and resizes the staging

@@ -131,6 +131,13 @@ QUICK: dict[str, object] = {
     # fault-injected flight-recorder acceptance run and the disabled-mode
     # window check) are ~10s combined. Whole file ~15s.
     "test_obs.py": "all",
+    # Device replay ring + IMPACT learner (learn/replay.py, ISSUE 14):
+    # the lease-protocol units (fencing/sampling/ledger/quarantine) are
+    # ~1s each against a tiny ring; the trainer e2e pair (off-identity,
+    # on-telemetry) and the learner target/anchor probes are ~15s
+    # combined. Tier-1 by the ISSUE 14 acceptance contract (replay off
+    # pinned to the pre-PR program on every PR). Whole file ~17s.
+    "test_replay.py": "all",
     # Training introspection (obs/introspect.py, ISSUE 8): staleness/
     # compile/memory units are sub-second; the live acceptance run
     # (metrics + /healthz flip + forensics) and the introspect-off A/B
@@ -197,6 +204,7 @@ QUICK: dict[str, object] = {
         "test_controller_down_on_backpressure_delta_not_level",
         "test_controller_down_reason_never_blames_a_disabled_signal",
         "test_controller_admission_signal_has_disable_knob",
+        "test_controller_replay_fill_inversion_scales_down_only_when_fed",
         "test_controller_blame_veto_blocks_misattributed_scale_up",
         "test_blame_horizon_covers_the_closed_window_not_the_1s_clamp",
         "test_scripted_requests_bypass_hysteresis_one_per_window",

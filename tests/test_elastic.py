@@ -109,6 +109,37 @@ def test_controller_admission_signal_has_disable_knob():
     assert c.decide(_window(server_overload=5.0, serve_shed=5.0), 3) is None
 
 
+def test_controller_replay_fill_inversion_scales_down_only_when_fed():
+    """ISSUE 14: a (nearly) full replay ring with a LOW learner stall is
+    a scale-down signal (sample reuse covers the duty cycle — fewer
+    actors would do); the same fill with a STARVED learner is not (a
+    full ring masking a real shortfall stays a throughput problem). Off
+    by default: a replay-off controller (threshold 0) never fires it."""
+    c = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                          hysteresis=1, down_backpressure=0.0,
+                          down_admission=0.0, down_replay_fill=0.9)
+    d = c.decide(
+        _window(replay_fill_frac=1.0, learner_stall_frac=0.02), 3
+    )
+    assert d is not None and d.direction == "down"
+    assert d.reason == "replay_fill"
+    # Full ring + starved learner: NOT a down signal (and the stall
+    # alone is the up case, vetoed here only by its own hysteresis).
+    c2 = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                           hysteresis=2, down_backpressure=0.0,
+                           down_admission=0.0, down_replay_fill=0.9)
+    assert c2.decide(
+        _window(replay_fill_frac=1.0, learner_stall_frac=0.95), 3
+    ) is None
+    # Disabled (the replay-off trainer passes 0.0): never fires.
+    c3 = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                           hysteresis=1, down_backpressure=0.0,
+                           down_admission=0.0)
+    assert c3.decide(
+        _window(replay_fill_frac=1.0, learner_stall_frac=0.02), 3
+    ) is None
+
+
 def test_controller_blame_veto_blocks_misattributed_scale_up():
     """A stall the spans blame on the learner (H2D-bound) must not grow
     the actor fleet — more actors cannot fix it."""

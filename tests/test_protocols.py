@@ -48,6 +48,7 @@ SCHEDULER = os.path.join(PACKAGE, "serve", "scheduler.py")
 TRAINER = os.path.join(PACKAGE, "api", "sebulba_trainer.py")
 DURABILITY = os.path.join(PACKAGE, "runtime", "durability.py")
 SEBULBA = os.path.join(PACKAGE, "rollout", "sebulba.py")
+REPLAY = os.path.join(PACKAGE, "learn", "replay.py")
 
 
 def codes(findings):
@@ -134,6 +135,26 @@ def test_neutering_the_real_void_trips_prot002():
     findings = _check_single(TRAINER, mutated, ("protocols",))
     assert any(
         f.code == "PROT002" and "staging-lease" in f.message
+        for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_neutering_the_replay_eviction_void_trips_prot002():
+    """The replay ring's ``# protocol:``-declared spec (the ISSUE-11
+    'coming replay ring' case, now real): publish adopts the evicted
+    row's outstanding lease via the ``_outstanding`` mint and must void
+    it — neutering the ``lease.void()`` (in memory) leaks the lease on
+    the eviction path, PROT002 under the declared replay-lease spec;
+    the real file is clean."""
+    assert not _check_single(REPLAY, open(REPLAY).read(), ("protocols",))
+    mutated = _mutated(
+        REPLAY,
+        "            lease.void()",
+        "            pass",
+    )
+    findings = _check_single(REPLAY, mutated, ("protocols",))
+    assert any(
+        f.code == "PROT002" and "replay-lease" in f.message
         for f in findings
     ), "\n".join(f.render() for f in findings)
 

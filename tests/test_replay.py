@@ -1,0 +1,473 @@
+"""Device replay ring + IMPACT-mode learner (ISSUE 14).
+
+Tier-1 contract:
+
+- the replay lease protocol holds at the unit level: generation fencing
+  (a zombie read after eviction raises, never returns a newer slab's
+  rows), least-reused-first sampling (a fresh slab is always sampled
+  before an already-replayed one), reuse-count ledger exactness against
+  a hand-tracked publish history, and quarantine voiding every in-flight
+  lease (the PR-10 rollback path extended to the device tier);
+- ``replay_slabs=0`` is the pre-PR program: seed-deterministic losses,
+  ZERO replay keys in the window snapshot, no target network traced;
+- replay ON is live end-to-end on the sebulba path: reuse/fill/target
+  telemetry in every window, updates multiplied by replay_passes, and
+  the IMPACT target net refreshing exactly on its period;
+- the anchored update degenerates to the plain V-trace update while the
+  target still equals the online net and the clip is loose — the
+  anchoring changes nothing it shouldn't.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.learn import replay as replay_lib
+from asyncrl_tpu.rollout.buffer import Rollout
+from asyncrl_tpu.rollout.staging import StaleLeaseError
+from asyncrl_tpu.utils.config import Config
+
+T, B, OBS = 3, 4, 2
+
+
+def tiny_template() -> Rollout:
+    f32 = np.dtype(np.float32)
+    return Rollout(
+        obs=jax.ShapeDtypeStruct((T, B, OBS), f32),
+        actions=jax.ShapeDtypeStruct((T, B), np.dtype(np.int32)),
+        behaviour_logp=jax.ShapeDtypeStruct((T, B), f32),
+        rewards=jax.ShapeDtypeStruct((T, B), f32),
+        terminated=jax.ShapeDtypeStruct((T, B), np.dtype(bool)),
+        truncated=jax.ShapeDtypeStruct((T, B), np.dtype(bool)),
+        bootstrap_obs=jax.ShapeDtypeStruct((B, OBS), f32),
+        init_core=None,
+        disc_returns=None,
+    )
+
+
+def slab(value: float) -> Rollout:
+    """A distinguishable device fragment: every float leaf holds
+    ``value``, so a consumed row names its publisher."""
+    f32 = np.float32
+    return jax.device_put(
+        Rollout(
+            obs=np.full((T, B, OBS), value, f32),
+            actions=np.zeros((T, B), np.int32),
+            behaviour_logp=np.full((T, B), value, f32),
+            rewards=np.zeros((T, B), f32),
+            terminated=np.zeros((T, B), bool),
+            truncated=np.zeros((T, B), bool),
+            bootstrap_obs=np.full((B, OBS), value, f32),
+            init_core=None,
+            disc_returns=None,
+        )
+    )
+
+
+def consume_value(lease) -> float:
+    out, _, _ = lease.consume()
+    return float(np.asarray(out.obs)[0, 0, 0])
+
+
+# ------------------------------------------------------------- ring units
+
+
+def test_generation_fencing_zombie_read_raises():
+    """A lease held across its row's eviction must raise — NEVER return
+    the newer slab's rows — and ReplayStaleError is a StaleLeaseError
+    (one error family for the staging discipline, host or device)."""
+    ring = replay_lib.DeviceReplayRing(tiny_template(), rows=2)
+    rng = np.random.default_rng(0)
+    ring.publish(slab(1.0))
+    ring.publish(slab(2.0))
+    lease = ring.lease_sample(rng)
+    held_row = lease.row
+    # Two more publishes wrap the 2-row ring: the held row is evicted.
+    ring.publish(slab(3.0))
+    ring.publish(slab(4.0))
+    with pytest.raises(replay_lib.ReplayStaleError):
+        lease.consume()
+    assert isinstance(
+        replay_lib.ReplayStaleError("x"), StaleLeaseError
+    )
+    # The evicted row now serves the NEW slab under a fresh lease.
+    fresh = ring.lease_sample(rng)
+    value = consume_value(fresh)
+    assert value in (3.0, 4.0)
+    assert ring._row_gen[held_row] > lease.gen
+
+
+def test_valid_consume_returns_the_published_rows():
+    ring = replay_lib.DeviceReplayRing(tiny_template(), rows=3)
+    rng = np.random.default_rng(1)
+    ring.publish(slab(7.0))
+    lease = ring.lease_sample(rng)
+    out, reuse, behaviour = lease.consume()
+    assert float(np.asarray(out.obs)[0, 0, 0]) == 7.0
+    assert float(np.asarray(out.bootstrap_obs)[0, 0]) == 7.0
+    assert reuse == 2  # fresh pass (1) + this replay (2)
+    assert behaviour == 0
+
+
+def test_fresh_slab_always_sampled_first():
+    """Least-reused-first: a slab the learner has seen fewer times
+    always samples before a more-reused one."""
+    ring = replay_lib.DeviceReplayRing(tiny_template(), rows=3)
+    rng = np.random.default_rng(2)
+    ring.publish(slab(1.0))
+    ring.publish(slab(2.0))
+    first = consume_value(ring.lease_sample(rng))
+    # The other (still reuse-1) row must come next, whatever the rng.
+    second = consume_value(ring.lease_sample(rng))
+    assert {first, second} == {1.0, 2.0}
+    # A NEW publish is now strictly the least-reused row: sampled first.
+    ring.publish(slab(9.0))
+    assert consume_value(ring.lease_sample(rng)) == 9.0
+
+
+def test_reuse_ledger_exact_vs_hand_tracked_history():
+    """Drive a scripted publish/consume history and check the ring's
+    ledger and the ReuseWindow percentiles against hand-tracked truth."""
+    ring = replay_lib.DeviceReplayRing(tiny_template(), rows=2)
+    rng = np.random.default_rng(3)
+    window = replay_lib.ReuseWindow()
+    observed = []
+
+    def publish(v, behaviour):
+        ring.publish(slab(v), behaviour_update=behaviour)
+        window.observe(1, 0)  # the trainer's fresh-pass observation
+        observed.append(1)
+
+    def replay_once():
+        lease = ring.lease_sample(rng)
+        _, reuse, _ = lease.consume()
+        window.observe(reuse, 0)
+        observed.append(reuse)
+        return reuse
+
+    publish(1.0, behaviour=5)
+    publish(2.0, behaviour=6)
+    assert replay_once() == 2
+    assert replay_once() == 2
+    assert replay_once() == 3
+    # Overwrite row 0 (oldest generation): its count restarts at 1.
+    publish(3.0, behaviour=7)
+    assert replay_once() == 2
+    truth = np.asarray(observed, np.float64)
+    drained = window.drain()
+    assert drained["reuse_p50"] == float(np.percentile(truth, 50))
+    assert drained["reuse_p95"] == float(np.percentile(truth, 95))
+    assert drained["reuse_max"] == float(truth.max())
+    assert window.drain() == {}  # absent, never a misleading zero
+
+
+def test_fill_frac_and_empty_ring_sampling():
+    ring = replay_lib.DeviceReplayRing(tiny_template(), rows=4)
+    rng = np.random.default_rng(4)
+    assert ring.fill_frac() == 0.0
+    assert ring.lease_sample(rng) is None
+    ring.publish(slab(1.0))
+    assert ring.fill_frac() == 0.25
+    # An outstanding lease makes the only filled row unleasable.
+    lease = ring.lease_sample(rng)
+    assert ring.lease_sample(rng) is None
+    lease.consume()
+    assert ring.lease_sample(rng) is not None
+
+
+def test_quarantine_voids_inflight_leases_and_empties_the_ring():
+    """The PR-10 rollback path extended to the replay tier: quarantine
+    voids every outstanding lease (a zombie consume raises) and drops
+    every filled row."""
+    ring = replay_lib.DeviceReplayRing(tiny_template(), rows=3)
+    rng = np.random.default_rng(5)
+    ring.publish(slab(1.0))
+    ring.publish(slab(2.0))
+    lease = ring.lease_sample(rng)
+    assert ring.quarantine() == 2
+    with pytest.raises(replay_lib.ReplayStaleError):
+        lease.consume()
+    assert ring.fill_frac() == 0.0
+    assert ring.lease_sample(rng) is None
+    # The ring is immediately usable again after the purge.
+    ring.publish(slab(8.0))
+    assert consume_value(ring.lease_sample(rng)) == 8.0
+
+
+def test_replay_config_validation():
+    base = Config(algo="impala", replay_slabs=2)
+    replay_lib.validate_replay_config(base)  # clean
+    replay_lib.validate_replay_config(Config(algo="ppo"))  # off = anything
+    with pytest.raises(ValueError, match="impala"):
+        replay_lib.validate_replay_config(
+            Config(algo="ppo", replay_slabs=2)
+        )
+    with pytest.raises(ValueError, match="updates_per_call"):
+        replay_lib.validate_replay_config(
+            base.replace(updates_per_call=2)
+        )
+    with pytest.raises(ValueError, match="core"):
+        replay_lib.validate_replay_config(base.replace(core="lstm"))
+    # Normalization stats would fold each slab replay_passes times (the
+    # jitted step cannot tell fresh from replayed): refused loudly.
+    with pytest.raises(ValueError, match="normalize"):
+        replay_lib.validate_replay_config(
+            base.replace(normalize_obs=True)
+        )
+    with pytest.raises(ValueError, match="normalize"):
+        replay_lib.validate_replay_config(
+            base.replace(normalize_returns=True)
+        )
+    with pytest.raises(ValueError, match="replay_passes"):
+        replay_lib.validate_replay_config(base.replace(replay_passes=0))
+    with pytest.raises(ValueError, match="replay_rho_clip"):
+        replay_lib.validate_replay_config(
+            base.replace(replay_rho_clip=0.5)
+        )
+
+
+# --------------------------------------------------------- trainer e2e
+
+
+NUM_ENVS, UNROLL = 16, 8
+
+
+def _cfg(**kw) -> Config:
+    return Config(
+        env_id="CartPole-v1", algo="impala", backend="sebulba",
+        host_pool="jax", num_envs=NUM_ENVS, actor_threads=1,
+        unroll_len=UNROLL, precision="f32", log_every=4, seed=3,
+        actor_staleness=1_000_000,  # frozen behaviour: deterministic
+        **kw,
+    )
+
+
+def _run(cfg: Config, updates: int = 12):
+    agent = make_agent(cfg)
+    try:
+        history = agent.train(
+            total_env_steps=updates * NUM_ENVS * UNROLL
+        )
+        state = agent.state
+        total_updates = agent._updates
+    finally:
+        agent.close()
+    return history, state, total_updates
+
+
+REPLAY_KEYS = (
+    "replay_fill_frac", "reuse_p50", "reuse_p95", "reuse_max",
+    "target_lag_mean", "target_kl", "learner_stall_trend",
+)
+
+
+def test_replay_off_is_deterministic_and_leaks_nothing():
+    """replay_slabs=0 = the pre-PR program: seed-deterministic losses,
+    zero replay keys anywhere in the window snapshot, and no target
+    network in the learner state (nothing replay-shaped was traced)."""
+    h1, s1, _ = _run(_cfg())
+    h2, s2, _ = _run(_cfg())
+    assert np.array_equal(
+        np.asarray([h["loss"] for h in h1]),
+        np.asarray([h["loss"] for h in h2]),
+    )
+    leaked = sorted(
+        k for h in h1 for k in h if k in REPLAY_KEYS
+    )
+    assert leaked == [], leaked
+    assert s1.target_params is None
+    assert s2.target_params is None
+
+
+def test_replay_on_e2e_telemetry_and_update_multiplier():
+    """With the ring armed every window carries the replay aux, the
+    update count is replay_passes x the fresh-fragment count, and the
+    learner state carries a live target net."""
+    updates = 12
+    history, state, total = _run(
+        _cfg(replay_slabs=4, replay_passes=3), updates=updates
+    )
+    last = history[-1]
+    for key in REPLAY_KEYS:
+        assert key in last, f"missing window key {key}"
+    assert total == updates * 3
+    assert 0.0 < last["replay_fill_frac"] <= 1.0
+    assert last["reuse_p50"] >= 1.0
+    assert np.isfinite(last["loss"])
+    assert state.target_params is not None
+    # Off-policy-ness stays OBSERVED: replayed consumptions feed the
+    # PR-8 staleness ledger, whose keys ride the same windows.
+    assert "staleness_p95" in last
+
+
+def test_replay_env_override_wins(monkeypatch):
+    """ASYNCRL_REPLAY resolves ONCE at construction, env over config —
+    the ASYNCRL_INTROSPECT precedence."""
+    monkeypatch.setenv("ASYNCRL_REPLAY", "3")
+    agent = make_agent(_cfg(replay_slabs=0))
+    try:
+        assert agent.config.replay_slabs == 3
+        assert agent._replay is not None
+        assert agent._replay.rows == 3
+    finally:
+        agent.close()
+    monkeypatch.setenv("ASYNCRL_REPLAY", "0")
+    agent = make_agent(_cfg(replay_slabs=5))
+    try:
+        assert agent.config.replay_slabs == 0
+        assert agent._replay is None
+    finally:
+        agent.close()
+
+
+def test_target_net_refreshes_exactly_on_period():
+    """The IMPACT anchor refreshes every target_update_period updates:
+    stale in between, equal to the online net right after."""
+    from asyncrl_tpu.learn.rollout_learner import RolloutLearner
+    from asyncrl_tpu.envs.core import EnvSpec
+    from asyncrl_tpu.models.networks import build_model
+    from asyncrl_tpu.parallel.mesh import make_mesh
+
+    cfg = _cfg(replay_slabs=2, replay_passes=2, target_update_period=2)
+    spec = EnvSpec(obs_shape=(4,), num_actions=2)
+    model = build_model(cfg, spec)
+    mesh = make_mesh(cfg.mesh_shape, cfg.mesh_axes)
+    learner = RolloutLearner(cfg, spec, model, mesh)
+    state = learner.init_state(0)
+
+    rng = np.random.default_rng(0)
+    frag = Rollout(
+        obs=rng.normal(size=(UNROLL, NUM_ENVS, 4)).astype(np.float32),
+        actions=rng.integers(0, 2, size=(UNROLL, NUM_ENVS)).astype(
+            np.int32
+        ),
+        behaviour_logp=np.full((UNROLL, NUM_ENVS), -0.69, np.float32),
+        rewards=np.ones((UNROLL, NUM_ENVS), np.float32),
+        terminated=np.zeros((UNROLL, NUM_ENVS), bool),
+        truncated=np.zeros((UNROLL, NUM_ENVS), bool),
+        bootstrap_obs=rng.normal(size=(NUM_ENVS, 4)).astype(np.float32),
+        init_core=None,
+        disc_returns=None,
+    )
+
+    def max_diff(a, b):
+        return max(
+            float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+
+    # "Held" is asserted to ~1 ulp, not bitwise: on older jax the compat
+    # shard_map (parallel/mesh.py) proves P() replication by passing
+    # outputs through an identity pmean, and the mean of 8 equal floats
+    # rounds at the 3x/5x/7x partial sums — value-preserving, not
+    # bit-preserving. The Adam step itself is ~lr (3e-4), three orders
+    # above the 1e-6 bar, so held/moved/refreshed stay unambiguous.
+    assert max_diff(state.target_params, state.params) == 0.0
+    state1, _ = learner.update(state, learner.put_rollout(frag))
+    # step 1: 1 % 2 != 0 — the target holds the INITIAL params, which
+    # no longer match the once-updated online net.
+    assert max_diff(state1.target_params, state.params) < 1e-6
+    assert max_diff(state1.target_params, state1.params) > 1e-5
+    state2, _ = learner.update(state1, learner.put_rollout(frag))
+    # step 2: refresh — target snaps to the updated online net.
+    assert max_diff(state2.target_params, state2.params) < 1e-6
+
+
+def test_loose_anchor_matches_plain_vtrace_update():
+    """While target == online params and the rho clip is loose, the
+    anchored behaviour log-prob is exactly the original (max(mu, pi -
+    log_clip) = mu), so one IMPACT-mode update must match the plain
+    V-trace update numerically — the anchoring changes nothing it
+    shouldn't."""
+    from asyncrl_tpu.learn.rollout_learner import RolloutLearner
+    from asyncrl_tpu.envs.core import EnvSpec
+    from asyncrl_tpu.models.networks import build_model
+    from asyncrl_tpu.parallel.mesh import make_mesh
+
+    spec = EnvSpec(obs_shape=(4,), num_actions=2)
+    mesh = make_mesh((-1,), ("dp",))
+    rng = np.random.default_rng(1)
+    frag = Rollout(
+        obs=rng.normal(size=(UNROLL, NUM_ENVS, 4)).astype(np.float32),
+        actions=rng.integers(0, 2, size=(UNROLL, NUM_ENVS)).astype(
+            np.int32
+        ),
+        behaviour_logp=np.full((UNROLL, NUM_ENVS), -0.69, np.float32),
+        rewards=np.ones((UNROLL, NUM_ENVS), np.float32),
+        terminated=np.zeros((UNROLL, NUM_ENVS), bool),
+        truncated=np.zeros((UNROLL, NUM_ENVS), bool),
+        bootstrap_obs=rng.normal(size=(NUM_ENVS, 4)).astype(np.float32),
+        init_core=None,
+        disc_returns=None,
+    )
+
+    def one_update(**kw):
+        cfg = _cfg(**kw)
+        model = build_model(cfg, spec)
+        learner = RolloutLearner(cfg, spec, model, mesh)
+        state = learner.init_state(0)
+        new_state, metrics = learner.update(
+            state, learner.put_rollout(frag)
+        )
+        return new_state, metrics
+
+    plain_state, plain_metrics = one_update()
+    # replay_rho_clip=1e9: log cap ~20.7 nats, far beyond any pi/mu gap
+    # on a fresh net — the anchor floor never binds on update 1 (the
+    # target still equals the online net).
+    anchored_state, anchored_metrics = one_update(
+        replay_slabs=2, replay_rho_clip=1e9
+    )
+    np.testing.assert_allclose(
+        float(plain_metrics["loss"]),
+        float(anchored_metrics["loss"]),
+        rtol=1e-5,
+    )
+    for a, b in zip(
+        jax.tree.leaves(plain_state.params),
+        jax.tree.leaves(anchored_state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+    assert "target_kl" in anchored_metrics
+    assert "target_kl" not in plain_metrics
+
+
+def test_rollback_quarantine_reaches_the_replay_ring():
+    """Trainer-level: _quarantine_poisoned (the PR-10 divergence path)
+    voids the replay ring's outstanding leases and empties it."""
+    cfg = _cfg(replay_slabs=4, replay_passes=2)
+    agent = make_agent(cfg)
+    try:
+        ring = agent._replay
+        ring.publish(slab_like(agent))
+        ring.publish(slab_like(agent))
+        rng = np.random.default_rng(0)
+        lease = ring.lease_sample(rng)
+        assert ring.fill_frac() == 0.5
+        agent._reuse_window.observe(2, 1)  # a poisoned-stretch sample
+        agent._quarantine_poisoned({}, [])
+        with pytest.raises(replay_lib.ReplayStaleError):
+            lease.consume()
+        assert ring.fill_frac() == 0.0
+        # Telemetry purges with the data: the quarantined stretch's
+        # reuse observations must not drain into the next window.
+        assert agent._reuse_window.drain() == {}
+    finally:
+        agent.close()
+
+
+def slab_like(agent) -> Rollout:
+    """A device fragment matching the agent's real replay geometry."""
+    from asyncrl_tpu.rollout import staging
+
+    template = staging.fragment_template(
+        agent.config, agent.spec, agent.model, agent._envs_per_actor
+    )
+    host = jax.tree.map(
+        lambda sds: np.zeros(sds.shape, np.dtype(sds.dtype)), template
+    )
+    return agent.learner.put_rollout(host)
