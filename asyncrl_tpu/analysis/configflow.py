@@ -57,6 +57,10 @@ KNOWN_ENV_VARS = {
     "ASYNCRL_SERVE_TOLERANCE",  # scripts/serve_smoke.sh throughput budget
     "ASYNCRL_SERVE_P95_MS",   # scripts/serve_smoke.sh p95 latency gate
     "ASYNCRL_OBS_PORT",       # obs/http.py — exposition endpoint port
+    "ASYNCRL_OBS_HOST",       # obs/http.py — exposition bind host
+    "ASYNCRL_GATEWAY_HOST",   # serve/gateway.py — gateway bind host
+    "ASYNCRL_GATEWAY_QPS",    # scripts/gateway_smoke.sh load-gen rate
+    "ASYNCRL_GATEWAY_P99_MS",  # scripts/gateway_smoke.sh p99 latency gate
     "ASYNCRL_INTROSPECT",     # obs/introspect.py — training introspection
     "ASYNCRL_INTROSPECT_TOLERANCE",  # scripts/introspect_smoke.sh budget
     "ASYNCRL_ELASTIC",        # api/sebulba_trainer.py — elastic-runtime toggle

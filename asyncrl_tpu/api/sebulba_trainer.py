@@ -382,6 +382,62 @@ class SebulbaTrainer:
                 "ASYNCRL_DRAIN_GRACE_S=0): the scripted SIGTERM would "
                 "kill the run undrained instead of testing the drain"
             )
+        # External gateway (asyncrl_tpu/serve/gateway.py): the wire
+        # frontier over the serve core. gateway_port=0 constructs NOTHING
+        # (zero threads, zero registry keys — the introspect=False
+        # bit-identity discipline); when on, the gateway requires the
+        # serve core (it routes through ServeCore.submit_external) and a
+        # feed-forward inference signature (recurrent/eps serving over
+        # the wire is a follow-up: core state has no wire story yet).
+        # A netfault-kind fault site is refused when the gateway is off —
+        # the preempt/scale precedent: a chaos script that can never fire
+        # is a chaos script that silently tests nothing.
+        self._gateway = None
+        self._gateway_backend = None
+        self._gateway_tenants = None
+        self._gateway_port: int | None = None
+        self._gateway_restarts = 0
+        self._recent_gateway_restarts: list[float] = []
+        # Supervisor re-bind backoff (a failed rebuild retries, it never
+        # kills training — see _supervise_gateway).
+        self._gateway_retry_at = 0.0
+        if config.gateway_port != 0:
+            if not config.inference_server or not self._use_serve_core():
+                raise ValueError(
+                    "gateway_port != 0 requires inference_server=True and "
+                    "the serve core (serve=True / ASYNCRL_SERVE=1): the "
+                    "gateway serves through ServeCore's continuous batch"
+                )
+            from asyncrl_tpu.rollout.sebulba import inference_mode
+
+            if inference_mode(config, self.model) != "ff":
+                raise ValueError(
+                    "gateway_port != 0 requires a feed-forward policy "
+                    "(core='ff', algo != 'qlearn'): recurrent/epsilon "
+                    "inference has no wire protocol yet"
+                )
+            if config.gateway_deadline_ms <= 0:
+                raise ValueError(
+                    "gateway_deadline_ms must be > 0: it is the default "
+                    "end-to-end budget for requests without an "
+                    f"X-Deadline-Ms header (got {config.gateway_deadline_ms})"
+                )
+            from asyncrl_tpu.serve import gateway as gateway_mod
+
+            # Eager spec validation: a malformed SLO matrix (or deadline,
+            # above) fails at construction, where the operator reads it —
+            # not mid-train when the gateway first spawns.
+            self._gateway_tenants = gateway_mod.parse_tenant_spec(
+                config.gateway_tenant_spec
+            )
+        else:
+            registry = faults.active()
+            if registry is not None and registry.has_kind("netfault"):
+                raise ValueError(
+                    "fault spec arms a 'netfault' site but the gateway is "
+                    "off (gateway_port=0): the scripted wire failure "
+                    "could never fire and would silently test nothing"
+                )
         # Automatic divergence rollback (RollbackPolicy): armed by
         # rollback_bad_windows > 0, which also arms the learner's
         # device-side NaN-guard. Needs a checkpoint_dir — without retained
@@ -476,6 +532,9 @@ class SebulbaTrainer:
             )
             self._actor_restarts = int(run_state.get("actor_restarts", 0))
             self._server_restarts = int(run_state.get("server_restarts", 0))
+            self._gateway_restarts = int(
+                run_state.get("gateway_restarts", 0)
+            )
             if self._rollback is not None:
                 self._rollback.attempts = int(
                     run_state.get("rollback_attempts", 0)
@@ -531,6 +590,7 @@ class SebulbaTrainer:
             ),
             "actor_restarts": self._actor_restarts,
             "server_restarts": self._server_restarts,
+            "gateway_restarts": self._gateway_restarts,
         }
 
     def _published(self, state):
@@ -624,6 +684,8 @@ class SebulbaTrainer:
         self._actor_gens = [g + 1 for g in self._actor_gens]
         if self.config.inference_server:
             self._spawn_server()
+        if self.config.gateway_port != 0:
+            self._spawn_gateway()
         self._actors = [
             self._spawn_actor(i) for i in range(self.config.actor_threads)
         ]
@@ -697,6 +759,94 @@ class SebulbaTrainer:
             )
         self._server.start()
 
+    def _spawn_gateway(self) -> None:
+        """(Re)build the external gateway (serve/gateway.py). The BACKEND
+        persists across rebuilds — its serve-stale anchor (a held
+        ParamSlots lease on the last-good generation) must survive a
+        gateway crash, that being exactly the outage stale mode exists
+        for. A rebuild after a crash re-binds the SAME port the first
+        spawn resolved (ephemeral -1 included), so external clients'
+        retry layers reconnect without re-discovery."""
+        from asyncrl_tpu.serve import gateway as gateway_mod
+
+        cfg = self.config
+        if self._gateway_backend is None:
+            self._gateway_backend = gateway_mod.CoreBackend(
+                core_fn=lambda: self._server,
+                inference_fn=self._inference_fn,
+                obs_shape=self.spec.obs_shape,
+                seed=cfg.seed,
+            )
+        port = (
+            self._gateway_port
+            if self._gateway_port is not None
+            else cfg.gateway_port
+        )
+        self._gateway = gateway_mod.ServeGateway(
+            self._gateway_backend,
+            port=port,
+            bind_host=gateway_mod.env_host(cfg.gateway_host),
+            tenants=self._gateway_tenants,
+            default_deadline_ms=cfg.gateway_deadline_ms,
+        ).start()
+        self._gateway_port = self._gateway.port
+
+    def _supervise_gateway(self) -> None:
+        """Supervised gateway rebuild: a gateway whose serving thread died
+        (netfault crash, serving-loop failure) is retired and rebuilt on
+        its own storm window — the ACTOR FLEET IS NEVER TOUCHED (the
+        chaos matrix's headline assertion for this boundary: a frontier
+        death must cost external availability only, never training). The
+        same invariant covers the REBUILD itself: a re-bind that fails
+        (the port momentarily taken during the outage) costs external
+        availability only — training continues and the supervisor keeps
+        retrying on a short backoff. (The INITIAL bind in _start_actors
+        stays loud: a taken port at startup is an operator config error,
+        not an outage.)"""
+        if self._stop.is_set() or self.config.gateway_port == 0:
+            return
+        gateway = self._gateway
+        if gateway is None:
+            # A previous rebuild could not re-bind: retry, backed off.
+            if time.monotonic() < self._gateway_retry_at:
+                return
+            try:
+                self._spawn_gateway()
+            except OSError as e:
+                self._gateway_retry_at = time.monotonic() + 2.0
+                print(
+                    f"asyncrl_tpu: gateway re-bind failed ({e}); external "
+                    "serving stays down, retrying (training continues)",
+                    file=sys.stderr,
+                )
+            return
+        if gateway.is_alive() and gateway.fatal is None:
+            return
+        fatal = gateway.fatal
+        flightrec.record(
+            "supervisor.gateway_restart", detail=f"{fatal!r}"
+        )
+        self._gateway_restarts += 1
+        obs_registry.counter("gateway_restarts").inc()
+        # The server storm rule at one instance: > 3 in the window aborts.
+        self._storm_guard(
+            self._recent_gateway_restarts, 3, "gateway", fatal
+        )
+        gateway.stop()
+        self._gateway = None  # a failed re-spawn must not re-reap the dead one
+        try:
+            self._spawn_gateway()
+        except OSError as e:
+            self._gateway_retry_at = time.monotonic() + 2.0
+            flightrec.record(
+                "supervisor.gateway_rebind_failed", detail=f"{e}"
+            )
+            print(
+                f"asyncrl_tpu: gateway re-bind failed ({e}); external "
+                "serving stays down, retrying (training continues)",
+                file=sys.stderr,
+            )
+
     def _supervise(self) -> None:  # thread-entry: watchdog@learner
         """The reap loop: rebuild a dead/hung inference server, restart
         dead actors (SURVEY.md §5.3 — fresh env pool each time), retire and
@@ -707,6 +857,7 @@ class SebulbaTrainer:
         from asyncrl_tpu.rollout.inference_server import InvariantViolation
 
         self._supervise_server()
+        self._supervise_gateway()
         self._supervise_stalled_actors()
         try:
             while True:
@@ -1151,6 +1302,13 @@ class SebulbaTrainer:
     def stop(self) -> None:
         """Stop actor threads (and the inference server), drain the queue."""
         self._stop.set()
+        if self._gateway is not None:
+            # The wire boundary closes FIRST: external clients observe
+            # 503-draining (and then connection refused) rather than
+            # requests dying mid-pipeline behind them.
+            self._gateway.close_admissions()
+            self._gateway.stop()
+            self._gateway = None
         # The server's personal event must be set BEFORE the actor joins:
         # actors blocked in _submit wake on the SERVER's stop event, not
         # the cohort's — setting it late would make every join below eat
@@ -1241,6 +1399,13 @@ class SebulbaTrainer:
             detail=f"signal {drain.signum}: draining within "
             f"{drain.grace_s:.0f}s, then exiting {durability.EXIT_DRAINED}",
         )
+        if self._gateway is not None:
+            # The drain protocol's outermost edge: gateway admissions
+            # close BEFORE the serve gate, so no external request can be
+            # admitted into a pipeline that is about to drain under it —
+            # and before the final checkpoint below, so the checkpoint
+            # never races live wire traffic.
+            self._gateway.close_admissions()
         server = self._server
         if server is not None:
             gate = getattr(server, "slo", None)
@@ -1261,6 +1426,10 @@ class SebulbaTrainer:
             "actor_restarts": self._actor_restarts,
             "server_restarts": self._server_restarts,
         }
+        if self.config.gateway_port != 0:
+            # Same guarded key the main-loop window exports: the terminal
+            # sample must not drop the gateway's restart history.
+            agg["gateway_restarts"] = self._gateway_restarts
         agg.update(faults.counters())
         self._obs.observe_window(agg)
         if self._ckpt.checkpointer is not None:
@@ -1678,6 +1847,9 @@ class SebulbaTrainer:
                     # backpressure, and per-site injected-fault counts.
                     agg["actor_restarts"] = self._actor_restarts
                     agg["server_restarts"] = self._server_restarts
+                    if self.config.gateway_port != 0:
+                        # Guarded: gateway off leaks zero gateway keys.
+                        agg["gateway_restarts"] = self._gateway_restarts
                     agg["queue_backpressure"] = self._backpressure_base + sum(
                         a.backpressure for a in self._actors
                     )
@@ -1777,6 +1949,20 @@ class SebulbaTrainer:
                     obs_registry.gauge("staging_slabs_live").set(
                         float(ring.num_slabs) if ring is not None else 0.0
                     )
+                    if cfg.gateway_port != 0:
+                        # Gateway liveness for /healthz and the recorded
+                        # history — guarded on the CONFIG (not the
+                        # object), so a crash-plus-failed-rebind outage
+                        # (self._gateway is None while the supervisor
+                        # retries) reads 0.0 instead of freezing at the
+                        # last healthy value; gateway-off still leaks
+                        # zero gateway keys (the bit-identity contract).
+                        obs_registry.gauge("gateway_live").set(
+                            1.0
+                            if self._gateway is not None
+                            and self._gateway.is_alive()
+                            else 0.0
+                        )
                     # ONE shared window snapshot (obs/__init__.py): the
                     # registry/trace drain merges in here, the health
                     # detectors run, and the time-series store records —
@@ -1831,6 +2017,12 @@ class SebulbaTrainer:
     def close(self) -> None:
         """Stop actors, flush pending checkpoint saves, release resources."""
         self.stop()
+        if self._gateway_backend is not None:
+            # Release the serve-stale anchor leases (stop() keeps the
+            # backend alive across gateway rebuilds; final teardown is
+            # here, after the last possible rebuild).
+            self._gateway_backend.close()
+            self._gateway_backend = None
         for pool in self._eval_pools.values():
             _close(pool)
         self._eval_pools = {}

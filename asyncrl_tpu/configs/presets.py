@@ -351,6 +351,30 @@ pong_pixels_t2t = pong_t2t.replace(
     learning_rate=3e-4,
 )
 
+# The serving-arc preset (ROADMAP item 4; scripts/gateway_smoke.sh):
+# pong IMPALA on the sebulba host path with the serve core AND the
+# external gateway mounted — wire clients hit /v1/act while training
+# continues and weights swap live. Tenant matrix: a latency-tier "gold"
+# class (tight p95, stale-degradation so availability survives a core
+# outage), a rate-limited "bulk" class (shed + Retry-After), and the "*"
+# catch-all. gateway_port=-1 binds an ephemeral port the harness reads
+# back; set a fixed port for real exposure.
+pong_serve = pong_impala.replace(
+    backend="sebulba",
+    host_pool="jax",
+    num_envs=16,
+    actor_threads=2,
+    unroll_len=16,
+    inference_server=True,
+    serve=True,
+    gateway_port=-1,
+    gateway_tenant_spec=(
+        "gold:stale:p95_ms=250,inflight=32;"
+        "bulk:shed:rps=50,burst=25;"
+        "*:fallback"
+    ),
+)
+
 PRESETS: dict[str, Config] = {
     "cartpole_a3c": cartpole_a3c,
     "cartpole_a3c_cpu": cartpole_a3c_cpu,
@@ -365,6 +389,7 @@ PRESETS: dict[str, Config] = {
     "pong_t2t_ale4": pong_t2t_ale4,
     "pong_pixels_t2t": pong_pixels_t2t,
     "pong_selfplay": pong_selfplay,
+    "pong_serve": pong_serve,
     "atari_impala": atari_impala,
     "atari_impala_wide": atari_impala_wide,
     "breakout_impala": breakout_impala,

@@ -262,7 +262,8 @@ def setup(config) -> PipelineObs:
     if port != 0:
         try:
             server = http_mod.ObsHTTPServer(
-                port=port, store=store, monitor=monitor
+                port=port, store=store, monitor=monitor,
+                bind_host=http_mod.env_host(config.obs_http_host),
             ).start()
         except OSError as e:
             # A taken/forbidden port must not kill training — the run is

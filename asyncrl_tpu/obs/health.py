@@ -52,6 +52,10 @@ recompile_storm       pipeline   warn      `compiles` grew >=
 memory_growth         pipeline   warn      memory watermark grew more than
                                            health_mem_growth x the run's
                                            first watermark (0 = off)
+gateway_error_rate    gateway    warn      >= half of a window's gateway
+                                           requests errored (>= 4 reqs)
+breaker_open          gateway    warn      a client-side circuit breaker
+                                           is sitting open
 ===================== ========== ========= =================================
 
 The last five (ISSUE 8) watch the *learning* and the *device* — fed by
@@ -77,7 +81,9 @@ from typing import Any, Callable
 from asyncrl_tpu.obs import flightrec, registry
 from asyncrl_tpu.obs import spans as span_names
 
-COMPONENTS = ("actors", "server", "learner", "serve-core", "pipeline")
+COMPONENTS = (
+    "actors", "server", "learner", "serve-core", "gateway", "pipeline"
+)
 _STATUS_RANK = {"ok": 0, "degraded": 1, "critical": 2}
 
 # Which component a dominant WAIT span indicts (the causal reading of
@@ -94,6 +100,7 @@ _BLAME = {
     span_names.SERVE_ADMIT_WAIT: "serve-core",
     span_names.SERVE_BATCH_FILL: "actors",
     span_names.SERVE_SWAP_DRAIN: "serve-core",
+    span_names.GATEWAY_ADMIT_WAIT: "gateway",
 }
 
 
@@ -366,6 +373,42 @@ def _recompile_storm(monitor: "HealthMonitor", sample: dict[str, Any]):
     )
 
 
+def _gateway_error_rate(monitor: "HealthMonitor", sample: dict[str, Any]):
+    """The wire boundary's failure-fraction detector: fires when at least
+    half of a window's gateway requests errored (500s, netfault-enacted
+    disconnects, backend failures) over a minimum request floor — a
+    handful of errors in a busy window is retry fodder, half the window
+    failing is an outage. Quiet (and key-free) when the gateway is off:
+    no ``gateway_requests`` key, no evaluation."""
+    requests = monitor.delta(sample, "gateway_requests")
+    if "gateway_requests" not in sample or requests < 4:
+        return None
+    errors = monitor.delta(sample, "gateway_errors")
+    frac = errors / requests
+    if frac < 0.5:
+        return None
+    return (
+        f"gateway error rate {100.0 * frac:.0f}% this window "
+        f"({errors:.0f}/{requests:.0f} requests failed)",
+        {"errors": errors, "requests": requests, "error_frac": frac},
+    )
+
+
+def _breaker_open(monitor: "HealthMonitor", sample: dict[str, Any]):
+    """A client-side circuit breaker sitting open means an endpoint is
+    being refused without even trying — the load generator (or any
+    in-process GatewayClient) exports its breaker states as gauges, and
+    an open one degrades the gateway component until it re-closes."""
+    value = sample.get("gateway_breaker_open")
+    if not _finite_number(value) or value <= 0:
+        return None
+    return (
+        f"{value:.0f} gateway circuit breaker(s) open: calls are refused "
+        "client-side until a half-open probe succeeds",
+        {"breakers_open": float(value)},
+    )
+
+
 def _memory_growth(monitor: "HealthMonitor", sample: dict[str, Any]):
     limit = monitor.thresholds.mem_growth
     if limit <= 0:
@@ -415,6 +458,12 @@ def default_detectors() -> list[Detector]:
         ),
         Detector("recompile_storm", "pipeline", "warn", _recompile_storm),
         Detector("memory_growth", "pipeline", "warn", _memory_growth),
+        # Wire-boundary detectors (the external gateway, serve/gateway.py);
+        # both quiet unless gateway keys are present in the window.
+        Detector(
+            "gateway_error_rate", "gateway", "warn", _gateway_error_rate
+        ),
+        Detector("breaker_open", "gateway", "warn", _breaker_open),
     ]
 
 

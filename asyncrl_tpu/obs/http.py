@@ -44,6 +44,7 @@ from urllib.parse import parse_qs, urlparse
 from asyncrl_tpu.obs import registry
 
 ENV_PORT = "ASYNCRL_OBS_PORT"
+ENV_HOST = "ASYNCRL_OBS_HOST"
 _METRIC_NAME = re.compile(r"[^a-zA-Z0-9_]")
 
 
@@ -61,6 +62,16 @@ def env_port(config_port: int) -> int:
                 "(0=off, -1=ephemeral)"
             )
     return config_port
+
+
+def env_host(config_host: str) -> str:
+    """The effective bind host: ``ASYNCRL_OBS_HOST`` (when set and
+    non-empty) wins over ``config.obs_http_host`` — the same precedence as
+    the port. Loopback stays the default everywhere; binding wider
+    (``0.0.0.0``) is a deliberate operator decision made through exactly
+    these two knobs."""
+    raw = os.environ.get(ENV_HOST, "").strip()
+    return raw if raw else config_host
 
 
 def render_prometheus(values: Mapping[str, Any]) -> str:

@@ -34,6 +34,10 @@ SERVE_BATCH_FILL = "serve.batch_fill"    # scheduler holding a partial batch ope
 SERVE_DISPATCH = "serve.dispatch"        # coalesce + batched device call
 SERVE_SWAP_DRAIN = "serve.swap_drain"    # waiting for old-generation batches
 
+# External gateway (serve/gateway.py): the wire boundary over the serve core.
+GATEWAY_ADMIT_WAIT = "gateway.admit_wait"  # request held at tenant admission
+GATEWAY_SERVE = "gateway.serve"            # backend call (act/evaluate)
+
 # Elastic runtime (asyncrl_tpu/runtime/elastic.py): the save → reconfigure
 # → restore barrier around a fleet-scale action. Runs on the learner
 # (window-close) thread; a COMPUTE span — its cost is the price of a scale
@@ -57,6 +61,7 @@ WAIT_SPANS = frozenset({
     SERVE_ADMIT_WAIT,
     SERVE_BATCH_FILL,
     SERVE_SWAP_DRAIN,
+    GATEWAY_ADMIT_WAIT,
     LEARNER_QUEUE_WAIT,
     LEARNER_H2D_WAIT,
 })
@@ -105,6 +110,11 @@ WAIT_CAUSES = {
         "to retire: dispatches are long relative to the publish cadence "
         "(teardown/barrier paths only — the swap itself never blocks)"
     ),
+    GATEWAY_ADMIT_WAIT: (
+        "external requests held at the gateway's tenant admission layer "
+        "(token bucket / per-tenant SLO class): offered wire load exceeds "
+        "the tenant's provisioned rate — shed responses carry Retry-After"
+    ),
 }
 
 
@@ -128,6 +138,7 @@ _GROUP_PREFIXES = (
     ("serve-core", "server"),
     ("flightrec-", "flightrec"),
     ("obs-http", "obs"),
+    ("gateway-", "gateway"),
     ("checkpoint", "checkpoint"),
 )
 

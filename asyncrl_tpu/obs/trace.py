@@ -82,11 +82,16 @@ class SpanRing:
     """
 
     __slots__ = ("capacity", "thread_name", "group", "names", "starts",
-                 "ends", "idx")
+                 "ends", "idx", "thread")
 
-    def __init__(self, capacity: int, thread_name: str, group: str):
+    def __init__(self, capacity: int, thread_name: str, group: str,
+                 thread=None):
         self.capacity = capacity
         self.thread_name = thread_name
+        # The owning Thread object (None for legacy/test construction):
+        # the tracer's bounded dead-ring retention needs liveness, and
+        # names alone cannot provide it.
+        self.thread = thread
         # lint: thread-shared-ok(written only via tag_thread on the owning thread; readers see old or new group, both coherent)
         self.group = group
         # lint: thread-shared-ok(single-writer ring slots; snapshot discards the copy-window slots a concurrent record may touch)
@@ -151,11 +156,22 @@ class Tracer:
         # recycles idents, and a restarted actor's fresh ring must never
         # evict its crashed predecessor's spans from the export/dumps.
         self._rings: list[SpanRing] = []  # guarded-by: _lock
+        self.pruned = 0  # guarded-by: _lock
         self._local = threading.local()
         # Clock anchor: exported timestamps are
         # (span.start - anchor_perf) in µs, wall-anchored by anchor_unix.
         self.anchor_perf = time.perf_counter()
         self.anchor_unix = time.time()
+
+    # Bound on RETAINED rings: dead threads' rings stay for forensics (a
+    # crashed actor's spans must survive into the export/dumps), but
+    # thread-per-request servers (the gateway's HTTP handlers) would
+    # otherwise grow the registry one ring per connection, forever —
+    # unbounded RSS and O(total requests) window closes. Past the cap,
+    # the OLDEST dead rings are pruned (live rings are never touched);
+    # the cap is far above any bounded fleet's thread count, so actor
+    # forensics keep the old retention semantics in practice.
+    RING_RETENTION = 128
 
     def _ring(self) -> SpanRing:
         ring = getattr(self._local, "span_ring", None)
@@ -164,10 +180,20 @@ class Tracer:
             ring = SpanRing(
                 self.capacity, thread.name,
                 span_names.thread_group(thread.name),
+                thread=thread,
             )
             self._local.span_ring = ring
             with self._lock:
                 self._rings.append(ring)
+                if len(self._rings) > self.RING_RETENTION:
+                    excess = len(self._rings) - self.RING_RETENTION
+                    dead = [
+                        r for r in self._rings
+                        if r.thread is not None and not r.thread.is_alive()
+                    ][:excess]
+                    for old in dead:
+                        self._rings.remove(old)
+                    self.pruned += len(dead)
         return ring
 
     def span(self, name: str) -> _Span:
@@ -180,8 +206,10 @@ class Tracer:
 
     def snapshots(self) -> list[dict[str, Any]]:
         """One snapshot per registered thread ring (any thread may call);
-        dead threads' rings are retained — a crashed actor's spans stay
-        in the export and the flight dumps."""
+        dead threads' rings are retained (up to ``RING_RETENTION``, then
+        oldest-dead-first pruning) — a crashed actor's spans stay in the
+        export and the flight dumps, while thread-per-request handlers
+        cannot grow the registry without bound."""
         with self._lock:
             rings = list(self._rings)
         return [r.snapshot() for r in rings]
@@ -190,10 +218,12 @@ class Tracer:
         """Window-metric view: spans recorded and dropped, all threads."""
         with self._lock:
             rings = list(self._rings)
+            pruned = self.pruned
         return {
             "trace_spans": sum(r.idx for r in rings),
             "trace_dropped_spans": sum(r.dropped for r in rings),
             "trace_threads": len(rings),
+            "trace_rings_pruned": pruned,
         }
 
 

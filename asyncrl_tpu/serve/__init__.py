@@ -13,12 +13,35 @@ into a serving core that could face external traffic:
   routing (population/league/self-play from one server).
 - :mod:`asyncrl_tpu.serve.params` — :class:`ParamSlots`,
   generation-stamped zero-drain weight swaps.
+- :mod:`asyncrl_tpu.serve.gateway` — :class:`ServeGateway`, the external
+  HTTP frontier (versioned JSON wire protocol, deadline propagation,
+  per-tenant SLO classes, graceful degradation, netfault chaos).
+- :mod:`asyncrl_tpu.serve.client` — :class:`GatewayClient`, the calling
+  side: bounded retry + jittered backoff + per-endpoint circuit breakers.
 
 ``SebulbaTrainer`` mounts the serve core behind ``config.serve`` (default
 on; ``ASYNCRL_SERVE`` env overrides) wherever ``config.inference_server``
-asks for a shared server — see docs/ARCHITECTURE.md "Policy serving".
+asks for a shared server, and the gateway behind ``config.gateway_port``
+(0 = off constructs nothing) — see docs/ARCHITECTURE.md "Policy serving"
+and "External gateway".
 """
 
+from asyncrl_tpu.serve.client import (
+    BreakerOpen,
+    CircuitBreaker,
+    GatewayClient,
+    GatewayResult,
+    GatewayShed,
+    GatewayUnavailable,
+)
+from asyncrl_tpu.serve.gateway import (
+    CoreBackend,
+    GatewayDegraded,
+    GatewaySpecError,
+    ServeGateway,
+    TenantClass,
+    parse_tenant_spec,
+)
 from asyncrl_tpu.serve.params import ParamSlots
 from asyncrl_tpu.serve.router import (
     DEFAULT_POLICY,
@@ -31,11 +54,23 @@ from asyncrl_tpu.serve.slo import RequestShed, SLOGate
 
 __all__ = [
     "DEFAULT_POLICY",
+    "BreakerOpen",
+    "CircuitBreaker",
+    "CoreBackend",
+    "GatewayClient",
+    "GatewayDegraded",
+    "GatewayResult",
+    "GatewayShed",
+    "GatewaySpecError",
+    "GatewayUnavailable",
     "ParamSlots",
     "PolicyRouter",
     "RequestShed",
     "SLOGate",
     "ServeCore",
+    "ServeGateway",
+    "TenantClass",
     "UnknownPolicyError",
+    "parse_tenant_spec",
     "selfplay_policies",
 ]

@@ -1,0 +1,408 @@
+"""GatewayClient: the calling side of the wire boundary.
+
+A real serving frontier is only half the robustness story — the other half
+is a client that behaves well when the frontier doesn't: bounded retries
+with jittered exponential backoff (never a synchronized thundering herd),
+a per-endpoint circuit breaker (a dead endpoint is refused client-side
+after a threshold, probed half-open, re-closed on success — the Nygard
+state machine), deadline budgets that bound the WHOLE attempt sequence
+(retrying past the caller's deadline serves nobody), and honest error
+taxonomy (a shed is not a crash; a breaker refusal is not a timeout).
+
+The breaker state machine (deterministic, clock-injected for tests):
+
+- **closed**: calls flow; consecutive failures (or latency breaches when
+  ``latency_ms`` is armed) count. At ``failures`` consecutive, → open.
+- **open**: calls raise :class:`BreakerOpen` immediately (no network I/O)
+  until ``reset_s`` elapses, then → half-open.
+- **half-open**: exactly ONE probe call passes; success → closed (counts
+  reset), failure → open (fresh reset clock). Concurrent calls during the
+  probe are refused like open.
+
+Breaker state exports as registry gauges (``gateway_breaker_<endpoint>``:
+0=closed, 1=half-open, 2=open) plus a ``gateway_breaker_open`` gauge (how
+many of this client's breakers sit open — the ``breaker_open`` health
+detector's feed) and cumulative ``gateway_breaker_opened`` /
+``gateway_client_retries`` counters.
+
+Stdlib-only transport (http.client), same discipline as the gateway
+itself. The transport is injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+from urllib.parse import urlparse
+
+from asyncrl_tpu.obs import registry as obs_registry
+
+ENDPOINTS = ("act", "evaluate")
+
+# Breaker states (gauge encoding: the monotone "how refused is this
+# endpoint" scale).
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+_STATE_GAUGE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class GatewayError(RuntimeError):
+    """Base class for client-visible gateway failures."""
+
+
+class GatewayShed(GatewayError):
+    """The gateway refused the request (429/503/504: rate limit, tenant
+    SLO shed, drain, deadline infeasible). Carries ``retry_after_s`` when
+    the server suggested one."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0,
+                 status: int = 0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.status = status
+
+
+class GatewayUnavailable(GatewayError):
+    """Transport-level failure: connection refused/reset, read timeout,
+    short or unparseable body — the retry layer's bread and butter."""
+
+
+class BreakerOpen(GatewayError):
+    """Refused client-side by an open circuit breaker — no network I/O
+    happened. Distinct from :class:`GatewayUnavailable` so callers can
+    tell "the endpoint is being avoided" from "the endpoint just failed"."""
+
+
+@dataclass
+class GatewayResult:
+    """One successful act/evaluate response."""
+
+    actions: list
+    logp: list
+    generation: int
+    stale: bool = False
+    fallback: bool = False
+    latency_ms: float = 0.0
+    attempts: int = 1
+    raw: dict = field(default_factory=dict)
+
+
+class CircuitBreaker:
+    """Per-endpoint breaker (see module doc). ``clock`` is injectable so
+    the open→half-open transition is testable without sleeping."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        failures: int = 5,
+        reset_s: float = 2.0,
+        latency_ms: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failures < 1:
+            raise ValueError(f"failures must be >= 1, got {failures}")
+        if reset_s <= 0:
+            raise ValueError(f"reset_s must be > 0, got {reset_s}")
+        self.endpoint = endpoint
+        self.failures = failures
+        self.reset_s = reset_s
+        self.latency_ms = latency_ms
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED  # guarded-by: _lock
+        self._consecutive = 0  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
+        self._probing = False  # guarded-by: _lock
+        self._gauge = obs_registry.gauge(f"gateway_breaker_{endpoint}")
+        self._counter_opened = obs_registry.counter("gateway_breaker_opened")
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:  # holds: _lock
+        if (
+            self._state == OPEN
+            and self._clock() - self._opened_at >= self.reset_s
+        ):
+            self._state = HALF_OPEN
+            self._probing = False
+        return self._state
+
+    def _publish_locked(self) -> None:  # holds: _lock
+        self._gauge.set(_STATE_GAUGE[self._state])
+
+    def before_call(self) -> None:
+        """Gate one call attempt. Raises :class:`BreakerOpen` when the
+        endpoint is being refused; in half-open, admits exactly one probe."""
+        with self._lock:
+            state = self._state_locked()
+            if state == OPEN:
+                self._publish_locked()
+                raise BreakerOpen(
+                    f"circuit open for endpoint {self.endpoint!r} "
+                    f"({self._consecutive} consecutive failures; probe in "
+                    f"{max(0.0, self.reset_s - (self._clock() - self._opened_at)):.2f}s)"
+                )
+            if state == HALF_OPEN:
+                if self._probing:
+                    raise BreakerOpen(
+                        f"circuit half-open for endpoint {self.endpoint!r}: "
+                        "probe in flight"
+                    )
+                self._probing = True
+            self._publish_locked()
+
+    def record_success(self, latency_ms: float = 0.0) -> None:
+        with self._lock:
+            if self.latency_ms > 0 and latency_ms > self.latency_ms:
+                # A latency breach is a soft failure: the endpoint answers,
+                # but past the caller's bar — it counts toward opening.
+                self._failure_locked()
+                return
+            self._state = CLOSED
+            self._consecutive = 0
+            self._probing = False
+            self._publish_locked()
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failure_locked()
+
+    def _failure_locked(self) -> None:  # holds: _lock
+        self._consecutive += 1
+        state = self._state_locked()
+        if state == HALF_OPEN or (
+            state == CLOSED and self._consecutive >= self.failures
+        ):
+            self._state = OPEN
+            self._opened_at = self._clock()
+            self._probing = False
+            self._counter_opened.inc()
+        self._publish_locked()
+
+
+class GatewayClient:
+    """Wire client for one gateway (see module doc).
+
+    ``transport`` (injectable for tests) maps ``(path, body_bytes,
+    headers, timeout_s) -> (status, headers_dict, body_bytes)`` and may
+    raise ``OSError`` for connection-level failures.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        tenant: str = "",
+        deadline_ms: float = 1000.0,
+        retries: int = 2,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 1.0,
+        breaker_failures: int = 5,
+        breaker_reset_s: float = 2.0,
+        breaker_latency_ms: float = 0.0,
+        seed: int = 0,
+        transport: Callable | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        parsed = urlparse(base_url)
+        if parsed.scheme not in ("http", ""):
+            raise ValueError(f"only http:// gateways: {base_url!r}")
+        netloc = parsed.netloc or parsed.path
+        self._host, _, port = netloc.partition(":")
+        self._port = int(port) if port else 80
+        self.tenant = tenant
+        self.deadline_ms = deadline_ms
+        self.retries = retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._transport = transport or self._http_transport
+        self._clock = clock
+        self._sleep = sleep
+        # Deterministic jitter: a fleet of clients seeded differently
+        # de-synchronizes; one client's retry schedule is reproducible.
+        self._rng = random.Random(seed ^ 0xBACC0FF)
+        self._rng_lock = threading.Lock()
+        self.breakers = {
+            endpoint: CircuitBreaker(
+                endpoint,
+                failures=breaker_failures,
+                reset_s=breaker_reset_s,
+                latency_ms=breaker_latency_ms,
+                clock=clock,
+            )
+            for endpoint in ENDPOINTS
+        }
+        self._gauge_open = obs_registry.gauge("gateway_breaker_open")
+        self._counter_retries = obs_registry.counter("gateway_client_retries")
+
+    # ---------------------------------------------------------- transport
+
+    def _http_transport(self, path, body, headers, timeout_s):
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=max(timeout_s, 0.05)
+        )
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            response = conn.getresponse()
+            return (
+                response.status, dict(response.getheaders()), response.read()
+            )
+        finally:
+            conn.close()
+
+    # -------------------------------------------------------------- calls
+
+    def act(self, obs, policy: str = "default",
+            deadline_ms: float | None = None) -> GatewayResult:
+        return self._call("act", obs, policy, deadline_ms)
+
+    def evaluate(self, obs, policy: str = "default",
+                 deadline_ms: float | None = None) -> GatewayResult:
+        return self._call("evaluate", obs, policy, deadline_ms)
+
+    def _publish_open_count(self) -> None:
+        self._gauge_open.set(
+            sum(1.0 for b in self.breakers.values() if b.state == OPEN)
+        )
+
+    def _jitter(self) -> float:
+        with self._rng_lock:
+            return 0.5 + self._rng.random()  # [0.5, 1.5)
+
+    def _call(self, endpoint, obs, policy, deadline_ms) -> GatewayResult:
+        budget_ms = deadline_ms if deadline_ms is not None else self.deadline_ms
+        if budget_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {budget_ms}")
+        breaker = self.breakers[endpoint]
+        obs_list = obs.tolist() if hasattr(obs, "tolist") else list(obs)
+        body = json.dumps({
+            "v": 1, "obs": obs_list, "policy": policy,
+        }).encode()
+        start = self._clock()
+        last: Exception | None = None
+        attempts = 0
+        for attempt in range(self.retries + 1):
+            remaining_ms = budget_ms - 1e3 * (self._clock() - start)
+            if remaining_ms <= 0:
+                break
+            try:
+                breaker.before_call()
+            except BreakerOpen:
+                self._publish_open_count()
+                raise
+            attempts += 1
+            if attempt > 0:
+                self._counter_retries.inc()
+            t0 = self._clock()
+            try:
+                result = self._attempt(
+                    endpoint, body, remaining_ms, attempts
+                )
+            except GatewayShed as e:
+                # A shed is the SERVER doing its job, not an endpoint
+                # failure: it must not open the breaker. Honor Retry-After
+                # inside the remaining budget.
+                breaker.record_success(0.0)
+                self._publish_open_count()
+                last = e
+                wait_s = e.retry_after_s or self._backoff_s(attempt)
+                if not self._wait(wait_s, start, budget_ms):
+                    break
+                continue
+            except GatewayUnavailable as e:
+                breaker.record_failure()
+                self._publish_open_count()
+                last = e
+                if not self._wait(
+                    self._backoff_s(attempt), start, budget_ms
+                ):
+                    break
+                continue
+            breaker.record_success(1e3 * (self._clock() - t0))
+            self._publish_open_count()
+            return result
+        if last is None:
+            last = GatewayUnavailable(
+                f"{endpoint}: deadline {budget_ms:.0f}ms spent before any "
+                "attempt completed"
+            )
+        raise last
+
+    def _backoff_s(self, attempt: int) -> float:
+        return min(
+            self.backoff_cap_s, self.backoff_base_s * (2.0 ** attempt)
+        ) * self._jitter()
+
+    def _wait(self, wait_s: float, start: float, budget_ms: float) -> bool:
+        """Sleep ``wait_s`` unless it would overrun the deadline budget;
+        returns False when the budget is spent (stop retrying)."""
+        remaining_s = budget_ms / 1e3 - (self._clock() - start)
+        if remaining_s <= wait_s:
+            return False
+        self._sleep(wait_s)
+        return True
+
+    def _attempt(self, endpoint, body, remaining_ms, attempts) -> GatewayResult:
+        headers = {
+            "Content-Type": "application/json",
+            "X-Deadline-Ms": f"{remaining_ms:.1f}",
+        }
+        if self.tenant:
+            headers["X-Tenant"] = self.tenant
+        try:
+            status, resp_headers, raw = self._transport(
+                f"/v1/{endpoint}", body, headers, remaining_ms / 1e3
+            )
+        except (OSError, http.client.HTTPException) as e:
+            raise GatewayUnavailable(
+                f"{endpoint}: transport failed: {type(e).__name__}: {e}"
+            ) from e
+        if status in (429, 503, 504):
+            retry_after = 0.0
+            for key, value in resp_headers.items():
+                if key.lower() == "retry-after":
+                    try:
+                        retry_after = float(value)
+                    except ValueError:
+                        pass
+            raise GatewayShed(
+                f"{endpoint}: shed with HTTP {status}: {raw[:200]!r}",
+                retry_after_s=retry_after, status=status,
+            )
+        if status != 200:
+            raise GatewayUnavailable(
+                f"{endpoint}: HTTP {status}: {raw[:200]!r}"
+            )
+        try:
+            doc = json.loads(raw)
+            if not isinstance(doc, dict) or "actions" not in doc:
+                raise ValueError(f"not a v1 response: {doc!r:.200}")
+            # Field coercion INSIDE the guard: a 200 carrying wrong-typed
+            # fields (generation: null from a torn server) is the same
+            # broken-endpoint condition as garbage bytes — it must become
+            # GatewayUnavailable and feed the breaker, never escape as a
+            # raw TypeError that skips breaker bookkeeping (and would
+            # wedge a half-open probe permanently).
+            return GatewayResult(
+                actions=doc["actions"],
+                logp=doc.get("logp", []),
+                generation=int(doc.get("generation", -1)),
+                stale=bool(doc.get("stale", False)),
+                fallback=bool(doc.get("fallback", False)),
+                latency_ms=float(doc.get("latency_ms", 0.0)),
+                attempts=attempts,
+                raw=doc,
+            )
+        except (ValueError, TypeError, KeyError) as e:
+            # Malformed payload on the wire (the netfault mode, or a torn
+            # response): indistinguishable from a broken endpoint.
+            raise GatewayUnavailable(
+                f"{endpoint}: unparseable response: {e}"
+            ) from e

@@ -1,0 +1,939 @@
+"""External serving gateway: the wire boundary over the serve core.
+
+ROADMAP item 4 made literal: ``ServeCore``/``SLOGate``/``PolicyRouter``/
+``ParamSlots`` serve in-process actor threads; this module puts the same
+core behind a versioned JSON wire protocol so external clients exist — and
+with them every failure mode a real network boundary breeds. Laminar
+(PAPERS.md, arXiv:2510.12633) is the model for a serving frontier fully
+decoupled from training; AcceRL (arXiv:2603.18464) for one async substrate
+serving heterogeneous clients. The design rule throughout is *robust by
+construction*: every overload, outage, and misbehaving-client path is an
+explicit, observable branch, not an accident.
+
+Wire protocol (v1, JSON over HTTP — the obs/http.py stdlib-first pattern
+scaled up to a mutating endpoint):
+
+- ``POST /v1/act``      — ``{"v": 1, "obs": [[...]], "policy": "default"}``
+  → ``{"v": 1, "actions": [...], "logp": [...], "generation": g}``.
+- ``POST /v1/evaluate`` — identical request/response shape, served through
+  the same continuous batch but as its OWN traffic class: evaluation
+  traffic gets separate counters and a separate client-side circuit
+  breaker so it can never be confused with — or silently starve — action
+  traffic.
+- Headers: ``X-Tenant`` names the caller's SLO class,
+  ``X-Deadline-Ms`` the request's end-to-end budget.
+
+Robustness machinery, in request order:
+
+1. **Deadline propagation**: the client's budget rides the header; a
+   request whose remaining budget is below the core's rolling p95 service
+   estimate is shed *before* it occupies a batch slot (HTTP 504,
+   ``gateway_deadline_shed``), and the surviving budget becomes the serve
+   core's batch-fill deadline for that request.
+2. **Per-tenant SLO classes** (``config.gateway_tenant_spec``): each class
+   carries its own token bucket (``rps``/``burst`` — starvation-free by
+   construction: no tenant can spend another's tokens), its own
+   :class:`~asyncrl_tpu.serve.slo.SLOGate` (per-class ``p95_ms`` target +
+   ``inflight`` cap, shed-mode, instruments prefixed
+   ``gateway_<class>_*`` so per-tenant p50/p95/p99 export per window), and
+   its own degradation ``mode``. Refusals answer 429 with ``Retry-After``.
+3. **Graceful degradation**: when the backing core is draining, swapping,
+   or dead, the tenant's mode picks the answer — ``shed`` (503 +
+   Retry-After), ``stale`` (serve from the last-good param generation: the
+   backend keeps a *stale anchor* — a held :class:`ParamSlots` lease on
+   the newest generation it served successfully, so the params are
+   resident and complete by the lease protocol, never freed memory; the
+   response stamps ``stale_generation``), or ``fallback`` (a configured
+   constant action, stamped ``fallback``).
+4. **Chaos** (``gateway.request`` fault site, ``netfault`` kind): scripted
+   client disconnect mid-request, slow-loris response body, malformed
+   payload on the wire, and gateway crash (the serving thread dies, the
+   trainer's supervisor rebuilds the gateway without dropping the actor
+   fleet). Refused eagerly when the gateway is off — the
+   ``preempt``/``scale`` precedent.
+
+Off is off: ``config.gateway_port=0`` constructs nothing — zero threads,
+zero registry keys, loss-bit-identical training (the ``introspect=False``
+discipline; pinned by tests/test_gateway.py and scripts/gateway_smoke.sh
+act 1). Port semantics match obs/http.py: ``-1`` binds an OS-assigned
+ephemeral port (read back from :attr:`ServeGateway.port`), positive binds
+exactly there. Binds loopback unless ``config.gateway_host`` /
+``ASYNCRL_GATEWAY_HOST`` says otherwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import urlparse
+
+import numpy as np
+
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.obs import spans as span_names
+from asyncrl_tpu.obs import trace
+from asyncrl_tpu.rollout.inference_server import ServerClosed
+from asyncrl_tpu.serve.slo import RequestShed, SLOGate
+from asyncrl_tpu.utils import faults
+from asyncrl_tpu.utils.faults import NetFault
+
+PROTOCOL_VERSION = 1
+ENV_HOST = "ASYNCRL_GATEWAY_HOST"
+DEFAULT_TENANT = "*"
+TENANT_MODES = ("shed", "stale", "fallback")
+# Bound on request bodies: a slow-loris or hostile client must exhaust its
+# own connection, never this process's memory.
+MAX_BODY_BYTES = 16 << 20
+
+REQUESTS_COUNTER = "gateway_requests"
+ERRORS_COUNTER = "gateway_errors"
+BAD_REQUEST_COUNTER = "gateway_bad_requests"
+SHED_COUNTER = "gateway_shed"
+DEADLINE_SHED_COUNTER = "gateway_deadline_shed"
+STALE_COUNTER = "gateway_stale_served"
+FALLBACK_COUNTER = "gateway_fallback_served"
+NETFAULT_COUNTER = "gateway_netfaults"
+
+
+def env_host(config_host: str) -> str:
+    """``ASYNCRL_GATEWAY_HOST`` (when set and non-empty) wins over
+    ``config.gateway_host`` — the obs/http.py ``env_host`` precedence;
+    loopback stays the default."""
+    raw = os.environ.get(ENV_HOST, "").strip()
+    return raw if raw else config_host
+
+
+class GatewaySpecError(ValueError):
+    """A malformed ``config.gateway_tenant_spec`` string."""
+
+
+class GatewayDegraded(RuntimeError):
+    """The backing serve core cannot take this request (draining, dead,
+    or mid-rebuild): the tenant's degradation mode owns the answer."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantClass:
+    """One tenant SLO class (see module doc). ``rps=0`` = unlimited rate,
+    ``p95_ms=0`` = no latency-breach shedding, ``inflight=0`` = uncapped."""
+
+    name: str
+    mode: str = "shed"
+    p95_ms: float = 0.0
+    inflight: int = 0
+    rps: float = 0.0
+    burst: int = 8
+    fallback_action: int = 0
+
+    def __post_init__(self):
+        if self.mode not in TENANT_MODES:
+            raise GatewaySpecError(
+                f"tenant {self.name!r}: unknown mode {self.mode!r}; "
+                f"have {TENANT_MODES}"
+            )
+        if self.p95_ms < 0 or self.rps < 0 or self.inflight < 0:
+            raise GatewaySpecError(
+                f"tenant {self.name!r}: p95_ms/rps/inflight must be >= 0"
+            )
+        if self.burst < 1:
+            raise GatewaySpecError(
+                f"tenant {self.name!r}: burst must be >= 1"
+            )
+
+
+def _metric_name(tenant: str) -> str:
+    """The registry-safe metric infix for a tenant class: the ``*``
+    catch-all gets the reserved ``catchall``; everything else sanitizes
+    punctuation to ``_``. ONE definition, shared by the spec validator
+    (collisions refuse at parse time) and the live tenant state."""
+    if tenant == DEFAULT_TENANT:
+        return "catchall"
+    return "".join(
+        ch if (ch.isalnum() or ch == "_") else "_" for ch in tenant
+    ) or "unnamed"
+
+
+def parse_tenant_spec(spec: str) -> dict[str, TenantClass]:
+    """Parse ``config.gateway_tenant_spec``: ``name:mode[:k=v,...]``,
+    ``;``-separated (the ASYNCRL_FAULTS grammar shape). Options:
+    ``p95_ms``, ``inflight``, ``rps``, ``burst``, ``fallback``. The
+    ``*`` tenant is the class unmatched tenant ids fold into; when the
+    spec names none, a permissive shed-mode default is supplied. Raises
+    :class:`GatewaySpecError` on any malformed field — an operator's SLO
+    matrix must never silently protect nothing."""
+    tenants: dict[str, TenantClass] = {}
+    for chunk in spec.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        fields = chunk.split(":")
+        if len(fields) < 2:
+            raise GatewaySpecError(
+                f"tenant spec {chunk!r} needs name:mode (optionally "
+                ":k=v,k=v)"
+            )
+        name, mode = fields[0].strip(), fields[1].strip()
+        if not name:
+            raise GatewaySpecError(f"tenant spec {chunk!r}: empty name")
+        if name in tenants:
+            raise GatewaySpecError(f"tenant {name!r} specified twice")
+        kwargs: dict[str, Any] = {}
+        for extra in fields[2:]:
+            for kv in extra.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                if "=" not in kv:
+                    raise GatewaySpecError(
+                        f"tenant spec {chunk!r}: option {kv!r} is not k=v"
+                    )
+                k, v = kv.split("=", 1)
+                k = k.strip()
+                try:
+                    if k == "p95_ms":
+                        kwargs["p95_ms"] = float(v)
+                    elif k == "inflight":
+                        kwargs["inflight"] = int(v)
+                    elif k == "rps":
+                        kwargs["rps"] = float(v)
+                    elif k == "burst":
+                        kwargs["burst"] = int(v)
+                    elif k == "fallback":
+                        kwargs["fallback_action"] = int(v)
+                    else:
+                        raise GatewaySpecError(
+                            f"tenant spec {chunk!r}: unknown option {k!r} "
+                            "(have p95_ms, inflight, rps, burst, fallback)"
+                        )
+                except ValueError as e:
+                    raise GatewaySpecError(
+                        f"tenant spec {chunk!r}: bad value for {k!r} — {e}"
+                    ) from None
+        tenants[name] = TenantClass(name=name, mode=mode, **kwargs)
+    if DEFAULT_TENANT not in tenants:
+        tenants[DEFAULT_TENANT] = TenantClass(name=DEFAULT_TENANT)
+    # Metric-name congruence: two classes whose names sanitize to the
+    # same prefix (or a class squatting the catch-all's reserved name)
+    # would silently MERGE registry instruments — per-tenant telemetry
+    # summing strangers. Refused here, where the operator reads it.
+    seen: dict[str, str] = {}
+    for name in tenants:
+        metric = _metric_name(name)
+        if metric in seen:
+            raise GatewaySpecError(
+                f"tenant {name!r} and {seen[metric]!r} share the metric "
+                f"prefix gateway_{metric}: rename one (punctuation "
+                "sanitizes to '_'; 'catchall' is reserved for '*')"
+            )
+        seen[metric] = name
+    return tenants
+
+
+class _RateBucket:
+    """Per-tenant token bucket (wall-clock refill at ``rps``, capacity
+    ``burst``). Starvation-free across tenants by construction: every
+    class owns its own bucket. ``rps=0`` admits everything."""
+
+    def __init__(self, rps: float, burst: int):
+        self.rps = rps
+        self.burst = float(burst)
+        self._lock = threading.Lock()
+        self._tokens = float(burst)  # guarded-by: _lock
+        self._stamp = time.monotonic()  # guarded-by: _lock
+
+    def try_take(self) -> float:
+        """0.0 when a token was taken; otherwise the seconds until the
+        next token accrues (the 429 ``Retry-After`` value)."""
+        if self.rps <= 0:
+            return 0.0
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._stamp) * self.rps
+            )
+            self._stamp = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            return max((1.0 - self._tokens) / self.rps, 1e-3)
+
+
+class _TenantState:
+    """One tenant class's live admission state: rate bucket + shed-mode
+    SLO gate (instruments ``gateway_<class>_*``)."""
+
+    def __init__(self, cls: TenantClass):
+        self.cls = cls
+        # Collisions (incl. squatting the reserved catch-all name) were
+        # refused at parse time — see parse_tenant_spec.
+        metric = _metric_name(cls.name)
+        self.gate = SLOGate(
+            p95_target_ms=cls.p95_ms,
+            max_inflight=cls.inflight,
+            shed=True,
+            metrics_prefix=f"gateway_{metric}",
+        )
+        self.bucket = _RateBucket(cls.rps, cls.burst)
+
+
+class CoreBackend:
+    """The trainer-side gateway backend: routes wire requests into the
+    live :class:`~asyncrl_tpu.serve.scheduler.ServeCore` and owns the
+    serve-stale anchor.
+
+    ``core_fn`` returns the CURRENT serve core (the trainer's supervisor
+    replaces the core object on rebuild, so the backend must re-read it
+    per request, never capture one). ``inference_fn`` is the same jitted
+    callable the core dispatches — the stale path runs it directly, on the
+    handler thread, under the anchored last-good params.
+
+    The stale anchor is a held ParamSlots lease: after every successful
+    serve the backend re-pins the generation it was just served under and
+    releases the previous pin, so during an outage the anchored params are
+    guaranteed resident and unmixed (the lease protocol's guarantee — see
+    tests/test_serve.py's serve-stale pins), never freed weights.
+    """
+
+    def __init__(
+        self,
+        core_fn: Callable[[], Any],
+        inference_fn: Callable,
+        obs_shape: tuple[int, ...],
+        seed: int = 0,
+    ):
+        import jax
+
+        self._core_fn = core_fn
+        self._fn = inference_fn
+        self.obs_shape = tuple(obs_shape)
+        self._lock = threading.Lock()
+        # policy -> (slots, generation); the lease is held until the next
+        # re-anchor or close().
+        self._anchors: dict[str, tuple[Any, int]] = {}  # guarded-by: _lock
+        self._key = jax.random.PRNGKey(seed ^ 0x6A7E)  # guarded-by: _lock
+
+    # ------------------------------------------------------------ serving
+
+    def latency_estimate_ms(self) -> float:
+        """The core's rolling p95 serve latency — the deadline-feasibility
+        estimate (0.0 = no signal yet, nothing is shed on it)."""
+        core = self._core_fn()
+        if core is None:
+            return 0.0
+        return core.slo.p95_ms()
+
+    @staticmethod
+    def _bucket_rows(obs: np.ndarray) -> np.ndarray:
+        """Pad the external batch's row count up to the next power of two
+        (repeating the first row). Wire clients send arbitrary B; without
+        bucketing every novel row count recompiles the shared jitted
+        inference fn on the training device — a multi-second stall the
+        wire must never be able to script. Buckets bound the external
+        shape alphabet to log2(max rows); callers slice answers back."""
+        rows = obs.shape[0]
+        bucket = 1 << (rows - 1).bit_length()
+        if bucket == rows:
+            return obs
+        return np.concatenate(
+            [obs, np.repeat(obs[:1], bucket - rows, axis=0)], axis=0
+        )
+
+    def act(
+        self, policy: str, obs: np.ndarray, deadline_ms: float
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        core = self._core_fn()
+        if core is None or not core.serving():
+            raise GatewayDegraded(
+                "serve core unavailable (draining, dead, or rebuilding)"
+            )
+        rows = obs.shape[0]
+        try:
+            result, generation = core.submit_external(
+                policy, (self._bucket_rows(obs),), deadline_ms
+            )
+        except (RequestShed, GatewayDegraded):
+            raise
+        except ServerClosed as e:
+            raise GatewayDegraded(f"serve core closed mid-request: {e}")
+        actions, logp = result[0], result[1]
+        self._reanchor(policy, core, generation)
+        return (
+            np.asarray(actions)[:rows], np.asarray(logp)[:rows], generation
+        )
+
+    # /v1/evaluate rides the same continuous batch as its own traffic
+    # class (separate wire counters + client breaker; see module doc).
+    evaluate = act
+
+    def serve_stale(
+        self, policy: str, obs: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """Answer from the anchored last-good generation (degradation mode
+        ``stale``). Raises :class:`GatewayDegraded` when no generation was
+        ever anchored — a gateway that never served cannot serve stale."""
+        import jax
+
+        rows = obs.shape[0]
+        with self._lock:
+            anchor = self._anchors.get(policy)
+            if anchor is None:
+                raise GatewayDegraded(
+                    f"no last-good generation anchored for policy "
+                    f"{policy!r}: nothing to serve stale from"
+                )
+            slots, generation = anchor
+            # Read THROUGH the held lease: resident by refcount, complete
+            # and unmixed by the install protocol (serve/params.py). Our
+            # own extra lease keeps the slot pinned even if close()
+            # releases the anchor concurrently.
+            params, _ = slots.lease_generation(generation)
+            # Per-call key split under the lock; the device call itself
+            # runs OUTSIDE it — stale requests must not serialize against
+            # each other or against healthy requests' re-anchoring.
+            self._key, sub = jax.random.split(self._key)
+        try:
+            out = self._fn(params, self._bucket_rows(obs), sub)
+            actions, logp = out[0], out[1]
+        finally:
+            slots.release(generation)
+        return (
+            np.asarray(actions)[:rows], np.asarray(logp)[:rows], generation
+        )
+
+    def _reanchor(self, policy: str, core, generation: int) -> None:
+        """Pin the generation just served (lease held), release the
+        previous anchor. A generation that retired between dispatch and
+        re-anchor falls back to pinning the latest — the anchor must
+        always end up on something resident."""
+        with self._lock:
+            prev = self._anchors.get(policy)
+            if prev is not None and prev[1] == generation:
+                return
+            try:
+                slots = core.router.slots(policy)
+            # lint: broad-except-ok(anchor refresh is best-effort: a router mid-rebuild keeps the previous anchor, which is exactly what stale mode wants)
+            except Exception:
+                return
+            try:
+                # lint: protocol-ok(sanctioned hand-off: the stale ANCHOR deliberately outlives this scope — held in _anchors until the next re-anchor or close() releases it; that held lease IS the serve-stale guarantee)
+                slots.lease_generation(generation)
+                anchor = (slots, generation)
+            except RuntimeError:
+                # lint: protocol-ok(same sanctioned anchor hand-off as above, latest-generation fallback branch)
+                _, latest = slots.lease()
+                anchor = (slots, latest)
+            self._anchors[policy] = anchor
+            if prev is not None:
+                prev_slots, prev_gen = prev
+                try:
+                    prev_slots.release(prev_gen)
+                # lint: broad-except-ok(releasing an anchor on a torn-down router of a replaced core: the old slots object is garbage either way; the new anchor is already installed)
+                except Exception:
+                    pass
+
+    def anchored_generation(self, policy: str) -> int | None:
+        with self._lock:
+            anchor = self._anchors.get(policy)
+            return None if anchor is None else anchor[1]
+
+    def close(self) -> None:
+        """Release every anchor lease (trainer teardown). Idempotent."""
+        with self._lock:
+            anchors, self._anchors = self._anchors, {}
+        for slots, generation in anchors.values():
+            try:
+                slots.release(generation)
+            # lint: broad-except-ok(teardown best-effort: the router may already be gone with its core; leaked refs on a dead object are unreachable either way)
+            except Exception:
+                pass
+
+
+class ServeGateway:
+    """The external HTTP gateway (see module doc).
+
+    Construction BINDS the socket (a taken port fails loudly at setup —
+    the obs/http.py rule); :meth:`start` spawns the ``gateway-http``
+    serving thread; :meth:`stop` shuts it down. Per-request handlers run
+    on ThreadingHTTPServer daemon threads; everything they touch is
+    either request-local, lock-guarded (tenant states, backend anchors),
+    or a GIL-atomic latch/flag annotated below.
+    """
+
+    def __init__(
+        self,
+        backend,
+        port: int = -1,
+        bind_host: str = "127.0.0.1",
+        tenants: dict[str, TenantClass] | None = None,
+        default_deadline_ms: float = 1000.0,
+    ):
+        if default_deadline_ms <= 0:
+            raise ValueError(
+                f"default_deadline_ms must be > 0: {default_deadline_ms}"
+            )
+        self.backend = backend
+        self.default_deadline_ms = default_deadline_ms
+        self._tenants = {
+            name: _TenantState(cls)
+            for name, cls in (tenants or parse_tenant_spec("")).items()
+        }
+        if DEFAULT_TENANT not in self._tenants:
+            self._tenants[DEFAULT_TENANT] = _TenantState(
+                TenantClass(name=DEFAULT_TENANT)
+            )
+        # Chaos handle: one fetch, None when unarmed (utils/faults.py).
+        self._fault_request = faults.site("gateway.request")
+        # Instruments exist only while a gateway does — gateway off leaks
+        # zero registry keys (the bit-identity contract).
+        self._c_requests = obs_registry.counter(REQUESTS_COUNTER)
+        self._c_errors = obs_registry.counter(ERRORS_COUNTER)
+        self._c_bad = obs_registry.counter(BAD_REQUEST_COUNTER)
+        self._c_shed = obs_registry.counter(SHED_COUNTER)
+        self._c_deadline_shed = obs_registry.counter(DEADLINE_SHED_COUNTER)
+        self._c_stale = obs_registry.counter(STALE_COUNTER)
+        self._c_fallback = obs_registry.counter(FALLBACK_COUNTER)
+        self._c_netfaults = obs_registry.counter(NETFAULT_COUNTER)
+        # lint: thread-shared-ok(single-writer latch: the handler thread that enacts a netfault crash writes once; the supervisor reads after the serving thread exits)
+        self._fatal: BaseException | None = None
+        # lint: thread-shared-ok(GIL-atomic bool flag: the drain/window thread writes, handler threads read the latest or previous value — both are coherent answers during a drain edge)
+        self._draining = False
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # Per-request daemon threads (see class docstring). The
+            # socket timeout is the INBOUND slow-loris defense: a client
+            # that connects and never sends (or sends headers and
+            # withholds the body, or never reads its response) releases
+            # its handler thread and fd after this long instead of
+            # pinning them forever — MAX_BODY_BYTES bounds memory, this
+            # bounds threads.
+            timeout = 30.0
+
+            def log_message(self, fmt, *args):  # silence stderr chatter
+                pass
+
+            def do_GET(self):  # noqa: N802 (stdlib handler contract)
+                outer._route_get(self)
+
+            def do_POST(self):  # noqa: N802 (stdlib handler contract)
+                try:
+                    outer._route_post(self)
+                # lint: broad-except-ok(the wire boundary must answer 500 and keep serving; the failure is counted and the next request is independent)
+                except Exception as e:
+                    outer._c_errors.inc()
+                    try:
+                        outer._send_json(
+                            self, 500,
+                            {"v": PROTOCOL_VERSION, "error": "internal",
+                             "detail": f"{type(e).__name__}: {e}"},
+                        )
+                    except OSError:
+                        pass  # client hung up mid-error — nothing to do
+
+        self._httpd = ThreadingHTTPServer((bind_host, max(0, port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.port: int = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -------------------------------------------------------------- wire
+
+    @staticmethod
+    def _send(handler, code: int, body: bytes,
+              headers: dict[str, str] | None = None) -> None:
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            handler.send_header(key, value)
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _send_json(self, handler, code: int, doc: Any,
+                   headers: dict[str, str] | None = None) -> None:
+        self._send(
+            handler, code, (json.dumps(doc) + "\n").encode(), headers
+        )
+
+    def _route_get(self, handler) -> None:
+        try:
+            url = urlparse(handler.path)
+            if url.path == "/":
+                self._send_json(handler, 200, {
+                    "v": PROTOCOL_VERSION,
+                    "endpoints": ["/v1/act", "/v1/evaluate"],
+                    "tenants": sorted(self._tenants),
+                    "draining": self._draining,
+                })
+            else:
+                self._send_json(
+                    handler, 404, {"error": f"no route {url.path}"}
+                )
+        except OSError:
+            pass  # client hung up — nothing to answer
+
+    def _route_post(self, handler) -> None:
+        url = urlparse(handler.path)
+        if url.path == "/v1/act":
+            self._handle_request(handler, "act")
+        elif url.path == "/v1/evaluate":
+            self._handle_request(handler, "evaluate")
+        else:
+            self._c_bad.inc()
+            self._send_json(handler, 404, {"error": f"no route {url.path}"})
+
+    # ------------------------------------------------------- the request
+
+    def _bad(self, handler, code: int, error: str, detail: str = "") -> None:
+        self._c_bad.inc()
+        doc = {"v": PROTOCOL_VERSION, "error": error}
+        if detail:
+            doc["detail"] = detail
+        self._send_json(handler, code, doc)
+
+    def _netfault(self, handler, fault: NetFault, payload: bytes) -> bool:
+        """Enact one scripted wire failure. Returns True when the request
+        was consumed (the caller must not answer it again)."""
+        self._c_netfaults.inc()
+        mode = fault.mode
+        # From the client's view every enacted mode is a failed request —
+        # no answer (disconnect/crash), a corrupt one (malformed), or a
+        # stalled-then-useless one (slowloris: a patient client gets a
+        # non-answer payload, an impatient one a read timeout). All count
+        # toward the gateway_error_rate detector like organic 500s.
+        self._c_errors.inc()
+        if mode == "crash":
+            # The gateway dies mid-flight: latch the cause for the
+            # supervisor (the trainer rebuilds the gateway WITHOUT
+            # touching the actor fleet), stop the serving loop, and drop
+            # the connection unanswered — exactly what a crashed frontier
+            # looks like from outside.
+            self._fatal = fault
+            threading.Thread(
+                target=self._httpd.shutdown, name="gateway-crash", daemon=True
+            ).start()
+            handler.close_connection = True
+            return True
+        if mode == "disconnect":
+            # The client vanishes mid-request: no response, socket gone.
+            handler.close_connection = True
+            try:
+                handler.connection.close()
+            except OSError:
+                pass
+            return True
+        if mode == "slowloris":
+            # A wedged-slow response body: headers land, then a
+            # non-answer payload trickles past the client's read timeout
+            # (its retry layer owns the recovery; stall_s rides the
+            # fault site). A patient client that waits out the trickle
+            # still fails — the body carries no actions — which is why
+            # the mode counts as an error above.
+            site = self._fault_request
+            stall_s = site.stall_s if site is not None else 1.0
+            try:
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Content-Length", str(len(payload)))
+                handler.end_headers()
+                handler.wfile.write(payload[: max(1, len(payload) // 2)])
+                handler.wfile.flush()
+                deadline = time.monotonic() + stall_s
+                while time.monotonic() < deadline and self._fatal is None:
+                    time.sleep(0.05)
+                handler.wfile.write(payload[max(1, len(payload) // 2):])
+            except OSError:
+                pass  # the client gave up mid-trickle — the point
+            return True
+        # malformed: the wire corrupts the payload — a truncated non-JSON
+        # body behind a 200, the worst case for a naive client parser.
+        try:
+            self._send(handler, 200, b'{"v": 1, "actions": [tru')
+        except OSError:
+            pass
+        return True
+
+    def _handle_request(self, handler, endpoint: str) -> None:
+        self._c_requests.inc()
+        arrival = time.monotonic()
+        # ---- parse + validate (nothing counted against tenants yet)
+        try:
+            length = int(handler.headers.get("Content-Length", "0"))
+        except ValueError:
+            return self._bad(handler, 400, "bad_length")
+        if length <= 0 or length > MAX_BODY_BYTES:
+            return self._bad(handler, 413 if length > 0 else 400,
+                             "bad_length", f"Content-Length {length}")
+        raw = handler.rfile.read(length)
+        if len(raw) < length:
+            self._c_errors.inc()  # client disconnected mid-body
+            handler.close_connection = True
+            return
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError as e:
+            return self._bad(handler, 400, "bad_json", str(e))
+        if not isinstance(body, dict) or body.get("v") != PROTOCOL_VERSION:
+            return self._bad(
+                handler, 400, "bad_version",
+                f"this gateway speaks v{PROTOCOL_VERSION}",
+            )
+        policy = body.get("policy", "default")
+        try:
+            obs = np.asarray(body.get("obs"), dtype=np.float32)
+        except (TypeError, ValueError) as e:
+            return self._bad(handler, 400, "bad_obs", str(e))
+        expected = getattr(self.backend, "obs_shape", None)
+        if (
+            obs.ndim == 0
+            or obs.shape[0] < 1
+            or (expected is not None and obs.shape[1:] != tuple(expected))
+        ):
+            # Validated HERE, before submission: a malformed observation
+            # must never reach the batch coalescer where its failure would
+            # poison innocent co-batched actor requests.
+            return self._bad(
+                handler, 400, "bad_obs",
+                f"obs shape {obs.shape} != [B, *{tuple(expected or ())}]",
+            )
+        tenant_id = handler.headers.get(
+            "X-Tenant", body.get("tenant", DEFAULT_TENANT)
+        )
+        tenant = self._tenants.get(tenant_id, self._tenants[DEFAULT_TENANT])
+        deadline_raw = handler.headers.get(
+            "X-Deadline-Ms", body.get("deadline_ms")
+        )
+        try:
+            deadline_ms = (
+                float(deadline_raw)
+                if deadline_raw is not None
+                else self.default_deadline_ms
+            )
+        except (TypeError, ValueError):
+            return self._bad(handler, 400, "bad_deadline", str(deadline_raw))
+        if deadline_ms <= 0:
+            return self._bad(handler, 400, "bad_deadline",
+                             f"{deadline_ms} <= 0")
+
+        # ---- scripted chaos (after parse: the payload exists to corrupt)
+        if self._fault_request is not None:
+            try:
+                self._fault_request.fire(stop=lambda: self._fatal is not None)
+            except NetFault as fault:
+                probe = json.dumps({
+                    "v": PROTOCOL_VERSION, "endpoint": endpoint,
+                    "netfault": fault.mode,
+                }).encode()
+                if self._netfault(handler, fault, probe):
+                    return
+
+        # ---- drain gate
+        if self._draining:
+            self._c_shed.inc()
+            return self._send_json(
+                handler, 503,
+                {"v": PROTOCOL_VERSION, "error": "draining"},
+                headers={"Retry-After": "1"},
+            )
+
+        # ---- deadline feasibility: shed BEFORE a batch slot is occupied
+        estimate_ms = self.backend.latency_estimate_ms()
+        if estimate_ms > 0 and deadline_ms < estimate_ms:
+            self._c_deadline_shed.inc()
+            return self._send_json(
+                handler, 504,
+                {"v": PROTOCOL_VERSION, "error": "deadline_unattainable",
+                 "estimate_ms": round(estimate_ms, 3),
+                 "deadline_ms": deadline_ms},
+            )
+
+        # ---- tenant admission (token bucket, then the class SLO gate)
+        with trace.span(span_names.GATEWAY_ADMIT_WAIT):
+            retry_after = tenant.bucket.try_take()
+            if retry_after > 0:
+                self._c_shed.inc()
+                return self._send_json(
+                    handler, 429,
+                    {"v": PROTOCOL_VERSION, "error": "rate_limited",
+                     "tenant": tenant.cls.name},
+                    headers={"Retry-After": f"{retry_after:.3f}"},
+                )
+            try:
+                tenant.gate.admit()
+            except RequestShed as e:
+                self._c_shed.inc()
+                return self._send_json(
+                    handler, 429,
+                    {"v": PROTOCOL_VERSION, "error": "tenant_slo_shed",
+                     "tenant": tenant.cls.name, "detail": str(e)},
+                    headers={"Retry-After": "0.1"},
+                )
+            except ServerClosed:
+                # close_admissions() raced this request past the drain
+                # check: the closed tenant gate is the backstop.
+                self._c_shed.inc()
+                return self._send_json(
+                    handler, 503,
+                    {"v": PROTOCOL_VERSION, "error": "draining"},
+                    headers={"Retry-After": "1"},
+                )
+
+        # ---- serve (admitted: every exit below must finish/abandon)
+        try:
+            with trace.span(span_names.GATEWAY_SERVE):
+                remaining_ms = deadline_ms - 1e3 * (
+                    time.monotonic() - arrival
+                )
+                if remaining_ms <= 0:
+                    raise RequestShed("deadline spent before dispatch")
+                fn = (
+                    self.backend.evaluate
+                    if endpoint == "evaluate"
+                    else self.backend.act
+                )
+                actions, logp, generation = fn(policy, obs, remaining_ms)
+        except RequestShed as e:
+            tenant.gate.abandoned()
+            self._c_shed.inc()
+            return self._send_json(
+                handler, 429,
+                {"v": PROTOCOL_VERSION, "error": "overloaded",
+                 "detail": str(e)},
+                headers={"Retry-After": "0.1"},
+            )
+        except GatewayDegraded as e:
+            # The degrade path owns the admission closure: stale/fallback
+            # answers count as served (finished), shed un-counts
+            # (abandoned) — never both.
+            return self._degrade(handler, endpoint, tenant, policy, obs,
+                                 arrival, str(e))
+        # lint: broad-except-ok(per-request boundary: an infrastructure failure behind one request answers 500 and is counted; the serving loop and other requests are independent)
+        except Exception as e:
+            tenant.gate.abandoned()
+            self._c_errors.inc()
+            return self._send_json(
+                handler, 500,
+                {"v": PROTOCOL_VERSION, "error": "serve_failed",
+                 "detail": f"{type(e).__name__}: {e}"},
+            )
+        latency_ms = 1e3 * (time.monotonic() - arrival)
+        tenant.gate.finished(latency_ms)
+        self._send_json(handler, 200, {
+            "v": PROTOCOL_VERSION,
+            "endpoint": endpoint,
+            "actions": np.asarray(actions).tolist(),
+            "logp": np.asarray(logp).tolist(),
+            "generation": int(generation),
+            "latency_ms": round(latency_ms, 3),
+        })
+
+    def _degrade(self, handler, endpoint, tenant, policy, obs, arrival,
+                 reason: str) -> None:
+        """The backing core is unavailable: answer per the tenant's mode
+        (see module doc). The stale path that itself fails falls through
+        to shed — degradation degrades, it never 500s."""
+        mode = tenant.cls.mode
+        if mode == "stale":
+            try:
+                actions, logp, generation = self.backend.serve_stale(
+                    policy, obs
+                )
+            # lint: broad-except-ok(degradation must degrade, never 500: ANY stale-path failure — nothing anchored yet, or the jitted call itself dying with the core — falls through to an honest shed, which also closes the tenant-gate admission)
+            except Exception:
+                mode = "shed"
+            else:
+                self._c_stale.inc()
+                latency_ms = 1e3 * (time.monotonic() - arrival)
+                tenant.gate.finished(latency_ms)
+                return self._send_json(handler, 200, {
+                    "v": PROTOCOL_VERSION,
+                    "endpoint": endpoint,
+                    "actions": np.asarray(actions).tolist(),
+                    "logp": np.asarray(logp).tolist(),
+                    "generation": int(generation),
+                    "stale_generation": int(generation),
+                    "stale": True,
+                    "latency_ms": round(latency_ms, 3),
+                })
+        if mode == "fallback":
+            self._c_fallback.inc()
+            rows = int(obs.shape[0])
+            action = tenant.cls.fallback_action
+            tenant.gate.finished(1e3 * (time.monotonic() - arrival))
+            return self._send_json(handler, 200, {
+                "v": PROTOCOL_VERSION,
+                "endpoint": endpoint,
+                "actions": [action] * rows,
+                "logp": [0.0] * rows,
+                "generation": -1,
+                "fallback": True,
+            })
+        tenant.gate.abandoned()
+        self._c_shed.inc()
+        self._send_json(
+            handler, 503,
+            {"v": PROTOCOL_VERSION, "error": "degraded",
+             "detail": reason, "tenant": tenant.cls.name},
+            headers={"Retry-After": "1"},
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def fatal(self) -> BaseException | None:
+        """The latched cause of a gateway death (netfault crash, serving-
+        loop failure) — the trainer's supervisor reads this."""
+        return self._fatal
+
+    def close_admissions(self) -> None:
+        """The drain edge (runtime/durability.py): every subsequent
+        request answers 503 + Retry-After; in-flight requests finish.
+        Tenant SLO gates close too, so a request already past the drain
+        check still refuses at admission. Idempotent."""
+        self._draining = True
+        for state in self._tenants.values():
+            state.gate.close()
+
+    def reopen_admissions(self) -> None:
+        """The recover edge: a gateway that degraded (or a supervisor that
+        chose to reuse the instance) takes traffic again — tenant gates
+        reopen with their rolling latency windows intact. Idempotent."""
+        for state in self._tenants.values():
+            state.gate.reopen()
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def start(self) -> "ServeGateway":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._serve, name="gateway-http", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _serve(self) -> None:  # thread-entry: gateway-http@gateway
+        try:
+            self._httpd.serve_forever(poll_interval=0.2)
+        # lint: broad-except-ok(thread boundary: the cause latches for the supervisor, same contract as ServeCore.run)
+        except Exception as e:
+            self._fatal = e
+
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self) -> None:
+        """Shut down the serving loop and close the socket (idempotent).
+        The backend is NOT closed — it outlives gateway rebuilds so the
+        serve-stale anchor survives a gateway crash."""
+        thread, self._thread = self._thread, None
+        if thread is not None and thread.is_alive():
+            self._httpd.shutdown()
+            thread.join(timeout=2.0)
+        self._httpd.server_close()

@@ -76,13 +76,33 @@ class ParamSlots:
             del self._refs[gen]
             del self._slots[gen]
 
+    def _lease_locked(self, generation: int) -> tuple[Any, int]:  # holds: _cond
+        """ONE copy of the lease bookkeeping, shared by both lease paths
+        (latest-dispatch and specific-generation) so ref accounting can
+        never diverge between them."""
+        self._refs[generation] += 1
+        return self._slots[generation], generation
+
     def lease(self) -> tuple[Any, int]:
         """Pin the latest generation for one dispatch; returns
         ``(params, generation)``. Must be paired with :meth:`release`."""
         with self._cond:
-            gen = self._latest
-            self._refs[gen] += 1
-            return self._slots[gen], gen
+            return self._lease_locked(self._latest)
+
+    def lease_generation(self, generation: int) -> tuple[Any, int]:
+        """Pin a SPECIFIC resident generation (the gateway's serve-stale
+        anchor re-pins its last-good generation through this). Raises
+        ``RuntimeError`` when the generation has retired — a stale reader
+        must fail loudly rather than be handed whatever params now occupy
+        freed memory. Must be paired with :meth:`release`."""
+        with self._cond:
+            if generation not in self._slots:
+                raise RuntimeError(
+                    f"ParamSlots.lease_generation({generation}): that "
+                    f"generation is retired (resident: {sorted(self._slots)})"
+                    " — the slot's params were freed and must not be served"
+                )
+            return self._lease_locked(generation)
 
     def release(self, generation: int) -> None:
         """Drop one lease on ``generation``; retires the slot when it is

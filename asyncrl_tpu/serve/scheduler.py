@@ -78,6 +78,11 @@ from asyncrl_tpu.utils import faults
 DISPATCH_FULL_COUNTER = "serve_dispatch_full"
 DISPATCH_DEADLINE_COUNTER = "serve_dispatch_deadline"
 
+# The client id external (gateway) requests carry: never a registered
+# slot, so it cannot collide with an actor index and never counts toward
+# a policy's slab-full fill target.
+EXTERNAL_CLIENT = -1
+
 
 class _Request:
     """One in-flight client request. Ownership protocol: the fields below
@@ -252,7 +257,7 @@ class ServeCore(threading.Thread):
             del params  # the router serves the policy's latest generation
             out = self._submit(
                 index, policy, (np.asarray(obs), *rest), deadline_s
-            )
+            ).result
             if self._mode in ("rec", "rec_eps"):
                 actions, logp, core = out
                 return actions, logp, key, core
@@ -260,6 +265,42 @@ class ServeCore(threading.Thread):
             return actions, logp, key
 
         return call
+
+    def submit_external(
+        self, policy: str, args: tuple, deadline_ms: float
+    ) -> tuple[Any, int]:
+        """One EXTERNAL request (the gateway's path) through the
+        continuous batch. Unlike :meth:`client`, no client slot registers:
+        the slab-full dispatch target stays actor-owned, so an idle
+        gateway never holds a training batch open for a request that is
+        not coming — external rows coalesce opportunistically into the
+        next dispatch of their policy (an actor slab-full, or their own
+        deadline flush when actors are quiet). ``deadline_ms`` is the
+        REMAINING wire budget, propagated from the request header — it
+        CAPS the batch-fill hold: the hold is normally the core's own
+        coalescing window (``serve_deadline_ms``, milliseconds not
+        seconds — a latency budget, not a wire budget), shortened when
+        the wire budget is tighter, so an external request is answered
+        at coalescing latency while never being held past its deadline.
+        Returns ``(result, generation)`` — the param generation the
+        serving batch leased, for response stamping."""
+        if deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        request = self._submit(
+            EXTERNAL_CLIENT, policy, args,
+            min(deadline_ms / 1e3, self._deadline_s),
+        )
+        return request.result, request.generation
+
+    def serving(self) -> bool:
+        """Is the core able to take NEW requests right now? (The gateway's
+        degradation probe: alive thread, stop not requested, admission
+        gate open.)"""
+        return (
+            self.is_alive()
+            and not self._stop_event.is_set()
+            and not self._slo.closed
+        )
 
     def _closed(self) -> bool:
         return self._stop_event.is_set() or not self.is_alive()
@@ -306,9 +347,11 @@ class ServeCore(threading.Thread):
             self._slo.abandoned()
             raise ServerClosed("serve core stopped")
         # Served: close the SLO accounting with the true client-observed
-        # latency (queue + fill + dispatch + slicing).
+        # latency (queue + fill + dispatch + slicing). Returns the request
+        # itself: the in-process client unpacks .result; the gateway path
+        # also reads .generation for wire stamping.
         self._slo.finished(1e3 * (time.monotonic() - request.arrival))
-        return request.result
+        return request
 
     # ------------------------------------------------------------- server
 
@@ -379,7 +422,16 @@ class ServeCore(threading.Thread):
                     ]
                     rows = sum(r.rows for r in group)
                     target = self._policy_clients_locked(policy)
-                    if target and len(group) >= target:
+                    # Only REGISTERED clients count toward the slab-full
+                    # target: an external (gateway) request rides along
+                    # but must never make a batch read as "full" while an
+                    # actor's request is still coming — that would split
+                    # actor cohorts and strand the straggler on its own
+                    # deadline flush under wire load.
+                    members = sum(
+                        1 for r in group if r.client != EXTERNAL_CLIENT
+                    )
+                    if target and members >= target:
                         reason = "full"
                         break
                     if self._max_rows and rows >= self._max_rows:

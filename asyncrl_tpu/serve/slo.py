@@ -86,6 +86,7 @@ class SLOGate:
         max_inflight: int = 0,
         shed: bool = False,
         window: int = 512,
+        metrics_prefix: str = "",
     ):
         if p95_target_ms < 0:
             raise ValueError(f"p95_target_ms must be >= 0: {p95_target_ms}")
@@ -111,13 +112,29 @@ class SLOGate:
         # Cached rolling p95 (ms), refreshed only where the window
         # mutates — the admit path reads it O(1).
         self._p95_cache = 0.0  # guarded-by: _cond
-        self._counter_overload = obs_registry.counter(OVERLOAD_COUNTER)
-        self._counter_shed = obs_registry.counter(SHED_COUNTER)
-        self._histogram = obs_registry.histogram(LATENCY_HISTOGRAM)
+        # ``metrics_prefix`` re-homes this gate's instruments (the
+        # gateway's per-tenant SLO classes export
+        # ``gateway_<tenant>_latency_ms_p95`` etc. instead of folding
+        # into the serve core's counters); empty keeps the historical
+        # serve-core names bit-for-bit.
+        p = metrics_prefix
+        self._counter_overload = obs_registry.counter(
+            f"{p}_overload" if p else OVERLOAD_COUNTER
+        )
+        self._counter_shed = obs_registry.counter(
+            f"{p}_shed" if p else SHED_COUNTER
+        )
+        self._histogram = obs_registry.histogram(
+            f"{p}_latency_ms" if p else LATENCY_HISTOGRAM
+        )
         # Health-detector feed (module docstring): rolling p95 + breach
         # flag as gauges, refreshed where the rolling window recomputes.
-        self._gauge_p95 = obs_registry.gauge(P95_GAUGE)
-        self._gauge_breach = obs_registry.gauge(BREACH_GAUGE)
+        self._gauge_p95 = obs_registry.gauge(
+            f"{p}_p95_rolling_ms" if p else P95_GAUGE
+        )
+        self._gauge_breach = obs_registry.gauge(
+            f"{p}_slo_breached" if p else BREACH_GAUGE
+        )
 
     # ------------------------------------------------------------ metrics
 
@@ -246,12 +263,24 @@ class SLOGate:
             self._cond.notify_all()
 
     def close(self) -> None:
-        """Stop admitting (preemption drain, runtime/durability.py):
-        every waiting and future :meth:`admit` raises ``ServerClosed``;
-        in-flight requests complete and :meth:`finished` normally. One-way
-        — a closed gate belongs to a run that is exiting."""
+        """Stop admitting (preemption drain, runtime/durability.py;
+        gateway degradation, serve/gateway.py): every waiting and future
+        :meth:`admit` raises ``ServerClosed``; in-flight requests complete
+        and :meth:`finished` normally. Idempotent, and reversible via
+        :meth:`reopen` — a drain that ends in process exit simply never
+        reopens, while a gateway that degrades-then-recovers does."""
         with self._cond:
             self._closed = True
+            self._cond.notify_all()
+
+    def reopen(self) -> None:
+        """Resume admissions after :meth:`close` (the degrade-then-recover
+        edge: a rebuilt gateway or a resumed serve core must be able to
+        take traffic again without constructing a fresh gate and losing
+        the rolling latency window). Idempotent; a no-op on a gate that
+        was never closed."""
+        with self._cond:
+            self._closed = False
             self._cond.notify_all()
 
     @property

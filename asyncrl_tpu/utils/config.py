@@ -210,6 +210,33 @@ class Config:
     # not crash; shed mode is for external-traffic front-ends that own a
     # retry policy.
     serve_shed: bool = False
+    # --- external gateway (asyncrl_tpu/serve/gateway.py) ---
+    # Wire frontier over the serve core: /v1/act + /v1/evaluate on a
+    # versioned JSON protocol with deadline propagation, per-tenant SLO
+    # classes, and graceful degradation. 0 = off — NOTHING constructs
+    # (zero threads, zero registry keys, loss-bit-identical; the
+    # introspect=False discipline, pinned by scripts/gateway_smoke.sh
+    # act 1); -1 = bind an OS-assigned ephemeral port (tests/smokes read
+    # it back from the handle), positive = bind exactly there. Requires
+    # inference_server=True and the serve core (the gateway routes
+    # through ServeCore's continuous batch).
+    gateway_port: int = 0
+    # Bind host for the gateway's socket; loopback by default — exposing
+    # beyond the host is a deliberate operator decision.
+    # ASYNCRL_GATEWAY_HOST wins when set (obs_http_host has the matching
+    # ASYNCRL_OBS_HOST knob).
+    gateway_host: str = "127.0.0.1"
+    # Default end-to-end budget for requests that carry no X-Deadline-Ms
+    # header; the remaining budget propagates into the serve core's
+    # batch-fill deadline, and a request that cannot make it is shed
+    # before it occupies a batch slot.
+    gateway_deadline_ms: float = 1000.0
+    # Per-tenant SLO classes: "name:mode[:k=v,...]" ';'-separated
+    # (serve/gateway.py grammar; modes shed|stale|fallback, options
+    # p95_ms, inflight, rps, burst, fallback). Empty = one permissive
+    # shed-mode class every tenant folds into. The "*" class catches
+    # unmatched tenant ids.
+    gateway_tenant_spec: str = ""
     # Zero-copy overlapped actor→learner data path (rollout/staging.py):
     # actors write fragments straight into preallocated pinned staging
     # slabs (no per-fragment emit copy, no per-drain np.stack) and the
@@ -375,6 +402,10 @@ class Config:
     # (tests/smoke harnesses read it back from the handle), positive =
     # bind exactly there (127.0.0.1). ASYNCRL_OBS_PORT wins when set.
     obs_http_port: int = 0
+    # Bind host for the exposition endpoint (obs/http.py always took a
+    # bind_host; this makes it configurable). Loopback default;
+    # ASYNCRL_OBS_HOST wins when set.
+    obs_http_host: str = "127.0.0.1"
     # Per-window samples retained in the in-memory time-series ring
     # (drop-oldest; the timeseries.jsonl persistence is unbounded).
     obs_timeseries_cap: int = 4096

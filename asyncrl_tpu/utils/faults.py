@@ -11,6 +11,7 @@ registry of *named fault sites* threaded through the hot paths —
 - ``server.serve``      each InferenceServer batched serve
 - ``serve.dispatch``    each ServeCore batched dispatch (serve/scheduler.py)
 - ``serve.swap``        each PolicyRouter param publish (serve/router.py)
+- ``gateway.request``   each external gateway request (serve/gateway.py)
 - ``pool.step``         inside the host env pool's batched step
 - ``checkpoint.save``   each Checkpointer save attempt
 - ``checkpoint.restore``each Checkpointer restore attempt
@@ -18,10 +19,13 @@ registry of *named fault sites* threaded through the hot paths —
 each able to inject a **crash** (raise ``InjectedFault``), a configurable
 **stall** (sleep, interruptible by the caller's stop predicate),
 **payload corruption** (NaN-poison / bit-flip a value flowing through the
-site), or a scripted **scale** event (enqueue a fleet grow/shrink request
+site), a scripted **scale** event (enqueue a fleet grow/shrink request
 the elastic runtime drains at the next window close — the chaos grammar
 driving deliberate elasticity instead of a death; see
-``asyncrl_tpu/runtime/elastic.py``). Whether a given call fires is decided
+``asyncrl_tpu/runtime/elastic.py``), or a scripted **netfault** (a wire
+failure the gateway enacts: client disconnect mid-request, slow-loris
+body, malformed payload, gateway crash — ``net=`` picks the mode; see
+``asyncrl_tpu/serve/gateway.py``). Whether a given call fires is decided
 by a per-site ``random.Random(seed)`` stream against ``prob`` — fully
 deterministic for a fixed call sequence, independent of wall clock and of
 other sites.
@@ -72,12 +76,19 @@ SITES = (
     "server.serve",
     "serve.dispatch",
     "serve.swap",
+    "gateway.request",
     "pool.step",
     "checkpoint.save",
     "checkpoint.restore",
 )
 
-KINDS = ("crash", "stall", "corrupt", "scale", "preempt")
+KINDS = ("crash", "stall", "corrupt", "scale", "preempt", "netfault")
+
+# What a ``netfault`` fire scripts at the wire boundary (serve/gateway.py
+# interprets the raised :class:`NetFault`): a client vanishing mid-request,
+# a slow-loris response stall, a malformed payload on the wire, or the
+# gateway process face dying mid-flight. The ``net=`` option picks one.
+NETFAULT_MODES = ("disconnect", "slowloris", "malformed", "crash")
 
 ENV_VAR = "ASYNCRL_FAULTS"
 
@@ -120,6 +131,21 @@ class InjectedFault(RuntimeError):
     worker failure, never special-case it (that would test nothing)."""
 
 
+class NetFault(RuntimeError):
+    """The netfault kind: raised out of ``gateway.request`` carrying the
+    scripted wire-failure mode. The GATEWAY interprets it (the one
+    legitimate special-case: a netfault is a scripted network condition to
+    enact — disconnect the socket, stall the body, corrupt the payload,
+    kill the serving thread — not a worker failure to recover from at the
+    fire site)."""
+
+    def __init__(self, mode: str, detail: str = ""):
+        super().__init__(
+            f"injected netfault mode={mode!r}" + (f" ({detail})" if detail else "")
+        )
+        self.mode = mode
+
+
 class FaultSpecError(ValueError):
     """A malformed ``ASYNCRL_FAULTS`` / ``config.fault_spec`` string."""
 
@@ -139,6 +165,7 @@ class FaultSite:
         stall_s: float = 1.0,
         after: int = 0,
         delta: int = 1,
+        net: str = "disconnect",
     ):
         if name not in SITES:
             raise FaultSpecError(
@@ -154,6 +181,19 @@ class FaultSite:
             raise FaultSpecError(f"fault 'after' must be >= 0, got {after}")
         if delta == 0:
             raise FaultSpecError("fault 'delta' must be nonzero")
+        if net not in NETFAULT_MODES:
+            raise FaultSpecError(
+                f"unknown netfault mode {net!r}; have {NETFAULT_MODES}"
+            )
+        if kind == "netfault" and name != "gateway.request":
+            # Only the gateway interprets NetFault; anywhere else the
+            # raise would masquerade as a worker crash and the scripted
+            # wire condition would silently test nothing (the same
+            # refuse-eagerly rule as delta on non-scale kinds).
+            raise FaultSpecError(
+                f"fault spec: the netfault kind only applies to the "
+                f"'gateway.request' site, got {name!r}"
+            )
         self.name = name
         self.kind = kind
         self.prob = prob
@@ -161,6 +201,7 @@ class FaultSite:
         self.stall_s = stall_s
         self.after = after
         self.delta = delta
+        self.net = net
         # zlib.crc32, not hash(): str hashing is salted per process and
         # would silently break cross-run determinism.
         self._rng = random.Random(seed ^ zlib.crc32(name.encode()))  # guarded-by: _lock
@@ -206,6 +247,9 @@ class FaultSite:
         - scale: enqueues one scripted fleet-scale request of ``delta``
           (drained by the elastic controller at the next window close);
           the site itself never perturbs the firing thread.
+        - netfault: raises :class:`NetFault` carrying the scripted wire
+          mode (``net=`` option); the gateway's request handler enacts
+          it — see serve/gateway.py.
         """
         ordinal = self._should_fire()
         if not ordinal:
@@ -239,6 +283,15 @@ class FaultSite:
         if self.kind == "scale":
             request_scale(self.delta)
             return payload
+        if self.kind == "netfault":
+            # Raised to the GATEWAY's request handler, which enacts the
+            # scripted wire condition (serve/gateway.py); the mode rides
+            # the exception. stall_s doubles as the slow-loris stall.
+            raise NetFault(
+                self.net,
+                detail=f"fire {ordinal}/{self.max_fires or 'inf'} in "
+                f"thread {threading.current_thread().name!r}",
+            )
         if self.kind == "preempt":
             # Scripted SIGTERM-under-load: delivered through the REAL
             # signal machinery when train()'s drain handler is installed
@@ -312,6 +365,7 @@ def parse_spec(spec: str) -> list[FaultSite]:
         stall_s = 1.0
         after = 0
         delta: int | None = None
+        net: str | None = None
         for extra in fields[4:]:
             for kv in extra.split(","):
                 kv = kv.strip()
@@ -323,10 +377,10 @@ def parse_spec(spec: str) -> list[FaultSite]:
                     )
                 k, v = kv.split("=", 1)
                 k = k.strip()
-                if k not in ("max", "stall_s", "after", "delta"):
+                if k not in ("max", "stall_s", "after", "delta", "net"):
                     raise FaultSpecError(
                         f"fault spec {chunk!r}: unknown option {k!r} "
-                        "(have max, stall_s, after, delta)"
+                        "(have max, stall_s, after, delta, net)"
                     )
                 try:
                     if k == "max":
@@ -335,6 +389,8 @@ def parse_spec(spec: str) -> list[FaultSite]:
                         stall_s = float(v)
                     elif k == "after":
                         after = int(v)
+                    elif k == "net":
+                        net = v.strip()
                     else:
                         delta = int(v)
                 except ValueError as e:
@@ -346,10 +402,16 @@ def parse_spec(spec: str) -> list[FaultSite]:
                 f"fault spec {chunk!r}: option 'delta' only applies to "
                 "the scale kind"
             )
+        if net is not None and kind != "netfault":
+            raise FaultSpecError(
+                f"fault spec {chunk!r}: option 'net' only applies to "
+                "the netfault kind"
+            )
         sites.append(
             FaultSite(name, kind, prob, seed, max_fires=max_fires,
                       stall_s=stall_s, after=after,
-                      delta=1 if delta is None else delta)
+                      delta=1 if delta is None else delta,
+                      net="disconnect" if net is None else net)
         )
     return sites
 

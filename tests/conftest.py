@@ -131,6 +131,13 @@ QUICK: dict[str, object] = {
     # fault-injected flight-recorder acceptance run and the disabled-mode
     # window check) are ~10s combined. Whole file ~15s.
     "test_obs.py": "all",
+    # External gateway (serve/gateway.py + client.py, ISSUE 15): grammar/
+    # breaker/retry units are sub-second (clock-injected, no sleeps);
+    # the wire-level tests run against a stub backend on an ephemeral
+    # port; the two trainer e2e chaos runs (live swaps over the wire,
+    # netfault-crash rebuild without dropping actors) are ~15s combined
+    # and ARE the ISSUE 15 acceptance contract. Whole file ~20s.
+    "test_gateway.py": "all",
     # Device replay ring + IMPACT learner (learn/replay.py, ISSUE 14):
     # the lease-protocol units (fencing/sampling/ledger/quarantine) are
     # ~1s each against a tiny ring; the trainer e2e pair (off-identity,

@@ -1,0 +1,807 @@
+"""External serving gateway (asyncrl_tpu/serve/gateway.py + client.py):
+wire protocol, deadline propagation, per-tenant SLO classes, retry/backoff
++ circuit breaking, graceful degradation under a dead core, netfault
+chaos, and the SebulbaTrainer mount (off = bit-identical nothing;
+supervised rebuild never drops the actor fleet)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from asyncrl_tpu import make_agent
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.rollout.sebulba import ParamStore
+from asyncrl_tpu.serve import (
+    BreakerOpen,
+    CircuitBreaker,
+    CoreBackend,
+    GatewayClient,
+    GatewayDegraded,
+    GatewayShed,
+    GatewaySpecError,
+    GatewayUnavailable,
+    ServeCore,
+    ServeGateway,
+    TenantClass,
+    parse_tenant_spec,
+)
+from asyncrl_tpu.serve.client import CLOSED, HALF_OPEN, OPEN
+from asyncrl_tpu.utils import faults
+from asyncrl_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs_registry.registry().reset()
+    yield
+    obs_registry.registry().reset()
+    faults.disarm()
+
+
+def _det_fn(params, obs, key):
+    bias = params["bias"]
+    return obs[:, 0].astype(jnp.int32), obs[:, 0] * 0.0 + bias, key
+
+
+class _StubBackend:
+    """Deterministic backend for wire-level tests: no core, no jax."""
+
+    obs_shape = (4,)
+
+    def __init__(self, estimate_ms=0.0, fail=False, stale_gen=None):
+        self.estimate_ms = estimate_ms
+        self.fail = fail
+        self.stale_gen = stale_gen
+        self.calls = []
+
+    def latency_estimate_ms(self):
+        return self.estimate_ms
+
+    def act(self, policy, obs, deadline_ms):
+        self.calls.append(("act", policy, obs.shape, deadline_ms))
+        if self.fail:
+            raise GatewayDegraded("stub core down")
+        rows = obs.shape[0]
+        return (
+            obs[:, 0].astype(np.int32),
+            np.zeros(rows, np.float32),
+            7,
+        )
+
+    def evaluate(self, policy, obs, deadline_ms):
+        self.calls.append(("evaluate", policy, obs.shape, deadline_ms))
+        if self.fail:
+            raise GatewayDegraded("stub core down")
+        return obs[:, 0].astype(np.int32), np.ones(obs.shape[0]), 7
+
+    def serve_stale(self, policy, obs):
+        if self.stale_gen is None:
+            raise GatewayDegraded("nothing anchored")
+        rows = obs.shape[0]
+        return (
+            np.full(rows, 3, np.int32),
+            np.zeros(rows, np.float32),
+            self.stale_gen,
+        )
+
+
+def _post(port, path, doc, headers=None):
+    request = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=10) as response:
+            return response.status, dict(response.headers), json.loads(
+                response.read()
+            )
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            doc = {"raw": body.decode(errors="replace")}
+        return e.code, dict(e.headers), doc
+
+
+# ------------------------------------------------------------ tenant grammar
+
+
+def test_tenant_spec_grammar_and_defaults():
+    tenants = parse_tenant_spec(
+        "gold:stale:p95_ms=50,inflight=8;bulk:shed:rps=100,burst=20;"
+        "edge:fallback:fallback=2"
+    )
+    assert tenants["gold"].mode == "stale"
+    assert tenants["gold"].p95_ms == 50.0 and tenants["gold"].inflight == 8
+    assert tenants["bulk"].rps == 100.0 and tenants["bulk"].burst == 20
+    assert tenants["edge"].fallback_action == 2
+    # The catch-all class is always present.
+    assert "*" in tenants and tenants["*"].mode == "shed"
+    assert parse_tenant_spec("")["*"].mode == "shed"
+
+
+@pytest.mark.parametrize("bad", [
+    "gold",                      # no mode
+    "gold:teleport",             # unknown mode
+    "gold:shed:rps",             # option not k=v
+    "gold:shed:nope=1",          # unknown option
+    "gold:shed:rps=fast",        # unparseable value
+    "gold:shed;gold:stale",      # duplicate tenant
+    ":shed",                     # empty name
+    "gold:shed:burst=0",         # burst < 1
+    "catchall:shed",             # squats the '*' class's reserved prefix
+    "a-b:shed;a.b:stale",        # sanitize to the same metric prefix
+])
+def test_tenant_spec_malformed_raises(bad):
+    with pytest.raises(GatewaySpecError):
+        parse_tenant_spec(bad)
+
+
+def test_netfault_fault_grammar():
+    """The chaos grammar's netfault kind: net= option parses, validates
+    its mode, and is refused on any other kind."""
+    (site,) = faults.parse_spec(
+        "gateway.request:netfault:1.0:0:net=slowloris,max=2,stall_s=0.2"
+    )
+    assert site.kind == "netfault" and site.net == "slowloris"
+    assert site.max_fires == 2 and site.stall_s == 0.2
+    with pytest.raises(faults.FaultSpecError, match="netfault"):
+        faults.parse_spec("actor.step:crash:1.0:0:net=disconnect")
+    with pytest.raises(faults.FaultSpecError, match="mode"):
+        faults.parse_spec("gateway.request:netfault:1.0:0:net=teleport")
+    # The kind is site-bound: anywhere but the gateway, the raise would
+    # masquerade as a worker crash and test nothing wire-related.
+    with pytest.raises(faults.FaultSpecError, match="gateway.request"):
+        faults.parse_spec("serve.dispatch:netfault:1.0:0")
+    # The raised NetFault carries the mode for the gateway to enact.
+    with pytest.raises(faults.NetFault) as info:
+        faults.parse_spec("gateway.request:netfault:1.0:0")[0].fire()
+    assert info.value.mode == "disconnect"
+
+
+# --------------------------------------------------------------- wire level
+
+
+def test_act_and_evaluate_roundtrip_and_protocol_versioning():
+    backend = _StubBackend()
+    gateway = ServeGateway(backend, port=-1).start()
+    try:
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[5, 0, 0, 0]]}
+        )
+        assert status == 200
+        assert doc["actions"] == [5] and doc["generation"] == 7
+        assert doc["endpoint"] == "act" and doc["v"] == 1
+        status, _, doc = _post(
+            gateway.port, "/v1/evaluate", {"v": 1, "obs": [[2, 0, 0, 0]]}
+        )
+        assert status == 200 and doc["endpoint"] == "evaluate"
+        assert doc["logp"] == [1.0]
+        # Versioning: a v2 request is refused, not misinterpreted.
+        status, _, doc = _post(gateway.port, "/v1/act",
+                               {"v": 2, "obs": [[0, 0, 0, 0]]})
+        assert status == 400 and doc["error"] == "bad_version"
+        # Unknown routes and malformed bodies answer 4xx, never 500.
+        status, _, _ = _post(gateway.port, "/v1/nope", {"v": 1})
+        assert status == 404
+        status, _, doc = _post(gateway.port, "/v1/act",
+                               {"v": 1, "obs": [[1, 2]]})
+        assert status == 400 and doc["error"] == "bad_obs"
+        window = obs_registry.window()
+        # 4 requests reached an endpoint (the unknown route 404s before
+        # endpoint accounting); 3 were client errors, none were 500s.
+        assert window["gateway_requests"] == 4.0
+        assert window["gateway_bad_requests"] == 3.0
+        assert window["gateway_errors"] == 0.0
+    finally:
+        gateway.stop()
+
+
+def test_deadline_infeasible_sheds_before_occupying_a_slot():
+    """A request whose budget is below the core's rolling p95 estimate is
+    refused at the door (504) — the backend is never called."""
+    backend = _StubBackend(estimate_ms=200.0)
+    gateway = ServeGateway(backend, port=-1).start()
+    try:
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Deadline-Ms": "50"},
+        )
+        assert status == 504 and doc["error"] == "deadline_unattainable"
+        assert backend.calls == []
+        assert obs_registry.window()["gateway_deadline_shed"] == 1.0
+        # A feasible budget passes, and the REMAINING budget propagates.
+        status, _, _ = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Deadline-Ms": "500"},
+        )
+        assert status == 200
+        assert backend.calls[0][3] <= 500.0
+    finally:
+        gateway.stop()
+
+
+def test_tenant_token_bucket_sheds_with_retry_after():
+    tenants = parse_tenant_spec("bulk:shed:rps=0.5,burst=1")
+    gateway = ServeGateway(_StubBackend(), port=-1, tenants=tenants).start()
+    try:
+        ok, _, _ = _post(gateway.port, "/v1/act",
+                         {"v": 1, "obs": [[0, 0, 0, 0]]},
+                         headers={"X-Tenant": "bulk"})
+        assert ok == 200
+        status, headers, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Tenant": "bulk"},
+        )
+        assert status == 429 and doc["error"] == "rate_limited"
+        assert float(headers["Retry-After"]) > 0
+        # Another tenant's bucket is untouched: starvation-free across
+        # classes by construction.
+        ok, _, _ = _post(gateway.port, "/v1/act",
+                         {"v": 1, "obs": [[0, 0, 0, 0]]})
+        assert ok == 200
+        assert obs_registry.window()["gateway_shed"] == 1.0
+    finally:
+        gateway.stop()
+
+
+def test_degradation_modes_shed_stale_fallback():
+    """All three per-tenant degradation modes against a dead core: shed
+    answers 503 + Retry-After, stale serves the anchored generation
+    stamped stale_generation, fallback serves the configured constant."""
+    backend = _StubBackend(fail=True, stale_gen=41)
+    tenants = parse_tenant_spec(
+        "s:shed;g:stale;f:fallback:fallback=2"
+    )
+    gateway = ServeGateway(backend, port=-1, tenants=tenants).start()
+    try:
+        status, headers, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Tenant": "s"},
+        )
+        assert status == 503 and doc["error"] == "degraded"
+        assert "Retry-After" in headers
+
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]] * 2},
+            headers={"X-Tenant": "g"},
+        )
+        assert status == 200
+        assert doc["stale"] is True and doc["stale_generation"] == 41
+        assert doc["actions"] == [3, 3]
+
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]] * 3},
+            headers={"X-Tenant": "f"},
+        )
+        assert status == 200
+        assert doc["fallback"] is True and doc["actions"] == [2, 2, 2]
+        assert doc["generation"] == -1
+
+        window = obs_registry.window()
+        assert window["gateway_stale_served"] == 1.0
+        assert window["gateway_fallback_served"] == 1.0
+        assert window["gateway_shed"] == 1.0
+        # Admission accounting balanced on every path: nothing inflight.
+        for state in gateway._tenants.values():
+            assert state.gate.inflight() == 0
+    finally:
+        gateway.stop()
+
+
+def test_stale_mode_with_nothing_anchored_sheds_honestly():
+    backend = _StubBackend(fail=True, stale_gen=None)
+    gateway = ServeGateway(
+        backend, port=-1, tenants=parse_tenant_spec("g:stale")
+    ).start()
+    try:
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Tenant": "g"},
+        )
+        assert status == 503 and doc["error"] == "degraded"
+    finally:
+        gateway.stop()
+
+
+def test_drain_close_and_reopen_admissions():
+    gateway = ServeGateway(_StubBackend(), port=-1).start()
+    try:
+        gateway.close_admissions()
+        gateway.close_admissions()  # idempotent
+        status, headers, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]}
+        )
+        assert status == 503 and doc["error"] == "draining"
+        assert headers["Retry-After"] == "1"
+        gateway.reopen_admissions()
+        status, _, _ = _post(gateway.port, "/v1/act",
+                             {"v": 1, "obs": [[0, 0, 0, 0]]})
+        assert status == 200
+    finally:
+        gateway.stop()
+
+
+# ------------------------------------------------------------ breaker machine
+
+
+def test_circuit_breaker_state_machine_deterministic():
+    """closed -> open on consecutive failures; open refuses without I/O
+    until reset_s; half-open admits exactly ONE probe; probe success
+    closes (counts reset), probe failure re-opens with a fresh clock."""
+    clock = {"t": 0.0}
+    breaker = CircuitBreaker(
+        "act", failures=3, reset_s=5.0, clock=lambda: clock["t"]
+    )
+    assert breaker.state == CLOSED
+    for _ in range(2):
+        breaker.before_call()
+        breaker.record_failure()
+    assert breaker.state == CLOSED  # 2 < 3: still closed
+    breaker.before_call()
+    breaker.record_failure()  # third consecutive -> open
+    assert breaker.state == OPEN
+    with pytest.raises(BreakerOpen, match="circuit open"):
+        breaker.before_call()
+    clock["t"] = 4.9
+    with pytest.raises(BreakerOpen):
+        breaker.before_call()  # still inside reset_s
+    clock["t"] = 5.0
+    assert breaker.state == HALF_OPEN
+    breaker.before_call()  # the one probe
+    with pytest.raises(BreakerOpen, match="probe in flight"):
+        breaker.before_call()  # concurrent call during the probe: refused
+    breaker.record_failure()  # probe failed -> open again, fresh clock
+    assert breaker.state == OPEN
+    clock["t"] = 9.9
+    with pytest.raises(BreakerOpen):
+        breaker.before_call()
+    clock["t"] = 10.0
+    breaker.before_call()  # probe #2
+    breaker.record_success(1.0)
+    assert breaker.state == CLOSED
+    # A success resets the consecutive count completely.
+    breaker.before_call()
+    breaker.record_failure()
+    assert breaker.state == CLOSED
+    window = obs_registry.window()
+    assert window["gateway_breaker_opened"] == 2.0
+    assert window["gateway_breaker_act"] == 0.0  # closed again
+
+
+def test_circuit_breaker_latency_breach_counts_as_failure():
+    clock = {"t": 0.0}
+    breaker = CircuitBreaker(
+        "evaluate", failures=2, reset_s=1.0, latency_ms=100.0,
+        clock=lambda: clock["t"],
+    )
+    breaker.before_call()
+    breaker.record_success(500.0)  # answered, but 5x over the bar
+    breaker.before_call()
+    breaker.record_success(500.0)
+    assert breaker.state == OPEN
+    assert obs_registry.window()["gateway_breaker_evaluate"] == 2.0
+
+
+def test_client_retry_backoff_is_bounded_jittered_and_budgeted():
+    """Transport failures retry with exponential backoff (deterministic
+    jitter in [0.5, 1.5)), stop at the retry bound, and never sleep past
+    the deadline budget."""
+    attempts = []
+    sleeps = []
+
+    def flaky_transport(path, body, headers, timeout_s):
+        attempts.append(path)
+        if len(attempts) < 3:
+            raise ConnectionRefusedError("down")
+        return 200, {}, json.dumps(
+            {"v": 1, "actions": [1], "logp": [0.0], "generation": 4}
+        ).encode()
+
+    client = GatewayClient(
+        "http://127.0.0.1:1", retries=3, backoff_base_s=0.1,
+        backoff_cap_s=10.0, seed=7,
+        transport=flaky_transport, sleep=sleeps.append,
+    )
+    result = client.act(np.zeros((1, 4), np.float32))
+    assert result.generation == 4 and result.attempts == 3
+    assert len(sleeps) == 2
+    # Exponential spine x jitter: attempt i sleeps base*2^i * [0.5, 1.5).
+    assert 0.05 <= sleeps[0] < 0.15
+    assert 0.10 <= sleeps[1] < 0.30
+    assert obs_registry.window()["gateway_client_retries"] == 2.0
+
+    # Bounded: retries exhausted -> the LAST failure propagates.
+    attempts.clear()
+
+    def dead_transport(path, body, headers, timeout_s):
+        attempts.append(path)
+        raise ConnectionRefusedError("always down")
+
+    client = GatewayClient(
+        "http://127.0.0.1:1", retries=2, backoff_base_s=0.001,
+        transport=dead_transport, sleep=lambda s: None,
+    )
+    with pytest.raises(GatewayUnavailable):
+        client.act(np.zeros((1, 4), np.float32))
+    assert len(attempts) == 3  # 1 + 2 retries
+
+    # Budgeted: a spent deadline stops retrying even with retries left.
+    clock = {"t": 0.0}
+
+    def slow_clock_transport(path, body, headers, timeout_s):
+        clock["t"] += 10.0  # each attempt burns 10s
+        raise ConnectionRefusedError("down")
+
+    client = GatewayClient(
+        "http://127.0.0.1:1", retries=50, deadline_ms=15_000,
+        transport=slow_clock_transport, sleep=lambda s: None,
+        clock=lambda: clock["t"],
+    )
+    with pytest.raises(GatewayUnavailable):
+        client.act(np.zeros((1, 4), np.float32))
+    assert clock["t"] <= 20.0  # two attempts max inside a 15s budget
+
+
+def test_client_wrong_typed_200_is_unavailable_not_a_raw_typeerror():
+    """A 200 whose fields coerce badly (generation: null from a torn
+    server) must surface as GatewayUnavailable THROUGH the breaker
+    bookkeeping — a raw TypeError escaping _call would skip
+    record_failure and permanently wedge a half-open probe."""
+
+    def torn_transport(path, body, headers, timeout_s):
+        return 200, {}, b'{"v": 1, "actions": [1], "generation": null}'
+
+    client = GatewayClient(
+        "http://127.0.0.1:1", retries=1, breaker_failures=2,
+        transport=torn_transport, sleep=lambda s: None,
+    )
+    with pytest.raises(GatewayUnavailable, match="unparseable"):
+        client.act(np.zeros((1, 4), np.float32))
+    # Both attempts recorded as failures: the breaker opened.
+    assert client.breakers["act"].state == OPEN
+
+
+def test_client_breaker_opens_and_refuses_then_probes():
+    calls = []
+
+    def dead_transport(path, body, headers, timeout_s):
+        calls.append(path)
+        raise ConnectionRefusedError("down")
+
+    clock = {"t": 0.0}
+    client = GatewayClient(
+        "http://127.0.0.1:1", retries=0, breaker_failures=2,
+        breaker_reset_s=5.0, transport=dead_transport,
+        sleep=lambda s: None, clock=lambda: clock["t"],
+    )
+    for _ in range(2):
+        with pytest.raises(GatewayUnavailable):
+            client.act(np.zeros((1, 4), np.float32))
+    with pytest.raises(BreakerOpen):
+        client.act(np.zeros((1, 4), np.float32))
+    assert len(calls) == 2  # the breaker refusal did no I/O
+    assert obs_registry.window()["gateway_breaker_open"] == 1.0
+    # evaluate's breaker is independent (per-endpoint isolation).
+    with pytest.raises(GatewayUnavailable):
+        client.evaluate(np.zeros((1, 4), np.float32))
+    clock["t"] = 5.0  # half-open: the probe goes through (and fails)
+    with pytest.raises(GatewayUnavailable):
+        client.act(np.zeros((1, 4), np.float32))
+    assert len(calls) == 4
+
+
+def test_client_shed_does_not_open_breaker_and_honors_retry_after():
+    sheds = []
+
+    def shedding_transport(path, body, headers, timeout_s):
+        sheds.append(path)
+        if len(sheds) < 3:
+            return 429, {"Retry-After": "0.25"}, b'{"error":"rate_limited"}'
+        return 200, {}, json.dumps(
+            {"v": 1, "actions": [0], "logp": [0.0], "generation": 1}
+        ).encode()
+
+    sleeps = []
+    client = GatewayClient(
+        "http://127.0.0.1:1", retries=4, breaker_failures=2,
+        transport=shedding_transport, sleep=sleeps.append,
+    )
+    result = client.act(np.zeros((1, 4), np.float32))
+    assert result.attempts == 3
+    assert sleeps == [0.25, 0.25]  # server-suggested pacing, not backoff
+    assert client.breakers["act"].state == CLOSED  # sheds never open it
+
+    def always_shed(path, body, headers, timeout_s):
+        return 503, {"Retry-After": "0.01"}, b'{"error":"draining"}'
+
+    client = GatewayClient(
+        "http://127.0.0.1:1", retries=2, transport=always_shed,
+        sleep=lambda s: None,
+    )
+    with pytest.raises(GatewayShed) as info:
+        client.act(np.zeros((1, 4), np.float32))
+    assert info.value.status == 503
+
+
+# ------------------------------------------------------------- netfault wire
+
+
+def _armed_gateway(spec, backend=None):
+    faults.arm(spec)
+    gateway = ServeGateway(
+        backend or _StubBackend(), port=-1,
+        tenants=parse_tenant_spec(""),
+    ).start()
+    return gateway
+
+
+def test_netfault_disconnect_is_absorbed_by_client_retry():
+    gateway = _armed_gateway(
+        "gateway.request:netfault:1.0:0:net=disconnect,max=1"
+    )
+    try:
+        client = GatewayClient(
+            f"http://127.0.0.1:{gateway.port}", retries=2,
+            backoff_base_s=0.01, deadline_ms=5000,
+        )
+        result = client.act(np.zeros((1, 4), np.float32))
+        assert result.attempts == 2  # first died mid-request, retry won
+        assert obs_registry.window()["gateway_netfaults"] == 1.0
+    finally:
+        gateway.stop()
+        faults.disarm()
+
+
+def test_netfault_malformed_payload_is_a_parse_failure_then_retry():
+    gateway = _armed_gateway(
+        "gateway.request:netfault:1.0:0:net=malformed,max=1"
+    )
+    try:
+        client = GatewayClient(
+            f"http://127.0.0.1:{gateway.port}", retries=2,
+            backoff_base_s=0.01, deadline_ms=5000,
+        )
+        result = client.act(np.zeros((1, 4), np.float32))
+        assert result.attempts == 2
+    finally:
+        gateway.stop()
+        faults.disarm()
+
+
+def test_netfault_slowloris_times_out_the_client():
+    gateway = _armed_gateway(
+        "gateway.request:netfault:1.0:0:net=slowloris,max=1,stall_s=2.0"
+    )
+    try:
+        client = GatewayClient(
+            f"http://127.0.0.1:{gateway.port}", retries=0, deadline_ms=400,
+        )
+        with pytest.raises(GatewayUnavailable, match="transport"):
+            client.act(np.zeros((1, 4), np.float32))
+        assert obs_registry.window()["gateway_netfaults"] == 1.0
+    finally:
+        gateway.stop()
+        faults.disarm()
+
+
+# ------------------------------------------------------------- trainer mount
+
+
+def _tiny_cfg(**overrides):
+    base = dict(
+        env_id="CartPole-v1", algo="a3c", backend="sebulba",
+        host_pool="jax", num_envs=16, actor_threads=2, unroll_len=4,
+        precision="f32", log_every=2, inference_server=True,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def test_gateway_off_constructs_nothing():
+    """gateway_port=0: no gateway object, no gateway thread, and ZERO
+    gateway keys in the metrics window — the bit-identity contract's
+    observable half (the loss half is scripts/gateway_smoke.sh act 1)."""
+    agent = make_agent(_tiny_cfg(gateway_port=0))
+    try:
+        agent._start_actors()
+        assert agent._gateway is None and agent._gateway_backend is None
+        assert not [
+            t for t in threading.enumerate()
+            if t.name.startswith("gateway")
+        ]
+        steps = 8 * 4 * 4
+        history = agent.train(total_env_steps=steps)
+        for key in history[-1]:
+            assert not key.startswith("gateway"), key
+        window = obs_registry.window()
+        for key in window:
+            assert not key.startswith("gateway"), key
+    finally:
+        agent.close()
+
+
+def test_gateway_requires_serve_core_and_ff_policy(monkeypatch):
+    with pytest.raises(ValueError, match="inference_server"):
+        make_agent(_tiny_cfg(gateway_port=-1, inference_server=False))
+    monkeypatch.setenv("ASYNCRL_SERVE", "0")
+    with pytest.raises(ValueError, match="serve core"):
+        make_agent(_tiny_cfg(gateway_port=-1))
+    monkeypatch.delenv("ASYNCRL_SERVE")
+    with pytest.raises(ValueError, match="feed-forward"):
+        make_agent(_tiny_cfg(gateway_port=-1, core="lstm"))
+    with pytest.raises(GatewaySpecError):
+        make_agent(_tiny_cfg(gateway_port=-1, gateway_tenant_spec="x"))
+
+
+def test_netfault_spec_refused_when_gateway_off():
+    with pytest.raises(ValueError, match="netfault"):
+        make_agent(_tiny_cfg(
+            fault_spec="gateway.request:netfault:0.5:0",
+        ))
+
+
+@pytest.mark.chaos
+def test_trainer_gateway_serves_during_training_with_live_swaps():
+    """The tentpole e2e: external act traffic is served while training
+    runs, the served generation advances (live zero-drain weight swaps
+    observed over the wire), and gateway metrics land in the window."""
+    agent = make_agent(_tiny_cfg(
+        gateway_port=-1, gateway_tenant_spec="gold:stale:p95_ms=0",
+    ))
+    try:
+        agent._start_actors()
+        port = agent._gateway.port
+        served = {"n": 0, "generations": set()}
+        stop = threading.Event()
+
+        def load():
+            client = GatewayClient(
+                f"http://127.0.0.1:{port}", tenant="gold",
+                deadline_ms=2000, retries=3, backoff_base_s=0.01,
+            )
+            while not stop.is_set():
+                try:
+                    result = client.act(np.zeros((2, 4), np.float32))
+                    served["n"] += 1
+                    served["generations"].add(result.generation)
+                except (GatewayUnavailable, GatewayShed, BreakerOpen):
+                    pass
+                time.sleep(0.01)
+
+        thread = threading.Thread(target=load, name="loadgen", daemon=True)
+        thread.start()
+        steps = 8 * 4 * 10
+        history = agent.train(total_env_steps=steps)
+        stop.set()
+        thread.join(timeout=5)
+        assert served["n"] > 0, "no external request was served"
+        assert len(served["generations"]) > 1, (
+            f"no live weight swap observed over the wire: "
+            f"{served['generations']}"
+        )
+        last = history[-1]
+        assert last["gateway_requests"] > 0
+        assert "gateway_gold_latency_ms_p95" in last
+        assert last["gateway_live"] == 1.0
+        assert agent._errors.empty()
+    finally:
+        agent.close()
+
+
+@pytest.mark.chaos
+def test_netfault_crash_rebuilds_gateway_without_dropping_actors():
+    """The chaos matrix's boundary assertion: a gateway crash mid-request
+    costs external availability only — the supervisor rebuilds the
+    gateway ON THE SAME PORT and the actor fleet never restarts."""
+    agent = make_agent(_tiny_cfg(
+        gateway_port=-1,
+        fault_spec="gateway.request:netfault:1.0:0:net=crash,max=1",
+    ))
+    try:
+        agent._start_actors()
+        port = agent._gateway.port
+        served = {"n": 0}
+        stop = threading.Event()
+
+        def load():
+            client = GatewayClient(
+                f"http://127.0.0.1:{port}", deadline_ms=2000, retries=4,
+                backoff_base_s=0.01,
+            )
+            while not stop.is_set():
+                try:
+                    client.act(np.zeros((1, 4), np.float32))
+                    served["n"] += 1
+                except (GatewayUnavailable, GatewayShed, BreakerOpen):
+                    pass
+                time.sleep(0.02)
+
+        thread = threading.Thread(target=load, name="crashgen", daemon=True)
+        thread.start()
+        steps = 8 * 4 * 10
+        history = agent.train(total_env_steps=steps)
+        stop.set()
+        thread.join(timeout=5)
+        last = history[-1]
+        assert last["gateway_restarts"] >= 1, "the crash never rebuilt"
+        assert last["gateway_netfaults"] >= 1
+        assert last["actor_restarts"] == 0, "the actor fleet was dropped"
+        assert served["n"] > 0, "no request survived the crash era"
+        # The rebuild re-bound the SAME resolved port (stop() tears the
+        # gateway down after train, so probe the recorded port).
+        assert agent._gateway_port == port, "rebuild moved the port"
+    finally:
+        agent.close()
+
+
+# ------------------------------------------------------ CoreBackend anchors
+
+
+def test_core_backend_stale_anchor_survives_core_death():
+    """After a successful serve the backend holds a lease on the served
+    generation; when the core dies, serve_stale answers from that
+    anchored (resident, unmixed) generation."""
+    store = ParamStore({"bias": jnp.asarray(0.5)})
+    stop = threading.Event()
+    core = ServeCore(
+        _det_fn, store=store, num_clients=1, stop_event=stop,
+        deadline_ms=10.0,
+    )
+    core.start()
+    holder = {"core": core}
+    backend = CoreBackend(
+        core_fn=lambda: holder["core"], inference_fn=_det_fn,
+        obs_shape=(4,), seed=0,
+    )
+    try:
+        obs = np.full((2, 4), 3.0, np.float32)
+        actions, logp, generation = backend.act("default", obs, 1000.0)
+        np.testing.assert_array_equal(actions, 3)
+        assert backend.anchored_generation("default") == generation
+        # Publishing g+1 while the anchor pins g keeps g resident.
+        store.publish({"bias": jnp.asarray(9.5)})
+        stop.set()
+        core.join(timeout=5)
+        with pytest.raises(GatewayDegraded):
+            backend.act("default", obs, 1000.0)
+        stale_actions, stale_logp, stale_gen = backend.serve_stale(
+            "default", obs
+        )
+        assert stale_gen == generation
+        np.testing.assert_allclose(np.asarray(stale_logp), 0.5, rtol=1e-6)
+    finally:
+        stop.set()
+        core.join(timeout=5)
+        backend.close()
+    # close() released the anchor: the slots can fully drain now.
+    assert core.router.slots("default").drain(timeout_s=2.0)
+
+
+def test_bind_host_env_overrides():
+    """Satellite: both HTTP servers' bind hosts are configurable, env
+    winning over config (loopback default)."""
+    from asyncrl_tpu.obs import http as obs_http
+    from asyncrl_tpu.serve import gateway as gateway_mod
+
+    assert obs_http.env_host("127.0.0.1") == "127.0.0.1"
+    assert gateway_mod.env_host("127.0.0.1") == "127.0.0.1"
+    import os
+
+    os.environ["ASYNCRL_OBS_HOST"] = "0.0.0.0"
+    os.environ["ASYNCRL_GATEWAY_HOST"] = "0.0.0.0"
+    try:
+        assert obs_http.env_host("127.0.0.1") == "0.0.0.0"
+        assert gateway_mod.env_host("127.0.0.1") == "0.0.0.0"
+    finally:
+        del os.environ["ASYNCRL_OBS_HOST"]
+        del os.environ["ASYNCRL_GATEWAY_HOST"]

@@ -159,6 +159,54 @@ def test_selfplay_policies_maps_live_and_opponent():
         selfplay_policies(_NoRival())
 
 
+# --------------------------------------------- ParamSlots serve-stale reads
+
+
+def test_param_slots_stale_lease_during_install_is_complete_and_unmixed():
+    """The degradation mode's correctness pin: a lease taken on generation
+    g stays g's COMPLETE tree through a concurrent g+1 install — the
+    reader never sees a leaf of the new tree (an install builds a new
+    slot; it never mutates a leased one)."""
+    tree_g = {"w": np.zeros(4), "b": np.ones(2)}
+    slots = ParamSlots(tree_g)
+    leased, g = slots.lease()
+
+    done = threading.Event()
+
+    def installer():
+        slots.install({"w": np.full(4, 9.0), "b": np.full(2, 9.0)})
+        done.set()
+
+    t = threading.Thread(target=installer, name="stale-installer")
+    t.start()
+    assert done.wait(timeout=5), "install must never block on a lease"
+    t.join(timeout=5)
+    # The leased tree is still generation g's, every leaf, unmixed.
+    np.testing.assert_array_equal(leased["w"], np.zeros(4))
+    np.testing.assert_array_equal(leased["b"], np.ones(2))
+    # A specific-generation lease (the gateway's stale-anchor re-pin)
+    # returns the same resident tree while a ref is out.
+    again, gen = slots.lease_generation(g)
+    assert gen == g
+    np.testing.assert_array_equal(again["w"], np.zeros(4))
+    slots.release(g)
+    slots.release(g)
+
+
+def test_param_slots_retired_generation_lease_raises():
+    """A retired slot's params were freed: leasing it must raise, never
+    serve whatever now occupies that memory."""
+    slots = ParamSlots({"w": 0})
+    slots.install({"w": 1})  # no refs on gen 0 -> retired immediately
+    assert slots.generations() == [1]
+    with pytest.raises(RuntimeError, match="retired"):
+        slots.lease_generation(0)
+    # The latest generation leases fine through either API.
+    params, gen = slots.lease_generation(1)
+    assert params == {"w": 1} and gen == 1
+    slots.release(1)
+
+
 # ------------------------------------------------------------ SLO gate units
 
 
@@ -236,6 +284,60 @@ def test_slo_gate_stop_raises_closed_not_shed():
     with pytest.raises(ServerClosed, match="stopped"):
         gate.admit(stop=lambda: True, timeout_s=10.0)
     assert obs_registry.window()["serve_shed"] == 0
+
+
+def test_slo_gate_close_is_idempotent_and_reopen_admits_again():
+    """The PR-10 drain's close() now has its recover edge: double-close is
+    a no-op on a no-op, reopen resumes admissions (a gateway that
+    degrades-then-recovers needs this; a drain that exits simply never
+    reopens), and double-reopen is equally idempotent."""
+    from asyncrl_tpu.rollout.inference_server import ServerClosed
+
+    gate = SLOGate(max_inflight=2)
+    gate.close()
+    gate.close()  # idempotent: still just closed
+    assert gate.closed
+    with pytest.raises(ServerClosed):
+        gate.admit()
+    gate.reopen()
+    gate.reopen()  # idempotent: still just open
+    assert not gate.closed
+    gate.admit()  # admit-after-reopen
+    gate.finished(1.0)
+    # A never-closed gate survives a stray reopen untouched.
+    fresh = SLOGate()
+    fresh.reopen()
+    fresh.admit()
+    fresh.finished(1.0)
+
+
+def test_slo_gate_reopen_wakes_blocked_admitters():
+    """A backpressured admit parked on a CLOSED gate raises ServerClosed
+    promptly; one parked at the inflight cap resumes when capacity frees
+    after a close/reopen cycle — reopen must notify, not strand."""
+    from asyncrl_tpu.rollout.inference_server import ServerClosed
+
+    gate = SLOGate(max_inflight=1)
+    gate.admit()
+    outcome = []
+
+    def blocked():
+        try:
+            gate.admit(timeout_s=10.0)
+            outcome.append("admitted")
+        except ServerClosed:
+            outcome.append("closed")
+
+    t = threading.Thread(target=blocked, name="reopen-admitter", daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not outcome, "must be parked at the inflight cap"
+    gate.close()
+    t.join(timeout=5.0)
+    assert outcome == ["closed"], "close must wake and refuse the waiter"
+    gate.reopen()
+    gate.finished(1.0)  # the original admission completes
+    gate.admit()  # and the reopened gate admits again
 
 
 def test_slo_gate_inflight_cap_sheds_immediately_in_shed_mode():
@@ -396,6 +498,72 @@ def test_max_batch_rows_caps_a_dispatch():
             np.testing.assert_array_equal(np.asarray(out[i][0]), i)
         assert core.coalesce_rows == 6
         assert core.coalesce_rounds >= 2  # 6 rows can't fit one 4-row slab
+    finally:
+        _join(core, stop)
+
+
+def test_external_request_never_fills_an_actor_batch_early():
+    """The fill-target invariant: with 2 registered clients, one actor
+    request + one external request must NOT read as slab-full — the
+    scheduler keeps the batch open for the second actor (external rows
+    ride along, they never split an actor cohort)."""
+    store = ParamStore({"bias": jnp.asarray(0.0)})
+    core, stop = _mk_core(_det_fn, 2, store=store, deadline_ms=600.0)
+    try:
+        c0, c1 = core.client(0), core.client(1)
+        done = {}
+
+        def actor(i, client):
+            done[i] = client(
+                None, np.full((1, 4), float(i), np.float32), None
+            )
+
+        def external():
+            done["ext"] = core.submit_external(
+                "default", (np.full((1, 4), 9.0, np.float32),), 2000.0
+            )
+
+        threads = [
+            threading.Thread(target=actor, args=(0, c0), name="fill-a0"),
+            threading.Thread(target=external, name="fill-ext"),
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.25)
+        # Inside the 600ms fill window with only actor0 + external in:
+        # nothing may have dispatched (members=1 < target=2).
+        assert not done, f"premature dispatch: {list(done)}"
+        t2 = threading.Thread(target=actor, args=(1, c1), name="fill-a1")
+        t2.start()
+        for t in threads + [t2]:
+            t.join(timeout=20)
+        assert set(done) == {0, 1, "ext"}
+        window = obs_registry.window()
+        assert window["serve_dispatch_full"] >= 1
+        assert core.coalesce_rounds == 1  # ONE batch carried all three
+    finally:
+        _join(core, stop)
+
+
+def test_submit_external_serves_without_registering_a_client():
+    """The gateway's entry: an external submission is served (own
+    deadline flush when no actor is around) and returns the generation
+    the batch leased — without growing any policy's slab-full fill
+    target (no client slot registers)."""
+    store = ParamStore({"bias": jnp.asarray(1.5)})
+    core, stop = _mk_core(_det_fn, 2, store=store, deadline_ms=20.0)
+    try:
+        obs = np.full((2, 4), 5.0, np.float32)
+        (actions, logp), generation = core.submit_external(
+            "default", (obs,), deadline_ms=2000.0
+        )
+        np.testing.assert_array_equal(np.asarray(actions), 5)
+        np.testing.assert_allclose(np.asarray(logp), 1.5, rtol=1e-6)
+        assert generation == 0
+        with core._cond:
+            assert core._policy_clients_locked("default") == 0
+        with pytest.raises(ValueError, match="deadline_ms"):
+            core.submit_external("default", (obs,), deadline_ms=0.0)
     finally:
         _join(core, stop)
 
