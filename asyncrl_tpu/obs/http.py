@@ -64,13 +64,14 @@ def env_port(config_port: int) -> int:
     return config_port
 
 
-def env_host(config_host: str) -> str:
-    """The effective bind host: ``ASYNCRL_OBS_HOST`` (when set and
-    non-empty) wins over ``config.obs_http_host`` — the same precedence as
-    the port. Loopback stays the default everywhere; binding wider
-    (``0.0.0.0``) is a deliberate operator decision made through exactly
-    these two knobs."""
-    raw = os.environ.get(ENV_HOST, "").strip()
+def env_host(config_host: str, env_var: str = ENV_HOST) -> str:
+    """The effective bind host: the env var (when set and non-empty) wins
+    over the config value — the same precedence as the port. Loopback
+    stays the default everywhere; binding wider (``0.0.0.0``) is a
+    deliberate operator decision made through exactly these two knobs.
+    ``env_var`` defaults to ``ASYNCRL_OBS_HOST``; the gateway reuses this
+    one precedence definition with ``ASYNCRL_GATEWAY_HOST``."""
+    raw = os.environ.get(env_var, "").strip()
     return raw if raw else config_host
 
 

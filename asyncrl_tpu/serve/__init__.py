@@ -30,6 +30,7 @@ from asyncrl_tpu.serve.client import (
     BreakerOpen,
     CircuitBreaker,
     GatewayClient,
+    GatewayRequestError,
     GatewayResult,
     GatewayShed,
     GatewayUnavailable,
@@ -59,6 +60,7 @@ __all__ = [
     "CoreBackend",
     "GatewayClient",
     "GatewayDegraded",
+    "GatewayRequestError",
     "GatewayResult",
     "GatewayShed",
     "GatewaySpecError",

@@ -7,7 +7,9 @@ a per-endpoint circuit breaker (a dead endpoint is refused client-side
 after a threshold, probed half-open, re-closed on success — the Nygard
 state machine), deadline budgets that bound the WHOLE attempt sequence
 (retrying past the caller's deadline serves nobody), and honest error
-taxonomy (a shed is not a crash; a breaker refusal is not a timeout).
+taxonomy (a shed is not a crash; a breaker refusal is not a timeout; a
+4xx-rejected request is the CALLER's bug — never retried, never counted
+against the endpoint's breaker).
 
 The breaker state machine (deterministic, clock-injected for tests):
 
@@ -69,6 +71,17 @@ class GatewayShed(GatewayError):
 class GatewayUnavailable(GatewayError):
     """Transport-level failure: connection refused/reset, read timeout,
     short or unparseable body — the retry layer's bread and butter."""
+
+
+class GatewayRequestError(GatewayError):
+    """The gateway rejected THIS request as malformed (a 4xx other than
+    the shed statuses: bad obs shape, bad deadline, unknown version).
+    Retrying the same bytes cannot succeed and the endpoint is healthy,
+    so it is neither retried nor counted against the circuit breaker."""
+
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
 
 
 class BreakerOpen(GatewayError):
@@ -316,6 +329,14 @@ class GatewayClient:
                 if not self._wait(wait_s, start, budget_ms):
                     break
                 continue
+            except GatewayRequestError:
+                # A healthy endpoint answered "this request is
+                # malformed": close the breaker bookkeeping as a success
+                # (clears a half-open probe) and surface it immediately —
+                # no retry can fix the caller's bytes.
+                breaker.record_success(0.0)
+                self._publish_open_count()
+                raise
             except GatewayUnavailable as e:
                 breaker.record_failure()
                 self._publish_open_count()
@@ -325,6 +346,16 @@ class GatewayClient:
                 ):
                     break
                 continue
+            except BaseException:
+                # Anything outside the taxonomy (an injected transport
+                # raising its own type, a bug below us) must still close
+                # the breaker's bookkeeping: an attempt admitted in
+                # half-open that escapes here would otherwise leave the
+                # probe flagged in-flight forever, wedging the endpoint
+                # in BreakerOpen.
+                breaker.record_failure()
+                self._publish_open_count()
+                raise
             breaker.record_success(1e3 * (self._clock() - t0))
             self._publish_open_count()
             return result
@@ -375,6 +406,15 @@ class GatewayClient:
             raise GatewayShed(
                 f"{endpoint}: shed with HTTP {status}: {raw[:200]!r}",
                 retry_after_s=retry_after, status=status,
+            )
+        if 400 <= status < 500:
+            # The server answered, and the answer is "this request can
+            # never succeed": retrying burns the budget for nothing, and
+            # a caller's malformed payload must not open the breaker
+            # against everyone else's healthy traffic.
+            raise GatewayRequestError(
+                f"{endpoint}: rejected with HTTP {status}: {raw[:200]!r}",
+                status=status,
             )
         if status != 200:
             raise GatewayUnavailable(

@@ -17,9 +17,10 @@ scaled up to a mutating endpoint):
   → ``{"v": 1, "actions": [...], "logp": [...], "generation": g}``.
 - ``POST /v1/evaluate`` — identical request/response shape, served through
   the same continuous batch but as its OWN traffic class: evaluation
-  traffic gets separate counters and a separate client-side circuit
-  breaker so it can never be confused with — or silently starve — action
-  traffic.
+  traffic gets separate wire counters (``gateway_evaluate_requests`` /
+  ``gateway_evaluate_errors``, vs the ``gateway_act_*`` pair) and a
+  separate client-side circuit breaker so it can never be confused with
+  — or silently starve — action traffic.
 - Headers: ``X-Tenant`` names the caller's SLO class,
   ``X-Deadline-Ms`` the request's end-to-end budget.
 
@@ -65,7 +66,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import os
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -74,6 +75,7 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+from asyncrl_tpu.obs import http as obs_http
 from asyncrl_tpu.obs import registry as obs_registry
 from asyncrl_tpu.obs import spans as span_names
 from asyncrl_tpu.obs import trace
@@ -92,6 +94,17 @@ MAX_BODY_BYTES = 16 << 20
 
 REQUESTS_COUNTER = "gateway_requests"
 ERRORS_COUNTER = "gateway_errors"
+# Per-endpoint splits of the two counters above: /v1/evaluate is its own
+# traffic class on the wire, so its volume and error rate must be
+# tellable apart from /v1/act server-side, not only at the client.
+ENDPOINT_REQUEST_COUNTERS = {
+    "act": "gateway_act_requests",
+    "evaluate": "gateway_evaluate_requests",
+}
+ENDPOINT_ERROR_COUNTERS = {
+    "act": "gateway_act_errors",
+    "evaluate": "gateway_evaluate_errors",
+}
 BAD_REQUEST_COUNTER = "gateway_bad_requests"
 SHED_COUNTER = "gateway_shed"
 DEADLINE_SHED_COUNTER = "gateway_deadline_shed"
@@ -102,10 +115,9 @@ NETFAULT_COUNTER = "gateway_netfaults"
 
 def env_host(config_host: str) -> str:
     """``ASYNCRL_GATEWAY_HOST`` (when set and non-empty) wins over
-    ``config.gateway_host`` — the obs/http.py ``env_host`` precedence;
-    loopback stays the default."""
-    raw = os.environ.get(ENV_HOST, "").strip()
-    return raw if raw else config_host
+    ``config.gateway_host`` — the ONE precedence definition lives in
+    obs/http.py; this is it bound to the gateway's knobs."""
+    return obs_http.env_host(config_host, env_var=ENV_HOST)
 
 
 class GatewaySpecError(ValueError):
@@ -262,6 +274,15 @@ class _RateBucket:
                 return 0.0
             return max((1.0 - self._tokens) / self.rps, 1e-3)
 
+    def refund(self) -> None:
+        """Return a taken token (the request it paid for was refused
+        downstream, e.g. by the tenant's SLO gate): a shed must not also
+        charge the tenant's rate budget."""
+        if self.rps <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + 1.0)
+
 
 class _TenantState:
     """One tenant class's live admission state: rate bucket + shed-mode
@@ -321,9 +342,13 @@ class CoreBackend:
 
     def latency_estimate_ms(self) -> float:
         """The core's rolling p95 serve latency — the deadline-feasibility
-        estimate (0.0 = no signal yet, nothing is shed on it)."""
+        estimate (0.0 = no signal, nothing is shed on it). Only a SERVING
+        core reports one: a dead or draining core's latched p95 must not
+        504-shed requests that would never touch the core anyway — the
+        stale/fallback degradation paths answer in milliseconds from the
+        handler thread, and shed-mode tenants deserve the honest 503."""
         core = self._core_fn()
-        if core is None:
+        if core is None or not core.serving():
             return 0.0
         return core.slo.p95_ms()
 
@@ -492,6 +517,14 @@ class ServeGateway:
         # zero registry keys (the bit-identity contract).
         self._c_requests = obs_registry.counter(REQUESTS_COUNTER)
         self._c_errors = obs_registry.counter(ERRORS_COUNTER)
+        self._c_requests_by = {
+            endpoint: obs_registry.counter(name)
+            for endpoint, name in ENDPOINT_REQUEST_COUNTERS.items()
+        }
+        self._c_errors_by = {
+            endpoint: obs_registry.counter(name)
+            for endpoint, name in ENDPOINT_ERROR_COUNTERS.items()
+        }
         self._c_bad = obs_registry.counter(BAD_REQUEST_COUNTER)
         self._c_shed = obs_registry.counter(SHED_COUNTER)
         self._c_deadline_shed = obs_registry.counter(DEADLINE_SHED_COUNTER)
@@ -526,6 +559,13 @@ class ServeGateway:
                 # lint: broad-except-ok(the wire boundary must answer 500 and keep serving; the failure is counted and the next request is independent)
                 except Exception as e:
                     outer._c_errors.inc()
+                    endpoint = {
+                        "/v1/act": "act", "/v1/evaluate": "evaluate",
+                    }.get(urlparse(self.path).path)
+                    if endpoint is not None:
+                        # Keep the per-endpoint splits summing to the
+                        # aggregate even for catch-all 500s.
+                        outer._c_errors_by[endpoint].inc()
                     try:
                         outer._send_json(
                             self, 500,
@@ -659,6 +699,7 @@ class ServeGateway:
 
     def _handle_request(self, handler, endpoint: str) -> None:
         self._c_requests.inc()
+        self._c_requests_by[endpoint].inc()
         arrival = time.monotonic()
         # ---- parse + validate (nothing counted against tenants yet)
         try:
@@ -670,7 +711,11 @@ class ServeGateway:
                              "bad_length", f"Content-Length {length}")
         raw = handler.rfile.read(length)
         if len(raw) < length:
-            self._c_errors.inc()  # client disconnected mid-body
+            # Client disconnected mid-body: both the aggregate and the
+            # endpoint split count it, so the splits always reconcile
+            # with the gateway_error_rate detector's feed.
+            self._c_errors.inc()
+            self._c_errors_by[endpoint].inc()
             handler.close_connection = True
             return
         try:
@@ -715,15 +760,22 @@ class ServeGateway:
             )
         except (TypeError, ValueError):
             return self._bad(handler, 400, "bad_deadline", str(deadline_raw))
-        if deadline_ms <= 0:
+        # isfinite, not just > 0: float("nan") fails every comparison
+        # (json.loads accepts NaN), and a nan budget downstream turns the
+        # serve core's deadline arithmetic into a never-firing flush — a
+        # single request wedging the serve thread. inf is refused for the
+        # same reason: the wire contract is a bounded budget.
+        if not math.isfinite(deadline_ms) or deadline_ms <= 0:
             return self._bad(handler, 400, "bad_deadline",
-                             f"{deadline_ms} <= 0")
+                             f"{deadline_ms} is not a positive finite ms "
+                             "budget")
 
         # ---- scripted chaos (after parse: the payload exists to corrupt)
         if self._fault_request is not None:
             try:
                 self._fault_request.fire(stop=lambda: self._fatal is not None)
             except NetFault as fault:
+                self._c_errors_by[endpoint].inc()
                 probe = json.dumps({
                     "v": PROTOCOL_VERSION, "endpoint": endpoint,
                     "netfault": fault.mode,
@@ -765,6 +817,9 @@ class ServeGateway:
             try:
                 tenant.gate.admit()
             except RequestShed as e:
+                # The gate refused AFTER the bucket charged: refund the
+                # token, or shed requests double-charge the rate budget.
+                tenant.bucket.refund()
                 self._c_shed.inc()
                 return self._send_json(
                     handler, 429,
@@ -775,6 +830,7 @@ class ServeGateway:
             except ServerClosed:
                 # close_admissions() raced this request past the drain
                 # check: the closed tenant gate is the backstop.
+                tenant.bucket.refund()
                 self._c_shed.inc()
                 return self._send_json(
                     handler, 503,
@@ -797,7 +853,11 @@ class ServeGateway:
                 )
                 actions, logp, generation = fn(policy, obs, remaining_ms)
         except RequestShed as e:
+            # Shed one layer deeper (the CORE's gate / wire-budget flush):
+            # still a shed, still refunded — no non-served request may
+            # charge the tenant's rate budget, whichever gate refused it.
             tenant.gate.abandoned()
+            tenant.bucket.refund()
             self._c_shed.inc()
             return self._send_json(
                 handler, 429,
@@ -815,6 +875,7 @@ class ServeGateway:
         except Exception as e:
             tenant.gate.abandoned()
             self._c_errors.inc()
+            self._c_errors_by[endpoint].inc()
             return self._send_json(
                 handler, 500,
                 {"v": PROTOCOL_VERSION, "error": "serve_failed",
@@ -873,6 +934,7 @@ class ServeGateway:
                 "fallback": True,
             })
         tenant.gate.abandoned()
+        tenant.bucket.refund()  # shed, not served: the token comes back
         self._c_shed.inc()
         self._send_json(
             handler, 503,

@@ -53,6 +53,7 @@ publish path inside the router.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
@@ -72,7 +73,7 @@ from asyncrl_tpu.rollout.inference_server import (
     coalesce_args,
 )
 from asyncrl_tpu.serve.router import DEFAULT_POLICY, PolicyRouter
-from asyncrl_tpu.serve.slo import SLOGate
+from asyncrl_tpu.serve.slo import RequestShed, SLOGate
 from asyncrl_tpu.utils import faults
 
 DISPATCH_FULL_COUNTER = "serve_dispatch_full"
@@ -284,11 +285,28 @@ class ServeCore(threading.Thread):
         at coalescing latency while never being held past its deadline.
         Returns ``(result, generation)`` — the param generation the
         serving batch leased, for response stamping."""
-        if deadline_ms <= 0:
-            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        # Defense in depth behind the gateway's own guard: a non-finite
+        # deadline (nan compares False against everything) would make the
+        # deadline flush never fire and wedge the serve thread on one
+        # request.
+        if not math.isfinite(deadline_ms) or deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be positive and finite, got {deadline_ms}"
+            )
+        # Two distinct budgets derive from the wire deadline: the
+        # BATCH-FILL hold is the coalescing window capped by the wire
+        # budget (tight by design — milliseconds), while the ADMISSION
+        # wait may use the remaining wire budget up to the gate's 30s
+        # backpressure ceiling (a budget beyond that still sheds at 30s —
+        # the bound that keeps a dead server from wedging clients), and
+        # never a moment past the budget. _submit re-caps the fill
+        # deadline by whatever budget SURVIVES the admission wait, so
+        # wait + hold together never exceed the wire budget.
+        wire_s = deadline_ms / 1e3
         request = self._submit(
             EXTERNAL_CLIENT, policy, args,
-            min(deadline_ms / 1e3, self._deadline_s),
+            min(wire_s, self._deadline_s),
+            wire_budget_s=wire_s,
         )
         return request.result, request.generation
 
@@ -305,19 +323,44 @@ class ServeCore(threading.Thread):
     def _closed(self) -> bool:
         return self._stop_event.is_set() or not self.is_alive()
 
-    def _submit(self, index, policy, args, deadline_s):  # thread-entry: serve-client@actor
+    def _submit(self, index, policy, args, deadline_s, wire_budget_s=None):  # thread-entry: serve-client@actor
         # Admission gate FIRST: a shed/backpressured request never costs a
         # queue slot. Blocked time traces as serve.admit_wait. A gate wait
         # interrupted by server death re-raises the REAL latched cause,
-        # never a bland closure (and never a fake shed).
+        # never a bland closure (and never a fake shed). External (wire)
+        # requests carry wire_budget_s — distinct from deadline_s, which
+        # for them is already capped at the tiny batch-fill window: the
+        # admission wait may spend the remaining wire budget (up to the
+        # gate's 30s backpressure ceiling), and whatever the wait
+        # consumed is then re-subtracted from the fill deadline below, so
+        # gate wait + batch hold together never exceed the deadline the
+        # gateway promised its client.
+        admit_start = time.monotonic()
         try:
-            self._slo.admit(stop=self._closed)
+            self._slo.admit(
+                stop=self._closed,
+                timeout_s=(
+                    min(wire_budget_s, 30.0)
+                    if wire_budget_s is not None
+                    else 30.0
+                ),
+            )
         except ServerClosed:
             if self._fatal is not None:
                 raise self._fatal
             raise
         try:
             arrival = time.monotonic()
+            if wire_budget_s is not None:
+                remaining_s = wire_budget_s - (arrival - admit_start)
+                if remaining_s <= 0:
+                    # Admitted on the budget's last gasp: the flush would
+                    # fire instantly on a batch of one anyway — shed
+                    # honestly instead (un-counting the admission below).
+                    raise RequestShed(
+                        "wire budget spent waiting at the admission gate"
+                    )
+                deadline_s = min(deadline_s, remaining_s)
             request = _Request(
                 index, policy, args, int(args[0].shape[0]),
                 arrival, arrival + deadline_s,

@@ -5,6 +5,7 @@ chaos, and the SebulbaTrainer mount (off = bit-identical nothing;
 supervised rebuild never drops the actor fleet)."""
 
 import json
+import socket
 import threading
 import time
 import urllib.error
@@ -23,9 +24,11 @@ from asyncrl_tpu.serve import (
     CoreBackend,
     GatewayClient,
     GatewayDegraded,
+    GatewayRequestError,
     GatewayShed,
     GatewaySpecError,
     GatewayUnavailable,
+    RequestShed,
     ServeCore,
     ServeGateway,
     TenantClass,
@@ -202,6 +205,11 @@ def test_act_and_evaluate_roundtrip_and_protocol_versioning():
         assert window["gateway_requests"] == 4.0
         assert window["gateway_bad_requests"] == 3.0
         assert window["gateway_errors"] == 0.0
+        # /v1/evaluate is its own traffic class SERVER-side too: the
+        # per-endpoint splits must tell the two apart.
+        assert window["gateway_act_requests"] == 3.0
+        assert window["gateway_evaluate_requests"] == 1.0
+        assert window["gateway_evaluate_errors"] == 0.0
     finally:
         gateway.stop()
 
@@ -230,6 +238,30 @@ def test_deadline_infeasible_sheds_before_occupying_a_slot():
         gateway.stop()
 
 
+def test_nonfinite_deadline_is_rejected_not_wedged():
+    """'nan' passes a naive <= 0 check (nan compares False against
+    everything) and json.loads accepts NaN in the body; both forms must
+    400 at the door — a nan budget reaching the serve core would disable
+    its deadline flush and wedge the serve thread on one request."""
+    backend = _StubBackend()
+    gateway = ServeGateway(backend, port=-1).start()
+    try:
+        for header in ("nan", "inf", "-inf"):
+            status, _, doc = _post(
+                gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+                headers={"X-Deadline-Ms": header},
+            )
+            assert status == 400 and doc["error"] == "bad_deadline"
+        status, _, doc = _post(
+            gateway.port, "/v1/act",
+            {"v": 1, "obs": [[0, 0, 0, 0]], "deadline_ms": float("nan")},
+        )
+        assert status == 400 and doc["error"] == "bad_deadline"
+        assert backend.calls == []
+    finally:
+        gateway.stop()
+
+
 def test_tenant_token_bucket_sheds_with_retry_after():
     tenants = parse_tenant_spec("bulk:shed:rps=0.5,burst=1")
     gateway = ServeGateway(_StubBackend(), port=-1, tenants=tenants).start()
@@ -250,6 +282,95 @@ def test_tenant_token_bucket_sheds_with_retry_after():
                          {"v": 1, "obs": [[0, 0, 0, 0]]})
         assert ok == 200
         assert obs_registry.window()["gateway_shed"] == 1.0
+    finally:
+        gateway.stop()
+
+
+def test_tenant_gate_shed_refunds_the_rate_token():
+    """A request the tenant's SLO gate refuses must not also charge the
+    rate bucket: with burst=1 and negligible refill, the token taken
+    before the shed must pay for the NEXT request once the gate frees."""
+    tenants = parse_tenant_spec("bulk:shed:rps=0.001,burst=1,inflight=1")
+    gateway = ServeGateway(_StubBackend(), port=-1, tenants=tenants).start()
+    try:
+        state = gateway._tenants["bulk"]
+        state.gate.admit()  # saturate the inflight cap: the gate sheds
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Tenant": "bulk"},
+        )
+        assert status == 429 and doc["error"] == "tenant_slo_shed"
+        state.gate.finished(1.0)  # release the cap
+        status, _, _ = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Tenant": "bulk"},
+        )
+        assert status == 200  # paid for by the refunded token
+    finally:
+        gateway.stop()
+
+
+def test_core_shed_and_degrade_shed_also_refund_the_rate_token():
+    """The refund covers EVERY non-served outcome, whichever layer shed:
+    a core-gate 429 'overloaded' and a degrade-mode 503 both hand the
+    rate token back. With burst=1 and negligible refill, the same token
+    must pay for every attempt — without the refund the second request
+    would answer 429 rate_limited instead."""
+
+    class SheddingBackend(_StubBackend):
+        def act(self, policy, obs, deadline_ms):
+            raise RequestShed("core gate refused")
+
+    tenants = parse_tenant_spec("bulk:shed:rps=0.001,burst=1")
+    gateway = ServeGateway(
+        SheddingBackend(), port=-1, tenants=tenants
+    ).start()
+    try:
+        for _ in range(3):
+            status, _, doc = _post(
+                gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+                headers={"X-Tenant": "bulk"},
+            )
+            assert status == 429 and doc["error"] == "overloaded"
+    finally:
+        gateway.stop()
+
+    gateway = ServeGateway(
+        _StubBackend(fail=True), port=-1,
+        tenants=parse_tenant_spec("bulk:shed:rps=0.001,burst=1"),
+    ).start()
+    try:
+        for _ in range(3):
+            status, _, doc = _post(
+                gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+                headers={"X-Tenant": "bulk"},
+            )
+            assert status == 503 and doc["error"] == "degraded"
+    finally:
+        gateway.stop()
+
+
+def test_mid_body_disconnect_counts_in_the_endpoint_error_split():
+    """A client that vanishes mid-body is an error on BOTH the aggregate
+    and the per-endpoint split — the splits must always reconcile with
+    the gateway_error_rate detector's aggregate feed."""
+    gateway = ServeGateway(_StubBackend(), port=-1).start()
+    try:
+        conn = socket.create_connection(("127.0.0.1", gateway.port), 5)
+        conn.sendall(
+            b"POST /v1/act HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Type: application/json\r\nContent-Length: 64\r\n"
+            b"\r\n" b'{"v": 1'
+        )
+        conn.close()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if obs_registry.window().get("gateway_act_errors", 0.0) >= 1:
+                break
+            time.sleep(0.05)
+        window = obs_registry.window()
+        assert window["gateway_errors"] == 1.0
+        assert window["gateway_act_errors"] == 1.0
     finally:
         gateway.stop()
 
@@ -311,6 +432,29 @@ def test_stale_mode_with_nothing_anchored_sheds_honestly():
         assert status == 503 and doc["error"] == "degraded"
     finally:
         gateway.stop()
+
+
+def test_latency_estimate_only_from_a_serving_core():
+    """A dead core's latched rolling p95 must not feed the feasibility
+    shed: during an outage the stale/fallback paths answer OFF the core
+    in milliseconds, so a 504 on the dead core's old latency would refuse
+    exactly the traffic the degradation modes exist to serve (and a
+    shed-mode tenant deserves the honest 503 'degraded')."""
+    store = ParamStore({"bias": jnp.asarray(0.0)})
+    core = ServeCore(_det_fn, store=store, num_clients=1)
+    core.slo.admit()
+    core.slo.finished(300.0)  # latch a rolling p95 on the gate
+    backend = CoreBackend(lambda: core, _det_fn, obs_shape=(4,))
+    assert not core.serving()  # never started
+    assert backend.latency_estimate_ms() == 0.0
+    core.start()
+    try:
+        assert core.serving()
+        assert backend.latency_estimate_ms() == pytest.approx(300.0)
+    finally:
+        core._stop_event.set()
+        core.join(timeout=5)
+    assert backend.latency_estimate_ms() == 0.0  # dead again: no shed
 
 
 def test_drain_close_and_reopen_admissions():
@@ -469,6 +613,60 @@ def test_client_wrong_typed_200_is_unavailable_not_a_raw_typeerror():
         client.act(np.zeros((1, 4), np.float32))
     # Both attempts recorded as failures: the breaker opened.
     assert client.breakers["act"].state == OPEN
+
+
+def test_client_4xx_is_not_retried_and_never_feeds_the_breaker():
+    """A malformed request (400 bad_obs) raises GatewayRequestError on
+    the FIRST attempt — retrying the same bytes cannot succeed, so no
+    retries burn the budget — and records as a breaker success: a
+    caller's bug must never open the circuit against healthy traffic."""
+    calls = []
+
+    def reject_transport(path, body, headers, timeout_s):
+        calls.append(path)
+        return 400, {}, b'{"v": 1, "error": "bad_obs"}'
+
+    client = GatewayClient(
+        "http://127.0.0.1:1", retries=5, breaker_failures=2,
+        transport=reject_transport, sleep=lambda s: None,
+    )
+    for _ in range(3):
+        with pytest.raises(GatewayRequestError, match="HTTP 400"):
+            client.act(np.zeros((1, 4), np.float32))
+    assert len(calls) == 3  # one transport call per act(): no retries
+    assert client.breakers["act"].state == CLOSED
+
+
+def test_unexpected_transport_exception_still_feeds_the_breaker():
+    """An injected transport raising OUTSIDE the taxonomy (plain
+    RuntimeError, not OSError/HTTPException) must still close the breaker
+    bookkeeping: a half-open probe escaping _attempt without a
+    record_* call would leave _probing latched True and refuse the
+    endpoint with BreakerOpen forever."""
+
+    class Boom(RuntimeError):
+        pass
+
+    def weird_transport(path, body, headers, timeout_s):
+        raise Boom("not an OSError")
+
+    clock = {"t": 0.0}
+    client = GatewayClient(
+        "http://127.0.0.1:1", retries=0, breaker_failures=1,
+        breaker_reset_s=5.0, transport=weird_transport,
+        sleep=lambda s: None, clock=lambda: clock["t"],
+    )
+    with pytest.raises(Boom):
+        client.act(np.zeros((1, 4), np.float32))
+    assert client.breakers["act"].state == OPEN  # the failure counted
+    clock["t"] = 5.0  # half-open: the probe itself raises Boom...
+    with pytest.raises(Boom):
+        client.act(np.zeros((1, 4), np.float32))
+    # ...and re-opens the breaker instead of wedging the probe flag.
+    assert client.breakers["act"].state == OPEN
+    clock["t"] = 10.0  # a FRESH probe is admitted: Boom, not BreakerOpen
+    with pytest.raises(Boom):
+        client.act(np.zeros((1, 4), np.float32))
 
 
 def test_client_breaker_opens_and_refuses_then_probes():

@@ -568,6 +568,73 @@ def test_submit_external_serves_without_registering_a_client():
         _join(core, stop)
 
 
+def test_submit_external_rejects_nonfinite_deadline():
+    """Defense in depth behind the gateway's 400: nan compares False
+    against everything, so a nan deadline would slip a naive <= 0 check,
+    disable the deadline flush in _admit, and wedge the serve thread on
+    one request. Raises before anything queues — no thread needed."""
+    store = ParamStore({"bias": jnp.asarray(0.0)})
+    core = ServeCore(_det_fn, store=store, num_clients=1)
+    obs = np.zeros((1, 4), np.float32)
+    for bad in (float("nan"), float("inf"), float("-inf"), -5.0):
+        with pytest.raises(ValueError, match="deadline_ms"):
+            core.submit_external("default", (obs,), deadline_ms=bad)
+
+
+def test_external_admission_wait_capped_at_wire_budget():
+    """Backpressure mode (shed=False) waits up to 30s for in-process
+    actors — but an EXTERNAL request's gate wait is capped at its
+    remaining wire budget, so a gateway handler thread is never held in
+    the admission gate past the deadline it promised its client."""
+    gate = SLOGate(max_inflight=1, shed=False)
+    store = ParamStore({"bias": jnp.asarray(0.0)})
+    core, stop = _mk_core(_det_fn, 1, store=store, slo=gate)
+    try:
+        gate.admit()  # saturate the inflight cap: externals must wait
+        t0 = time.monotonic()
+        with pytest.raises(RequestShed):
+            core.submit_external(
+                "default", (np.zeros((1, 4), np.float32),),
+                deadline_ms=200.0,
+            )
+        elapsed = time.monotonic() - t0
+        # A 0.2s wire budget waits ~0.2s: LONGER than the 20ms batch-fill
+        # window (the gate gives the wire request its whole budget, not
+        # the coalescing deadline) and nowhere near the 30s backpressure
+        # bound (generous upper margin for a loaded CI box).
+        assert 0.15 <= elapsed < 5.0
+        gate.finished(1.0)
+    finally:
+        _join(core, stop)
+
+
+def test_external_fill_deadline_shrinks_by_the_admission_wait():
+    """A request admitted after a long gate wait must NOT get a fresh
+    coalescing window on top: the fill deadline is re-capped by whatever
+    wire budget SURVIVED the wait, so wait + hold never exceeds the
+    deadline the gateway promised its client."""
+    gate = SLOGate(max_inflight=1, shed=False)
+    store = ParamStore({"bias": jnp.asarray(0.0)})
+    core, stop = _mk_core(_det_fn, 1, store=store, slo=gate,
+                          deadline_ms=5000.0)
+    try:
+        gate.admit()  # saturated; the timer frees it mid-budget
+        threading.Timer(1.4, lambda: gate.finished(1.0)).start()
+        t0 = time.monotonic()
+        (actions, _), _ = core.submit_external(
+            "default", (np.full((2, 4), 3.0, np.float32),),
+            deadline_ms=2000.0,
+        )
+        elapsed = time.monotonic() - t0
+        np.testing.assert_array_equal(np.asarray(actions), 3)
+        # Admitted at ~1.4s with ~0.6s of budget left: the 5s coalescing
+        # window is capped by the surviving budget, so the flush fires by
+        # ~2.0s — an uncapped window would hold until ~3.4s.
+        assert 1.35 <= elapsed < 2.7
+    finally:
+        _join(core, stop)
+
+
 # ------------------------------------------------------- zero-drain swaps e2e
 
 
