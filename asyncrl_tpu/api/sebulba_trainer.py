@@ -347,6 +347,7 @@ class SebulbaTrainer:
                 max_actors=self._elastic_bounds()[1],
                 cooldown_windows=config.elastic_cooldown_windows,
                 up_stall_frac=config.elastic_up_stall_frac,
+                up_shed_rate=config.elastic_up_shed_rate,
                 down_backpressure=config.elastic_down_backpressure,
                 down_admission=config.elastic_down_admission,
                 # The replay inversion: high ring fill + low stall means

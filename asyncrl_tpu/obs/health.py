@@ -56,6 +56,13 @@ gateway_error_rate    gateway    warn      >= half of a window's gateway
                                            requests errored (>= 4 reqs)
 breaker_open          gateway    warn      a client-side circuit breaker
                                            is sitting open
+replica_staleness_-   fleet      warn      worst replica's weight-sync lag
+runaway                                    reached the fleet's staleness
+                                           cap (fleet_staleness_max vs
+                                           fleet_staleness_cap gauges)
+replica_flap          fleet      warn      >= 3 replica readmissions in
+                                           the flap horizon (eject/
+                                           readmit oscillation)
 ===================== ========== ========= =================================
 
 The last five (ISSUE 8) watch the *learning* and the *device* — fed by
@@ -82,7 +89,8 @@ from asyncrl_tpu.obs import flightrec, registry
 from asyncrl_tpu.obs import spans as span_names
 
 COMPONENTS = (
-    "actors", "server", "learner", "serve-core", "gateway", "pipeline"
+    "actors", "server", "learner", "serve-core", "gateway", "fleet",
+    "pipeline",
 )
 _STATUS_RANK = {"ok": 0, "degraded": 1, "critical": 2}
 
@@ -409,6 +417,46 @@ def _breaker_open(monitor: "HealthMonitor", sample: dict[str, Any]):
     )
 
 
+def _replica_staleness_runaway(
+    monitor: "HealthMonitor", sample: dict[str, Any]
+):
+    """The fleet's bounded-staleness contract, watched from the outside:
+    fires when the worst replica's weight-sync lag reached the fleet's
+    configured cap (at which point the fleet has ejected it — the event
+    is the operator-visible record that the bound did its job, or that
+    it keeps being hit). Quiet (and key-free) when no fleet is mounted:
+    no ``fleet_staleness_max`` gauge, no evaluation."""
+    value = sample.get("fleet_staleness_max")
+    cap = sample.get("fleet_staleness_cap")
+    if not _finite_number(value) or not _finite_number(cap) or cap <= 0:
+        return None
+    if value < cap:
+        return None
+    return (
+        f"replica weight-sync staleness hit the cap: worst replica is "
+        f"{value:.0f} version(s) behind its target (cap {cap:.0f}) — "
+        "the fleet ejects at the bound; a persistent hit means a replica "
+        "cannot keep up with the learner's publish rate",
+        {"staleness_max": float(value), "staleness_cap": float(cap)},
+    )
+
+
+def _replica_flap(monitor: "HealthMonitor", sample: dict[str, Any]):
+    """Repeated eject/readmit cycles: a replica oscillating through the
+    half-open probe door is sick in a way neither steady ejection nor
+    steady serving shows. Threshold 3 readmissions inside the fleet's
+    60s flap horizon — one readmission is recovery, three is a flap."""
+    value = sample.get("fleet_replica_flaps")
+    if not _finite_number(value) or value < 3:
+        return None
+    return (
+        f"{value:.0f} replica readmission(s) inside the flap horizon: a "
+        "replica is cycling eject → probe → readmit — failing under "
+        "load, recovering when drained",
+        {"flaps": float(value)},
+    )
+
+
 def _memory_growth(monitor: "HealthMonitor", sample: dict[str, Any]):
     limit = monitor.thresholds.mem_growth
     if limit <= 0:
@@ -464,6 +512,13 @@ def default_detectors() -> list[Detector]:
             "gateway_error_rate", "gateway", "warn", _gateway_error_rate
         ),
         Detector("breaker_open", "gateway", "warn", _breaker_open),
+        # Replicated-fleet detectors (serve/fleet.py); both quiet unless
+        # fleet gauges are present in the window.
+        Detector(
+            "replica_staleness_runaway", "fleet", "warn",
+            _replica_staleness_runaway,
+        ),
+        Detector("replica_flap", "fleet", "warn", _replica_flap),
     ]
 
 
@@ -482,6 +537,7 @@ class HealthMonitor:
         detectors: list[Detector] | None = None,
         emit: bool = True,
         recorder: Any = flightrec,
+        replica_probe: Callable[[], dict[str, Any]] | None = None,
     ):
         self.thresholds = thresholds or Thresholds()
         self.store = store
@@ -500,6 +556,10 @@ class HealthMonitor:
         # obs.setup always binds explicitly (its recorder, or None for
         # never-dump when it armed none).
         self.recorder = recorder
+        # Per-replica health source (ServeFleet.replica_verdicts when a
+        # fleet is mounted): surfaced verbatim in the /healthz payload
+        # next to the aggregate components.
+        self.replica_probe = replica_probe
         # Detector trailing state (window-close thread only).
         self.fps_history: deque[float] = deque(maxlen=32)
         self.slo_breach_run = 0
@@ -651,7 +711,7 @@ class HealthMonitor:
             if _STATUS_RANK[status] > _STATUS_RANK[worst]:
                 worst = status
         latest = self.store.latest() if self.store is not None else None
-        return {
+        doc = {
             "status": worst,
             "window": self.window_idx,
             "env_steps": (latest or {}).get("env_steps", 0),
@@ -660,6 +720,13 @@ class HealthMonitor:
             "detectors": [d.name for d in self.detectors],
             "ttl_windows": self.thresholds.window_ttl,
         }
+        if self.replica_probe is not None:
+            try:
+                doc["replicas"] = self.replica_probe()
+            # lint: broad-except-ok(a torn-down fleet must not 500 the health endpoint; the per-replica section just vanishes)
+            except Exception:
+                pass
+        return doc
 
 
 def replay(

@@ -18,7 +18,9 @@ Three pieces, one per concern:
   ``HealthMonitor``; no thread of its own), it consumes signals that
   already exist — ``learner_stall_frac`` (+ the WAIT_SPANS blame when
   tracing is armed), ``queue_backpressure`` deltas, the serve gate's
-  overload/shed counters, ``staleness_p95`` — behind hysteresis windows,
+  overload/shed counters, the external gateway's shed counters
+  (aggregate + per-tenant — client pain scales the fleet UP),
+  ``staleness_p95`` — behind hysteresis windows,
   a post-action cooldown, and hard min/max fleet bounds. Scripted scale
   requests from the chaos layer (``utils/faults.py`` ``scale`` kind)
   bypass hysteresis and cooldown but never the bounds, and at most ONE
@@ -65,6 +67,12 @@ from asyncrl_tpu.utils import faults
 # 1.0 disables the organic up signal (the stall fraction caps at exactly
 # 1.0, never exceeding it) …
 UP_STALL_FRAC = 0.5
+# scale UP when the external gateway shed at least this many requests in
+# a window (admission-gate 429s + wire-deadline sheds — CLIENT pain,
+# where the stall signal is LEARNER pain; 0 disables). Deliberately not
+# subject to the blame veto: a span blaming H2D can excuse a stall, but
+# nothing excuses turning away paying traffic.
+UP_SHED_RATE = 0.0
 # … for this many CONSECUTIVE windows (hysteresis: one noisy window is
 # not a trend).
 HYSTERESIS_WINDOWS = 2
@@ -109,7 +117,7 @@ class ScaleDecision:
     #                 one slot per window, re-queueing the remainder — a
     #                 single mutate-last slot op is what the reconfigure
     #                 barrier's restore contract covers exactly)
-    reason: str     # "stall" | "backpressure" | "admission" | "staleness" | "replay_fill" | "scripted"
+    reason: str     # "stall" | "shed_rate" | "backpressure" | "admission" | "staleness" | "replay_fill" | "scripted"
     detail: str
     scripted: bool = False
     signals: dict[str, float] = dataclasses.field(default_factory=dict)
@@ -149,6 +157,7 @@ class ElasticController:
         cooldown_windows: int = 2,
         hysteresis: int = HYSTERESIS_WINDOWS,
         up_stall_frac: float = UP_STALL_FRAC,
+        up_shed_rate: float = UP_SHED_RATE,
         down_backpressure: float = DOWN_BACKPRESSURE,
         down_admission: float = DOWN_ADMISSION,
         down_staleness_p95: float = 0.0,
@@ -171,6 +180,7 @@ class ElasticController:
         self.cooldown_windows = cooldown_windows
         self.hysteresis = max(1, hysteresis)
         self.up_stall_frac = up_stall_frac
+        self.up_shed_rate = up_shed_rate
         self.down_backpressure = down_backpressure
         self.down_admission = down_admission
         self.down_staleness_p95 = down_staleness_p95
@@ -215,9 +225,34 @@ class ElasticController:
         admit_delta = self._delta(window, "server_overload") + self._delta(
             window, "serve_shed"
         )
+        # The gateway's shed counters (admission-gate 429s + wire-deadline
+        # sheds) measure CLIENT pain. The aggregate drives the up signal;
+        # the per-tenant gate counters (``gateway_<tenant>_shed``) ride
+        # along in the decision's signals so the structured event names
+        # which SLO class was turned away.
+        tenant_shed_keys = sorted(
+            key
+            for key in window
+            if key.startswith("gateway_")
+            and key.endswith("_shed")
+            and key not in ("gateway_shed", "gateway_deadline_shed")
+        )
+        tenant_shed = {
+            key: self._delta(window, key) for key in tenant_shed_keys
+        }
+        shed_delta = self._delta(window, "gateway_shed") + self._delta(
+            window, "gateway_deadline_shed"
+        )
         self._prev = {
             key: float(window[key])
-            for key in ("queue_backpressure", "server_overload", "serve_shed")
+            for key in (
+                "queue_backpressure",
+                "server_overload",
+                "serve_shed",
+                "gateway_shed",
+                "gateway_deadline_shed",
+                *tenant_shed_keys,
+            )
             if isinstance(window.get(key), (int, float))
             and not isinstance(window.get(key), bool)
         }
@@ -264,13 +299,18 @@ class ElasticController:
 
         stall = window.get("learner_stall_frac")
         stall = float(stall) if isinstance(stall, (int, float)) else 0.0
-        up_signal = stall > self.up_stall_frac
-        if up_signal and self.blame_fn is not None:
+        stall_hit = stall > self.up_stall_frac
+        if stall_hit and self.blame_fn is not None:
             blamed = self.blame_fn()
             if blamed is not None and blamed != "actors":
                 # The stall is real but growing the fleet cannot fix it
                 # (H2D-bound, serve-bound, ...): not an up signal.
-                up_signal = False
+                stall_hit = False
+        # The shed signal is NOT blame-vetoed: span blame arbitrates which
+        # component starved the learner, but a shed request was turned
+        # away at the door — no wait-span can excuse it.
+        shed_hit = self.up_shed_rate > 0 and shed_delta >= self.up_shed_rate
+        up_signal = stall_hit or shed_hit
 
         staleness = window.get("staleness_p95")
         staleness = (
@@ -318,15 +358,36 @@ class ElasticController:
             if delta <= 0:
                 return None  # already at max_actors
             self._cooldown = self.cooldown_windows
+            # Blame the signal that fired THIS window (the down branch's
+            # convention); stall wins a tie — it is the primary signal.
+            if stall_hit:
+                return ScaleDecision(
+                    direction="up",
+                    delta=delta,
+                    reason="stall",
+                    detail=(
+                        f"learner starved {100.0 * stall:.0f}% of the window "
+                        f"for {self.hysteresis} consecutive windows"
+                    ),
+                    signals={"learner_stall_frac": stall},
+                )
             return ScaleDecision(
                 direction="up",
                 delta=delta,
-                reason="stall",
+                reason="shed_rate",
                 detail=(
-                    f"learner starved {100.0 * stall:.0f}% of the window "
-                    f"for {self.hysteresis} consecutive windows"
+                    f"gateway shed {shed_delta:.0f} requests/window for "
+                    f"{self.hysteresis} consecutive windows (clients turned "
+                    "away at the door)"
                 ),
-                signals={"learner_stall_frac": stall},
+                signals={
+                    "gateway_shed_delta": shed_delta,
+                    "learner_stall_frac": stall,
+                    **{
+                        f"{key}_delta": value
+                        for key, value in tenant_shed.items()
+                    },
+                },
             )
         if self._down_run >= self.hysteresis:
             delta = self._clamp(live, -1)

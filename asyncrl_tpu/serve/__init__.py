@@ -18,6 +18,12 @@ into a serving core that could face external traffic:
   per-tenant SLO classes, graceful degradation, netfault chaos).
 - :mod:`asyncrl_tpu.serve.client` — :class:`GatewayClient`, the calling
   side: bounded retry + jittered backoff + per-endpoint circuit breakers.
+- :mod:`asyncrl_tpu.serve.fleet` — the replicated serving tier:
+  :class:`ServeFleet` (N replicas, decoupled per-replica weight sync,
+  staleness bounds, supervised rebuild), :class:`FleetRouter`
+  (health-checked failover routing inside the wire budget),
+  :class:`CanaryController` (version splits with auto-promote /
+  auto-rollback), :class:`ParamFeed` (the learner's version stream).
 
 ``SebulbaTrainer`` mounts the serve core behind ``config.serve`` (default
 on; ``ASYNCRL_SERVE`` env overrides) wherever ``config.inference_server``
@@ -35,12 +41,20 @@ from asyncrl_tpu.serve.client import (
     GatewayShed,
     GatewayUnavailable,
 )
+from asyncrl_tpu.serve.fleet import (
+    CanaryController,
+    FleetRouter,
+    ParamFeed,
+    Replica,
+    ServeFleet,
+)
 from asyncrl_tpu.serve.gateway import (
     CoreBackend,
     GatewayDegraded,
     GatewaySpecError,
     ServeGateway,
     TenantClass,
+    bucket_rows,
     parse_tenant_spec,
 )
 from asyncrl_tpu.serve.params import ParamSlots
@@ -50,14 +64,17 @@ from asyncrl_tpu.serve.router import (
     UnknownPolicyError,
     selfplay_policies,
 )
-from asyncrl_tpu.serve.scheduler import ServeCore
+from asyncrl_tpu.serve.scheduler import DispatchTimeout, ServeCore
 from asyncrl_tpu.serve.slo import RequestShed, SLOGate
 
 __all__ = [
     "DEFAULT_POLICY",
     "BreakerOpen",
+    "CanaryController",
     "CircuitBreaker",
     "CoreBackend",
+    "DispatchTimeout",
+    "FleetRouter",
     "GatewayClient",
     "GatewayDegraded",
     "GatewayRequestError",
@@ -65,14 +82,18 @@ __all__ = [
     "GatewayShed",
     "GatewaySpecError",
     "GatewayUnavailable",
+    "ParamFeed",
     "ParamSlots",
     "PolicyRouter",
+    "Replica",
     "RequestShed",
     "SLOGate",
     "ServeCore",
+    "ServeFleet",
     "ServeGateway",
     "TenantClass",
     "UnknownPolicyError",
+    "bucket_rows",
     "parse_tenant_spec",
     "selfplay_policies",
 ]

@@ -101,6 +101,10 @@ class GatewayResult:
     fallback: bool = False
     latency_ms: float = 0.0
     attempts: int = 1
+    # Which fleet replica served the response ("" when the backend is a
+    # single core) — the per-response provenance the canary/mixing
+    # assertions read.
+    replica: str = ""
     raw: dict = field(default_factory=dict)
 
 
@@ -438,6 +442,7 @@ class GatewayClient:
                 fallback=bool(doc.get("fallback", False)),
                 latency_ms=float(doc.get("latency_ms", 0.0)),
                 attempts=attempts,
+                replica=str(doc.get("replica", "") or ""),
                 raw=doc,
             )
         except (ValueError, TypeError, KeyError) as e:

@@ -1,0 +1,1093 @@
+"""Replicated serving fleet: N serve cores, one param stream, zero mixing.
+
+PR 15 hardened the wire boundary, but behind it still sat ONE serve core:
+one replica death took the whole serving tier down. This module is the
+ROADMAP's replicated tier — Laminar's fully-decoupled per-replica weight
+sync (PAPERS.md, arXiv:2510.12633: each inference replica installs new
+weights on its OWN schedule, no global barrier, staleness bounded and
+exported) layered on the actor/learner decoupling of "Parallel Actors
+and Learners" (arXiv:2110.01101). Four pieces:
+
+- :class:`ParamFeed` — the learner-side publish stream. Every publish is
+  a monotone **version**; the last few versions stay resident so lagging
+  replicas and canary pins can still install something the feed has
+  already moved past.
+- :class:`Replica` — one serve core + its own :class:`PolicyRouter`
+  (``serve/params.py`` generation slots per replica, so a dispatch leases
+  ONE generation and mixed batches stay impossible by construction), a
+  local-generation → feed-version ledger for response provenance, a
+  decoupled sync schedule, and a health typestate:
+  ``serving → ejected → probe → serving`` (half-open readmission, the
+  same discipline as the client breaker in serve/client.py).
+- :class:`CanaryController` — router-level version splits: generation g
+  and g+1 on DISJOINT replicas, per-version action distributions + error
+  rates over a sliding window, auto-promote on agreement, auto-rollback
+  (with a version veto) on divergence or error-rate breach.
+- :class:`FleetRouter` — the gateway backend (duck-type of
+  ``CoreBackend``): health-checked replica choice, failover inside the
+  REMAINING wire budget (per-attempt even split, so a hung replica can
+  never eat the whole deadline), rate-bucket-exact shed semantics (a
+  shed re-raises so the gateway refunds, PR-15 accounting unchanged),
+  and per-response ``replica`` + version stamping.
+
+Chaos: the ``fleet.replica`` site (utils/faults.py, the new ``replica``
+kind) fires on the fleet's maintenance tick; the fleet enacts the
+scripted mode — ``kill`` (the core dies and is supervised back up),
+``hang`` (the inference path wedges; external requests fail over on
+:class:`DispatchTimeout`), ``lag`` (weight sync wedges; the staleness cap
+ejects the replica before it serves beyond the bound).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, OrderedDict, deque
+from typing import Any, Callable
+
+import numpy as np
+
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.rollout.inference_server import ServerClosed
+from asyncrl_tpu.serve.gateway import GatewayDegraded, bucket_rows
+from asyncrl_tpu.serve.router import DEFAULT_POLICY, PolicyRouter
+from asyncrl_tpu.serve.scheduler import DispatchTimeout, ServeCore
+from asyncrl_tpu.serve.slo import RequestShed
+from asyncrl_tpu.utils import faults
+
+
+class ParamFeed:
+    """The learner's published-version stream, fleet edition of
+    ``ParamStore``: every :meth:`publish` stamps a monotone version, and
+    the last ``history`` versions stay resident so a lagging replica or
+    a canary pin can still install a version the feed has moved past.
+    A version older than the retention window raises ``KeyError`` — the
+    caller falls back to latest (an honest catch-up, never a silent
+    serve of freed weights)."""
+
+    def __init__(self, params: Any, history: int = 4):
+        if history < 2:
+            raise ValueError(f"history must be >= 2, got {history}")
+        self._lock = threading.Lock()
+        self._history = history
+        self._versions: "OrderedDict[int, Any]" = OrderedDict()  # guarded-by: _lock
+        self._versions[0] = params
+        self._latest = 0  # guarded-by: _lock
+
+    def publish(self, params: Any) -> int:
+        with self._lock:
+            self._latest += 1
+            self._versions[self._latest] = params
+            while len(self._versions) > self._history:
+                self._versions.popitem(last=False)
+            return self._latest
+
+    def get(self, version: int) -> Any:
+        with self._lock:
+            return self._versions[version]
+
+    def latest(self) -> tuple[Any, int]:
+        with self._lock:
+            return self._versions[self._latest], self._latest
+
+    def version(self) -> int:
+        with self._lock:
+            return self._latest
+
+
+class Replica:
+    """One fleet member: its own router + serve core + health typestate.
+
+    The router OUTLIVES core rebuilds: a killed core's replacement serves
+    the same :class:`ParamSlots`, so the replica's installed version and
+    its generation → version ledger survive the restart.
+
+    Health states (``state``): ``"serving"`` (in rotation), ``"ejected"``
+    (out of rotation; ``eject_reason`` says why — consecutive
+    ``"failures"``, ``"staleness"`` beyond the cap, or a ``"dead"``
+    core), ``"probe"`` (half-open: the router routed it ONE trial
+    request; success readmits, failure re-ejects with a fresh backoff
+    clock, a plain shed aborts the probe without judging health)."""
+
+    def __init__(
+        self,
+        name: str,
+        inference_fn: Callable,
+        feed: ParamFeed,
+        *,
+        mode: str = "ff",
+        deadline_ms: float = 2.0,
+        max_batch_rows: int = 0,
+        seed: int = 0,
+        sync_interval_s: float = 0.0,
+    ):
+        self.name = name
+        self._raw_fn = inference_fn
+        self._feed = feed
+        self._mode = mode
+        self._deadline_ms = deadline_ms
+        self._max_rows = max_batch_rows
+        self._seed = seed
+        self.sync_interval_s = sync_interval_s
+        self._lock = threading.Lock()
+        params, version = feed.latest()
+        self.router = PolicyRouter()
+        gen = self.router.install(DEFAULT_POLICY, params)
+        self._version = version  # guarded-by: _lock
+        # Local generation -> feed version: the provenance ledger a
+        # response's generation stamp resolves through (pruned against
+        # the router's resident generations on every sync).
+        self._gen_version: dict[int, int] = {gen: version}  # guarded-by: _lock
+        # Canary pin: None follows the feed's latest; a version pins the
+        # sync target (written by the fleet tick only).
+        # lint: thread-shared-ok(GIL-atomic value; single-writer fleet tick, readers tolerate one-tick lag)
+        self.target: int | None = None
+        self._next_sync = 0.0  # fleet-tick-thread only
+        # Chaos enactments: monotonic deadlines the hang gate / sync path
+        # compare against.
+        # lint: thread-shared-ok(GIL-atomic float stamp; fleet tick writes, serve thread reads)
+        self._hang_until = 0.0
+        # lint: thread-shared-ok(GIL-atomic float stamp; fleet tick writes and reads)
+        self._lag_until = 0.0
+        # Health typestate (see class doc).
+        self.state = "serving"  # guarded-by: _lock
+        self.eject_reason = ""  # guarded-by: _lock
+        self.consecutive_failures = 0  # guarded-by: _lock
+        self.ejections = 0  # guarded-by: _lock
+        self.readmissions = 0  # guarded-by: _lock
+        self.restarts = 0  # guarded-by: _lock
+        self._flap_stamps: "deque[float]" = deque()  # guarded-by: _lock
+        self._ejected_at = 0.0  # guarded-by: _lock
+        self.started = False  # lint: thread-shared-ok(GIL-atomic flag; set once at start)
+        self._core_stop = threading.Event()
+        self.core = self._make_core()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def _make_core(self) -> ServeCore:
+        self._core_stop = threading.Event()
+        return ServeCore(
+            self._gated_fn,
+            store=None,
+            num_clients=1,
+            stop_event=self._core_stop,
+            mode=self._mode,
+            seed=self._seed,
+            deadline_ms=self._deadline_ms,
+            router=self.router,
+            max_batch_rows=self._max_rows,
+            name=f"serve-core-{self.name}",
+        )
+
+    def _gated_fn(self, params, *rest):
+        """The replica's inference path with the ``hang`` chaos gate in
+        front: while a hang is scripted, the serve thread wedges here —
+        external requests observe :class:`DispatchTimeout` and fail over,
+        which is exactly what a real stuck accelerator call looks like.
+        The gate re-reads ``_hang_until`` each slice so ``stop()``/
+        ``kill()`` can cancel a long hang instantly."""
+        while True:
+            until = self._hang_until
+            now = time.monotonic()
+            if now >= until or self._core_stop.is_set():
+                break
+            time.sleep(min(0.05, until - now))
+        return self._raw_fn(params, *rest)
+
+    def start(self) -> None:
+        self.started = True
+        self.core.start()
+
+    def stop(self) -> None:
+        """Clean stop (teardown, not chaos): no fatal latch — pending
+        waiters observe an ordinary ``ServerClosed``."""
+        self._hang_until = 0.0
+        self._lag_until = 0.0
+        self._core_stop.set()
+
+    def kill(self) -> None:
+        """The ``replica`` chaos kind's ``kill`` mode: abrupt core death
+        (fatal latch + stop), supervised back up by the fleet tick."""
+        self._hang_until = 0.0
+        self.core.kill(ServerClosed(f"replica {self.name} killed (chaos)"))
+
+    def rebuild(self) -> None:
+        """Supervised restart after core death: a NEW core (fresh stop
+        event) over the SAME router — installed weights and the
+        generation ledger survive, exactly like the trainer's serve-core
+        rebuild."""
+        self._hang_until = 0.0
+        self.core = self._make_core()
+        with self._lock:
+            self.restarts += 1
+        if self.started:
+            self.core.start()
+
+    def enact(self, fault: faults.ReplicaFault) -> None:
+        """Apply one scripted ``fleet.replica`` fire to this replica."""
+        if fault.mode == "kill":
+            self.kill()
+        elif fault.mode == "hang":
+            self._hang_until = time.monotonic() + fault.stall_s
+        elif fault.mode == "lag":
+            self._lag_until = time.monotonic() + fault.stall_s
+
+    # --------------------------------------------------------- weight sync
+
+    def maybe_sync(self, now: float | None = None) -> bool:
+        """Decoupled per-replica sync schedule (the Laminar discipline):
+        install only when THIS replica's interval elapsed — replicas
+        deliberately do not swap in lockstep."""
+        now = time.monotonic() if now is None else now
+        if now < self._next_sync:
+            return False
+        self._next_sync = now + self.sync_interval_s
+        return self.sync()
+
+    def sync(self) -> bool:
+        """Install the sync target (the canary pin, else the feed's
+        latest). A scripted ``lag`` wedges this path — the replica keeps
+        serving its installed version while its staleness grows toward
+        the cap. Returns True when a new version was installed."""
+        if time.monotonic() < self._lag_until:
+            return False
+        target = self.target
+        if target is None:
+            params, version = self._feed.latest()
+        else:
+            try:
+                params = self._feed.get(target)
+                version = target
+            except KeyError:
+                # Pin fell out of the feed's retention window: catch up
+                # to latest rather than serve nothing.
+                params, version = self._feed.latest()
+        with self._lock:
+            if version == self._version:
+                return False
+        gen = self.router.install(DEFAULT_POLICY, params)
+        with self._lock:
+            self._version = version
+            self._gen_version[gen] = version
+            resident = set(
+                self.router.slots(DEFAULT_POLICY).generations()
+            )
+            for g in [g for g in self._gen_version if g not in resident]:
+                del self._gen_version[g]
+        return True
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def version_of(self, generation: int) -> int:
+        """Resolve a local param generation to its feed version (the
+        provenance stamp responses carry)."""
+        with self._lock:
+            return self._gen_version.get(generation, self._version)
+
+    def staleness(self) -> int:
+        """Versions behind the replica's TARGET (its canary pin, else
+        the feed's latest): the bounded-staleness contract's measure. A
+        pinned replica holding its pin is 0-stale by definition."""
+        target = self.target
+        with self._lock:
+            goal = target if target is not None else self._feed.version()
+            return max(goal - self._version, 0)
+
+    # ------------------------------------------------------------- health
+
+    def record_failure(self, eject_after: int) -> str | None:
+        """One failed request against this replica. Returns ``"ejected"``
+        on the serving → ejected transition, ``"probe_failed"`` when a
+        half-open probe failed (re-ejected, fresh backoff clock), else
+        None."""
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == "probe":
+                self.state = "ejected"
+                self._ejected_at = time.monotonic()
+                return "probe_failed"
+            if (
+                self.state == "serving"
+                and self.consecutive_failures >= eject_after
+            ):
+                self._eject_locked("failures")
+                return "ejected"
+        return None
+
+    def record_success(self) -> bool:
+        """One served request. Returns True on the probe → serving
+        readmission transition (the flap the health detector counts)."""
+        with self._lock:
+            self.consecutive_failures = 0
+            if self.state == "probe":
+                self.state = "serving"
+                self.eject_reason = ""
+                self.readmissions += 1
+                self._flap_stamps.append(time.monotonic())
+                return True
+        return False
+
+    def eject(self, reason: str) -> bool:
+        with self._lock:
+            if self.state in ("serving", "probe"):
+                self._eject_locked(reason)
+                return True
+        return False
+
+    def _eject_locked(self, reason: str) -> None:  # holds: _lock
+        self.state = "ejected"
+        self.eject_reason = reason
+        self._ejected_at = time.monotonic()
+        self.ejections += 1
+
+    def readmit(self) -> bool:
+        """Direct readmission (no probe): the staleness-ejection recovery
+        path — a replica that caught back up is healthy by construction,
+        it does not need a trial request."""
+        with self._lock:
+            if self.state == "serving":
+                return False
+            self.state = "serving"
+            self.eject_reason = ""
+            self.consecutive_failures = 0
+            self.readmissions += 1
+            self._flap_stamps.append(time.monotonic())
+            return True
+
+    def begin_probe(self, readmit_after_s: float) -> bool:
+        """Claim the half-open trial slot: only an ejected-for-failures
+        (or dead-then-rebuilt) replica past its backoff becomes the
+        probe. Staleness ejections readmit via :meth:`readmit` when they
+        catch up — probing one would serve bounded-stale weights."""
+        with self._lock:
+            if self.state != "ejected":
+                return False
+            if self.eject_reason not in ("failures", "dead"):
+                return False
+            if time.monotonic() - self._ejected_at < readmit_after_s:
+                return False
+            self.state = "probe"
+            return True
+
+    def probe_abort(self) -> None:
+        """The probe request was SHED (load, not sickness): back to
+        ejected with the backoff clock UNCHANGED — eligible again on the
+        next request."""
+        with self._lock:
+            if self.state == "probe":
+                self.state = "ejected"
+
+    def flaps(self, horizon_s: float = 60.0) -> int:
+        """Readmissions inside the horizon — the flap-detector signal
+        (repeated eject/readmit cycles are a sick replica oscillating
+        through the probe door)."""
+        now = time.monotonic()
+        with self._lock:
+            while (
+                self._flap_stamps
+                and now - self._flap_stamps[0] > horizon_s
+            ):
+                self._flap_stamps.popleft()
+            return len(self._flap_stamps)
+
+
+def _tvd(a, b) -> float:
+    """Total variation distance between two empirical (discretized)
+    action distributions — the canary's divergence measure."""
+    ca, cb = Counter(a), Counter(b)
+    na, nb = sum(ca.values()), sum(cb.values())
+    if not na or not nb:
+        return 0.0
+    return 0.5 * sum(
+        abs(ca[k] / na - cb[k] / nb) for k in set(ca) | set(cb)
+    )
+
+
+class CanaryController:
+    """Version-split state machine: stable ↔ canary.
+
+    While a canary is active, the fleet pins the canary members to the
+    candidate version and everyone else to the stable version (disjoint
+    replica sets — the generation-lease machinery then guarantees no
+    batch mixes them). The router records every response's served
+    version and action sample here; :meth:`evaluate` compares the two
+    sliding windows:
+
+    - **rollback** when the candidate's error rate exceeds the stable's
+      by more than ``error_rate``, or the action distributions diverge
+      past ``divergence`` (total variation distance) — the candidate
+      version is VETOED so the fleet never follows it again;
+    - **promote** when both windows have ``min_serves`` samples and
+      agree — stable becomes the candidate, pins clear, every replica
+      follows latest again.
+    """
+
+    def __init__(
+        self,
+        *,
+        window: int = 64,
+        min_serves: int = 8,
+        divergence: float = 0.5,
+        error_rate: float = 0.5,
+        share: int = 4,
+    ):
+        if min_serves > window:
+            # The sample deques cap at ``window`` rows, so a verdict
+            # gate above that can NEVER be met: the canary would run
+            # forever without promoting or rolling back.
+            raise ValueError(
+                f"min_serves ({min_serves}) must be <= window ({window})"
+            )
+        self.window = window
+        self.min_serves = min_serves
+        self.divergence = divergence
+        self.error_rate = error_rate
+        # 1-in-share requests route to the canary group (deterministic
+        # counter split, no RNG: replayable in tests and smoke acts).
+        self.share = max(int(share), 2)
+        self._lock = threading.Lock()
+        self._state = "stable"  # guarded-by: _lock
+        self.stable_version: int | None = None  # guarded-by: _lock
+        self.canary_version: int | None = None  # guarded-by: _lock
+        self._members: tuple[str, ...] = ()  # guarded-by: _lock
+        self._vetoed: set[int] = set()  # guarded-by: _lock
+        self._actions: dict[int, deque] = {}  # guarded-by: _lock
+        self._outcomes: dict[int, deque] = {}  # guarded-by: _lock
+        self._split = 0  # guarded-by: _lock
+        self.history: "deque[tuple[str, int]]" = deque(maxlen=64)  # guarded-by: _lock
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._state == "canary"
+
+    @property
+    def members(self) -> tuple[str, ...]:
+        with self._lock:
+            return self._members
+
+    def vetoed(self) -> frozenset:
+        with self._lock:
+            return frozenset(self._vetoed)
+
+    def begin(
+        self, stable: int, candidate: int, members: tuple[str, ...]
+    ) -> bool:
+        with self._lock:
+            if self._state == "canary" or candidate in self._vetoed:
+                return False
+            if not members:
+                return False
+            self.stable_version = stable
+            self.canary_version = candidate
+            self._members = tuple(members)
+            self._state = "canary"
+            self._actions = {
+                stable: deque(maxlen=self.window),
+                candidate: deque(maxlen=self.window),
+            }
+            self._outcomes = {
+                stable: deque(maxlen=self.window),
+                candidate: deque(maxlen=self.window),
+            }
+            self.history.append(("begin", candidate))
+            return True
+
+    def record(self, version: int, actions, error: bool) -> None:
+        """One response (or one failed request) served under ``version``.
+        Quietly ignores versions outside the live pair — a failover onto
+        an old generation mid-swap must not poison either window."""
+        with self._lock:
+            if self._state != "canary":
+                return
+            outcomes = self._outcomes.get(version)
+            if outcomes is None:
+                return
+            outcomes.append(1.0 if error else 0.0)
+            if actions is not None and not error:
+                window = self._actions[version]
+                for v in np.asarray(actions).reshape(-1)[: self.window]:
+                    window.append(int(v))
+
+    def evaluate(self) -> str | None:
+        """``"rollback"`` | ``"promote"`` | None (keep sampling)."""
+        with self._lock:
+            if self._state != "canary":
+                return None
+            out_s = self._outcomes.get(self.stable_version, ())
+            out_c = self._outcomes.get(self.canary_version, ())
+            if len(out_c) >= self.min_serves:
+                err_c = sum(out_c) / len(out_c)
+                err_s = sum(out_s) / len(out_s) if out_s else 0.0
+                if err_c - err_s > self.error_rate:
+                    return "rollback"
+            act_s = self._actions.get(self.stable_version, ())
+            act_c = self._actions.get(self.canary_version, ())
+            if (
+                len(act_s) >= self.min_serves
+                and len(act_c) >= self.min_serves
+            ):
+                if _tvd(act_s, act_c) > self.divergence:
+                    return "rollback"
+                return "promote"
+            return None
+
+    def promote(self) -> int | None:
+        with self._lock:
+            if self._state != "canary":
+                return None
+            promoted = self.canary_version
+            self.stable_version = promoted
+            self._reset_locked()
+            self.history.append(("promote", promoted))
+            return promoted
+
+    def rollback(self) -> int | None:
+        with self._lock:
+            if self._state != "canary":
+                return None
+            vetoed = self.canary_version
+            self._vetoed.add(vetoed)
+            self._reset_locked()
+            self.history.append(("rollback", vetoed))
+            return vetoed
+
+    def _reset_locked(self) -> None:  # holds: _lock
+        self._state = "stable"
+        self.canary_version = None
+        self._members = ()
+        self._actions = {}
+        self._outcomes = {}
+
+    def pin_for(self, name: str, latest: int) -> int | None:
+        """The sync target the fleet applies to replica ``name``: the
+        candidate for canary members, the stable version for everyone
+        else while a canary is live or while the feed's latest is a
+        vetoed version; None (follow latest) otherwise."""
+        with self._lock:
+            if self._state == "canary":
+                if name in self._members:
+                    return self.canary_version
+                return self.stable_version
+            if latest in self._vetoed and self.stable_version is not None:
+                return self.stable_version
+            return None
+
+    def route_canary(self) -> bool:
+        """Deterministic 1-in-``share`` traffic split toward the canary
+        group for the next request."""
+        with self._lock:
+            if self._state != "canary":
+                return False
+            self._split += 1
+            return self._split % self.share == 0
+
+
+class ServeFleet:
+    """N replicas + the maintenance tick that keeps them honest.
+
+    The tick (its own ``fleet-maint`` thread, or caller-driven via
+    :meth:`tick` when ``auto_tick=False`` — deterministic tests) runs the
+    whole control loop: fire/enact ``fleet.replica`` chaos, supervise
+    dead cores back up, apply canary pins, run each replica's decoupled
+    weight sync, enforce the staleness cap (eject at the bound, readmit
+    on catch-up), drive the canary state machine, and export the fleet
+    gauges. Instruments are created HERE, not at import — a process with
+    no fleet has zero ``fleet_*`` keys in its metrics window."""
+
+    def __init__(
+        self,
+        inference_fn: Callable,
+        feed: ParamFeed,
+        num_replicas: int = 2,
+        *,
+        mode: str = "ff",
+        deadline_ms: float = 2.0,
+        max_batch_rows: int = 0,
+        seed: int = 0,
+        staleness_cap: int = 4,
+        sync_interval_s: float = 0.0,
+        eject_failures: int = 3,
+        readmit_after_s: float = 0.25,
+        canary: CanaryController | None = None,
+        auto_tick: bool = True,
+        tick_interval_s: float = 0.05,
+    ):
+        if num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {num_replicas}"
+            )
+        if staleness_cap < 1:
+            raise ValueError(
+                f"staleness_cap must be >= 1, got {staleness_cap}"
+            )
+        self.inference_fn = inference_fn
+        self.feed = feed
+        self.staleness_cap = staleness_cap
+        self.eject_failures = eject_failures
+        self.readmit_after_s = readmit_after_s
+        self.canary = canary
+        if canary is not None and canary.stable_version is None:
+            canary.stable_version = feed.version()
+        self._auto_tick = auto_tick
+        self._tick_interval_s = tick_interval_s
+        self._stop = threading.Event()
+        self._maint: threading.Thread | None = None
+        self.replicas = [
+            Replica(
+                f"r{i}",
+                inference_fn,
+                feed,
+                mode=mode,
+                deadline_ms=deadline_ms,
+                max_batch_rows=max_batch_rows,
+                seed=seed + i,
+                sync_interval_s=sync_interval_s,
+            )
+            for i in range(num_replicas)
+        ]
+        # Chaos handle: one fetch, None when unarmed (the faults.py
+        # convention — the tick then pays a single identity check).
+        self._fault_replica = faults.site("fleet.replica")
+        self._g_live = obs_registry.gauge("fleet_replicas_live")
+        self._g_stale_max = obs_registry.gauge("fleet_staleness_max")
+        self._g_stale_cap = obs_registry.gauge("fleet_staleness_cap")
+        self._g_flaps = obs_registry.gauge("fleet_replica_flaps")
+        self._g_replica_stale = {
+            r.name: obs_registry.gauge(f"fleet_{r.name}_staleness")
+            for r in self.replicas
+        }
+        self._c_ejections = obs_registry.counter("fleet_ejections")
+        self._c_readmissions = obs_registry.counter("fleet_readmissions")
+        self._c_promotions = obs_registry.counter("fleet_promotions")
+        self._c_rollbacks = obs_registry.counter("fleet_rollbacks")
+        self._c_restarts = obs_registry.counter("fleet_replica_restarts")
+        self._g_stale_cap.set(float(staleness_cap))
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        for replica in self.replicas:
+            replica.start()
+        if self._auto_tick:
+            self._maint = threading.Thread(
+                target=self._maint_loop, name="fleet-maint", daemon=True
+            )
+            self._maint.start()
+
+    def _maint_loop(self) -> None:  # thread-entry: fleet-maint@fleet
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(self._tick_interval_s)
+
+    def tick(self) -> None:
+        """One maintenance round (see class doc). Order matters: canary
+        begin runs BEFORE the sync pass so a fresh candidate version is
+        pinned to its members before any stable replica could follow the
+        feed's latest onto it."""
+        # 1. Chaos: fire the fleet.replica site, enact on the target.
+        if self._fault_replica is not None:
+            try:
+                self._fault_replica.fire(stop=self._stop.is_set)
+            except faults.ReplicaFault as fault:
+                target = self._chaos_target(fault.replica)
+                if target is not None:
+                    target.enact(fault)
+        # 2. Supervise: a started core that is no longer alive died
+        # (chaos kill or a real crash) — eject and rebuild.
+        for replica in self.replicas:
+            if replica.started and not replica.core.is_alive():
+                if replica.eject("dead"):
+                    self._c_ejections.inc()
+                replica.rebuild()
+                self._c_restarts.inc()
+        latest = self.feed.version()
+        # 3. Canary state machine: begin on a fresh un-vetoed version
+        # (needs >= 2 serving replicas so the split is disjoint), else
+        # evaluate the live windows.
+        if self.canary is not None:
+            canary = self.canary
+            if not canary.active:
+                stable = (
+                    canary.stable_version
+                    if canary.stable_version is not None
+                    else latest
+                )
+                serving = [
+                    r.name for r in self.replicas if r.state == "serving"
+                ]
+                if (
+                    latest > stable
+                    and latest not in canary.vetoed()
+                    and len(serving) >= 2
+                ):
+                    canary.begin(stable, latest, (serving[-1],))
+            else:
+                verdict = canary.evaluate()
+                if verdict == "promote":
+                    if canary.promote() is not None:
+                        self._c_promotions.inc()
+                elif verdict == "rollback":
+                    if canary.rollback() is not None:
+                        self._c_rollbacks.inc()
+        # 4. Pins + decoupled weight sync.
+        now = time.monotonic()
+        for replica in self.replicas:
+            if self.canary is not None:
+                replica.target = self.canary.pin_for(replica.name, latest)
+            replica.maybe_sync(now)
+        # 5. Staleness bound: eject AT the cap (never serve beyond it),
+        # readmit directly on catch-up; export per-replica lag.
+        worst = 0
+        for replica in self.replicas:
+            lag = replica.staleness()
+            worst = max(worst, lag)
+            self._g_replica_stale[replica.name].set(float(lag))
+            if replica.state == "serving" and lag >= self.staleness_cap:
+                if replica.eject("staleness"):
+                    self._c_ejections.inc()
+            elif (
+                replica.state == "ejected"
+                and replica.eject_reason == "staleness"
+                and lag < self.staleness_cap
+            ):
+                if replica.readmit():
+                    self._c_readmissions.inc()
+        # 6. Fleet gauges.
+        self._g_live.set(float(len(self.serving_replicas())))
+        self._g_stale_max.set(float(worst))
+        self._g_flaps.set(
+            float(sum(r.flaps() for r in self.replicas))
+        )
+
+    def _chaos_target(self, name: str) -> Replica | None:
+        """Resolve a scripted fire to its victim: the named replica; or,
+        unnamed, an active canary member (replica death mid-canary is
+        THE scripted scenario), else the first serving replica, else the
+        first replica."""
+        if name:
+            for replica in self.replicas:
+                if replica.name == name:
+                    return replica
+            return None
+        if self.canary is not None and self.canary.active:
+            members = set(self.canary.members)
+            for replica in self.replicas:
+                if replica.name in members:
+                    return replica
+        for replica in self.replicas:
+            if replica.state == "serving":
+                return replica
+        return self.replicas[0] if self.replicas else None
+
+    def serving_replicas(self) -> list[Replica]:
+        return [
+            r for r in self.replicas
+            if r.state == "serving" and r.core.serving()
+        ]
+
+    def next_probe(self) -> Replica | None:
+        """Claim at most one half-open probe for the next request."""
+        for replica in self.replicas:
+            if replica.begin_probe(self.readmit_after_s):
+                return replica
+        return None
+
+    def note_success(self, replica: Replica) -> None:
+        if replica.record_success():
+            self._c_readmissions.inc()
+
+    def note_failure(self, replica: Replica) -> None:
+        if self.canary is not None:
+            self.canary.record(replica.version, None, error=True)
+        if replica.record_failure(self.eject_failures) == "ejected":
+            self._c_ejections.inc()
+
+    def replica_verdicts(self) -> dict[str, dict]:
+        """Per-replica health doc for /healthz (obs/health.py's
+        ``replica_probe``)."""
+        docs: dict[str, dict] = {}
+        for r in self.replicas:
+            docs[r.name] = {
+                "state": r.state,
+                "reason": r.eject_reason,
+                "version": r.version,
+                "staleness": r.staleness(),
+                "consecutive_failures": r.consecutive_failures,
+                "ejections": r.ejections,
+                "readmissions": r.readmissions,
+                "restarts": r.restarts,
+                "flaps_60s": r.flaps(),
+            }
+        return docs
+
+    def drain(self, timeout_s: float = 5.0, stop=None) -> bool:
+        """Fleet-level drain: every replica's router drains under ONE
+        shared deadline (the PR-15 finite-deadline discipline) — a hung
+        replica eats the budget, it never multiplies it."""
+        deadline = time.monotonic() + timeout_s
+        ok = True
+        for replica in self.replicas:
+            remaining = deadline - time.monotonic()
+            ok = (
+                replica.router.drain(max(remaining, 0.0), stop=stop)
+                and ok
+            )
+        return ok
+
+    def close(self, timeout_s: float = 2.0) -> None:
+        """Bounded teardown: stop the tick, stop every core, join what
+        joins inside the budget, drain the remainder."""
+        deadline = time.monotonic() + timeout_s
+        self._stop.set()
+        if self._maint is not None:
+            self._maint.join(
+                timeout=max(deadline - time.monotonic(), 0.0)
+            )
+        for replica in self.replicas:
+            replica.stop()
+        for replica in self.replicas:
+            replica.core.join(
+                timeout=max(deadline - time.monotonic(), 0.05)
+            )
+        self.drain(max(deadline - time.monotonic(), 0.0))
+
+
+class FleetRouter:
+    """The fleet as a gateway backend (``CoreBackend`` duck-type).
+
+    Per request: order the candidates — one half-open probe first (it
+    gets exactly one trial request), then the primary group (the canary
+    split's pick, rotated round-robin), then the other group as failover
+    targets — and walk them inside the wire budget with a per-attempt
+    EVEN SPLIT of whatever budget remains, so a hung first replica can
+    never starve the failover of deadline. Failure accounting matches
+    the breaker discipline: a :class:`DispatchTimeout` or error counts
+    against the replica's health; a plain shed is load, not sickness
+    (a shed probe aborts without judging). When every candidate is
+    exhausted, the LAST SHED re-raises (the gateway 429s and refunds the
+    rate-bucket token, the PR-15 accounting exactly), else the request
+    degrades honestly."""
+
+    def __init__(
+        self, fleet: ServeFleet, obs_shape: tuple[int, ...], seed: int = 0
+    ):
+        self.fleet = fleet
+        self.obs_shape = tuple(obs_shape)
+        self._seed = seed
+        self._lock = threading.Lock()
+        self._rr = 0  # guarded-by: _lock
+        # policy -> (slots, generation, feed version, replica name): the
+        # serve-stale anchor, a HELD lease exactly like CoreBackend's.
+        self._anchors: dict[str, tuple] = {}  # guarded-by: _lock
+        # Lazy PRNG key: the jax import is deferred to first stale serve.
+        self._key = None  # guarded-by: _lock
+        self._c_failover = obs_registry.counter("fleet_failovers")
+
+    # ------------------------------------------------------------ serving
+
+    def latency_estimate_ms(self) -> float:
+        """The most optimistic serving replica's rolling p95 — the
+        deadline-feasibility estimate. Optimistic is correct here: the
+        router fails over, so a request is feasible if ANY replica can
+        make the deadline. 0.0 (no signal) when nothing is serving."""
+        estimates = [
+            r.core.slo.p95_ms() for r in self.fleet.serving_replicas()
+        ]
+        estimates = [e for e in estimates if e > 0.0]
+        return min(estimates) if estimates else 0.0
+
+    def _order(self) -> list[Replica]:
+        fleet = self.fleet
+        probe = fleet.next_probe()
+        serving = fleet.serving_replicas()
+        canary = fleet.canary
+        with self._lock:
+            self._rr += 1
+            rotation = self._rr
+
+        def rotate(group: list[Replica]) -> list[Replica]:
+            if not group:
+                return group
+            k = rotation % len(group)
+            return group[k:] + group[:k]
+
+        if canary is not None and canary.active:
+            members = set(canary.members)
+            canary_group = [r for r in serving if r.name in members]
+            stable_group = [r for r in serving if r.name not in members]
+            if canary.route_canary() and canary_group:
+                order = rotate(canary_group) + rotate(stable_group)
+            else:
+                order = rotate(stable_group) + rotate(canary_group)
+        else:
+            order = rotate(serving)
+        if probe is not None:
+            order = [probe] + [r for r in order if r is not probe]
+        return order
+
+    def act(
+        self, policy: str, obs: np.ndarray, deadline_ms: float
+    ) -> tuple[np.ndarray, np.ndarray, int, dict]:
+        fleet = self.fleet
+        rows = obs.shape[0]
+        padded = bucket_rows(obs)
+        deadline = time.monotonic() + deadline_ms / 1e3
+        order = self._order()
+        probe = order[0] if order and order[0].state == "probe" else None
+        if not order:
+            raise GatewayDegraded("no serving replica in the fleet")
+        last_shed: RequestShed | None = None
+        try:
+            for i, replica in enumerate(order):
+                remaining_s = deadline - time.monotonic()
+                if remaining_s <= 0:
+                    break
+                # Even split of the REMAINING budget across the replicas
+                # not yet tried: attempt k of n gets remaining/(n-k), so
+                # a hung replica burns only its share and the failover
+                # keeps a real budget.
+                budget_ms = max(
+                    1e3 * remaining_s / (len(order) - i), 1.0
+                )
+                try:
+                    result, generation = replica.core.submit_external(
+                        policy, (padded,), budget_ms
+                    )
+                except DispatchTimeout as e:
+                    # The replica did not answer inside its share: sick.
+                    last_shed = e
+                    fleet.note_failure(replica)
+                    continue
+                except RequestShed as e:
+                    # Admission shed: LOAD, not sickness — no health
+                    # penalty; a shed probe aborts (clock unchanged).
+                    last_shed = e
+                    if replica is probe:
+                        replica.probe_abort()
+                    continue
+                except ServerClosed:
+                    fleet.note_failure(replica)
+                    continue
+                # lint: broad-except-ok(failover boundary: ANY replica failure — injected crash, dead router, torn-down core — must try the next candidate, and note_failure feeds the ejection/canary accounting)
+                except Exception:
+                    fleet.note_failure(replica)
+                    continue
+                actions, logp = result[0], result[1]
+                version = replica.version_of(generation)
+                fleet.note_success(replica)
+                if fleet.canary is not None:
+                    fleet.canary.record(
+                        version, np.asarray(actions)[:rows], error=False
+                    )
+                if i > 0:
+                    self._c_failover.inc()
+                self._reanchor(policy, replica, generation, version)
+                return (
+                    np.asarray(actions)[:rows],
+                    np.asarray(logp)[:rows],
+                    version,
+                    {"replica": replica.name},
+                )
+        finally:
+            # A claimed probe the loop never resolved (budget ran out
+            # before its turn, or its attempt raised through) must not
+            # stay parked in the half-open state.
+            if probe is not None and probe.state == "probe":
+                probe.probe_abort()
+        if last_shed is not None:
+            raise last_shed
+        raise GatewayDegraded(
+            "every replica failed or was unavailable inside the wire "
+            "budget"
+        )
+
+    # /v1/evaluate rides the same failover path as its own traffic class
+    # (the gateway keeps separate wire counters per endpoint).
+    evaluate = act
+
+    def serve_stale(
+        self, policy: str, obs: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, int, dict]:
+        """Answer from the anchored last-good generation (tenant mode
+        ``stale``) — same held-lease guarantee as ``CoreBackend``: the
+        anchored params are resident and unmixed by refcount, never
+        freed weights."""
+        import jax
+
+        rows = obs.shape[0]
+        with self._lock:
+            anchor = self._anchors.get(policy)
+            if anchor is None:
+                raise GatewayDegraded(
+                    f"no last-good generation anchored for policy "
+                    f"{policy!r}: nothing to serve stale from"
+                )
+            slots, generation, version, name = anchor
+            params, _ = slots.lease_generation(generation)
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed ^ 0xF1EE7)
+            self._key, sub = jax.random.split(self._key)
+        try:
+            out = self.fleet.inference_fn(params, bucket_rows(obs), sub)
+            actions, logp = out[0], out[1]
+        finally:
+            slots.release(generation)
+        return (
+            np.asarray(actions)[:rows],
+            np.asarray(logp)[:rows],
+            version,
+            {"replica": name},
+        )
+
+    def _reanchor(
+        self, policy: str, replica: Replica, generation: int, version: int
+    ) -> None:
+        """Pin the (replica, generation) just served, release the
+        previous anchor — CoreBackend's discipline, plus the replica
+        name so stale responses keep their provenance."""
+        with self._lock:
+            prev = self._anchors.get(policy)
+            if (
+                prev is not None
+                and prev[1] == generation
+                and prev[3] == replica.name
+            ):
+                return
+            try:
+                slots = replica.router.slots(policy)
+            # lint: broad-except-ok(anchor refresh is best-effort: a router mid-rebuild keeps the previous anchor, which is exactly what stale mode wants)
+            except Exception:
+                return
+            try:
+                # lint: protocol-ok(sanctioned hand-off: the stale ANCHOR deliberately outlives this scope — held in _anchors until the next re-anchor or close() releases it; that held lease IS the serve-stale guarantee)
+                slots.lease_generation(generation)
+                anchor = (slots, generation, version, replica.name)
+            except RuntimeError:
+                # lint: protocol-ok(same sanctioned anchor hand-off as above, latest-generation fallback branch)
+                _, latest = slots.lease()
+                anchor = (
+                    slots, latest, replica.version_of(latest),
+                    replica.name,
+                )
+            self._anchors[policy] = anchor
+            if prev is not None:
+                try:
+                    prev[0].release(prev[1])
+                # lint: broad-except-ok(releasing an anchor against a torn-down replica's slots: the old object is garbage either way; the new anchor is already installed)
+                except Exception:
+                    pass
+
+    def close(self) -> None:
+        """Release every held anchor lease."""
+        with self._lock:
+            anchors, self._anchors = self._anchors, {}
+        for slots, generation, _version, _name in anchors.values():
+            try:
+                slots.release(generation)
+            # lint: broad-except-ok(teardown: the fleet may already be closed; the lease dies with it)
+            except Exception:
+                pass

@@ -129,6 +129,24 @@ class GatewayDegraded(RuntimeError):
     or mid-rebuild): the tenant's degradation mode owns the answer."""
 
 
+def bucket_rows(obs: np.ndarray) -> np.ndarray:
+    """Pad the external batch's row count up to the next power of two
+    (repeating the first row). Wire clients send arbitrary B; without
+    bucketing every novel row count recompiles the shared jitted
+    inference fn on the training device — a multi-second stall the wire
+    must never be able to script. Buckets bound the external shape
+    alphabet to log2(max rows); callers slice answers back. Shared by
+    every backend that fronts a jitted core (CoreBackend here, the
+    fleet's FleetRouter in serve/fleet.py)."""
+    rows = obs.shape[0]
+    bucket = 1 << (rows - 1).bit_length()
+    if bucket == rows:
+        return obs
+    return np.concatenate(
+        [obs, np.repeat(obs[:1], bucket - rows, axis=0)], axis=0
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class TenantClass:
     """One tenant SLO class (see module doc). ``rps=0`` = unlimited rate,
@@ -352,21 +370,9 @@ class CoreBackend:
             return 0.0
         return core.slo.p95_ms()
 
-    @staticmethod
-    def _bucket_rows(obs: np.ndarray) -> np.ndarray:
-        """Pad the external batch's row count up to the next power of two
-        (repeating the first row). Wire clients send arbitrary B; without
-        bucketing every novel row count recompiles the shared jitted
-        inference fn on the training device — a multi-second stall the
-        wire must never be able to script. Buckets bound the external
-        shape alphabet to log2(max rows); callers slice answers back."""
-        rows = obs.shape[0]
-        bucket = 1 << (rows - 1).bit_length()
-        if bucket == rows:
-            return obs
-        return np.concatenate(
-            [obs, np.repeat(obs[:1], bucket - rows, axis=0)], axis=0
-        )
+    # Kept as a method name for callers/tests that reached it here; the
+    # one definition is the module-level :func:`bucket_rows`.
+    _bucket_rows = staticmethod(bucket_rows)
 
     def act(
         self, policy: str, obs: np.ndarray, deadline_ms: float
@@ -851,7 +857,14 @@ class ServeGateway:
                     if endpoint == "evaluate"
                     else self.backend.act
                 )
-                actions, logp, generation = fn(policy, obs, remaining_ms)
+                # Backends answer (actions, logp, generation) or, with
+                # provenance, (actions, logp, generation, extras): the
+                # fleet backend stamps which REPLICA served — with the
+                # generation stamp, the per-response provenance the
+                # canary/mixing assertions read off the wire.
+                out = fn(policy, obs, remaining_ms)
+                actions, logp, generation = out[0], out[1], out[2]
+                extras = dict(out[3]) if len(out) > 3 else {}
         except RequestShed as e:
             # Shed one layer deeper (the CORE's gate / wire-budget flush):
             # still a shed, still refunded — no non-served request may
@@ -883,14 +896,18 @@ class ServeGateway:
             )
         latency_ms = 1e3 * (time.monotonic() - arrival)
         tenant.gate.finished(latency_ms)
-        self._send_json(handler, 200, {
+        doc = {
             "v": PROTOCOL_VERSION,
             "endpoint": endpoint,
             "actions": np.asarray(actions).tolist(),
             "logp": np.asarray(logp).tolist(),
             "generation": int(generation),
             "latency_ms": round(latency_ms, 3),
-        })
+        }
+        for key, value in extras.items():
+            # Backend provenance never overrides protocol fields.
+            doc.setdefault(key, value)
+        self._send_json(handler, 200, doc)
 
     def _degrade(self, handler, endpoint, tenant, policy, obs, arrival,
                  reason: str) -> None:
@@ -900,9 +917,9 @@ class ServeGateway:
         mode = tenant.cls.mode
         if mode == "stale":
             try:
-                actions, logp, generation = self.backend.serve_stale(
-                    policy, obs
-                )
+                out = self.backend.serve_stale(policy, obs)
+                actions, logp, generation = out[0], out[1], out[2]
+                extras = dict(out[3]) if len(out) > 3 else {}
             # lint: broad-except-ok(degradation must degrade, never 500: ANY stale-path failure — nothing anchored yet, or the jitted call itself dying with the core — falls through to an honest shed, which also closes the tenant-gate admission)
             except Exception:
                 mode = "shed"
@@ -910,7 +927,7 @@ class ServeGateway:
                 self._c_stale.inc()
                 latency_ms = 1e3 * (time.monotonic() - arrival)
                 tenant.gate.finished(latency_ms)
-                return self._send_json(handler, 200, {
+                doc = {
                     "v": PROTOCOL_VERSION,
                     "endpoint": endpoint,
                     "actions": np.asarray(actions).tolist(),
@@ -919,7 +936,10 @@ class ServeGateway:
                     "stale_generation": int(generation),
                     "stale": True,
                     "latency_ms": round(latency_ms, 3),
-                })
+                }
+                for key, value in extras.items():
+                    doc.setdefault(key, value)
+                return self._send_json(handler, 200, doc)
         if mode == "fallback":
             self._c_fallback.inc()
             rows = int(obs.shape[0])

@@ -25,6 +25,7 @@ First in-repo clients:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 from asyncrl_tpu.serve.params import ParamSlots
@@ -112,10 +113,19 @@ class PolicyRouter:
 
     def drain(self, timeout_s: float = 5.0, stop=None) -> bool:
         """Drain every policy's superseded generations (teardown barrier;
-        traced per policy as ``serve.swap_drain``)."""
+        traced per policy as ``serve.swap_drain``). ``timeout_s`` is ONE
+        deadline shared across all policies — a wedged lease on the first
+        policy eats the budget, it never multiplies it (a K-policy router
+        used to take up to K x timeout_s; shutdown must be bounded by the
+        number the caller wrote, the PR-15 finite-deadline discipline)."""
+        deadline = time.monotonic() + timeout_s
         ok = True
         for policy in self.policies():
-            ok = self.slots(policy).drain(timeout_s, stop=stop) and ok
+            remaining = deadline - time.monotonic()
+            ok = (
+                self.slots(policy).drain(max(remaining, 0.0), stop=stop)
+                and ok
+            )
         return ok
 
 

@@ -84,6 +84,25 @@ DISPATCH_DEADLINE_COUNTER = "serve_dispatch_deadline"
 # a policy's slab-full fill target.
 EXTERNAL_CLIENT = -1
 
+# Extra result-wait granted ONCE to an external request whose wire
+# budget expired before its result landed. The external fill deadline
+# is capped by the surviving wire budget, so a flush legitimately fires
+# AT the deadline with the compute landing a few ms after — without the
+# grace the waiter sheds (or steals back off the pending queue) a
+# request whose answer is in flight. A hung serve thread still times
+# out right after the grace, so failover stays prompt and bounded.
+DISPATCH_GRACE_S = 0.25
+
+
+class DispatchTimeout(RequestShed):
+    """An admitted EXTERNAL request whose wire budget ran out while still
+    waiting for its dispatch: the serve thread is wedged, hung, or simply
+    slower than the budget. A :class:`RequestShed` subclass (the gateway's
+    shed/refund handling applies unchanged), but distinct so the fleet
+    router can tell a sick replica from an overloaded one: a gate shed is
+    load (fail over, don't punish), a dispatch timeout is the replica not
+    answering (fail over AND count it against the replica's health)."""
+
 
 class _Request:
     """One in-flight client request. Ownership protocol: the fields below
@@ -140,8 +159,12 @@ class ServeCore(threading.Thread):
         slo: SLOGate | None = None,
         router: PolicyRouter | None = None,
         max_batch_rows: int = 0,
+        name: str = "serve-core",
     ):
-        super().__init__(name="serve-core", daemon=True)
+        # ``name`` distinguishes fleet replicas ("serve-core-r0", ...) in
+        # fault messages and flight-recorder dumps; the default keeps the
+        # single-core trainer surface byte-identical.
+        super().__init__(name=name, daemon=True)
         if mode not in self.MODES:
             raise ValueError(f"unknown mode {mode!r}; expected {self.MODES}")
         if num_clients < 1:
@@ -320,6 +343,23 @@ class ServeCore(threading.Thread):
             and not self._slo.closed
         )
 
+    def kill(self, cause: BaseException | None = None) -> None:
+        """Abrupt death from outside (the fleet's ``replica`` chaos kind,
+        ``rmode=kill``): latch a fatal cause and stop the serve loop, so
+        from every client's view this core died exactly like a crash —
+        queued waiters observe the latched cause, ``serving()`` turns
+        false, and a supervisor rebuilds. Sets THIS core's stop event:
+        callers sharing one stop event across cores must not use kill().
+        Idempotent."""
+        if self._fatal is None:
+            # lint: thread-shared-ok(deliberate cross-thread latch: kill IS a supervisor-side writer and the serve thread only latches its own death cause, which this pre-set flag merely pre-empts)
+            self._fatal = cause if cause is not None else ServerClosed(
+                "serve core killed"
+            )
+        self._stop_event.set()
+        with self._cond:
+            self._cond.notify_all()
+
     def _closed(self) -> bool:
         return self._stop_event.is_set() or not self.is_alive()
 
@@ -372,12 +412,59 @@ class ServeCore(threading.Thread):
         except BaseException:
             self._slo.abandoned()
             raise
-        while not request.event.wait(timeout=0.2):
+        # External requests bound the RESULT wait by the wire budget too:
+        # a wedged or hung serve thread must never pin a gateway handler
+        # past the deadline it promised its client — the fleet router
+        # fails the request over to a live replica with whatever budget
+        # survives. In-process clients (no wire budget) keep the
+        # wait-until-served contract: their supervisor owns hang recovery.
+        wire_deadline = (
+            None if wire_budget_s is None else admit_start + wire_budget_s
+        )
+        graced = False
+        while True:
+            if wire_deadline is None:
+                timeout = 0.2
+            else:
+                timeout = min(
+                    0.2, max(wire_deadline - time.monotonic(), 0.01)
+                )
+            if request.event.wait(timeout=timeout):
+                break
             if self._closed():
                 self._slo.abandoned()
                 if self._fatal is not None:
                     raise self._fatal
                 raise ServerClosed("serve core stopped")
+            if (
+                wire_deadline is not None
+                and time.monotonic() >= wire_deadline
+            ):
+                if not graced:
+                    # The deadline-capped flush fires AT the wire deadline
+                    # — the answer may be ms away, or the serve thread may
+                    # be about to claim the request off the queue this
+                    # very instant. Un-queuing here would STEAL it from
+                    # the imminent flush, so grant one bounded grace
+                    # before touching the queue; a wedged serve thread
+                    # still sheds right after.
+                    graced = True
+                    wire_deadline = time.monotonic() + DISPATCH_GRACE_S
+                    continue
+                # Grace spent. Un-queue if still pending (never
+                # dispatched: no ghost batch slot later); if mid-dispatch,
+                # the serve thread's eventual event.set() wakes nobody —
+                # benign.
+                with self._cond:
+                    try:
+                        self._pending.remove(request)
+                    except ValueError:
+                        pass
+                self._slo.abandoned()
+                raise DispatchTimeout(
+                    "wire budget exhausted before dispatch completed "
+                    "(serve thread busy or hung)"
+                )
         if self._fatal is not None:
             # Integrity violation: no delivered content can be trusted.
             self._slo.abandoned()

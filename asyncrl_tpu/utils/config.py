@@ -314,6 +314,12 @@ class Config:
     # stall fraction is capped at exactly 1.0 — leaving only scripted
     # chaos events (how the smoke/tests pin deterministic fleets).
     elastic_up_stall_frac: float = 0.5
+    # Scale-up trigger #2: the external gateway's shed counters
+    # (admission 429s + wire-deadline sheds) must grow by at least this
+    # much in a window — client pain, complementary to the learner-pain
+    # stall signal and deliberately NOT subject to the span-blame veto.
+    # 0 disables (the default: runs without a gateway never see it).
+    elastic_up_shed_rate: float = 0.0
     # Scale-down trigger: the queue_backpressure counter must grow by at
     # least this much in a window (actors out-ran the learner). 0
     # disables the organic backpressure signal.

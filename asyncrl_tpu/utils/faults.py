@@ -15,6 +15,7 @@ registry of *named fault sites* threaded through the hot paths —
 - ``pool.step``         inside the host env pool's batched step
 - ``checkpoint.save``   each Checkpointer save attempt
 - ``checkpoint.restore``each Checkpointer restore attempt
+- ``fleet.replica``     each ServeFleet maintenance tick (serve/fleet.py)
 
 each able to inject a **crash** (raise ``InjectedFault``), a configurable
 **stall** (sleep, interruptible by the caller's stop predicate),
@@ -22,10 +23,14 @@ each able to inject a **crash** (raise ``InjectedFault``), a configurable
 site), a scripted **scale** event (enqueue a fleet grow/shrink request
 the elastic runtime drains at the next window close — the chaos grammar
 driving deliberate elasticity instead of a death; see
-``asyncrl_tpu/runtime/elastic.py``), or a scripted **netfault** (a wire
+``asyncrl_tpu/runtime/elastic.py``), a scripted **netfault** (a wire
 failure the gateway enacts: client disconnect mid-request, slow-loris
 body, malformed payload, gateway crash — ``net=`` picks the mode; see
-``asyncrl_tpu/serve/gateway.py``). Whether a given call fires is decided
+``asyncrl_tpu/serve/gateway.py``), or a scripted **replica** event (a
+serving-replica failure the ServeFleet enacts: kill the replica's serve
+core, hang its inference path, or lag its weight sync — ``rmode=`` picks
+the mode, ``replica=`` names the target; see
+``asyncrl_tpu/serve/fleet.py``). Whether a given call fires is decided
 by a per-site ``random.Random(seed)`` stream against ``prob`` — fully
 deterministic for a fixed call sequence, independent of wall clock and of
 other sites.
@@ -44,7 +49,10 @@ first actor step). Options: ``max`` (cap on fires; default unlimited),
 ``stall_s`` (stall duration, default 1.0), ``after`` (skip the site's
 first N calls before the probability stream starts drawing — stages
 multi-site chaos scripts), ``delta`` (scale kind only: signed fleet-size
-change per fire, default +1).
+change per fire, default +1), ``rmode``/``replica`` (replica kind only:
+the failure mode ``kill`` | ``hang`` | ``lag`` and the target replica
+name — empty lets the fleet pick; ``stall_s`` doubles as the hang/lag
+duration).
 
 Unarmed cost
 ------------
@@ -80,15 +88,28 @@ SITES = (
     "pool.step",
     "checkpoint.save",
     "checkpoint.restore",
+    "fleet.replica",
 )
 
-KINDS = ("crash", "stall", "corrupt", "scale", "preempt", "netfault")
+KINDS = (
+    "crash", "stall", "corrupt", "scale", "preempt", "netfault", "replica"
+)
 
 # What a ``netfault`` fire scripts at the wire boundary (serve/gateway.py
 # interprets the raised :class:`NetFault`): a client vanishing mid-request,
 # a slow-loris response stall, a malformed payload on the wire, or the
 # gateway process face dying mid-flight. The ``net=`` option picks one.
 NETFAULT_MODES = ("disconnect", "slowloris", "malformed", "crash")
+
+# What a ``replica`` fire scripts inside the serving fleet
+# (serve/fleet.py interprets the raised :class:`ReplicaFault` on its
+# maintenance tick): kill the target replica's serve core (supervised
+# rebuild), hang its inference path for ``stall_s`` (failover + health
+# ejection), or lag its weight sync for ``stall_s`` (staleness-cap
+# ejection). The ``rmode=`` option picks one; ``replica=`` names the
+# target (empty lets the fleet pick — the live canary first, so replica
+# death mid-canary is a one-line script).
+REPLICA_MODES = ("kill", "hang", "lag")
 
 ENV_VAR = "ASYNCRL_FAULTS"
 
@@ -146,6 +167,27 @@ class NetFault(RuntimeError):
         self.mode = mode
 
 
+class ReplicaFault(RuntimeError):
+    """The replica kind: raised out of ``fleet.replica`` carrying the
+    scripted replica-failure mode. The FLEET interprets it (the netfault
+    precedent: a scripted infrastructure condition to enact — kill the
+    target's serve core, hang its inference path, lag its weight sync —
+    not a worker failure to recover from at the fire site)."""
+
+    def __init__(
+        self, mode: str, replica: str = "", stall_s: float = 1.0,
+        detail: str = "",
+    ):
+        super().__init__(
+            f"injected replica fault mode={mode!r}"
+            + (f" replica={replica!r}" if replica else "")
+            + (f" ({detail})" if detail else "")
+        )
+        self.mode = mode
+        self.replica = replica
+        self.stall_s = stall_s
+
+
 class FaultSpecError(ValueError):
     """A malformed ``ASYNCRL_FAULTS`` / ``config.fault_spec`` string."""
 
@@ -166,6 +208,8 @@ class FaultSite:
         after: int = 0,
         delta: int = 1,
         net: str = "disconnect",
+        rmode: str = "kill",
+        replica: str = "",
     ):
         if name not in SITES:
             raise FaultSpecError(
@@ -194,6 +238,27 @@ class FaultSite:
                 f"fault spec: the netfault kind only applies to the "
                 f"'gateway.request' site, got {name!r}"
             )
+        if rmode not in REPLICA_MODES:
+            raise FaultSpecError(
+                f"unknown replica mode {rmode!r}; have {REPLICA_MODES}"
+            )
+        if kind == "replica" and name != "fleet.replica":
+            # Only the fleet's maintenance tick interprets ReplicaFault;
+            # anywhere else the scripted replica failure would masquerade
+            # as a worker crash (the netfault rule again).
+            raise FaultSpecError(
+                f"fault spec: the replica kind only applies to the "
+                f"'fleet.replica' site, got {name!r}"
+            )
+        if kind != "replica" and name == "fleet.replica":
+            # The fleet tick catches ONLY ReplicaFault: a crash/stall/...
+            # armed there would kill or wedge the maintenance thread
+            # itself instead of scripting a replica failure — refuse
+            # eagerly rather than let a chaos run test the wrong thing.
+            raise FaultSpecError(
+                f"fault spec: the 'fleet.replica' site only takes the "
+                f"replica kind, got {kind!r}"
+            )
         self.name = name
         self.kind = kind
         self.prob = prob
@@ -202,6 +267,8 @@ class FaultSite:
         self.after = after
         self.delta = delta
         self.net = net
+        self.rmode = rmode
+        self.replica = replica
         # zlib.crc32, not hash(): str hashing is salted per process and
         # would silently break cross-run determinism.
         self._rng = random.Random(seed ^ zlib.crc32(name.encode()))  # guarded-by: _lock
@@ -250,6 +317,10 @@ class FaultSite:
         - netfault: raises :class:`NetFault` carrying the scripted wire
           mode (``net=`` option); the gateway's request handler enacts
           it — see serve/gateway.py.
+        - replica: raises :class:`ReplicaFault` carrying the scripted
+          replica-failure mode (``rmode=``/``replica=`` options, stall_s
+          as the hang/lag duration); the fleet's maintenance tick enacts
+          it — see serve/fleet.py.
         """
         ordinal = self._should_fire()
         if not ordinal:
@@ -289,6 +360,17 @@ class FaultSite:
             # the exception. stall_s doubles as the slow-loris stall.
             raise NetFault(
                 self.net,
+                detail=f"fire {ordinal}/{self.max_fires or 'inf'} in "
+                f"thread {threading.current_thread().name!r}",
+            )
+        if self.kind == "replica":
+            # Raised to the FLEET's maintenance tick, which enacts the
+            # scripted replica failure (serve/fleet.py); mode, target,
+            # and duration ride the exception.
+            raise ReplicaFault(
+                self.rmode,
+                replica=self.replica,
+                stall_s=self.stall_s,
                 detail=f"fire {ordinal}/{self.max_fires or 'inf'} in "
                 f"thread {threading.current_thread().name!r}",
             )
@@ -366,6 +448,8 @@ def parse_spec(spec: str) -> list[FaultSite]:
         after = 0
         delta: int | None = None
         net: str | None = None
+        rmode: str | None = None
+        replica: str | None = None
         for extra in fields[4:]:
             for kv in extra.split(","):
                 kv = kv.strip()
@@ -377,10 +461,14 @@ def parse_spec(spec: str) -> list[FaultSite]:
                     )
                 k, v = kv.split("=", 1)
                 k = k.strip()
-                if k not in ("max", "stall_s", "after", "delta", "net"):
+                if k not in (
+                    "max", "stall_s", "after", "delta", "net",
+                    "rmode", "replica",
+                ):
                     raise FaultSpecError(
                         f"fault spec {chunk!r}: unknown option {k!r} "
-                        "(have max, stall_s, after, delta, net)"
+                        "(have max, stall_s, after, delta, net, rmode, "
+                        "replica)"
                     )
                 try:
                     if k == "max":
@@ -391,6 +479,10 @@ def parse_spec(spec: str) -> list[FaultSite]:
                         after = int(v)
                     elif k == "net":
                         net = v.strip()
+                    elif k == "rmode":
+                        rmode = v.strip()
+                    elif k == "replica":
+                        replica = v.strip()
                     else:
                         delta = int(v)
                 except ValueError as e:
@@ -407,11 +499,18 @@ def parse_spec(spec: str) -> list[FaultSite]:
                 f"fault spec {chunk!r}: option 'net' only applies to "
                 "the netfault kind"
             )
+        if (rmode is not None or replica is not None) and kind != "replica":
+            raise FaultSpecError(
+                f"fault spec {chunk!r}: options 'rmode'/'replica' only "
+                "apply to the replica kind"
+            )
         sites.append(
             FaultSite(name, kind, prob, seed, max_fires=max_fires,
                       stall_s=stall_s, after=after,
                       delta=1 if delta is None else delta,
-                      net="disconnect" if net is None else net)
+                      net="disconnect" if net is None else net,
+                      rmode="kill" if rmode is None else rmode,
+                      replica="" if replica is None else replica)
         )
     return sites
 
@@ -454,7 +553,9 @@ class FaultRegistry:
         return bool(self._sites)
 
 
+# lint: thread-shared-ok(double-checked latch under _ARM_LOCK: every write holds the lock; the lockless fast-path read in active() re-checks under the lock before writing, and a stale None/registry read is a coherent pre-arm answer)
 _ACTIVE: FaultRegistry | None = None
+# lint: thread-shared-ok(double-checked latch under _ARM_LOCK: monotonic False→True; a stale False read only routes through the locked slow path, which re-checks)
 _ENV_CHECKED = False
 _ARM_LOCK = threading.Lock()
 

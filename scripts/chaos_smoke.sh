@@ -81,4 +81,66 @@ TMP_CK="$(mktemp -d)"
 trap 'rm -rf "$TMP_CK"' EXIT
 run_one "checkpoint save crash" "checkpoint.save:crash:1.0:0:max=2" "checkpoint_dir=${TMP_CK}/ck,checkpoint_every=2"
 
+# The serving fleet's replica kind (fleet.replica site): the trainer
+# does not mount a fleet, so this scenario drives the replicated tier
+# standalone — kill one replica's serve core mid-traffic and require the
+# supervised rebuild AND uninterrupted serving from the survivor.
+echo "=== chaos_smoke: replica kill (fleet.replica:replica:rmode=kill) ==="
+python - <<'EOF'
+import sys
+import time
+
+import numpy as np
+
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.serve import FleetRouter, ParamFeed, ServeFleet
+from asyncrl_tpu.utils import faults
+
+faults.arm("fleet.replica:replica:1.0:0:rmode=kill,max=1,replica=r0")
+
+
+def fn(params, obs, key):
+    rows = obs.shape[0]
+    value = int(params["v"])
+    return (
+        np.full((rows,), value, np.int32),
+        np.zeros((rows,), np.float32),
+        key,
+    )
+
+
+feed = ParamFeed({"v": 0})
+fleet = ServeFleet(fn, feed, num_replicas=2, deadline_ms=2.0,
+                   readmit_after_s=0.05, tick_interval_s=0.02)
+fleet.start()
+router = FleetRouter(fleet, obs_shape=(4,))
+obs = np.zeros((2, 4), np.float32)
+victim = fleet.replicas[0]
+served = set()
+deadline = time.monotonic() + 20.0
+try:
+    while time.monotonic() < deadline:
+        actions, _, version, extras = router.act("default", obs, 500.0)
+        if actions.tolist() != [version] * 2:
+            sys.exit(f"chaos_smoke FAILED: generation mixing "
+                     f"(actions {actions.tolist()} under version {version})")
+        served.add(extras["replica"])
+        if victim.restarts >= 1 and served == {"r0", "r1"}:
+            break
+        time.sleep(0.01)
+finally:
+    router.close()
+    fleet.close()
+    faults.disarm()
+
+restarts = obs_registry.counter("fleet_replica_restarts").value()
+if victim.restarts < 1 or restarts < 1:
+    sys.exit("chaos_smoke FAILED: replica kill produced no supervised rebuild")
+if served != {"r0", "r1"}:
+    sys.exit(f"chaos_smoke FAILED: rebuilt replica never rejoined "
+             f"(served: {sorted(served)})")
+print("chaos_smoke OK: replica killed, rebuilt (restarts",
+      int(restarts), ") and back in rotation")
+EOF
+
 echo "=== chaos_smoke: all fault sites recovered ==="

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Gateway smoke: the external serving gateway (asyncrl_tpu/serve/gateway.py)
-# proven as a load-generator A/B in three acts:
+# proven as a load-generator A/B in four acts:
 #
 #   Act 1 — gateway-off bit-identity: a gateway_port=0 run and a mounted-
 #     but-idle gateway_port=-1 run produce IDENTICAL per-window losses
@@ -20,6 +20,17 @@
 #     work), the fault fired, a flight-recorder dump landed, /healthz
 #     finishes ok, and the disconnect act observes the degrade->recover
 #     edge (gateway_error_rate fires, then the TTL clears it).
+#   Act 4 — replicated fleet (asyncrl_tpu/serve/fleet.py): >= 2 replicas
+#     behind one gateway under sustained multi-tenant QPS, in two scenes.
+#     Scene A: a live canary PROMOTION (agreeing version) while every
+#     response stamps its replica + generation and no batch ever mixes
+#     generations. Scene B: an injected-divergence canary with a replica
+#     KILL mid-canary through the fleet.replica chaos grammar — gates:
+#     the kill lands while the canary is live, the core is supervised
+#     back into rotation, the canary auto-ROLLS BACK and vetoes the
+#     version, zero generation mixing throughout, and the client sees no
+#     availability gap beyond the failover budget (sheds allowed,
+#     unavailability not).
 #
 # Usage: scripts/gateway_smoke.sh                  # CPU, ~2-3 min
 #        ASYNCRL_SMOKE_UPDATES=32 scripts/gateway_smoke.sh
@@ -331,7 +342,7 @@ run_netfault("crash", ",max=1")
 print("gateway_smoke act 3 OK: every netfault mode recovered to /healthz ok")
 ledger["act3_modes"] = ["disconnect", "malformed", "slowloris", "crash"]
 
-print("gateway_smoke OK: all three acts green")
+print("gateway_smoke OK: acts 1-3 green")
 
 if record:
     from asyncrl_tpu.utils import bench_history
@@ -350,3 +361,220 @@ if record:
     })
     print("gateway_smoke: recorded", entry["ts"])
 EOF
+
+# ------------------------------------------------- act 4: replicated fleet
+# Standalone fleet (the trainer does not mount one): ParamFeed publisher,
+# >= 2 replicas behind ServeGateway via FleetRouter, multi-tenant load.
+QPS4="${ASYNCRL_GATEWAY_QPS:-50}"
+python - "$QPS4" <<'EOF'
+import sys
+import threading
+import time
+
+import numpy as np
+
+from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.serve import (
+    BreakerOpen, CanaryController, FleetRouter, GatewayClient,
+    GatewayShed, GatewayUnavailable, ParamFeed, ServeFleet, ServeGateway,
+    parse_tenant_spec,
+)
+from asyncrl_tpu.utils import faults
+
+qps = float(sys.argv[1])
+REPLICAS = 3
+TENANT_SPEC = "gold:shed:rps=1000,burst=500;bulk:shed:rps=1000,burst=500"
+
+
+def version_fn(params, obs, key):
+    """action == params["a"]: the version -> action map is the mixing
+    oracle — any generation-mixed batch (or mis-stamped response) shows
+    an action that disagrees with its version's known value."""
+    rows = obs.shape[0]
+    value = int(params["a"])
+    return (
+        np.full((rows,), value, np.int32),
+        np.zeros((rows,), np.float32),
+        key,
+    )
+
+
+class FleetLoad:
+    """Per-tenant load thread recording replica + generation provenance
+    and checking the mixing oracle on EVERY response."""
+
+    def __init__(self, port, tenant, rate_hz, vmap, seed):
+        self.client = GatewayClient(
+            f"http://127.0.0.1:{port}", tenant=tenant, deadline_ms=2000,
+            retries=2, backoff_base_s=0.01, seed=seed,
+        )
+        self.period = 1.0 / rate_hz
+        self.vmap = vmap  # version -> expected action value
+        self.served = 0
+        self.shed = 0
+        self.failed = 0
+        self.mixed = 0
+        self.replicas = set()
+        self.versions = set()
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self._run, name=f"fleetload-{tenant}", daemon=True
+        )
+
+    def _run(self):
+        obs = np.zeros((2, 4), np.float32)
+        while not self.stop.is_set():
+            try:
+                result = self.client.act(obs)
+                self.served += 1
+                self.replicas.add(result.replica)
+                self.versions.add(result.generation)
+                expected = self.vmap.get(result.generation)
+                if expected is not None and any(
+                    a != expected for a in result.actions
+                ):
+                    self.mixed += 1
+            except GatewayShed:
+                self.shed += 1
+            except (GatewayUnavailable, BreakerOpen):
+                # Both are availability gaps: an open client breaker
+                # means repeated unavailability, not load shedding.
+                self.failed += 1
+            time.sleep(self.period)
+
+
+def run_scene(label, vmap, canary, fault_spec, publish, wait_for,
+              settle_s=0.0):
+    """One fleet scene: build (optionally chaos-armed) fleet + gateway +
+    loaders, publish the staged versions, wait for the scene's verdict,
+    and gate provenance/mixing/availability on teardown."""
+    if fault_spec:
+        faults.arm(fault_spec)
+    feed = ParamFeed({"a": vmap[0]})
+    fleet = ServeFleet(
+        version_fn, feed, num_replicas=REPLICAS, deadline_ms=2.0,
+        readmit_after_s=0.1, canary=canary, tick_interval_s=0.02,
+    )
+    fleet.start()
+    router = FleetRouter(fleet, obs_shape=(4,))
+    gateway = ServeGateway(
+        router, port=-1, tenants=parse_tenant_spec(TENANT_SPEC)
+    ).start()
+    loaders = [
+        FleetLoad(gateway.port, "gold", qps / 2, vmap, seed=31),
+        FleetLoad(gateway.port, "bulk", qps / 2, vmap, seed=41),
+    ]
+    for loader in loaders:
+        loader.thread.start()
+    try:
+        time.sleep(0.3)  # a few served requests before the stage turns
+        for version, action in publish:
+            feed.publish({"a": action})
+        deadline = time.monotonic() + 45.0
+        while time.monotonic() < deadline and not wait_for(fleet):
+            time.sleep(0.05)
+        if not wait_for(fleet):
+            sys.exit(f"gateway_smoke FAILED (act 4 {label}): scene never "
+                     "reached its verdict inside the budget")
+        if settle_s:
+            time.sleep(settle_s)
+    finally:
+        for loader in loaders:
+            loader.stop.set()
+        for loader in loaders:
+            loader.thread.join(timeout=5)
+        gateway.stop()
+        router.close()
+        fleet.close()
+        faults.disarm()
+    served = sum(ld.served for ld in loaders)
+    failed = sum(ld.failed for ld in loaders)
+    mixed = sum(ld.mixed for ld in loaders)
+    replicas = set().union(*(ld.replicas for ld in loaders))
+    versions = set().union(*(ld.versions for ld in loaders))
+    print(f"gateway_smoke act 4 {label}: served={served} "
+          f"shed={sum(ld.shed for ld in loaders)} failed={failed} "
+          f"replicas={sorted(replicas)} versions={sorted(versions)}")
+    if served < 20:
+        sys.exit(f"gateway_smoke FAILED (act 4 {label}): almost no "
+                 f"traffic served ({served})")
+    if len(replicas) < 2:
+        sys.exit(f"gateway_smoke FAILED (act 4 {label}): responses name "
+                 f"only {sorted(replicas)} — not a replicated fleet")
+    if mixed:
+        sys.exit(f"gateway_smoke FAILED (act 4 {label}): {mixed} "
+                 "response(s) mixed generations (action != version's "
+                 "known value)")
+    if failed:
+        sys.exit(f"gateway_smoke FAILED (act 4 {label}): {failed} "
+                 "unavailability window(s) — failover must absorb every "
+                 "replica loss inside the wire budget")
+    return fleet
+
+
+# Scene A — live PROMOTION: v1 agrees with v0 (same action value), the
+# canary windows match, the fleet auto-promotes and follows v1.
+canary_a = CanaryController(min_serves=24, divergence=0.5, share=4)
+fleet_a = run_scene(
+    "scene A (promotion)",
+    vmap={0: 0, 1: 0},
+    canary=canary_a,
+    fault_spec="",
+    publish=[(1, 0)],
+    wait_for=lambda fleet: ("promote", 1) in list(fleet.canary.history),
+    settle_s=0.3,
+)
+if canary_a.stable_version != 1:
+    sys.exit("gateway_smoke FAILED (act 4 scene A): promotion did not "
+             f"advance the stable version (at {canary_a.stable_version})")
+if any(r.version != 1 for r in fleet_a.replicas):
+    sys.exit("gateway_smoke FAILED (act 4 scene A): fleet did not follow "
+             "the promoted version")
+
+# Scene B — injected divergence + replica KILL mid-canary, through the
+# chaos grammar: the fault sleeps for its first 100 tick-calls (~2 s),
+# then kills the active canary member (the unnamed-target rule) while
+# the high min_serves keeps the canary live past the kill. Gates: the
+# kill landed DURING the canary, the core rebuilt, and the divergent
+# version rolled back vetoed.
+kill_during_canary = {"seen": False}
+
+
+def scene_b_done(fleet):
+    victim_restarts = sum(r.restarts for r in fleet.replicas)
+    if victim_restarts >= 1 and fleet.canary.active:
+        kill_during_canary["seen"] = True
+    return ("rollback", 1) in list(fleet.canary.history)
+
+
+# window must cover min_serves: the sample deques cap at `window`, so
+# the verdict gate (min_serves samples per side) is only reachable when
+# window >= min_serves. 150 canary serves at a 1-in-4 split keeps the
+# canary alive long enough for the after=100 kill to land mid-canary.
+canary_b = CanaryController(window=300, min_serves=150, divergence=0.5, share=4)
+fleet_b = run_scene(
+    "scene B (kill mid-canary, rollback)",
+    vmap={0: 0, 1: 7},
+    canary=canary_b,
+    fault_spec="fleet.replica:replica:1.0:0:rmode=kill,max=1,after=100",
+    publish=[(1, 7)],
+    wait_for=scene_b_done,
+    settle_s=0.5,  # post-rollback ticks re-pin everyone to stable v0
+)
+if sum(r.restarts for r in fleet_b.replicas) < 1:
+    sys.exit("gateway_smoke FAILED (act 4 scene B): the replica kill "
+             "never fired (no supervised rebuild)")
+if not kill_during_canary["seen"]:
+    sys.exit("gateway_smoke FAILED (act 4 scene B): the kill did not "
+             "land while the canary was live")
+if 1 not in canary_b.vetoed():
+    sys.exit("gateway_smoke FAILED (act 4 scene B): the divergent "
+             "version was not vetoed")
+if any(r.version != 0 for r in fleet_b.replicas):
+    sys.exit("gateway_smoke FAILED (act 4 scene B): a replica still "
+             "serves the rolled-back version")
+print("gateway_smoke act 4 OK: promotion, kill-mid-canary rollback, "
+      "zero mixing, no availability gap")
+EOF
+
+echo "gateway_smoke OK: all four acts green"

@@ -80,6 +80,17 @@ python -m asyncrl_tpu.analysis \
     --cache-dir .analysis-cache-scripts \
     scripts/*.py bench.py __graft_entry__.py || rc=1
 
+# The replicated serving tier is lease-protocol and lock-order critical
+# (held serve-stale anchors, replica rebuild under the fleet tick, the
+# probe/readmit typestate): run the protocol-typestate and deadlock
+# passes over it EXPLICITLY, so a future baseline or file-set edit to
+# the package run can never silently un-gate serve/fleet.py. Own cache
+# dir — manifests key on the (file set, pass tuple) pair.
+python -m asyncrl_tpu.analysis \
+    --pass protocols --pass deadlock \
+    --cache-dir .analysis-cache-fleet \
+    asyncrl_tpu/serve/fleet.py || rc=1
+
 if [ "$fast" -eq 1 ] && [ "$rc" -eq 0 ] && python - <<'EOF'
 import json
 import sys

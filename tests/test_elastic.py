@@ -84,6 +84,42 @@ def test_controller_down_on_backpressure_delta_not_level():
     assert d.reason == "backpressure"
 
 
+def test_controller_up_on_sustained_gateway_shed_rate():
+    """The fleet scales on CLIENT pain: a sustained gateway shed rate is
+    an up signal, classified "shed_rate", with the per-tenant shed deltas
+    riding along in the decision's signals — and the span-blame veto
+    (which excuses a stall) never excuses turned-away traffic."""
+    c = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                          hysteresis=2, up_shed_rate=5.0,
+                          blame_fn=lambda: "h2d")
+    # Window 1: +6 shed (>= 5) — the trend starts, no decision yet.
+    assert c.decide(_window(gateway_shed=6.0), 2) is None
+    # Window 2: +3 admission sheds, +3 deadline sheds — still >= 5.
+    d = c.decide(
+        _window(gateway_shed=9.0, gateway_deadline_shed=3.0,
+                gateway_bulk_shed=4.0),
+        2,
+    )
+    assert d is not None and d.direction == "up" and d.delta == 1
+    assert d.reason == "shed_rate"
+    assert d.signals["gateway_shed_delta"] == 6.0
+    assert d.signals["gateway_bulk_shed_delta"] == 4.0
+
+
+def test_controller_shed_rate_is_delta_not_level_and_has_disable_knob():
+    # A high-but-flat cumulative shed counter is history, not pain.
+    c = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                          hysteresis=1, up_shed_rate=5.0)
+    c.decide(_window(gateway_shed=100.0), 2)  # baseline (delta 100 fires)
+    assert c.decide(_window(gateway_shed=100.0), 2) is None
+    assert c.decide(_window(gateway_shed=102.0), 2) is None  # +2 < 5
+    # Default (0) disables: gateway-less runs never see the signal.
+    c2 = ElasticController(min_actors=1, max_actors=4, cooldown_windows=0,
+                           hysteresis=1)
+    c2.decide(_window(), 2)
+    assert c2.decide(_window(gateway_shed=50.0), 2) is None
+
+
 def test_controller_down_reason_never_blames_a_disabled_signal():
     """Code-review pin: with the backpressure signal DISABLED (0), an
     admission-triggered scale-down must be classified "admission" — the

@@ -45,10 +45,28 @@ def test_spec_grammar_round_trip():
         "actor.step:crash:1.0",  # missing seed
         "nope.site:crash:1.0:0",  # unknown site
         "actor.step:explode:1.0:0",  # unknown kind
-        "actor.step:crash:2.0:0",  # prob out of range
+        "actor.step:crash:2.0:0",  # prob out of range (high)
+        "actor.step:crash:-0.1:0",  # prob out of range (negative)
+        "actor.step:crash:abc:0",  # non-numeric prob
+        "actor.step:crash:1.0:xyz",  # non-integer seed
         "actor.step:crash:1.0:0:bogus=1",  # unknown option
+        "actor.step:crash:1.0:0:max",  # option is not k=v
         "actor.step:crash:1.0:0:max=one",  # malformed option value
         "actor.step:stall:1.0:0:stall_s=abc",  # malformed option value
+        "actor.step:crash:1.0:0:after=x",  # malformed option value
+        "actor.step:crash:1.0:0:after=-1",  # negative warmup
+        "actor.step:scale:1.0:0:delta=0",  # zero delta scales nothing
+        "actor.step:crash:1.0:0:delta=1",  # delta only on the scale kind
+        "actor.step:crash:1.0:0:net=disconnect",  # net only on netfault
+        "gateway.request:netfault:1.0:0:net=bogus",  # unknown net mode
+        "actor.step:netfault:1.0:0",  # netfault only at gateway.request
+        # -- replica-kind constraints (the fleet chaos grammar) --
+        "fleet.replica:replica:1.0:0:rmode=explode",  # unknown rmode
+        "actor.step:replica:1.0:0",  # replica kind only at fleet.replica
+        "fleet.replica:crash:1.0:0",  # fleet.replica takes ONLY replica
+        "fleet.replica:stall:1.0:0",  # ... any other kind is refused
+        "actor.step:crash:1.0:0:rmode=kill",  # rmode only on replica kind
+        "actor.step:crash:1.0:0:replica=r0",  # replica= only on that kind
         "actor.step:crash:1.0:0;actor.step:crash:1.0:1",  # duplicate site
     ],
 )

@@ -1,0 +1,534 @@
+"""Replicated serving fleet (asyncrl_tpu/serve/fleet.py): the param feed,
+decoupled per-replica weight sync with the bounded-staleness eject/readmit
+contract, health-checked failover routing inside the wire budget, canary
+promotion/auto-rollback with zero generation mixing, the ``replica`` chaos
+kind's supervised rebuild, the fleet-level single-deadline drain, and the
+wire roundtrip (ServeGateway over FleetRouter, ``replica`` provenance on
+every response with rate-bucket-exact shed accounting)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from asyncrl_tpu.obs import health, registry as obs_registry
+from asyncrl_tpu.serve import (
+    CanaryController,
+    FleetRouter,
+    GatewayClient,
+    GatewayDegraded,
+    ParamFeed,
+    RequestShed,
+    ServeFleet,
+    ServeGateway,
+    parse_tenant_spec,
+)
+from asyncrl_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs_registry.registry().reset()
+    yield
+    obs_registry.registry().reset()
+    faults.disarm()
+
+
+def _version_fn(params, obs, key):
+    """Every action IS the serving params' version: any generation-mixed
+    batch (or a response stamped with the wrong version) is instantly
+    visible as an action that disagrees with its provenance stamp."""
+    rows = obs.shape[0]
+    value = int(params["v"])
+    return (
+        np.full((rows,), value, np.int32),
+        np.zeros((rows,), np.float32),
+        key,
+    )
+
+
+def _const_fn(params, obs, key):
+    """Version-independent actions: the canary's agreement case."""
+    rows = obs.shape[0]
+    return np.zeros((rows,), np.int32), np.zeros((rows,), np.float32), key
+
+
+def _fleet(fn=_version_fn, n=2, **kw):
+    kw.setdefault("deadline_ms", 2.0)
+    kw.setdefault("auto_tick", False)
+    feed = kw.pop("feed", None) or ParamFeed({"v": 0})
+    fleet = ServeFleet(fn, feed, num_replicas=n, **kw)
+    fleet.start()
+    return fleet, feed
+
+
+OBS = np.zeros((2, 4), np.float32)
+
+
+def _post(port, path, doc, headers=None):
+    request = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=10) as response:
+            return response.status, json.loads(response.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _always_shed(*args, **kwargs):
+    raise RequestShed("core gate refused")
+
+
+# ----------------------------------------------------------------- ParamFeed
+
+
+def test_param_feed_versions_retention_and_bad_history():
+    feed = ParamFeed({"v": 0}, history=2)
+    assert feed.version() == 0
+    assert feed.publish({"v": 1}) == 1
+    assert feed.publish({"v": 2}) == 2
+    assert feed.latest() == ({"v": 2}, 2)
+    assert feed.get(1) == {"v": 1}
+    with pytest.raises(KeyError):
+        feed.get(0)  # evicted past the retention window
+    with pytest.raises(ValueError):
+        ParamFeed({"v": 0}, history=1)
+
+
+# -------------------------------------------------- decoupled sync + routing
+
+
+def test_decoupled_sync_provenance_and_response_stamping():
+    fleet, feed = _fleet()
+    router = FleetRouter(fleet, obs_shape=(4,))
+    try:
+        names = set()
+        for _ in range(4):
+            actions, logp, version, extras = router.act(
+                "default", OBS, 500.0
+            )
+            assert version == 0 and actions.tolist() == [0, 0]
+            names.add(extras["replica"])
+        assert names == {"r0", "r1"}  # round-robin spreads the load
+        # Publish v1 but sync ONLY r0: per-replica schedules are
+        # decoupled — r1 keeps serving (and stamping) v0.
+        feed.publish({"v": 1})
+        assert fleet.replicas[0].sync()
+        for _ in range(4):
+            actions, _, version, extras = router.act("default", OBS, 500.0)
+            expected = 1 if extras["replica"] == "r0" else 0
+            # Zero mixing: the actions always agree with the stamp.
+            assert version == expected
+            assert actions.tolist() == [expected] * 2
+        assert fleet.replicas[1].staleness() == 1
+        assert fleet.replicas[0].staleness() == 0
+    finally:
+        router.close()
+        fleet.close()
+
+
+def test_failover_on_hung_replica_inside_the_wire_budget():
+    fleet, _ = _fleet(eject_failures=100)
+    router = FleetRouter(fleet, obs_shape=(4,))
+    try:
+        hung = fleet.replicas[0]
+        hung.enact(faults.ReplicaFault("hang", stall_s=30.0))
+        start = time.monotonic()
+        for _ in range(3):
+            _, _, _, extras = router.act("default", OBS, 600.0)
+            assert extras["replica"] == "r1"  # the healthy one answered
+        elapsed = time.monotonic() - start
+        # 3 requests, each with a 600ms budget split across 2 replicas:
+        # the hang burns only ITS share, never the whole deadline.
+        assert elapsed < 2.5
+        assert obs_registry.counter("fleet_failovers").value() >= 1.0
+        assert hung.consecutive_failures >= 1  # DispatchTimeout = sick
+    finally:
+        hung.enact(faults.ReplicaFault("hang", stall_s=0.0))
+        router.close()
+        fleet.close()
+
+
+def test_ejection_then_half_open_probe_readmission():
+    fleet, _ = _fleet(eject_failures=2, readmit_after_s=0.05)
+    router = FleetRouter(fleet, obs_shape=(4,))
+    try:
+        sick = fleet.replicas[0]
+        fleet.note_failure(sick)
+        assert sick.state == "serving"  # one failure is not a trend
+        fleet.note_failure(sick)
+        assert sick.state == "ejected" and sick.eject_reason == "failures"
+        assert obs_registry.counter("fleet_ejections").value() == 1.0
+        # Inside the backoff the replica is not probed (no readmission).
+        _, _, _, extras = router.act("default", OBS, 500.0)
+        assert extras["replica"] == "r1" and sick.state == "ejected"
+        time.sleep(0.06)
+        # Past the backoff the NEXT request is the half-open trial: the
+        # healthy core answers it and the replica rejoins the rotation.
+        _, _, _, extras = router.act("default", OBS, 500.0)
+        assert extras["replica"] == "r0"
+        assert sick.state == "serving"
+        assert obs_registry.counter("fleet_readmissions").value() == 1.0
+        assert sick.flaps() == 1
+    finally:
+        router.close()
+        fleet.close()
+
+
+def test_failed_probe_re_ejects_with_a_fresh_backoff():
+    fleet, _ = _fleet(eject_failures=1, readmit_after_s=0.05)
+    try:
+        sick = fleet.replicas[0]
+        fleet.note_failure(sick)
+        assert sick.state == "ejected"
+        time.sleep(0.06)
+        assert fleet.next_probe() is sick and sick.state == "probe"
+        assert sick.record_failure(1) == "probe_failed"
+        assert sick.state == "ejected"
+        # Fresh clock: immediately after the failed probe it is NOT
+        # eligible again.
+        assert fleet.next_probe() is None
+        # A SHED probe aborts without judging: clock unchanged, the very
+        # next request may probe again.
+        time.sleep(0.06)
+        assert fleet.next_probe() is sick
+        sick.probe_abort()
+        assert sick.state == "ejected"
+        assert fleet.next_probe() is sick
+    finally:
+        fleet.close()
+
+
+# -------------------------------------------------------- staleness contract
+
+
+def test_staleness_cap_ejects_lagged_replica_and_health_reports_it():
+    fleet, feed = _fleet(staleness_cap=2)
+    monitor = health.HealthMonitor(
+        emit=False, replica_probe=fleet.replica_verdicts
+    )
+    try:
+        lagged = fleet.replicas[0]
+        lagged.enact(faults.ReplicaFault("lag", stall_s=30.0))
+        feed.publish({"v": 1})
+        fleet.tick()
+        assert lagged.state == "serving"  # 1 behind, cap is 2
+        feed.publish({"v": 2})
+        fleet.tick()
+        # Ejected AT the bound: it never serves beyond the cap.
+        assert lagged.state == "ejected"
+        assert lagged.eject_reason == "staleness"
+        assert fleet.replicas[1].version == 2  # the healthy one kept up
+        window = obs_registry.window()
+        assert window["fleet_staleness_max"] == 2.0
+        assert window["fleet_r0_staleness"] == 2.0
+        assert window["fleet_replicas_live"] == 1.0
+        events = monitor.on_window(window)
+        assert any(
+            e.detector == "replica_staleness_runaway" for e in events
+        )
+        verdict = monitor.verdict()
+        assert verdict["components"]["fleet"] == "degraded"
+        assert verdict["replicas"]["r0"]["state"] == "ejected"
+        assert verdict["replicas"]["r0"]["reason"] == "staleness"
+        assert verdict["replicas"]["r1"]["state"] == "serving"
+        # Recovery: the lag clears, the replica catches up and readmits
+        # DIRECTLY (no probe — fresh weights are health by construction).
+        lagged.enact(faults.ReplicaFault("lag", stall_s=0.0))
+        fleet.tick()
+        assert lagged.state == "serving" and lagged.version == 2
+        assert obs_registry.counter("fleet_readmissions").value() == 1.0
+    finally:
+        fleet.close()
+
+
+def test_replica_flap_detector_fires_on_oscillation():
+    fleet, _ = _fleet(eject_failures=1, readmit_after_s=0.0)
+    monitor = health.HealthMonitor(emit=False)
+    try:
+        sick = fleet.replicas[0]
+        for _ in range(3):  # eject -> probe -> readmit, three times
+            fleet.note_failure(sick)
+            assert fleet.next_probe() is sick
+            fleet.note_success(sick)
+        fleet.tick()
+        window = obs_registry.window()
+        assert window["fleet_replica_flaps"] == 3.0
+        events = monitor.on_window(window)
+        assert any(e.detector == "replica_flap" for e in events)
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------- canary control
+
+
+def test_canary_promotes_on_agreement_and_fleet_follows():
+    canary = CanaryController(min_serves=4, divergence=0.5, share=2)
+    fleet, feed = _fleet(fn=_const_fn, canary=canary)
+    router = FleetRouter(fleet, obs_shape=(4,))
+    try:
+        feed.publish({"v": 1})
+        fleet.tick()
+        assert canary.active and len(canary.members) == 1
+        member = canary.members[0]
+        # While the canary runs, the member serves ONLY the candidate and
+        # everyone else ONLY the stable version: disjoint by pin.
+        for _ in range(40):
+            _, _, version, extras = router.act("default", OBS, 500.0)
+            assert version == (1 if extras["replica"] == member else 0)
+            fleet.tick()
+            if not canary.active:
+                break
+        assert not canary.active
+        assert canary.stable_version == 1
+        assert obs_registry.counter("fleet_promotions").value() == 1.0
+        assert ("promote", 1) in list(canary.history)
+        fleet.tick()  # pins cleared: everyone follows latest again
+        assert [r.version for r in fleet.replicas] == [1, 1]
+    finally:
+        router.close()
+        fleet.close()
+
+
+def test_canary_rolls_back_on_divergence_and_vetoes_the_version():
+    canary = CanaryController(min_serves=4, divergence=0.5, share=2)
+    # _version_fn makes v1's action distribution maximally divergent
+    # from v0's (TVD 1.0): the rollback case.
+    fleet, feed = _fleet(canary=canary)
+    router = FleetRouter(fleet, obs_shape=(4,))
+    try:
+        feed.publish({"v": 1})
+        fleet.tick()
+        assert canary.active
+        for _ in range(40):
+            actions, _, version, _ = router.act("default", OBS, 500.0)
+            # Zero mixing holds THROUGH the canary: every batch's actions
+            # agree with its version stamp.
+            assert actions.tolist() == [version] * 2
+            fleet.tick()
+            if not canary.active:
+                break
+        assert not canary.active
+        assert obs_registry.counter("fleet_rollbacks").value() == 1.0
+        assert 1 in canary.vetoed()
+        assert ("rollback", 1) in list(canary.history)
+        # The vetoed version is never followed: more ticks keep every
+        # replica pinned to the stable version.
+        for _ in range(3):
+            fleet.tick()
+        assert [r.version for r in fleet.replicas] == [0, 0]
+        # ... and a fresh v2 gets its own (un-vetoed) canary.
+        feed.publish({"v": 2})
+        fleet.tick()
+        assert canary.active and canary.canary_version == 2
+    finally:
+        router.close()
+        fleet.close()
+
+
+def test_canary_rolls_back_on_error_rate_breach():
+    canary = CanaryController(min_serves=4, error_rate=0.5)
+    canary.begin(0, 1, ("r1",))
+    for _ in range(6):
+        canary.record(0, np.zeros(2), error=False)
+        canary.record(1, None, error=True)
+    assert canary.evaluate() == "rollback"
+    assert canary.rollback() == 1
+    assert 1 in canary.vetoed()
+    # Versions outside the live pair never poison a window.
+    canary.begin(0, 2, ("r1",))
+    canary.record(7, np.zeros(2), error=True)
+    assert canary.evaluate() is None
+
+
+def test_canary_rejects_a_verdict_gate_above_its_window():
+    # min_serves > window could never be met (the sample deques cap at
+    # window rows): the canary would run forever without a verdict.
+    with pytest.raises(ValueError, match="min_serves"):
+        CanaryController(window=64, min_serves=150)
+
+
+# ------------------------------------------------------------- replica chaos
+
+
+def test_replica_kill_chaos_supervised_rebuild_keeps_serving():
+    faults.arm("fleet.replica:replica:1.0:0:rmode=kill,max=1,replica=r0")
+    fleet, _ = _fleet()  # the fleet fetches the armed site at build
+    router = FleetRouter(fleet, obs_shape=(4,))
+    try:
+        victim = fleet.replicas[0]
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and victim.restarts == 0:
+            fleet.tick()
+            time.sleep(0.02)
+        assert victim.restarts >= 1
+        assert obs_registry.counter("fleet_replica_restarts").value() >= 1.0
+        assert obs_registry.counter("fleet_ejections").value() >= 1.0
+        # The rebuilt core serves again over the SAME router (weights and
+        # the generation ledger survived), once readmitted via its probe.
+        deadline = time.monotonic() + 5.0
+        served = set()
+        while time.monotonic() < deadline and "r0" not in served:
+            fleet.tick()
+            _, _, version, extras = router.act("default", OBS, 500.0)
+            assert version == 0
+            served.add(extras["replica"])
+            time.sleep(0.01)
+        assert served == {"r0", "r1"}
+    finally:
+        router.close()
+        fleet.close()
+
+
+def test_chaos_targets_the_canary_member_when_unnamed():
+    canary = CanaryController(min_serves=4, share=2)
+    fleet, feed = _fleet(fn=_const_fn, canary=canary)
+    try:
+        feed.publish({"v": 1})
+        fleet.tick()
+        assert canary.active
+        member = canary.members[0]
+        target = fleet._chaos_target("")
+        assert target is not None and target.name == member
+        # A named fire overrides; an unknown name resolves to nothing.
+        assert fleet._chaos_target("r0").name == "r0"
+        assert fleet._chaos_target("nope") is None
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------------ drain + close
+
+
+def test_fleet_drain_is_one_shared_deadline_not_per_replica():
+    fleet, _ = _fleet(n=3)
+    try:
+        leases = []
+        for replica in fleet.replicas:
+            slots = replica.router.slots("default")
+            _, generation = slots.lease()
+            leases.append((slots, generation))
+        start = time.monotonic()
+        assert fleet.drain(timeout_s=0.3) is False
+        elapsed = time.monotonic() - start
+        # One shared 0.3s budget across all three replicas — a blocked
+        # drain may never multiply into 3 x 0.3s.
+        assert elapsed < 0.75
+        for slots, generation in leases:
+            slots.release(generation)
+        assert fleet.drain(timeout_s=1.0) is True
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------------------------- wire
+
+
+def test_fleet_router_reraises_shed_without_health_penalty():
+    """An admission shed is LOAD, not sickness: when every candidate
+    sheds, the shed re-raises (the gateway's 429 + refund path) and no
+    replica's failure count moves."""
+    fleet, _ = _fleet()
+    router = FleetRouter(fleet, obs_shape=(4,))
+    try:
+        for replica in fleet.replicas:
+            replica.core.submit_external = _always_shed
+        with pytest.raises(RequestShed):
+            router.act("default", OBS, 200.0)
+        assert [r.consecutive_failures for r in fleet.replicas] == [0, 0]
+        assert [r.state for r in fleet.replicas] == ["serving", "serving"]
+    finally:
+        router.close()
+        fleet.close()
+
+
+def test_wire_roundtrip_stamps_replica_and_refunds_on_fleet_shed():
+    fleet, feed = _fleet()
+    router = FleetRouter(fleet, obs_shape=(4,))
+    gateway = ServeGateway(
+        router,
+        port=-1,
+        tenants=parse_tenant_spec("bulk:shed:rps=0.001,burst=1"),
+    ).start()
+    client = GatewayClient(
+        f"http://127.0.0.1:{gateway.port}", retries=0
+    )
+    try:
+        result = client.act([[0, 0, 0, 0], [0, 0, 0, 0]])
+        assert result.actions == [0, 0] and result.generation == 0
+        assert result.replica in ("r0", "r1")
+        # Fleet-wide shed: every candidate sheds, the LAST shed re-raises
+        # so the gateway 429s AND refunds the tenant's rate token — the
+        # PR-15 accounting, unchanged by the fleet in front. With
+        # burst=1 at ~0 rps, the same token must pay for every attempt:
+        # without the refund the later requests would answer
+        # 429 rate_limited instead of 429 overloaded.
+        for replica in fleet.replicas:
+            replica.core.submit_external = _always_shed
+        for _ in range(3):
+            status, doc = _post(
+                gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+                headers={"X-Tenant": "bulk"},
+            )
+            assert status == 429 and doc["error"] == "overloaded"
+    finally:
+        gateway.stop()
+        router.close()
+        fleet.close()
+
+
+def test_backend_extras_merge_never_overrides_protocol_fields():
+    class ExtrasBackend:
+        obs_shape = (4,)
+
+        def latency_estimate_ms(self):
+            return 0.0
+
+        def act(self, policy, obs, deadline_ms):
+            rows = obs.shape[0]
+            return (
+                np.zeros(rows, np.int32),
+                np.zeros(rows, np.float32),
+                5,
+                {"replica": "r9", "generation": 999, "endpoint": "evil"},
+            )
+
+        evaluate = act
+
+        def serve_stale(self, policy, obs):
+            raise GatewayDegraded("nothing anchored")
+
+    gateway = ServeGateway(ExtrasBackend(), port=-1).start()
+    client = GatewayClient(f"http://127.0.0.1:{gateway.port}", retries=0)
+    try:
+        result = client.act([[0, 0, 0, 0]])
+        assert result.replica == "r9"  # backend provenance rode along
+        assert result.generation == 5  # ... but protocol fields won
+    finally:
+        gateway.stop()
+
+
+def test_fleet_router_serve_stale_answers_from_the_anchor():
+    fleet, feed = _fleet()
+    router = FleetRouter(fleet, obs_shape=(4,))
+    try:
+        with pytest.raises(GatewayDegraded):
+            router.serve_stale("default", OBS)  # nothing anchored yet
+        _, _, version, extras = router.act("default", OBS, 500.0)
+        actions, logp, stale_version, stale_extras = router.serve_stale(
+            "default", OBS
+        )
+        assert stale_version == version == 0
+        assert actions.tolist() == [0, 0]
+        assert stale_extras["replica"] == extras["replica"]
+    finally:
+        router.close()
+        fleet.close()
