@@ -47,6 +47,7 @@ KNOWN_ENV_VARS = {
     "ASYNCRL_SMOKE_RECORD",   # scripts/perf_smoke.sh — ledger opt-in
     "ASYNCRL_SMOKE_UPDATES",  # scripts/perf_smoke harness sizing
     "ASYNCRL_SMOKE_TOLERANCE",  # scripts/perf_smoke pass threshold
+    "ASYNCRL_FUSED_AB_TOLERANCE",  # bench.py fused_ab pass threshold
     "ASYNCRL_CHAOS_STEPS",    # scripts/chaos_smoke.sh harness sizing
     "ASYNCRL_TRACE",          # obs/trace.py — arm pipeline tracing
     "ASYNCRL_TRACE_RING",     # obs/trace.py — per-thread ring capacity

@@ -30,6 +30,7 @@ from asyncrl_tpu.learn.learner import (
     TrainState,
     derive_init_keys,
     fuse_updates,
+    fused_smap_opts,
     init_params,
     make_optimizer,
     make_train_step,
@@ -180,6 +181,7 @@ class PopulationTrainer:
                 mesh=self.mesh,
                 in_specs=(spec, P(axes)),
                 out_specs=(spec, P(axes)),
+                **fused_smap_opts(config),
             ),
             donate_argnums=(0,) if config.donate_buffers else (),
         )

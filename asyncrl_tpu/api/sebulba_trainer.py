@@ -312,6 +312,37 @@ class SebulbaTrainer:
             # (this window minus the trailing mean: the operator-facing
             # "is replay actually closing the duty-cycle gap" signal).
             self._stall_history = deque(maxlen=8)
+        # HBM rollout hand-off (rollout/device_queue.py): the staging
+        # ledger one tier down — bounds device-resident fragments
+        # between H2D and the consuming update, and (with the replay
+        # ring) enables the zero-copy ref publish. "auto" resolves on
+        # the backend: fragments live in HBM only on a real accelerator;
+        # on CPU the device array aliases host memory and host staging
+        # already owns the hand-off, so the off path constructs NOTHING.
+        dq = config.device_queue
+        if dq == "auto":
+            dq = "on" if jax.default_backend() == "tpu" else "off"
+            config = config.replace(device_queue=dq)
+            self.config = config
+        if dq not in ("on", "off"):
+            raise ValueError(
+                f"unknown device_queue {config.device_queue!r}; "
+                "expected auto|on|off"
+            )
+        self._device_queue = None
+        if dq == "on":
+            from asyncrl_tpu.rollout import device_queue as devq_lib
+
+            self._device_queue = devq_lib.DeviceRolloutQueue(
+                self.learner.put_rollout,
+                slots=config.device_queue_slots,
+            )
+        # Replay adoption (publish ref=True) hands the learner the SAME
+        # device pytree on replayed passes, so it is only sound when the
+        # update does not donate its fragment argument.
+        self._replay_ref = (
+            self._device_queue is not None and not config.donate_buffers
+        )
         # Observability (asyncrl_tpu/obs/): arms span tracing + the
         # flight recorder per config.trace (ASYNCRL_TRACE wins), resets
         # the counters/histograms registry, and mounts the run-health
@@ -1358,6 +1389,11 @@ class SebulbaTrainer:
             self._replay.quarantine()
             self._reuse_window.drain()
             self._stall_history.clear()
+        if self._device_queue is not None:
+            # Straggler device leases go stale and every pending update
+            # handle drains: no async consumer of a slot outlives the
+            # cohort whose drain minted it.
+            self._device_queue.reset()
 
     # ----------------------------------------------------- durable runs
 
@@ -1737,53 +1773,84 @@ class SebulbaTrainer:
                         ),
                     )
                 t_put = time.perf_counter()
-                with trace.span(span_names.LEARNER_H2D_WAIT):
-                    rollout_d = self.learner.put_rollout(rollout)
-                    if ring is not None:
-                        # Transfer barrier: wait for slab i+1's H2D to
-                        # finish BEFORE dispatching its update — this wait
-                        # runs while the PREVIOUS update still computes on
-                        # device, so transfer time hides behind compute
-                        # and h2d_wait_s records only the part that didn't
-                        # fit under it.
-                        jax.block_until_ready(rollout_d)
-                h2d_wait = time.perf_counter() - t_put
-                h2d_wait_s += h2d_wait
-                # Registry histogram (obs/registry.py): the per-update
-                # unhidden-transfer distribution — p50/p95/max surface in
-                # the window next to the legacy h2d_wait_s sum.
-                obs_registry.histogram("h2d_wait_ms").observe(
-                    1e3 * h2d_wait
-                )
-                # Slab batches are constant-sized (precomputed); only the
-                # legacy stack path needs the per-update leaf walk.
-                h2d_bytes += (
-                    batch_ring.slab_nbytes
-                    if batch_ring is not None
-                    else int(
-                        sum(leaf.nbytes for leaf in jax.tree.leaves(rollout))
+                dlease = None
+                try:
+                    with trace.span(span_names.LEARNER_H2D_WAIT):
+                        if self._device_queue is None:
+                            rollout_d = self.learner.put_rollout(rollout)
+                        else:
+                            # HBM hand-off (rollout/device_queue.py): the
+                            # same sharded transfer, behind the queue's
+                            # slot ledger — enqueue blocks here (counted in
+                            # devq_reuse_waits) when the drain has outrun
+                            # the learner by the full queue depth.
+                            dlease = self._device_queue.enqueue(rollout)
+                            rollout_d = dlease.rollout()
+                        if ring is not None:
+                            # Transfer barrier: wait for slab i+1's H2D to
+                            # finish BEFORE dispatching its update — this
+                            # wait runs while the PREVIOUS update still
+                            # computes on device, so transfer time hides
+                            # behind compute and h2d_wait_s records only
+                            # the part that didn't fit under it.
+                            jax.block_until_ready(rollout_d)
+                    h2d_wait = time.perf_counter() - t_put
+                    h2d_wait_s += h2d_wait
+                    # Registry histogram (obs/registry.py): the per-update
+                    # unhidden-transfer distribution — p50/p95/max surface
+                    # in the window next to the legacy h2d_wait_s sum.
+                    obs_registry.histogram("h2d_wait_ms").observe(
+                        1e3 * h2d_wait
                     )
-                )
-                if self._replay is not None:
-                    # The fresh slab enters the device ring BEFORE the
-                    # update can donate it (publish is a device-to-device
-                    # install into the leased row, oldest-generation
-                    # eviction); the fresh pass itself counts as the
-                    # row's first consumption.
-                    self._replay.publish(
-                        rollout_d,
-                        behaviour_update=self._published_updates.get(
-                            batch[0].version, self._updates
-                        ),
+                    # Slab batches are constant-sized (precomputed); only
+                    # the legacy stack path needs the per-update leaf walk.
+                    h2d_bytes += (
+                        batch_ring.slab_nbytes
+                        if batch_ring is not None
+                        else int(
+                            sum(
+                                leaf.nbytes
+                                for leaf in jax.tree.leaves(rollout)
+                            )
+                        )
                     )
-                    self._reuse_window.observe(
-                        1,
-                        self._updates
-                        % max(cfg.target_update_period, 1),
+                    if self._replay is not None:
+                        # The fresh slab enters the device ring BEFORE the
+                        # update can donate it (publish is a device-to-
+                        # device install into the leased row, oldest-
+                        # generation eviction); the fresh pass itself
+                        # counts as the row's first consumption.
+                        self._replay.publish(
+                            rollout_d,
+                            behaviour_update=self._published_updates.get(
+                                batch[0].version, self._updates
+                            ),
+                            # Zero-copy adoption when the fragment is HBM-
+                            # resident behind the device queue's ledger and
+                            # the update cannot donate it out from under
+                            # the ring (see DeviceReplayRing.publish).
+                            ref=self._replay_ref,
+                        )
+                        self._reuse_window.observe(
+                            1,
+                            self._updates
+                            % max(cfg.target_update_period, 1),
+                        )
+                    self.state, metrics = self.learner.update(
+                        self.state, rollout_d
                     )
-                self.state, metrics = self.learner.update(
-                    self.state, rollout_d
-                )
+                # lint: broad-except-ok(cleanup-and-reraise: the held HBM lease voids so the slot cannot leak past train(), then the failure propagates unchanged)
+                except BaseException:
+                    if dlease is not None:
+                        # The update never consumed this fragment: void
+                        # the lease (barriers the in-flight H2D) so the
+                        # slot frees instead of leaking held.
+                        dlease.void()
+                    raise
+                if dlease is not None:
+                    # The slot re-leases only once THIS update's output
+                    # is ready — the staging retire gate, device tier.
+                    dlease.consume(self.state.update_step)
                 if batch_ring is not None:
                     # The slab frees only once this update's OUTPUT is
                     # ready — the gate that makes reuse safe even where
@@ -1865,6 +1932,13 @@ class SebulbaTrainer:
                     )
                     if ring is not None:
                         agg["slab_reuse_waits"] = ring.reuse_waits
+                    if self._device_queue is not None:
+                        # Device-tier twin of slab_reuse_waits: enqueues
+                        # that blocked on a pending update's handle (the
+                        # drain outran the learner by the queue depth).
+                        agg["devq_reuse_waits"] = (
+                            self._device_queue.reuse_waits
+                        )
                     # Off-policy staleness distribution for the window
                     # (staleness_p50/p95/max/mean, in learner updates) —
                     # the per-fragment lags behind the param_lag mean.

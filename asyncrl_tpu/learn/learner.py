@@ -174,24 +174,88 @@ def make_optimizer(config: Config) -> optax.GradientTransformation:
 
 
 def resolve_scan_impl(config: Config, mesh: Mesh) -> Config:
-    """Resolve ``scan_impl="auto"`` to a concrete implementation. Called by
-    each learner constructor so the per-shard loss code sees a fixed choice.
+    """Resolve ``scan_impl="auto"`` and ``fused_scan="auto"`` to concrete
+    implementations. Called by each learner constructor so the per-shard
+    loss code sees a fixed choice.
 
-    "auto" -> "associative" everywhere. The Pallas kernel
-    (ops/pallas_scan.py) WAS validated on a real TPU v5lite chip
-    (2026-07-30): its Mosaic lowering compiles and runs, and it is
+    ``scan_impl`` "auto" -> "associative" everywhere. The plain Pallas
+    scan kernel (ops/pallas_scan.py) WAS validated on a real TPU v5lite
+    chip (2026-07-30): its Mosaic lowering compiles and runs, and it is
     numerically identical to the associative scan (rtol 2e-5 over
     [128, 1024] fragments). End-to-end it is indistinguishable — the
-    reverse scan is a negligible slice of the train step at RL fragment
-    lengths, and single-chip throughput here is dispatch-dominated anyway
-    (see bench.py's sync-discipline note). It stays opt-in
-    (``scan_impl=pallas``) because it defines no VJP and buys nothing
-    measurable; it exists as the hook point for fragment lengths in the
-    thousands where a single VMEM walk beats the O(log T) all-HBM passes."""
+    reverse scan ALONE is a negligible slice of the train step at RL
+    fragment lengths — so it stays opt-in (``scan_impl=pallas``).
+
+    ``fused_scan`` "auto" -> "pallas" on TPU meshes, "lax" elsewhere.
+    Unlike the bare scan swap, the fused kernel replaces the WHOLE
+    V-trace/GAE tail — five [T, B] elementwise HBM passes plus the
+    O(log T) scan rounds collapse into one tile-resident pass — and it
+    is bit-identical to the lax reference (sequential schedule), so the
+    TPU default changes no training numerics beyond the documented
+    sequential-vs-associative rounding split that scan_impl already
+    owns. "interpret" (the Pallas interpreter) is the CPU CI surface;
+    it is never auto-selected."""
+    if config.fused_scan == "auto":
+        platform = mesh.devices.flat[0].platform if mesh.devices.size else "cpu"
+        config = config.replace(
+            fused_scan="pallas" if platform == "tpu" else "lax"
+        )
+    elif config.fused_scan not in ("pallas", "interpret", "lax"):
+        raise ValueError(
+            f"unknown fused_scan {config.fused_scan!r}; "
+            "expected auto|pallas|interpret|lax"
+        )
+    if config.smap_check not in ("auto", "off"):
+        raise ValueError(
+            f"unknown smap_check {config.smap_check!r}; expected auto|off"
+        )
+    if config.grad_reduce == "auto":
+        config = config.replace(grad_reduce="psum")
+    elif config.grad_reduce == "ring":
+        # Ring gradient sync replaces the EXPLICIT psum of the
+        # pre-graduation shard_map path; on jax with top-level shard_map
+        # the implicit vma-transpose reduction already ran by the time
+        # reduce_grads is called, so a ring there would double-reduce.
+        if hasattr(jax, "shard_map"):
+            raise ValueError(
+                "grad_reduce='ring' requires the explicit-reduction "
+                "shard_map path (jax.experimental.shard_map); this jax "
+                "reduces gradients implicitly — use grad_reduce='psum'"
+            )
+        if len(dp_axes(mesh)) != 1:
+            raise ValueError(
+                "grad_reduce='ring' needs a single data-parallel mesh "
+                f"axis, got {dp_axes(mesh)}; use grad_reduce='psum'"
+            )
+    elif config.grad_reduce != "psum":
+        raise ValueError(
+            f"unknown grad_reduce {config.grad_reduce!r}; "
+            "expected auto|psum|ring"
+        )
     if config.scan_impl != "auto":
         return config
-    del mesh
     return config.replace(scan_impl="associative")
+
+
+def fused_smap_opts(config: Config) -> dict:
+    """shard_map kwargs for a learner step whose loss tail may contain a
+    ``pallas_call``: jax 0.4.x's shard_map has no replication rule for it
+    (``NotImplementedError`` at trace time), so fused-kernel configs must
+    opt out of the replication checker. Safe here because the learner
+    bodies never rely on the checker's transpose rewrite — gradients of
+    the replicated params are reduced EXPLICITLY (``reduce_grads``,
+    parallel/mesh.py) and every P()-spec'd metric comes out of a
+    pmean/psum, i.e. is replicated by construction, checker or not. Lax
+    configs keep the checked path (and its free replication proofs)
+    unless ``smap_check="off"`` forces the opt-out — the knob A/B
+    probes use to compile both arms with the SAME wrapper, since the
+    checker's identity collectives move XLA fusion boundaries and can
+    shift loss trajectories by a final ULP on multi-device meshes."""
+    if config.smap_check == "off":
+        return {"check_vma": False}
+    if config.fused_scan in ("pallas", "interpret"):
+        return {"check_vma": False}
+    return {}
 
 
 def validate_qlearn_config(config: Config) -> None:
@@ -360,7 +424,8 @@ def _algo_loss(
         boot = qlearn_bootstrap(config, logits[-1], q_target)
         return qlearn_loss(
             logits_t, rollout.actions, rollout.rewards, discounts, boot,
-            scan_impl=config.scan_impl, huber_delta=config.huber_delta,
+            scan_impl=config.scan_impl, fused_scan=config.fused_scan,
+            huber_delta=config.huber_delta,
         )
     if config.algo == "a3c":
         return a3c_loss(
@@ -368,6 +433,7 @@ def _algo_loss(
             jax.lax.stop_gradient(bootstrap_value),
             value_coef=config.value_coef, entropy_coef=entropy_coef,
             dist=dist, scan_impl=config.scan_impl,
+            fused_scan=config.fused_scan,
             diagnostics=config.introspect,
         )
     if config.algo == "impala":
@@ -377,6 +443,7 @@ def _algo_loss(
             value_coef=config.value_coef, entropy_coef=entropy_coef,
             rho_clip=config.vtrace_rho_clip, c_clip=config.vtrace_c_clip,
             dist=dist, scan_impl=config.scan_impl,
+            fused_scan=config.fused_scan,
             diagnostics=config.introspect,
         )
     if config.algo == "ppo":
@@ -386,7 +453,7 @@ def _algo_loss(
         adv = gae(
             rollout.rewards, discounts, jax.lax.stop_gradient(values_t),
             jax.lax.stop_gradient(bootstrap_value), config.gae_lambda,
-            scan_impl=config.scan_impl,
+            scan_impl=config.scan_impl, fused=config.fused_scan,
         )
         return ppo_loss(
             logits_t, values_t, rollout.actions, rollout.behaviour_logp,
@@ -451,6 +518,7 @@ def _ppo_multipass(
             jax.lax.stop_gradient(bootstrap_value),
             config.gae_lambda,
             scan_impl=config.scan_impl,
+            fused=config.fused_scan,
         )
     else:
         from asyncrl_tpu.parallel.timeshard import gae_timesharded
@@ -509,7 +577,7 @@ def _ppo_multipass(
                 return loss / _axis_size(axes), metrics
 
             grads, metrics = jax.grad(scaled_loss, has_aux=True)(params)
-            grads = reduce_grads(grads, axes)
+            grads = reduce_grads(grads, axes, impl=config.grad_reduce)
             metrics["grad_norm"] = optax.global_norm(grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -885,7 +953,7 @@ def make_train_step(
                     grads, loss, metrics = accumulate_grads(
                         scaled_loss, state.params, rollout, n_accum
                     )
-            grads = reduce_grads(grads, axes)
+            grads = reduce_grads(grads, axes, impl=config.grad_reduce)
             with jax.named_scope("optimizer"):
                 grad_norm = optax.global_norm(grads)
                 updates, opt_state = optimizer.update(
@@ -1014,7 +1082,8 @@ class Learner:
 
         self._step = jax.jit(
             shard_map(
-                wrapped, mesh=mesh, in_specs=(spec,), out_specs=(spec, P())
+                wrapped, mesh=mesh, in_specs=(spec,), out_specs=(spec, P()),
+                **fused_smap_opts(config),
             ),
             donate_argnums=(0,) if config.donate_buffers else (),
         )

@@ -160,7 +160,16 @@ class ReplayLease:
         ring._row_reuse[self.row] += 1
         reuse = ring._row_reuse[self.row]
         behaviour = ring._row_behaviour[self.row]
-        slab = ring._take(ring._buf, np.int32(self.row))
+        # Adopted rows (publish ref=True) hand back the adopted pytree
+        # itself — zero-copy on the replay read path too; installed rows
+        # gather a fresh copy out of the stacked buffer (which is what
+        # keeps the LEARNER's donation of replayed fragments safe there).
+        ref = ring._row_ref[self.row]
+        slab = (
+            ref
+            if ref is not None
+            else ring._take(ring._buf, np.int32(self.row))
+        )
         return slab, reuse, behaviour
 
     def void(self) -> None:
@@ -243,6 +252,11 @@ class DeviceReplayRing:
         self._row_gen = [0] * rows  # 0 = empty row
         self._row_reuse = [0] * rows
         self._row_behaviour = [0] * rows
+        # Zero-copy adoptions (rollout/device_queue.py): a row published
+        # with ref=True stores the caller's device pytree here instead
+        # of installing into the stacked buffer; None = the row lives in
+        # self._buf (the install path).
+        self._row_ref: list[Rollout | None] = [None] * rows
         self._out: dict[int, ReplayLease] = {}  # row -> outstanding lease
 
     # ------------------------------------------------------------ facade
@@ -266,14 +280,28 @@ class DeviceReplayRing:
 
     # ----------------------------------------------------------- publish
 
-    def publish(self, slab: Rollout, behaviour_update: int = 0) -> None:
+    def publish(
+        self, slab: Rollout, behaviour_update: int = 0, ref: bool = False
+    ) -> None:
         """Land a fresh DEVICE slab into the cursor row (oldest-
         generation eviction: the cursor is the ring order). Called with
         the just-transferred fragment BEFORE the learner update can
         donate it; the install is a device-to-device copy (or in-place
         under donation). ``behaviour_update`` is the learner-update
         count the slab's behaviour params were published at — replayed
-        consumptions report staleness against it."""
+        consumptions report staleness against it.
+
+        ``ref=True`` ADOPTS the slab by reference — the zero-copy
+        publish path for fragments already resident in HBM behind the
+        device rollout queue's ledger (rollout/device_queue.py): no row
+        install, no install barrier (the slab is a committed device
+        array with no host alias to tear), and ``consume`` later hands
+        back the SAME pytree instead of a gathered copy. jax arrays are
+        immutable, so queue slot reuse can never corrupt the adoption;
+        the caller's one obligation is that the consuming updates do NOT
+        donate the fragment (the trainer enables ref publishing only
+        with ``config.donate_buffers`` off — a donating update would
+        delete the adopted buffers under the ring)."""
         row = self._cursor
         lease = self._outstanding(row)
         if lease is not None:
@@ -289,6 +317,13 @@ class DeviceReplayRing:
         self._row_reuse[row] = 1
         self._row_behaviour[row] = int(behaviour_update)
         self._cursor = (row + 1) % self._rows
+        if ref:
+            # Dropping a previous adoption (or shadowing a stacked-buffer
+            # row) is pure ledger work: the old reference frees when the
+            # last holder drops it.
+            self._row_ref[row] = slab
+            return
+        self._row_ref[row] = None
         self._buf = self._install(self._buf, slab, np.int32(row))
         # Barrier: the install is an INDEPENDENT async reader of the
         # fresh slab, and the staging ring's retire gate only waits for
@@ -341,6 +376,9 @@ class DeviceReplayRing:
         self._row_gen = [0] * self._rows
         self._row_reuse = [0] * self._rows
         self._row_behaviour = [0] * self._rows
+        # Adopted references drop with the ledger: quarantined HBM frees
+        # as soon as the device queue's slot binding also moves on.
+        self._row_ref = [None] * self._rows
         return dropped
 
 

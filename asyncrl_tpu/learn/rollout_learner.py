@@ -29,6 +29,7 @@ from asyncrl_tpu.learn.learner import (
     _ppo_multipass,
     accumulate_grads,
     entropy_coef_at,
+    fused_smap_opts,
     make_optimizer,
     qlearn_bootstrap,
     resolve_scan_impl,
@@ -394,6 +395,11 @@ class RolloutLearner:
 
                 def scaled_loss(p, frag):
                     ec = entropy_coef_at(config, state.update_step)
+                    # fused_scan reaches the non-timesharded branch through
+                    # _algo_loss/config; the timesharded variants keep the
+                    # two-level lax decomposition — the fused kernel's
+                    # whole-T recurrence has no sp-sharded form, so
+                    # fused_scan applies only to an unsharded time axis.
                     if time_sharded:
                         loss, metrics = _algo_loss_timesharded(
                             config, napply, p, frag,
@@ -421,7 +427,7 @@ class RolloutLearner:
                     grads, loss, metrics = accumulate_grads(
                         scaled_loss, state.params, rollout, n_accum
                     )
-                grads = reduce_grads(grads, reduce_axes)
+                grads = reduce_grads(grads, reduce_axes, impl=config.grad_reduce)
                 grad_norm = optax.global_norm(grads)
                 updates, opt_state = optimizer.update(
                     grads, state.opt_state, state.params
@@ -522,6 +528,7 @@ class RolloutLearner:
                     ),
                 ),
                 out_specs=(sspec, P()),
+                **fused_smap_opts(config),
             ),
             donate_argnums=(1,) if config.donate_buffers else (),
         )

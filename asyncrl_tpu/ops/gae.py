@@ -17,6 +17,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from asyncrl_tpu.ops.pallas_scan import fused_vtrace_pallas, mul_no_fma
 from asyncrl_tpu.ops.scan import reverse_linear_scan
 
 
@@ -32,9 +33,43 @@ def gae(
     bootstrap_value: jax.Array,
     gae_lambda: float = 0.95,
     scan_impl: str = "associative",
+    fused: str = "lax",
 ) -> GAEOutput:
+    # "auto" = unresolved config reaching the op directly: reference path
+    # (the ops.scan convention; Learner construction resolves to pallas).
+    if fused not in ("auto", "lax", "pallas", "interpret"):
+        raise ValueError(f"unknown fused mode: {fused!r}")
+    if fused in ("pallas", "interpret") and rewards.shape[0] and rewards.size:
+        # GAE rides the fused V-trace kernel with unit importance
+        # weights: delta_t collapses to the GAE TD error (x1.0 is
+        # bit-preserving), the scan coefficient is the reference's own
+        # discounts * gae_lambda expression (computed HERE, outside the
+        # kernel, like the V-trace prologue), the raw scan output IS the
+        # advantage, and the kernel's vs = advantage + value IS the
+        # return. Bit-identical to the sequential lax path on f32 inputs
+        # (tests/test_differential.py); f32 compute/outputs like the
+        # fused V-trace path.
+        f32 = jnp.float32
+        rewards = rewards.astype(f32)
+        discounts = discounts.astype(f32)
+        values = values.astype(f32)
+        bootstrap_value = bootstrap_value.astype(f32)
+        sg = jax.lax.stop_gradient
+        returns, advantages, _ = fused_vtrace_pallas(
+            jnp.ones_like(rewards),
+            sg(discounts * gae_lambda),
+            sg(rewards),
+            sg(discounts),
+            sg(values),
+            sg(bootstrap_value),
+            interpret=(fused == "interpret"),
+        )
+        return GAEOutput(advantages=advantages, returns=returns)
+
+    # mul_no_fma: FMA-fenced like the fused kernel, so both paths round
+    # identically in every fusion context (ops.pallas_scan.mul_no_fma).
     values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
-    deltas = rewards + discounts * values_tp1 - values
+    deltas = rewards + mul_no_fma(discounts, values_tp1) - values
     # Scan inputs stop-gradient'd (outputs are stop-gradient targets anyway;
     # the Pallas impl defines no VJP, so tangents must not reach it).
     advantages = reverse_linear_scan(
@@ -54,6 +89,7 @@ def n_step_returns(
     discounts: jax.Array,
     bootstrap_value: jax.Array,
     scan_impl: str = "associative",
+    fused: str = "lax",
 ) -> jax.Array:
     """Discounted n-step returns across the whole fragment (A3C targets,
     cf. the A3C paper's t_max-step returns — PAPERS.md:8): the lambda=1,
@@ -61,8 +97,32 @@ def n_step_returns(
     # R_t = r_t + gamma_t R_{t+1} with R_T = bootstrap; the scan solves for
     # x_T = 0, so fold the bootstrap into the final step's b term.
     rewards_ext = jnp.concatenate(
-        [rewards[:-1], (rewards[-1] + discounts[-1] * bootstrap_value)[None]], axis=0
+        [rewards[:-1], (rewards[-1] + mul_no_fma(discounts[-1], bootstrap_value))[None]],
+        axis=0,
     )
+    if fused not in ("auto", "lax", "pallas", "interpret"):
+        raise ValueError(f"unknown fused mode: {fused!r}")
+    if fused in ("pallas", "interpret") and rewards.size:
+        # Unit-weight, values = 0 degenerate case of the fused kernel:
+        # delta_t collapses to (r_t + d_t*0) - 0 == r_t (bit-preserving
+        # for every r_t except a literal -0.0 reward, which normalizes
+        # to +0.0 — below the noise floor of any real reward stream) and
+        # the scan coefficient input is d_t itself.
+        f32 = jnp.float32
+        rewards_ext = rewards_ext.astype(f32)
+        discounts = discounts.astype(f32)
+        sg = jax.lax.stop_gradient
+        zeros = jnp.zeros_like(rewards_ext)
+        _, returns, _ = fused_vtrace_pallas(
+            jnp.ones_like(rewards_ext),
+            sg(discounts),
+            sg(rewards_ext),
+            sg(discounts),
+            zeros,
+            jnp.zeros_like(bootstrap_value, dtype=f32),
+            interpret=(fused == "interpret"),
+        )
+        return returns
     # Inputs stop-gradient'd: the caller treats R_t as a fixed target, and
     # the Pallas impl defines no VJP.
     return reverse_linear_scan(

@@ -61,6 +61,7 @@ def a3c_loss(
     entropy_coef: float = 0.01,
     dist=None,
     scan_impl: str = "associative",
+    fused_scan: str = "lax",
     returns=None,
     diagnostics: bool = False,
 ):
@@ -70,10 +71,12 @@ def a3c_loss(
     V(x_T); advantage = R_t - V_t with stop-gradient on the target.
     ``returns`` may be passed precomputed (the time-sharded learner builds
     them with ``parallel.timeshard.n_step_returns_timesharded``).
+    ``fused_scan`` forwards to ``n_step_returns``' fused kernel selector.
     """
     if returns is None:
         returns = n_step_returns(
-            rewards, discounts, bootstrap_value, scan_impl=scan_impl
+            rewards, discounts, bootstrap_value, scan_impl=scan_impl,
+            fused=fused_scan,
         )
     returns = jax.lax.stop_gradient(returns)
     advantages = returns - values
@@ -107,6 +110,7 @@ def impala_loss(
     c_clip: float = 1.0,
     dist=None,
     scan_impl: str = "associative",
+    fused_scan: str = "lax",
     vtrace_out=None,
     diagnostics: bool = False,
 ):
@@ -131,6 +135,7 @@ def impala_loss(
         rho_clip=rho_clip,
         c_clip=c_clip,
         scan_impl=scan_impl,
+        fused=fused_scan,
     )
     pg_loss = -jnp.mean(target_logp * vt.pg_advantages)
     value_loss = 0.5 * jnp.mean(jnp.square(vt.vs - values))
@@ -162,6 +167,7 @@ def qlearn_loss(
     discounts: jax.Array,
     bootstrap_value: jax.Array,
     scan_impl: str = "associative",
+    fused_scan: str = "lax",
     returns=None,
     huber_delta: float = 0.0,
 ):
@@ -184,7 +190,8 @@ def qlearn_loss(
         # n_step_returns stop-gradients its inputs (fixed-target contract,
         # same as the a3c path); no second guard needed here.
         returns = n_step_returns(
-            rewards, discounts, bootstrap_value, scan_impl=scan_impl
+            rewards, discounts, bootstrap_value, scan_impl=scan_impl,
+            fused=fused_scan,
         )
     returns = jax.lax.stop_gradient(returns)
     q_taken = jnp.take_along_axis(

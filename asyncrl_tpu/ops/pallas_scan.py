@@ -21,7 +21,7 @@ construction — so no custom VJP is defined; differentiating through this
 kernel raises, which is the correct loud failure if a future loss forgets
 the stop (covered by tests/test_pallas_scan.py grad tests).
 
-Two kernels share the math:
+Three kernels share the math:
 
 - :func:`reverse_linear_scan_pallas` — automatic pipelining: Pallas
   block-feeds [T, block] tiles into VMEM and double-buffers across grid
@@ -35,6 +35,23 @@ Two kernels share the math:
   manual DMA — and as the live-tree surface the PAL static pass guards
   (delete a ``wait`` and ``python -m asyncrl_tpu.analysis`` fails
   before the chip can hang).
+- :func:`fused_vtrace_pallas` — the V-trace hot path in one kernel: the
+  per-step TD errors, the reverse recurrence, and the vs/pg-advantage
+  reconstruction, fused over [block_t, block_b] VMEM tiles that the
+  Pallas pipeline double-buffers along the (reversed) time axis. The lax
+  path reads/writes the fragment ~10 times across the elementwise ops and
+  the O(log T) associative-scan rounds; this kernel reads each input tile
+  once and writes each output tile once. Bit-exactness contract (pinned
+  by tests/test_differential.py): the fused path is bit-identical to the
+  f32 lax reference with ``scan_impl="sequential"``. Two ingredients make
+  that hold: every mul feeding an add is FMA-fenced on BOTH paths
+  (:func:`mul_no_fma` — XLA's contraction choice is fusion-context-
+  dependent), and the exp/clip prologue plus the clip-fraction
+  reductions stay OUTSIDE the kernel in the callers' plain jnp (XLA's
+  vectorized exp rounds loop-tail lanes differently, so it is only
+  reproducible at the reference's own [T, B] geometry). Compute is f32
+  regardless of input dtype (bf16 inputs are upcast once at entry; the
+  contract is then against the reference on the same upcast inputs).
 """
 
 from __future__ import annotations
@@ -70,6 +87,26 @@ def _scan_kernel(a_ref, b_ref, out_ref):
 
 def _round_up(n: int, mult: int) -> int:
     return (n + mult - 1) // mult * mult
+
+
+def mul_no_fma(x, y):
+    """``x * y``, fenced against FMA contraction.
+
+    LLVM may contract ``add(mul(x, y), z)`` into a single-rounded fma —
+    and whether it does depends on the fusion context, so the same
+    jnp expression can produce different BITS at top level vs inside a
+    Pallas kernel or a large loss jit (observed on CPU: the top-level
+    V-trace jit keeps the separate mul+add, the interpret-mode kernel
+    contracted). The fused-kernel bit-exactness contract needs one
+    deterministic answer, so every multiply that feeds an add on the
+    V-trace/GAE hot path — reference AND kernel — routes through this
+    fence: a data-dependent select between the mul and the add that the
+    compiler can neither fold (the operands differ) nor contract
+    through. Numerically the identity: ``prod == prod`` is true unless
+    prod is NaN, and a NaN keeps propagating (only its sign bit flips).
+    """
+    prod = x * y
+    return jnp.where(prod == prod, prod, -prod)
 
 
 def _out_struct(shape: tuple[int, ...], *arrays) -> jax.ShapeDtypeStruct:
@@ -223,3 +260,214 @@ def reverse_linear_scan_pallas_dma(
     )(a2, b2)
 
     return out[:T, :B].reshape(orig_shape).astype(a.dtype)
+
+
+def _fused_vtrace_kernel(
+    crho_ref,
+    a_ref,
+    rew_ref,
+    disc_ref,
+    val_ref,
+    boot_ref,
+    vs_ref,
+    adv_ref,
+    pg_ref,
+    carry_x,
+    carry_vn,
+    carry_vsn,
+):
+    """One (batch-block, time-chunk) grid step of the fused V-trace scan.
+
+    Grid is (B_blocks, n_chunks) with the time axis LAST, so for a fixed
+    batch block Pallas walks the time chunks consecutively — and, because
+    the index_map reverses the chunk order (jt=0 is the LAST chunk of
+    real time), the automatic pipeline double-buffers the [block_t,
+    block_b] VMEM tiles backwards along time, prefetching chunk jt+1
+    (earlier in time) while chunk jt computes. The recurrence carry and
+    the V_{t+1}/vs_{t+1} boundary rows live in (1, block_b) VMEM scratch
+    across chunks of the same batch block and are re-seeded from the
+    bootstrap row when jt == 0.
+
+    The time axis is FRONT-padded (zeros before t=0): real time ends at
+    the last padded row, so the bootstrap boundary seeds the first chunk
+    processed and the pad rows are walked last, after all real rows, as
+    dead compute whose outputs are sliced off by the wrapper.
+
+    Inputs are the PRE-CLIPPED weights (crho = min(rho_bar, rho),
+    a = d * min(c_bar, rho)), not the raw log-probs: the exp/minimum
+    prologue is pointwise [T, B] work the wrapper leaves in plain jnp —
+    XLA's vectorized exp was observed to round loop-TAIL lanes
+    differently from main-loop lanes, so an in-kernel exp over the
+    PADDED tile geometry cannot bit-match a reference exp over the raw
+    [T, B] array. Everything downstream of exp is mul/add/sub, which is
+    position-uniform once FMA contraction is fenced (mul_no_fma).
+    """
+    jt = pl.program_id(1)
+    boot = boot_ref[...]  # (1, block_b)
+
+    @pl.when(jt == 0)
+    def _():
+        # Recurrence boundary: x_T = 0, V_{T} = vs_{T} = bootstrap. The
+        # zero is built FROM the input (not jnp.zeros) so it inherits
+        # the input's varying-mesh-axes under shard_map interpret mode.
+        carry_x[...] = boot * 0.0
+        carry_vn[...] = boot
+        carry_vsn[...] = boot
+
+    block_t = rew_ref.shape[0]
+
+    # --- TD errors, vectorized (reference line):
+    #   delta_t = crho_t * (r_t + d_t * V_{t+1} - V_t)
+    # V_{t+1} within the chunk is the one-row shift of values; the
+    # chunk-boundary row is the carry (first row of the LATER-time chunk
+    # processed in the previous grid step, or the bootstrap at jt == 0).
+    # Reproduced as the SAME vectorized elementwise expression as the
+    # reference (a per-row formulation of the very same ops was observed
+    # to FMA-contract differently and drift by ULPs).
+    crho = crho_ref[...]
+    a = a_ref[...]
+    rew = rew_ref[...]
+    disc = disc_ref[...]
+    val = val_ref[...]
+    v_boundary = carry_vn[...]
+    vs_boundary = carry_vsn[...]
+    vtp1 = jnp.concatenate([val[1:, :], v_boundary], axis=0)
+    delta = crho * (rew + mul_no_fma(disc, vtp1) - val)
+
+    # --- The recurrence is the ONLY sequential piece:
+    #   x_t = delta_t + (d_t * cc_t) * x_{t+1}
+    # One fused multiply-add per row, identical in structure to the
+    # plain scan kernel (bit-pinned against the sequential lax scan).
+    def body(i, x):
+        t = block_t - 1 - i
+        x = (
+            jax.lax.dynamic_slice_in_dim(delta, t, 1, 0)
+            + jax.lax.dynamic_slice_in_dim(a, t, 1, 0) * x
+        )
+        adv_ref[pl.ds(t, 1), :] = x
+        return x
+
+    x_end = jax.lax.fori_loop(0, block_t, body, carry_x[...])
+
+    # --- vs / pg reconstruction, vectorized (reference lines):
+    #   vs_t = x_t + V_t
+    #   pg_t = crho_t * (r_t + d_t * vs_{t+1} - V_t)
+    adv = adv_ref[...]
+    vs = adv + val
+    vs_ref[...] = vs
+    vstp1 = jnp.concatenate([vs[1:, :], vs_boundary], axis=0)
+    pg_ref[...] = crho * (rew + mul_no_fma(disc, vstp1) - val)
+
+    carry_x[...] = x_end
+    carry_vn[...] = val[0:1, :]
+    carry_vsn[...] = vs[0:1, :]
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "block_t", "interpret"))
+def fused_vtrace_pallas(
+    clipped_rhos: jax.Array,
+    scan_coeffs: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    block_b: int = 512,
+    block_t: int = 256,
+    interpret: bool = False,
+):
+    """Fused V-trace hot path: TD errors + reverse scan + vs/pg
+    reconstruction in ONE Pallas kernel over double-buffered
+    [block_t, block_b] tiles.
+
+    ``clipped_rhos`` is min(rho_bar, rho) and ``scan_coeffs`` is
+    d_t * min(c_bar, rho) — the callers compute the exp/minimum
+    prologue (and the clip-fraction reductions) in plain jnp with the
+    REFERENCE's own expressions, because vectorized exp is not
+    position-uniform across loop tails and so cannot be reproduced
+    bit-exactly over a retiled/padded geometry (see the kernel
+    docstring). Everything after that prologue — the five [T, B]
+    elementwise passes and the recurrence the lax path spreads over
+    ~10 HBM round trips — runs here in one read of each input tile and
+    one write of each output tile.
+
+    Inputs are time-major [T, ...] (trailing dims flattened into the
+    lane axis, like :func:`_prep`) with ``bootstrap_value`` shaped like
+    one timestep [...]. Compute is f32 (non-f32 inputs upcast once at
+    entry).
+
+    Returns ``(vs, vs_minus_v, pg_advantages)`` — f32, shaped like
+    ``rewards``. ``vs_minus_v`` is the raw scan output: with unit
+    weights and ``c_bar = lambda`` it IS the GAE advantage and ``vs``
+    IS the GAE return, so :func:`ops.gae.gae` rides this kernel without
+    a second entry point.
+
+    Callers must stop_gradient the inputs (the outputs are
+    training-loop TARGETS — same contract as the plain scans); no VJP
+    is defined, so differentiating through raises loudly.
+
+    T == 0 and B == 0 are the callers' problem (they fall back to the
+    lax reference, which handles empties) — this function requires
+    non-degenerate shapes.
+    """
+    orig_shape = rewards.shape
+    T = orig_shape[0]
+    f32 = jnp.float32
+
+    def flat(x):
+        return x.reshape(T, -1).astype(f32)
+
+    crho, a, rew, disc, val = (
+        flat(x) for x in (clipped_rhos, scan_coeffs, rewards, discounts, values)
+    )
+    boot = bootstrap_value.reshape(1, -1).astype(f32)
+    B = rew.shape[1]
+
+    # Time is chunked (pipelined), batch is blocked (gridded). Chunk
+    # count first, then the chunk length rounds up to the sublane grid —
+    # keeps front-padding below 8 * n_chunks rows instead of up to a
+    # whole chunk. VMEM budget: 8 live tiles (5 in + 3 out) double-
+    # buffered by the pipeline = 16 tiles within ~8 MB of the ~16 MB.
+    n_chunks = max(1, -(-T // block_t))
+    bt = _round_up(-(-T // n_chunks), _SUBLANE)
+    budget_elems = (8 * 1024 * 1024) // (16 * 4)
+    fit_b = max(_LANE, (budget_elems // bt) // _LANE * _LANE)
+    block = min(block_b, fit_b, _round_up(B, _LANE))
+    B_pad = _round_up(B, block)
+    T_pad = n_chunks * bt
+    P = T_pad - T
+
+    def pad(x):
+        return jnp.pad(x, ((P, 0), (0, B_pad - B)))
+
+    crho, a, rew, disc, val = (pad(x) for x in (crho, a, rew, disc, val))
+    boot = jnp.pad(boot, ((0, 0), (0, B_pad - B)))
+
+    n_b = B_pad // block
+    # jt indexes PROCESSING order; chunk n_chunks-1-jt of padded time.
+    tile = pl.BlockSpec(
+        (bt, block), lambda ib, jt: (n_chunks - 1 - jt, ib), memory_space=pltpu.VMEM
+    )
+    args = (crho, a, rew, disc, val, boot)
+    vs, adv, pg = pl.pallas_call(
+        _fused_vtrace_kernel,
+        grid=(n_b, n_chunks),
+        in_specs=[tile] * 5
+        + [pl.BlockSpec((1, block), lambda ib, jt: (0, ib), memory_space=pltpu.VMEM)],
+        out_specs=[tile, tile, tile],
+        out_shape=[
+            _out_struct((T_pad, B_pad), *args),
+            _out_struct((T_pad, B_pad), *args),
+            _out_struct((T_pad, B_pad), *args),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, block), jnp.float32),
+            pltpu.VMEM((1, block), jnp.float32),
+            pltpu.VMEM((1, block), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+    def unpad(x):
+        return x[P:, :B].reshape(orig_shape)
+
+    return unpad(vs), unpad(adv), unpad(pg)

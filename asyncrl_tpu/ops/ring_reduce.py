@@ -1,0 +1,334 @@
+"""Bidirectional ring all-reduce for gradient sync (ROADMAP item 2).
+
+A monolithic ``psum`` is a black box the XLA scheduler places AFTER the
+backward pass; a chunked ring exposes the reduction as 2(n-1) neighbor
+transfers per direction that the scheduler can overlap with the tail of
+the backward pass (and, in the Pallas variant, with the kernel's own
+local HBM traffic). Both implementations here walk the SAME schedule —
+the classic reduce-scatter + all-gather ring (cf. the ring-permute
+kernels in SNIPPETS.md and the Pallas distributed guide) run clockwise
+and counter-clockwise at once over two halves of the payload, using the
+full bisection bandwidth:
+
+* ``ring_all_reduce_lax`` — the schedule in ``lax.ppermute`` steps.
+  Runs anywhere (CPU tests, TPU), composes with ``shard_map``'s
+  replication checker (``check_rep=True``), and is what the learner's
+  gradient sync wires (``parallel.mesh.reduce_grads``).
+* ``ring_all_reduce_pallas`` — the same schedule as ONE Pallas kernel:
+  ``pltpu.make_async_remote_copy`` RDMA steps against double-buffered
+  VMEM slots, local chunk loads overlapping the remote transfers. jax
+  0.4.x ``shard_map`` has no replication rule for ``pallas_call``, so
+  this variant needs a ``check_rep=False`` wrapper and is validated
+  on-chip against ``psum``/the lax twin by
+  ``scripts/validate_pallas_tpu.py`` (the learner swaps it in when the
+  shard_map rep gap closes — the call is already schedule-compatible).
+
+Numerics: the ring fixes the reduction ORDER — chunk c of the clockwise
+half lands fully reduced on device (c+1) mod n as the right-fold
+x_d + (x_{d-1} + (... + x_{c})), deterministically, run to run. That
+order differs from whatever ``psum`` compiles to, so ring-vs-psum is
+equal only within the float summation ULP bound ((n-1) rounding steps;
+tests/test_ring_reduce.py pins it), while ring-vs-ring — lax twin vs
+Pallas kernel, or the same impl re-run — is bit-identical. n=2 is
+bit-identical to psum too: a two-operand float add is commutative.
+
+Payload geometry: the flat vector is zero-padded into
+[2 directions, n chunks, S sublanes, 128 lanes] f32 tiles. Zero-padding
+is sum-safe (0.0 + 0.0 contributes nothing, and -0.0 cannot appear in
+the pad).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_SUBLANE = 8
+# VMEM budget for the Pallas kernel's scratch: 8 chunk-sized [S, 128]
+# f32 buffers (2 accumulators + 2 local-load slots + 2x2 recv slots).
+_MAX_SUBLANES = 512  # 8 * 512 * 128 * 4B = 2 MiB of scratch
+
+
+def static_axis_size(axis_name) -> int:
+    """The mapped axis size as a PYTHON int inside a shard_map body.
+
+    ``lax.psum(1, axis)`` only constant-folds inside XLA — the ring
+    needs the size at trace time to unroll its steps. jax 0.4.x keeps
+    the trace-time axis environment under ``jax._src.core``; newer jax
+    exposes ``jax.core.axis_frame``-family lookups. Try both, loudly.
+    """
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    size = 1
+    for name in names:
+        try:
+            from jax._src.core import get_axis_env
+
+            size *= int(get_axis_env().axis_size(name))
+            continue
+        except Exception:  # pragma: no cover - jax-version dependent
+            pass
+        frame = jax.core.axis_frame(name)  # pragma: no cover
+        size *= int(getattr(frame, "size", frame))  # pragma: no cover
+    return size
+
+
+def _to_chunks(flat: jax.Array, n: int) -> jax.Array:
+    """Zero-pad a flat f32 vector into [2, n, S, 128] ring tiles."""
+    rows_per_chunk = -(-flat.size // (2 * n * _LANE))
+    sublanes = max(_SUBLANE, -(-rows_per_chunk // _SUBLANE) * _SUBLANE)
+    total = 2 * n * sublanes * _LANE
+    padded = jnp.pad(flat, (0, total - flat.size))
+    return padded.reshape(2, n, sublanes, _LANE)
+
+
+def _ring_passes_lax(buf: jax.Array, axis_name, n: int, sign: int) -> jax.Array:
+    """One direction's reduce-scatter + all-gather over [n, S, 128]
+    chunks, in ``ppermute`` steps. ``sign=+1`` sends clockwise (to
+    device idx+1), ``sign=-1`` counter-clockwise. The chunk indices are
+    the kernel's exact schedule — keep the two in lockstep (the
+    bit-identity contract between the twins rests on it)."""
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + sign) % n) for i in range(n)]
+    # Reduce-scatter: step s sends the chunk accumulated at step s-1,
+    # receives the left/right neighbor's partial, folds the LOCAL chunk
+    # in as local + incoming (the kernel's operand order).
+    for s in range(n - 1):
+        send_c = jnp.mod(idx - sign * s, n)
+        incoming = jax.lax.ppermute(
+            jnp.take(buf, send_c, axis=0), axis_name, perm
+        )
+        recv_c = jnp.mod(idx - sign * (s + 1), n)
+        buf = buf.at[recv_c].set(jnp.take(buf, recv_c, axis=0) + incoming)
+    # All-gather: circulate the fully-reduced chunks. Device d owns
+    # reduced chunk (d + sign) and receives chunk (d - sign*s) at step s.
+    for s in range(n - 1):
+        send_c = jnp.mod(idx + sign * (1 - s), n)
+        incoming = jax.lax.ppermute(
+            jnp.take(buf, send_c, axis=0), axis_name, perm
+        )
+        recv_c = jnp.mod(idx - sign * s, n)
+        buf = buf.at[recv_c].set(incoming)
+    return buf
+
+
+def ring_all_reduce_lax(x: jax.Array, axis_name, axis_size: int | None = None):
+    """Sum ``x`` across ``axis_name`` with the bidirectional ring
+    schedule, in lax collectives. Call inside shard_map over a single
+    mesh axis. Drop-in for ``lax.psum(x, axis_name)`` up to summation
+    order (module docstring)."""
+    n = static_axis_size(axis_name) if axis_size is None else axis_size
+    if n == 1:
+        return x
+    orig_dtype = x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    buf = _to_chunks(flat, n)
+    out0 = _ring_passes_lax(buf[0], axis_name, n, +1)
+    out1 = _ring_passes_lax(buf[1], axis_name, n, -1)
+    out = jnp.stack([out0, out1]).reshape(-1)[: flat.size]
+    return out.reshape(x.shape).astype(orig_dtype)
+
+
+def _ring_kernel(
+    x_ref,  # ANY [2, n, S, 128] local payload
+    o_ref,  # ANY [2, n, S, 128] reduced payload
+    acc0, acc1,  # VMEM [S, 128] per-direction accumulators
+    tmp0, tmp1,  # VMEM [S, 128] local chunk load slots
+    recv0, recv1,  # VMEM [2, S, 128] double-buffered RDMA landing slots
+    local_sem, store_sem, send_sem, recv_sem,
+    *, n: int, axis_name: str,
+):
+    """The lax twin's schedule as explicit RDMA: every remote step is a
+    ``make_async_remote_copy`` whose recv slot alternates by step parity.
+    Slot safety rides the SPMD symmetry the guide's ring examples use: a
+    neighbor reuses slot p only after its previous step's ``wait()``,
+    which includes the arrival of OUR send — i.e. after we finished
+    reading that slot (the kernel body is serial per device)."""
+    idx = jax.lax.axis_index(axis_name)
+    right = jnp.mod(idx + 1, n)
+    left = jnp.mod(idx - 1, n)
+
+    # Prologue: my own chunk idx seeds both directions' accumulators.
+    cp0 = pltpu.make_async_copy(x_ref.at[0, idx], acc0, local_sem.at[0])
+    cp0.start()
+    cp1 = pltpu.make_async_copy(x_ref.at[1, idx], acc1, local_sem.at[1])
+    cp1.start()
+    cp0.wait()
+    cp1.wait()
+
+    # Neighborhood barrier (guide: Local Barrier Between Neighbors): no
+    # RDMA may launch until both neighbors entered the kernel, or the
+    # first transfer could land in a slot still owned by the PREVIOUS
+    # kernel on that chip.
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=(left,),
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=(right,),
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+    # Reduce-scatter: both directions' sends fly first, then the local
+    # loads of the next chunk overlap them; the adds run as each pair of
+    # transfers completes.
+    for s in range(n - 1):
+        slot = s % 2
+        r0 = pltpu.make_async_remote_copy(
+            src_ref=acc0, dst_ref=recv0.at[slot],
+            send_sem=send_sem.at[0, slot], recv_sem=recv_sem.at[0, slot],
+            device_id=(right,), device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        r0.start()
+        r1 = pltpu.make_async_remote_copy(
+            src_ref=acc1, dst_ref=recv1.at[slot],
+            send_sem=send_sem.at[1, slot], recv_sem=recv_sem.at[1, slot],
+            device_id=(left,), device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        r1.start()
+        c0 = pltpu.make_async_copy(
+            x_ref.at[0, jnp.mod(idx - (s + 1), n)], tmp0, local_sem.at[2]
+        )
+        c0.start()
+        c1 = pltpu.make_async_copy(
+            x_ref.at[1, jnp.mod(idx + (s + 1), n)], tmp1, local_sem.at[3]
+        )
+        c1.start()
+        c0.wait()
+        r0.wait()  # send done (acc0 reusable) AND my incoming landed
+        acc0[...] = tmp0[...] + recv0[slot]
+        c1.wait()
+        r1.wait()
+        acc1[...] = tmp1[...] + recv1[slot]
+
+    # My fully-reduced chunks — (idx+1) clockwise, (idx-1) counter —
+    # go straight to HBM.
+    st0 = pltpu.make_async_copy(
+        acc0, o_ref.at[0, jnp.mod(idx + 1, n)], store_sem.at[0]
+    )
+    st0.start()
+    st1 = pltpu.make_async_copy(
+        acc1, o_ref.at[1, jnp.mod(idx - 1, n)], store_sem.at[1]
+    )
+    st1.start()
+    st0.wait()
+    st1.wait()
+
+    # All-gather: circulate the reduced chunks; each received slot is
+    # both the HBM store source and the next step's send source.
+    for s in range(n - 1):
+        slot = s % 2
+        src0 = acc0 if s == 0 else recv0.at[(s - 1) % 2]
+        src1 = acc1 if s == 0 else recv1.at[(s - 1) % 2]
+        r0 = pltpu.make_async_remote_copy(
+            src_ref=src0, dst_ref=recv0.at[slot],
+            send_sem=send_sem.at[0, slot], recv_sem=recv_sem.at[0, slot],
+            device_id=(right,), device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        r0.start()
+        r1 = pltpu.make_async_remote_copy(
+            src_ref=src1, dst_ref=recv1.at[slot],
+            send_sem=send_sem.at[1, slot], recv_sem=recv_sem.at[1, slot],
+            device_id=(left,), device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        r1.start()
+        r0.wait()
+        r1.wait()
+        st0 = pltpu.make_async_copy(
+            recv0.at[slot], o_ref.at[0, jnp.mod(idx - s, n)],
+            store_sem.at[0],
+        )
+        st0.start()
+        st1 = pltpu.make_async_copy(
+            recv1.at[slot], o_ref.at[1, jnp.mod(idx + s, n)],
+            store_sem.at[1],
+        )
+        st1.start()
+        st0.wait()
+        st1.wait()
+
+
+def ring_all_reduce_pallas(
+    x: jax.Array,
+    axis_name: str,
+    axis_size: int | None = None,
+    collective_id: int = 7,
+    interpret: bool = False,
+):
+    """Sum ``x`` across ``axis_name`` with the Pallas RDMA ring kernel.
+
+    Must run inside a ``shard_map`` with ``check_rep=False`` on jax 0.4.x
+    (no pallas_call replication rule — see module docstring); use
+    ``ring_all_reduce_lax`` under a checked shard_map. Bit-identical to
+    the lax twin (same schedule, same operand order)."""
+    n = static_axis_size(axis_name) if axis_size is None else axis_size
+    if n == 1:
+        return x
+    orig_dtype = x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    buf = _to_chunks(flat, n)
+    sublanes = buf.shape[2]
+    if sublanes > _MAX_SUBLANES:
+        raise ValueError(
+            f"ring payload chunk [{sublanes}, {_LANE}] exceeds the kernel's "
+            f"VMEM scratch budget ([{_MAX_SUBLANES}, {_LANE}] per chunk, "
+            f"i.e. {2 * n * _MAX_SUBLANES * _LANE} f32 elements total at "
+            f"n={n}); reduce in segments or use ring_all_reduce_lax"
+        )
+    chunk = (sublanes, _LANE)
+    out = pl.pallas_call(
+        functools.partial(_ring_kernel, n=n, axis_name=axis_name),
+        out_shape=jax.ShapeDtypeStruct(buf.shape, jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM(chunk, jnp.float32),  # acc0
+            pltpu.VMEM(chunk, jnp.float32),  # acc1
+            pltpu.VMEM(chunk, jnp.float32),  # tmp0
+            pltpu.VMEM(chunk, jnp.float32),  # tmp1
+            pltpu.VMEM((2,) + chunk, jnp.float32),  # recv0
+            pltpu.VMEM((2,) + chunk, jnp.float32),  # recv1
+            pltpu.SemaphoreType.DMA((4,)),  # local_sem
+            pltpu.SemaphoreType.DMA((2,)),  # store_sem
+            pltpu.SemaphoreType.DMA((2, 2)),  # send_sem [dir, slot]
+            pltpu.SemaphoreType.DMA((2, 2)),  # recv_sem [dir, slot]
+        ],
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=collective_id,
+        ),
+        interpret=interpret,
+    )(buf)
+    out = out.reshape(-1)[: flat.size]
+    return out.reshape(x.shape).astype(orig_dtype)
+
+
+def ring_all_reduce_grads(grads, axes):
+    """Ring-sum a gradient PYTREE across a single mesh axis: the
+    ``reduce_grads(impl="ring")`` body. Flattens the whole tree into one
+    vector first — one ring over the concatenation beats a ring per leaf
+    (most leaves are far below the chunk size and would degenerate to
+    pure latency)."""
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    if len(axes) != 1:
+        raise ValueError(
+            f"ring gradient reduction needs a single mesh axis, got {axes}; "
+            "use grad_reduce='psum' on multi-axis meshes"
+        )
+    from jax.flatten_util import ravel_pytree
+
+    flat, unravel = ravel_pytree(grads)
+    return unravel(ring_all_reduce_lax(flat, axes[0]))
+
+
+__all__ = [
+    "ring_all_reduce_grads",
+    "ring_all_reduce_lax",
+    "ring_all_reduce_pallas",
+    "static_axis_size",
+]

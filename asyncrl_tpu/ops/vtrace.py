@@ -27,6 +27,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from asyncrl_tpu.ops.pallas_scan import fused_vtrace_pallas, mul_no_fma
 from asyncrl_tpu.ops.scan import reverse_linear_scan
 
 
@@ -47,6 +48,7 @@ def vtrace(
     rho_clip: float = 1.0,
     c_clip: float = 1.0,
     scan_impl: str = "associative",
+    fused: str = "lax",
 ) -> VTraceOutput:
     """Compute V-trace targets and advantages.
 
@@ -60,17 +62,71 @@ def vtrace(
       bootstrap_value: [B] V(x_T).
       rho_clip: rho_bar >= c_bar per the paper.
       c_clip: c_bar.
+      scan_impl: recurrence impl for the LAX path (``ops.scan``).
+      fused: "lax" (this function's elementwise ops + ``scan_impl``),
+        "pallas" (the whole hot path in ``fused_vtrace_pallas``, compiled),
+        or "interpret" (same kernel in the Pallas interpreter — CPU CI).
+        The fused path is bit-identical to ``fused="lax",
+        scan_impl="sequential"`` on f32 inputs (tests/test_differential.py).
 
     Returns:
       ``VTraceOutput`` with stop-gradient applied to vs and advantages.
     """
+    # "auto" (an unresolved config reaching the op directly, the same
+    # convention ops.scan.reverse_linear_scan follows) runs the reference
+    # path; resolution to pallas happens at Learner construction.
+    if fused not in ("auto", "lax", "pallas", "interpret"):
+        raise ValueError(f"unknown fused mode: {fused!r}")
+    if fused in ("pallas", "interpret") and rewards.shape[0] and rewards.size:
+        # The exp/minimum prologue and the clip-fraction reductions run
+        # HERE, in plain jnp, with the reference's own expressions below
+        # — vectorized exp is not bit-reproducible over the kernel's
+        # retiled geometry (see fused_vtrace_pallas). Everything after
+        # the prologue is fused into the kernel. All kernel inputs are
+        # stop-gradient'd: the outputs are targets/metrics through which
+        # gradients never flow in the lax path either, and the kernel
+        # defines no VJP. The fused path computes in f32 throughout
+        # (inputs upcast once HERE, before the prologue): its contract
+        # on low-precision inputs is bit-identity to the reference on
+        # the same f32-upcast inputs, and its outputs stay f32.
+        f32 = jnp.float32
+        behaviour_logp = behaviour_logp.astype(f32)
+        target_logp = target_logp.astype(f32)
+        rewards = rewards.astype(f32)
+        discounts = discounts.astype(f32)
+        values = values.astype(f32)
+        bootstrap_value = bootstrap_value.astype(f32)
+        rhos = jnp.exp(target_logp - behaviour_logp)
+        clipped_rhos = jnp.minimum(rho_clip, rhos)
+        clipped_cs = jnp.minimum(c_clip, rhos)
+        sg = jax.lax.stop_gradient
+        vs, _, pg_advantages = fused_vtrace_pallas(
+            sg(clipped_rhos),
+            sg(discounts * clipped_cs),
+            sg(rewards),
+            sg(discounts),
+            sg(values),
+            sg(bootstrap_value),
+            interpret=(fused == "interpret"),
+        )
+        return VTraceOutput(
+            vs=vs,
+            pg_advantages=pg_advantages,
+            rho_clip_frac=jnp.mean((rhos > rho_clip).astype(jnp.float32)),
+            c_clip_frac=jnp.mean((rhos > c_clip).astype(jnp.float32)),
+        )
+
     log_rhos = target_logp - behaviour_logp
     rhos = jnp.exp(log_rhos)
     clipped_rhos = jnp.minimum(rho_clip, rhos)
     clipped_cs = jnp.minimum(c_clip, rhos)
 
+    # mul_no_fma: the discount products are FMA-fenced on BOTH paths so
+    # the reference's bits cannot drift with the fusion context (see
+    # ops.pallas_scan.mul_no_fma) — a no-op where XLA already kept the
+    # separate mul+add, which is what the top-level jit does.
     values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
-    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+    deltas = clipped_rhos * (rewards + mul_no_fma(discounts, values_tp1) - values)
 
     # vs_t - V_t = delta_t + gamma_t c_t (vs_{t+1} - V_{t+1}).
     # The scan's INPUTS are stop-gradient'd (not just the outputs below):
@@ -84,7 +140,7 @@ def vtrace(
     vs = vs_minus_v + values
 
     vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
-    pg_advantages = clipped_rhos * (rewards + discounts * vs_tp1 - values)
+    pg_advantages = clipped_rhos * (rewards + mul_no_fma(discounts, vs_tp1) - values)
 
     # Clip saturation fractions (ISSUE 8 off-policy diagnostics): how often
     # the importance weights hit their caps. Near-1.0 rho saturation means

@@ -107,18 +107,34 @@ def axis_size(axis_name):
     return jax.lax.psum(1, axis_name)
 
 
-def reduce_grads(grads, axes):
+def reduce_grads(grads, axes, impl: str = "psum"):
     """Cross-shard reduction for gradients of REPLICATED params computed by
     ``jax.grad`` INSIDE a shard_map body. Under jax>=0.8 vma semantics the
     transpose of the implicit replicated->varying broadcast psums those
     cotangents automatically (the bodies scale their loss by 1/axis_size to
     match); the pre-graduation shard_map does no such thing inside the body
     — each shard would silently keep its LOCAL gradient — so this inserts
-    the psum explicitly there. No-op on new jax (a second psum would
-    double-count) and on an unsharded mesh."""
+    the reduction explicitly there. No-op on new jax (a second reduction
+    would double-count — which is also why ``impl`` cannot apply there;
+    ``resolve_scan_impl`` rejects ring on that path) and on an unsharded
+    mesh.
+
+    ``impl``: "psum"/"auto" — one compiler-scheduled all-reduce of the
+    whole tree; "ring" — the deterministic-order bidirectional ring
+    schedule over the flattened tree (``ops.ring_reduce``), which exposes
+    2(n-1) chunked neighbor transfers the scheduler can overlap with the
+    tail of the backward pass. Ring sums in a fixed order, so it is
+    run-to-run deterministic but differs from psum within the float
+    summation ULP bound (bit-equal at n=2)."""
     if not axes or hasattr(jax, "shard_map"):
         return grads
-    return jax.tree.map(lambda g: jax.lax.psum(g, axes), grads)
+    if impl in ("psum", "auto"):
+        return jax.tree.map(lambda g: jax.lax.psum(g, axes), grads)
+    if impl == "ring":
+        from asyncrl_tpu.ops.ring_reduce import ring_all_reduce_grads
+
+        return ring_all_reduce_grads(grads, axes)
+    raise ValueError(f"unknown grad_reduce impl {impl!r}; expected psum|ring")
 
 
 def make_mesh(

@@ -251,6 +251,20 @@ class Config:
     # steady-state acquisition never blocks (blocking is counted in the
     # slab_reuse_waits metric either way).
     staging_slabs: int = 0
+    # HBM rollout hand-off (rollout/device_queue.py): bound the device-
+    # resident fragments between H2D and the consuming update behind a
+    # generation/lease ledger (the staging-ring discipline one tier
+    # down), and give the replay ring a zero-copy (by-reference) publish
+    # path. "auto" resolves at Sebulba trainer construction: on where
+    # the default backend is a TPU (fragments live in HBM), off
+    # elsewhere (CPU device arrays alias host memory — there is no HBM
+    # tier to manage, and host staging already owns the hand-off).
+    # "on"/"off" force it either way; the off path constructs NOTHING
+    # (the elastic/introspect off-is-bit-identical discipline).
+    device_queue: str = "auto"
+    # Queue depth in fragments; 2 = the double-buffer (slot B's transfer
+    # overlaps slot A's update). Must be >= 2 when the queue is on.
+    device_queue_slots: int = 2
 
     # --- device-resident replay (learn/replay.py; host backends) ---
     # IMPACT-style sample reuse (arXiv:1912.00167): a circular ring of
@@ -521,6 +535,40 @@ class Config:
     # use it on TPU (long-T fragments benefit most), or
     # "pallas_interpret" | "sequential" for debugging.
     scan_impl: str = "auto"
+    # Fused V-trace/GAE device hot path (ops/pallas_scan.py
+    # fused_vtrace_pallas): TD errors + reverse recurrence + vs/pg
+    # reconstruction in one Pallas kernel instead of ~10 HBM round trips
+    # of lax elementwise + scan. "auto" resolves at Learner construction
+    # (learn/learner.py resolve_scan_impl): "pallas" on TPU, "lax" on
+    # CPU/GPU. "interpret" runs the same kernel in the Pallas
+    # interpreter (CPU CI; tier-1 differential coverage). The fused path
+    # is bit-identical to the lax reference with scan_impl="sequential"
+    # (tests/test_differential.py) and supersedes scan_impl when active
+    # — scan_impl then only governs the lax fallback (zero-length
+    # traces, time-sharded losses).
+    fused_scan: str = "auto"
+    # shard_map replication-checker wrapper (learn/learner.py
+    # fused_smap_opts). "auto": fused-kernel configs opt out of the
+    # checker (jax 0.4.x shard_map has no pallas_call replication rule),
+    # lax configs keep the checked wrapper and its free replication
+    # proofs. "off": force the opt-out on any config — the checked and
+    # unchecked wrappers compile DIFFERENT HLO (the checker's identity
+    # collectives move fusion boundaries), which can split otherwise
+    # identical loss trajectories at the final ULP on multi-device
+    # meshes. A/B probes that claim bit-identity across arms (bench.py
+    # fused_ab, tests/test_differential.py) pin the lax reference arm to
+    # "off" so the only varying ingredient is the kernel under test.
+    smap_check: str = "auto"
+    # Gradient all-reduce schedule (parallel/mesh.py reduce_grads):
+    # "psum" — one compiler-scheduled all-reduce; "ring" — the
+    # deterministic-order bidirectional ring (ops/ring_reduce.py), 2(n-1)
+    # chunked neighbor transfers the scheduler can overlap with the tail
+    # of the backward pass. "auto" resolves to "psum" at Learner
+    # construction (ring is opt-in: its fixed summation order differs
+    # from psum within the float ULP bound, bit-equal at n=2). Ring
+    # needs a single data-parallel mesh axis and the explicit-reduction
+    # shard_map path (resolve_scan_impl validates both).
+    grad_reduce: str = "auto"
     # Donate the TrainState into the compiled step. Off by default: the
     # experimental axon PJRT plugin (the one real chip available here)
     # returns INVALID_ARGUMENT when the full train step's donation/aliasing

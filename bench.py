@@ -299,6 +299,140 @@ def measure_preset(preset_name: str, overrides: list[str]) -> dict:
     return result
 
 
+def measure_fused_ab(overrides: list[str]) -> dict:
+    """A/B the fused Pallas V-trace scan against the lax path on one
+    identical Anakin config (``python bench.py fused_ab [key=value ...]``)
+    — the device-hot-path sibling of scripts/perf_smoke.sh's overlap_ab.
+
+    Two claims, checked separately because they pin different references:
+
+    - **Loss bit-identity**: the fused kernel's contract is bit-equality
+      against the SEQUENTIAL lax scan (ops/pallas_scan.py; the
+      associative production scan rounds differently by design), so the
+      identity arm runs ``fused_scan="lax", scan_impl="sequential"`` and
+      the losses must match to the bit on the shared seed. The identity
+      arm also pins ``smap_check="off"`` so both arms compile the SAME
+      (unchecked) shard_map wrapper — the replication checker's identity
+      collectives move XLA fusion boundaries, which drifts trajectories
+      a final ULP on multi-device meshes independent of the kernel.
+    - **Throughput**: the perf bar is against the PRODUCTION lax path
+      (``fused_scan="lax"`` with the default scan_impl resolution) —
+      beating a deliberately-slow reference would be a hollow win. On an
+      accelerator the fused arm must not be slower beyond
+      ASYNCRL_FUSED_AB_TOLERANCE (default 1.10x, the perf_smoke noise
+      convention); the CPU interpreter arm only reports (the Pallas
+      interpreter is an emulator — its fps is not evidence either way).
+
+    Records one kind="device_hot_path" probe="fused_ab" ledger row.
+    """
+    import jax
+    import numpy as np
+
+    from asyncrl_tpu.api.trainer import Trainer
+    from asyncrl_tpu.envs import registered
+    from asyncrl_tpu.utils import bench_history
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    fused_mode = "interpret" if on_cpu else "pallas"
+    tolerance = float(os.environ.get("ASYNCRL_FUSED_AB_TOLERANCE", "1.10"))
+    preset_name = (
+        "pong_impala" if "JaxPong-v0" in registered() else "cartpole_impala"
+    )
+    cfg = resolve_bench_config(preset_name, overrides, on_cpu)
+    if on_cpu:
+        # The interpreter arm runs the kernel as a Python emulation: keep
+        # the CPU geometry small enough that the probe finishes inside a
+        # CI window. Explicit overrides win, as everywhere in bench.py.
+        if not any(o.startswith("num_envs=") for o in overrides):
+            cfg = cfg.replace(num_envs=64)
+        if not any(o.startswith("updates_per_call=") for o in overrides):
+            cfg = cfg.replace(updates_per_call=4)
+    if cfg.backend != "tpu":
+        print(
+            f"bench: fused_ab needs the Anakin backend, got "
+            f"{cfg.backend!r}",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+
+    def losses_of(arm_cfg, calls: int = 3):
+        trainer = Trainer(arm_cfg)
+        state = trainer.state
+        out = []
+        for _ in range(calls):
+            state, metrics = trainer.learner.update(state)
+            out.append(np.asarray(jax.device_get(metrics["loss"])))
+        return np.stack(out), trainer, state
+
+    # Identity arm: fused vs the sequential lax reference, same seed.
+    fused_losses, fused_trainer, fused_state = losses_of(
+        cfg.replace(fused_scan=fused_mode)
+    )
+    seq_losses, _, _ = losses_of(
+        cfg.replace(fused_scan="lax", scan_impl="sequential", smap_check="off")
+    )
+    if not np.array_equal(fused_losses, seq_losses):
+        print(
+            "bench: fused_ab FAILED — fused losses diverged from the "
+            f"sequential lax reference (max abs diff "
+            f"{np.max(np.abs(fused_losses - seq_losses))})",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+    # Throughput arms: fused (continuing the warm trainer) vs the
+    # PRODUCTION lax path, both through the shared sync-disciplined
+    # window.
+    _, timed_f, elapsed_f = timed_update_window(
+        fused_trainer.learner.update, fused_state, cfg.updates_per_call
+    )
+    lax_losses, lax_trainer, lax_state = losses_of(
+        cfg.replace(fused_scan="lax")
+    )
+    _, timed_l, elapsed_l = timed_update_window(
+        lax_trainer.learner.update, lax_state, cfg.updates_per_call
+    )
+    per_call = cfg.updates_per_call * cfg.num_envs * cfg.unroll_len
+    fps_fused = timed_f * per_call / elapsed_f
+    fps_lax = timed_l * per_call / elapsed_l
+
+    if not on_cpu and fps_fused * tolerance < fps_lax:
+        print(
+            f"bench: fused_ab FAILED — fused path slower "
+            f"({fps_fused:,.0f} vs {fps_lax:,.0f} fps, "
+            f"tolerance {tolerance}x)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+    dev = bench_history.device_entry()
+    bench_history.record({
+        "kind": "device_hot_path",
+        "probe": "fused_ab",
+        "preset": preset_name,
+        **dev,
+        "num_envs": cfg.num_envs,
+        "unroll_len": cfg.unroll_len,
+        "updates_per_call": cfg.updates_per_call,
+        "fused_impl": fused_mode,
+        "fps_fused": round(fps_fused),
+        "fps_lax": round(fps_lax),
+        "fused_speedup": round(fps_fused / fps_lax, 3),
+        "losses_bit_identical": True,
+    })
+    return {
+        "metric": f"fused_ab ({preset_name}, {cfg.num_envs} envs x "
+        f"{cfg.unroll_len} unroll x {cfg.updates_per_call} fused "
+        f"updates/call, {fused_mode}, {dev['device_kind']} "
+        f"x{dev['device_count']})",
+        "fps_fused": round(fps_fused),
+        "fps_lax": round(fps_lax),
+        "fused_speedup": round(fps_fused / fps_lax, 3),
+        "losses_bit_identical": True,
+        "unit": "frames/sec",
+    }
+
+
 # Dual-flagship driver mode (VERDICT r3 Next #3/Weak #2): the vector-Pong
 # number alone overstates the framework (its MLP is trivial — the win is
 # dispatch amortization), so the no-preset invocation measures BOTH
@@ -323,6 +457,10 @@ def main() -> None:
             overrides.append(a)
         else:
             preset_name = a
+
+    if preset_name == "fused_ab":
+        print(json.dumps(measure_fused_ab(overrides)))
+        return
 
     if preset_name is not None:
         print(json.dumps(measure_preset(preset_name, overrides)))

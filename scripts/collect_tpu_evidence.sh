@@ -28,6 +28,11 @@ run python scripts/bench_matrix.py
 # vector config (VERDICT #2's requested breakdown).
 run python scripts/roofline.py atari_impala updates_per_call=8
 run python scripts/roofline.py pong_impala updates_per_call=32
+# Device hot path: on-chip bit-identity gates for the fused V-trace tail
+# and the RDMA ring, then the fused on/off throughput A/B on the
+# flagship geometry (ledger rows kind=kernel_validation/device_hot_path).
+run python scripts/validate_pallas_tpu.py fused ring
+run python bench.py fused_ab
 
 if [ "$QUICK" != "--quick" ]; then
   # North-star outcomes: wall-clock to target (VERDICT #1 / BASELINE.md).

@@ -91,6 +91,19 @@ python -m asyncrl_tpu.analysis \
     --cache-dir .analysis-cache-fleet \
     asyncrl_tpu/serve/fleet.py || rc=1
 
+# The device hot path's kernels carry the PR-17 contracts: Pallas DMA
+# start/wait discipline in the fused scan and RDMA ring, SPMD sharding
+# hygiene in the ring's collectives, and the devq-lease typestate in the
+# HBM rollout queue. The package run covers them today; this explicit
+# gate (the serve/fleet.py pattern) makes that non-optional — a future
+# baseline or file-set edit to the package run can never silently
+# un-gate the kernels. Own cache dir, same manifest-keying reason.
+python -m asyncrl_tpu.analysis \
+    --pass pallas --pass sharding --pass protocols \
+    --cache-dir .analysis-cache-kernels \
+    asyncrl_tpu/ops/pallas_scan.py asyncrl_tpu/ops/ring_reduce.py \
+    asyncrl_tpu/rollout/device_queue.py || rc=1
+
 if [ "$fast" -eq 1 ] && [ "$rc" -eq 0 ] && python - <<'EOF'
 import json
 import sys

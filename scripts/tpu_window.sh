@@ -381,7 +381,15 @@ sys.exit(0 if ok else 1)" 2>/dev/null
   run_job pixel_bench 420 python bench.py atari_impala updates_per_call=8 num_envs=256 || continue
   run_job roofline_pong 420 python scripts/roofline.py pong_impala updates_per_call=32 || continue
   run_job roofline_atari 480 python scripts/roofline.py atari_impala updates_per_call=8 num_envs=256 || continue
-  run_job pallas_validate 420 python scripts/validate_pallas_tpu.py || continue
+  run_job pallas_validate 420 python scripts/validate_pallas_tpu.py scan || continue
+  # Device hot path (this round's kernels): fused V-trace tail + RDMA
+  # ring bit-identity gates on real silicon, then the fused on/off
+  # throughput A/B on the flagship geometry. Separate stamps from the
+  # scan gate so a ring-fabric failure retries without re-proving the
+  # settled reverse-scan result.
+  run_job kernels_fused_ring 600 python scripts/validate_pallas_tpu.py fused ring || continue
+  run_job fused_ab 1200 python bench.py fused_ab || continue
+  commit_ledger
   # The reference's FULL 1024-envs/chip pixel geometry (BASELINE.json:9).
   run_job pixel_bench_1024 480 python bench.py atari_impala updates_per_call=8 grad_accum=4 remat=true || continue
   # Vector-flagship env scaling: the 27.3M headline keeps the parity
@@ -407,7 +415,8 @@ sys.exit(0 if ok else 1)" 2>/dev/null
      && settled "bench_w$WINDOW" \
      && settled eval_caps_tpu && settled pixel_bench \
      && settled roofline_pong && settled roofline_atari \
-     && settled pallas_validate && settled pixel_bench_1024 \
+     && settled pallas_validate && settled kernels_fused_ring \
+     && settled fused_ab && settled pixel_bench_1024 \
      && settled vec_envs1024 && settled vec_envs4096 \
      && settled pixel_wide \
      && settled bench_matrix && settled selfplay_exp \

@@ -1,22 +1,35 @@
-"""Real-chip validation + microbench for the Pallas reverse-scan kernel.
+"""Real-chip validation + microbench for the device hot path's Pallas
+kernels.
 
 ``Config.scan_impl='auto'`` resolves to ``associative`` everywhere because
 the Pallas VMEM kernel had never run on actual TPU hardware (utils/config.py
 scan_impl note). This script is the validation gate: on a live chip it
-judges the ``reverse_linear_scan_pallas`` kernel, its explicit-DMA twin
-(``pallas_dma`` — the ROADMAP item-2 beachhead whose start/wait discipline
-the PAL static pass guards), and the ``lax.associative_scan`` reference
-against a float64 sequential truth across the fragment geometries the
-presets use (scale-aware RMS-relative error — a per-element relative
-metric falsely flags rounding tails at large T*B; see the inline comment),
-times all three, and appends a ``kind="kernel_validation"`` entry to
-BENCH_HISTORY.json.
+judges each kernel set against its contract and appends one
+``kind="kernel_validation"`` entry per set to BENCH_HISTORY.json:
 
-    python scripts/validate_pallas_tpu.py
+- ``scan`` — ``reverse_linear_scan_pallas`` + its explicit-DMA twin
+  (``pallas_dma`` — the ROADMAP item-2 beachhead whose start/wait
+  discipline the PAL static pass guards) vs the ``lax.associative_scan``
+  reference, judged against a float64 sequential truth (scale-aware
+  RMS-relative error — a per-element relative metric falsely flags
+  rounding tails at large T*B; see the inline comment).
+- ``fused`` — the fused V-trace/GAE tail kernel (``ops/pallas_scan.py``)
+  vs the sequential lax reference: the contract is BIT-identity (all
+  four V-trace outputs and both GAE outputs, ``np.array_equal``), the
+  same claim tests/test_differential.py pins through the interpreter,
+  here on real silicon where the Mosaic compiler (not the interpreter)
+  decides FMA contraction.
+- ``ring`` — the RDMA ring all-reduce (``ops/ring_reduce.py``) under a
+  ``check_vma=False`` shard_map: bit-identity vs the lax twin (same
+  schedule, same operand order), the (n-1)-step ULP envelope vs
+  ``psum`` (bit-identity at n=2), replication across devices. Skipped
+  (ok) on a single-device chip — there is no ring to run.
 
-Exit 0 = every geometry matched (the kernel is no less accurate than the
-associative reference — safe to promote); exit 1 = mismatch (keep the
-associative default, entry records which geometry).
+    python scripts/validate_pallas_tpu.py [scan] [fused] [ring]
+
+No argv = all sets. Exit 0 = every selected set matched (safe to
+promote); exit 1 = mismatch (keep the lax defaults; the ledger entry
+records which geometry); exit 2 = no accelerator / bad argv.
 """
 
 from __future__ import annotations
@@ -51,13 +64,7 @@ def timed(fn, *args, reps=20):
     return (time.perf_counter() - t0) / reps
 
 
-def main() -> int:
-    dev = jax.devices()[0]
-    if dev.platform == "cpu":
-        print("validate_pallas_tpu: no accelerator; refusing (the whole "
-              "point is real-chip behaviour)", file=sys.stderr)
-        return 2
-
+def validate_scan() -> bool:
     rng = np.random.default_rng(0)
     results = []
     ok = True
@@ -146,7 +153,214 @@ def main() -> int:
         "geometries": results,
     }
     bench_history.record(entry)
-    print(json.dumps({"ok": ok, "n": len(results)}))
+    print(json.dumps({"kernel": "scan", "ok": ok, "n": len(results)}))
+    return ok
+
+
+def validate_fused() -> bool:
+    """Fused V-trace/GAE vs the sequential lax reference: bit-identity,
+    on the real Mosaic-compiled kernel."""
+    from asyncrl_tpu.ops.gae import gae
+    from asyncrl_tpu.ops.vtrace import vtrace
+
+    rng = np.random.default_rng(1)
+    results = []
+    ok = True
+    for T, B in GEOMETRIES:
+        f = lambda *s: jnp.asarray(rng.standard_normal(s).astype(np.float32))
+        kw = dict(
+            behaviour_logp=f(T, B), target_logp=f(T, B), rewards=f(T, B),
+            discounts=jnp.asarray(
+                (0.99 * (rng.random((T, B)) > 0.1)).astype(np.float32)
+            ),
+            values=f(T, B), bootstrap_value=f(B),
+        )
+        vt_ref = jax.jit(
+            functools.partial(vtrace, scan_impl="sequential", fused="lax")
+        )
+        vt_pal = jax.jit(functools.partial(vtrace, fused="pallas"))
+        entry = {"T": T, "B": B}
+        try:
+            ref = jax.device_get(vt_ref(**kw))
+            out = jax.device_get(vt_pal(**kw))
+        except Exception as e:  # noqa: BLE001 — record, don't crash
+            entry["error"] = str(e)[:300]
+            entry["match"] = False
+            ok = False
+            results.append(entry)
+            print(json.dumps(entry))
+            continue
+        match = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(ref, out)
+        )
+        mismatched = [
+            name for name, a, b in zip(ref._fields, ref, out)
+            if not np.array_equal(np.asarray(a), np.asarray(b))
+        ]
+        g_ref = jax.device_get(gae(
+            kw["rewards"], kw["discounts"], kw["values"],
+            kw["bootstrap_value"], gae_lambda=0.95,
+            scan_impl="sequential", fused="lax",
+        ))
+        g_out = jax.device_get(gae(
+            kw["rewards"], kw["discounts"], kw["values"],
+            kw["bootstrap_value"], gae_lambda=0.95, fused="pallas",
+        ))
+        if not all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(g_ref, g_out)
+        ):
+            match = False
+            mismatched.append("gae")
+        t_ref = timed(lambda: vt_ref(**kw))
+        t_pal = timed(lambda: vt_pal(**kw))
+        entry.update({
+            "match": match,
+            "lax_us": round(t_ref * 1e6, 1),
+            "pallas_us": round(t_pal * 1e6, 1),
+            "speedup": round(t_ref / max(t_pal, 1e-9), 2),
+        })
+        if mismatched:
+            entry["mismatched"] = mismatched
+        ok = ok and match
+        results.append(entry)
+        print(json.dumps(entry))
+
+    bench_history.record({
+        "kind": "kernel_validation",
+        "kernel": "fused_vtrace_pallas",
+        **bench_history.device_entry(),
+        "ok": ok,
+        "geometries": results,
+    })
+    print(json.dumps({"kernel": "fused", "ok": ok, "n": len(results)}))
+    return ok
+
+
+def validate_ring() -> bool:
+    """RDMA ring vs lax twin (bit-identity) and psum (ULP envelope), on
+    the real ICI fabric."""
+    from asyncrl_tpu.ops import ring_reduce
+    from asyncrl_tpu.parallel.mesh import make_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        print(json.dumps({
+            "kernel": "ring", "ok": True, "skipped": f"{n} device(s)"
+        }))
+        return True
+    mesh = make_mesh((n,), ("dp",), devices=devices)
+
+    def all_reduce(fn, vals, checked):
+        def body(x):
+            return fn(x[0])[None]
+
+        # The pallas_call has no replication rule on jax 0.4.x, so the
+        # kernel (and, for schedule-timing parity, its lax twin) runs
+        # under the check_vma=False wrapper; psum keeps the checked path.
+        kw = {} if checked else {"check_vma": False}
+        return np.asarray(jax.jit(shard_map(
+            body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"), **kw
+        ))(vals))
+
+    rng = np.random.default_rng(2)
+    results = []
+    ok = True
+    # Ragged small, lane-aligned mid, and the largest payload the
+    # kernel's VMEM scratch budget admits at this ring size (the
+    # gradient-tree regime: ops/ring_reduce.py _MAX_SUBLANES).
+    for d in (
+        1031,
+        2 * n * 64 * 128,
+        2 * n * ring_reduce._MAX_SUBLANES * 128,
+    ):
+        vals = rng.standard_normal((n, d)).astype(np.float32)
+        entry = {"n": n, "d": d}
+        try:
+            pal = all_reduce(
+                lambda x: ring_reduce.ring_all_reduce_pallas(
+                    x, "dp", axis_size=n
+                ),
+                vals, checked=False,
+            )
+            lax_twin = all_reduce(
+                lambda x: ring_reduce.ring_all_reduce_lax(
+                    x, "dp", axis_size=n
+                ),
+                vals, checked=False,
+            )
+            psum = all_reduce(lambda x: jax.lax.psum(x, "dp"), vals, True)
+        except Exception as e:  # noqa: BLE001 — record, don't crash
+            entry["error"] = str(e)[:300]
+            entry["match"] = False
+            ok = False
+            results.append(entry)
+            print(json.dumps(entry))
+            continue
+        # Twin contract: same schedule, same operand order -> same bits.
+        twin_ok = bool(np.array_equal(pal, lax_twin))
+        # Replication: every device ends with the same bits.
+        rep_ok = all(np.array_equal(pal[0], row) for row in pal[1:])
+        # psum envelope: condition-relative (n-1)-step float-fold bound
+        # (tests/test_ring_reduce.py rationale); bit-identical at n=2.
+        if n == 2:
+            psum_ok = bool(np.array_equal(pal, psum))
+            psum_err = 0.0 if psum_ok else float(
+                np.max(np.abs(pal - psum))
+            )
+        else:
+            cond = np.sum(np.abs(vals), axis=0)
+            psum_err = float(np.max(np.abs(pal - psum)[0] / cond))
+            psum_ok = psum_err < (n - 1) * np.finfo(np.float32).eps
+        match = twin_ok and rep_ok and psum_ok
+        entry.update({
+            "twin_bit_identical": twin_ok,
+            "replicated": rep_ok,
+            "psum_err": psum_err,
+            "match": match,
+        })
+        ok = ok and match
+        results.append(entry)
+        print(json.dumps(entry))
+
+    bench_history.record({
+        "kind": "kernel_validation",
+        "kernel": "ring_all_reduce_pallas",
+        **bench_history.device_entry(),
+        "ok": ok,
+        "geometries": results,
+    })
+    print(json.dumps({"kernel": "ring", "ok": ok, "n": len(results)}))
+    return ok
+
+
+KERNEL_SETS = {
+    "scan": validate_scan,
+    "fused": validate_fused,
+    "ring": validate_ring,
+}
+
+
+def main() -> int:
+    selected = sys.argv[1:] or list(KERNEL_SETS)
+    unknown = [k for k in selected if k not in KERNEL_SETS]
+    if unknown:
+        print(
+            f"validate_pallas_tpu: unknown kernel set(s) {unknown}; "
+            f"expected any of {list(KERNEL_SETS)}",
+            file=sys.stderr,
+        )
+        return 2
+    if jax.devices()[0].platform == "cpu":
+        print("validate_pallas_tpu: no accelerator; refusing (the whole "
+              "point is real-chip behaviour)", file=sys.stderr)
+        return 2
+    ok = True
+    for name in selected:
+        ok = KERNEL_SETS[name]() and ok
     return 0 if ok else 1
 
 

@@ -60,6 +60,26 @@ def test_explicit_preset_passes_overrides(monkeypatch, capsys):
     assert "pixel_flagship" not in out  # single-measurement mode
 
 
+def test_fused_ab_mode_routes_with_overrides(monkeypatch, capsys):
+    """`bench.py fused_ab [k=v ...]` routes to the device-hot-path A/B
+    probe (never to measure_preset — there is no preset by that name)."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "cpu_fallback_or_refuse", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench,
+        "measure_fused_ab",
+        lambda ov: calls.append(ov)
+        or {"metric": "fused_ab", "fused_speedup": 1.0, "unit": "frames/sec"},
+    )
+    monkeypatch.setattr("sys.argv", ["bench.py", "fused_ab", "num_envs=32"])
+    bench.main()
+    assert calls == [["num_envs=32"]]
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "fused_ab"
+
+
 def test_driver_mode_cpu_attaches_pixel_lkg(monkeypatch, capsys, tmp_path):
     """On the CPU fallback, driver mode must NOT burn minutes on a fresh
     pixel CNN run: the pixel rider carries the newest committed TPU row

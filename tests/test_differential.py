@@ -94,3 +94,182 @@ def test_backends_share_loss_machinery_on_identical_fragment():
     np.testing.assert_allclose(
         float(metrics["loss"]), float(loss_direct), rtol=1e-6
     )
+
+
+# ------------------------------------------------- fused scan kernel
+
+# Fused Pallas V-trace/GAE vs the lax reference (ops/pallas_scan.py):
+# the device hot path's bit-exactness contract, exercised through the
+# Pallas INTERPRETER so it gates on CPU CI. Both paths share the same
+# FMA-fenced prologue (mul_no_fma), so "bit-identical" is literal —
+# np.array_equal on the raw float bits, not allclose — across awkward
+# geometries (time/batch lengths that are not multiples of any block),
+# both input precisions, and the aux clip-fraction outputs.
+
+
+def _vtrace_inputs(T, B, dtype, seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    f = lambda *s: jnp.asarray(  # noqa: E731
+        rng.standard_normal(s).astype(np.float32), dtype=dtype
+    )
+    discounts = jnp.asarray(
+        (0.99 * (rng.random((T, B)) > 0.1)).astype(np.float32), dtype=dtype
+    )
+    return dict(
+        behaviour_logp=f(T, B),
+        target_logp=f(T, B),
+        rewards=f(T, B),
+        discounts=discounts,
+        values=f(T, B),
+        bootstrap_value=f(B),
+    )
+
+
+@pytest.mark.parametrize("T,B", [(1, 1), (3, 5), (17, 9), (20, 8), (33, 2)])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_fused_vtrace_bit_identical_to_lax(T, B, dtype):
+    import jax.numpy as jnp
+
+    from asyncrl_tpu.ops.vtrace import vtrace
+
+    kw = _vtrace_inputs(T, B, jnp.dtype(dtype), seed=T * 31 + B)
+    # The fused path computes in f32 regardless of input dtype (bf16 is
+    # upcast ONCE at entry — ops/pallas_scan.py), so the bit-identity
+    # reference is the lax path on the same f32-upcast inputs.
+    kw_f32 = {k: v.astype(jnp.float32) for k, v in kw.items()}
+    ref = vtrace(**kw_f32, rho_clip=1.0, c_clip=1.0,
+                 scan_impl="sequential", fused="lax")
+    fused = vtrace(**kw, rho_clip=1.0, c_clip=1.0, fused="interpret")
+    # Targets, advantages, AND the aux clip fractions: all four outputs
+    # bit-equal (the kernel computes none of the prologue/epilogue
+    # differently — clip fracs come from the same pre-kernel rhos).
+    for name, a, b in zip(ref._fields, ref, fused):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"{name} diverged at T={T} B={B} {dtype}"
+        )
+
+
+@pytest.mark.parametrize("T,B", [(2, 3), (19, 7), (20, 8)])
+def test_fused_gae_and_nstep_bit_identical_to_lax(T, B):
+    import jax.numpy as jnp
+
+    from asyncrl_tpu.ops.gae import gae, n_step_returns
+
+    rng = np.random.default_rng(T * 13 + B)
+    rewards = jnp.asarray(rng.standard_normal((T, B)).astype(np.float32))
+    discounts = jnp.asarray(
+        (0.99 * (rng.random((T, B)) > 0.1)).astype(np.float32)
+    )
+    values = jnp.asarray(rng.standard_normal((T, B)).astype(np.float32))
+    boot = jnp.asarray(rng.standard_normal((B,)).astype(np.float32))
+
+    ref = gae(rewards, discounts, values, boot, gae_lambda=0.95,
+              scan_impl="sequential", fused="lax")
+    fused = gae(rewards, discounts, values, boot, gae_lambda=0.95,
+                fused="interpret")
+    assert np.array_equal(np.asarray(ref.advantages),
+                          np.asarray(fused.advantages))
+    assert np.array_equal(np.asarray(ref.returns),
+                          np.asarray(fused.returns))
+
+    ref_r = n_step_returns(rewards, discounts, boot,
+                           scan_impl="sequential", fused="lax")
+    fused_r = n_step_returns(rewards, discounts, boot, fused="interpret")
+    assert np.array_equal(np.asarray(ref_r), np.asarray(fused_r))
+
+
+def test_fused_zero_length_trace_falls_back_to_lax():
+    """T=0 fragments (a degenerate-but-legal geometry: the guard routes
+    them to the lax path) return empty outputs instead of tripping a
+    zero-sized Pallas grid."""
+    import jax.numpy as jnp
+
+    from asyncrl_tpu.ops.vtrace import vtrace
+
+    kw = _vtrace_inputs(0, 4, jnp.float32)
+    out = vtrace(**kw, fused="interpret")
+    assert out.vs.shape == (0, 4) and out.pg_advantages.shape == (0, 4)
+
+
+def test_fused_losses_bit_identical_through_loss_layer():
+    """The loss layer threads fused_scan through to the ops: a3c and
+    impala losses are bit-identical between fused="interpret" and the
+    lax reference on the same fragment/params (the fused_ab bench
+    probe's assertion, as a unit test)."""
+    import jax
+    import jax.numpy as jnp
+
+    from asyncrl_tpu.envs import registry
+    from asyncrl_tpu.learn.learner import _algo_loss
+    from asyncrl_tpu.models.networks import build_model
+    from asyncrl_tpu.ops import distributions
+    from asyncrl_tpu.rollout.buffer import Rollout
+
+    T, B = 20, 8
+    rng = np.random.default_rng(11)
+    rollout = jax.tree.map(
+        jnp.asarray,
+        Rollout(
+            obs=rng.normal(size=(T, B, 4)).astype(np.float32),
+            actions=rng.integers(0, 2, (T, B)).astype(np.int32),
+            behaviour_logp=np.full((T, B), -0.69, np.float32),
+            rewards=rng.normal(size=(T, B)).astype(np.float32),
+            terminated=rng.random((T, B)) < 0.05,
+            truncated=np.zeros((T, B), bool),
+            bootstrap_obs=rng.normal(size=(B, 4)).astype(np.float32),
+        ),
+    )
+    for algo in ("a3c", "impala"):
+        cfg = matched_cfg("tpu").replace(
+            algo=algo, scan_impl="sequential", fused_scan="lax"
+        )
+        env = registry.make(cfg.env_id)
+        model = build_model(cfg, env.spec)
+        dummy_obs = jnp.zeros((1, *env.spec.obs_shape), env.spec.obs_dtype)
+        params = model.init(jax.random.PRNGKey(0), dummy_obs)
+        dist = distributions.for_spec(env.spec)
+        ref, _ = _algo_loss(
+            cfg, model.apply, params, rollout, axis_name=None, dist=dist
+        )
+        fused, _ = _algo_loss(
+            cfg.replace(fused_scan="interpret"), model.apply, params,
+            rollout, axis_name=None, dist=dist,
+        )
+        assert np.array_equal(np.asarray(ref), np.asarray(fused)), algo
+
+
+def test_fused_learner_trains_and_matches_lax_sequential():
+    """The full Anakin learner with a fused kernel in the loss tail: the
+    step must TRACE under shard_map (jax 0.4.x has no pallas_call
+    replication rule — fused configs opt out via fused_smap_opts) and
+    walk a bit-identical loss trajectory to the sequential lax path.
+
+    The reference arm pins smap_check="off" so both arms compile the
+    SAME (unchecked) shard_map wrapper: the replication checker's
+    identity collectives move XLA fusion boundaries, and with the
+    checked wrapper the lax arm's trajectory drifts a final ULP from
+    the fused arm's within a few updates on the 8-device test mesh —
+    wrapper compilation noise, not kernel numerics. With the wrapper
+    held fixed the only varying ingredient is the kernel, and the
+    trajectories must be bit-equal."""
+    from asyncrl_tpu.api.trainer import Trainer
+    from asyncrl_tpu.utils.config import Config
+
+    def losses(**kw):
+        cfg = Config(
+            env_id="CartPole-v1", algo="impala", num_envs=8, unroll_len=8,
+            precision="f32", log_every=1, **kw,
+        )
+        t = Trainer(cfg)
+        try:
+            hist = t.train(total_env_steps=3 * cfg.batch_steps_per_update)
+            return [float(h["loss"]) for h in hist]
+        finally:
+            t.close()
+
+    fused = losses(fused_scan="interpret")
+    ref = losses(fused_scan="lax", scan_impl="sequential", smap_check="off")
+    assert fused and np.all(np.isfinite(fused))
+    assert fused == ref

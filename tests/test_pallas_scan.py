@@ -253,3 +253,11 @@ def test_auto_resolution_is_concrete():
         Config(env_id="CartPole-v1", num_envs=8, unroll_len=4, precision="f32")
     )
     assert t.learner.config.scan_impl in ("associative", "pallas")
+    # fused_scan resolves alongside scan_impl: "auto" must be gone after
+    # Learner construction (pallas on TPU meshes, lax elsewhere).
+    assert t.learner.config.fused_scan in ("pallas", "interpret", "lax")
+    import jax
+
+    platform = jax.devices()[0].platform
+    expected = "pallas" if platform == "tpu" else "lax"
+    assert t.learner.config.fused_scan == expected
