@@ -1,6 +1,6 @@
 """Framework-aware static checker for the async pipeline.
 
-``python -m asyncrl_tpu.analysis [paths...]`` runs twelve passes over the
+``python -m asyncrl_tpu.analysis [paths...]`` runs fifteen passes over the
 package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
 :mod:`asyncrl_tpu.analysis.annotations` for the annotation grammar):
 
@@ -29,6 +29,16 @@ package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
 - ``pallas``      — Pallas kernel discipline: DMA start/wait typestate
   over the CFG, semaphore pairing, grid/BlockSpec statics, undeclared
   input aliasing (PAL*)
+- ``deadlines``   — wire-budget deadline flow: unbounded blocking on a
+  ``# budget:``-carrying path, budgets re-derived from fresh clocks
+  inside retry loops, unguarded wire-boundary deadline reads (DLN*)
+- ``refund``      — multi-exit token typestate (``multi-exit=yes``
+  protocol specs): a charged rate token must reach a terminal state —
+  served or refunded — on EVERY exit path, exception edges included
+  (RFD*)
+- ``units``       — time-unit soundness: ms/s/ns inferred from name
+  suffixes and stdlib sinks; mixed-unit arithmetic, wrong-unit sink
+  flow, cross-unit comparisons (UNT*)
 
 Annotation-grammar errors and unloadable files (ANN*) are produced by
 every run and can be neither waived nor baselined. The analyzer core
@@ -65,6 +75,9 @@ PASSES = (
     "sharding",
     "hostsync",
     "pallas",
+    "deadlines",
+    "refund",
+    "units",
 )
 
 # Finding-code prefix -> owning pass (for per-pass stats; ANN* belongs to
@@ -83,6 +96,9 @@ CODE_FAMILIES = {
     "SHD": "sharding",
     "HSY": "hostsync",
     "PAL": "pallas",
+    "DLN": "deadlines",
+    "RFD": "refund",
+    "UNT": "units",
     "ANN": "annotations",
 }
 
@@ -91,6 +107,7 @@ def _impl():
     from asyncrl_tpu.analysis import (
         collectives,
         configflow,
+        deadlines,
         deadlock,
         donation,
         hostsync,
@@ -99,8 +116,10 @@ def _impl():
         pallas,
         protocols,
         purity,
+        refund,
         sharding,
         signals,
+        units,
     )
 
     return {
@@ -116,6 +135,9 @@ def _impl():
         "sharding": sharding.run,
         "hostsync": hostsync.run,
         "pallas": pallas.run,
+        "deadlines": deadlines.run,
+        "refund": refund.run,
+        "units": units.run,
     }
 
 

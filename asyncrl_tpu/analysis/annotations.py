@@ -42,8 +42,25 @@ enforce. The full grammar (also documented in docs/ARCHITECTURE.md):
     the post-mint state in every lease discipline) and only then to the
     first op rule's first from-state, so op-rule ordering alone can
     never silently un-arm leak detection. A declared name overrides a
-    same-named built-in spec. Malformed declarations are hard ANN013
-    errors.
+    same-named built-in spec. ``multi-exit=yes`` switches the spec to
+    the refund engine (:func:`protocols.run_multi_exit`, RFD codes): the
+    tracked token is the function activation's obligation (one per call,
+    not per assigned object), every non-terminal exit — normal OR
+    exception edge — is a leak, and mint/op tokens may carry ONE
+    receiver qualifier (``gate.admit``, ``bucket.refund``) so two
+    specs over different attributes of the same object never cross-match.
+    Dotted *op* tokens are only legal under ``multi-exit=yes``.
+    Malformed declarations are hard ANN013 errors.
+
+``# budget: <param>[, <param> ...]``
+    On a ``def`` line. Declares the named parameter(s) as wire-budget
+    carriers (a deadline or remaining-time value promised to a caller)
+    for the deadline-flow pass (:mod:`asyncrl_tpu.analysis.deadlines`).
+    Inside the function, every value derived from a declared parameter
+    is budget-tainted: blocking calls must bound their timeout by the
+    tainted remainder (DLN001) and no loop may re-derive the budget
+    anchor from a fresh clock read (DLN002). Names must be parameters
+    of the def they trail; anything else is a hard ANN014 error.
 
 ``# lint: <tag>(<reason>)``
     A waiver for one finding on the same line (or the line directly
@@ -69,7 +86,12 @@ enforce. The full grammar (also documented in docs/ARCHITECTURE.md):
     reason), ``hostsync-ok`` (a host-divergent collective/barrier whose
     congruence is argued elsewhere — say where), ``pallas-ok`` (a DMA/
     semaphore pairing or aliasing deviation the kernel discharges in a
-    way the pass cannot see). The reason is mandatory.
+    way the pass cannot see), ``deadline-ok`` (a sanctioned budget-flow
+    deviation — e.g. a deliberate one-shot grace extension re-derived
+    from the clock, with the boundedness argument in the reason),
+    ``units-ok`` (a deliberate cross-unit expression the unit pass
+    cannot see through — name the units and why the math is right).
+    The reason is mandatory.
 
 Malformed annotations and unknown waiver tags are **hard lint errors**
 (ANN0xx findings) — a misspelled annotation must never silently enforce
@@ -98,12 +120,18 @@ WAIVER_TAGS = (
     "sharding-ok",
     "hostsync-ok",
     "pallas-ok",
+    "deadline-ok",
+    "units-ok",
 )
 
 _PROTOCOL_RE = re.compile(r"^protocol:\s*([\w-]+)\s+(.+)$")
 _STATE_RE = re.compile(r"^[A-Za-z_][\w-]*$")
 _OP_RULE_RE = re.compile(
-    r"^([A-Za-z_]\w*):([\w-]+(?:\|[\w-]+)*)->([\w-]+)$"
+    r"^((?:[A-Za-z_]\w*\.)?[A-Za-z_]\w*)"
+    r":([\w-]+(?:\|[\w-]+)*)->([\w-]+)$"
+)
+_BUDGET_RE = re.compile(
+    r"^budget:\s*([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)\s*$"
 )
 _GUARDED_RE = re.compile(r"^guarded-by:\s*(\S+)\s*$")
 _LOCKSPEC_RE = re.compile(r"^[A-Za-z_]\w*(\.[A-Za-z_]\w*)*$")
@@ -163,6 +191,19 @@ class ProtocolDecl:
     initial: str | None            # explicit post-mint state, or None
     line: int
     raw: str
+    multi_exit: bool = False       # refund-engine spec (RFD codes)
+
+
+@dataclasses.dataclass(frozen=True)
+class Budget:
+    """One ``# budget:`` declaration: the named parameters of the def at
+    ``def_line`` carry a wire budget for the deadline-flow pass."""
+
+    names: tuple[str, ...]
+    class_name: str | None
+    fn_name: str
+    def_line: int
+    line: int
 
 
 @dataclasses.dataclass(frozen=True)
@@ -182,6 +223,7 @@ class ModuleAnnotations:
         self.holds: dict[tuple[str, str], str] = {}  # (class, method) -> lock
         self.entries: list[Entry] = []
         self.protocols: list[ProtocolDecl] = []
+        self.budgets: dict[int, Budget] = {}  # def lineno -> decl
         self.waivers: dict[int, Waiver] = {}
         self.errors: list[Finding] = []
 
@@ -254,7 +296,52 @@ def parse_module(module: SourceModule) -> ModuleAnnotations:
             _parse_entry(module, line, text, out)
         elif text.startswith("protocol:"):
             _parse_protocol(module, line, text, out)
+        elif text.startswith("budget:"):
+            _parse_budget(module, line, text, out)
     return out
+
+
+def _parse_budget(
+    module: SourceModule, line: int, text: str, out: ModuleAnnotations
+) -> None:
+    def err(detail: str) -> None:
+        out.errors.append(
+            Finding(
+                "ANN014", module.path, line,
+                f"malformed budget declaration {text!r}: {detail}; "
+                "expected '# budget: <param>[, <param>]' on a def line",
+            )
+        )
+
+    m = _BUDGET_RE.match(text)
+    if not m:
+        err("bad parameter list")
+        return
+    node = _def_at_line(module.tree, line)
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return err("budget declaration must trail a def line")
+    params = {
+        a.arg
+        for a in (
+            *node.args.posonlyargs, *node.args.args, *node.args.kwonlyargs,
+            *((node.args.vararg,) if node.args.vararg else ()),
+            *((node.args.kwarg,) if node.args.kwarg else ()),
+        )
+    }
+    names = tuple(n.strip() for n in m.group(1).split(","))
+    for name in names:
+        if name not in params:
+            return err(
+                f"{name!r} is not a parameter of {node.name}()"
+            )
+    cls = _enclosing_class(module.tree, node)
+    out.budgets[node.lineno] = Budget(
+        names=names,
+        class_name=cls.name if cls else None,
+        fn_name=node.name,
+        def_line=node.lineno,
+        line=line,
+    )
 
 
 def _parse_protocol(
@@ -280,7 +367,8 @@ def _parse_protocol(
     for token in rest.split():
         key, sep, value = token.partition("=")
         if not sep or key not in (
-            "mint", "attrs", "ops", "reads", "open", "terminal", "initial"
+            "mint", "attrs", "ops", "reads", "open", "terminal", "initial",
+            "multi-exit",
         ) or not value:
             err(f"bad field {token!r}")
             return
@@ -291,6 +379,11 @@ def _parse_protocol(
     if "mint" not in fields and "attrs" not in fields:
         err("a protocol needs a mint= or attrs= source")
         return
+    multi_exit_raw = fields.get("multi-exit", "no")
+    if multi_exit_raw not in ("yes", "no"):
+        err("multi-exit= takes yes or no")
+        return
+    multi_exit = multi_exit_raw == "yes"
     mint: list[str] = []
     mint_names: list[str] = []
     for item in fields.get("mint", "").split(","):
@@ -308,6 +401,12 @@ def _parse_protocol(
             err(f"bad op rule {rule!r} (want op:from[|from]->to)")
             return
         froms = tuple(rm.group(2).split("|"))
+        if "." in rm.group(1) and not multi_exit:
+            err(
+                f"receiver-qualified op {rm.group(1)!r} requires "
+                "multi-exit=yes"
+            )
+            return
         ops.append((rm.group(1), froms, rm.group(3)))
         states.update(froms)
         states.add(rm.group(3))
@@ -344,6 +443,7 @@ def _parse_protocol(
             initial=initial,
             line=line,
             raw=text,
+            multi_exit=multi_exit,
         )
     )
 

@@ -35,7 +35,7 @@ import os
 
 from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
 
-ANALYZER_VERSION = "4"
+ANALYZER_VERSION = "5"
 _MANIFEST = "manifest.json"
 
 # Code prefixes whose findings fold whole-project state: recomputed every
@@ -104,6 +104,11 @@ def _module_env(module: SourceModule) -> str:
         # findings depend on: a spec edit must invalidate every
         # per-file result, exactly like a code-shape change.
         "protocols": sorted(p.raw for p in ann.protocols),
+        # Budget declarations feed the deadline-flow pass the same way.
+        "budgets": sorted(
+            (b.class_name or "", b.fn_name, ",".join(b.names))
+            for b in ann.budgets.values()
+        ),
     }
     payload = ast.dump(tree, include_attributes=False) + json.dumps(
         decls, sort_keys=True

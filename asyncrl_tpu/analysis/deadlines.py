@@ -1,0 +1,482 @@
+"""Deadline-flow pass (DLN0xx).
+
+The serving tier promises a wire deadline (``X-Deadline-Ms``) and must
+spend it, not ignore it and not regrow it: admission waits, dispatch
+waits, and retry backoffs all have to be bounded by the REMAINING
+budget, and a value read off the wire has to be range-checked before it
+feeds arithmetic. Each rule below is a PR-15 review finding turned into
+a finding class.
+
+Budget sources in a function are (a) parameters declared with
+``# budget: <param>`` on the def line (grammar in
+:mod:`asyncrl_tpu.analysis.annotations`) and (b) wire-boundary reads —
+a ``.get("X-Deadline-Ms")``/``["deadline_ms"]`` whose string key names
+a deadline or budget. Taint is name-level and flow-insensitive per
+function (any assignment whose RHS mentions a tainted name taints its
+targets); DLN003 alone walks the statement CFG, because guardedness is
+a path property.
+
+- **DLN001** — a blocking call (the DEAD003 inventory: queue get/put,
+  ``Event``/``Condition`` wait, ``join``, ``time.sleep``, plus the
+  serving tier's ``admit``) on a budget-carrying path whose timeout is
+  missing, or present but derived from no tainted name — the admission
+  wait that outlives the deadline it was promised. ``open``/``input``
+  (no timeout concept) and executor ``submit`` (non-blocking hand-off)
+  are deliberately excluded.
+- **DLN002** — a budget that can GROW along a path: inside a loop, an
+  assignment whose RHS reads a fresh clock at positive sign rebinding a
+  name that contributes positively to the budget arithmetic (the
+  anchor). ``remaining = budget - k*(clock() - start)`` with ``start``
+  re-captured per retry resets elapsed to zero every iteration — the
+  PR-15 round-two bug; ``now = clock()`` per iteration is fine (it
+  contributes negatively) and stays silent.
+- **DLN003** — a wire-read value reaching arithmetic (any BinOp) or a
+  timeout operand with no ``isfinite``/``isnan`` guard on some CFG
+  path: the NaN deadline that wedged the serve thread. A guard anywhere
+  in an ``if`` test covers both branches (the reject arm returns; the
+  pass does not re-prove that).
+
+All three waive with ``# lint: deadline-ok(<reason>)`` — the one
+sanctioned site in-tree is the scheduler's one-shot dispatch-grace
+extension, whose boundedness argument lives in its reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from asyncrl_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceModule,
+    _header_exprs,
+    build_cfg,
+)
+from asyncrl_tpu.analysis.protocols import _functions
+
+_WAIVER = "deadline-ok"
+_WIRE_KEY_RE = re.compile(r"deadline|budget", re.IGNORECASE)
+_QUEUEY_RE = re.compile(r"queue|^q$|_q$", re.IGNORECASE)
+_CLOCK_NAMES = frozenset({
+    "monotonic", "monotonic_ns", "time", "time_ns",
+    "perf_counter", "perf_counter_ns", "clock", "_clock",
+})
+_TIMEOUT_KWS = (
+    "timeout", "timeout_s", "timeout_ms",
+    "deadline_s", "deadline_ms", "budget_s", "budget_ms",
+)
+# method name -> positional slot of its timeout operand (after self).
+_BLOCKING_SLOTS = {
+    "wait": 0, "wait_for": 1, "join": 0,
+    "get": 1, "put": 2, "admit": 1, "sleep": 0,
+}
+
+
+def _walk_fn(root: ast.AST):
+    """Walk ``root``'s own frame: nested defs/lambdas are their own
+    analysis roots and their bodies must not leak taint into this one."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _names(node: ast.AST) -> set[str]:
+    return {
+        sub.id for sub in ast.walk(node) if isinstance(sub, ast.Name)
+    }
+
+
+def _is_wire_read(node: ast.AST) -> bool:
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "get"
+        and node.args
+    ):
+        key = node.args[0]
+        return (
+            isinstance(key, ast.Constant)
+            and isinstance(key.value, str)
+            and bool(_WIRE_KEY_RE.search(key.value))
+        )
+    if isinstance(node, ast.Subscript):
+        key = node.slice
+        return (
+            isinstance(key, ast.Constant)
+            and isinstance(key.value, str)
+            and bool(_WIRE_KEY_RE.search(key.value))
+        )
+    return False
+
+
+def _contains_wire_read(expr: ast.AST) -> bool:
+    return any(_is_wire_read(sub) for sub in ast.walk(expr))
+
+
+def _is_clock_call(call: ast.Call) -> bool:
+    func = call.func
+    name = None
+    if isinstance(func, ast.Attribute):
+        name = func.attr
+    elif isinstance(func, ast.Name):
+        name = func.id
+    return name in _CLOCK_NAMES
+
+
+def _clock_positive(node: ast.AST, sign: int = 1) -> bool:
+    """True when a fresh clock read contributes at POSITIVE sign to this
+    expression's value — the shape of an anchor extension
+    (``clock() + grace``), not of an elapsed measurement
+    (``budget - k*(clock() - start)``)."""
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Add):
+            return (_clock_positive(node.left, sign)
+                    or _clock_positive(node.right, sign))
+        if isinstance(node.op, ast.Sub):
+            return (_clock_positive(node.left, sign)
+                    or _clock_positive(node.right, -sign))
+        if isinstance(node.op, (ast.Mult, ast.Div, ast.FloorDiv)):
+            return (_clock_positive(node.left, sign)
+                    or _clock_positive(node.right, sign))
+        return False
+    if isinstance(node, ast.UnaryOp):
+        flip = -sign if isinstance(node.op, ast.USub) else sign
+        return _clock_positive(node.operand, flip)
+    if isinstance(node, ast.IfExp):
+        return (_clock_positive(node.body, sign)
+                or _clock_positive(node.orelse, sign))
+    if isinstance(node, ast.Call):
+        if _is_clock_call(node):
+            return sign > 0
+        func = node.func
+        if isinstance(func, ast.Name) and func.id in ("min", "max"):
+            return any(_clock_positive(a, sign) for a in node.args)
+        return False
+    return False
+
+
+def _name_signs(node: ast.AST, sign: int = 1, out: dict | None = None):
+    """name -> set of signs at which it appears in ``node`` (the same
+    walk as :func:`_clock_positive`, for the anchor-contribution test)."""
+    if out is None:
+        out = {}
+    if isinstance(node, ast.Name):
+        out.setdefault(node.id, set()).add(sign)
+    elif isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Sub):
+            _name_signs(node.left, sign, out)
+            _name_signs(node.right, -sign, out)
+        elif isinstance(node.op, (ast.Add, ast.Mult, ast.Div,
+                                  ast.FloorDiv)):
+            _name_signs(node.left, sign, out)
+            _name_signs(node.right, sign, out)
+        else:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    out.setdefault(sub.id, set()).add(sign)
+    elif isinstance(node, ast.UnaryOp):
+        flip = -sign if isinstance(node.op, ast.USub) else sign
+        _name_signs(node.operand, flip, out)
+    elif isinstance(node, ast.IfExp):
+        _name_signs(node.body, sign, out)
+        _name_signs(node.orelse, sign, out)
+    elif isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Name) and func.id in ("min", "max"):
+            for a in node.args:
+                _name_signs(a, sign, out)
+    else:
+        for child in ast.iter_child_nodes(node):
+            _name_signs(child, sign, out)
+    return out
+
+
+def _assignments(fn: ast.AST):
+    """(targets, value, node) for every binding form in ``fn``'s frame."""
+    for sub in _walk_fn(fn):
+        if isinstance(sub, ast.Assign):
+            yield sub.targets, sub.value, sub
+        elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+            yield [sub.target], sub.value, sub
+        elif isinstance(sub, ast.AugAssign):
+            yield [sub.target], sub.value, sub
+        elif isinstance(sub, ast.NamedExpr):
+            yield [sub.target], sub.value, sub
+
+
+def _target_names(targets: list[ast.AST]) -> set[str]:
+    out: set[str] = set()
+    for t in targets:
+        for elt in ast.walk(t):
+            if isinstance(elt, ast.Name):
+                out.add(elt.id)
+    return out
+
+
+def _taint(fn: ast.AST, seeds: set[str]) -> set[str]:
+    tainted = set(seeds)
+    rows = [
+        (_target_names(targets), value)
+        for targets, value, _node in _assignments(fn)
+    ]
+    changed = True
+    while changed:
+        changed = False
+        for targets, value in rows:
+            if targets <= tainted:
+                continue
+            if (_names(value) & tainted) or _contains_wire_read(value):
+                tainted |= targets
+                changed = True
+    return tainted
+
+
+def _recv_name(func: ast.Attribute) -> str:
+    recv = func.value
+    if isinstance(recv, ast.Name):
+        return recv.id
+    if isinstance(recv, ast.Attribute):
+        return recv.attr
+    return ""
+
+
+def _blocking_call(call: ast.Call) -> tuple[str, ast.AST | None] | None:
+    """(description, timeout_operand | None) when ``call`` is in the
+    blocking inventory; None when it is not (or is provably
+    non-blocking: ``block=False``, ``*_nowait``)."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    meth = func.attr
+    recv = _recv_name(func)
+    if meth == "sleep":
+        if not (isinstance(func.value, ast.Name)
+                and func.value.id == "time"):
+            return None
+    elif meth in ("get", "put"):
+        if not _QUEUEY_RE.search(recv):
+            return None
+    elif meth not in ("wait", "wait_for", "join", "admit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("block", "blocking") and (
+            isinstance(kw.value, ast.Constant) and kw.value.value is False
+        ):
+            return None
+        if kw.arg in _TIMEOUT_KWS:
+            return f"{recv}.{meth}" if recv else meth, kw.value
+    slot = _BLOCKING_SLOTS[meth]
+    operand = call.args[slot] if slot < len(call.args) else None
+    return (f"{recv}.{meth}" if recv else meth), operand
+
+
+class _FunctionPass:
+    def __init__(
+        self,
+        module: SourceModule,
+        fn: ast.AST,
+        findings: list[Finding],
+    ):
+        self.module = module
+        self.fn = fn
+        self.findings = findings
+        self.fn_name = getattr(fn, "name", "<lambda>")
+        ann = module.annotations
+        budget = ann.budgets.get(getattr(fn, "lineno", -1))
+        self.declared = set(budget.names) if budget else set()
+        self.wire = any(
+            _contains_wire_read(value)
+            for _t, value, _n in _assignments(fn)
+        )
+        self.tainted = (
+            _taint(fn, self.declared)
+            if (self.declared or self.wire)
+            else set()
+        )
+
+    def _report(self, code: str, line: int, message: str) -> None:
+        if self.module.annotations.waived(line, _WAIVER):
+            return
+        self.findings.append(Finding(code, self.module.path, line, message))
+
+    # ---------------------------------------------------------- DLN001
+
+    def check_blocking(self) -> None:
+        if not self.tainted:
+            return
+        for sub in _walk_fn(self.fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            hit = _blocking_call(sub)
+            if hit is None:
+                continue
+            what, operand = hit
+            if operand is None:
+                self._report(
+                    "DLN001", sub.lineno,
+                    f"blocking {what}() without a timeout on a "
+                    f"budget-carrying path ({self.fn_name} handles "
+                    f"{sorted(self.tainted & (self.declared or self.tainted))[:3]}): "
+                    "an unbounded wait can outlive the promised deadline "
+                    "— bound it by the remaining budget",
+                )
+            elif not (_names(operand) & self.tainted):
+                self._report(
+                    "DLN001", sub.lineno,
+                    f"blocking {what}() timeout is not derived from the "
+                    "remaining budget: a fixed bound can exceed what is "
+                    "left of the promised deadline — compute it from the "
+                    "surviving remainder",
+                )
+
+    # ---------------------------------------------------------- DLN002
+
+    def check_regrow(self) -> None:
+        if not self.tainted:
+            return
+        anchor_pos: set[str] = set()
+        for targets, value, _node in _assignments(self.fn):
+            if _target_names(targets) & self.tainted:
+                for name, signs in _name_signs(value).items():
+                    if 1 in signs:
+                        anchor_pos.add(name)
+        candidates = self.tainted | anchor_pos
+        for loop in _walk_fn(self.fn):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for targets, value, node in _assignments(loop):
+                if isinstance(node, ast.AugAssign):
+                    continue
+                if not _clock_positive(value):
+                    continue
+                hit = _target_names(targets) & candidates
+                if hit:
+                    self._report(
+                        "DLN002", node.lineno,
+                        f"budget anchor {sorted(hit)[0]!r} is re-derived "
+                        "from a fresh clock read inside a loop: the "
+                        "remaining budget grows every iteration instead "
+                        "of shrinking — capture the anchor once before "
+                        "the loop",
+                    )
+
+    # ---------------------------------------------------------- DLN003
+
+    def check_wire_guards(self) -> None:
+        if not self.wire:
+            return
+        flow = build_cfg(self.fn)
+        reported: set[str] = set()
+
+        def transfer(stmt, unguarded: frozenset) -> frozenset:
+            if stmt is None:
+                return unguarded
+            exprs = _header_exprs(stmt)
+            # Uses first (RHS evaluates before the target binds).
+            for expr in exprs:
+                for sub in ast.walk(expr):
+                    used: set[str] = set()
+                    if isinstance(sub, ast.BinOp):
+                        used = _names(sub) & unguarded
+                    elif isinstance(sub, ast.Call):
+                        for kw in sub.keywords:
+                            if kw.arg in _TIMEOUT_KWS:
+                                used |= _names(kw.value) & unguarded
+                    for name in sorted(used - reported):
+                        reported.add(name)
+                        self._report(
+                            "DLN003", stmt.lineno,
+                            f"wire-boundary value {name!r} reaches "
+                            "arithmetic/a timeout with no isfinite/range "
+                            "guard on some path: a NaN or absurd deadline "
+                            "off the wire wedges the serve path — guard "
+                            "it at the boundary",
+                        )
+                    unguarded -= used & reported
+            # Guards: an if-test running isfinite/isnan over the name.
+            if isinstance(stmt, (ast.If, ast.While)):
+                for sub in ast.walk(stmt.test):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(
+                            sub.func, (ast.Attribute, ast.Name)
+                        )
+                        and (
+                            sub.func.attr
+                            if isinstance(sub.func, ast.Attribute)
+                            else sub.func.id
+                        ) in ("isfinite", "isnan")
+                    ):
+                        unguarded -= frozenset(_names(sub))
+            # Gen/kill on bindings.
+            for targets, value, _node in _assignments_of_stmt(stmt):
+                dirty = (
+                    _contains_wire_read(value)
+                    or bool(_names(value) & unguarded)
+                )
+                names = _target_names(targets)
+                if dirty:
+                    unguarded |= frozenset(names - reported)
+                else:
+                    unguarded -= frozenset(names)
+            return unguarded
+
+        states: dict[int, frozenset] = {flow.entry: frozenset()}
+        work = [flow.entry]
+        visits = 0
+        limit = 50 * (len(flow.stmts) + 1)
+        while work and visits < limit:
+            visits += 1
+            n = work.pop()
+            state = states.get(n)
+            if state is None:
+                continue
+            out = transfer(flow.stmts[n], state)
+            for target, _kind, _narrow in flow.succ[n]:
+                # Absence from the dict — not emptiness — means
+                # unvisited: the clean (empty) state still has to push
+                # its successors once, or nothing past the entry node is
+                # ever analyzed.
+                seen = states.get(target)
+                merged = out if seen is None else seen | out
+                if seen is None or merged != seen:
+                    states[target] = merged
+                    work.append(target)
+
+
+def _assignments_of_stmt(stmt: ast.stmt):
+    if isinstance(stmt, ast.Assign):
+        yield stmt.targets, stmt.value, stmt
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        yield [stmt.target], stmt.value, stmt
+    elif isinstance(stmt, ast.AugAssign):
+        yield [stmt.target], stmt.value, stmt
+    for expr in _header_exprs(stmt):
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.NamedExpr):
+                yield [sub.target], sub.value, sub
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """DLN findings attach to the file containing the flagged statement
+    and derive from that file's own source + its ``# budget:``
+    declarations, so they are per-file cacheable; the declarations ride
+    the cache's env hash (see analysis/cache.py)."""
+    findings: list[Finding] = []
+    for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
+        for _cls_name, fn in _functions(module):
+            fp = _FunctionPass(module, fn, findings)
+            fp.check_blocking()
+            fp.check_regrow()
+            fp.check_wire_guards()
+    return sorted(findings, key=lambda f: (f.path, f.line, f.code))

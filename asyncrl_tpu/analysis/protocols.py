@@ -74,6 +74,7 @@ from asyncrl_tpu.analysis.core import (
     Finding,
     Project,
     SourceModule,
+    _header_exprs,
     build_cfg,
 )
 
@@ -118,6 +119,11 @@ class ProtocolSpec:
     # False for objects living in traced kernel code, where a Python
     # exception aborts tracing and no runtime path exists to hang.
     exc_leaks: bool = True
+    # ``multi-exit=yes`` specs run under the refund engine
+    # (:func:`run_multi_exit`, RFD codes) instead of this one: the token
+    # is the function activation's obligation, not an assigned object,
+    # and mint/op tokens may carry a receiver qualifier (``gate.admit``).
+    multi_exit: bool = False
 
     def facade_names(self) -> frozenset[str]:
         """Function names sanctioned to RETURN a tracked object (the
@@ -192,6 +198,25 @@ def _spec_from_decl(decl) -> ProtocolSpec:
         initial = decl.open_states[0]
     else:
         initial = decl.ops[0][1][0] if decl.ops else "held"
+    if decl.multi_exit:
+        # Refund-engine spec: RFD codes, and the lease-engine escape/mix
+        # machinery is meaningless for an activation-scoped obligation.
+        return ProtocolSpec(
+            name=decl.name,
+            mint=frozenset(decl.mint),
+            mint_names=frozenset(decl.mint_names),
+            mint_attrs=frozenset(decl.mint_attrs),
+            initial=initial,
+            ops=ops,
+            reads={},
+            open_states=frozenset(decl.open_states),
+            terminal=frozenset(decl.terminal),
+            code_op="RFD001",
+            code_leak="RFD002",
+            flag_escapes=False,
+            check_mix=False,
+            multi_exit=True,
+        )
     return ProtocolSpec(
         name=decl.name,
         mint=frozenset(decl.mint),
@@ -1135,8 +1160,17 @@ def run(
     containing the flagged statement and are re-derived per file; the
     cross-file context (specs, wrappers, param-op summaries) is rebuilt
     from the whole project on every non-warm run, and any cross-file
-    code or declaration change invalidates the env hash."""
-    specs = collect_specs(project)
+    code or declaration change invalidates the env hash.
+
+    ``multi-exit=yes`` specs are excluded: they run under the refund
+    engine (:func:`run_multi_exit`, registered as the ``refund`` pass),
+    and letting their op names seed this engine's param-op summaries
+    would mint phantom lease obligations."""
+    specs = {
+        name: spec
+        for name, spec in collect_specs(project).items()
+        if not spec.multi_exit
+    }
     index = _SpecIndex(specs)
     resolvers = _ResolverCache(project)
     contexts = [
@@ -1154,4 +1188,242 @@ def run(
             module, fn, index, wrappers, param_ops, findings,
             resolvers.get(module, cls_name, fn),
         ).analyze()
+    return findings
+
+
+# ---------------------------------------------------- multi-exit (refund)
+
+# The refund engine's handed-off pseudo-state: a call into a function
+# that provably resolves the token (``return self._degrade(...)``) is
+# terminal-equivalent for the caller.
+_HANDED = "handed-off"
+
+
+def _me_call_name(call: ast.Call) -> tuple[str | None, str] | None:
+    """(receiver-name-or-None, method) for an attribute call. The
+    receiver name is the RIGHTMOST component (``self.tenant.gate`` ->
+    ``gate``) so a one-level qualifier in the spec matches however deep
+    the access chain is."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = func.value
+    if isinstance(recv, ast.Name):
+        return recv.id, func.attr
+    if isinstance(recv, ast.Attribute):
+        return recv.attr, func.attr
+    return None, func.attr
+
+
+def _me_matches(call: ast.Call, token: str) -> bool:
+    """``gate.admit`` matches ``<...>.gate.admit(...)``; a bare
+    ``admit`` matches any receiver."""
+    named = _me_call_name(call)
+    if named is None:
+        return False
+    recv, meth = named
+    want_recv, _, want_meth = token.rpartition(".")
+    if meth != want_meth:
+        return False
+    return not want_recv or recv == want_recv
+
+
+def _me_direct_resolves(fn: ast.AST, spec: ProtocolSpec) -> bool:
+    """True when ``fn``'s own body applies a terminal-reaching op of
+    ``spec`` — calls to it discharge the caller's obligation (the
+    gateway's ``return self._degrade(...)`` hand-off). Direct only: the
+    one-level summary matches how the hand-off is actually written, and
+    a transitive fixpoint would let a long helper chain hide a missing
+    refund from both the caller AND the deletion proof."""
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            for op, (_froms, to) in spec.ops.items():
+                if to in spec.terminal and _me_matches(sub, op):
+                    return True
+    return False
+
+
+class _MultiExitAnalyzer:
+    """Refund typestate over one function, one spec: one abstract token
+    per activation (the request's rate-token charge), states joined as
+    sets across paths. Differences from the lease engine, deliberately:
+
+    - The token has no name — ANY matching op call transitions it, and
+      an op observed while untracked ACTIVATES tracking at the op's
+      to-state (``_degrade`` never charges, yet its ``abandoned()``
+      commits it to refunding).
+    - Every call's exception edge carries the PRE-call state: the refund
+      discipline is precisely about exceptions BETWEEN charge and
+      resolution, so the engine must not model an op as resolved on the
+      edge where it failed (the lease engine's opposite convention
+      exists to spare try/except around every final ``release()``).
+    - Exit rules: an open state reaching NORMAL exit on any path is
+      RFD002; the raise exit reports only when open states arrive and no
+      terminal/handed state does (must-leak — with pre-call exception
+      states, a function whose every path resolves the token always
+      parks one resolved state at the raise exit, and one that never
+      resolves it cannot)."""
+
+    def __init__(
+        self,
+        module: SourceModule,
+        fn: ast.AST,
+        spec: ProtocolSpec,
+        dischargers: set[int],
+        resolver: _Resolver,
+        findings: list[Finding],
+    ):
+        self.module = module
+        self.fn = fn
+        self.spec = spec
+        self.dischargers = dischargers
+        self.resolver = resolver
+        self.findings = findings
+        self.fn_name = getattr(fn, "name", "<lambda>")
+        self.act_lines: set[int] = set()
+        self.reported: set[tuple] = set()
+
+    def _report(self, code: str, line: int, key: str, message: str) -> None:
+        if (code, line, key) in self.reported:
+            return
+        if self.module.annotations.waived(line, self.spec.waiver):
+            return
+        self.reported.add((code, line, key))
+        self.findings.append(Finding(code, self.module.path, line, message))
+
+    def _transfer(self, stmt, states: frozenset) -> tuple[frozenset, frozenset]:
+        """(normal_out, exc_out); exc_out is always the pre-call state."""
+        if stmt is None:
+            return states, states
+        exc_out = states
+        spec = self.spec
+        for expr in _header_exprs(stmt):
+            for sub in ast.walk(expr):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if any(
+                    _me_matches(sub, m)
+                    for m in (*spec.mint, *spec.mint_names)
+                ):
+                    states = frozenset({spec.initial})
+                    self.act_lines.add(sub.lineno)
+                    continue
+                op_hit = None
+                for op, (froms, to) in spec.ops.items():
+                    if _me_matches(sub, op):
+                        op_hit = (op, froms, to)
+                        break
+                if op_hit is not None:
+                    op, froms, to = op_hit
+                    bad = states - froms - {_HANDED}
+                    if states and bad:
+                        self._report(
+                            spec.code_op, sub.lineno, f"op:{op}",
+                            f"{op}() on the {spec.name} token in state "
+                            f"{sorted(bad)} on some path — the protocol "
+                            f"allows it only from {sorted(froms)}",
+                        )
+                    if not states:
+                        self.act_lines.add(sub.lineno)
+                    states = frozenset({to})
+                    continue
+                if not (states & spec.open_states) or not self.dischargers:
+                    continue
+                if any(
+                    id(hit.fn) in self.dischargers
+                    for hit in self.resolver.callees(sub)
+                ):
+                    states = frozenset({_HANDED})
+        return states, exc_out
+
+    def analyze(self) -> None:
+        flow = build_cfg(self.fn)
+        states: dict[int, frozenset] = {flow.entry: frozenset()}
+        work = [flow.entry]
+        visits = 0
+        limit = 50 * (len(flow.stmts) + 1)
+        while work and visits < limit:
+            visits += 1
+            n = work.pop()
+            state = states.get(n)
+            if state is None:
+                continue
+            normal, exc = self._transfer(flow.stmts[n], state)
+            for target, kind, _narrow in flow.succ[n]:
+                out = exc if kind == "exc" else normal
+                # The empty set is a REAL lattice value here (untracked:
+                # no token charged yet), so "unvisited" must be absence
+                # from the dict, not emptiness — an empty-state node
+                # still has to push its successors once.
+                seen = states.get(target)
+                merged = out if seen is None else seen | out
+                if seen is None or merged != seen:
+                    states[target] = merged
+                    work.append(target)
+        self._check_exits(flow, states)
+
+    def _check_exits(self, flow: CFG, states: dict[int, frozenset]) -> None:
+        spec = self.spec
+        act = min(self.act_lines, default=getattr(self.fn, "lineno", 1))
+        resolved = spec.terminal | {_HANDED}
+        at_exit = states.get(flow.exit, frozenset())
+        leaked = at_exit & spec.open_states
+        if leaked:
+            self._report(
+                spec.code_leak, act, "leak:exit",
+                f"the {spec.name} token charged here can reach the end of "
+                f"{self.fn_name} still {sorted(leaked)}: every non-"
+                f"{'/'.join(sorted(spec.terminal)) or 'terminal'} exit "
+                "must resolve it "
+                f"({', '.join(sorted(spec.ops))}) or hand it off",
+            )
+        at_raise = states.get(flow.raise_exit, frozenset())
+        if (at_raise & spec.open_states) and not (at_raise & resolved):
+            self._report(
+                spec.code_leak, act, "leak:raise",
+                f"an exception can escape {self.fn_name} with the "
+                f"{spec.name} token still "
+                f"{sorted(at_raise & spec.open_states)} and no exception "
+                "path resolves it: wrap the charged region so every "
+                "escape refunds or hands off the token",
+            )
+
+
+def run_multi_exit(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """The ``refund`` pass: every ``multi-exit=yes`` spec, every
+    function. Findings attach to the flagged file (per-file cacheable);
+    the specs and discharge summaries are cross-file context covered by
+    the env hash, exactly like :func:`run`."""
+    specs = [
+        spec
+        for spec in collect_specs(project).values()
+        if spec.multi_exit
+    ]
+    if not specs:
+        return []
+    resolvers = _ResolverCache(project)
+    contexts = [
+        (module, cls_name, fn)
+        for module in project.modules
+        for cls_name, fn in _functions(module)
+    ]
+    dischargers: dict[str, set[int]] = {
+        spec.name: {
+            id(fn)
+            for _module, _cls, fn in contexts
+            if _me_direct_resolves(fn, spec)
+        }
+        for spec in specs
+    }
+    findings: list[Finding] = []
+    for module, cls_name, fn in contexts:
+        if targets is not None and module.path not in targets:
+            continue
+        for spec in specs:
+            _MultiExitAnalyzer(
+                module, fn, spec, dischargers[spec.name],
+                resolvers.get(module, cls_name, fn), findings,
+            ).analyze()
     return findings

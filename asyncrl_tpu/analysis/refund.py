@@ -1,0 +1,31 @@
+"""Refund typestate pass (RFD0xx) — the ``refund`` CLI pass.
+
+The serving tier's rate tokens follow charge -> served | refunded: a
+request that charges a tenant's token bucket must either be served
+(``gate.finished``) or give the token back (``bucket.refund``) on EVERY
+exit — shed, degrade, 500, exception. PR 15's review fixed exactly this
+discipline in three separate places by hand; this pass machine-checks
+it via the protocol engine's multi-exit mode
+(:func:`asyncrl_tpu.analysis.protocols.run_multi_exit`): declare the
+token machine with ``# protocol: ... multi-exit=yes`` (grammar in
+:mod:`asyncrl_tpu.analysis.annotations`) and every function is walked
+for
+
+- **RFD001** — an op applied in a state the spec forbids (refund after
+  served, double refund);
+- **RFD002** — a charged token that can reach a function exit — normal
+  or exception edge — still in an open state, with no path resolving it
+  (the stripped-refund deletion proof in tests/test_analysis.py pins
+  this on the live gateway).
+
+Waived with ``# lint: protocol-ok(<reason>)`` like every other
+typestate finding. This module is registration glue: the engine lives
+next to the lease walker in ``protocols.py`` on purpose (one CFG
+convention, one resolver cache — a second walker would drift).
+"""
+
+from __future__ import annotations
+
+from asyncrl_tpu.analysis.protocols import run_multi_exit as run
+
+__all__ = ["run"]

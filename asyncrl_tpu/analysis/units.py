@@ -1,0 +1,328 @@
+"""Time-unit soundness pass (UNT0xx).
+
+The wire protocol speaks milliseconds (``X-Deadline-Ms``,
+``latency_ms``), the stdlib speaks seconds (``time.sleep``,
+``wait(timeout=)``, ``join(timeout=)``), and the clocks can speak
+nanoseconds (``monotonic_ns``) — so every boundary crossing needs a
+``/1e3``/``*1e3`` and the review history shows they get dropped. This
+pass infers units from the repo's own naming convention and flags the
+crossings the conversion is missing from.
+
+Inference is a scale exponent (s=0, ms=3, ns=9; anything else is
+unknown and adopts the known side):
+
+- names and attributes suffixed ``_ms``/``_s``/``_ns`` (any case:
+  ``deadline_ms``, ``DISPATCH_GRACE_S``, ``sync_interval_s``) carry
+  their suffix's unit, as do calls to suffixed methods
+  (``latency_estimate_ms()``);
+- ``monotonic``/``time``/``perf_counter``/``clock`` calls are seconds,
+  their ``*_ns`` variants nanoseconds;
+- multiplying/dividing by a power-of-ten constant shifts the scale
+  (``deadline_ms / 1e3`` is seconds); dividing two like-united values
+  is unitless; ``min``/``max`` join their arguments' units.
+
+Findings (all intraprocedural, per file, cacheable per file):
+
+- **UNT001** — mixed-unit ``+``/``-``: ``deadline_s + grace_ms`` is a
+  number with no meaning.
+- **UNT002** — a known unit delivered where a different one is
+  expected: a non-seconds value into a seconds sink (``time.sleep``,
+  ``.wait(timeout=)``, ``.join(timeout=)``, ``settimeout``), a
+  mismatched keyword argument (``timeout_s=deadline_ms``), or an
+  assignment re-labelling a value (``wire_s = deadline_ms``) without a
+  conversion on the path.
+- **UNT003** — a comparison across known different units (including
+  via ``min``/``max`` argument mixing): always-true/always-false
+  deadline checks are how budget bugs hide.
+
+Waive with ``# lint: units-ok(<reason>)`` naming the units and why the
+math is right.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+import re
+
+from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
+from asyncrl_tpu.analysis.protocols import _functions
+
+_WAIVER = "units-ok"
+
+# Scale exponents relative to seconds.
+_S, _MS, _NS = 0, 3, 9
+_KNOWN = (_S, _MS, _NS)
+_SUFFIXES = (("_ms", _MS), ("_ns", _NS), ("_s", _S))
+_CLOCKS_S = frozenset({"monotonic", "time", "perf_counter", "clock",
+                       "_clock"})
+_CLOCKS_NS = frozenset({"monotonic_ns", "time_ns", "perf_counter_ns"})
+_UNIT_NAMES = {_S: "s", _MS: "ms", _NS: "ns"}
+
+# Seconds-taking stdlib sinks: method name -> positional slot of the
+# seconds operand (timeout= keyword always counts).
+_SECONDS_SINKS = {"sleep": 0, "wait": 0, "wait_for": 1, "join": 0,
+                  "settimeout": 0}
+
+_SUFFIX_RE = re.compile(r"_(ms|ns|s)$", re.IGNORECASE)
+
+
+def _suffix_unit(name: str) -> int | None:
+    m = _SUFFIX_RE.search(name)
+    if not m:
+        return None
+    return {"ms": _MS, "ns": _NS, "s": _S}[m.group(1).lower()]
+
+
+def _pow10(node: ast.AST) -> int | None:
+    """The exponent when ``node`` is a positive power-of-ten constant
+    (1000, 1e3, 1e6); None otherwise."""
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)
+    ):
+        v = node.value
+        if v <= 0:
+            return None
+        k = round(math.log10(v))
+        if 10.0 ** k == float(v):
+            return k
+    return None
+
+
+class _UnitWalker:
+    """Infers units bottom-up over one function, reporting as it goes."""
+
+    def __init__(self, module: SourceModule, findings: list[Finding]):
+        self.module = module
+        self.findings = findings
+        self.reported: set[tuple] = set()
+
+    def _report(self, code: str, line: int, key: str, message: str) -> None:
+        if (code, line, key) in self.reported:
+            return
+        if self.module.annotations.waived(line, _WAIVER):
+            return
+        self.reported.add((code, line, key))
+        self.findings.append(Finding(code, self.module.path, line, message))
+
+    # -------------------------------------------------------- inference
+
+    def unit_of(self, node: ast.AST) -> int | None:
+        """Scale exponent, or None for unknown/unitless (both adopt the
+        other side; constants are deliberately unknown — ``30.0`` means
+        whatever its context says)."""
+        if isinstance(node, ast.Name):
+            return _suffix_unit(node.id)
+        if isinstance(node, ast.Attribute):
+            return _suffix_unit(node.attr)
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = None
+            if isinstance(func, ast.Attribute):
+                name = func.attr
+            elif isinstance(func, ast.Name):
+                name = func.id
+            if name in _CLOCKS_NS:
+                return _NS
+            if name in _CLOCKS_S:
+                return _S
+            if name in ("min", "max"):
+                units = [self.unit_of(a) for a in node.args]
+                known = [u for u in units if u is not None]
+                if len(set(known)) > 1:
+                    self._report(
+                        "UNT003", node.lineno, f"minmax:{node.col_offset}",
+                        f"{name}() mixes units "
+                        f"({'/'.join(_UNIT_NAMES[u] for u in sorted(set(known)))}): "
+                        "comparing across units picks a winner by scale, "
+                        "not by meaning — convert first",
+                    )
+                return known[0] if known else None
+            if name is not None:
+                return _suffix_unit(name)
+            return None
+        if isinstance(node, ast.BinOp):
+            return self._binop(node)
+        if isinstance(node, ast.UnaryOp):
+            return self.unit_of(node.operand)
+        if isinstance(node, ast.IfExp):
+            return (
+                self.unit_of(node.body)
+                if self.unit_of(node.body) is not None
+                else self.unit_of(node.orelse)
+            )
+        return None
+
+    def _binop(self, node: ast.BinOp) -> int | None:
+        left, right = self.unit_of(node.left), self.unit_of(node.right)
+        if isinstance(node.op, (ast.Add, ast.Sub)):
+            if left is not None and right is not None and left != right:
+                self._report(
+                    "UNT001", node.lineno, f"arith:{node.col_offset}",
+                    f"mixed-unit arithmetic: {_UNIT_NAMES[left]} "
+                    f"{'+' if isinstance(node.op, ast.Add) else '-'} "
+                    f"{_UNIT_NAMES[right]} is a number with no meaning — "
+                    "convert one side",
+                )
+                return left
+            return left if left is not None else right
+        if isinstance(node.op, ast.Mult):
+            k = _pow10(node.right)
+            base = left
+            if k is None:
+                k = _pow10(node.left)
+                base = right
+                if k is None:
+                    # scalar * united (2 * timeout_s) keeps the unit when
+                    # exactly one side is united; two united sides are
+                    # beyond this model.
+                    if left is not None and right is not None:
+                        return None
+                    return left if left is not None else right
+            if base is None:
+                return None
+            shifted = base + k
+            return shifted if shifted in _KNOWN else None
+        if isinstance(node.op, (ast.Div, ast.FloorDiv)):
+            if left is not None and right is not None and left == right:
+                return None  # ratio: unitless
+            k = _pow10(node.right)
+            if k is not None and left is not None:
+                shifted = left - k
+                return shifted if shifted in _KNOWN else None
+            return left if right is None else None
+        return None
+
+    # ------------------------------------------------------------ sinks
+
+    def check_call(self, call: ast.Call) -> None:
+        func = call.func
+        meth = None
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+        elif isinstance(func, ast.Name):
+            meth = func.id
+        # A bare min()/max() still has to be probed for argument mixing
+        # (unit_of reports it): it may sit in a return or argument where
+        # nothing else asks for its unit.
+        if meth in ("min", "max"):
+            self.unit_of(call)
+        # Seconds sinks by method name + timeout keyword.
+        if meth in _SECONDS_SINKS:
+            operand = None
+            for kw in call.keywords:
+                if kw.arg == "timeout":
+                    operand = kw.value
+            if operand is None:
+                slot = _SECONDS_SINKS[meth]
+                if slot < len(call.args):
+                    operand = call.args[slot]
+            if operand is not None:
+                unit = self.unit_of(operand)
+                if unit is not None and unit != _S:
+                    self._report(
+                        "UNT002", call.lineno, f"sink:{meth}",
+                        f"{meth}() takes seconds but receives a "
+                        f"{_UNIT_NAMES[unit]} value with no conversion: "
+                        f"divide by 1e{unit} at the boundary",
+                    )
+        # Suffixed keyword arguments expect their suffix's unit.
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            want = _suffix_unit(kw.arg)
+            if want is None:
+                continue
+            got = self.unit_of(kw.value)
+            if got is not None and got != want:
+                self._report(
+                    "UNT002", call.lineno, f"kw:{kw.arg}",
+                    f"keyword {kw.arg}= expects "
+                    f"{_UNIT_NAMES[want]} but receives a "
+                    f"{_UNIT_NAMES[got]} value with no conversion",
+                )
+
+    def check_assign(self, targets: list[ast.AST], value: ast.AST,
+                     line: int) -> None:
+        got = self.unit_of(value)
+        if got is None:
+            return
+        for t in targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for elt in elts:
+                want = None
+                if isinstance(elt, ast.Name):
+                    want = _suffix_unit(elt.id)
+                elif isinstance(elt, ast.Attribute):
+                    want = _suffix_unit(elt.attr)
+                if want is not None and got != want:
+                    self._report(
+                        "UNT002", line, f"assign:{line}",
+                        f"a {_UNIT_NAMES[got]} value is stored under a "
+                        f"*_{_UNIT_NAMES[want]} name with no conversion: "
+                        "the label and the value disagree",
+                    )
+
+    def check_compare(self, node: ast.Compare) -> None:
+        units = [self.unit_of(node.left)] + [
+            self.unit_of(c) for c in node.comparators
+        ]
+        known = {u for u in units if u is not None}
+        if len(known) > 1:
+            self._report(
+                "UNT003", node.lineno, f"cmp:{node.col_offset}",
+                "comparison across units "
+                f"({'/'.join(_UNIT_NAMES[u] for u in sorted(known))}): "
+                "the check is decided by scale, not by meaning — convert "
+                "one side",
+            )
+
+    # ------------------------------------------------------------- walk
+
+    def walk(self, fn: ast.AST) -> None:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.BinOp):
+                self._binop(sub)
+            elif isinstance(sub, ast.Call):
+                self.check_call(sub)
+            elif isinstance(sub, ast.Compare):
+                self.check_compare(sub)
+            elif isinstance(sub, ast.Assign):
+                self.check_assign(sub.targets, sub.value, sub.lineno)
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                self.check_assign([sub.target], sub.value, sub.lineno)
+            elif isinstance(sub, ast.AugAssign) and isinstance(
+                sub.op, (ast.Add, ast.Sub)
+            ):
+                want = None
+                if isinstance(sub.target, ast.Name):
+                    want = _suffix_unit(sub.target.id)
+                elif isinstance(sub.target, ast.Attribute):
+                    want = _suffix_unit(sub.target.attr)
+                got = self.unit_of(sub.value)
+                if want is not None and got is not None and got != want:
+                    self._report(
+                        "UNT001", sub.lineno, f"aug:{sub.lineno}",
+                        f"mixed-unit arithmetic: {_UNIT_NAMES[want]} "
+                        f"+= {_UNIT_NAMES[got]} — convert the right side",
+                    )
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    """UNT findings are a pure function of one file's source: per-file
+    cacheable, no cross-file context at all."""
+    findings: list[Finding] = []
+    for module in project.modules:
+        if targets is not None and module.path not in targets:
+            continue
+        walker = _UnitWalker(module, findings)
+        # Module-level statements too: unit constants are defined there.
+        for stmt in module.tree.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                walker.walk(stmt)
+        for _cls_name, fn in _functions(module):
+            walker.walk(fn)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.code))

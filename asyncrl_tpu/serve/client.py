@@ -293,7 +293,7 @@ class GatewayClient:
         with self._rng_lock:
             return 0.5 + self._rng.random()  # [0.5, 1.5)
 
-    def _call(self, endpoint, obs, policy, deadline_ms) -> GatewayResult:
+    def _call(self, endpoint, obs, policy, deadline_ms) -> GatewayResult:  # budget: deadline_ms
         budget_ms = deadline_ms if deadline_ms is not None else self.deadline_ms
         if budget_ms <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {budget_ms}")
@@ -375,7 +375,7 @@ class GatewayClient:
             self.backoff_cap_s, self.backoff_base_s * (2.0 ** attempt)
         ) * self._jitter()
 
-    def _wait(self, wait_s: float, start: float, budget_ms: float) -> bool:
+    def _wait(self, wait_s: float, start: float, budget_ms: float) -> bool:  # budget: budget_ms
         """Sleep ``wait_s`` unless it would overrun the deadline budget;
         returns False when the budget is spent (stop retrying)."""
         remaining_s = budget_ms / 1e3 - (self._clock() - start)

@@ -931,7 +931,7 @@ class FleetRouter:
 
     def act(
         self, policy: str, obs: np.ndarray, deadline_ms: float
-    ) -> tuple[np.ndarray, np.ndarray, int, dict]:
+    ) -> tuple[np.ndarray, np.ndarray, int, dict]:  # budget: deadline_ms
         fleet = self.fleet
         rows = obs.shape[0]
         padded = bucket_rows(obs)

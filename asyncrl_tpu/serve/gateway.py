@@ -703,6 +703,11 @@ class ServeGateway:
             pass
         return True
 
+    # The rate-token refund discipline, machine-checked by the refund
+    # pass (RFD*): admission charges the tenant's token; every exit is
+    # then either served (gate.finished) or gives the token back
+    # (bucket.refund) — shed, degrade-shed, drain, 500, all of them.
+    # protocol: rate-token multi-exit=yes mint=gate.admit ops=gate.abandoned:charged->refund_due,bucket.refund:charged|refund_due->refunded,gate.finished:charged->served open=charged,refund_due terminal=served,refunded
     def _handle_request(self, handler, endpoint: str) -> None:
         self._c_requests.inc()
         self._c_requests_by[endpoint].inc()
@@ -821,7 +826,13 @@ class ServeGateway:
                     headers={"Retry-After": f"{retry_after:.3f}"},
                 )
             try:
-                tenant.gate.admit()
+                # The admission wait is part of the promised budget: an
+                # uncapped admit() could hold the request in the gate
+                # queue past its own deadline and then dispatch work the
+                # client already abandoned (wait + hold <= deadline).
+                tenant.gate.admit(
+                    timeout_s=min(deadline_ms / 1e3, 30.0)
+                )
             except RequestShed as e:
                 # The gate refused AFTER the bucket charged: refund the
                 # token, or shed requests double-charge the rate budget.
@@ -887,6 +898,11 @@ class ServeGateway:
         # lint: broad-except-ok(per-request boundary: an infrastructure failure behind one request answers 500 and is counted; the serving loop and other requests are independent)
         except Exception as e:
             tenant.gate.abandoned()
+            # A 500 is not a served request: the rate token comes back,
+            # like every other non-served outcome (shed, degrade-shed,
+            # drain) — an erroring backend must not also eat the
+            # tenant's rate budget.
+            tenant.bucket.refund()
             self._c_errors.inc()
             self._c_errors_by[endpoint].inc()
             return self._send_json(

@@ -292,7 +292,7 @@ class ServeCore(threading.Thread):
 
     def submit_external(
         self, policy: str, args: tuple, deadline_ms: float
-    ) -> tuple[Any, int]:
+    ) -> tuple[Any, int]:  # budget: deadline_ms
         """One EXTERNAL request (the gateway's path) through the
         continuous batch. Unlike :meth:`client`, no client slot registers:
         the slab-full dispatch target stays actor-owned, so an idle
@@ -363,7 +363,14 @@ class ServeCore(threading.Thread):
     def _closed(self) -> bool:
         return self._stop_event.is_set() or not self.is_alive()
 
-    def _submit(self, index, policy, args, deadline_s, wire_budget_s=None):  # thread-entry: serve-client@actor
+    # The SLO-slot discipline, machine-checked by the refund pass
+    # (RFD*): admit() counts the request into the gate's in-flight
+    # window; every exit must then un-count it — finished() on the one
+    # served path, abandoned() on every shed/death/error path — or the
+    # window leaks a phantom in-flight slot and the gate starves.
+    # protocol: slo-slot multi-exit=yes mint=_slo.admit ops=_slo.abandoned:admitted->closed,_slo.finished:admitted->served open=admitted terminal=served,closed
+    def _submit(self, index, policy, args, deadline_s,  # thread-entry: serve-client@actor
+                wire_budget_s=None):  # budget: deadline_s, wire_budget_s
         # Admission gate FIRST: a shed/backpressured request never costs a
         # queue slot. Blocked time traces as serve.admit_wait. A gate wait
         # interrupted by server death re-raises the REAL latched cause,
@@ -449,6 +456,7 @@ class ServeCore(threading.Thread):
                     # before touching the queue; a wedged serve thread
                     # still sheds right after.
                     graced = True
+                    # lint: deadline-ok(one-shot bounded extension: the graced flag makes this re-derivation fire at most once, and DISPATCH_GRACE_S caps it — the budget cannot ratchet)
                     wire_deadline = time.monotonic() + DISPATCH_GRACE_S
                     continue
                 # Grace spent. Un-queue if still pending (never

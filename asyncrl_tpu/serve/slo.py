@@ -176,7 +176,7 @@ class SLOGate:
         self,
         stop: Callable[[], bool] | None = None,
         timeout_s: float = 30.0,
-    ) -> None:
+    ) -> None:  # budget: timeout_s
         """Admit one request or refuse it.
 
         Returns when admitted (inflight is counted from here — pair with

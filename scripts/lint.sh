@@ -4,16 +4,17 @@
 # lock discipline, JAX purity, donation safety, thread ownership,
 # deadlock/lock-order, device contracts, config contracts, protocol
 # typestate, async-signal safety, SPMD sharding contracts, multi-host
-# collective congruence, Pallas DMA discipline). The default package run
-# covers EVERY subpackage — asyncrl_tpu/obs/ (span rings, flight
-# recorder) included, so its guarded-by/thread-entry annotations gate
-# like the rest of the concurrency substrate — plus ALL the repo entry
-# points (scripts/*.py, bench.py, __graft_entry__.py) under the
-# entry-point pass set: configflow (CFG003: smoke scripts can't invent
-# unregistered ASYNCRL_* env vars) and the three SPMD passes (a launch
-# script that builds its mesh before jax.distributed.initialize, or a
-# validation script with an unpaired DMA, gates here — HSY002/PAL001
-# and friends).
+# collective congruence, Pallas DMA discipline, deadline flow, token
+# refund, time-unit soundness). The default package run covers EVERY
+# subpackage — asyncrl_tpu/obs/ (span rings, flight recorder) included,
+# so its guarded-by/thread-entry annotations gate like the rest of the
+# concurrency substrate. Focused gates beyond the package run live in
+# the GATES manifest below — one loop, no hand-maintained command
+# blocks: the entry points (scripts/*.py, bench.py, __graft_entry__.py)
+# under configflow + the SPMD passes + the wire-budget trio (a smoke
+# script that sleeps a millisecond value or drops a deadline guard gates
+# here), and the serve/kernel files whose gating must survive any future
+# package file-set edit.
 #
 #   scripts/lint.sh            # lint the package + script entries (CI gate)
 #   scripts/lint.sh --fast     # warm-cache mode: a full analyzer cache hit
@@ -70,39 +71,45 @@ python -m asyncrl_tpu.analysis \
     --format json --stats \
     > lint_report.json || rc=1
 
-# Entry points: configflow + the SPMD contract passes. Own cache
-# manifest (manifests key on the (file set, pass tuple) pair, so sharing
-# the package dir would invalidate both manifests on every run — the
-# PR-11 scripts-manifest pattern, now covering bench.py and
-# __graft_entry__.py too).
-python -m asyncrl_tpu.analysis \
-    --pass configflow --pass sharding --pass hostsync --pass pallas \
-    --cache-dir .analysis-cache-scripts \
-    scripts/*.py bench.py __graft_entry__.py || rc=1
-
-# The replicated serving tier is lease-protocol and lock-order critical
-# (held serve-stale anchors, replica rebuild under the fleet tick, the
-# probe/readmit typestate): run the protocol-typestate and deadlock
-# passes over it EXPLICITLY, so a future baseline or file-set edit to
-# the package run can never silently un-gate serve/fleet.py. Own cache
-# dir — manifests key on the (file set, pass tuple) pair.
-python -m asyncrl_tpu.analysis \
-    --pass protocols --pass deadlock \
-    --cache-dir .analysis-cache-fleet \
-    asyncrl_tpu/serve/fleet.py || rc=1
-
-# The device hot path's kernels carry the PR-17 contracts: Pallas DMA
-# start/wait discipline in the fused scan and RDMA ring, SPMD sharding
-# hygiene in the ring's collectives, and the devq-lease typestate in the
-# HBM rollout queue. The package run covers them today; this explicit
-# gate (the serve/fleet.py pattern) makes that non-optional — a future
-# baseline or file-set edit to the package run can never silently
-# un-gate the kernels. Own cache dir, same manifest-keying reason.
-python -m asyncrl_tpu.analysis \
-    --pass pallas --pass sharding --pass protocols \
-    --cache-dir .analysis-cache-kernels \
-    asyncrl_tpu/ops/pallas_scan.py asyncrl_tpu/ops/ring_reduce.py \
-    asyncrl_tpu/rollout/device_queue.py || rc=1
+# Focused gates, ONE manifest: "name|passes|paths". Each entry gets its
+# own cache dir (.analysis-cache-<name>) because manifests key on the
+# (file set, pass tuple) pair — sharing a dir would invalidate both
+# manifests on every run (the PR-11 scripts-manifest lesson).
+#
+# - scripts: every repo entry point under configflow (CFG003: smoke
+#   scripts can't invent unregistered ASYNCRL_* env vars), the SPMD
+#   passes (a launch script that builds its mesh before
+#   jax.distributed.initialize, or an unpaired DMA — HSY002/PAL001 and
+#   friends), and the wire-budget trio (deadline flow, token refund,
+#   time-unit soundness: a script that feeds an ms value to time.sleep
+#   gates here).
+# - fleet: the replicated serving tier is lease-protocol and lock-order
+#   critical (held serve-stale anchors, replica rebuild under the fleet
+#   tick, the probe/readmit typestate) — gated explicitly so a future
+#   baseline or package file-set edit can never silently un-gate it.
+# - kernels: the PR-17 device hot path contracts (Pallas DMA start/wait
+#   in the fused scan and RDMA ring, sharding hygiene in the ring's
+#   collectives, the devq-lease typestate in the HBM rollout queue),
+#   explicit for the same un-gating reason.
+GATES=(
+    "scripts|configflow,sharding,hostsync,pallas,deadlines,refund,units|scripts/*.py bench.py __graft_entry__.py"
+    "fleet|protocols,deadlock|asyncrl_tpu/serve/fleet.py"
+    "kernels|pallas,sharding,protocols|asyncrl_tpu/ops/pallas_scan.py asyncrl_tpu/ops/ring_reduce.py asyncrl_tpu/rollout/device_queue.py"
+)
+for gate in "${GATES[@]}"; do
+    name="${gate%%|*}"
+    rest="${gate#*|}"
+    passes="${rest%%|*}"
+    paths="${rest#*|}"
+    pass_args=()
+    for p in ${passes//,/ }; do
+        pass_args+=(--pass "$p")
+    done
+    # $paths is a glob-bearing word list on purpose (scripts/*.py).
+    # shellcheck disable=SC2086
+    python -m asyncrl_tpu.analysis "${pass_args[@]}" \
+        --cache-dir ".analysis-cache-$name" $paths || rc=1
+done
 
 if [ "$fast" -eq 1 ] && [ "$rc" -eq 0 ] && python - <<'EOF'
 import json

@@ -1,0 +1,62 @@
+"""Known-bad deadline flow: every DLN code, plus the waiver escape.
+
+The shapes mirror the real serving tier (admission wait, retry loop,
+wire parse) with the budget discipline deliberately broken.
+"""
+
+import queue
+import threading
+import time
+
+_requests_q = queue.Queue()
+
+
+def unbounded_admission(evt: threading.Event, budget_s):  # budget: budget_s
+    # DLN001: the gate wait has no timeout at all — it can outlive the
+    # promised budget by forever.
+    evt.wait()
+    return budget_s
+
+
+def fixed_timeout(evt: threading.Event, budget_s):  # budget: budget_s
+    # DLN001: bounded, but by a constant that ignores the budget.
+    evt.wait(timeout=30.0)
+    # DLN001: queue get with a fixed bound, same disease.
+    _requests_q.get(timeout=5.0)
+    return budget_s
+
+
+def regrowing_budget(evt: threading.Event, budget_s):  # budget: budget_s
+    start = time.monotonic()
+    while True:
+        # DLN002: re-capturing the anchor resets elapsed to zero every
+        # retry — the budget grows instead of shrinking.
+        start = time.monotonic()
+        remaining_s = budget_s - (time.monotonic() - start)
+        if remaining_s <= 0:
+            raise TimeoutError("budget spent")
+        if evt.wait(timeout=remaining_s):
+            return
+
+
+def unguarded_wire_read(headers, evt: threading.Event):
+    raw = headers.get("X-Deadline-Ms")
+    # DLN003: a wire value feeding arithmetic with no isfinite/range
+    # guard on any path — NaN sails straight through.
+    wait_budget = raw / 1e3
+    evt.wait(timeout=wait_budget)
+    return wait_budget
+
+
+def bounded_grace(evt: threading.Event, budget_s):  # budget: budget_s
+    deadline = time.monotonic() + budget_s
+    graced = False
+    while not evt.wait(timeout=max(deadline - time.monotonic(), 0.01)):
+        if time.monotonic() >= deadline and not graced:
+            graced = True
+            # NOT flagged: the waiver names the boundedness argument.
+            # lint: deadline-ok(one-shot grace bounded by the flag above; the budget cannot ratchet)
+            deadline = time.monotonic() + 0.25
+            continue
+        return False
+    return True

@@ -1,0 +1,47 @@
+"""Known-bad token-refund discipline: both RFD codes, plus the shapes
+the multi-exit engine must NOT flag (hand-off, full resolution).
+
+The spec mirrors the gateway's rate-token machine: charge at admission,
+then every exit either serves or refunds.
+"""
+
+# protocol: fixture-token multi-exit=yes mint=bucket.charge ops=gate.abandoned:charged->refund_due,bucket.refund:charged|refund_due->refunded,gate.served:charged->served open=charged,refund_due terminal=served,refunded
+
+
+def leaks_on_error_branch(bucket, gate, ok: bool):
+    bucket.charge()
+    if ok:
+        gate.served()
+        return "served"
+    # RFD002: this exit keeps the charged token — no refund, no serve.
+    return "error"
+
+
+def leaks_across_exception(bucket, gate, backend):
+    bucket.charge()
+    # RFD002 (raise edge): backend.run() can raise between charge and
+    # resolution with no try/finally refunding the token.
+    out = backend.run()
+    gate.served()
+    return out
+
+
+def refund_after_served(bucket, gate):
+    bucket.charge()
+    gate.served()
+    # RFD001: the protocol forbids refunding a token already served.
+    bucket.refund()
+
+
+def resolves_every_exit(bucket, gate, backend):
+    # NOT flagged: the discipline the spec wants, exception edges
+    # included.
+    bucket.charge()
+    try:
+        out = backend.run()
+    except Exception:
+        gate.abandoned()
+        bucket.refund()
+        raise
+    gate.served()
+    return out

@@ -1,0 +1,52 @@
+"""Known-bad time-unit flow: every UNT code, plus the waiver escape and
+the conversions the pass must respect as correct.
+"""
+
+import time
+
+GRACE_S = 0.25
+WINDOW_MS = 50.0
+
+
+def mixed_arithmetic(deadline_ms, backoff_s):
+    # UNT001: ms + s is a number with no meaning.
+    total = deadline_ms + backoff_s
+    return total
+
+
+def wrong_unit_sink(deadline_ms, evt):
+    # UNT002: time.sleep takes seconds; this sleeps a thousand times
+    # too long.
+    time.sleep(deadline_ms)
+    # UNT002: wait's timeout is seconds too.
+    evt.wait(timeout=WINDOW_MS)
+
+
+def relabelled_value():
+    # UNT002: a seconds constant stored under an *_ms name — the label
+    # and the value disagree by 1000x.
+    grace_ms = GRACE_S
+    return grace_ms
+
+
+def cross_unit_compare(deadline_ms, elapsed_s):
+    # UNT003: the comparison is decided by scale, not by meaning.
+    if deadline_ms < elapsed_s:
+        return True
+    # UNT003: min() mixing units picks a winner by scale.
+    return min(deadline_ms, elapsed_s)
+
+
+def converted_correctly(deadline_ms, evt):
+    # NOT flagged: explicit conversions at every boundary.
+    evt.wait(timeout=deadline_ms / 1e3)
+    budget_s = deadline_ms / 1e3
+    elapsed_ms = 1e3 * (time.monotonic() - time.monotonic())
+    return budget_s, elapsed_ms
+
+
+def waived_site(interval_s):
+    # NOT flagged: the waiver names the units and the why.
+    # lint: units-ok(interval is seconds on both sides; the _ms name is the wire field it feeds, converted by the transport)
+    payload_ms = interval_s
+    return payload_ms

@@ -90,6 +90,9 @@ def test_entry_map_names_the_five_thread_entries():
         ("bad_deadlock.py", {"DEAD001", "DEAD002", "DEAD003"}),
         ("bad_collectives.py", {"COL001", "COL002", "COL003"}),
         ("bad_configflow.py", {"CFG001", "CFG002", "CFG003"}),
+        ("bad_deadlines.py", {"DLN001", "DLN002", "DLN003"}),
+        ("bad_refund.py", {"RFD001", "RFD002"}),
+        ("bad_units.py", {"UNT001", "UNT002", "UNT003"}),
     ],
 )
 def test_fixture_corpus_is_flagged(fixture, expected):
@@ -1065,3 +1068,270 @@ def test_thread_target_closure_locks_feed_the_order_graph():
         passes=("deadlock",),
     )
     assert any(f.code == "DEAD001" for f in findings)
+
+
+# -------------------------- wire-budget contract passes (13..15)
+
+
+SERVE = os.path.join(PACKAGE, "serve")
+
+
+def _serve_src(name):
+    with open(os.path.join(SERVE, name)) as fh:
+        return fh.read()
+
+
+def test_stripping_the_grace_waiver_resurfaces_dln002():
+    """The scheduler's one-shot dispatch grace re-derives the wire
+    deadline from a fresh clock inside the wait loop — exactly the
+    budget-regrowth shape DLN002 exists for (the round-two retry bug
+    class). The deadline-ok waiver carrying the boundedness argument is
+    load-bearing: stripping it resurfaces the finding."""
+    src = _serve_src("scheduler.py")
+    assert not analysis.check_source(
+        src, path="scheduler.py", passes=("deadlines",)
+    )
+    stripped = "\n".join(
+        l for l in src.split("\n") if "lint: deadline-ok(one-shot" not in l
+    )
+    assert stripped != src
+    findings = analysis.check_source(
+        stripped, path="scheduler.py", passes=("deadlines",)
+    )
+    assert any(f.code == "DLN002" for f in findings), (
+        "stripping the grace waiver must resurface DLN002; got "
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_fresh_clock_in_the_client_wait_loop_trips_dln002():
+    """The acceptance proof for the client: re-anchoring ``start`` to a
+    fresh clock inside the retry loop makes ``remaining_ms`` regrow every
+    iteration — the deadline never expires. DLN002 must catch the
+    insertion on an in-memory copy; the pristine file is clean."""
+    src = _serve_src("client.py")
+    assert not analysis.check_source(
+        src, path="client.py", passes=("deadlines",)
+    )
+    anchor = "            remaining_ms = budget_ms - 1e3 * (self._clock() - start)"
+    assert src.count(anchor) == 1
+    mutated = src.replace(
+        anchor, "            start = self._clock()\n" + anchor
+    )
+    findings = analysis.check_source(
+        mutated, path="client.py", passes=("deadlines",)
+    )
+    assert any(f.code == "DLN002" for f in findings), (
+        "the fresh-clock re-anchor must trip DLN002; got "
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_removing_the_gateway_isfinite_guard_trips_dln003():
+    """The wire boundary is hostile: 'inf' parses as a float and survives
+    a naive > 0 check. Neutering the gateway's isfinite guard (in memory)
+    lets the wire-read deadline reach budget arithmetic unguarded on
+    every path — DLN003."""
+    src = _serve_src("gateway.py")
+    assert not analysis.check_source(
+        src, path="gateway.py", passes=("deadlines",)
+    )
+    guard = "if not math.isfinite(deadline_ms) or deadline_ms <= 0:"
+    assert src.count(guard) == 1
+    findings = analysis.check_source(
+        src.replace(guard, "if False:"),
+        path="gateway.py", passes=("deadlines",),
+    )
+    assert any(f.code == "DLN003" for f in findings), (
+        "removing the isfinite guard must trip DLN003; got "
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+@pytest.mark.parametrize(
+    "name, line",
+    [
+        (
+            "gateway.py",
+            "        tenant.bucket.refund()"
+            "  # shed, not served: the token comes back\n",
+        ),
+        (
+            "scheduler.py",
+            "        self._slo.finished("
+            "1e3 * (time.monotonic() - request.arrival))\n",
+        ),
+    ],
+)
+def test_stripping_a_token_resolution_trips_rfd002(name, line):
+    """The refund typestate is machine-checked on the live tree: delete
+    the degrade-path refund (the token silently vanishes on a shed) or
+    the scheduler's served-path ``finished`` (a phantom in-flight slot)
+    and the multi-exit pass reports the leaked token. Pristine files are
+    clean under the same pass."""
+    src = _serve_src(name)
+    assert not analysis.check_source(src, path=name, passes=("refund",))
+    assert src.count(line) == 1
+    findings = analysis.check_source(
+        src.replace(line, ""), path=name, passes=("refund",)
+    )
+    assert any(f.code == "RFD002" for f in findings), (
+        f"stripping the resolution in {name} must trip RFD002; got "
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_feeding_grace_seconds_to_an_ms_name_trips_unt002():
+    """DISPATCH_GRACE_S is a seconds constant; binding it to an ``_ms``
+    name (the classic 1000x unit slip) must trip the unit pass on an
+    in-memory copy of the scheduler."""
+    src = _serve_src("scheduler.py")
+    assert not analysis.check_source(
+        src, path="scheduler.py", passes=("units",)
+    )
+    anchor = "                    graced = True\n"
+    assert src.count(anchor) == 1
+    mutated = src.replace(
+        anchor,
+        anchor + "                    grace_budget_ms = DISPATCH_GRACE_S\n",
+    )
+    findings = analysis.check_source(
+        mutated, path="scheduler.py", passes=("units",)
+    )
+    assert any(f.code == "UNT002" for f in findings), (
+        "binding DISPATCH_GRACE_S to an _ms name must trip UNT002; got "
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def _wire_tree(tree):
+    (tree / "deadline.py").write_text(
+        textwrap.dedent(
+            """
+            def waiter(evt, budget_s):  # budget: budget_s
+                # lint: deadline-ok(fixture: caller bounds the wait)
+                evt.wait(timeout=30.0)
+            """
+        )
+    )
+    (tree / "units_mod.py").write_text(
+        textwrap.dedent(
+            """
+            import time
+
+            GRACE_MS = 50.0
+
+            def napper():
+                # lint: units-ok(fixture: intentional ms-long sleep)
+                time.sleep(GRACE_MS)
+            """
+        )
+    )
+    (tree / "refund_mod.py").write_text(
+        textwrap.dedent(
+            """
+            # protocol: mini-token multi-exit=yes mint=bucket.charge ops=bucket.refund:charged->refunded,gate.served:charged->served open=charged terminal=served,refunded
+
+            def handle(bucket, gate, ok):
+                bucket.charge()
+                try:
+                    if not ok:
+                        bucket.refund()
+                        return None
+                    gate.served()
+                    return 1
+                except Exception:
+                    bucket.refund()
+                    raise
+            """
+        )
+    )
+
+
+def test_wire_budget_findings_survive_the_cache(tmp_path):
+    """Cache soundness for the three new families, both directions: a
+    clean tree replays clean from a warm manifest, and the waiver-strip
+    (comment-only) or refund-strip (code) edits each resurface their
+    finding through a partial cached run — never hidden by stale
+    per-file results."""
+    tree, cache_dir = tmp_path / "src", tmp_path / "cache"
+    tree.mkdir()
+    _wire_tree(tree)
+    cold = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert cold.findings == [], "\n".join(
+        f.render() for f in cold.findings
+    )
+    warm = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert warm.stats["cache"] == "warm" and warm.findings == []
+    # Comment-only edit #1: strip the deadline waiver.
+    src = (tree / "deadline.py").read_text()
+    (tree / "deadline.py").write_text(
+        "\n".join(l for l in src.split("\n") if "deadline-ok" not in l)
+    )
+    after = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert after.stats["cache"] == "partial"
+    assert any(f.code == "DLN001" for f in after.findings)
+    # Comment-only edit #2: strip the units waiver.
+    src = (tree / "units_mod.py").read_text()
+    (tree / "units_mod.py").write_text(
+        "\n".join(l for l in src.split("\n") if "units-ok" not in l)
+    )
+    after = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert any(f.code == "UNT002" for f in after.findings)
+    # Code edit: strip the refund on the not-ok exit (the except-path
+    # refund stays — only the normal-exit leak appears).
+    src = (tree / "refund_mod.py").read_text()
+    assert src.count("            bucket.refund()\n") == 1
+    (tree / "refund_mod.py").write_text(
+        src.replace("            bucket.refund()\n", "")
+    )
+    after = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert any(f.code == "RFD002" for f in after.findings)
+
+
+def test_pre_wire_budget_manifest_plans_cold(tmp_path):
+    """The wire-budget trio bumped ANALYZER_VERSION 4 -> 5: a manifest
+    written by the previous analyzer (version "4") must plan COLD — its
+    cached findings predate three whole pass families."""
+    tree, cache_dir = tmp_path / "src", tmp_path / "cache"
+    tree.mkdir()
+    _mini_tree(tree)
+    analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    mpath = os.path.join(str(cache_dir), "manifest.json")
+    with open(mpath) as fh:
+        doc = json.load(fh)
+    assert doc["version"] == "5"
+    doc["version"] = "4"
+    with open(mpath, "w") as fh:
+        json.dump(doc, fh)
+    after = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert after.stats["cache"] == "cold"
+
+
+def test_cli_pass_selects_the_wire_budget_passes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for pass_name, fixture, code in [
+        ("deadlines", "bad_deadlines.py", "DLN001"),
+        ("refund", "bad_refund.py", "RFD002"),
+        ("units", "bad_units.py", "UNT001"),
+    ]:
+        run = subprocess.run(
+            [
+                sys.executable, "-m", "asyncrl_tpu.analysis",
+                "--pass", pass_name, os.path.join(FIXTURES, fixture),
+            ],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        assert run.returncode == 1, run.stdout + run.stderr
+        assert code in run.stdout
+    # Selectivity: the refund pass alone sees no protocol declaration in
+    # the deadline fixture — a clean, gating-grade exit 0.
+    clean = subprocess.run(
+        [
+            sys.executable, "-m", "asyncrl_tpu.analysis",
+            "--pass", "refund",
+            os.path.join(FIXTURES, "bad_deadlines.py"),
+        ],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr

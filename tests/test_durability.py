@@ -332,15 +332,19 @@ def test_slo_gate_close_wakes_a_waiting_admitter():
     gate.admit()  # fills the cap; the next admit blocks
     err = {}
 
+    parked = threading.Event()
+
     def waiter():
         try:
+            parked.set()  # proves the thread reached the blocking call
             gate.admit(timeout_s=10.0)
         except ServerClosed as e:
             err["e"] = e
 
     t = threading.Thread(target=waiter, daemon=True)
     t.start()
-    time.sleep(0.1)
+    assert parked.wait(5.0)
+    time.sleep(0.05)  # small settle so the admit is parked, not pre-call
     gate.close()
     t.join(timeout=5.0)
     assert not t.is_alive() and "e" in err

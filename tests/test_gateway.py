@@ -262,6 +262,111 @@ def test_nonfinite_deadline_is_rejected_not_wedged():
         gateway.stop()
 
 
+def test_negative_and_zero_deadlines_are_rejected():
+    """A non-positive budget is a contradiction, not a tiny one: zero
+    and negative ms values (header or body) must 400 at the door — a
+    negative remaining budget downstream would admit and then instantly
+    shed every request, charging tenants for work never attempted."""
+    backend = _StubBackend()
+    gateway = ServeGateway(backend, port=-1).start()
+    try:
+        for header in ("-100", "0", "-0.5", "0.0"):
+            status, _, doc = _post(
+                gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+                headers={"X-Deadline-Ms": header},
+            )
+            assert status == 400 and doc["error"] == "bad_deadline", header
+        status, _, doc = _post(
+            gateway.port, "/v1/act",
+            {"v": 1, "obs": [[0, 0, 0, 0]], "deadline_ms": -250},
+        )
+        assert status == 400 and doc["error"] == "bad_deadline"
+        assert backend.calls == []
+    finally:
+        gateway.stop()
+
+
+def test_overflowing_deadline_is_rejected():
+    """An ms budget too large for a float overflows to inf at parse time
+    ('1e400') — and inf survives a naive > 0 check, then turns the
+    seconds conversion and every downstream min() into a no-op bound.
+    The isfinite guard must refuse it like any other unbounded budget."""
+    backend = _StubBackend()
+    gateway = ServeGateway(backend, port=-1).start()
+    try:
+        for header in ("1e400", "1e309", "1" + "0" * 400):
+            status, _, doc = _post(
+                gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+                headers={"X-Deadline-Ms": header},
+            )
+            assert status == 400 and doc["error"] == "bad_deadline", header
+        assert backend.calls == []
+    finally:
+        gateway.stop()
+
+
+def test_budget_death_in_grace_window_answers_429_and_refunds():
+    """A budget that SURVIVES admission and then dies waiting on a
+    wedged serve thread (through the scheduler's one-shot dispatch
+    grace) must answer 429 'overloaded' — and hand the rate token back,
+    like every other non-served outcome: with burst=1 and negligible
+    refill, the follow-up request only succeeds on the refunded token."""
+    release = threading.Event()
+
+    def wedge_fn(params, obs, key):
+        release.wait(10.0)  # the serve thread parks here mid-dispatch
+        return _det_fn(params, obs, key)
+
+    store = ParamStore({"bias": jnp.asarray(0.0)})
+    stop = threading.Event()
+    core = ServeCore(
+        wedge_fn, store=store, num_clients=1, stop_event=stop,
+        deadline_ms=10.0,  # tiny fill window: dispatch starts instantly
+    )
+    core.start()
+    backend = CoreBackend(
+        core_fn=lambda: core, inference_fn=wedge_fn, obs_shape=(4,),
+    )
+    tenants = parse_tenant_spec("bulk:shed:rps=0.001,burst=1")
+    gateway = ServeGateway(backend, port=-1, tenants=tenants).start()
+    wedge_result = {}
+
+    def wedge_request():
+        # Default tenant: occupies the serve thread without touching
+        # bulk's bucket.
+        wedge_result["r"] = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[1, 0, 0, 0]]},
+            headers={"X-Deadline-Ms": "8000"},
+        )
+
+    wedger = threading.Thread(target=wedge_request, daemon=True)
+    try:
+        wedger.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not core.slo.inflight():
+            time.sleep(0.01)  # until the wedge request is admitted
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[2, 0, 0, 0]]},
+            headers={"X-Tenant": "bulk", "X-Deadline-Ms": "300"},
+        )
+        # Admission passed (the core gate had room); the wire budget and
+        # the grace both died against the wedged serve thread.
+        assert status == 429 and doc["error"] == "overloaded"
+        release.set()
+        wedger.join(timeout=10.0)
+        assert wedge_result["r"][0] == 200
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[3, 0, 0, 0]]},
+            headers={"X-Tenant": "bulk", "X-Deadline-Ms": "5000"},
+        )
+        assert status == 200, doc  # paid for by the refunded token
+    finally:
+        release.set()
+        stop.set()
+        gateway.stop()
+        core.join(timeout=10.0)
+
+
 def test_tenant_token_bucket_sheds_with_retry_after():
     tenants = parse_tenant_spec("bulk:shed:rps=0.5,burst=1")
     gateway = ServeGateway(_StubBackend(), port=-1, tenants=tenants).start()

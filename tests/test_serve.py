@@ -250,14 +250,17 @@ def test_slo_gate_backpressure_unblocks_on_completion():
     gate.admit()
     gate.admit()
     released = []
+    parked = threading.Event()
 
     def admit_third():
+        parked.set()  # proves the thread reached the blocking call
         gate.admit(timeout_s=10.0)
         released.append(time.monotonic())
 
     t = threading.Thread(target=admit_third, name="slo-admitter", daemon=True)
     t.start()
-    time.sleep(0.1)
+    assert parked.wait(5.0)
+    time.sleep(0.05)  # small settle: a buggy pass-through needs a beat
     assert not released, "third admit must backpressure, not pass"
     gate.finished(50.0)  # completion refills one token
     t.join(timeout=5.0)
@@ -320,9 +323,11 @@ def test_slo_gate_reopen_wakes_blocked_admitters():
     gate = SLOGate(max_inflight=1)
     gate.admit()
     outcome = []
+    parked = threading.Event()
 
     def blocked():
         try:
+            parked.set()  # proves the thread reached the blocking call
             gate.admit(timeout_s=10.0)
             outcome.append("admitted")
         except ServerClosed:
@@ -330,7 +335,8 @@ def test_slo_gate_reopen_wakes_blocked_admitters():
 
     t = threading.Thread(target=blocked, name="reopen-admitter", daemon=True)
     t.start()
-    time.sleep(0.1)
+    assert parked.wait(5.0)
+    time.sleep(0.05)  # small settle: a buggy pass-through needs a beat
     assert not outcome, "must be parked at the inflight cap"
     gate.close()
     t.join(timeout=5.0)
@@ -508,19 +514,26 @@ def test_external_request_never_fills_an_actor_batch_early():
     scheduler keeps the batch open for the second actor (external rows
     ride along, they never split an actor cohort)."""
     store = ParamStore({"bias": jnp.asarray(0.0)})
-    core, stop = _mk_core(_det_fn, 2, store=store, deadline_ms=600.0)
+    # A WIDE fill window (10s) so the premature-dispatch check below can
+    # never race the deadline flush on a loaded box — membership, not
+    # the flush, must gate the dispatch this test pins.
+    core, stop = _mk_core(_det_fn, 2, store=store, deadline_ms=10_000.0)
     try:
         c0, c1 = core.client(0), core.client(1)
         done = {}
+        entered = threading.Barrier(3)
 
-        def actor(i, client):
+        def actor(i, client, sync=True):
+            if sync:  # the late third member skips the fill-phase gate
+                entered.wait(5.0)
             done[i] = client(
                 None, np.full((1, 4), float(i), np.float32), None
             )
 
         def external():
+            entered.wait(5.0)
             done["ext"] = core.submit_external(
-                "default", (np.full((1, 4), 9.0, np.float32),), 2000.0
+                "default", (np.full((1, 4), 9.0, np.float32),), 10_000.0
             )
 
         threads = [
@@ -529,11 +542,14 @@ def test_external_request_never_fills_an_actor_batch_early():
         ]
         for t in threads:
             t.start()
-        time.sleep(0.25)
-        # Inside the 600ms fill window with only actor0 + external in:
-        # nothing may have dispatched (members=1 < target=2).
+        entered.wait(5.0)  # both submitters are past the gate...
+        time.sleep(0.25)  # ...then a settle inside the fill window
+        # Inside the fill window with only actor0 + external in: nothing
+        # may have dispatched (members=1 < target=2).
         assert not done, f"premature dispatch: {list(done)}"
-        t2 = threading.Thread(target=actor, args=(1, c1), name="fill-a1")
+        t2 = threading.Thread(
+            target=actor, args=(1, c1, False), name="fill-a1"
+        )
         t2.start()
         for t in threads + [t2]:
             t.join(timeout=20)

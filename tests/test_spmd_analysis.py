@@ -803,7 +803,10 @@ def test_analyzer_version_bump_invalidates_old_manifests(tmp_path):
     analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
     manifest_path = os.path.join(str(cache_dir), "manifest.json")
     doc = json.load(open(manifest_path))
-    assert doc["version"] == cache.ANALYZER_VERSION == "4"
+    # The literal current version is pinned where it is bumped
+    # (test_analysis.py's pre-wire-budget test); here only the
+    # invariant matters: an older manifest can never replay.
+    assert doc["version"] == cache.ANALYZER_VERSION
     doc["version"] = "3"
     json.dump(doc, open(manifest_path, "w"))
     files = core.discover_files([str(tree)])

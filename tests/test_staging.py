@@ -100,15 +100,18 @@ def test_no_reuse_before_transfer_complete():
         ring.retire(lease.slab, handle)
 
     got = []
+    parked = threading.Event()
 
     def acquire_blocked():
+        parked.set()  # proves the thread reached the blocking call
         got.append(ring.acquire())
 
     t = threading.Thread(
         target=acquire_blocked, name="staging-acquirer", daemon=True
     )
     t.start()
-    time.sleep(0.15)
+    assert parked.wait(5.0)
+    time.sleep(0.05)  # small settle: a buggy re-lease needs a beat
     assert not got, "slab re-leased while its transfer was still in flight"
     handles[0].set_ready()
     t.join(timeout=5)
@@ -246,13 +249,16 @@ def test_ring_swap_wakes_blocked_acquirer_onto_new_ring():
         _fill_and_commit(lease)
         old.retire(lease.slab, FakeReady(ready=False))
     got = []
+    parked = threading.Event()
 
     def blocked():
+        parked.set()  # proves the thread reached the blocking call
         got.append(holder.acquire())
 
     t = threading.Thread(target=blocked, name="swap-acquirer", daemon=True)
     t.start()
-    time.sleep(0.15)
+    assert parked.wait(5.0)
+    time.sleep(0.05)  # small settle: a buggy pass-through needs a beat
     assert not got, "acquire should be blocked on the exhausted old ring"
     new = StagingRing(_template(), rows_per_slab=1, num_slabs=2)
     holder.swap(new)
