@@ -1,6 +1,6 @@
 """Framework-aware static checker for the async pipeline.
 
-``python -m asyncrl_tpu.analysis [paths...]`` runs fifteen passes over the
+``python -m asyncrl_tpu.analysis [paths...]`` runs sixteen passes over the
 package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
 :mod:`asyncrl_tpu.analysis.annotations` for the annotation grammar):
 
@@ -39,6 +39,11 @@ package (see :mod:`asyncrl_tpu.analysis.core` for the philosophy and
 - ``units``       — time-unit soundness: ms/s/ns inferred from name
   suffixes and stdlib sinks; mixed-unit arithmetic, wrong-unit sink
   flow, cross-unit comparisons (UNT*)
+- ``races``       — interprocedural lockset race detection with
+  shared-state escape inference: discovered thread roots (Thread
+  targets, pool submits, HTTP handler entries, signal handlers),
+  per-site locksets, check-then-act gaps, condition-variable
+  discipline, and guarded-by inference (RACE*)
 
 Annotation-grammar errors and unloadable files (ANN*) are produced by
 every run and can be neither waived nor baselined. The analyzer core
@@ -78,6 +83,7 @@ PASSES = (
     "deadlines",
     "refund",
     "units",
+    "races",
 )
 
 # Finding-code prefix -> owning pass (for per-pass stats; ANN* belongs to
@@ -99,6 +105,7 @@ CODE_FAMILIES = {
     "DLN": "deadlines",
     "RFD": "refund",
     "UNT": "units",
+    "RACE": "races",
     "ANN": "annotations",
 }
 
@@ -116,6 +123,7 @@ def _impl():
         pallas,
         protocols,
         purity,
+        races,
         refund,
         sharding,
         signals,
@@ -138,6 +146,7 @@ def _impl():
         "deadlines": deadlines.run,
         "refund": refund.run,
         "units": units.run,
+        "races": races.run,
     }
 
 
@@ -145,16 +154,24 @@ def run_passes(
     project: Project,
     passes: tuple[str, ...] | list[str] = PASSES,
     targets: set[str] | None = None,
+    timings: dict[str, float] | None = None,
 ) -> list[Finding]:
     """Annotation errors + every requested pass's findings, stably ordered
     by (path, line, code). ``targets`` scopes per-file findings for the
-    incremental cache (global passes ignore it — see analysis/cache.py)."""
+    incremental cache (global passes ignore it — see analysis/cache.py).
+    ``timings``, when given, accumulates per-pass wall seconds (the
+    ``--stats`` breakdown that catches an accidentally quadratic pass)."""
     impl = _impl()
     findings = list(project.annotation_errors())
     for name in passes:
         if name not in impl:
             raise ValueError(f"unknown pass {name!r}; have {PASSES}")
+        t0 = time.perf_counter()
         findings.extend(impl[name](project, targets))
+        if timings is not None:
+            timings[name] = (
+                timings.get(name, 0.0) + time.perf_counter() - t0
+            )
     return sorted(findings, key=lambda f: (f.path, f.line, f.code))
 
 
@@ -199,6 +216,10 @@ def run_analysis(
     t0 = time.perf_counter()
     passes = tuple(passes)
     files = _core.discover_files(paths)
+    # Per-pass wall seconds. A warm run replays the manifest without
+    # running a single pass, so the dict stays empty — "{}" in the
+    # stats means "nothing ran", never "everything was instant".
+    timings: dict[str, float] = {}
 
     def finish(findings, mode, analyzed):
         # Every requested pass reports, zeros included: lint_report.json
@@ -221,13 +242,19 @@ def run_analysis(
                 "cache": mode,
                 "passes": list(passes),
                 "findings_per_pass": dict(sorted(per_pass.items())),
+                "pass_wall_s": {
+                    name: round(seconds, 6)
+                    for name, seconds in sorted(timings.items())
+                },
                 "findings_total": len(findings),
             },
         )
 
     if cache_dir is None:
         project = load_paths(paths)
-        return finish(run_passes(project, passes), "off", len(files))
+        return finish(
+            run_passes(project, passes, timings=timings), "off", len(files)
+        )
 
     hashes = {f: _cache.file_sha(f) for f in files}
     cache_plan, manifest = _cache.plan(cache_dir, files, hashes, passes)
@@ -240,14 +267,16 @@ def run_analysis(
         cache_plan, manifest, project, files, hashes, env_hash
     )
     if cache_plan.mode == "partial":
-        fresh = run_passes(project, passes, targets=cache_plan.targets)
+        fresh = run_passes(
+            project, passes, targets=cache_plan.targets, timings=timings
+        )
         findings = sorted(
             fresh + cache_plan.reused,
             key=lambda f: (f.path, f.line, f.code),
         )
         analyzed = len(cache_plan.targets)
     else:
-        findings = run_passes(project, passes)
+        findings = run_passes(project, passes, timings=timings)
         analyzed = len(files)
     _cache.store(cache_dir, files, hashes, passes, env_hash, findings)
     return finish(findings, cache_plan.mode, analyzed)

@@ -39,7 +39,8 @@ def main(argv: list[str] | None = None) -> int:
         "JAX purity, donation safety, thread ownership, deadlock/"
         "lock-order, device contracts, config contracts, protocol "
         "typestate, async-signal safety, SPMD sharding contracts, "
-        "multi-host collective congruence, Pallas DMA discipline)",
+        "multi-host collective congruence, Pallas DMA discipline, "
+        "lockset race detection)",
     )
     parser.add_argument(
         "paths",
@@ -147,8 +148,12 @@ def main(argv: list[str] | None = None) -> int:
             "analyzed",
             file=text_out,
         )
+        pass_wall = stats.get("pass_wall_s", {})
         for name, count in stats["findings_per_pass"].items():
-            print(f"  {name}: {count} finding(s)", file=text_out)
+            timing = (
+                f"  [{pass_wall[name]:.3f}s]" if name in pass_wall else ""
+            )
+            print(f"  {name}: {count} finding(s){timing}", file=text_out)
 
     if baseline_info.get("stale_entries"):
         print(

@@ -90,8 +90,11 @@ enforce. The full grammar (also documented in docs/ARCHITECTURE.md):
     deviation — e.g. a deliberate one-shot grace extension re-derived
     from the clock, with the boundedness argument in the reason),
     ``units-ok`` (a deliberate cross-unit expression the unit pass
-    cannot see through — name the units and why the math is right).
-    The reason is mandatory.
+    cannot see through — name the units and why the math is right),
+    ``race-ok`` (a sanctioned finding of the race pass: an unlocked
+    sharing with a correctness argument the lockset audit cannot see —
+    say what orders the accesses — or a deliberate check-then-act /
+    condition-discipline deviation). The reason is mandatory.
 
 Malformed annotations and unknown waiver tags are **hard lint errors**
 (ANN0xx findings) — a misspelled annotation must never silently enforce
@@ -122,6 +125,7 @@ WAIVER_TAGS = (
     "pallas-ok",
     "deadline-ok",
     "units-ok",
+    "race-ok",
 )
 
 _PROTOCOL_RE = re.compile(r"^protocol:\s*([\w-]+)\s+(.+)$")

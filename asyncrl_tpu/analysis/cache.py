@@ -35,12 +35,14 @@ import os
 
 from asyncrl_tpu.analysis.core import Finding, Project, SourceModule
 
-ANALYZER_VERSION = "5"
+ANALYZER_VERSION = "6"
 _MANIFEST = "manifest.json"
 
 # Code prefixes whose findings fold whole-project state: recomputed every
 # run, never cached per-file. SIG is global because handler reachability
-# folds registrations and call edges from everywhere. The SPMD families
+# folds registrations and call edges from everywhere; RACE likewise —
+# thread roots, reach closures, and entry locksets are whole-program
+# facts, so a per-file replay could serve a stale verdict. The SPMD families
 # (SHD/HSY/PAL) are deliberately NOT here: every finding attaches to the
 # file containing the flagged statement, and the cross-file context they
 # consult (axis-binding sites, the collective-reaching closure, DMA
@@ -48,7 +50,7 @@ _MANIFEST = "manifest.json"
 # hash and cold-invalidates per-file reuse, while a waiver strip changes
 # the flagged file's own hash. tests/test_spmd_analysis.py pins both
 # directions.
-GLOBAL_CODES = ("OWN", "EXC", "DEAD", "ANN", "SIG")
+GLOBAL_CODES = ("OWN", "EXC", "DEAD", "ANN", "SIG", "RACE")
 _GLOBAL_EXACT = ("CFG002",)
 
 

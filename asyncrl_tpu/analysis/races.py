@@ -1,0 +1,776 @@
+"""Interprocedural lockset race detection (RACE0xx).
+
+The lock pass is **opt-in**: it enforces only attributes somebody
+remembered to declare ``# guarded-by:``, and the ownership audit roots
+only at ``# thread-entry:`` annotations. Every *undeclared* mutable
+field shared across an *undeclared* thread is invisible to both — the
+one blind spot a careless refactor needs (delete the lock AND its
+annotation, and fifteen passes go silent). This pass closes it
+Eraser/RacerD-style: it *discovers* the concurrency instead of waiting
+for declarations.
+
+1. **Thread-root discovery** partitions the call graph into concurrent
+   contexts: ``threading.Thread(target=...)`` / ``threading.Timer``
+   creation sites (the target resolved to a method, module function, or
+   nested closure), ``threading.Thread`` subclasses (their ``run``),
+   ``ThreadPoolExecutor.submit`` callables, ``do_*`` handler entries of
+   ``BaseHTTPRequestHandler`` subclasses (``serve/gateway.py``'s
+   per-request daemon threads), and the signal-handler closure the
+   signals pass already computes. The functions *spawning* those
+   threads — plus the public methods of every class that owns a root —
+   form the ``main`` context (RacerD's rule: the spawning thread keeps
+   calling the object's API after ``start()``). Pool and HTTP-handler
+   contexts are **multi-instance**: they race against themselves, so a
+   single such context with a write already counts as concurrent.
+2. **Escape inference**: an attribute reachable (through the shared
+   conservative call graph, nested thread-target closures included) from
+   two concurrent contexts — or one multi-instance context — has
+   escaped; construction never counts (writes in the declaring class's
+   ``__init__`` precede publication, ``Thread.start`` is the
+   happens-before edge). This is the same capture/self-store reasoning
+   as the protocols pass's PROT003 escape machinery, applied to plain
+   attributes.
+3. **Per-site locksets**: the set of locks provably held at every touch
+   — lexical ``with`` nesting (the deadlock pass's lock identities:
+   ``Class.attr`` / ``module:NAME``, one typed hop), ``# holds:``
+   method-entry seeds, and interprocedurally the classic lockset
+   fixpoint: a callee's entry lockset is the intersection over every
+   observed call site of (caller entry set ∪ locks held at the site).
+
+Findings:
+
+- **RACE001** — an escaped attribute with at least one write, an EMPTY
+  lockset intersection across its concurrent sites, and no
+  ``# guarded-by:`` declaration. The undeclared-AND-unlocked case no
+  other pass sees.
+- **RACE002** — check-then-act: a function reads an attribute under a
+  lock, releases it, and later re-acquires the same lock to write the
+  attribute — the state checked can be gone by the time it acts.
+- **RACE003** — ``Condition.wait()`` outside a ``while``-predicate
+  recheck loop (spurious wakeups and stolen predicates are real;
+  ``wait_for`` rechecks internally and is exempt), or
+  ``notify``/``notify_all`` without the condition's own lock held.
+- **RACE004** — the inference gap: every concurrent site holds a COMMON
+  lock but nobody declared it. The finding emits the exact
+  ``# guarded-by:`` line to add, so discovery feeds the opt-in lock
+  pass and the discipline becomes enforced instead of accidental.
+
+``# lint: race-ok(<reason>)`` waives a finding; an existing
+``# lint: thread-shared-ok(...)`` (a declared non-lock discipline) and
+a ``# guarded-by:`` declaration (the lock pass enforces it) silence the
+escape audit the same way they silence the ownership audit. RACE is a
+**global family** like SIG: thread roots are whole-program facts, so
+findings are recomputed on every non-warm run and never cached per-file
+(see ``cache.GLOBAL_CODES``).
+
+Like every pass here, this is a linter, not a verifier. What it cannot
+see: dynamic dispatch through stored callables, locks bound to local
+variables, threads created by frameworks outside the source set, and
+helper functions only reachable through unresolvable calls. What it
+guarantees: every spelled-out thread root is discovered, and every
+attribute those roots share is either locked-and-declared, waived with
+a reason, or reported — on every run.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from asyncrl_tpu.analysis.core import Finding, Project, _dotted
+from asyncrl_tpu.analysis.deadlock import _Index, _LockRef
+from asyncrl_tpu.analysis.ownership import (
+    _MUTATORS,
+    CallNode,
+    _declaring_class,
+    _receiver_class,
+    _subscript_write_targets,
+)
+
+_EXECUTOR_TYPES = {"ThreadPoolExecutor"}
+_HANDLER_BASE = "BaseHTTPRequestHandler"
+
+
+@dataclasses.dataclass(frozen=True)
+class _Root:
+    """One discovered concurrent context entry."""
+
+    group: str  # context key; same group == same thread (or thread role)
+    multi: bool  # context concurrent with itself (pool / handler fleet)
+    node: CallNode
+
+
+@dataclasses.dataclass
+class _TouchSite:
+    owner: object  # ClassInfo
+    attr: str
+    line: int
+    write: bool
+    held: frozenset  # lexical lock keys at the site
+    fn_id: int
+    module: object  # SourceModule containing the touch
+
+
+@dataclasses.dataclass
+class _Region:
+    """One non-reentrant ``with <lock>:`` region (for check-then-act)."""
+
+    key: str
+    line: int
+    reads: set = dataclasses.field(default_factory=set)
+    writes: set = dataclasses.field(default_factory=set)
+
+
+class _SiteVisitor(ast.NodeVisitor):
+    """One function body: held-lock stack through ``with`` nesting,
+    attribute touches with their locksets, resolvable call sites, and
+    the condition-variable wait/notify sites."""
+
+    def __init__(self, pass_, node: CallNode):
+        self.p = pass_
+        self.node = node
+        self.held: list[_LockRef] = []
+        ann = node.module.annotations
+        if node.cls is not None:
+            held_lock = ann.holds.get((node.cls.name, node.name))
+            if held_lock is not None:
+                ref = self.p.index._class_lock(node.cls, held_lock)
+                if ref is not None:
+                    self.held.append(ref)
+        self.touches: list[_TouchSite] = []
+        self.calls: list[tuple[CallNode, frozenset, int]] = []
+        # (cond key, line, lexical held keys) for notify/notify_all.
+        self.notifies: list[tuple[str, int, frozenset]] = []
+        # (cond key, line) for a wait outside any while loop.
+        self.naked_waits: list[tuple[str, int]] = []
+        self.regions: list[_Region] = []
+        self._region_stack: list[_Region] = []
+        self._while_depth = 0
+        self._local_types = None
+        self._sub_writes = _subscript_write_targets(node.fn)
+        self._mutated: set[int] = set()
+        for sub in ast.walk(node.fn):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _MUTATORS
+                and isinstance(sub.func.value, ast.Attribute)
+            ):
+                self._mutated.add(id(sub.func.value))
+
+    def run(self) -> None:
+        for stmt in getattr(self.node.fn, "body", []) or []:
+            self.visit(stmt)
+
+    # ----------------------------------------------------------- helpers
+
+    def _held_keys(self) -> frozenset:
+        return frozenset(r.key for r in self.held)
+
+    # ------------------------------------------------------------- withs
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        opened = 0
+        for item in node.items:
+            ref = self.p.index.resolve(self.node, item.context_expr)
+            if ref is None or ref.key in self._held_keys():
+                continue  # unresolved, or reentrant: no new region
+            self.held.append(ref)
+            pushed += 1
+            region = _Region(ref.key, item.context_expr.lineno)
+            self.regions.append(region)
+            self._region_stack.append(region)
+            opened += 1
+        self.generic_visit(node)
+        for _ in range(pushed):
+            self.held.pop()
+        for _ in range(opened):
+            self._region_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        # A nested def outlives the block: analyzed as its own node with
+        # a fresh held context (thread-target closures become roots).
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # Lambdas inherit the held set (wait_for predicates run locked).
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._while_depth += 1
+        self.generic_visit(node)
+        self._while_depth -= 1
+
+    # ----------------------------------------------------------- touches
+
+    def visit_Attribute(self, sub: ast.Attribute) -> None:
+        write = (
+            isinstance(sub.ctx, (ast.Store, ast.Del))
+            or id(sub) in self._sub_writes
+            or id(sub) in self._mutated
+        )
+        cls = self.node.cls
+        is_self = isinstance(sub.value, ast.Name) and sub.value.id == "self"
+        owners = []
+        if is_self and cls is not None:
+            owner = _declaring_class(self.p.project, cls, sub.attr)
+            if owner is not None:
+                owners = [owner]
+        elif not is_self:
+            candidates = self.p.project.attrs_by_name.get(sub.attr, [])
+            typed = _receiver_class(self.p.project, self.node, sub.value)
+            if typed is not None:
+                owners = [c for c in candidates if c.name == typed]
+            elif (
+                len(candidates) == 1
+                and sub.attr not in self.p.project.dataclass_fields
+            ):
+                owners = candidates
+        held = self._held_keys()
+        for owner in owners:
+            self.touches.append(
+                _TouchSite(
+                    owner, sub.attr, sub.lineno, write, held,
+                    id(self.node.fn), self.node.module,
+                )
+            )
+            for region in self._region_stack:
+                pair = (id(owner), sub.attr)
+                (region.writes if write else region.reads).add(pair)
+        self.generic_visit(sub)
+
+    # ------------------------------------------------------------- calls
+
+    def visit_Call(self, call: ast.Call) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "wait":
+                ref = self.p.index.resolve(self.node, func.value)
+                if (
+                    ref is not None
+                    and ref.is_cond
+                    and self._while_depth == 0
+                ):
+                    self.naked_waits.append((ref.key, call.lineno))
+            elif func.attr in ("notify", "notify_all"):
+                ref = self.p.index.resolve(self.node, func.value)
+                if ref is not None and ref.is_cond:
+                    self.notifies.append(
+                        (ref.key, call.lineno, self._held_keys())
+                    )
+        graph = self.p.graph
+        if self._local_types is None:
+            self._local_types = graph._local_types(
+                self.node.fn, self.node.cls
+            )
+        for callee in graph.resolve_call(self.node, call, self._local_types):
+            self.calls.append((callee, self._held_keys(), call.lineno))
+        self.generic_visit(call)
+
+
+class _Pass:
+    def __init__(self, project: Project):
+        self.project = project
+        self.graph = project.call_graph
+        self.index = _Index(project)
+        self.findings: list[Finding] = []
+        # Every analyzable function node (top-level, methods, nested
+        # defs), keyed by id(fn).
+        self.nodes: dict[int, CallNode] = dict(self.graph.nodes)
+        self._add_nested_nodes()
+        self.visitors: dict[int, _SiteVisitor] = {}
+
+    def _add_nested_nodes(self) -> None:
+        """Synthesize nodes for nested defs (thread-target closures,
+        locked helpers) with the lexically enclosing class attached so
+        ``self.<attr>`` touches and locks resolve — same rule as the
+        deadlock pass."""
+        for module in self.project.modules:
+            class_of: dict[int, object] = {}
+            for info in self.project.class_list:
+                if info.module is module:
+                    for sub in ast.walk(info.node):
+                        class_of[id(sub)] = info
+            for fn in ast.walk(module.tree):
+                if (
+                    isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and id(fn) not in self.nodes
+                ):
+                    self.nodes[id(fn)] = CallNode(
+                        module, class_of.get(id(fn)), fn.name, fn
+                    )
+
+    # --------------------------------------------------- root discovery
+
+    def discover_roots(self) -> list[_Root]:
+        roots: list[_Root] = []
+        seen: set[tuple[str, int]] = set()
+
+        def add(group: str, multi: bool, node: CallNode | None) -> None:
+            if node is None:
+                return
+            key = (group, id(node.fn))
+            if key not in seen:
+                seen.add(key)
+                roots.append(_Root(group, multi, node))
+
+        spawners: list[CallNode] = []
+        root_methods: set[int] = set()
+
+        # threading.Thread(target=...) / threading.Timer(t, fn) /
+        # executor.submit(fn) creation sites, per analyzable function.
+        for node in self.nodes.values():
+            local_defs = {
+                sub.name: self.nodes[id(sub)]
+                for sub in ast.walk(node.fn)
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not node.fn
+                and id(sub) in self.nodes
+            }
+            loop_spans: list[tuple[int, int]] = [
+                (sub.lineno, getattr(sub, "end_lineno", sub.lineno))
+                for sub in ast.walk(node.fn)
+                if isinstance(sub, (ast.For, ast.While, ast.AsyncFor))
+            ]
+            for call in ast.walk(node.fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                target_expr = self._spawn_target(node, call)
+                if target_expr is None:
+                    continue
+                in_loop = any(
+                    a <= call.lineno <= b for a, b in loop_spans
+                )
+                target = self._resolve_callable(node, target_expr, local_defs)
+                if target is not None:
+                    multi = in_loop or self._is_submit(node, call)
+                    kind = "pool" if self._is_submit(node, call) else "thread"
+                    add(f"{kind}:{target.qualname}", multi, target)
+                    root_methods.add(id(target.fn))
+                spawners.append(node)
+
+        # threading.Thread subclasses: run() is the entry.
+        for info in self.project.class_list:
+            if not _extends(self.project, info.name, "Thread"):
+                continue
+            run_fn = info.methods.get("run")
+            if run_fn is not None and id(run_fn) in self.nodes:
+                node = self.nodes[id(run_fn)]
+                add(f"thread:{node.qualname}", False, node)
+                root_methods.add(id(run_fn))
+
+        # BaseHTTPRequestHandler subclasses (nested classes included):
+        # every do_* method is an entry, one multi-instance context per
+        # handler class (the server runs one daemon thread per request).
+        for module in self.project.modules:
+            for cls in ast.walk(module.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                bases = {
+                    b.rsplit(".", 1)[-1]
+                    for b in (_dotted(base) for base in cls.bases)
+                    if b
+                }
+                if _HANDLER_BASE not in bases:
+                    continue
+                for stmt in cls.body:
+                    if (
+                        isinstance(stmt, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                        and stmt.name.startswith("do_")
+                        and id(stmt) in self.nodes
+                    ):
+                        node = self.nodes[id(stmt)]
+                        add(f"http:{module.name}.{cls.name}", True, node)
+                        root_methods.add(id(stmt))
+
+        # The signal-handler closure (whole-program facts the signals
+        # pass already computes): a handler interleaves with whatever
+        # frame it interrupted — a concurrent context for data purposes.
+        from asyncrl_tpu.analysis.signals import _handler_roots
+
+        for _module, _call, _fn, handler in _handler_roots(
+            self.project, self.graph
+        ):
+            if handler is not None:
+                add("signal", False, handler)
+                root_methods.add(id(handler.fn))
+
+        # The main context: the spawning functions, plus the public API
+        # of every class that owns a root method — after start(), the
+        # spawning thread keeps calling into the same object.
+        owner_classes = {
+            id(r.node.cls): r.node.cls
+            for r in roots
+            if r.node.cls is not None
+        }
+        for node in spawners:
+            if id(node.fn) not in root_methods:
+                add("main", False, node)
+        for info in owner_classes.values():
+            for mname, fn in info.methods.items():
+                if mname.startswith("_") or id(fn) in root_methods:
+                    continue
+                if id(fn) in self.nodes:
+                    add("main", False, self.nodes[id(fn)])
+        return roots
+
+    def _spawn_target(self, node: CallNode, call: ast.Call):
+        """The callable expression a thread-creation call will run, or
+        None when ``call`` spawns nothing."""
+        resolved = node.module.resolve(call.func)
+        if resolved in ("threading.Thread", "threading.Timer"):
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    return kw.value
+            if resolved == "threading.Timer" and len(call.args) >= 2:
+                return call.args[1]
+            return None
+        if self._is_submit(node, call) and call.args:
+            return call.args[0]
+        return None
+
+    def _is_submit(self, node: CallNode, call: ast.Call) -> bool:
+        func = call.func
+        if not (
+            isinstance(func, ast.Attribute) and func.attr == "submit"
+        ):
+            return False
+        recv = func.value
+        type_name = None
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and node.cls is not None
+        ):
+            type_name = node.cls.attr_types.get(recv.attr)
+        elif isinstance(recv, ast.Name):
+            for sub in ast.walk(node.fn):
+                if (
+                    isinstance(sub, ast.Assign)
+                    and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)
+                    and sub.targets[0].id == recv.id
+                    and isinstance(sub.value, ast.Call)
+                ):
+                    callee = _dotted(sub.value.func)
+                    if callee:
+                        type_name = callee.rsplit(".", 1)[-1]
+        return type_name in _EXECUTOR_TYPES
+
+    def _resolve_callable(
+        self, node: CallNode, expr: ast.AST, local_defs: dict
+    ) -> CallNode | None:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and node.cls is not None
+        ):
+            return self.graph._method_on(node.cls.name, expr.attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in local_defs:
+                return local_defs[expr.id]
+            return self.graph._resolve_bare(node.module, expr.id)
+        return None
+
+    # -------------------------------------------------------------- run
+
+    def run(self) -> list[Finding]:
+        roots = self.discover_roots()
+        if not roots:
+            return []
+        for node in self.nodes.values():
+            visitor = _SiteVisitor(self, node)
+            visitor.run()
+            self.visitors[id(node.fn)] = visitor
+
+        # Reach closure per root over the already-resolved call sites.
+        adjacency = {
+            fid: [
+                id(callee.fn)
+                for callee, _, _ in v.calls
+                if id(callee.fn) in self.nodes
+            ]
+            for fid, v in self.visitors.items()
+        }
+        contexts_of: dict[int, set[str]] = {}
+        multi_groups: set[str] = set()
+        for root in roots:
+            if root.multi:
+                multi_groups.add(root.group)
+            work = [id(root.node.fn)]
+            seen: set[int] = set()
+            while work:
+                fid = work.pop()
+                if fid in seen:
+                    continue
+                seen.add(fid)
+                contexts_of.setdefault(fid, set()).add(root.group)
+                work.extend(adjacency.get(fid, ()))
+
+        entry = self._entry_locksets(roots, contexts_of)
+        self._audit_attrs(contexts_of, multi_groups, entry)
+        self._check_conditions(contexts_of, entry)
+        self._check_then_act(contexts_of)
+        return sorted(
+            self.findings, key=lambda f: (f.path, f.line, f.code)
+        )
+
+    def _entry_locksets(self, roots, contexts_of) -> dict[int, frozenset]:
+        """The classic lockset fixpoint: entry[f] = ∩ over observed call
+        sites of (entry[caller] ∪ held-at-site); roots start empty.
+        ``None`` is ⊤ (no observed caller yet)."""
+        entry: dict[int, frozenset | None] = {
+            fid: None for fid in contexts_of
+        }
+        for root in roots:
+            entry[id(root.node.fn)] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for fid in contexts_of:
+                caller_entry = entry.get(fid)
+                if caller_entry is None:
+                    continue
+                for callee, held, _line in self.visitors[fid].calls:
+                    cid = id(callee.fn)
+                    if cid not in contexts_of:
+                        continue
+                    incoming = caller_entry | held
+                    current = entry.get(cid)
+                    new = (
+                        incoming if current is None
+                        else current & incoming
+                    )
+                    if new != current:
+                        entry[cid] = new
+                        changed = True
+        return {
+            fid: (locks or frozenset())
+            for fid, locks in entry.items()
+        }
+
+    # ------------------------------------------- RACE001/RACE004 audit
+
+    def _audit_attrs(self, contexts_of, multi_groups, entry) -> None:
+        touches: dict[tuple[int, str], list[tuple[_TouchSite, set]]] = {}
+        owner_of: dict[int, object] = {}
+        for fid, groups in contexts_of.items():
+            visitor = self.visitors[fid]
+            node = self.nodes[fid]
+            for t in visitor.touches:
+                # Construction precedes publication.
+                if node.cls is t.owner and node.name == "__init__":
+                    continue
+                if _touch_waived(t):
+                    continue
+                touches.setdefault((id(t.owner), t.attr), []).append(
+                    (t, groups)
+                )
+                owner_of[id(t.owner)] = t.owner
+
+        for (oid, attr), tlist in sorted(
+            touches.items(),
+            key=lambda kv: (owner_of[kv[0][0]].name, kv[0][1]),
+        ):
+            owner = owner_of[oid]
+            groups: set[str] = set()
+            for _t, gs in tlist:
+                groups |= gs
+            concurrent = len(groups) >= 2 or bool(groups & multi_groups)
+            if not concurrent:
+                continue
+            if not any(t.write for t, _ in tlist):
+                continue
+            ann = owner.module.annotations
+            if ann.guard_for(owner.name, attr) is not None:
+                continue  # declared: the lock pass enforces it
+            decl_line = owner.attrs.get(attr, 0)
+            if _decl_waived(ann, decl_line):
+                continue
+            locksets = [
+                t.held | entry.get(t.fn_id, frozenset()) for t, _ in tlist
+            ]
+            common = frozenset.intersection(*locksets)
+            ctxs = ", ".join(sorted(groups))
+            if not common:
+                first_write = min(t.line for t, _ in tlist if t.write)
+                self.findings.append(
+                    Finding(
+                        "RACE001", owner.module.path,
+                        decl_line or first_write,
+                        f"{owner.name}.{attr} escapes to concurrent "
+                        f"contexts ({ctxs}) with at least one write and "
+                        "no lock common to its sites: add locking and "
+                        "declare '# guarded-by: <lock>', or waive with "
+                        "'# lint: race-ok(<reason>)'",
+                    )
+                )
+                continue
+            lockspec = _suggest_lockspec(owner, common)
+            if lockspec is None:
+                continue  # common lock exists but the grammar can't
+                # name it (module lock guarding a class attr): locked
+                # in practice, nothing unsafe to report
+            self.findings.append(
+                Finding(
+                    "RACE004", owner.module.path, decl_line,
+                    f"{owner.name}.{attr} is locked consistently "
+                    f"({lockspec} held at every concurrent site: {ctxs}) "
+                    "but never declared — the discipline is accidental "
+                    "until the lock pass enforces it: add "
+                    f"'# guarded-by: {lockspec}' to the declaration at "
+                    f"{owner.module.path}:{decl_line}",
+                )
+            )
+
+    # -------------------------------------------------------- RACE003
+
+    def _check_conditions(self, contexts_of, entry) -> None:
+        for fid in sorted(
+            contexts_of, key=lambda i: self.nodes[i].qualname
+        ):
+            visitor = self.visitors[fid]
+            node = self.nodes[fid]
+            ann = node.module.annotations
+            for key, line in visitor.naked_waits:
+                if ann.waived(line, "race-ok"):
+                    continue
+                self.findings.append(
+                    Finding(
+                        "RACE003", node.module.path, line,
+                        f"{node.qualname} calls {key}.wait() outside a "
+                        "while-predicate recheck loop: wakeups are "
+                        "spurious and predicates get stolen between "
+                        "notify and wakeup — re-test the predicate in a "
+                        "while loop (or use wait_for), or waive with "
+                        "'# lint: race-ok(<reason>)'",
+                    )
+                )
+            held_entry = entry.get(fid, frozenset())
+            for key, line, held in visitor.notifies:
+                if key in held or key in held_entry:
+                    continue
+                if ann.waived(line, "race-ok"):
+                    continue
+                self.findings.append(
+                    Finding(
+                        "RACE003", node.module.path, line,
+                        f"{node.qualname} notifies {key} without its "
+                        "lock held: the woken waiter can observe the "
+                        "predicate mid-update, or the notify can fire "
+                        "before the waiter sleeps and be lost — wrap "
+                        "the notify in 'with <cond>:', or waive with "
+                        "'# lint: race-ok(<reason>)'",
+                    )
+                )
+
+    # -------------------------------------------------------- RACE002
+
+    def _check_then_act(self, contexts_of) -> None:
+        for fid in sorted(
+            contexts_of, key=lambda i: self.nodes[i].qualname
+        ):
+            visitor = self.visitors[fid]
+            node = self.nodes[fid]
+            ann = node.module.annotations
+            by_key: dict[str, list[_Region]] = {}
+            for region in visitor.regions:
+                by_key.setdefault(region.key, []).append(region)
+            for key, regions in sorted(by_key.items()):
+                if len(regions) < 2:
+                    continue
+                for i, first in enumerate(regions):
+                    checked = first.reads - first.writes
+                    if not checked:
+                        continue
+                    for later in regions[i + 1:]:
+                        acted = checked & later.writes
+                        for (oid, attr) in sorted(
+                            acted, key=lambda p: p[1]
+                        ):
+                            if ann.waived(later.line, "race-ok") or (
+                                ann.waived(first.line, "race-ok")
+                            ):
+                                continue
+                            self.findings.append(
+                                Finding(
+                                    "RACE002", node.module.path,
+                                    later.line,
+                                    f"check-then-act in {node.qualname}: "
+                                    f".{attr} is read under {key} (line "
+                                    f"{first.line}) but the dependent "
+                                    "write happens under a later "
+                                    "re-acquisition — the lock is "
+                                    "released between check and act, so "
+                                    "the state checked can be gone: "
+                                    "merge the regions, or waive with "
+                                    "'# lint: race-ok(<reason>)'",
+                                )
+                            )
+
+
+def _touch_waived(t: _TouchSite) -> bool:
+    for module in (t.module, t.owner.module):
+        ann = module.annotations
+        if ann.waived(t.line, "race-ok") or ann.waived(
+            t.line, "thread-shared-ok"
+        ):
+            return True
+    return False
+
+
+def _decl_waived(ann, decl_line: int) -> bool:
+    return ann.waived(decl_line, "race-ok") or ann.waived(
+        decl_line, "thread-shared-ok"
+    )
+
+
+def _suggest_lockspec(owner, common: frozenset) -> str | None:
+    """The ``# guarded-by:`` lockspec for the (sorted-first) common
+    lock: a same-class lock becomes the simple ``_lock`` form, a
+    foreign class lock the dotted ``Owner._lock`` form; module-level
+    locks have no class-attr guard grammar."""
+    for key in sorted(common):
+        if ":" in key:
+            continue  # module lock: not declarable on a class attr
+        cls_name, _, lock_attr = key.rpartition(".")
+        if cls_name == owner.name:
+            return lock_attr
+        return key
+    return None
+
+
+def _extends(project: Project, class_name: str, base: str) -> bool:
+    seen: set[str] = set()
+    queue = [class_name]
+    while queue:
+        name = queue.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for info in project.classes.get(name, []):
+            for b in info.bases:
+                tail = b.rsplit(".", 1)[-1]
+                if tail == base:
+                    return True
+                queue.append(tail)
+    return False
+
+
+def run(
+    project: Project, targets: set[str] | None = None
+) -> list[Finding]:
+    # ``targets`` is accepted for pass-protocol uniformity but ignored:
+    # thread roots and reach closures are whole-program facts, so RACE
+    # findings are recomputed in full on every non-warm run (global
+    # codes for the incremental cache — see cache.GLOBAL_CODES).
+    del targets
+    return _Pass(project).run()

@@ -142,7 +142,7 @@ class Replica:
         # sync target (written by the fleet tick only).
         # lint: thread-shared-ok(GIL-atomic value; single-writer fleet tick, readers tolerate one-tick lag)
         self.target: int | None = None
-        self._next_sync = 0.0  # fleet-tick-thread only
+        self._next_sync = 0.0  # lint: race-ok(fleet-tick-thread only: maybe_sync is the tick's body; tests that drive tick() directly do so single-threaded)
         # Chaos enactments: monotonic deadlines the hang gate / sync path
         # compare against.
         # lint: thread-shared-ok(GIL-atomic float stamp; fleet tick writes, serve thread reads)
@@ -159,8 +159,12 @@ class Replica:
         self._flap_stamps: "deque[float]" = deque()  # guarded-by: _lock
         self._ejected_at = 0.0  # guarded-by: _lock
         self.started = False  # lint: thread-shared-ok(GIL-atomic flag; set once at start)
-        self._core_stop = threading.Event()
-        self.core = self._make_core()
+        # The rebuild hand-off: the fleet tick swaps in a fresh stop
+        # event + core as ONE GIL-atomic reference write each; a reader
+        # that grabbed the dying core observes its fatal latch and fails
+        # over, which is the supervised-restart contract.
+        self._core_stop = threading.Event()  # lint: race-ok(single-writer fleet tick; GIL-atomic reference swap on rebuild)
+        self.core = self._make_core()  # lint: race-ok(single-writer fleet tick; a reader holding the old core sees its fatal latch and retries)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -266,6 +270,7 @@ class Replica:
             if version == self._version:
                 return False
         gen = self.router.install(DEFAULT_POLICY, params)
+        # lint: race-ok(deliberate check-then-act: install is a device transfer and must not run under _lock; sync has a single caller — the fleet tick — so the version check cannot be invalidated between the regions)
         with self._lock:
             self._version = version
             self._gen_version[gen] = version

@@ -584,7 +584,7 @@ class ServeGateway:
         self._httpd = ThreadingHTTPServer((bind_host, max(0, port)), _Handler)
         self._httpd.daemon_threads = True
         self.port: int = self._httpd.server_address[1]
-        self._thread: threading.Thread | None = None
+        self._thread: threading.Thread | None = None  # lint: race-ok(single-writer: start/stop assign on the owner thread; is_alive only reads the GIL-atomic reference)
 
     # -------------------------------------------------------------- wire
 

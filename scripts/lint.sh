@@ -5,7 +5,8 @@
 # deadlock/lock-order, device contracts, config contracts, protocol
 # typestate, async-signal safety, SPMD sharding contracts, multi-host
 # collective congruence, Pallas DMA discipline, deadline flow, token
-# refund, time-unit soundness). The default package run covers EVERY
+# refund, time-unit soundness, lockset race detection). The default
+# package run covers EVERY
 # subpackage — asyncrl_tpu/obs/ (span rings, flight recorder) included,
 # so its guarded-by/thread-entry annotations gate like the rest of the
 # concurrency substrate. Focused gates beyond the package run live in
@@ -71,6 +72,23 @@ python -m asyncrl_tpu.analysis \
     --format json --stats \
     > lint_report.json || rc=1
 
+# The race pass must have RUN on the package and found nothing: a
+# report where the `races` key is missing means the pass silently fell
+# out of the run (a regression the zero-findings exit code would hide).
+python - <<'EOF' || rc=1
+import json
+import sys
+
+with open("lint_report.json") as fh:
+    per_pass = json.load(fh)["stats"]["findings_per_pass"]
+if per_pass.get("races") != 0:
+    print(
+        "lint.sh: expected findings_per_pass['races'] == 0, got "
+        f"{per_pass.get('races')!r}", file=sys.stderr,
+    )
+    sys.exit(1)
+EOF
+
 # Focused gates, ONE manifest: "name|passes|paths". Each entry gets its
 # own cache dir (.analysis-cache-<name>) because manifests key on the
 # (file set, pass tuple) pair — sharing a dir would invalidate both
@@ -80,9 +98,10 @@ python -m asyncrl_tpu.analysis \
 #   scripts can't invent unregistered ASYNCRL_* env vars), the SPMD
 #   passes (a launch script that builds its mesh before
 #   jax.distributed.initialize, or an unpaired DMA — HSY002/PAL001 and
-#   friends), and the wire-budget trio (deadline flow, token refund,
+#   friends), the wire-budget trio (deadline flow, token refund,
 #   time-unit soundness: a script that feeds an ms value to time.sleep
-#   gates here).
+#   gates here), and the race pass (a script that spawns a bare
+#   Thread against undeclared shared state gates here).
 # - fleet: the replicated serving tier is lease-protocol and lock-order
 #   critical (held serve-stale anchors, replica rebuild under the fleet
 #   tick, the probe/readmit typestate) — gated explicitly so a future
@@ -92,7 +111,7 @@ python -m asyncrl_tpu.analysis \
 #   collectives, the devq-lease typestate in the HBM rollout queue),
 #   explicit for the same un-gating reason.
 GATES=(
-    "scripts|configflow,sharding,hostsync,pallas,deadlines,refund,units|scripts/*.py bench.py __graft_entry__.py"
+    "scripts|configflow,sharding,hostsync,pallas,deadlines,refund,units,races|scripts/*.py bench.py __graft_entry__.py"
     "fleet|protocols,deadlock|asyncrl_tpu/serve/fleet.py"
     "kernels|pallas,sharding,protocols|asyncrl_tpu/ops/pallas_scan.py asyncrl_tpu/ops/ring_reduce.py asyncrl_tpu/rollout/device_queue.py"
 )

@@ -1,0 +1,79 @@
+"""Known-bad fixture: lockset-race-pass violations (RACE001/002/003/004).
+
+Mirrors the serving fleet's shapes: a worker thread and the spawning
+object's public API sharing undeclared attributes (unlocked, and
+consistently-locked-but-undeclared), a check-then-act across two
+acquisitions of the same lock, a condition wait outside a while-recheck
+loop, an unlocked notify, and a pool-submit job racing against its own
+sibling instances. No ``# guarded-by:`` or ``# thread-entry:``
+declarations anywhere — the point of the race pass is discovering the
+concurrency the opt-in passes were never told about.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class BadCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self.total = 0
+        self.high_water = 0
+        self.armed = False
+        self.ready = False
+
+    def start(self):
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        # RACE001 half: unlocked write from the worker context.
+        self.total += 1
+        with self._lock:
+            # RACE004 half: locked consistently, but never declared.
+            self.high_water = max(self.high_water, 1)
+        with self._cond:
+            self.ready = True
+            self._cond.notify_all()
+
+    def snapshot(self):
+        # RACE001 other half: unlocked read from the main context.
+        return self.total
+
+    def peak(self):
+        with self._lock:
+            # RACE004 other half: every concurrent site holds _lock.
+            return self.high_water
+
+    def bump_if_high(self):
+        # RACE002: check under the lock, release, act under a later
+        # re-acquisition — the checked state can be gone in between.
+        with self._lock:
+            should = not self.armed
+        if should:
+            with self._lock:
+                self.armed = True
+
+    def wait_ready(self):
+        with self._cond:
+            if not self.ready:
+                # RACE003: wait outside a while-recheck loop.
+                self._cond.wait()
+
+    def finish(self):
+        # RACE003: notify without the condition's lock held.
+        self._cond.notify_all()
+
+
+class BadPool:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(2)
+        self.jobs_done = 0
+
+    def kick(self):
+        self._pool.submit(self._job)
+
+    def _job(self):
+        # RACE001 via a multi-instance context: the pool races this
+        # job against its own siblings — one context is enough.
+        self.jobs_done += 1

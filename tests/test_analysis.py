@@ -93,6 +93,10 @@ def test_entry_map_names_the_five_thread_entries():
         ("bad_deadlines.py", {"DLN001", "DLN002", "DLN003"}),
         ("bad_refund.py", {"RFD001", "RFD002"}),
         ("bad_units.py", {"UNT001", "UNT002", "UNT003"}),
+        (
+            "bad_races.py",
+            {"RACE001", "RACE002", "RACE003", "RACE004"},
+        ),
     ],
 )
 def test_fixture_corpus_is_flagged(fixture, expected):
@@ -1289,10 +1293,10 @@ def test_wire_budget_findings_survive_the_cache(tmp_path):
     assert any(f.code == "RFD002" for f in after.findings)
 
 
-def test_pre_wire_budget_manifest_plans_cold(tmp_path):
-    """The wire-budget trio bumped ANALYZER_VERSION 4 -> 5: a manifest
-    written by the previous analyzer (version "4") must plan COLD — its
-    cached findings predate three whole pass families."""
+def test_pre_race_pass_manifest_plans_cold(tmp_path):
+    """The race pass bumped ANALYZER_VERSION 5 -> 6: a manifest written
+    by the previous analyzer (version "5") must plan COLD — its cached
+    findings predate a whole pass family."""
     tree, cache_dir = tmp_path / "src", tmp_path / "cache"
     tree.mkdir()
     _mini_tree(tree)
@@ -1300,8 +1304,8 @@ def test_pre_wire_budget_manifest_plans_cold(tmp_path):
     mpath = os.path.join(str(cache_dir), "manifest.json")
     with open(mpath) as fh:
         doc = json.load(fh)
-    assert doc["version"] == "5"
-    doc["version"] = "4"
+    assert doc["version"] == "6"
+    doc["version"] = "5"
     with open(mpath, "w") as fh:
         json.dump(doc, fh)
     after = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
@@ -1335,3 +1339,180 @@ def test_cli_pass_selects_the_wire_budget_passes():
         capture_output=True, text=True, env=env, timeout=300,
     )
     assert clean.returncode == 0, clean.stdout + clean.stderr
+
+
+# ------------------------------------- lockset race detection (pass 16)
+
+
+def test_race004_names_the_exact_lockspec_on_declaration_strip():
+    """The inference gap: strip ONE ``# guarded-by: _lock`` declaration
+    from serve/fleet.py (in memory) and the race pass reports that the
+    attribute is consistently locked but undeclared — naming the exact
+    lockspec to add back. The pristine file is clean."""
+    path = os.path.join(PACKAGE, "serve", "fleet.py")
+    src = open(path).read()
+    assert not analysis.check_source(src, path="fleet.py", passes=("races",))
+    mutated = src.replace(
+        "self._version = version  # guarded-by: _lock",
+        "self._version = version",
+    )
+    assert mutated != src
+    findings = analysis.check_source(
+        mutated, path="fleet.py", passes=("races",)
+    )
+    assert any(
+        f.code == "RACE004"
+        and "Replica._version" in f.message
+        and "'# guarded-by: _lock'" in f.message
+        for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def test_deleting_lock_and_declaration_trips_race001():
+    """The motivating blind spot: delete BOTH the lock region and the
+    ``# guarded-by:`` declaration (plus the adjacent waiver a careless
+    refactor would sweep away too) and the opt-in lock pass goes silent
+    — but the race pass still reports the now-unlocked shared write."""
+    path = os.path.join(PACKAGE, "serve", "fleet.py")
+    src = open(path).read()
+    mutated = src.replace(
+        "self._version = version  # guarded-by: _lock",
+        "self._version = version",
+    )
+    before = (
+        "        gen = self.router.install(DEFAULT_POLICY, params)\n"
+    )
+    region = (
+        before
+        + "        # lint: race-ok(deliberate check-then-act: install is"
+        " a device transfer and must not run under _lock; sync has a"
+        " single caller — the fleet tick — so the version check cannot"
+        " be invalidated between the regions)\n"
+        "        with self._lock:\n"
+        "            self._version = version\n"
+        "            self._gen_version[gen] = version\n"
+    )
+    hoisted = (
+        before
+        + "        self._version = version\n"
+        "        with self._lock:\n"
+        "            self._gen_version[gen] = version\n"
+    )
+    assert region in mutated
+    mutated = mutated.replace(region, hoisted)
+    race = analysis.check_source(mutated, path="fleet.py", passes=("races",))
+    assert any(
+        f.code == "RACE001" and "Replica._version" in f.message
+        for f in race
+    ), "\n".join(f.render() for f in race)
+    # The lock pass sees nothing: the declaration is gone, so the write
+    # it would have flagged is invisible — exactly the gap pass 16 closes.
+    assert not analysis.check_source(
+        mutated, path="fleet.py", passes=("locks",)
+    )
+
+
+def test_dropping_a_wait_loops_while_trips_race003():
+    """Neutering SLOGate.admit's while-recheck loop (``while True`` ->
+    ``if True``) makes its ``_cond.wait`` a naked wait; the race pass
+    flags it because the HTTP-handler roots in serve/gateway.py reach
+    the admission gate. The pristine file set is clean."""
+    from asyncrl_tpu.analysis import core
+
+    paths = [
+        os.path.join(PACKAGE, "serve", p)
+        for p in ("gateway.py", "scheduler.py", "slo.py", "router.py",
+                  "params.py")
+    ]
+    modules = [core.SourceModule(p, open(p).read()) for p in paths]
+    assert not analysis.run_passes(core.Project(modules), ("races",))
+    slo_path = paths[2]
+    src = open(slo_path).read()
+    mutated = src.replace(
+        "            while True:\n", "            if True:\n", 1
+    )
+    assert mutated != src
+    modules[2] = core.SourceModule(slo_path, mutated)
+    findings = analysis.run_passes(core.Project(modules), ("races",))
+    assert any(
+        f.code == "RACE003" and "SLOGate.admit" in f.message
+        for f in findings
+    ), "\n".join(f.render() for f in findings)
+
+
+def _racy_tree(tmp_path, waived=False):
+    waiver = "  # lint: race-ok(test fixture: benign tally)" if waived else ""
+    (tmp_path / "tally.py").write_text(
+        textwrap.dedent(
+            f"""
+            import threading
+
+            class Tally:
+                def __init__(self):
+                    self.count = 0{waiver}
+
+                def start(self):
+                    threading.Thread(target=self._work, daemon=True).start()
+
+                def _work(self):
+                    self.count += 1
+
+                def read(self):
+                    return self.count
+            """
+        )
+    )
+    (tmp_path / "other.py").write_text("def helper(x):\n    return x\n")
+
+
+def test_race_findings_survive_partial_and_warm_cache_runs(tmp_path):
+    """RACE is a global code family: the warm manifest must replay it
+    and a partial cached run (edit elsewhere) must re-emit it — a cached
+    run silently dropping the race would break the soundness contract."""
+    tree, cache_dir = tmp_path / "src", tmp_path / "cache"
+    tree.mkdir()
+    _racy_tree(tree)
+    cold = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert any(f.code == "RACE001" for f in cold.findings)
+    warm = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert warm.stats["cache"] == "warm"
+    assert any(f.code == "RACE001" for f in warm.findings)
+    with open(tree / "other.py", "a") as fh:
+        fh.write("# comment-only edit\n")
+    partial = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert partial.stats["cache"] == "partial"
+    assert any(f.code == "RACE001" for f in partial.findings), (
+        "partial cached run dropped the global RACE001 finding"
+    )
+
+
+def test_stripping_a_race_waiver_resurfaces_on_a_cached_run(tmp_path):
+    """The other direction: a waived tree caches clean, and removing the
+    ``race-ok`` waiver (a comment-only edit) must resurface RACE001 on
+    the very next cached run."""
+    tree, cache_dir = tmp_path / "src", tmp_path / "cache"
+    tree.mkdir()
+    _racy_tree(tree, waived=True)
+    clean = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert not any(f.code.startswith("RACE") for f in clean.findings)
+    src = (tree / "tally.py").read_text()
+    (tree / "tally.py").write_text(
+        src.replace("  # lint: race-ok(test fixture: benign tally)", "")
+    )
+    after = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert any(f.code == "RACE001" for f in after.findings)
+
+
+def test_stats_report_per_pass_wall_time(tmp_path):
+    """--stats satellite: a run that executes passes reports per-pass
+    wall seconds for exactly the passes that ran; a warm replay reports
+    an empty map ("nothing ran", never "everything was instant")."""
+    tree, cache_dir = tmp_path / "src", tmp_path / "cache"
+    tree.mkdir()
+    _mini_tree(tree)
+    cold = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert set(cold.stats["pass_wall_s"]) == set(analysis.PASSES)
+    assert all(t >= 0.0 for t in cold.stats["pass_wall_s"].values())
+    warm = analysis.run_analysis([str(tree)], cache_dir=str(cache_dir))
+    assert warm.stats["cache"] == "warm"
+    assert warm.stats["pass_wall_s"] == {}

@@ -344,7 +344,12 @@ def test_slo_gate_close_wakes_a_waiting_admitter():
     t = threading.Thread(target=waiter, daemon=True)
     t.start()
     assert parked.wait(5.0)
-    time.sleep(0.05)  # small settle so the admit is parked, not pre-call
+    # Observe the park instead of guessing with a settle: close() must
+    # exercise the wake-from-wait path, not the admit-entry precheck.
+    deadline = time.monotonic() + 5.0
+    while not gate._cond._waiters:
+        assert time.monotonic() < deadline, "admitter never parked"
+        time.sleep(0.001)
     gate.close()
     t.join(timeout=5.0)
     assert not t.is_alive() and "e" in err

@@ -56,6 +56,18 @@ def _join(core, stop):
     assert not core.is_alive()
 
 
+def _poll_until(predicate, what, timeout_s=5.0):
+    """Deadline-bounded poll on a real state predicate — the deflake
+    companion to the parked-Event join: instead of sleeping and hoping
+    the blocked thread reached its wait, observe that it did."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.001)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
 # --------------------------------------------------------- ParamSlots units
 
 
@@ -260,7 +272,10 @@ def test_slo_gate_backpressure_unblocks_on_completion():
     t = threading.Thread(target=admit_third, name="slo-admitter", daemon=True)
     t.start()
     assert parked.wait(5.0)
-    time.sleep(0.05)  # small settle: a buggy pass-through needs a beat
+    # A buggy pass-through never parks in _cond.wait — this poll times
+    # out instead of racing a fixed settle against the admit.
+    _poll_until(lambda: gate._cond._waiters,
+                "the admitter to park in _cond.wait")
     assert not released, "third admit must backpressure, not pass"
     gate.finished(50.0)  # completion refills one token
     t.join(timeout=5.0)
@@ -336,7 +351,8 @@ def test_slo_gate_reopen_wakes_blocked_admitters():
     t = threading.Thread(target=blocked, name="reopen-admitter", daemon=True)
     t.start()
     assert parked.wait(5.0)
-    time.sleep(0.05)  # small settle: a buggy pass-through needs a beat
+    _poll_until(lambda: gate._cond._waiters,
+                "the admitter to park at the inflight cap")
     assert not outcome, "must be parked at the inflight cap"
     gate.close()
     t.join(timeout=5.0)

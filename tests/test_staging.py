@@ -24,6 +24,18 @@ from asyncrl_tpu.rollout.staging import (
 from asyncrl_tpu.utils.config import Config
 
 
+def _poll_until(predicate, what, timeout_s=5.0):
+    """Deadline-bounded poll on a real state predicate — the deflake
+    companion to the parked-Event join: instead of sleeping and hoping
+    the blocked thread reached its wait, observe that it did."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.001)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
 def _template(T=4, B=3, obs=(4,), track_returns=False):
     cfg = Config(
         unroll_len=T, precision="f32", normalize_returns=track_returns
@@ -111,7 +123,8 @@ def test_no_reuse_before_transfer_complete():
     )
     t.start()
     assert parked.wait(5.0)
-    time.sleep(0.05)  # small settle: a buggy re-lease needs a beat
+    _poll_until(lambda: ring.reuse_waits >= 1,
+                "the acquirer to enter the blocked reuse wait")
     assert not got, "slab re-leased while its transfer was still in flight"
     handles[0].set_ready()
     t.join(timeout=5)
@@ -258,7 +271,8 @@ def test_ring_swap_wakes_blocked_acquirer_onto_new_ring():
     t = threading.Thread(target=blocked, name="swap-acquirer", daemon=True)
     t.start()
     assert parked.wait(5.0)
-    time.sleep(0.05)  # small settle: a buggy pass-through needs a beat
+    _poll_until(lambda: old.reuse_waits >= 1,
+                "the acquirer to block on the exhausted old ring")
     assert not got, "acquire should be blocked on the exhausted old ring"
     new = StagingRing(_template(), rows_per_slab=1, num_slabs=2)
     holder.swap(new)
