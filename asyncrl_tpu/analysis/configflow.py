@@ -51,6 +51,7 @@ KNOWN_ENV_VARS = {
     "ASYNCRL_CHAOS_STEPS",    # scripts/chaos_smoke.sh harness sizing
     "ASYNCRL_TRACE",          # obs/trace.py — arm pipeline tracing
     "ASYNCRL_TRACE_RING",     # obs/trace.py — per-thread ring capacity
+    "ASYNCRL_REQUEST_TRACE",  # obs/requests.py — request hop journaling
     "ASYNCRL_RUN_DIR",        # obs/__init__.py — observability output dir
     "ASYNCRL_TRACE_TOLERANCE",  # scripts/trace_smoke.sh overhead threshold
     "ASYNCRL_REPLAY",         # api/sebulba_trainer.py — replay-ring depth

@@ -51,6 +51,7 @@ import time
 from asyncrl_tpu.obs import export, flightrec, introspect, registry, trace
 from asyncrl_tpu.obs import health as health_mod
 from asyncrl_tpu.obs import http as http_mod
+from asyncrl_tpu.obs import requests as requests_mod
 from asyncrl_tpu.obs import timeseries as timeseries_mod
 
 # Process-wide export sequence: two agents sharing a run_dir (A/B
@@ -72,6 +73,26 @@ def _default_run_dir(config) -> str:
     return os.path.join(
         "runs", f"{slug}-{config.algo}-s{config.seed}-{stamp}-{os.getpid()}"
     )
+
+
+def _arm_requests(config, run_dir: str | None) -> None:
+    """Arm/disarm request hop journaling (obs/requests.py) per
+    ``config.request_trace``, ``ASYNCRL_REQUEST_TRACE`` winning when set
+    — the trace-arming precedence. Off DISARMS any predecessor's store
+    (fresh-agent semantics); on with no run_dir keeps the recent ring and
+    span emission but persists no ``requests.jsonl``."""
+    env = requests_mod.env_requests()
+    on = bool(config.request_trace) if env is None else env
+    if on:
+        requests_mod.arm(
+            run_dir=run_dir,
+            cap=config.request_journal_cap,
+            slow_ms=config.request_sample_slow_ms,
+            meta={"env_id": config.env_id, "algo": config.algo,
+                  "seed": config.seed},
+        )
+    else:
+        requests_mod.disarm()
 
 
 def _platform() -> str | None:
@@ -217,6 +238,13 @@ def setup(config) -> PipelineObs:
         # agent must never dump forensics into an OLD agent's run_dir
         # with the old agent's config embedded (faults.arm("") precedent).
         flightrec.disarm()
+        # Request journaling is orthogonal to span tracing: a serving
+        # deployment may want hop journals without paying for ring
+        # tracing, so it arms even on this early-return path.
+        _arm_requests(
+            config,
+            os.environ.get("ASYNCRL_RUN_DIR") or config.run_dir or None,
+        )
         return PipelineObs(False, None, None, introspect_on=intro)
     if enabled:
         run_dir = (
@@ -234,6 +262,7 @@ def setup(config) -> PipelineObs:
         flightrec.disarm()
         recorder = None
         run_dir = os.environ.get("ASYNCRL_RUN_DIR") or config.run_dir or None
+    _arm_requests(config, run_dir)
     thresholds = health_mod.Thresholds.from_config(config)
     store = timeseries_mod.TimeSeriesStore(
         capacity=config.obs_timeseries_cap,

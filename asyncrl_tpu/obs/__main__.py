@@ -9,6 +9,10 @@ section is analyzed). ``validate`` checks the trace_event schema
 timeseries + forensics into a health report (detector timeline,
 bottleneck attribution, BENCH_HISTORY regression verdict) and exits 1 on
 a throughput regression — the gate ``scripts/health_smoke.sh`` runs.
+``explain`` renders request hop journals from a run_dir's
+``requests.jsonl`` as budget waterfalls — one journal by trace id, or
+the ``--worst N`` set (non-200 verdicts first, then by latency); exits 2
+when the file or the trace id is missing.
 """
 
 from __future__ import annotations
@@ -20,6 +24,7 @@ import sys
 from asyncrl_tpu.obs import doctor as doctor_mod
 from asyncrl_tpu.obs import export as export_mod
 from asyncrl_tpu.obs import flightrec, report
+from asyncrl_tpu.obs import requests as requests_mod
 
 
 def _load_trace_doc(path: str) -> tuple[dict, bool]:
@@ -83,7 +88,32 @@ def main(argv: list[str] | None = None) -> int:
         help="ledger path (default: BENCH_HISTORY.json, or "
         "ASYNCRL_BENCH_HISTORY when set)",
     )
+    p_explain = sub.add_parser(
+        "explain",
+        help="request budget waterfalls from a run_dir's requests.jsonl "
+        "(one trace id, or --worst N; exits 2 when missing)",
+    )
+    p_explain.add_argument(
+        "trace_id", nargs="?", default=None,
+        help="wire trace id (X-Trace-Id) of the journal to render; omit "
+        "with --worst to rank instead",
+    )
+    p_explain.add_argument(
+        "run_dir", help="run directory holding requests.jsonl"
+    )
+    p_explain.add_argument(
+        "--worst", type=int, default=0,
+        help="render the N worst journals (non-200 first, then by "
+        "latency) instead of one trace id",
+    )
     args = parser.parse_args(argv)
+
+    if args.cmd == "explain":
+        text, code = requests_mod.explain(
+            args.run_dir, trace_id=args.trace_id, worst=args.worst
+        )
+        print(text, file=sys.stderr if code == 2 else sys.stdout)
+        return code
 
     if args.cmd == "doctor":
         text, code = doctor_mod.diagnose(

@@ -15,10 +15,16 @@ span export) — into one report:
    memory watermarks — first/last/min/max per metric, plus every
    recorded compile event with its static-shape blame. The offline
    replay of what the introspection layer measured live.
-3. **Bottleneck attribution**: the stall-attribution table from the run's
+3. **Serving timeline**: the serving-side story — cumulative gateway/
+   fleet counters (shed, deadline shed, failover, canary promote/
+   rollback) from the windows, and, when the run journaled requests
+   (``requests.jsonl``), the deciding-stage census for every non-200,
+   per-stage duration percentiles across all hops, and the worst
+   journals' budget waterfalls inlined (the ``obs explain`` shape).
+4. **Bottleneck attribution**: the stall-attribution table from the run's
    newest trace export (falling back to the newest flight dump's embedded
    trace) — the ``obs report`` analysis inlined.
-4. **Regression verdict**: the run's best window throughput against the
+5. **Regression verdict**: the run's best window throughput against the
    matching BENCH_HISTORY.json rows (preset- and platform-matched,
    newest row wins) with a tolerance fraction — "did this PR regress
    perf" as a command, not archaeology.
@@ -190,6 +196,81 @@ def learning_timeline(
     return lines
 
 
+# Serving-side counters the serving-timeline section surfaces (window
+# samples carry them cumulatively; only non-zero keys are shown).
+SERVING_KEYS = (
+    "gateway_requests", "gateway_errors", "gateway_shed",
+    "gateway_deadline_shed", "gateway_stale_served",
+    "gateway_fallback_served", "gateway_netfaults", "fleet_failovers",
+    "fleet_ejections", "fleet_readmissions", "fleet_promotions",
+    "fleet_rollbacks", "fleet_replica_restarts", "request_journals",
+    "request_journals_persisted", "request_journals_capped",
+)
+
+
+def serving_timeline(
+    run_dir: str, samples: list[dict[str, Any]]
+) -> list[str]:
+    """The serving-timeline section lines: shed/failover/canary counters
+    from the windows, plus — when the run journaled requests — the
+    deciding-stage census, per-stage duration percentiles, and the worst
+    journals' budget waterfalls from ``requests.jsonl``."""
+    from asyncrl_tpu.obs import requests as requests_mod
+
+    lines: list[str] = []
+    any_counter = False
+    for key in SERVING_KEYS:
+        values = timeseries.series_of(samples, key)
+        if not values or max(values) <= 0:
+            continue
+        any_counter = True
+        lines.append(f"{key:<28} last {values[-1]:>10.0f}")
+    if not any_counter:
+        lines.append("no serving traffic recorded in the timeseries")
+    path = os.path.join(run_dir, requests_mod.FILENAME)
+    if not os.path.exists(path):
+        lines.append(
+            "no requests.jsonl: request journaling was off "
+            "(config.request_trace / ASYNCRL_REQUEST_TRACE)"
+        )
+        return lines
+    docs = requests_mod.read_jsonl(path)["requests"]
+    if not docs:
+        lines.append("requests.jsonl holds no finished journals")
+        return lines
+    non200 = sum(1 for d in docs if int(d.get("status", 0)) != 200)
+    lines.append(f"-- {len(docs)} journaled request(s), {non200} non-200 --")
+    deciders: dict[str, int] = {}
+    for d in docs:
+        if int(d.get("status", 0)) != 200:
+            key = str(d.get("decided_by") or "?")
+            deciders[key] = deciders.get(key, 0) + 1
+    for key in sorted(deciders, key=lambda k: -deciders[k]):
+        lines.append(f"decided_by {key:<24} {deciders[key]:>6}")
+    stage_durs: dict[str, list[float]] = {}
+    for d in docs:
+        for hop in d.get("hops", ()):
+            stage_durs.setdefault(str(hop.get("stage", "?")), []).append(
+                float(hop.get("dur_ms", 0.0))
+            )
+    if stage_durs:
+        lines.append("per-stage dur_ms:            count       p50       "
+                     "p95       max")
+        for stage in sorted(stage_durs):
+            vals = sorted(stage_durs[stage])
+            p50 = vals[max(0, min(len(vals) - 1, int(0.50 * len(vals))))]
+            p95 = vals[max(0, min(len(vals) - 1, int(0.95 * len(vals))))]
+            lines.append(
+                f"{stage:<26} {len(vals):>7}  {p50:>8.1f}  {p95:>8.1f}"
+                f"  {vals[-1]:>8.1f}"
+            )
+    text, code = requests_mod.explain(run_dir, worst=3)
+    if code == 0:
+        lines.append("-- worst journals (obs explain --worst 3) --")
+        lines.extend(text.splitlines())
+    return lines
+
+
 def _timeline(
     recorded: list[dict[str, Any]], replayed: list[health.HealthEvent]
 ) -> list[dict[str, Any]]:
@@ -263,6 +344,10 @@ def diagnose(
     lines.append("")
     lines.append("== learning timeline ==")
     lines.extend(learning_timeline(samples, recorded))
+
+    lines.append("")
+    lines.append("== serving timeline ==")
+    lines.extend(serving_timeline(run_dir, samples))
 
     lines.append("")
     lines.append("== bottleneck attribution ==")

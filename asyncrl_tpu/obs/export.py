@@ -36,8 +36,12 @@ def to_trace_events(
             "tid": tid,
             "args": {"name": snap["thread"], "group": snap["group"]},
         })
-        for name, start, end in snap["spans"]:
-            events.append({
+        for span in snap["spans"]:
+            # 3-tuple (name, start, end), or 4-tuple with a meta dict —
+            # request-journal replay spans carry their trace id, which
+            # lands in Perfetto's args pane.
+            name, start, end = span[0], span[1], span[2]
+            event = {
                 "ph": "X",
                 "name": name,
                 "cat": span_names.stage_of(name),
@@ -45,7 +49,10 @@ def to_trace_events(
                 "tid": tid,
                 "ts": max(0.0, (start - anchor_perf) * 1e6),
                 "dur": max(0.0, (end - start) * 1e6),
-            })
+            }
+            if len(span) > 3 and span[3]:
+                event["args"] = dict(span[3])
+            events.append(event)
     return {
         "schema": SCHEMA,
         "displayTimeUnit": "ms",

@@ -32,8 +32,14 @@ import time
 from typing import Any
 
 from asyncrl_tpu.obs import export, registry, trace
+from asyncrl_tpu.obs import requests as requests_mod
 
 SCHEMA = "asyncrl-flightrec-v1"
+
+# Wire-facing failure reasons whose dumps embed the recent request hop
+# journals (obs/requests.py): the forensics for "which requests were in
+# flight and why did they end that way" live next to the spans.
+_REQUEST_REASONS = ("netfault", "replica", "gateway")
 
 _STOP = object()
 _SAFE_REASON = re.compile(r"[^A-Za-z0-9_.-]+")
@@ -94,6 +100,10 @@ class FlightRecorder:
             "counters": _all_counters(),
             "extra": extra or {},
         }
+        if any(k in reason for k in _REQUEST_REASONS):
+            # [] when request journaling is disarmed — the off-is-off
+            # discipline leaves the dump shape stable but empty.
+            doc["requests"] = requests_mod.recent()
         if tracer is not None:
             cutoff = time.perf_counter() - self.window_s
             snaps = tracer.snapshots()

@@ -79,13 +79,27 @@ def render_prometheus(values: Mapping[str, Any]) -> str:
     """Prometheus text exposition (gauge-typed) for a flat metrics dict.
     Keys sanitize to ``asyncrl_<name>`` metric names; non-numeric values
     (e.g. the ``health_status`` string) are skipped — ``/healthz`` owns
-    the categorical story."""
+    the categorical story.
+
+    A key may carry a label suffix — ``fleet_replica_staleness
+    {replica="r0"}`` (no space) — in which case only the base sanitizes
+    and the labels pass through, rendering a labeled series; one TYPE
+    line is emitted per family, so the ``{replica=...}`` series of one
+    base share it."""
     lines: list[str] = []
+    typed: set[str] = set()
     for key in sorted(values):
         value = values[key]
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             continue
-        name = "asyncrl_" + _METRIC_NAME.sub("_", str(key))
+        key = str(key)
+        labels = ""
+        if "{" in key and key.endswith("}"):
+            base, raw = key.split("{", 1)
+            labels = "{" + raw
+        else:
+            base = key
+        name = "asyncrl_" + _METRIC_NAME.sub("_", base)
         value = float(value)
         if math.isfinite(value):
             rendered = f"{value:g}"
@@ -95,8 +109,10 @@ def render_prometheus(values: Mapping[str, Any]) -> str:
             rendered = "NaN" if math.isnan(value) else (
                 "+Inf" if value > 0 else "-Inf"
             )
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {rendered}")
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {rendered}")
     return "\n".join(lines) + "\n"
 
 

@@ -89,8 +89,12 @@ class Histogram:
         self._count = 0  # guarded-by: _lock
         self._sum = 0.0  # guarded-by: _lock
         self._max = 0.0  # guarded-by: _lock
+        # Per-bucket last exemplar (a request trace id): populated lazily
+        # only when an observation carries one, so histograms without
+        # exemplars stay allocation-free and the window shape unchanged.
+        self._exemplars: dict[int, str] = {}  # guarded-by: _lock
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         i = 0
         for i, bound in enumerate(self.buckets):  # noqa: B007
             if value <= bound:
@@ -103,6 +107,20 @@ class Histogram:
             self._sum += value
             if value > self._max:
                 self._max = value
+            if exemplar:
+                self._exemplars[i] = exemplar
+
+    def exemplars(self) -> dict[float, str]:
+        """Bucket upper bound -> last exemplar observed into that bucket
+        (the overflow bucket keys on +inf). Empty unless observations
+        carried exemplars — a p99 breach in the summary links here to a
+        concrete request journal."""
+        with self._lock:
+            items = list(self._exemplars.items())
+        return {
+            (self.buckets[i] if i < len(self.buckets) else float("inf")): ex
+            for i, ex in items
+        }
 
     def _quantile_locked(self, q: float) -> float:  # holds: _lock
         if self._count == 0:

@@ -1,0 +1,580 @@
+"""Request-scoped hop journals: deadline-budget accounting on the wire.
+
+Aggregate counters and window percentiles (``serve_latency_ms`` and the
+gateway histograms) answer "how is the fleet doing?" — they cannot answer
+"where did THIS request's 50 ms go, and why was it shed?". This module
+gives every gateway request a **hop journal**: a wire-propagated trace id
+(``X-Trace-Id``, generated at the client, echoed in responses) plus an
+ordered record of each stage the request crossed — rate-bucket verdict,
+tenant admission wait, per-replica failover attempts with their budget
+shares, canary assignment, the scheduler's admission/batch-fill/dispatch
+phases — each hop stamped with its **budget remaining at entry** so the
+rendered timeline reads as a waterfall ("admitted at 46 ms remaining,
+batch-fill held 9 ms, shed by slo-gate").
+
+Journal invariants (what the tests gate):
+
+- **Level-0 hops partition the request.** The gateway records contiguous
+  level-0 segments (each new segment starts where the previous ended) and
+  :meth:`RequestJournal.finish` closes the tail, so level-0 durations sum
+  to the journal latency *exactly* (float slack only). Nested detail —
+  fleet attempts (level 1), scheduler phases (level 2) — overlaps its
+  parent and is excluded from the sum.
+- **One journal, N attempts.** The journal is bound to the gateway
+  handler thread (:func:`bind`); the fleet router and scheduler pick it
+  up via :func:`current` — retries and failover hops append to the same
+  journal, never fork a new one.
+- **Every non-200 names its deciding stage.** ``finish(status, stage)``
+  records the stage that produced the verdict (``gateway.rate_bucket``,
+  ``serve.slo_gate``, ``serve.dispatch_grace``, ...) as ``decided_by``.
+- **Off is off.** With no armed store, :func:`begin` returns None and
+  every hook degrades to a thread-local read + ``None`` check — no
+  allocation, no registry keys, no file handles (the ``trace.py``
+  compile-away discipline).
+
+Persistence mirrors ``timeseries.jsonl``: slow/shed journals append one
+JSON line each to ``<run_dir>/requests.jsonl`` (line-buffered, non-finite
+floats as strings, torn-tail-tolerant reader, last run segment wins),
+sampled by ``request_sample_slow_ms`` and budget-bounded by
+``request_journal_cap``. Finished journals also emit their hops as
+trace-id-stamped spans into the per-thread rings (Perfetto export) and
+feed a bounded in-memory ring the flight recorder embeds into
+netfault/replica/gateway dumps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from asyncrl_tpu.obs import registry, trace
+from asyncrl_tpu.obs.timeseries import decode_tree, encode_tree
+
+SCHEMA = "asyncrl-requests-v1"
+FILENAME = "requests.jsonl"
+ENV_VAR = "ASYNCRL_REQUEST_TRACE"
+_FALSEY = ("", "0", "false", "no")
+
+DEFAULT_JOURNAL_CAP = 512
+DEFAULT_SLOW_MS = 0.0  # <= 0: every finished journal is persist-eligible
+# In-memory bound on finished journals (flight-recorder embeds, explain
+# on a live store); the JSONL keeps the sampled history.
+RECENT_CAPACITY = 32
+# Spans emitted per journal are bounded by the hop count, which is itself
+# bounded by the fleet size (attempts) + fixed stage vocabulary.
+
+# Journal stage vocabulary (level-0 gateway segments + nested detail).
+STAGE_PARSE = "gateway.parse"
+STAGE_ADMIT = "gateway.admit"
+STAGE_SERVE = "gateway.serve"
+STAGE_RESPOND = "gateway.respond"
+STAGE_ATTEMPT = "fleet.attempt"
+STAGE_CORE_ADMIT = "serve.admit"
+STAGE_BATCH_FILL = "serve.batch_fill"
+STAGE_DISPATCH = "serve.dispatch"
+
+# Deciding stages (``decided_by`` vocabulary) for non-200 verdicts.
+DECIDED_PARSE = "gateway.parse"
+DECIDED_NETFAULT = "gateway.netfault"
+DECIDED_DRAIN = "gateway.drain"
+DECIDED_DEADLINE = "gateway.deadline"
+DECIDED_RATE_BUCKET = "gateway.rate_bucket"
+DECIDED_TENANT_GATE = "gateway.tenant_gate"
+DECIDED_DEGRADE = "gateway.degrade"
+DECIDED_BACKEND_ERROR = "gateway.backend_error"
+DECIDED_SLO_GATE = "serve.slo_gate"
+DECIDED_DISPATCH_GRACE = "serve.dispatch_grace"
+DECIDED_FLEET = "fleet.exhausted"
+DECIDED_SERVED = "served"
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char wire trace id (client-side generation)."""
+    return os.urandom(8).hex()
+
+
+class RequestJournal:
+    """One request's hop record, rooted at the gateway handler.
+
+    Single-writer by contract: hops are appended by the handler thread
+    that owns the request (the scheduler's serve thread hands its stamps
+    back through the ``_Request`` event handshake, so even core-phase
+    hops are recorded handler-side). Absolute times are
+    ``time.perf_counter()`` — the span rings' clock — so emitted spans
+    land on the exporter's anchor.
+    """
+
+    __slots__ = ("trace_id", "endpoint", "tenant", "policy", "deadline_ms",
+                 "t0", "hops", "status", "decided_by", "cause",
+                 "latency_ms", "_cursor", "_done")
+
+    def __init__(self, trace_id: str, endpoint: str = "",
+                 deadline_ms: float = 0.0, tenant: str = "",
+                 policy: str = ""):  # budget: deadline_ms
+        self.trace_id = trace_id
+        self.endpoint = endpoint
+        self.tenant = tenant
+        self.policy = policy
+        self.deadline_ms = float(deadline_ms)
+        self.t0 = time.perf_counter()
+        # lint: race-ok(single-writer by contract: only the owning handler thread appends hops; the scheduler's serve thread hands stamps back through the _Request event handshake, never touching the journal)
+        self.hops: list[dict[str, Any]] = []
+        self.status = 0
+        self.decided_by = ""
+        self.cause = ""
+        self.latency_ms = 0.0
+        # end of the last level-0 segment
+        # lint: race-ok(single-writer by contract: advanced only by the owning handler thread's level-0 segments)
+        self._cursor = self.t0
+        self._done = False
+
+    def annotate(self, tenant: str = "", policy: str = "",
+                 deadline_ms: float = 0.0) -> None:  # budget: deadline_ms
+        """Backfill request identity once the gateway has parsed it. A
+        method rather than bare attribute assignment at the call site:
+        the journal local is untyped there, and a cross-module attribute
+        write on an untyped receiver is exactly what the race pass's
+        unique-name attribution would pin to the wrong class."""
+        if tenant:
+            self.tenant = tenant
+        if policy:
+            self.policy = policy
+        if deadline_ms:
+            self.deadline_ms = float(deadline_ms)
+
+    def budget_remaining_ms(self, at: float | None = None) -> float:
+        """Wire budget left at ``at`` (perf stamp; now when omitted) —
+        negative once the deadline is overdrawn, deliberately unclamped
+        so the waterfall shows the overdraft."""
+        t = time.perf_counter() if at is None else at
+        return self.deadline_ms - 1e3 * (t - self.t0)
+
+    def hop(self, stage: str, t_enter: float, t_exit: float,
+            level: int = 1, cause: str = "", **extra: Any) -> None:
+        """Append one hop. ``level`` 0 = gateway segment (sums to the
+        latency), 1 = fleet attempt, 2 = scheduler phase (nested detail,
+        excluded from the sum)."""
+        row: dict[str, Any] = {
+            "stage": stage,
+            "t_ms": 1e3 * (t_enter - self.t0),
+            "dur_ms": max(0.0, 1e3 * (t_exit - t_enter)),
+            "budget_ms": self.budget_remaining_ms(t_enter),
+            "level": level,
+            "_t0": t_enter,
+            "_t1": t_exit,
+        }
+        if cause:
+            row["cause"] = cause
+        for key, value in extra.items():
+            row[key] = value
+        self.hops.append(row)
+        if level == 0:
+            self._cursor = t_exit
+
+    def seg(self, stage: str, cause: str = "", **extra: Any) -> None:
+        """Close the current level-0 segment at now, named ``stage``.
+        Segments are contiguous by construction (each starts at the
+        previous segment's end), which is what makes the level-0
+        durations sum to the journal latency."""
+        now = time.perf_counter()
+        self.hop(stage, self._cursor, now, level=0, cause=cause, **extra)
+
+    def finish(self, status: int, stage: str, cause: str = "") -> None:
+        """Close the journal: the tail becomes a final level-0 segment
+        named ``stage`` (the verdict's deciding stage for non-200s), and
+        the finished journal is committed to the armed store (span
+        emission, sampling, persistence). Idempotent — only the first
+        verdict sticks."""
+        if self._done:
+            return
+        self._done = True
+        self.seg(stage, cause=cause)
+        self.status = int(status)
+        self.decided_by = stage if status != 200 else DECIDED_SERVED
+        self.cause = cause
+        self.latency_ms = 1e3 * (self._cursor - self.t0)
+        store = active()
+        if store is not None:
+            store.commit(self)
+
+    def to_doc(self) -> dict[str, Any]:
+        """The persisted/embedded shape (relative-ms hops, no perf
+        stamps)."""
+        hops = []
+        for row in self.hops:
+            hops.append({k: v for k, v in row.items()
+                         if not k.startswith("_")})
+        return {
+            "trace_id": self.trace_id,
+            "endpoint": self.endpoint,
+            "tenant": self.tenant,
+            "policy": self.policy,
+            "deadline_ms": self.deadline_ms,
+            "status": self.status,
+            "decided_by": self.decided_by,
+            "cause": self.cause,
+            "latency_ms": self.latency_ms,
+            "hops": hops,
+        }
+
+
+class JournalStore:
+    """The armed journal collector: bounded recent ring + sampled JSONL.
+
+    ``commit`` is called from gateway handler threads (plural), so the
+    ring/counters/file mutate under ``_lock`` — journals finish at
+    request rate, not window rate, and the critical section is a deque
+    append plus one buffered write.
+    """
+
+    def __init__(self, run_dir: str | None = None,
+                 cap: int = DEFAULT_JOURNAL_CAP,
+                 slow_ms: float = DEFAULT_SLOW_MS,
+                 meta: dict[str, Any] | None = None):
+        self.cap = max(0, int(cap))
+        self.slow_ms = float(slow_ms)
+        self.persist_path = (
+            os.path.join(run_dir, FILENAME) if run_dir else None
+        )
+        self._lock = threading.Lock()
+        self._recent: deque[dict[str, Any]] = deque(
+            maxlen=RECENT_CAPACITY
+        )  # guarded-by: _lock
+        self._persisted = 0  # guarded-by: _lock
+        self._f = None  # guarded-by: _lock
+        self._c_finished = registry.counter("request_journals")
+        self._c_persisted = registry.counter("request_journals_persisted")
+        self._c_capped = registry.counter("request_journals_capped")
+        if self.persist_path:
+            parent = os.path.dirname(self.persist_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            f = open(self.persist_path, "a", buffering=1)
+            with self._lock:
+                self._f = f
+                self._write_line(
+                    {"kind": "meta", "schema": SCHEMA, "t": time.time(),
+                     "pid": os.getpid(), "run": dict(meta or {})}
+                )
+
+    def _write_line(self, row: dict[str, Any]) -> None:  # holds: _lock
+        if self._f is None:
+            return
+        try:
+            line = json.dumps(encode_tree(row), default=str,
+                              allow_nan=False)
+        except (TypeError, ValueError) as e:
+            print(f"requests: row not serializable: {e}", file=sys.stderr)
+            return
+        try:
+            self._f.write(line + "\n")
+        except (OSError, ValueError) as e:
+            # Best-effort persistence (the timeseries discipline): a full
+            # disk must never fail a request on the serving path.
+            print(f"requests: persist failed: {e}", file=sys.stderr)
+            self._f = None
+
+    def _emit_spans(self, journal: RequestJournal) -> None:
+        """Replay the hops as ``request.*`` spans into the calling
+        thread's ring, trace-id-stamped, in pre-order (enter asc, exit
+        desc) so the per-thread nesting invariant the report relies on
+        holds."""
+        tracer = trace.active()
+        if tracer is None:
+            return
+        meta = {"trace_id": journal.trace_id}
+        ordered = sorted(journal.hops,
+                         key=lambda h: (h["_t0"], -h["_t1"]))
+        for row in ordered:
+            trace.record_span(f"request.{row['stage']}", row["_t0"],
+                              row["_t1"], meta=meta)
+
+    def commit(self, journal: RequestJournal) -> None:
+        """Accept one finished journal (any handler thread)."""
+        self._emit_spans(journal)
+        doc = journal.to_doc()
+        self._c_finished.inc()
+        persist = (
+            journal.status != 200
+            or self.slow_ms <= 0.0
+            or journal.latency_ms >= self.slow_ms
+        )
+        with self._lock:
+            self._recent.append(doc)
+            if persist and self._f is not None:
+                if self._persisted < self.cap:
+                    self._persisted += 1
+                    self._write_line(
+                        {"kind": "request", "t": time.time(),
+                         "request": doc}
+                    )
+                    self._c_persisted.inc()
+                else:
+                    # Budget-bounded: past the cap the JSONL stays fixed
+                    # size; the recent ring and counters keep moving.
+                    self._c_capped.inc()
+
+    def recent(self, n: int = RECENT_CAPACITY) -> list[dict[str, Any]]:
+        """Newest-last copies of the most recent finished journals."""
+        with self._lock:
+            docs = list(self._recent)
+        return docs[-n:]
+
+    def close(self) -> None:
+        with self._lock:
+            f, self._f = self._f, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+# ------------------------------------------------------------ module state
+
+_ARM_LOCK = threading.Lock()
+# Double-checked lazy arming (the trace.py pattern): writes under
+# _ARM_LOCK; the hot-path read in active() is deliberately lock-free.
+# lint: thread-shared-ok(single reference swap under _ARM_LOCK; lock-free readers see None or a fully-constructed JournalStore)
+_STORE: JournalStore | None = None
+# lint: thread-shared-ok(GIL-atomic bool latch, written under _ARM_LOCK; a racing reader at worst re-enters the locked init once)
+_ENV_CHECKED = False
+_LOCAL = threading.local()
+
+
+def arm(run_dir: str | None = None, cap: int = DEFAULT_JOURNAL_CAP,
+        slow_ms: float = DEFAULT_SLOW_MS,
+        meta: dict[str, Any] | None = None) -> JournalStore:
+    """Arm process-wide request journaling (the trainer's
+    ``config.request_trace``). Re-arming replaces — and closes — the
+    previous store."""
+    global _STORE, _ENV_CHECKED
+    # Construct (and open the JSONL) OUTSIDE the lock: file I/O under
+    # _ARM_LOCK would stall every hot-path active() reader racing the
+    # first lazy init.
+    store = JournalStore(run_dir=run_dir, cap=cap, slow_ms=slow_ms,
+                         meta=meta)
+    with _ARM_LOCK:
+        old, _STORE = _STORE, store
+        _ENV_CHECKED = True
+    if old is not None:
+        old.close()
+    return store
+
+
+def disarm() -> None:
+    global _STORE, _ENV_CHECKED
+    with _ARM_LOCK:
+        old, _STORE = _STORE, None
+        _ENV_CHECKED = True
+    if old is not None:
+        old.close()
+
+
+def active() -> JournalStore | None:
+    """The armed store, lazily initialized from ``ASYNCRL_REQUEST_TRACE``
+    on first call (plain scripts get journaling without code changes; an
+    env-armed store has no run_dir, so it keeps the recent ring and
+    metrics but persists nothing)."""
+    global _STORE, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        # Construct outside _ARM_LOCK (no blocking I/O under the lock);
+        # a racing loser closes its store and defers to the winner's.
+        want = os.environ.get(ENV_VAR, "").lower() not in _FALSEY
+        store = JournalStore() if want else None
+        published = False
+        with _ARM_LOCK:
+            if not _ENV_CHECKED:
+                _STORE = store
+                _ENV_CHECKED = True
+                published = True
+        if store is not None and not published:
+            store.close()
+    return _STORE
+
+
+def env_requests() -> bool | None:
+    """What ASYNCRL_REQUEST_TRACE asks for: None when unset (the config
+    decides), else its truthiness — the precedence obs.setup implements."""
+    raw = os.environ.get(ENV_VAR)
+    if raw is None:
+        return None
+    return raw.lower() not in _FALSEY
+
+
+def begin(trace_id: str, endpoint: str = "", deadline_ms: float = 0.0,
+          tenant: str = "", policy: str = "") -> RequestJournal | None:  # budget: deadline_ms
+    """Open a journal for one request (None when journaling is off — the
+    single branch every gateway hook keys on). Generates a trace id when
+    the client did not send one."""
+    if active() is None:
+        return None
+    return RequestJournal(trace_id or new_trace_id(), endpoint=endpoint,
+                          deadline_ms=deadline_ms, tenant=tenant,
+                          policy=policy)
+
+
+class _Bind:
+    """Context manager binding a journal to the calling thread, so the
+    fleet router and scheduler (same thread, deeper frames) can append
+    hops via :func:`current` without signature plumbing."""
+
+    __slots__ = ("_journal", "_prev")
+
+    def __init__(self, journal: RequestJournal | None):
+        self._journal = journal
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_LOCAL, "journal", None)
+        _LOCAL.journal = self._journal
+        return self._journal
+
+    def __exit__(self, *exc):
+        _LOCAL.journal = self._prev
+        return False
+
+
+def bind(journal: RequestJournal | None) -> _Bind:
+    return _Bind(journal)
+
+
+def current() -> RequestJournal | None:
+    """The journal bound to the calling thread (None off the request
+    path, or when journaling is off)."""
+    return getattr(_LOCAL, "journal", None)
+
+
+def current_trace_id() -> str | None:
+    """The bound journal's trace id (histogram exemplar stamping)."""
+    journal = current()
+    return journal.trace_id if journal is not None else None
+
+
+def recent(n: int = RECENT_CAPACITY) -> list[dict[str, Any]]:
+    """Most recent finished journal docs ([] when disarmed) — the flight
+    recorder's embed source."""
+    store = active()
+    return store.recent(n) if store is not None else []
+
+
+# ---------------------------------------------------------------- reading
+
+
+def read_jsonl(path: str) -> dict[str, Any]:
+    """Parse a persisted ``requests.jsonl`` into ``{"meta": ..,
+    "requests": [..]}`` — torn-tail-tolerant, last run segment wins (the
+    ``timeseries.read_jsonl`` contract)."""
+    meta: dict[str, Any] = {}
+    requests: list[dict[str, Any]] = []
+    started = False  # a meta AFTER data starts a new segment
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail of a crashed run — keep what parsed
+            kind = row.get("kind")
+            if kind == "meta":
+                if started:
+                    requests = []
+                    started = False
+                meta = row.get("run") or {}
+            elif kind == "request":
+                doc = row.get("request")
+                if isinstance(doc, dict):
+                    started = True
+                    requests.append(decode_tree(doc))
+    return {"meta": meta, "requests": requests}
+
+
+# -------------------------------------------------------------- rendering
+
+
+def level0_sum_ms(doc: dict[str, Any]) -> float:
+    """Sum of the level-0 segment durations — equals ``latency_ms`` up to
+    float slack (the invariant the smoke gates)."""
+    return sum(
+        float(h.get("dur_ms", 0.0))
+        for h in doc.get("hops", ())
+        if int(h.get("level", 0)) == 0
+    )
+
+
+def render_waterfall(doc: dict[str, Any]) -> list[str]:
+    """One journal as a budget waterfall (the ``obs explain`` shape)."""
+    status = int(doc.get("status", 0))
+    head = (
+        f"trace {doc.get('trace_id', '?')}  {doc.get('endpoint', '?')}"
+        f"  tenant={doc.get('tenant') or '-'}"
+        f"  status={status}"
+        f"  decided_by={doc.get('decided_by') or '-'}"
+    )
+    cause = doc.get("cause")
+    if cause:
+        head += f"  cause={cause}"
+    lines = [head]
+    lines.append(
+        f"  deadline {float(doc.get('deadline_ms', 0.0)):.1f} ms"
+        f" · latency {float(doc.get('latency_ms', 0.0)):.1f} ms"
+        f" · level-0 sum {level0_sum_ms(doc):.1f} ms"
+    )
+    lines.append("      t+ms    budget_ms  stage")
+    known = {"stage", "t_ms", "dur_ms", "budget_ms", "level", "cause"}
+    for hop in doc.get("hops", ()):
+        level = int(hop.get("level", 0))
+        indent = "  " * level
+        extras = " ".join(
+            f"{k}={hop[k]}" for k in sorted(hop) if k not in known
+        )
+        tail = f"  [{hop['cause']}]" if hop.get("cause") else ""
+        if extras:
+            tail += f"  {extras}"
+        lines.append(
+            f"  {float(hop.get('t_ms', 0.0)):8.1f} {float(hop.get('budget_ms', 0.0)):10.1f}"
+            f"  {indent}{hop.get('stage', '?')}"
+            f"  {float(hop.get('dur_ms', 0.0)):.1f} ms{tail}"
+        )
+    return lines
+
+
+def explain(run_dir: str, trace_id: str | None = None,
+            worst: int = 0) -> tuple[str, int]:
+    """Render hop timelines from a run's ``requests.jsonl``: one journal
+    by trace id, or the ``--worst N`` set (non-200 verdicts first, then
+    by latency). Returns ``(text, exit_code)`` — 2 when the file or the
+    trace id is missing (the doctor's "cannot judge" convention)."""
+    path = os.path.join(run_dir, FILENAME)
+    if not os.path.exists(path):
+        return f"explain: no {FILENAME} under {run_dir}", 2
+    docs = read_jsonl(path)["requests"]
+    if not docs:
+        return f"explain: {FILENAME} has no finished journals", 2
+    if trace_id:
+        picked = [d for d in docs if d.get("trace_id") == trace_id]
+        if not picked:
+            return (
+                f"explain: trace {trace_id} not found "
+                f"({len(docs)} journal(s) in the segment)", 2,
+            )
+    else:
+        n = max(1, worst)
+        picked = sorted(
+            docs,
+            key=lambda d: (int(d.get("status", 0)) != 200,
+                           float(d.get("latency_ms", 0.0))),
+            reverse=True,
+        )[:n]
+    lines: list[str] = []
+    for doc in picked:
+        lines.extend(render_waterfall(doc))
+        lines.append("")
+    return "\n".join(lines).rstrip(), 0

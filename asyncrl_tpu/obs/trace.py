@@ -82,7 +82,7 @@ class SpanRing:
     """
 
     __slots__ = ("capacity", "thread_name", "group", "names", "starts",
-                 "ends", "idx", "thread")
+                 "ends", "metas", "idx", "thread")
 
     def __init__(self, capacity: int, thread_name: str, group: str,
                  thread=None):
@@ -100,14 +100,21 @@ class SpanRing:
         self.starts: list[float] = [0.0] * capacity
         # lint: thread-shared-ok(single-writer ring slots, same snapshot discipline as names)
         self.ends: list[float] = [0.0] * capacity
+        # Optional per-span metadata (request trace ids). None for the
+        # overwhelming majority of spans — snapshots emit the legacy
+        # (name, start, end) 3-tuple unless a meta dict is present.
+        # lint: thread-shared-ok(single-writer ring slots, same snapshot discipline as names)
+        self.metas: list[dict | None] = [None] * capacity
         # lint: thread-shared-ok(GIL-atomic int; single-writer monotone counter, snapshot reads it before/after the copy)
         self.idx = 0
 
-    def record(self, name: str, start: float, end: float) -> None:
+    def record(self, name: str, start: float, end: float,
+               meta: dict | None = None) -> None:
         i = self.idx % self.capacity
         self.names[i] = name
         self.starts[i] = start
         self.ends[i] = end
+        self.metas[i] = meta
         self.idx += 1
 
     @property
@@ -126,6 +133,7 @@ class SpanRing:
         names = list(self.names)
         starts = list(self.starts)
         ends = list(self.ends)
+        metas = list(self.metas)
         i1 = self.idx
         lo = max(0, i1 - self.capacity + 1)
         out = []
@@ -133,7 +141,12 @@ class SpanRing:
             slot = j % self.capacity
             name = names[slot]
             if name is not None:
-                out.append((name, starts[slot], ends[slot]))
+                if metas[slot] is None:
+                    out.append((name, starts[slot], ends[slot]))
+                else:
+                    out.append(
+                        (name, starts[slot], ends[slot], metas[slot])
+                    )
         return {
             "thread": self.thread_name,
             "group": self.group,
@@ -294,6 +307,17 @@ def span(name: str):
     if tracer is None:
         return _NOOP
     return tracer.span(name)
+
+
+def record_span(name: str, start: float, end: float,
+                meta: dict | None = None) -> None:
+    """Record one already-timed span (perf_counter stamps) into the
+    calling thread's ring — the request-journal replay path, which emits
+    trace-id-stamped ``request.*`` spans at journal close. No-op when
+    tracing is disabled."""
+    tracer = active()
+    if tracer is not None:
+        tracer._ring().record(name, start, end, meta)
 
 
 def tag_thread(group: str) -> None:

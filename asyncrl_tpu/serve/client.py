@@ -43,6 +43,7 @@ from typing import Any, Callable
 from urllib.parse import urlparse
 
 from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.obs import requests as obs_requests
 
 ENDPOINTS = ("act", "evaluate")
 
@@ -105,6 +106,10 @@ class GatewayResult:
     # single core) — the per-response provenance the canary/mixing
     # assertions read.
     replica: str = ""
+    # The wire trace id this call carried (client-generated, stable
+    # across retries; the gateway echoes it and keys its hop journal on
+    # it — ``obs explain <trace_id>`` renders the budget waterfall).
+    trace_id: str = ""
     raw: dict = field(default_factory=dict)
 
 
@@ -302,6 +307,11 @@ class GatewayClient:
         body = json.dumps({
             "v": 1, "obs": obs_list, "policy": policy,
         }).encode()
+        # One trace id per CALL, minted before the retry loop: every
+        # attempt of this request carries the same id on the wire, so a
+        # failover that burns three attempts still lands in ONE gateway
+        # journal per attempt under one correlatable identity.
+        trace_id = obs_requests.new_trace_id()
         start = self._clock()
         last: Exception | None = None
         attempts = 0
@@ -320,7 +330,7 @@ class GatewayClient:
             t0 = self._clock()
             try:
                 result = self._attempt(
-                    endpoint, body, remaining_ms, attempts
+                    endpoint, body, remaining_ms, attempts, trace_id
                 )
             except GatewayShed as e:
                 # A shed is the SERVER doing its job, not an endpoint
@@ -384,13 +394,16 @@ class GatewayClient:
         self._sleep(wait_s)
         return True
 
-    def _attempt(self, endpoint, body, remaining_ms, attempts) -> GatewayResult:
+    def _attempt(self, endpoint, body, remaining_ms, attempts,
+                 trace_id: str = "") -> GatewayResult:
         headers = {
             "Content-Type": "application/json",
             "X-Deadline-Ms": f"{remaining_ms:.1f}",
         }
         if self.tenant:
             headers["X-Tenant"] = self.tenant
+        if trace_id:
+            headers["X-Trace-Id"] = trace_id
         try:
             status, resp_headers, raw = self._transport(
                 f"/v1/{endpoint}", body, headers, remaining_ms / 1e3
@@ -443,6 +456,7 @@ class GatewayClient:
                 latency_ms=float(doc.get("latency_ms", 0.0)),
                 attempts=attempts,
                 replica=str(doc.get("replica", "") or ""),
+                trace_id=str(doc.get("trace_id", "") or trace_id),
                 raw=doc,
             )
         except (ValueError, TypeError, KeyError) as e:

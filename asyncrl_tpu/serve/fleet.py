@@ -48,12 +48,19 @@ from typing import Any, Callable
 import numpy as np
 
 from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.obs import requests as obs_requests
 from asyncrl_tpu.rollout.inference_server import ServerClosed
 from asyncrl_tpu.serve.gateway import GatewayDegraded, bucket_rows
 from asyncrl_tpu.serve.router import DEFAULT_POLICY, PolicyRouter
 from asyncrl_tpu.serve.scheduler import DispatchTimeout, ServeCore
 from asyncrl_tpu.serve.slo import RequestShed
 from asyncrl_tpu.utils import faults
+
+# Lifecycle-state encoding for the per-replica labeled gauge
+# (fleet_replica_state{replica=...}): numeric because the registry and
+# every scraper speak floats; the mapping is part of the /metrics
+# contract (docs/ARCHITECTURE.md).
+REPLICA_STATE_CODES = {"serving": 0.0, "probe": 1.0, "ejected": 2.0}
 
 
 class ParamFeed:
@@ -665,6 +672,26 @@ class ServeFleet:
             r.name: obs_registry.gauge(f"fleet_{r.name}_staleness")
             for r in self.replicas
         }
+        # Scraper-visible per-replica series: label-bearing keys
+        # ('name{replica="r0"}') render as labeled Prometheus families on
+        # /metrics (obs/http.py understands the brace suffix) and mirror
+        # into timeseries.jsonl through the registry window like any
+        # other gauge — a flapping replica is now visible to a scraper,
+        # not only to /healthz.
+        self._g_replica_labeled = {
+            r.name: {
+                "staleness": obs_registry.gauge(
+                    f'fleet_replica_staleness{{replica="{r.name}"}}'
+                ),
+                "version": obs_registry.gauge(
+                    f'fleet_replica_version{{replica="{r.name}"}}'
+                ),
+                "state": obs_registry.gauge(
+                    f'fleet_replica_state{{replica="{r.name}"}}'
+                ),
+            }
+            for r in self.replicas
+        }
         self._c_ejections = obs_registry.counter("fleet_ejections")
         self._c_readmissions = obs_registry.counter("fleet_readmissions")
         self._c_promotions = obs_registry.counter("fleet_promotions")
@@ -751,6 +778,12 @@ class ServeFleet:
             lag = replica.staleness()
             worst = max(worst, lag)
             self._g_replica_stale[replica.name].set(float(lag))
+            labeled = self._g_replica_labeled[replica.name]
+            labeled["staleness"].set(float(lag))
+            labeled["version"].set(float(replica.version))
+            labeled["state"].set(
+                REPLICA_STATE_CODES.get(replica.state, -1.0)
+            )
             if replica.state == "serving" and lag >= self.staleness_cap:
                 if replica.eject("staleness"):
                     self._c_ejections.inc()
@@ -944,7 +977,34 @@ class FleetRouter:
         order = self._order()
         probe = order[0] if order and order[0].state == "probe" else None
         if not order:
-            raise GatewayDegraded("no serving replica in the fleet")
+            exc = GatewayDegraded("no serving replica in the fleet")
+            # Journal provenance: the gateway's degrade path stamps this
+            # as the deciding stage on the shed answer.
+            exc.decided_by = obs_requests.DECIDED_FLEET
+            raise exc
+        # Per-attempt hop journaling (obs/requests.py): each replica
+        # tried records its budget share, canary assignment, and outcome
+        # into the handler thread's bound journal — one journal, N
+        # attempts is the failover-provenance invariant the tests gate.
+        journal = obs_requests.current()
+        canary_members: frozenset[str] = frozenset()
+        if journal is not None and fleet.canary is not None \
+                and fleet.canary.active:
+            canary_members = frozenset(fleet.canary.members)
+
+        def attempt_hop(
+            t0: float, outcome: str, replica: "Replica",
+            budget_share_ms: float, **extra,
+        ) -> None:
+            if journal is not None:
+                journal.hop(
+                    obs_requests.STAGE_ATTEMPT, t0, time.perf_counter(),
+                    level=1, cause=outcome, replica=replica.name,
+                    budget_share_ms=round(budget_share_ms, 3),
+                    canary=replica.name in canary_members,
+                    **extra,
+                )
+
         last_shed: RequestShed | None = None
         try:
             for i, replica in enumerate(order):
@@ -958,6 +1018,7 @@ class FleetRouter:
                 budget_ms = max(
                     1e3 * remaining_s / (len(order) - i), 1.0
                 )
+                t_attempt = time.perf_counter()
                 try:
                     result, generation = replica.core.submit_external(
                         policy, (padded,), budget_ms
@@ -965,22 +1026,32 @@ class FleetRouter:
                 except DispatchTimeout as e:
                     # The replica did not answer inside its share: sick.
                     last_shed = e
+                    attempt_hop(
+                        t_attempt, "dispatch_timeout", replica, budget_ms
+                    )
                     fleet.note_failure(replica)
                     continue
                 except RequestShed as e:
                     # Admission shed: LOAD, not sickness — no health
                     # penalty; a shed probe aborts (clock unchanged).
                     last_shed = e
+                    attempt_hop(t_attempt, "shed", replica, budget_ms)
                     if replica is probe:
                         replica.probe_abort()
                     continue
                 except ServerClosed:
+                    attempt_hop(t_attempt, "closed", replica, budget_ms)
                     fleet.note_failure(replica)
                     continue
                 # lint: broad-except-ok(failover boundary: ANY replica failure — injected crash, dead router, torn-down core — must try the next candidate, and note_failure feeds the ejection/canary accounting)
                 except Exception:
+                    attempt_hop(t_attempt, "error", replica, budget_ms)
                     fleet.note_failure(replica)
                     continue
+                attempt_hop(
+                    t_attempt, "served", replica, budget_ms,
+                    generation=generation,
+                )
                 actions, logp = result[0], result[1]
                 version = replica.version_of(generation)
                 fleet.note_success(replica)
@@ -1005,10 +1076,12 @@ class FleetRouter:
                 probe.probe_abort()
         if last_shed is not None:
             raise last_shed
-        raise GatewayDegraded(
+        exc = GatewayDegraded(
             "every replica failed or was unavailable inside the wire "
             "budget"
         )
+        exc.decided_by = obs_requests.DECIDED_FLEET
+        raise exc
 
     # /v1/evaluate rides the same failover path as its own traffic class
     # (the gateway keeps separate wire counters per endpoint).

@@ -77,9 +77,11 @@ import numpy as np
 
 from asyncrl_tpu.obs import http as obs_http
 from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.obs import requests as obs_requests
 from asyncrl_tpu.obs import spans as span_names
 from asyncrl_tpu.obs import trace
 from asyncrl_tpu.rollout.inference_server import ServerClosed
+from asyncrl_tpu.serve.scheduler import DispatchTimeout
 from asyncrl_tpu.serve.slo import RequestShed, SLOGate
 from asyncrl_tpu.utils import faults
 from asyncrl_tpu.utils.faults import NetFault
@@ -712,14 +714,43 @@ class ServeGateway:
         self._c_requests.inc()
         self._c_requests_by[endpoint].inc()
         arrival = time.monotonic()
+        # Wire trace context: a client-sent ``X-Trace-Id`` echoes on every
+        # answer (header + body) whether or not journaling is armed; with
+        # the request-journal store armed it also roots this request's hop
+        # journal (obs/requests.py). Off means ``begin`` returns None and
+        # nothing beyond the echo string is constructed.
+        wire_tid = str(handler.headers.get("X-Trace-Id", "") or "").strip()
+        jr = obs_requests.begin(wire_tid, endpoint=endpoint)
+        tid = jr.trace_id if jr is not None else wire_tid
+
+        def reply(code: int, doc: dict, headers: dict | None = None,
+                  stage: str = "", cause: str = "") -> None:
+            # Every answered exit funnels here: the journal's final
+            # segment is named the DECIDING stage, so a non-200 always
+            # says which gate refused it.
+            if tid:
+                headers = dict(headers or {})
+                headers["X-Trace-Id"] = tid
+                doc.setdefault("trace_id", tid)
+            if jr is not None:
+                jr.finish(code, stage, cause)
+            self._send_json(handler, code, doc, headers=headers)
+
+        def bad(code: int, error: str, detail: str = "") -> None:
+            self._c_bad.inc()
+            doc = {"v": PROTOCOL_VERSION, "error": error}
+            if detail:
+                doc["detail"] = detail
+            reply(code, doc, stage=obs_requests.DECIDED_PARSE, cause=error)
+
         # ---- parse + validate (nothing counted against tenants yet)
         try:
             length = int(handler.headers.get("Content-Length", "0"))
         except ValueError:
-            return self._bad(handler, 400, "bad_length")
+            return bad(400, "bad_length")
         if length <= 0 or length > MAX_BODY_BYTES:
-            return self._bad(handler, 413 if length > 0 else 400,
-                             "bad_length", f"Content-Length {length}")
+            return bad(413 if length > 0 else 400,
+                       "bad_length", f"Content-Length {length}")
         raw = handler.rfile.read(length)
         if len(raw) < length:
             # Client disconnected mid-body: both the aggregate and the
@@ -728,21 +759,25 @@ class ServeGateway:
             self._c_errors.inc()
             self._c_errors_by[endpoint].inc()
             handler.close_connection = True
+            if jr is not None:
+                # Status 0: no HTTP status ever reached the client.
+                jr.finish(0, obs_requests.DECIDED_PARSE,
+                          "client_disconnect_mid_body")
             return
         try:
             body = json.loads(raw)
         except json.JSONDecodeError as e:
-            return self._bad(handler, 400, "bad_json", str(e))
+            return bad(400, "bad_json", str(e))
         if not isinstance(body, dict) or body.get("v") != PROTOCOL_VERSION:
-            return self._bad(
-                handler, 400, "bad_version",
+            return bad(
+                400, "bad_version",
                 f"this gateway speaks v{PROTOCOL_VERSION}",
             )
         policy = body.get("policy", "default")
         try:
             obs = np.asarray(body.get("obs"), dtype=np.float32)
         except (TypeError, ValueError) as e:
-            return self._bad(handler, 400, "bad_obs", str(e))
+            return bad(400, "bad_obs", str(e))
         expected = getattr(self.backend, "obs_shape", None)
         if (
             obs.ndim == 0
@@ -752,8 +787,8 @@ class ServeGateway:
             # Validated HERE, before submission: a malformed observation
             # must never reach the batch coalescer where its failure would
             # poison innocent co-batched actor requests.
-            return self._bad(
-                handler, 400, "bad_obs",
+            return bad(
+                400, "bad_obs",
                 f"obs shape {obs.shape} != [B, *{tuple(expected or ())}]",
             )
         tenant_id = handler.headers.get(
@@ -770,16 +805,22 @@ class ServeGateway:
                 else self.default_deadline_ms
             )
         except (TypeError, ValueError):
-            return self._bad(handler, 400, "bad_deadline", str(deadline_raw))
+            return bad(400, "bad_deadline", str(deadline_raw))
         # isfinite, not just > 0: float("nan") fails every comparison
         # (json.loads accepts NaN), and a nan budget downstream turns the
         # serve core's deadline arithmetic into a never-firing flush — a
         # single request wedging the serve thread. inf is refused for the
         # same reason: the wire contract is a bounded budget.
         if not math.isfinite(deadline_ms) or deadline_ms <= 0:
-            return self._bad(handler, 400, "bad_deadline",
-                             f"{deadline_ms} is not a positive finite ms "
-                             "budget")
+            return bad(400, "bad_deadline",
+                       f"{deadline_ms} is not a positive finite ms "
+                       "budget")
+        if jr is not None:
+            # Identity resolved: backfill the journal's request fields and
+            # close the parse segment (budget arithmetic starts here).
+            jr.annotate(tenant=tenant.cls.name, policy=str(policy),
+                        deadline_ms=deadline_ms)
+            jr.seg(obs_requests.STAGE_PARSE)
 
         # ---- scripted chaos (after parse: the payload exists to corrupt)
         if self._fault_request is not None:
@@ -792,26 +833,34 @@ class ServeGateway:
                     "netfault": fault.mode,
                 }).encode()
                 if self._netfault(handler, fault, probe):
+                    if jr is not None:
+                        # Status 0: the scripted wire failure means no
+                        # usable HTTP answer left the gateway.
+                        jr.finish(0, obs_requests.DECIDED_NETFAULT,
+                                  fault.mode)
                     return
 
         # ---- drain gate
         if self._draining:
             self._c_shed.inc()
-            return self._send_json(
-                handler, 503,
+            return reply(
+                503,
                 {"v": PROTOCOL_VERSION, "error": "draining"},
                 headers={"Retry-After": "1"},
+                stage=obs_requests.DECIDED_DRAIN, cause="draining",
             )
 
         # ---- deadline feasibility: shed BEFORE a batch slot is occupied
         estimate_ms = self.backend.latency_estimate_ms()
         if estimate_ms > 0 and deadline_ms < estimate_ms:
             self._c_deadline_shed.inc()
-            return self._send_json(
-                handler, 504,
+            return reply(
+                504,
                 {"v": PROTOCOL_VERSION, "error": "deadline_unattainable",
                  "estimate_ms": round(estimate_ms, 3),
                  "deadline_ms": deadline_ms},
+                stage=obs_requests.DECIDED_DEADLINE,
+                cause=f"estimate {estimate_ms:.1f}ms exceeds budget",
             )
 
         # ---- tenant admission (token bucket, then the class SLO gate)
@@ -819,11 +868,13 @@ class ServeGateway:
             retry_after = tenant.bucket.try_take()
             if retry_after > 0:
                 self._c_shed.inc()
-                return self._send_json(
-                    handler, 429,
+                return reply(
+                    429,
                     {"v": PROTOCOL_VERSION, "error": "rate_limited",
                      "tenant": tenant.cls.name},
                     headers={"Retry-After": f"{retry_after:.3f}"},
+                    stage=obs_requests.DECIDED_RATE_BUCKET,
+                    cause="rate_limited",
                 )
             try:
                 # The admission wait is part of the promised budget: an
@@ -838,22 +889,29 @@ class ServeGateway:
                 # token, or shed requests double-charge the rate budget.
                 tenant.bucket.refund()
                 self._c_shed.inc()
-                return self._send_json(
-                    handler, 429,
+                return reply(
+                    429,
                     {"v": PROTOCOL_VERSION, "error": "tenant_slo_shed",
                      "tenant": tenant.cls.name, "detail": str(e)},
                     headers={"Retry-After": "0.1"},
+                    stage=obs_requests.DECIDED_TENANT_GATE,
+                    cause=str(e),
                 )
             except ServerClosed:
                 # close_admissions() raced this request past the drain
                 # check: the closed tenant gate is the backstop.
                 tenant.bucket.refund()
                 self._c_shed.inc()
-                return self._send_json(
-                    handler, 503,
+                return reply(
+                    503,
                     {"v": PROTOCOL_VERSION, "error": "draining"},
                     headers={"Retry-After": "1"},
+                    stage=obs_requests.DECIDED_DRAIN,
+                    cause="admission gate closed",
                 )
+        if jr is not None:
+            # The admission segment covers bucket take + SLO-gate wait.
+            jr.seg(obs_requests.STAGE_ADMIT)
 
         # ---- serve (admitted: every exit below must finish/abandon)
         try:
@@ -873,7 +931,15 @@ class ServeGateway:
                 # fleet backend stamps which REPLICA served — with the
                 # generation stamp, the per-response provenance the
                 # canary/mixing assertions read off the wire.
-                out = fn(policy, obs, remaining_ms)
+                if jr is not None:
+                    # Thread-local bind: the fleet router and the serve
+                    # core's submit path (same handler thread) attach
+                    # their hops to THIS request's journal without any
+                    # signature plumbing through the backend protocol.
+                    with obs_requests.bind(jr):
+                        out = fn(policy, obs, remaining_ms)
+                else:
+                    out = fn(policy, obs, remaining_ms)
                 actions, logp, generation = out[0], out[1], out[2]
                 extras = dict(out[3]) if len(out) > 3 else {}
         except RequestShed as e:
@@ -883,18 +949,26 @@ class ServeGateway:
             tenant.gate.abandoned()
             tenant.bucket.refund()
             self._c_shed.inc()
-            return self._send_json(
-                handler, 429,
+            if isinstance(e, DispatchTimeout):
+                shed_stage = obs_requests.DECIDED_DISPATCH_GRACE
+            elif remaining_ms <= 0:
+                shed_stage = obs_requests.DECIDED_DEADLINE
+            else:
+                shed_stage = obs_requests.DECIDED_SLO_GATE
+            return reply(
+                429,
                 {"v": PROTOCOL_VERSION, "error": "overloaded",
                  "detail": str(e)},
                 headers={"Retry-After": "0.1"},
+                stage=shed_stage, cause=str(e),
             )
         except GatewayDegraded as e:
             # The degrade path owns the admission closure: stale/fallback
             # answers count as served (finished), shed un-counts
             # (abandoned) — never both.
             return self._degrade(handler, endpoint, tenant, policy, obs,
-                                 arrival, str(e))
+                                 arrival, str(e), journal=jr, trace_id=tid,
+                                 stage=getattr(e, "decided_by", ""))
         # lint: broad-except-ok(per-request boundary: an infrastructure failure behind one request answers 500 and is counted; the serving loop and other requests are independent)
         except Exception as e:
             tenant.gate.abandoned()
@@ -905,13 +979,19 @@ class ServeGateway:
             tenant.bucket.refund()
             self._c_errors.inc()
             self._c_errors_by[endpoint].inc()
-            return self._send_json(
-                handler, 500,
+            return reply(
+                500,
                 {"v": PROTOCOL_VERSION, "error": "serve_failed",
                  "detail": f"{type(e).__name__}: {e}"},
+                stage=obs_requests.DECIDED_BACKEND_ERROR,
+                cause=type(e).__name__,
             )
+        if jr is not None:
+            jr.seg(obs_requests.STAGE_SERVE,
+                   generation=int(generation),
+                   replica=str(extras.get("replica", "")))
         latency_ms = 1e3 * (time.monotonic() - arrival)
-        tenant.gate.finished(latency_ms)
+        tenant.gate.finished(latency_ms, trace_id=tid or None)
         doc = {
             "v": PROTOCOL_VERSION,
             "endpoint": endpoint,
@@ -923,14 +1003,25 @@ class ServeGateway:
         for key, value in extras.items():
             # Backend provenance never overrides protocol fields.
             doc.setdefault(key, value)
-        self._send_json(handler, 200, doc)
+        headers = None
+        if tid:
+            doc.setdefault("trace_id", tid)
+            headers = {"X-Trace-Id": tid}
+        if jr is not None:
+            jr.finish(200, obs_requests.STAGE_RESPOND, "served")
+        self._send_json(handler, 200, doc, headers=headers)
 
     def _degrade(self, handler, endpoint, tenant, policy, obs, arrival,
-                 reason: str) -> None:
+                 reason: str, journal=None, trace_id: str = "",
+                 stage: str = "") -> None:
         """The backing core is unavailable: answer per the tenant's mode
         (see module doc). The stale path that itself fails falls through
-        to shed — degradation degrades, it never 500s."""
+        to shed — degradation degrades, it never 500s. ``journal`` /
+        ``trace_id`` carry the request's wire trace context; ``stage`` (a
+        ``decided_by`` vocabulary value, e.g. the fleet's
+        ``fleet.exhausted``) names the decider on the shed answer."""
         mode = tenant.cls.mode
+        headers = {"X-Trace-Id": trace_id} if trace_id else None
         if mode == "stale":
             try:
                 out = self.backend.serve_stale(policy, obs)
@@ -942,7 +1033,7 @@ class ServeGateway:
             else:
                 self._c_stale.inc()
                 latency_ms = 1e3 * (time.monotonic() - arrival)
-                tenant.gate.finished(latency_ms)
+                tenant.gate.finished(latency_ms, trace_id=trace_id or None)
                 doc = {
                     "v": PROTOCOL_VERSION,
                     "endpoint": endpoint,
@@ -955,29 +1046,47 @@ class ServeGateway:
                 }
                 for key, value in extras.items():
                     doc.setdefault(key, value)
-                return self._send_json(handler, 200, doc)
+                if trace_id:
+                    doc.setdefault("trace_id", trace_id)
+                if journal is not None:
+                    journal.seg(obs_requests.STAGE_SERVE,
+                                cause="degraded_stale")
+                    journal.finish(200, obs_requests.STAGE_RESPOND, "stale")
+                return self._send_json(handler, 200, doc, headers=headers)
         if mode == "fallback":
             self._c_fallback.inc()
             rows = int(obs.shape[0])
             action = tenant.cls.fallback_action
-            tenant.gate.finished(1e3 * (time.monotonic() - arrival))
-            return self._send_json(handler, 200, {
+            tenant.gate.finished(1e3 * (time.monotonic() - arrival),
+                                 trace_id=trace_id or None)
+            doc = {
                 "v": PROTOCOL_VERSION,
                 "endpoint": endpoint,
                 "actions": [action] * rows,
                 "logp": [0.0] * rows,
                 "generation": -1,
                 "fallback": True,
-            })
+            }
+            if trace_id:
+                doc["trace_id"] = trace_id
+            if journal is not None:
+                journal.seg(obs_requests.STAGE_SERVE,
+                            cause="degraded_fallback")
+                journal.finish(200, obs_requests.STAGE_RESPOND, "fallback")
+            return self._send_json(handler, 200, doc, headers=headers)
         tenant.gate.abandoned()
         tenant.bucket.refund()  # shed, not served: the token comes back
         self._c_shed.inc()
-        self._send_json(
-            handler, 503,
-            {"v": PROTOCOL_VERSION, "error": "degraded",
-             "detail": reason, "tenant": tenant.cls.name},
-            headers={"Retry-After": "1"},
-        )
+        doc = {"v": PROTOCOL_VERSION, "error": "degraded",
+               "detail": reason, "tenant": tenant.cls.name}
+        shed_headers = {"Retry-After": "1"}
+        if trace_id:
+            doc["trace_id"] = trace_id
+            shed_headers["X-Trace-Id"] = trace_id
+        if journal is not None:
+            journal.finish(503, stage or obs_requests.DECIDED_DEGRADE,
+                           reason)
+        self._send_json(handler, 503, doc, headers=shed_headers)
 
     # ---------------------------------------------------------- lifecycle
 

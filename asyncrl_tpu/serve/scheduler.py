@@ -63,6 +63,7 @@ import jax
 import numpy as np
 
 from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.obs import requests as obs_requests
 from asyncrl_tpu.obs import spans as span_names
 from asyncrl_tpu.obs import trace
 from asyncrl_tpu.rollout.inference_server import (
@@ -113,6 +114,7 @@ class _Request:
     __slots__ = (
         "client", "policy", "args", "rows", "arrival", "deadline",
         "event", "result", "error", "generation",
+        "t_dispatch0", "t_dispatch1", "dispatch_reason",
     )
 
     def __init__(self, client, policy, args, rows, arrival, deadline):
@@ -129,6 +131,16 @@ class _Request:
         self.error: BaseException | None = None
         # lint: thread-shared-ok(event handshake, same protocol as result)
         self.generation = -1
+        # Dispatch provenance for the request journal (obs/requests.py):
+        # perf_counter stamps + the fill verdict, written by the serve
+        # thread before event.set() under the same handshake as result —
+        # the waiter turns them into serve.batch_fill/serve.dispatch hops.
+        # lint: thread-shared-ok(event handshake, same protocol as result)
+        self.t_dispatch0 = 0.0
+        # lint: thread-shared-ok(event handshake, same protocol as result)
+        self.t_dispatch1 = 0.0
+        # lint: thread-shared-ok(event handshake, same protocol as result)
+        self.dispatch_reason = ""
 
 
 class ServeCore(threading.Thread):
@@ -382,6 +394,13 @@ class ServeCore(threading.Thread):
         # consumed is then re-subtracted from the fill deadline below, so
         # gate wait + batch hold together never exceed the deadline the
         # gateway promised its client.
+        # The request journal bound to THIS handler thread (None on actor
+        # threads and whenever journaling is off): core-phase hops —
+        # admission wait, batch-fill hold, dispatch — are recorded here,
+        # on the waiter's side of the event handshake, from the stamps
+        # the serve thread wrote before event.set().
+        journal = obs_requests.current()
+        p_admit0 = time.perf_counter() if journal is not None else 0.0
         admit_start = time.monotonic()
         try:
             self._slo.admit(
@@ -396,14 +415,28 @@ class ServeCore(threading.Thread):
             if self._fatal is not None:
                 raise self._fatal
             raise
+        except RequestShed:
+            if journal is not None:
+                journal.hop(
+                    obs_requests.STAGE_CORE_ADMIT, p_admit0,
+                    time.perf_counter(), level=2, cause="slo_gate_shed",
+                )
+            raise
         try:
             arrival = time.monotonic()
+            p_arrival = time.perf_counter() if journal is not None else 0.0
             if wire_budget_s is not None:
                 remaining_s = wire_budget_s - (arrival - admit_start)
                 if remaining_s <= 0:
                     # Admitted on the budget's last gasp: the flush would
                     # fire instantly on a batch of one anyway — shed
                     # honestly instead (un-counting the admission below).
+                    if journal is not None:
+                        journal.hop(
+                            obs_requests.STAGE_CORE_ADMIT, p_admit0,
+                            p_arrival, level=2,
+                            cause="admission_budget_spent",
+                        )
                     raise RequestShed(
                         "wire budget spent waiting at the admission gate"
                     )
@@ -469,6 +502,16 @@ class ServeCore(threading.Thread):
                     except ValueError:
                         pass
                 self._slo.abandoned()
+                if journal is not None:
+                    journal.hop(
+                        obs_requests.STAGE_CORE_ADMIT, p_admit0,
+                        p_arrival, level=2,
+                    )
+                    journal.hop(
+                        obs_requests.STAGE_BATCH_FILL, p_arrival,
+                        time.perf_counter(), level=2,
+                        cause="dispatch_grace_exhausted",
+                    )
                 raise DispatchTimeout(
                     "wire budget exhausted before dispatch completed "
                     "(serve thread busy or hung)"
@@ -488,7 +531,26 @@ class ServeCore(threading.Thread):
         # latency (queue + fill + dispatch + slicing). Returns the request
         # itself: the in-process client unpacks .result; the gateway path
         # also reads .generation for wire stamping.
-        self._slo.finished(1e3 * (time.monotonic() - request.arrival))
+        self._slo.finished(
+            1e3 * (time.monotonic() - request.arrival),
+            trace_id=journal.trace_id if journal is not None else None,
+        )
+        if journal is not None:
+            p_now = time.perf_counter()
+            d0 = request.t_dispatch0 or p_now
+            d1 = request.t_dispatch1 or p_now
+            journal.hop(
+                obs_requests.STAGE_CORE_ADMIT, p_admit0, p_arrival,
+                level=2,
+            )
+            journal.hop(
+                obs_requests.STAGE_BATCH_FILL, p_arrival, d0, level=2,
+                cause=request.dispatch_reason,
+            )
+            journal.hop(
+                obs_requests.STAGE_DISPATCH, d0, d1, level=2,
+                generation=request.generation,
+            )
         return request
 
     # ------------------------------------------------------------- server
@@ -617,6 +679,9 @@ class ServeCore(threading.Thread):
             self._router.publish(DEFAULT_POLICY, params)
 
     def _dispatch(self, group: list[_Request], reason: str) -> None:
+        # Journal provenance: the batch-fill hold ends (and the dispatch
+        # phase begins) here, for every request in the group.
+        t_dispatch0 = time.perf_counter()
         if self._debug:
             # Checked before any delivery so a violation cannot poison
             # already-served clients; raised outside the per-request try
@@ -686,9 +751,15 @@ class ServeCore(threading.Thread):
                         actions[a:b], logp[a:b], _slice(core, a, b)
                     )
                 request.generation = generation
+                request.t_dispatch0 = t_dispatch0
+                request.t_dispatch1 = time.perf_counter()
+                request.dispatch_reason = reason
                 request.event.set()
         # lint: broad-except-ok(per-request boundary: the failure is delivered to every admitted client, then the core keeps serving — same contract as InferenceServer._serve)
         except BaseException as e:
             for request in group:
                 request.error = e
+                request.t_dispatch0 = t_dispatch0
+                request.t_dispatch1 = time.perf_counter()
+                request.dispatch_reason = reason
                 request.event.set()

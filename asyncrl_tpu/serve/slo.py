@@ -238,11 +238,16 @@ class SLOGate:
                         )
                     self._cond.wait(timeout=min(remaining, 0.05))
 
-    def finished(self, latency_ms: float) -> None:
+    def finished(
+        self, latency_ms: float, trace_id: str | None = None
+    ) -> None:
         """Record one completed request: feeds the latency window and the
         registry histogram, refills one token during breach, and wakes
-        backpressured admitters."""
-        self._histogram.observe(latency_ms)
+        backpressured admitters. ``trace_id`` (a request journal's id)
+        becomes the histogram bucket's exemplar — a p95/p99 breach in the
+        summary then links to a concrete journal via
+        ``Histogram.exemplars()``."""
+        self._histogram.observe(latency_ms, exemplar=trace_id)
         with self._cond:
             self._inflight -= 1
             self._lat.append(latency_ms)

@@ -414,6 +414,19 @@ class Config:
     # timeseries.jsonl). Empty = runs/<env>-<algo>-s<seed>-<stamp>-<pid>
     # when tracing is on; ASYNCRL_RUN_DIR overrides.
     run_dir: str = ""
+    # Request hop journals (obs/requests.py): per-request wire tracing
+    # with deadline-budget accounting across gateway -> fleet -> replica
+    # -> batch. ASYNCRL_REQUEST_TRACE (when set) wins, like ASYNCRL_TRACE.
+    # Off = begin() returns None; every hook is one thread-local read.
+    request_trace: bool = False
+    # Persistence budget: at most this many journals append to
+    # runs/<run>/requests.jsonl (past it, the request_journals_capped
+    # counter moves and the file stays fixed size).
+    request_journal_cap: int = 512
+    # Sampling bar: served (200) journals persist only when latency_ms
+    # reaches this; <= 0 persists every finished journal. Non-200s always
+    # persist (a shed IS the story).
+    request_sample_slow_ms: float = 0.0
     # --- run-health telemetry (obs/timeseries.py, obs/health.py,
     # obs/http.py) ---
     # Exposition endpoint port (/metrics, /healthz, /timeseries): 0 = off

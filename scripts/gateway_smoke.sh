@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Gateway smoke: the external serving gateway (asyncrl_tpu/serve/gateway.py)
-# proven as a load-generator A/B in four acts:
+# proven as a load-generator A/B in five acts:
 #
 #   Act 1 — gateway-off bit-identity: a gateway_port=0 run and a mounted-
 #     but-idle gateway_port=-1 run produce IDENTICAL per-window losses
@@ -31,6 +31,17 @@
 #     version, zero generation mixing throughout, and the client sees no
 #     availability gap beyond the failover budget (sheds allowed,
 #     unavailability not).
+#   Act 5 — request tracing (asyncrl_tpu/obs/requests.py): two scenes.
+#     Scene A: journaling ARMED over a replicated fleet under two-tenant
+#     QPS with a replica KILL mid-run; gates: the kill fired, journals
+#     persisted to requests.jsonl, `obs explain --worst 5` renders, and
+#     every worst-5 journal names a known deciding stage with its level-0
+#     segments summing to its latency within tolerance. Scene B: an
+#     on/off A/B of the same sequential wire load; gate: armed-vs-
+#     disarmed median latency ratio under ASYNCRL_TRACE_AB_MAX (default
+#     1.15x — a noise bar, not a budget: the journal is a few dict
+#     appends per request). ASYNCRL_SMOKE_RECORD=1 appends the A/B as a
+#     kind="observability" probe="request_trace_ab" BENCH_HISTORY row.
 #
 # Usage: scripts/gateway_smoke.sh                  # CPU, ~2-3 min
 #        ASYNCRL_SMOKE_UPDATES=32 scripts/gateway_smoke.sh
@@ -577,4 +588,229 @@ print("gateway_smoke act 4 OK: promotion, kill-mid-canary rollback, "
       "zero mixing, no availability gap")
 EOF
 
-echo "gateway_smoke OK: all four acts green"
+# -------------------------------------------- act 5: request tracing
+# Scene A: journaling armed over a replicated fleet under two-tenant QPS
+# with a replica kill; the persisted journals must survive the `obs
+# explain --worst 5` gate. Scene B: on/off A/B of the same wire load.
+QPS5="${ASYNCRL_GATEWAY_QPS:-50}"
+AB_MAX="${ASYNCRL_TRACE_AB_MAX:-1.15}"
+python - "$QPS5" "$AB_MAX" "$RECORD" <<'EOF'
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from asyncrl_tpu.obs import requests as obs_requests
+from asyncrl_tpu.serve import (
+    BreakerOpen, FleetRouter, GatewayClient, GatewayShed,
+    GatewayUnavailable, ParamFeed, ServeFleet, ServeGateway,
+    parse_tenant_spec,
+)
+from asyncrl_tpu.utils import faults
+
+qps = float(sys.argv[1])
+ab_max = float(sys.argv[2])
+record = sys.argv[3] not in ("", "0")
+TENANT_SPEC = "gold:shed:rps=1000,burst=500;bulk:shed:rps=1000,burst=500"
+DECIDED = {
+    getattr(obs_requests, name)
+    for name in dir(obs_requests) if name.startswith("DECIDED_")
+}
+
+
+def const_fn(params, obs, key):
+    rows = obs.shape[0]
+    return (
+        np.full((rows,), int(params["a"]), np.int32),
+        np.zeros((rows,), np.float32),
+        key,
+    )
+
+
+def build_fleet(num_replicas):
+    feed = ParamFeed({"a": 0})
+    fleet = ServeFleet(
+        const_fn, feed, num_replicas=num_replicas, deadline_ms=2.0,
+        readmit_after_s=0.1, tick_interval_s=0.02,
+    )
+    fleet.start()
+    router = FleetRouter(fleet, obs_shape=(4,))
+    gateway = ServeGateway(
+        router, port=-1, tenants=parse_tenant_spec(TENANT_SPEC)
+    ).start()
+    return fleet, router, gateway
+
+
+class TraceLoad:
+    def __init__(self, port, tenant, rate_hz, seed):
+        self.client = GatewayClient(
+            f"http://127.0.0.1:{port}", tenant=tenant, deadline_ms=2000,
+            retries=2, backoff_base_s=0.01, seed=seed,
+        )
+        self.period = 1.0 / rate_hz
+        self.served = 0
+        self.shed = 0
+        self.failed = 0
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self._run, name=f"traceload-{tenant}", daemon=True
+        )
+
+    def _run(self):
+        obs = np.zeros((2, 4), np.float32)
+        while not self.stop.is_set():
+            try:
+                self.client.act(obs)
+                self.served += 1
+            except GatewayShed:
+                self.shed += 1
+            except (GatewayUnavailable, BreakerOpen):
+                self.failed += 1
+            time.sleep(self.period)
+
+
+# ---- scene A: armed journaling + two-tenant QPS + replica kill
+run_dir = tempfile.mkdtemp(prefix="gwsmoke-trace-")
+# The kill sleeps for its first 50 tick-calls (~1 s at the 0.02 s tick),
+# then takes out one replica mid-load; the supervisor rebuilds it.
+faults.arm("fleet.replica:replica:1.0:0:rmode=kill,max=1,after=50")
+fleet, router, gateway = build_fleet(3)
+obs_requests.arm(run_dir=run_dir, meta={"smoke": "gateway_act5"})
+loaders = [
+    TraceLoad(gateway.port, "gold", qps / 2, seed=7),
+    TraceLoad(gateway.port, "bulk", qps / 2, seed=13),
+]
+for loader in loaders:
+    loader.thread.start()
+try:
+    deadline = time.monotonic() + 20.0
+    # Run until the kill landed AND the rebuilt core served again, with
+    # a floor of ~3 s of steady two-tenant load either way.
+    time.sleep(3.0)
+    while time.monotonic() < deadline and (
+        sum(r.restarts for r in fleet.replicas) < 1
+    ):
+        time.sleep(0.1)
+    time.sleep(0.5)  # post-rebuild traffic lands in the journal too
+    restarts = sum(r.restarts for r in fleet.replicas)
+finally:
+    for loader in loaders:
+        loader.stop.set()
+    for loader in loaders:
+        loader.thread.join(timeout=5)
+    gateway.stop()
+    router.close()
+    fleet.close()
+    faults.disarm()
+
+served = sum(ld.served for ld in loaders)
+print(f"gateway_smoke act 5 scene A: served={served} "
+      f"shed={sum(ld.shed for ld in loaders)} "
+      f"failed={sum(ld.failed for ld in loaders)} restarts={restarts}")
+if served < 20:
+    sys.exit(f"gateway_smoke FAILED (act 5): almost no traffic ({served})")
+if restarts < 1:
+    sys.exit("gateway_smoke FAILED (act 5): the replica kill never fired")
+
+text, code = obs_requests.explain(run_dir, worst=5)
+if code != 0:
+    sys.exit(f"gateway_smoke FAILED (act 5): explain --worst 5 -> {text}")
+print("gateway_smoke act 5: obs explain --worst 5")
+print("\n".join(f"  {line}" for line in text.splitlines()[:12]))
+
+docs = obs_requests.read_jsonl(f"{run_dir}/requests.jsonl")["requests"]
+worst = sorted(
+    docs,
+    key=lambda d: (int(d.get("status", 0)) != 200,
+                   float(d.get("latency_ms", 0.0))),
+    reverse=True,
+)[:5]
+if not worst:
+    sys.exit("gateway_smoke FAILED (act 5): no journal persisted")
+for doc in worst:
+    label = f"trace {doc.get('trace_id')}"
+    if doc.get("decided_by") not in DECIDED:
+        sys.exit(f"gateway_smoke FAILED (act 5): {label} decided_by="
+                 f"{doc.get('decided_by')!r} is not a known stage")
+    if int(doc["status"]) != 200 and not doc.get("cause"):
+        sys.exit(f"gateway_smoke FAILED (act 5): {label} shed with an "
+                 "empty cause")
+    gap = abs(obs_requests.level0_sum_ms(doc) - float(doc["latency_ms"]))
+    if gap > 0.01:
+        sys.exit(f"gateway_smoke FAILED (act 5): {label} level-0 sum "
+                 f"misses latency by {gap:.4f} ms")
+if not any(
+    h.get("stage") == obs_requests.STAGE_ATTEMPT
+    for d in docs for h in d.get("hops", ())
+):
+    sys.exit("gateway_smoke FAILED (act 5): no fleet.attempt hop in any "
+             "journal — fleet-level tracing is dark")
+print(f"gateway_smoke act 5 scene A OK: {len(docs)} journals persisted, "
+      "worst-5 waterfalls sum to their latencies and name their stages")
+
+# ---- scene B: on/off A/B on a clean fleet (no chaos)
+obs_requests.disarm()
+fleet, router, gateway = build_fleet(2)
+client = GatewayClient(
+    f"http://127.0.0.1:{gateway.port}", tenant="gold", deadline_ms=2000,
+    retries=0,
+)
+
+
+def median_latency_ms(n=150, warmup=20):
+    obs = np.zeros((2, 4), np.float32)
+    lat = []
+    for i in range(n + warmup):
+        t0 = time.perf_counter()
+        try:
+            client.act(obs)
+        except GatewayShed:
+            continue
+        dt = 1e3 * (time.perf_counter() - t0)
+        if i >= warmup:
+            lat.append(dt)
+    if not lat:
+        sys.exit("gateway_smoke FAILED (act 5 A/B): nothing served")
+    return float(np.median(np.asarray(lat)))
+
+
+try:
+    p50_off = median_latency_ms()
+    obs_requests.arm(run_dir=run_dir, meta={"smoke": "gateway_act5_ab"})
+    p50_on = median_latency_ms()
+finally:
+    gateway.stop()
+    router.close()
+    fleet.close()
+    obs_requests.disarm()
+
+ratio = p50_on / max(p50_off, 1e-9)
+print(f"gateway_smoke act 5 scene B: p50 off={p50_off:.2f}ms "
+      f"on={p50_on:.2f}ms ratio={ratio:.3f}x (bar {ab_max:.2f}x)")
+if ratio > ab_max:
+    sys.exit(f"gateway_smoke FAILED (act 5 A/B): journaling costs "
+             f"{ratio:.3f}x on the serving path (bar {ab_max:.2f}x)")
+print("gateway_smoke act 5 OK: traced kill-run journals gate, tracing "
+      "overhead inside the noise bar")
+
+if record:
+    from asyncrl_tpu.utils import bench_history
+
+    entry = bench_history.record({
+        "kind": "observability",
+        "probe": "request_trace_ab",
+        "preset": "fleet(standalone)",
+        **bench_history.device_entry(),
+        "qps_offered": qps,
+        "p50_off_ms": round(p50_off, 3),
+        "p50_on_ms": round(p50_on, 3),
+        "trace_overhead_x": round(ratio, 4),
+        "ab_bar_x": ab_max,
+        "journals_persisted": len(docs),
+    })
+    print("gateway_smoke: recorded", entry["ts"])
+EOF
+
+echo "gateway_smoke OK: all five acts green"

@@ -1162,8 +1162,11 @@ def test_removing_the_gateway_isfinite_guard_trips_dln003():
         ),
         (
             "scheduler.py",
-            "        self._slo.finished("
-            "1e3 * (time.monotonic() - request.arrival))\n",
+            "        self._slo.finished(\n"
+            "            1e3 * (time.monotonic() - request.arrival),\n"
+            "            trace_id=journal.trace_id"
+            " if journal is not None else None,\n"
+            "        )\n",
         ),
     ],
 )

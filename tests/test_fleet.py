@@ -15,6 +15,7 @@ import numpy as np
 import pytest
 
 from asyncrl_tpu.obs import health, registry as obs_registry
+from asyncrl_tpu.obs import requests as obs_requests
 from asyncrl_tpu.serve import (
     CanaryController,
     FleetRouter,
@@ -34,6 +35,7 @@ def _fresh_registry():
     obs_registry.registry().reset()
     yield
     obs_registry.registry().reset()
+    obs_requests.disarm()
     faults.disarm()
 
 
@@ -514,6 +516,96 @@ def test_backend_extras_merge_never_overrides_protocol_fields():
         assert result.generation == 5  # ... but protocol fields won
     finally:
         gateway.stop()
+
+
+def test_one_journal_collects_failover_attempts():
+    """One request, N replica attempts, ONE journal: the hung replica's
+    dispatch-timeout attempt and the healthy replica's serving attempt
+    land as level-1 fleet.attempt hops in the same journal, each with
+    its budget share and outcome — retries never fork a new trace."""
+    obs_requests.arm()
+    fleet, _ = _fleet(eject_failures=100)
+    router = FleetRouter(fleet, obs_shape=(4,))
+    hung = fleet.replicas[0]
+    try:
+        hung.enact(faults.ReplicaFault("hang", stall_s=30.0))
+        attempts = []
+        for _ in range(4):  # round-robin: one of these starts at r0
+            journal = obs_requests.begin(
+                "", endpoint="/v1/act", deadline_ms=600.0
+            )
+            with obs_requests.bind(journal):
+                _, _, _, extras = router.act("default", OBS, 600.0)
+            assert extras["replica"] == "r1"  # the healthy one answered
+            attempts = [h for h in journal.hops
+                        if h["stage"] == obs_requests.STAGE_ATTEMPT]
+            assert all(h["level"] == 1 for h in attempts)
+            if len(attempts) == 2:
+                break
+        assert [h["cause"] for h in attempts] == [
+            "dispatch_timeout", "served",
+        ], "no act ever started at the hung replica"
+        assert [h["replica"] for h in attempts] == ["r0", "r1"]
+        assert all(h["budget_share_ms"] > 0 for h in attempts)
+        assert "generation" in attempts[1]
+    finally:
+        hung.enact(faults.ReplicaFault("hang", stall_s=0.0))
+        router.close()
+        fleet.close()
+
+
+def test_fleet_exhausted_names_the_deciding_stage():
+    """An empty candidate set (the sole replica ejected) degrades with
+    ``decided_by=fleet.exhausted`` on the exception — the stage the
+    gateway stamps on the shed answer's journal."""
+    obs_requests.arm()
+    fleet, _ = _fleet(n=1, eject_failures=1, readmit_after_s=60.0)
+    router = FleetRouter(fleet, obs_shape=(4,))
+    hung = fleet.replicas[0]
+    try:
+        hung.enact(faults.ReplicaFault("hang", stall_s=30.0))
+        with pytest.raises(RequestShed):
+            router.act("default", OBS, 150.0)  # times out, ejects r0
+        assert hung.state == "ejected"
+        journal = obs_requests.begin("", deadline_ms=150.0)
+        with obs_requests.bind(journal):
+            with pytest.raises(GatewayDegraded) as excinfo:
+                router.act("default", OBS, 150.0)
+        assert excinfo.value.decided_by == obs_requests.DECIDED_FLEET
+    finally:
+        hung.enact(faults.ReplicaFault("hang", stall_s=0.0))
+        router.close()
+        fleet.close()
+
+
+def test_wire_roundtrip_journal_records_replica_attempt(tmp_path):
+    """Through the full wire stack (gateway over FleetRouter): the
+    journal's fleet.attempt hop names the same replica the response
+    stamps, and the level-0 sum invariant holds end to end."""
+    obs_requests.arm(run_dir=str(tmp_path))
+    fleet, _ = _fleet()
+    router = FleetRouter(fleet, obs_shape=(4,))
+    gateway = ServeGateway(router, port=-1).start()
+    try:
+        sent = "0123456789abcdef"
+        status, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Trace-Id": sent},
+        )
+        assert status == 200 and doc["trace_id"] == sent
+        journal = next(d for d in obs_requests.recent()
+                       if d["trace_id"] == sent)
+        attempts = [h for h in journal["hops"]
+                    if h["stage"] == obs_requests.STAGE_ATTEMPT]
+        assert len(attempts) == 1 and attempts[0]["cause"] == "served"
+        assert attempts[0]["replica"] == doc["replica"]
+        assert obs_requests.level0_sum_ms(journal) == pytest.approx(
+            journal["latency_ms"], abs=1e-6
+        )
+    finally:
+        gateway.stop()
+        router.close()
+        fleet.close()
 
 
 def test_fleet_router_serve_stale_answers_from_the_anchor():

@@ -17,6 +17,7 @@ import pytest
 
 from asyncrl_tpu import make_agent
 from asyncrl_tpu.obs import registry as obs_registry
+from asyncrl_tpu.obs import requests as obs_requests
 from asyncrl_tpu.rollout.sebulba import ParamStore
 from asyncrl_tpu.serve import (
     BreakerOpen,
@@ -44,6 +45,7 @@ def _fresh_registry():
     obs_registry.registry().reset()
     yield
     obs_registry.registry().reset()
+    obs_requests.disarm()
     faults.disarm()
 
 
@@ -311,6 +313,7 @@ def test_budget_death_in_grace_window_answers_429_and_refunds():
     grace) must answer 429 'overloaded' — and hand the rate token back,
     like every other non-served outcome: with burst=1 and negligible
     refill, the follow-up request only succeeds on the refunded token."""
+    obs_requests.arm()
     release = threading.Event()
 
     def wedge_fn(params, obs, key):
@@ -352,6 +355,11 @@ def test_budget_death_in_grace_window_answers_429_and_refunds():
         # Admission passed (the core gate had room); the wire budget and
         # the grace both died against the wedged serve thread.
         assert status == 429 and doc["error"] == "overloaded"
+        # The journal's verdict names the grace window as deciding stage
+        # (a DispatchTimeout shed, not a generic slo-gate one).
+        journal = next(d for d in obs_requests.recent()
+                       if d["trace_id"] == doc["trace_id"])
+        assert journal["decided_by"] == obs_requests.DECIDED_DISPATCH_GRACE
         release.set()
         wedger.join(timeout=10.0)
         assert wedge_result["r"][0] == 200
@@ -895,6 +903,206 @@ def test_netfault_slowloris_times_out_the_client():
     finally:
         gateway.stop()
         faults.disarm()
+
+
+# ------------------------------------------------------ request hop journals
+
+
+def _level0(doc):
+    return [h for h in doc["hops"] if h["level"] == 0]
+
+
+def _journal_for(trace_id):
+    for doc in obs_requests.recent():
+        if doc["trace_id"] == trace_id:
+            return doc
+    raise AssertionError(f"no finished journal for trace {trace_id}")
+
+
+def test_trace_id_round_trips_and_journal_sums_to_latency(tmp_path):
+    """The wire contract: a client-sent X-Trace-Id echoes in the response
+    header AND body; the finished journal's level-0 segments are
+    contiguous and sum to its latency exactly (the budget-waterfall
+    invariant); the journal persists to requests.jsonl where
+    ``obs explain <trace-id>`` finds it."""
+    obs_requests.arm(run_dir=str(tmp_path))
+    gateway = ServeGateway(_StubBackend(), port=-1).start()
+    try:
+        sent = "deadbeefcafe0123"
+        status, headers, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[1, 0, 0, 0]]},
+            headers={"X-Trace-Id": sent, "X-Deadline-Ms": "500"},
+        )
+        assert status == 200
+        assert headers["X-Trace-Id"] == sent and doc["trace_id"] == sent
+        journal = _journal_for(sent)
+        assert journal["status"] == 200
+        assert journal["decided_by"] == obs_requests.DECIDED_SERVED
+        assert journal["deadline_ms"] == 500.0
+        segments = _level0(journal)
+        assert [h["stage"] for h in segments] == [
+            obs_requests.STAGE_PARSE, obs_requests.STAGE_ADMIT,
+            obs_requests.STAGE_SERVE, obs_requests.STAGE_RESPOND,
+        ]
+        for prev, nxt in zip(segments, segments[1:]):
+            assert nxt["t_ms"] == pytest.approx(
+                prev["t_ms"] + prev["dur_ms"], abs=1e-6
+            )
+        assert obs_requests.level0_sum_ms(journal) == pytest.approx(
+            journal["latency_ms"], abs=1e-6
+        )
+        assert segments[2]["generation"] == 7  # backend provenance
+        # No client id: the gateway mints one and still echoes it.
+        status, headers, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[1, 0, 0, 0]]},
+        )
+        assert status == 200
+        minted = doc["trace_id"]
+        assert headers["X-Trace-Id"] == minted
+        assert len(minted) == 16 and int(minted, 16) >= 0
+        # slow_ms=0: every finished journal persisted; explain finds the
+        # trace by id in the run dir.
+        text, code = obs_requests.explain(str(tmp_path), trace_id=sent)
+        assert code == 0 and sent in text
+        parsed = obs_requests.read_jsonl(str(tmp_path / "requests.jsonl"))
+        assert {d["trace_id"] for d in parsed["requests"]} >= {sent, minted}
+    finally:
+        gateway.stop()
+
+
+def test_trace_id_stable_across_client_retries():
+    """One GatewayClient call = one trace id, however many transport
+    attempts: the netfault-killed attempt and the winning retry journal
+    under the SAME wire id, and the dead attempt's verdict names the
+    netfault stage (status 0: no HTTP status reached the client)."""
+    obs_requests.arm()
+    gateway = _armed_gateway(
+        "gateway.request:netfault:1.0:0:net=disconnect,max=1"
+    )
+    try:
+        client = GatewayClient(
+            f"http://127.0.0.1:{gateway.port}", retries=2,
+            backoff_base_s=0.01, deadline_ms=5000,
+        )
+        result = client.act(np.zeros((1, 4), np.float32))
+        assert result.attempts == 2
+        assert result.trace_id and len(result.trace_id) == 16
+        docs = [d for d in obs_requests.recent()
+                if d["trace_id"] == result.trace_id]
+        assert len(docs) == 2  # both wire attempts, one trace id
+        assert docs[0]["status"] == 0
+        assert docs[0]["decided_by"] == obs_requests.DECIDED_NETFAULT
+        assert docs[1]["status"] == 200
+        assert docs[1]["decided_by"] == obs_requests.DECIDED_SERVED
+    finally:
+        gateway.stop()
+        faults.disarm()
+
+
+def test_every_shed_path_names_its_deciding_stage():
+    """Every non-200 verdict names the stage that produced it: parse
+    reject, infeasible deadline, rate bucket, tenant SLO gate, core
+    admission shed, and the degrade path (the dispatch-grace and
+    fleet-exhausted stages are gated in their own tests)."""
+    obs_requests.arm()
+    gateway = ServeGateway(_StubBackend(estimate_ms=200.0), port=-1).start()
+    try:
+        status, _, doc = _post(gateway.port, "/v1/act", {"v": 1})
+        assert status == 400
+        journal = _journal_for(doc["trace_id"])
+        assert journal["decided_by"] == obs_requests.DECIDED_PARSE
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Deadline-Ms": "10"},
+        )
+        assert status == 504
+        journal = _journal_for(doc["trace_id"])
+        assert journal["decided_by"] == obs_requests.DECIDED_DEADLINE
+        assert journal["cause"]  # names the estimate-vs-budget overdraft
+    finally:
+        gateway.stop()
+    gateway = ServeGateway(
+        _StubBackend(), port=-1,
+        tenants=parse_tenant_spec("bulk:shed:rps=0.5,burst=1,inflight=1"),
+    ).start()
+    try:
+        ok, _, _ = _post(gateway.port, "/v1/act",
+                         {"v": 1, "obs": [[0, 0, 0, 0]]},
+                         headers={"X-Tenant": "bulk"})
+        assert ok == 200
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Tenant": "bulk"},
+        )
+        assert status == 429 and doc["error"] == "rate_limited"
+        journal = _journal_for(doc["trace_id"])
+        assert journal["decided_by"] == obs_requests.DECIDED_RATE_BUCKET
+        assert journal["tenant"] == "bulk"
+        # Saturate the inflight cap: the tenant's own SLO gate decides.
+        gateway._tenants["bulk"].gate.admit()
+        gateway._tenants["bulk"].bucket.refund()  # isolate the gate shed
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+            headers={"X-Tenant": "bulk"},
+        )
+        assert status == 429 and doc["error"] == "tenant_slo_shed"
+        journal = _journal_for(doc["trace_id"])
+        assert journal["decided_by"] == obs_requests.DECIDED_TENANT_GATE
+    finally:
+        gateway.stop()
+
+    class SheddingBackend(_StubBackend):
+        def act(self, policy, obs, deadline_ms):
+            raise RequestShed("core gate refused")
+
+    gateway = ServeGateway(SheddingBackend(), port=-1).start()
+    try:
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+        )
+        assert status == 429 and doc["error"] == "overloaded"
+        journal = _journal_for(doc["trace_id"])
+        assert journal["decided_by"] == obs_requests.DECIDED_SLO_GATE
+    finally:
+        gateway.stop()
+    gateway = ServeGateway(_StubBackend(fail=True), port=-1).start()
+    try:
+        status, _, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[0, 0, 0, 0]]},
+        )
+        assert status == 503 and doc["error"] == "degraded"
+        journal = _journal_for(doc["trace_id"])
+        assert journal["decided_by"] == obs_requests.DECIDED_DEGRADE
+    finally:
+        gateway.stop()
+
+
+def test_request_trace_off_constructs_nothing():
+    """Disarmed (the default): no journals, no recent ring, and ZERO
+    request_* registry keys — but a client-sent trace id still echoes
+    (pure wire passthrough, no allocation behind it)."""
+    obs_requests.disarm()
+    gateway = ServeGateway(_StubBackend(), port=-1).start()
+    try:
+        sent = "feedface00000001"
+        status, headers, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[1, 0, 0, 0]]},
+            headers={"X-Trace-Id": sent},
+        )
+        assert status == 200
+        assert headers["X-Trace-Id"] == sent and doc["trace_id"] == sent
+        assert obs_requests.active() is None
+        assert obs_requests.recent() == []
+        assert not [k for k in obs_registry.window()
+                    if k.startswith("request_")]
+        # And with no wire id either, the response carries none at all.
+        status, headers, doc = _post(
+            gateway.port, "/v1/act", {"v": 1, "obs": [[1, 0, 0, 0]]},
+        )
+        assert status == 200
+        assert "X-Trace-Id" not in headers and "trace_id" not in doc
+    finally:
+        gateway.stop()
 
 
 # ------------------------------------------------------------- trainer mount
